@@ -1,0 +1,22 @@
+"""kai_scheduler_tpu — a TPU-native batch/gang scheduling framework.
+
+A from-scratch re-design of NVIDIA/KAI-Scheduler's capabilities
+(hierarchical DRF fair-share, gang scheduling, bin-pack/spread placement,
+preemption/reclaim/consolidation, topology-aware placement, accelerator
+sharing, and the companion controller fleet) where the per-cycle scheduling
+hot loop runs as a single jitted JAX/XLA program over dense cluster tensors.
+
+Layers (mirroring SURVEY.md §1):
+  api/         L0/L2 info model + snapshot tensor packing
+  ops/         JAX kernels: fair-share, predicates, scoring, gang allocate,
+               topology aggregation, scenario batching
+  parallel/    device mesh + shard_map sharding of the cycle kernel
+  framework/   session lifecycle, plugin/action registries, statements
+  plugins/     policy plugins registering tensor terms + host callbacks
+  actions/     allocate / preempt / reclaim / consolidation / staleness
+  controllers/ companion services (binder, podgrouper, queue/status ctrl, ...)
+  models/      workload-kind groupers (the podgrouper GVK table)
+  tools/       offline simulators and replay harnesses
+"""
+
+__version__ = "0.1.0"

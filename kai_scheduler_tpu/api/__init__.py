@@ -1,0 +1,20 @@
+"""Scheduler info model: dense-vector mirrors of the reference's L0/L2 layers.
+
+Reference parity: pkg/scheduler/api/ (resource_info, node_info, pod_info,
+podgroup_info, queue_info, cluster_info) — see SURVEY.md §2.2.
+"""
+
+from . import resources
+from .cluster_info import BindRequest, ClusterInfo
+from .node_info import NodeInfo
+from .pod_info import DEFAULT_SUBGROUP, PodInfo
+from .pod_status import PodStatus
+from .podgroup_info import PodGroupInfo, PodSet, SubGroupNode
+from .queue_info import QueueInfo, QueueQuota
+from .snapshot import LabelCodec, SnapshotTensors, pack
+
+__all__ = [
+    "resources", "BindRequest", "ClusterInfo", "NodeInfo", "PodInfo",
+    "PodStatus", "PodGroupInfo", "PodSet", "SubGroupNode", "QueueInfo",
+    "QueueQuota", "LabelCodec", "SnapshotTensors", "pack", "DEFAULT_SUBGROUP",
+]

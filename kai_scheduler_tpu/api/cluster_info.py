@@ -1,0 +1,106 @@
+"""Point-in-time cluster snapshot.
+
+Mirrors the role of pkg/scheduler/api/cluster_info.go +
+pkg/scheduler/cache/cluster_info/cluster_info.go:118 (Snapshot): an immutable
+in-memory copy of nodes, podgroups, and queues that every action mutates only
+through Statement transactions.  ``pack()`` (api/snapshot.py) produces the
+dense tensor view shipped to the device once per cycle.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from . import resources as rs
+from .node_info import NodeInfo
+from .pod_status import PodStatus
+from .podgroup_info import PodGroupInfo
+from .queue_info import QueueInfo
+
+
+@dataclass
+class BindRequest:
+    """Durable scheduler->binder command (bindrequest_types.go:12)."""
+    pod_uid: str
+    pod_name: str
+    namespace: str
+    node_name: str
+    reconcile_attempts: int = 0
+    gpu_groups: list = field(default_factory=list)
+    backoff_limit: int = 3
+    phase: str = "Pending"  # Pending | Succeeded | Failed
+
+
+class ClusterInfo:
+    def __init__(self, nodes: dict[str, NodeInfo] | None = None,
+                 podgroups: dict[str, PodGroupInfo] | None = None,
+                 queues: dict[str, QueueInfo] | None = None,
+                 topologies: dict | None = None,
+                 now: float = 0.0):
+        self.nodes: dict[str, NodeInfo] = nodes or {}
+        self.podgroups: dict[str, PodGroupInfo] = podgroups or {}
+        self.queues: dict[str, QueueInfo] = queues or {}
+        self.topologies: dict = topologies or {}
+        self.bind_requests: list[BindRequest] = []
+        self.now = now
+        # Stable orderings for tensor packing.
+        self.node_order: list[str] = sorted(self.nodes)
+        for i, name in enumerate(self.node_order):
+            self.nodes[name].idx = i
+        self._wire_tasks_to_nodes()
+
+    def _wire_tasks_to_nodes(self) -> None:
+        """Account every already-placed task on its node (snapshot build)."""
+        for pg in self.podgroups.values():
+            for task in pg.pods.values():
+                if task.node_name and task.node_name in self.nodes:
+                    node = self.nodes[task.node_name]
+                    if task.uid not in node.pod_infos:
+                        node.add_task(task)
+
+    # -- aggregates used by fair-share -------------------------------------
+    def total_allocatable(self) -> np.ndarray:
+        if not self.nodes:
+            return rs.zeros()
+        return np.sum([n.allocatable for n in self.nodes.values()], axis=0)
+
+    def queue_allocated(self) -> dict[str, np.ndarray]:
+        """Per-leaf-queue sum of active-allocated task requests."""
+        out = {qid: rs.zeros() for qid in self.queues}
+        for pg in self.podgroups.values():
+            if pg.queue_id not in out:
+                continue
+            for t in pg.pods.values():
+                if t.is_active_allocated():
+                    out[pg.queue_id] += t.req_vec()
+        return out
+
+    def queue_requested(self) -> dict[str, np.ndarray]:
+        """Per-leaf-queue total demand (alive tasks)."""
+        out = {qid: rs.zeros() for qid in self.queues}
+        for pg in self.podgroups.values():
+            if pg.queue_id not in out:
+                continue
+            for t in pg.pods.values():
+                if t.status in (PodStatus.PENDING, PodStatus.GATED) or t.is_active_allocated():
+                    out[pg.queue_id] += t.req_vec()
+        return out
+
+    def pending_jobs(self) -> list[PodGroupInfo]:
+        return [pg for pg in self.podgroups.values()
+                if pg.has_tasks_to_allocate() and pg.is_ready_for_scheduling()]
+
+    def clone(self) -> "ClusterInfo":
+        # Node accounting is fully derived from task state, so clone bare
+        # nodes and let __init__ re-wire the cloned tasks onto them.
+        bare_nodes = {
+            name: NodeInfo(node.name, node.allocatable.copy(),
+                           dict(node.labels), set(node.taints),
+                           node.gpu_memory_per_device, node.max_pods, node.idx)
+            for name, node in self.nodes.items()}
+        return ClusterInfo(
+            bare_nodes,
+            {uid: pg.clone() for uid, pg in self.podgroups.items()},
+            dict(self.queues), dict(self.topologies), self.now)

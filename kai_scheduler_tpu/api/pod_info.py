@@ -1,0 +1,65 @@
+"""Task (pod) info — the schedulable unit.
+
+Mirrors the behavioral surface of pkg/scheduler/api/pod_info/pod_info.go:
+resource-request parsing (including gpu-fraction / gpu-memory annotations),
+status tracking, subgroup membership, and preemptibility.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from .pod_status import PodStatus, is_active_allocated, is_active_used
+from .resources import ResourceRequirements
+
+DEFAULT_SUBGROUP = "default"
+
+
+@dataclass
+class PodInfo:
+    uid: str
+    name: str
+    namespace: str = "default"
+    job_id: str = ""                 # owning PodGroup uid
+    subgroup: str = DEFAULT_SUBGROUP
+    res_req: ResourceRequirements = field(default_factory=ResourceRequirements)
+    status: PodStatus = PodStatus.PENDING
+    node_name: str = ""
+    priority: int = 0
+    # Scheduling constraints (encoded, see cluster_info.LabelCodec):
+    node_selector: dict = field(default_factory=dict)   # label -> required value
+    tolerations: set = field(default_factory=set)       # taint keys tolerated
+    accepted_resource_types: Optional[set] = None       # None = any
+    # Fraction bookkeeping
+    gpu_group: str = ""  # shared-GPU group id once placed fractionally
+    # Index into the packed task tensor for the current snapshot.
+    tensor_idx: int = -1
+
+    def is_active_used(self) -> bool:
+        return is_active_used(self.status)
+
+    def is_active_allocated(self) -> bool:
+        return is_active_allocated(self.status)
+
+    @property
+    def is_fractional(self) -> bool:
+        return self.res_req.is_fractional
+
+    def req_vec(self, node_gpu_memory: float = 0.0) -> np.ndarray:
+        return self.res_req.to_vec(node_gpu_memory)
+
+    def clone(self) -> "PodInfo":
+        return PodInfo(
+            uid=self.uid, name=self.name, namespace=self.namespace,
+            job_id=self.job_id, subgroup=self.subgroup,
+            res_req=self.res_req.clone(), status=self.status,
+            node_name=self.node_name, priority=self.priority,
+            node_selector=dict(self.node_selector),
+            tolerations=set(self.tolerations),
+            accepted_resource_types=(set(self.accepted_resource_types)
+                                     if self.accepted_resource_types else None),
+            gpu_group=self.gpu_group, tensor_idx=self.tensor_idx,
+        )

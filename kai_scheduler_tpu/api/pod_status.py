@@ -1,0 +1,45 @@
+"""Task lifecycle statuses.
+
+Mirrors the status lattice of the reference scheduler
+(pkg/scheduler/api/pod_status/pod_status.go:23-70): statuses are flags and the
+interesting queries are membership in the aggregate sets below.
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class PodStatus(enum.IntFlag):
+    PENDING = enum.auto()
+    GATED = enum.auto()
+    ALLOCATED = enum.auto()   # scheduler assigned a host this session
+    PIPELINED = enum.auto()   # assigned onto releasing resources
+    BINDING = enum.auto()     # bind request in flight
+    BOUND = enum.auto()
+    RUNNING = enum.auto()
+    RELEASING = enum.auto()   # being deleted / evicted
+    SUCCEEDED = enum.auto()
+    FAILED = enum.auto()
+    UNKNOWN = enum.auto()
+    DELETED = enum.auto()
+
+
+S = PodStatus
+ACTIVE_USED = S.ALLOCATED | S.PIPELINED | S.BINDING | S.BOUND | S.RUNNING | S.RELEASING
+ACTIVE_ALLOCATED = S.ALLOCATED | S.PIPELINED | S.BINDING | S.BOUND | S.RUNNING
+ALIVE = S.ALLOCATED | S.PIPELINED | S.BINDING | S.BOUND | S.RUNNING | S.PENDING | S.GATED
+BOUND_STATUSES = S.ALLOCATED | S.BOUND | S.RUNNING | S.RELEASING
+ALLOCATED_STATUSES = S.ALLOCATED | S.BOUND | S.BINDING | S.RUNNING
+
+
+def is_active_used(s: PodStatus) -> bool:
+    return bool(s & ACTIVE_USED)
+
+
+def is_active_allocated(s: PodStatus) -> bool:
+    return bool(s & ACTIVE_ALLOCATED)
+
+
+def is_alive(s: PodStatus) -> bool:
+    return bool(s & ALIVE)

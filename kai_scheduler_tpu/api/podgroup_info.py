@@ -1,0 +1,292 @@
+"""PodGroup (job) info: gang semantics, subgroup tree, task selection.
+
+Mirrors the behavioral surface of pkg/scheduler/api/podgroup_info/
+(job_info.go, allocation_info.go, subgroup_info/): a job is a PodGroup plus
+its tasks, organized into pod sets (leaf subgroups with their own
+minAvailable) under a hierarchical subgroup tree.  Key reproduced behaviors:
+gang readiness (job_info.go:434), staleness (:417), elasticity (:408),
+pipelining decision (:443), task selection for the next allocation attempt
+(allocation_info.go:26-177), and the scheduling-constraints signature
+(:547) used to skip provably-unschedulable lookalike jobs.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import Callable, Iterable, Optional
+
+import numpy as np
+
+from . import resources as rs
+from .pod_info import DEFAULT_SUBGROUP, PodInfo
+from .pod_status import PodStatus, is_active_allocated, is_active_used, is_alive
+
+
+class PodSet:
+    """Leaf subgroup: a set of interchangeable tasks with a gang minimum."""
+
+    def __init__(self, name: str, min_available: int, parent: str | None = None):
+        self.name = name
+        self.min_available = int(min_available)
+        self.parent = parent  # name of parent SubGroupSet node, None = root
+        self.pods: dict[str, PodInfo] = {}
+
+    def add(self, task: PodInfo) -> None:
+        self.pods[task.uid] = task
+
+    def remove(self, task: PodInfo) -> None:
+        self.pods.pop(task.uid, None)
+
+    def num_active_allocated(self) -> int:
+        return sum(1 for t in self.pods.values() if t.is_active_allocated())
+
+    def num_active_used(self) -> int:
+        return sum(1 for t in self.pods.values() if t.is_active_used())
+
+    def num_alive(self) -> int:
+        return sum(1 for t in self.pods.values() if is_alive(t.status))
+
+    def is_gang_satisfied(self) -> bool:
+        return self.num_active_used() >= self.min_available
+
+    def is_ready_for_scheduling(self) -> bool:
+        return self.num_alive() >= self.min_available
+
+    def is_elastic(self) -> bool:
+        return len(self.pods) > self.min_available
+
+
+@dataclass
+class SubGroupNode:
+    """Interior node of the hierarchical subgroup tree (Grove-style gangs)."""
+    name: str
+    parent: str | None = None
+    children: list[str] = field(default_factory=list)   # child SubGroupNode names
+    pod_sets: list[str] = field(default_factory=list)   # child PodSet names
+    # Optional topology constraint levels for this gang subtree.
+    required_level: str | None = None
+    preferred_level: str | None = None
+
+
+class PodGroupInfo:
+    def __init__(self, uid: str, name: str, namespace: str = "default",
+                 queue_id: str = "default", priority: int = 0,
+                 min_available: int = 1, preemptible: bool = True,
+                 creation_ts: float = 0.0,
+                 staleness_grace_seconds: float | None = 60.0,
+                 required_topology_level: str | None = None,
+                 preferred_topology_level: str | None = None,
+                 topology_name: str | None = None):
+        self.uid = uid
+        self.name = name
+        self.namespace = namespace
+        self.queue_id = queue_id
+        self.priority = priority
+        self.preemptible = preemptible
+        self.creation_ts = creation_ts
+        self.staleness_grace_seconds = staleness_grace_seconds
+        self.last_start_ts: float | None = None
+        self.pod_sets: dict[str, PodSet] = {
+            DEFAULT_SUBGROUP: PodSet(DEFAULT_SUBGROUP, min_available)}
+        self.subgroup_nodes: dict[str, SubGroupNode] = {}
+        self.pods: dict[str, PodInfo] = {}
+        self.fit_errors: list[str] = []
+        self.task_fit_errors: dict[str, str] = {}
+        self.required_topology_level = required_topology_level
+        self.preferred_topology_level = preferred_topology_level
+        self.topology_name = topology_name
+        # caches (invalidated on status change, job_info.go:281)
+        self._tasks_to_allocate: Optional[list[PodInfo]] = None
+        self._signature: Optional[str] = None
+
+    # -- structure ---------------------------------------------------------
+    def set_pod_sets(self, pod_sets: Iterable[PodSet],
+                     subgroup_nodes: Iterable[SubGroupNode] = ()) -> None:
+        self.pod_sets = {ps.name: ps for ps in pod_sets}
+        self.subgroup_nodes = {sg.name: sg for sg in subgroup_nodes}
+        for task in self.pods.values():
+            self._index_task(task)
+
+    def _index_task(self, task: PodInfo) -> None:
+        ps = self.pod_sets.get(task.subgroup)
+        if ps is None:
+            ps = self.pod_sets.get(DEFAULT_SUBGROUP)
+            if ps is None:
+                ps = PodSet(DEFAULT_SUBGROUP, 1)
+                self.pod_sets[DEFAULT_SUBGROUP] = ps
+        ps.add(task)
+
+    def add_task(self, task: PodInfo) -> None:
+        task.job_id = self.uid
+        self.pods[task.uid] = task
+        self._index_task(task)
+        self.invalidate_caches()
+
+    def update_task_status(self, task: PodInfo, status: PodStatus) -> None:
+        task.status = status
+        self.invalidate_caches()
+
+    def invalidate_caches(self) -> None:
+        self._tasks_to_allocate = None
+        self._signature = None
+
+    # -- aggregate state ---------------------------------------------------
+    def num_active_used(self) -> int:
+        return sum(1 for t in self.pods.values() if t.is_active_used())
+
+    def num_active_allocated(self) -> int:
+        return sum(1 for t in self.pods.values() if t.is_active_allocated())
+
+    def pending_tasks(self) -> list[PodInfo]:
+        return [t for t in self.pods.values() if t.status == PodStatus.PENDING]
+
+    def is_gang_satisfied(self) -> bool:
+        return all(ps.is_gang_satisfied() for ps in self.pod_sets.values())
+
+    def is_ready_for_scheduling(self) -> bool:
+        return all(ps.is_ready_for_scheduling() for ps in self.pod_sets.values())
+
+    def is_elastic(self) -> bool:
+        return any(ps.is_elastic() for ps in self.pod_sets.values())
+
+    def is_stale(self) -> bool:
+        """Partially-running gang below minAvailable (job_info.go:417)."""
+        if any(t.status == PodStatus.SUCCEEDED for t in self.pods.values()):
+            return False
+        if self.num_active_used() == 0:
+            return False
+        return not self.is_gang_satisfied()
+
+    def should_pipeline(self) -> bool:
+        """If any podset has a pipelined task and too few allocated for the
+        gang, the whole job's new placements must pipeline (job_info.go:443)."""
+        for ps in self.pod_sets.values():
+            has_pipelined = any(t.status == PodStatus.PIPELINED
+                                for t in ps.pods.values())
+            active_allocated = sum(1 for t in ps.pods.values()
+                                   if is_active_allocated(t.status))
+            if has_pipelined and active_allocated < ps.min_available:
+                return True
+        return False
+
+    def is_preemptible(self) -> bool:
+        return self.preemptible
+
+    # -- task selection for one allocation attempt -------------------------
+    def _should_allocate(self, task: PodInfo, real_allocation: bool) -> bool:
+        if task.status == PodStatus.PENDING:
+            return True
+        # During scenario simulation, releasing tasks may be re-placed.
+        if not real_allocation and task.status == PodStatus.RELEASING:
+            return True
+        return False
+
+    def tasks_to_allocate(self, subgroup_order_fn: Callable | None = None,
+                          task_order_fn: Callable | None = None,
+                          real_allocation: bool = True) -> list[PodInfo]:
+        """Select the next chunk of tasks to try to place.
+
+        Mirrors GetTasksToAllocate (allocation_info.go:26): while any podset
+        is below its gang minimum, only those podsets contribute, each its
+        (minAvailable - allocated) chunk; once all podsets are satisfied, grow
+        elastically one task at a time from one podset per attempt (:145-177).
+        """
+        # The cache is only valid for the default orderings; explicit
+        # ordering functions always recompute.
+        cacheable = (real_allocation and subgroup_order_fn is None
+                     and task_order_fn is None)
+        if cacheable and self._tasks_to_allocate is not None:
+            return self._tasks_to_allocate
+
+        unsatisfied = [ps for ps in self.pod_sets.values()
+                       if ps.num_active_allocated() < ps.min_available]
+        if unsatisfied:
+            eligible, max_subgroups = unsatisfied, len(unsatisfied)
+        else:
+            eligible, max_subgroups = list(self.pod_sets.values()), 1
+
+        eligible = sorted(eligible,
+                          key=(subgroup_order_fn or (lambda ps: ps.name)))
+        out: list[PodInfo] = []
+        taken_subgroups = 0
+        for ps in eligible:
+            if taken_subgroups >= max_subgroups:
+                break
+            candidates = [t for t in ps.pods.values()
+                          if self._should_allocate(t, real_allocation)]
+            if not candidates:
+                continue
+            candidates.sort(key=(task_order_fn or (lambda t: (t.name, t.uid))))
+            allocated = ps.num_active_allocated()
+            if allocated >= ps.min_available:
+                take = 1
+            else:
+                take = ps.min_available - allocated
+            out.extend(candidates[:take])
+            taken_subgroups += 1
+
+        if cacheable:
+            self._tasks_to_allocate = out
+        return out
+
+    def has_tasks_to_allocate(self, real_allocation: bool = True) -> bool:
+        return any(self._should_allocate(t, real_allocation)
+                   for t in self.pods.values())
+
+    def tasks_to_allocate_init_resource(self, **kw) -> np.ndarray:
+        total = rs.zeros()
+        for t in self.tasks_to_allocate(real_allocation=False, **kw):
+            total += t.req_vec()
+        return total
+
+    # -- scheduling-constraints signature ----------------------------------
+    def scheduling_signature(self) -> str:
+        """Hash of everything that determines schedulability, used to skip
+        jobs identical to one that already failed (job_info.go:547)."""
+        if self._signature is not None:
+            return self._signature
+        h = hashlib.sha256()
+        h.update(self.queue_id.encode())
+        h.update(str(self.priority).encode())
+        h.update(str(self.required_topology_level).encode())
+        h.update(str(self.preferred_topology_level).encode())
+        for ps_name in sorted(self.pod_sets):
+            ps = self.pod_sets[ps_name]
+            h.update(f"{ps_name}:{ps.min_available}".encode())
+            reqs = sorted(
+                (tuple(t.req_vec()), tuple(sorted(t.node_selector.items())),
+                 tuple(sorted(t.tolerations)))
+                for t in ps.pods.values() if t.status == PodStatus.PENDING)
+            h.update(repr(reqs).encode())
+        self._signature = h.hexdigest()
+        return self._signature
+
+    # -- errors / explainability -------------------------------------------
+    def add_fit_error(self, message: str) -> None:
+        self.fit_errors.append(message)
+
+    def add_task_fit_error(self, task: PodInfo, message: str) -> None:
+        self.task_fit_errors[task.uid] = message
+
+    def clone(self) -> "PodGroupInfo":
+        pg = PodGroupInfo(
+            self.uid, self.name, self.namespace, self.queue_id, self.priority,
+            1, self.preemptible, self.creation_ts,
+            self.staleness_grace_seconds, self.required_topology_level,
+            self.preferred_topology_level, self.topology_name)
+        pg.pod_sets = {n: PodSet(p.name, p.min_available, p.parent)
+                       for n, p in self.pod_sets.items()}
+        pg.subgroup_nodes = {
+            n: SubGroupNode(s.name, s.parent, list(s.children),
+                            list(s.pod_sets), s.required_level,
+                            s.preferred_level)
+            for n, s in self.subgroup_nodes.items()}
+        pg.last_start_ts = self.last_start_ts
+        for t in self.pods.values():
+            pg.add_task(t.clone())
+        return pg
+
+    def __repr__(self) -> str:
+        return (f"PodGroupInfo({self.namespace}/{self.name}, queue={self.queue_id}, "
+                f"pods={len(self.pods)}, active={self.num_active_used()})")

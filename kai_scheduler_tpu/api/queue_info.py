@@ -1,0 +1,58 @@
+"""Queue info: hierarchical quota nodes.
+
+Mirrors pkg/scheduler/api/queue_info/queue_info.go (quota / over-quota-weight
+/ limit per resource, parent/children, priority) — the inputs to the DRF
+fair-share division (ops/fairshare.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from . import resources as rs
+
+
+@dataclass
+class QueueQuota:
+    """Per-resource quota triple, dense over NUM_RES."""
+    deserved: np.ndarray = field(default_factory=rs.unlimited)
+    limit: np.ndarray = field(default_factory=rs.unlimited)  # MaxAllowed
+    over_quota_weight: np.ndarray = field(
+        default_factory=lambda: np.ones(rs.NUM_RES))
+
+    @classmethod
+    def from_spec(cls, deserved=None, limit=None, over_quota_weight=1.0):
+        def _v(spec, default):
+            if spec is None:
+                return default()
+            if isinstance(spec, np.ndarray):
+                return spec.astype(np.float64)
+            return rs.vec_from_spec(**spec)
+        w = over_quota_weight
+        if not isinstance(w, np.ndarray):
+            w = np.full(rs.NUM_RES, float(w))
+        return cls(_v(deserved, rs.unlimited), _v(limit, rs.unlimited), w)
+
+
+@dataclass
+class QueueInfo:
+    uid: str
+    name: str = ""
+    parent: str | None = None
+    children: list = field(default_factory=list)
+    priority: int = 0
+    creation_ts: float = 0.0
+    quota: QueueQuota = field(default_factory=QueueQuota)
+    # Min-runtime protection windows (minruntime plugin), seconds.
+    preempt_min_runtime: float | None = None
+    reclaim_min_runtime: float | None = None
+
+    def __post_init__(self):
+        if not self.name:
+            self.name = self.uid
+
+    @property
+    def is_top(self) -> bool:
+        return self.parent is None

@@ -1,0 +1,187 @@
+"""Dense resource algebra — the tensorization seam of the framework.
+
+The reference models cluster resources as a float64 algebra over
+{milli-CPU, memory-bytes, GPU-devices} plus a dense ``ResourceVector []float64``
+mirror (reference: pkg/scheduler/api/resource_info/resource_vector.go:15-130,
+base_resources.go:19-20).  Here the dense vector IS the primary representation:
+every node, task, and queue carries a fixed-width ``numpy.float64[NUM_RES]``
+vector so that an entire cluster snapshot packs into ``[N, NUM_RES]`` matrices
+that ship to the TPU unchanged.
+
+Resource order is fixed: CPU (milli-cores), MEMORY (bytes), GPU (devices,
+fractional allowed).  Extended resources can be appended by widening NUM_RES
+at snapshot-pack time; the kernels are width-agnostic.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+import numpy as np
+
+# Resource axis indices (order mirrors resource_share.AllResources semantics).
+RES_CPU = 0  # milli-CPU
+RES_MEM = 1  # bytes
+RES_GPU = 2  # device count (fractions allowed for shared accelerators)
+NUM_RES = 3
+
+RESOURCE_NAMES = ("cpu", "memory", "gpu")
+
+# Sentinel for "no quota limit" (reference: pkg/common/constants/constants.go:11).
+UNLIMITED = float(-1)
+
+MILLI_CPU_TO_CORES = 1000.0
+MEMORY_TO_GB = 1000.0 * 1000.0 * 1000.0
+
+_MEM_SUFFIX = {
+    "": 1.0,
+    "k": 1e3, "M": 1e6, "G": 1e9, "T": 1e12, "P": 1e15,
+    "Ki": 2.0 ** 10, "Mi": 2.0 ** 20, "Gi": 2.0 ** 30, "Ti": 2.0 ** 40,
+    "Pi": 2.0 ** 50,
+}
+
+_QTY_RE = re.compile(r"^\s*(-?\d+(?:\.\d+)?)\s*([A-Za-z]*)\s*$")
+
+
+def parse_cpu(value: "str | int | float") -> float:
+    """Parse a Kubernetes CPU quantity into milli-cores ("500m" -> 500, 2 -> 2000)."""
+    if isinstance(value, (int, float)):
+        return float(value) * MILLI_CPU_TO_CORES
+    m = _QTY_RE.match(value)
+    if not m:
+        raise ValueError(f"bad cpu quantity: {value!r}")
+    num, suffix = float(m.group(1)), m.group(2)
+    if suffix == "m":
+        return num
+    if suffix == "":
+        return num * MILLI_CPU_TO_CORES
+    raise ValueError(f"bad cpu suffix: {value!r}")
+
+
+def parse_memory(value: "str | int | float") -> float:
+    """Parse a Kubernetes memory quantity into bytes ("1Gi" -> 2**30)."""
+    if isinstance(value, (int, float)):
+        return float(value)
+    m = _QTY_RE.match(value)
+    if not m:
+        raise ValueError(f"bad memory quantity: {value!r}")
+    num, suffix = float(m.group(1)), m.group(2)
+    if suffix not in _MEM_SUFFIX:
+        raise ValueError(f"bad memory suffix: {value!r}")
+    return num * _MEM_SUFFIX[suffix]
+
+
+def vec(cpu_milli: float = 0.0, memory: float = 0.0, gpu: float = 0.0) -> np.ndarray:
+    """Build a resource vector from raw units (milli-CPU, bytes, GPUs)."""
+    v = np.zeros(NUM_RES, dtype=np.float64)
+    v[RES_CPU] = cpu_milli
+    v[RES_MEM] = memory
+    v[RES_GPU] = gpu
+    return v
+
+
+def vec_from_spec(cpu: "str | float | None" = None,
+                  memory: "str | float | None" = None,
+                  gpu: float = 0.0) -> np.ndarray:
+    """Build a resource vector from K8s-style quantities ("500m", "1Gi", 2)."""
+    return vec(
+        parse_cpu(cpu) if cpu is not None else 0.0,
+        parse_memory(memory) if memory is not None else 0.0,
+        float(gpu),
+    )
+
+
+def zeros() -> np.ndarray:
+    return np.zeros(NUM_RES, dtype=np.float64)
+
+
+def unlimited() -> np.ndarray:
+    return np.full(NUM_RES, UNLIMITED, dtype=np.float64)
+
+
+def less_equal(a: np.ndarray, b: np.ndarray, eps: float = 1e-9) -> bool:
+    """a <= b element-wise, treating UNLIMITED entries of b as +inf.
+
+    Mirrors ResourceVector.LessEqual semantics (resource_vector.go) with a
+    small epsilon for float accumulation drift.
+    """
+    b_eff = np.where(b == UNLIMITED, np.inf, b)
+    return bool(np.all(a <= b_eff + eps))
+
+
+def less_in_at_least_one(a: np.ndarray, b: np.ndarray) -> bool:
+    b_eff = np.where(b == UNLIMITED, np.inf, b)
+    return bool(np.any(a < b_eff))
+
+
+def clip_unlimited(v: np.ndarray, fallback: np.ndarray) -> np.ndarray:
+    """Replace UNLIMITED entries with values from ``fallback``."""
+    return np.where(v == UNLIMITED, fallback, v)
+
+
+def humanize(v: np.ndarray) -> str:
+    return (f"cpu={v[RES_CPU] / MILLI_CPU_TO_CORES:g}cores "
+            f"mem={v[RES_MEM] / MEMORY_TO_GB:g}GB gpu={v[RES_GPU]:g}")
+
+
+@dataclass
+class ResourceRequirements:
+    """A task's resource request, including fractional-accelerator forms.
+
+    Mirrors resource_info.ResourceRequirements / GpuResourceRequirement
+    (reference: pkg/scheduler/api/resource_info/resource_requirment.go):
+    a task requests either N whole GPUs, a fraction of one GPU, or a GPU
+    memory amount (converted to a fraction against node GPU memory at
+    snapshot time).
+    """
+
+    base: np.ndarray = field(default_factory=zeros)  # cpu/mem (+whole gpus)
+    gpu_fraction: float = 0.0      # 0 < f < 1 when sharing one device
+    gpu_memory_bytes: float = 0.0  # alternative fractional form
+    num_fraction_devices: int = 1  # multi-fraction gangs (rare)
+
+    @property
+    def is_fractional(self) -> bool:
+        return self.gpu_fraction > 0.0 or self.gpu_memory_bytes > 0.0
+
+    def gpus(self) -> float:
+        """Effective GPU device count for capacity math."""
+        if self.gpu_fraction > 0.0:
+            return self.gpu_fraction * self.num_fraction_devices
+        return float(self.base[RES_GPU])
+
+    def to_vec(self, node_gpu_memory: float = 0.0) -> np.ndarray:
+        """Dense vector for capacity accounting.
+
+        ``gpu_memory_bytes`` requests are resolved against a node's per-GPU
+        memory when known; otherwise they count as a whole device (the
+        conservative choice the reference makes via minNodeGPUMemory).
+        """
+        v = self.base.copy()
+        if self.gpu_fraction > 0.0:
+            v[RES_GPU] = self.gpu_fraction * self.num_fraction_devices
+        elif self.gpu_memory_bytes > 0.0:
+            if node_gpu_memory > 0.0:
+                frac = min(1.0, self.gpu_memory_bytes / node_gpu_memory)
+            else:
+                frac = 1.0
+            v[RES_GPU] = frac * self.num_fraction_devices
+        return v
+
+    @classmethod
+    def from_spec(cls, cpu=None, memory=None, gpu: float = 0.0,
+                  gpu_fraction: float = 0.0, gpu_memory=None,
+                  num_fraction_devices: int = 1) -> "ResourceRequirements":
+        base = vec_from_spec(cpu, memory, gpu if gpu_fraction == 0.0 else 0.0)
+        return cls(
+            base=base,
+            gpu_fraction=float(gpu_fraction),
+            gpu_memory_bytes=parse_memory(gpu_memory) if gpu_memory else 0.0,
+            num_fraction_devices=num_fraction_devices,
+        )
+
+    def clone(self) -> "ResourceRequirements":
+        return ResourceRequirements(self.base.copy(), self.gpu_fraction,
+                                    self.gpu_memory_bytes,
+                                    self.num_fraction_devices)

@@ -1,0 +1,236 @@
+"""Snapshot -> dense tensor packing: the host<->device seam.
+
+The reference keeps dense ``ResourceVector`` mirrors alongside its pointer
+graph precisely so state can be serialized cheaply
+(pkg/scheduler/api/node_info/node_info.go:82-89,
+resource_info/resource_vector.go:15).  Here that seam is primary: once per
+cycle the ClusterInfo packs into the arrays below and ships to the device,
+where the predicate mask, score matrix, fair-share vectors, and gang
+allocation run as one jitted program (SURVEY.md §7).
+
+Label/taint constraints are encoded through a vocabulary codec so that the
+node-affinity and toleration predicates become pure integer-compare tensor
+ops (no strings on device).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from . import resources as rs
+from .cluster_info import ClusterInfo
+from .pod_info import PodInfo
+from .podgroup_info import PodGroupInfo
+
+NO_LABEL = -1      # node lacks the label / task doesn't constrain it
+NO_TAINT = -1
+
+
+class LabelCodec:
+    """Maps (label key -> column, label value -> int code) and taints -> codes."""
+
+    def __init__(self):
+        self.key_cols: dict[str, int] = {}
+        self.value_codes: dict[tuple[str, str], int] = {}
+        self.taint_codes: dict[str, int] = {}
+
+    def key_col(self, key: str) -> int:
+        if key not in self.key_cols:
+            self.key_cols[key] = len(self.key_cols)
+        return self.key_cols[key]
+
+    def value_code(self, key: str, value: str) -> int:
+        k = (key, value)
+        if k not in self.value_codes:
+            self.value_codes[k] = len(self.value_codes)
+        return self.value_codes[k]
+
+    def taint_code(self, taint: str) -> int:
+        if taint not in self.taint_codes:
+            self.taint_codes[taint] = len(self.taint_codes)
+        return self.taint_codes[taint]
+
+    @property
+    def num_cols(self) -> int:
+        return len(self.key_cols)
+
+
+@dataclass
+class SnapshotTensors:
+    """Dense, device-ready view of one scheduling cycle's inputs."""
+    # --- nodes [N, ...] ---
+    node_allocatable: np.ndarray   # [N,R] f64
+    node_idle: np.ndarray          # [N,R]
+    node_releasing: np.ndarray     # [N,R]
+    node_labels: np.ndarray        # [N,L] int32, NO_LABEL where absent
+    node_taints: np.ndarray        # [N,Tt] int32, NO_TAINT padding
+    node_pod_room: np.ndarray      # [N] f64 remaining pod slots
+    # --- tasks (pending, candidate set) [T, ...] ---
+    task_req: np.ndarray           # [T,R] f64
+    task_job: np.ndarray           # [T] int32 job index
+    task_selector: np.ndarray      # [T,L] int32, NO_LABEL = unconstrained
+    task_tolerations: np.ndarray   # [T,Tl] int32, NO_TAINT padding
+    # --- jobs [J, ...] ---
+    job_queue: np.ndarray          # [J] int32 queue index
+    job_min_available: np.ndarray  # [J] int32
+    job_task_start: np.ndarray     # [J] int32 offset into task arrays
+    job_task_count: np.ndarray     # [J] int32
+    # --- queues [Q, ...] ---
+    queue_deserved: np.ndarray     # [Q,R] f64 (UNLIMITED = -1)
+    queue_limit: np.ndarray        # [Q,R]
+    queue_over_quota_weight: np.ndarray  # [Q,R]
+    queue_priority: np.ndarray     # [Q] int32
+    queue_parent: np.ndarray       # [Q] int32, -1 for top queues
+    queue_creation: np.ndarray     # [Q] f64
+    queue_allocated: np.ndarray    # [Q,R] f64
+    queue_requested: np.ndarray    # [Q,R] f64
+    queue_usage: np.ndarray        # [Q,R] f64 normalized historical usage
+    # --- index maps (host-side only) ---
+    node_names: list = field(default_factory=list)
+    task_uids: list = field(default_factory=list)
+    job_uids: list = field(default_factory=list)
+    queue_uids: list = field(default_factory=list)
+
+    @property
+    def num_nodes(self) -> int:
+        return self.node_allocatable.shape[0]
+
+    @property
+    def num_tasks(self) -> int:
+        return self.task_req.shape[0]
+
+
+def build_codec(cluster: ClusterInfo,
+                tasks: list[PodInfo]) -> LabelCodec:
+    codec = LabelCodec()
+    # Only label keys that some task constrains need columns.
+    for t in tasks:
+        for k in t.node_selector:
+            codec.key_col(k)
+    for node in cluster.nodes.values():
+        for k, v in node.labels.items():
+            if k in codec.key_cols:
+                codec.value_code(k, v)
+        for taint in node.taints:
+            codec.taint_code(taint)
+    return codec
+
+
+def pack(cluster: ClusterInfo,
+         jobs: list[PodGroupInfo] | None = None,
+         queue_usage: dict[str, np.ndarray] | None = None,
+         pad_nodes_to: int | None = None,
+         real_allocation: bool = True) -> SnapshotTensors:
+    """Pack the snapshot; ``jobs`` selects the candidate pending jobs
+    (defaults to all jobs with tasks to allocate).  ``pad_nodes_to`` rounds
+    the node axis up to a bucket size to avoid recompilation across cycles.
+    ``real_allocation=False`` additionally admits RELEASING tasks as
+    candidates — only scenario simulation wants that.
+    """
+    if jobs is None:
+        jobs = sorted(cluster.pending_jobs(), key=lambda j: j.uid)
+    # A job pointing at an unknown queue must not alias onto queue 0.
+    jobs = [pg for pg in jobs if pg.queue_id in cluster.queues]
+
+    tasks: list[PodInfo] = []
+    job_start, job_count = [], []
+    for pg in jobs:
+        start = len(tasks)
+        sel = pg.tasks_to_allocate(real_allocation=real_allocation)
+        tasks.extend(sel)
+        job_start.append(start)
+        job_count.append(len(sel))
+
+    codec = build_codec(cluster, tasks)
+    L = max(1, codec.num_cols)
+    max_taints = max([len(n.taints) for n in cluster.nodes.values()] + [1])
+    max_tols = max([len(t.tolerations) for t in tasks] + [1])
+
+    node_names = cluster.node_order
+    n = len(node_names)
+    n_pad = max(pad_nodes_to or n, n)
+
+    node_alloc = np.zeros((n_pad, rs.NUM_RES))
+    node_idle = np.zeros((n_pad, rs.NUM_RES))
+    node_rel = np.zeros((n_pad, rs.NUM_RES))
+    node_labels = np.full((n_pad, L), NO_LABEL, np.int32)
+    node_taints = np.full((n_pad, max_taints), NO_TAINT, np.int32)
+    node_room = np.zeros(n_pad)
+    for i, name in enumerate(node_names):
+        node = cluster.nodes[name]
+        node_alloc[i] = node.allocatable
+        node_idle[i] = node.idle
+        node_rel[i] = node.releasing
+        node_room[i] = max(0, node.max_pods - len(node.pod_infos))
+        for k, v in node.labels.items():
+            if k in codec.key_cols:
+                node_labels[i, codec.key_cols[k]] = codec.value_codes[(k, v)]
+        for j, taint in enumerate(sorted(node.taints)):
+            node_taints[i, j] = codec.taint_codes[taint]
+
+    t_count = len(tasks)
+    task_req = np.zeros((max(t_count, 1), rs.NUM_RES))
+    task_job = np.zeros(max(t_count, 1), np.int32)
+    task_sel = np.full((max(t_count, 1), L), NO_LABEL, np.int32)
+    task_tol = np.full((max(t_count, 1), max_tols), NO_TAINT, np.int32)
+    job_index = {pg.uid: j for j, pg in enumerate(jobs)}
+    for i, t in enumerate(tasks):
+        t.tensor_idx = i
+        task_req[i] = t.req_vec()
+        task_job[i] = job_index[t.job_id]
+        for k, v in t.node_selector.items():
+            task_sel[i, codec.key_cols[k]] = codec.value_code(k, v)
+        for j, tol in enumerate(sorted(t.tolerations)):
+            if tol in codec.taint_codes:
+                task_tol[i, j] = codec.taint_codes[tol]
+
+    queue_uids = sorted(cluster.queues)
+    q_index = {qid: i for i, qid in enumerate(queue_uids)}
+    q = max(len(queue_uids), 1)
+    q_deserved = np.zeros((q, rs.NUM_RES))
+    q_limit = np.full((q, rs.NUM_RES), rs.UNLIMITED)
+    q_oqw = np.ones((q, rs.NUM_RES))
+    q_prio = np.zeros(q, np.int32)
+    q_parent = np.full(q, -1, np.int32)
+    q_creation = np.zeros(q)
+    q_alloc = np.zeros((q, rs.NUM_RES))
+    q_req = np.zeros((q, rs.NUM_RES))
+    q_usage = np.zeros((q, rs.NUM_RES))
+    allocated = cluster.queue_allocated()
+    requested = cluster.queue_requested()
+    for qid, i in q_index.items():
+        info = cluster.queues[qid]
+        q_deserved[i] = info.quota.deserved
+        q_limit[i] = info.quota.limit
+        q_oqw[i] = info.quota.over_quota_weight
+        q_prio[i] = info.priority
+        q_parent[i] = q_index.get(info.parent, -1) if info.parent else -1
+        q_creation[i] = info.creation_ts
+        q_alloc[i] = allocated.get(qid, rs.zeros())
+        q_req[i] = requested.get(qid, rs.zeros())
+        if queue_usage and qid in queue_usage:
+            q_usage[i] = queue_usage[qid]
+
+    job_q = np.array([q_index[pg.queue_id] for pg in jobs] or [0], np.int32)
+    job_min = np.array(
+        [sum(ps.min_available for ps in pg.pod_sets.values()) for pg in jobs]
+        or [0], np.int32)
+
+    return SnapshotTensors(
+        node_allocatable=node_alloc, node_idle=node_idle,
+        node_releasing=node_rel, node_labels=node_labels,
+        node_taints=node_taints, node_pod_room=node_room,
+        task_req=task_req, task_job=task_job, task_selector=task_sel,
+        task_tolerations=task_tol,
+        job_queue=job_q, job_min_available=job_min,
+        job_task_start=np.array(job_start or [0], np.int32),
+        job_task_count=np.array(job_count or [0], np.int32),
+        queue_deserved=q_deserved, queue_limit=q_limit,
+        queue_over_quota_weight=q_oqw, queue_priority=q_prio,
+        queue_parent=q_parent, queue_creation=q_creation,
+        queue_allocated=q_alloc, queue_requested=q_req, queue_usage=q_usage,
+        node_names=list(node_names), task_uids=[t.uid for t in tasks],
+        job_uids=[pg.uid for pg in jobs], queue_uids=queue_uids,
+    )

@@ -1,0 +1,261 @@
+"""Unit tests for the info model (resource algebra, node/podgroup accounting,
+snapshot packing) — the analog of the reference's pkg/scheduler/api tests."""
+
+import numpy as np
+import pytest
+
+from kai_scheduler_tpu.api import (
+    ClusterInfo, NodeInfo, PodGroupInfo, PodInfo, PodSet, PodStatus,
+    QueueInfo, QueueQuota, pack, resources as rs,
+)
+from kai_scheduler_tpu.api.resources import ResourceRequirements
+
+
+def mknode(name, cpu="8", mem="32Gi", gpu=8, **kw):
+    return NodeInfo(name, rs.vec_from_spec(cpu, mem, gpu), **kw)
+
+
+def mktask(uid, cpu="1", mem="1Gi", gpu=0, gpu_fraction=0.0,
+           status=PodStatus.PENDING, **kw):
+    return PodInfo(
+        uid=uid, name=uid, status=status,
+        res_req=ResourceRequirements.from_spec(cpu, mem, gpu,
+                                               gpu_fraction=gpu_fraction),
+        **kw)
+
+
+class TestResources:
+    def test_parse(self):
+        assert rs.parse_cpu("500m") == 500
+        assert rs.parse_cpu(2) == 2000
+        assert rs.parse_memory("1Gi") == 2 ** 30
+        assert rs.parse_memory("1G") == 1e9
+
+    def test_less_equal_unlimited(self):
+        a = rs.vec(100, 100, 1)
+        b = rs.unlimited()
+        assert rs.less_equal(a, b)
+        assert not rs.less_equal(a, rs.vec(50, 200, 2))
+
+    def test_fractional_req(self):
+        r = ResourceRequirements.from_spec(cpu="1", gpu_fraction=0.5)
+        assert r.is_fractional
+        assert r.to_vec()[rs.RES_GPU] == 0.5
+        r2 = ResourceRequirements.from_spec(gpu_memory="8Gi")
+        assert r2.to_vec(node_gpu_memory=16 * 2 ** 30)[rs.RES_GPU] == 0.5
+        assert r2.to_vec()[rs.RES_GPU] == 1.0  # conservative w/o node info
+
+
+class TestNodeInfo:
+    def test_accounting_roundtrip(self):
+        node = mknode("n1")
+        t = mktask("t1", gpu=2, status=PodStatus.RUNNING)
+        node.add_task(t)
+        assert node.used[rs.RES_GPU] == 2
+        assert node.idle[rs.RES_GPU] == 6
+        node.remove_task(t)
+        assert node.used[rs.RES_GPU] == 0
+
+    def test_releasing_and_pipelined(self):
+        node = mknode("n1")
+        rel = mktask("rel", gpu=4, status=PodStatus.RELEASING)
+        node.add_task(rel)
+        # Releasing tasks still occupy the node but their resources are
+        # available for pipelining.
+        assert node.idle[rs.RES_GPU] == 4
+        assert node.releasing[rs.RES_GPU] == 4
+        pend = mktask("p", gpu=6)
+        assert not node.is_task_allocatable(pend)
+        assert node.is_task_allocatable_on_releasing_or_idle(pend)
+        pip = mktask("pip", gpu=4, status=PodStatus.PIPELINED)
+        node.add_task(pip)
+        assert node.releasing[rs.RES_GPU] == 0
+
+    def test_max_pods(self):
+        node = mknode("n1", max_pods=1)
+        node.add_task(mktask("t1", status=PodStatus.RUNNING))
+        assert not node.is_task_allocatable(mktask("t2"))
+
+    def test_fractional_groups(self):
+        node = mknode("n1", gpu=2)
+        t1 = mktask("f1", gpu_fraction=0.6)
+
+        groups = node.find_gpu_groups_for_task(t1, allow_releasing=False)
+        assert groups and len(groups) == 1
+        t1.gpu_group = groups[0]
+        t1.status = PodStatus.RUNNING
+        node.add_task(t1)
+        # The whole backing device is charged, not just the fraction.
+        assert node.used[rs.RES_GPU] == pytest.approx(1.0)
+        # A 0.5 fraction doesn't fit the same device; gets a fresh one.
+        t2 = mktask("f2", gpu_fraction=0.5)
+        g2 = node.find_gpu_groups_for_task(t2, allow_releasing=False)
+        assert g2 and g2[0] != groups[0]
+        # A 0.4 fraction packs onto the existing shared device.
+        t3 = mktask("f3", gpu_fraction=0.4)
+        g3 = node.find_gpu_groups_for_task(t3, allow_releasing=False)
+        assert g3 == [groups[0]]
+
+    def test_whole_gpu_blocked_by_sharing_groups(self):
+        """Two sharing groups on a 2-GPU node hold both physical devices;
+        a whole-GPU task must not be admitted (review finding)."""
+        node = mknode("n1", gpu=2)
+        for uid, frac in (("a", 0.4), ("b", 0.6)):
+            t = mktask(uid, gpu_fraction=frac)
+            t.gpu_group = f"grp-{uid}"
+            t.status = PodStatus.RUNNING
+            node.add_task(t)
+        assert node.used[rs.RES_GPU] == pytest.approx(2.0)
+        assert not node.is_task_allocatable(mktask("whole", gpu=1))
+
+    def test_pipeline_onto_releasing_group(self):
+        """A fully-releasing sharing group frees its whole device for
+        pipelining, and releasing fractions don't block the group budget."""
+        node = mknode("n1", gpu=1)
+        rel = mktask("rel", gpu_fraction=0.8, status=PodStatus.RELEASING)
+        rel.gpu_group = "g1"
+        node.add_task(rel)
+        assert node.releasing[rs.RES_GPU] == pytest.approx(1.0)
+        pend = mktask("p", gpu_fraction=0.5)
+        assert not node.is_task_allocatable(pend)
+        assert node.is_task_allocatable_on_releasing_or_idle(pend)
+        g = node.find_gpu_groups_for_task(pend, allow_releasing=True)
+        assert g == ["g1"]  # reuses the releasing device, no phantom group
+
+
+def mktask_frac(uid, fraction):
+    return mktask(uid, gpu_fraction=fraction)
+
+
+class TestPodGroupInfo:
+    def _gang(self, n_pods=4, min_available=3):
+        pg = PodGroupInfo("pg1", "job1", min_available=min_available)
+        for i in range(n_pods):
+            pg.add_task(mktask(f"t{i}"))
+        return pg
+
+    def test_gang_satisfaction(self):
+        pg = self._gang()
+        assert not pg.is_gang_satisfied()
+        assert pg.is_ready_for_scheduling()
+        assert pg.is_elastic()
+        for i, t in enumerate(list(pg.pods.values())[:3]):
+            pg.update_task_status(t, PodStatus.RUNNING)
+        assert pg.is_gang_satisfied()
+
+    def test_tasks_to_allocate_gang_then_elastic(self):
+        pg = self._gang(n_pods=5, min_available=3)
+        sel = pg.tasks_to_allocate()
+        assert len(sel) == 3  # gang chunk first
+        for t in sel:
+            pg.update_task_status(t, PodStatus.ALLOCATED)
+        sel2 = pg.tasks_to_allocate()
+        assert len(sel2) == 1  # then elastic, one at a time
+
+    def test_staleness(self):
+        pg = self._gang(n_pods=3, min_available=3)
+        assert not pg.is_stale()  # nothing running
+        pg.update_task_status(list(pg.pods.values())[0], PodStatus.RUNNING)
+        assert pg.is_stale()  # 1 of 3 running
+
+    def test_should_pipeline(self):
+        pg = self._gang(n_pods=3, min_available=2)
+        tasks = list(pg.pods.values())
+        pg.update_task_status(tasks[0], PodStatus.PIPELINED)
+        assert pg.should_pipeline()
+        pg.update_task_status(tasks[1], PodStatus.RUNNING)
+        pg.update_task_status(tasks[2], PodStatus.RUNNING)
+        assert not pg.should_pipeline()
+
+    def test_signature_dedup(self):
+        a, b = self._gang(), self._gang()
+        b.uid = "pg2"
+        assert a.scheduling_signature() == b.scheduling_signature()
+        list(b.pods.values())[0].node_selector["zone"] = "us-1"
+        b._signature = None
+        assert a.scheduling_signature() != b.scheduling_signature()
+
+    def test_gang_chunks_before_elastic(self):
+        """An unsatisfied podset's gang chunk must win over another podset's
+        elastic growth (review finding)."""
+        pg = PodGroupInfo("pg1", "job1")
+        pg.set_pod_sets([PodSet("a", 1), PodSet("b", 2)])
+        a_run = mktask("a0", subgroup="a", status=PodStatus.RUNNING)
+        pg.add_task(a_run)
+        pg.add_task(mktask("a1", subgroup="a"))  # elastic candidate
+        pg.add_task(mktask("b0", subgroup="b"))
+        pg.add_task(mktask("b1", subgroup="b"))
+        sel = pg.tasks_to_allocate()
+        assert sorted(t.uid for t in sel) == ["b0", "b1"]
+
+    def test_multi_podset_selection(self):
+        pg = PodGroupInfo("pg1", "job1")
+        pg.set_pod_sets([PodSet("workers", 2), PodSet("ps", 1)])
+        for i in range(3):
+            pg.add_task(mktask(f"w{i}", subgroup="workers"))
+        pg.add_task(mktask("ps0", subgroup="ps"))
+        sel = pg.tasks_to_allocate()
+        assert len(sel) == 3  # 2 workers + 1 ps
+        by_sg = {}
+        for t in sel:
+            by_sg.setdefault(t.subgroup, []).append(t)
+        assert len(by_sg["workers"]) == 2 and len(by_sg["ps"]) == 1
+
+
+class TestSnapshotPack:
+    def _cluster(self):
+        nodes = {f"n{i}": mknode(f"n{i}", labels={"zone": f"z{i % 2}"},
+                                 taints={"gpu-only"} if i == 0 else set())
+                 for i in range(4)}
+        pg = PodGroupInfo("pg1", "j1", queue_id="q1", min_available=2)
+        pg.add_task(mktask("t0", gpu=1,
+                           node_selector={"zone": "z0"},
+                           tolerations={"gpu-only"}))
+        pg.add_task(mktask("t1", gpu=1))
+        queues = {"q1": QueueInfo("q1", quota=QueueQuota.from_spec(
+            deserved=dict(cpu="16", memory="64Gi", gpu=4)))}
+        return ClusterInfo(nodes, {"pg1": pg}, queues)
+
+    def test_pack_shapes(self):
+        snap = pack(self._cluster())
+        assert snap.node_allocatable.shape == (4, rs.NUM_RES)
+        assert snap.num_tasks == 2
+        assert snap.task_job.tolist() == [0, 0]
+        assert snap.job_task_count.tolist() == [2]
+        assert snap.queue_deserved[0, rs.RES_GPU] == 4
+
+    def test_pack_padding(self):
+        snap = pack(self._cluster(), pad_nodes_to=16)
+        assert snap.node_allocatable.shape == (16, rs.NUM_RES)
+        # Padded nodes have zero capacity: nothing fits there.
+        assert np.all(snap.node_idle[4:] == 0)
+
+    def test_selector_encoding(self):
+        snap = pack(self._cluster())
+        # t0 constrains zone=z0; node n0/n2 have z0.
+        col = 0
+        sel = snap.task_selector[0, col]
+        assert sel != -1
+        assert snap.node_labels[0, col] == sel
+        assert snap.node_labels[1, col] != sel
+
+    def test_clone_independent(self):
+        ci = self._cluster()
+        ci2 = ci.clone()
+        t = list(ci2.podgroups["pg1"].pods.values())[0]
+        ci2.podgroups["pg1"].update_task_status(t, PodStatus.RUNNING)
+        assert ci.podgroups["pg1"].num_active_used() == 0
+        assert ci2.podgroups["pg1"].num_active_used() == 1
+
+    def test_clone_rewires_node_accounting(self):
+        ci = self._cluster()
+        pg = ci.podgroups["pg1"]
+        t = pg.pods["t0"]
+        t.node_name = "n1"
+        pg.update_task_status(t, PodStatus.RUNNING)
+        ci.nodes["n1"].add_task(t)
+        ci2 = ci.clone()
+        assert ci2.nodes["n1"].used[rs.RES_GPU] == 1
+        assert len(ci2.nodes["n1"].pod_infos) == 1
+        # and the clone's pod ref is the cloned task, not the original
+        assert ci2.nodes["n1"].pod_infos["t0"] is ci2.podgroups["pg1"].pods["t0"]
