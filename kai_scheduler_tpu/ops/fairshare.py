@@ -1,0 +1,402 @@
+"""Hierarchical DRF fair-share division.
+
+Re-implements the behavior of the reference's proportion plugin division
+algorithm (pkg/scheduler/plugins/proportion/resource_division/
+resource_division.go:26-357 and proportion.go:403-440):
+
+1. *Deserved phase*: every queue first receives min(deserved, requestable)
+   (UNLIMITED deserved counts as the whole pool).
+2. *Over-quota phase*: the remainder is divided within priority bands
+   (higher priority first).  Within a band, repeated proportional rounds by
+   usage-penalized over-quota weight ``w' = max(0, W' + k*(W' - U'))``
+   (:245), each grant floored to whole units (:292); fractional remainders
+   are then distributed one unit at a time, largest remainder first (:264).
+3. *Hierarchy*: each parent's fair share becomes the pool divided among its
+   children (proportion.go:410-425).
+
+Two implementations, property-tested against each other:
+- ``set_resources_share_np``: sequential numpy reference, one queue group.
+- ``fair_share_levels``: jitted JAX kernel.  Queue groups (siblings under one
+  parent) become segment ids so every level of the hierarchy is one
+  vectorized division over all groups at once; priority bands are a static
+  unroll; the round loop is a ``lax.while_loop`` fixed point.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+UNLIMITED = -1.0
+EPS = 1e-9
+# Fractional remainders are quantized before largest-remainder ranking so
+# that float-accumulation noise can't flip near-ties between the sequential
+# reference and the vectorized kernel (the tiebreak rank then decides).
+FRAC_DECIMALS = 9
+
+
+# ---------------------------------------------------------------------------
+# numpy reference (single group of sibling queues, all resources)
+# ---------------------------------------------------------------------------
+
+def _requestable(request, limit):
+    return np.where(limit == UNLIMITED, request, np.minimum(limit, request))
+
+
+def set_resources_share_np(total: np.ndarray, k_value: float,
+                           deserved: np.ndarray, limit: np.ndarray,
+                           over_quota_weight: np.ndarray,
+                           request: np.ndarray, usage: np.ndarray,
+                           priority: np.ndarray,
+                           tiebreak_rank: np.ndarray | None = None
+                           ) -> np.ndarray:
+    """Sequential reference for one sibling group.
+
+    Shapes: total [R]; per-queue arrays [Q,R] except priority [Q].
+    Returns fair_share [Q,R].
+    """
+    q, r = deserved.shape
+    if tiebreak_rank is None:
+        tiebreak_rank = np.arange(q)
+    fair = np.zeros((q, r))
+    for res in range(r):
+        fair[:, res] = _set_resource_share_np(
+            float(total[res]), k_value, deserved[:, res], limit[:, res],
+            over_quota_weight[:, res], request[:, res], usage[:, res],
+            priority, tiebreak_rank)
+    return fair
+
+
+def _set_resource_share_np(total, k, deserved, limit, oqw, request, usage,
+                           priority, tiebreak_rank):
+    q = deserved.shape[0]
+    requestable = _requestable(request, limit)
+    # Phase 1: deserved-first (resource_division.go:92-109).
+    eff_deserved = np.where(deserved == UNLIMITED, total, deserved)
+    fair = np.minimum(eff_deserved, requestable)
+    remaining = total - fair.sum()
+    if remaining <= 0:
+        return fair
+
+    # Phase 2: over-quota by priority band (:111-144).
+    bands = sorted(set(priority.tolist()), reverse=True)
+    rem_frac = {b: np.zeros(q) for b in bands}  # remainder map per band
+    for band in bands:
+        in_band = priority == band
+        while True:
+            unsat = in_band & (requestable - fair > EPS)
+            tw = oqw[unsat].sum()
+            if tw <= 0:
+                break
+            n_w = np.where(unsat, oqw / tw, 0.0)
+            share_w = np.where(unsat, np.maximum(0.0, n_w + k * (n_w - usage)),
+                               0.0)
+            sw = share_w.sum()
+            if sw <= 0:
+                break
+            amount_this_round = remaining
+            another_round = False
+            for i in range(q):
+                if not unsat[i] or oqw[i] == 0:
+                    continue
+                fair_i = amount_this_round * share_w[i] / sw
+                rem_req = requestable[i] - fair[i]
+                if rem_req <= fair_i:
+                    give = rem_req
+                    rem_frac[band][i] = 0.0
+                else:
+                    give = np.floor(fair_i)
+                    rem_frac[band][i] = fair_i - give
+                if give > 0:
+                    fair[i] += give
+                    remaining -= give
+                another_round = another_round or rem_req < fair_i
+            if not another_round or remaining <= EPS:
+                break
+        if remaining <= EPS:
+            break
+
+    # Phase 3: largest-remainder units, priority band order (:126-141,264-281).
+    for band in bands:
+        if remaining <= EPS:
+            break
+        entries = [(i, round(rem_frac[band][i], FRAC_DECIMALS))
+                   for i in range(q) if rem_frac[band][i] > 0]
+        entries.sort(key=lambda e: (-e[1], tiebreak_rank[e[0]]))
+        for i, _ in entries:
+            if remaining <= EPS:
+                break
+            give = min(1.0, remaining)
+            fair[i] += give
+            remaining -= give
+    return fair
+
+
+# ---------------------------------------------------------------------------
+# JAX kernel: segment (multi-group) division, one hierarchy level
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class LevelSpec:
+    """Static structure of one hierarchy level (trace-time constants)."""
+    num_groups: int
+    num_bands: int
+    max_rounds: int = 64
+
+
+def _segment_sum(x, seg, num_groups):
+    return jax.ops.segment_sum(x, seg, num_segments=num_groups)
+
+
+@functools.partial(jax.jit, static_argnames=("spec",))
+def divide_groups_jax(spec: LevelSpec, group_total, group_of_queue,
+                      band_of_queue, deserved, limit, oqw, request, usage,
+                      tiebreak_rank, k_value):
+    """One level of fair-share: divide each group's total among its queues.
+
+    Shapes: group_total [G,R]; group_of_queue/band_of_queue/tiebreak [Q];
+    per-queue arrays [Q,R].  Returns fair [Q,R].
+
+    Vectorization of the sequential reference: all sums become segment sums
+    over the group axis, priority bands unroll statically, and the
+    proportional rounds run as a while_loop until no group/resource wants
+    another round.  Order-independence of each round (grants are computed
+    from round-start state) makes this exactly equal to the sequential
+    algorithm.
+    """
+    G, Q = spec.num_groups, group_of_queue.shape[0]
+    R = deserved.shape[1]
+    seg = group_of_queue
+
+    requestable = jnp.where(limit == UNLIMITED, request,
+                            jnp.minimum(limit, request))
+    my_total = group_total[seg]  # [Q,R]
+    eff_deserved = jnp.where(deserved == UNLIMITED, my_total, deserved)
+    fair0 = jnp.minimum(eff_deserved, requestable)
+    remaining0 = jnp.maximum(group_total - _segment_sum(fair0, seg, G), 0.0)
+
+    def run_band(band, fair, remaining, rem_frac_all):
+        in_band = (band_of_queue == band)[:, None]  # [Q,1]
+
+        def cond(carry):
+            fair, remaining, rem_frac, go, i = carry
+            return go & (i < spec.max_rounds)
+
+        def body(carry):
+            fair, remaining, rem_frac, _, i = carry
+            unsat = in_band & (requestable - fair > EPS)
+            tw = _segment_sum(jnp.where(unsat, oqw, 0.0), seg, G)  # [G,R]
+            n_w = jnp.where(unsat & (tw[seg] > 0), oqw / jnp.where(
+                tw[seg] > 0, tw[seg], 1.0), 0.0)
+            share_w = jnp.where(unsat,
+                                jnp.maximum(0.0, n_w + k_value * (n_w - usage)),
+                                0.0)
+            sw = _segment_sum(share_w, seg, G)  # [G,R]
+            active = unsat & (share_w > 0) & (sw[seg] > 0)
+            fair_q = jnp.where(active,
+                               remaining[seg] * share_w
+                               / jnp.where(sw[seg] > 0, sw[seg], 1.0), 0.0)
+            rem_req = requestable - fair
+            satisfied_now = rem_req <= fair_q
+            give = jnp.where(active,
+                             jnp.where(satisfied_now, rem_req,
+                                       jnp.floor(fair_q)), 0.0)
+            new_frac = jnp.where(active,
+                                 jnp.where(satisfied_now, 0.0,
+                                           fair_q - jnp.floor(fair_q)),
+                                 rem_frac)
+            fair = fair + give
+            remaining = jnp.maximum(
+                remaining - _segment_sum(give, seg, G), 0.0)
+            another = (active & (rem_req < fair_q)) & (remaining[seg] > EPS)
+            go = jnp.any(another)
+            return fair, remaining, new_frac, go, i + 1
+
+        fair, remaining, rem_frac, _, _ = jax.lax.while_loop(
+            cond, body,
+            (fair, remaining, rem_frac_all, jnp.array(True), jnp.array(0)))
+        return fair, remaining, rem_frac
+
+    # Static unroll over priority bands (band ids are dense 0..num_bands-1,
+    # 0 = highest priority — computed by the host-side prep).
+    rem_fracs = []
+    fair, remaining = fair0, remaining0
+    for band in range(spec.num_bands):
+        fair, remaining, rem_frac = run_band(
+            band, fair, remaining, jnp.zeros_like(fair0))
+        rem_fracs.append(rem_frac)
+
+    # Largest-remainder unit distribution, per band, per group, per resource.
+    def distribute(fair, remaining, rem_frac):
+        # rank within (group, resource) by (-frac, tiebreak); non-members
+        # (frac == 0) sort last and receive nothing.
+        member = rem_frac > 0.0  # [Q,R]
+
+        def per_resource(fair_r, remaining_r, frac_r, member_r):
+            frac_r = jnp.round(frac_r, FRAC_DECIMALS)
+            # Sort by group, then -frac, then tiebreak.
+            order = jnp.lexsort((tiebreak_rank, -frac_r,
+                                 jnp.where(member_r, 0, 1), seg))
+            sorted_seg = seg[order]
+            pos = jnp.arange(Q)
+            # Rank within group = position - first position of the group.
+            is_start = jnp.concatenate([
+                jnp.array([True]), sorted_seg[1:] != sorted_seg[:-1]])
+            group_start = jnp.where(is_start, pos, 0)
+            group_start = jax.lax.associative_scan(jnp.maximum, group_start)
+            rank_sorted = pos - group_start
+            rank = jnp.zeros(Q, jnp.int32).at[order].set(
+                rank_sorted.astype(jnp.int32))
+            amount = jnp.where(
+                member_r,
+                jnp.clip(remaining_r[seg] - rank.astype(fair_r.dtype),
+                         0.0, 1.0),
+                0.0)
+            fair_r = fair_r + amount
+            remaining_r = jnp.maximum(
+                remaining_r - _segment_sum(amount, seg, G), 0.0)
+            return fair_r, remaining_r
+
+        outs = [per_resource(fair[:, r], remaining[:, r], rem_frac[:, r],
+                             member[:, r]) for r in range(R)]
+        fair = jnp.stack([o[0] for o in outs], axis=1)
+        remaining = jnp.stack([o[1] for o in outs], axis=1)
+        return fair, remaining
+
+    for band in range(spec.num_bands):
+        fair, remaining = distribute(fair, remaining, rem_fracs[band])
+    return fair
+
+
+# ---------------------------------------------------------------------------
+# Hierarchy orchestration (host-side prep + per-level kernel calls)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class QueueHierarchy:
+    """Host-side prep of the queue forest for the level-by-level kernel."""
+    levels: list            # list of np.ndarray of queue indices per depth
+    parent: np.ndarray      # [Q] int, -1 for roots
+    band_of_queue: np.ndarray   # [Q] dense band index per level (global bands)
+    num_bands: int
+    tiebreak_rank: np.ndarray   # [Q]
+
+    @classmethod
+    def build(cls, parent: np.ndarray, priority: np.ndarray,
+              creation: np.ndarray, uids: list[str] | None = None
+              ) -> "QueueHierarchy":
+        q = parent.shape[0]
+        depth = np.zeros(q, np.int32)
+        for i in range(q):
+            d, p = 0, parent[i]
+            while p >= 0:
+                d += 1
+                p = parent[p]
+            depth[i] = d
+        levels = [np.where(depth == d)[0]
+                  for d in range(int(depth.max()) + 1 if q else 0)]
+        # Dense band ids: 0 = highest priority.
+        uniq = np.unique(priority)[::-1]
+        band = np.searchsorted(-uniq, -priority)
+        order = sorted(range(q), key=lambda i: (creation[i],
+                                                uids[i] if uids else str(i)))
+        rank = np.zeros(q, np.int64)
+        for r_, i in enumerate(order):
+            rank[i] = r_
+        return cls(levels, parent.astype(np.int64), band.astype(np.int32),
+                   len(uniq) if q else 1, rank)
+
+
+def fair_share_levels(total: np.ndarray, k_value: float,
+                      hierarchy: QueueHierarchy,
+                      deserved: np.ndarray, limit: np.ndarray,
+                      oqw: np.ndarray, request: np.ndarray,
+                      usage: np.ndarray) -> np.ndarray:
+    """Full hierarchical fair share: one kernel call per depth level.
+
+    ``request`` must already be rolled up the parent chain (roll_up_requests).
+    Returns fair share [Q,R] for every queue, leaf and interior alike.
+    """
+    q, r = deserved.shape
+    fair = np.zeros((q, r))
+    if q == 0:
+        return fair
+    for depth, idxs in enumerate(hierarchy.levels):
+        if len(idxs) == 0:
+            continue
+        if depth == 0:
+            group_of = np.zeros(len(idxs), np.int32)
+            group_totals = total[None, :]
+        else:
+            parents = hierarchy.parent[idxs]
+            uniq_parents, group_of = np.unique(parents, return_inverse=True)
+            group_totals = fair[uniq_parents]
+        spec = LevelSpec(num_groups=group_totals.shape[0],
+                         num_bands=hierarchy.num_bands)
+        out = divide_groups_jax(
+            spec, jnp.asarray(group_totals), jnp.asarray(group_of),
+            jnp.asarray(hierarchy.band_of_queue[idxs]),
+            jnp.asarray(deserved[idxs]), jnp.asarray(limit[idxs]),
+            jnp.asarray(oqw[idxs]), jnp.asarray(request[idxs]),
+            jnp.asarray(usage[idxs]),
+            jnp.asarray(hierarchy.tiebreak_rank[idxs]),
+            k_value)
+        fair[idxs] = np.asarray(out)
+    return fair
+
+
+def roll_up_requests(parent: np.ndarray, leaf_values: np.ndarray
+                     ) -> np.ndarray:
+    """Aggregate per-leaf quantities up the parent chain
+    (proportion.go:378-401: Request/Allocated accumulate on every ancestor)."""
+    q = parent.shape[0]
+    # Deepest-first so each child's (already complete) subtotal flows up.
+    accum = leaf_values.copy()
+    for i in sorted(range(q), key=lambda i: -_depth_of(parent, i)):
+        p = parent[i]
+        if p >= 0:
+            accum[p] += accum[i]
+    return accum
+
+
+def _depth_of(parent: np.ndarray, i: int) -> int:
+    d, p = 0, parent[i]
+    while p >= 0:
+        d += 1
+        p = parent[p]
+    return d
+
+
+# ---------------------------------------------------------------------------
+# DRF dominant share (queue_resource_share.go:142-162)
+# ---------------------------------------------------------------------------
+
+NO_FAIR_SHARE_DRF_MULTIPLIER = 1000.0
+
+
+def dominant_share(allocated: np.ndarray, allocatable: np.ndarray,
+                   total: np.ndarray) -> np.ndarray:
+    """max over resources of allocated/allocatable; zero allocatable with
+    allocation gets the penalty multiplier.  [Q,R],[Q,R],[R] -> [Q]."""
+    xp = jnp if isinstance(allocated, jnp.ndarray) else np
+    alloc_share = xp.where(allocatable == UNLIMITED,
+                           xp.broadcast_to(total, allocated.shape),
+                           allocatable)
+    value = xp.where(alloc_share > 0, allocated / xp.where(
+        alloc_share > 0, alloc_share, 1.0),
+        allocated * NO_FAIR_SHARE_DRF_MULTIPLIER)
+    return value.max(axis=1)
+
+
+def allocatable_share(deserved: np.ndarray, fair: np.ndarray,
+                      limit: np.ndarray) -> np.ndarray:
+    """GetAllocatableShare (resource_share.go:52-62): max(deserved, fair)
+    capped at limit; UNLIMITED deserved -> limit."""
+    xp = jnp if isinstance(deserved, jnp.ndarray) else np
+    base = xp.maximum(deserved, fair)
+    capped = xp.where(limit == UNLIMITED, base, xp.minimum(limit, base))
+    return xp.where(deserved == UNLIMITED, limit, capped)

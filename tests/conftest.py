@@ -6,7 +6,10 @@ virtual CPU mesh exactly as the driver's dryrun does.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even if the outer environment points at an accelerator: tests
+# need x64 determinism and the virtual 8-device mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -16,3 +19,11 @@ os.environ.setdefault("JAX_ENABLE_X64", "1")
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The environment's accelerator plugin (registered from sitecustomize before
+# this file runs) force-updates jax_platforms; point it back at CPU before
+# any backend initializes.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,220 @@
+"""Fair-share division tests: hand-checked semantics + numpy<->JAX parity.
+
+Mirrors the reference's resource_division tests
+(pkg/scheduler/plugins/proportion/resource_division/resource_division_test.go
+coverage areas): deserved-first, over-quota weights, priority bands, limits,
+whole-unit rounding with largest-remainder distribution, usage penalty, and
+hierarchical recursion.
+"""
+
+import numpy as np
+import pytest
+
+from kai_scheduler_tpu.ops import fairshare as fs
+
+R = 3
+
+
+def run_np(total, queues, k=0.0):
+    """queues: list of dicts with deserved/limit/oqw/request/usage/priority."""
+    q = len(queues)
+    arr = lambda key, default: np.array(
+        [np.full(R, float(qd.get(key, default))) if np.isscalar(
+            qd.get(key, default)) else qd.get(key, default)
+         for qd in queues])
+    return fs.set_resources_share_np(
+        np.full(R, float(total)), k,
+        arr("deserved", fs.UNLIMITED), arr("limit", fs.UNLIMITED),
+        arr("oqw", 1.0), arr("request", 0.0), arr("usage", 0.0),
+        np.array([qd.get("priority", 0) for qd in queues]),
+    )
+
+
+def run_jax_flat(total, queues, k=0.0):
+    """Same instance through the segmented JAX kernel as one group."""
+    q = len(queues)
+    arr = lambda key, default: np.array(
+        [np.full(R, float(qd.get(key, default))) if np.isscalar(
+            qd.get(key, default)) else qd.get(key, default)
+         for qd in queues])
+    priority = np.array([qd.get("priority", 0) for qd in queues])
+    hier = fs.QueueHierarchy.build(
+        np.full(q, -1, np.int64), priority, np.zeros(q),
+        [f"q{i}" for i in range(q)])
+    return fs.fair_share_levels(
+        np.full(R, float(total)), k, hier,
+        arr("deserved", fs.UNLIMITED), arr("limit", fs.UNLIMITED),
+        arr("oqw", 1.0), arr("request", 0.0), arr("usage", 0.0))
+
+
+class TestDeservedPhase:
+    def test_under_quota_everyone_satisfied(self):
+        out = run_np(100, [dict(deserved=30, request=20),
+                           dict(deserved=30, request=25)])
+        assert out[0, 0] == 20 and out[1, 0] == 25
+
+    def test_deserved_caps_first_phase(self):
+        out = run_np(100, [dict(deserved=30, request=80),
+                           dict(deserved=30, request=10)])
+        # q0: 30 deserved + over-quota up to its request (80); surplus
+        # beyond aggregate demand stays undistributed.
+        assert out[0, 0] == 80 and out[1, 0] == 10
+
+    def test_unlimited_deserved_takes_requested(self):
+        out = run_np(100, [dict(request=40), dict(request=30)])
+        assert out[0, 0] == 40 and out[1, 0] == 30
+
+
+class TestOverQuota:
+    def test_weighted_split(self):
+        out = run_np(90, [dict(deserved=0, request=90, oqw=2),
+                          dict(deserved=0, request=90, oqw=1)])
+        assert out[0, 0] == 60 and out[1, 0] == 30
+
+    def test_limit_caps_over_quota(self):
+        out = run_np(90, [dict(deserved=0, request=90, oqw=1, limit=10),
+                          dict(deserved=0, request=90, oqw=1)])
+        assert out[0, 0] == 10 and out[1, 0] == 80
+
+    def test_zero_weight_gets_nothing_over_quota(self):
+        out = run_np(90, [dict(deserved=10, request=90, oqw=0),
+                          dict(deserved=0, request=90, oqw=1)])
+        assert out[0, 0] == 10 and out[1, 0] == 80
+
+    def test_priority_band_precedence(self):
+        # Higher-priority band consumes everything it can first.
+        out = run_np(50, [dict(deserved=0, request=50, priority=10),
+                          dict(deserved=0, request=30, priority=0)])
+        assert out[0, 0] == 50 and out[1, 0] == 0
+
+    def test_rounding_whole_units_largest_remainder(self):
+        # 10 split 3 ways by equal weight = 3.33 each -> floor 3 each,
+        # remainder 1 goes to one queue (largest remainder ties -> rank).
+        out = run_np(10, [dict(deserved=0, request=10),
+                          dict(deserved=0, request=10),
+                          dict(deserved=0, request=10)])
+        col = sorted(out[:, 0].tolist())
+        assert col == [3, 3, 4]
+        assert out[:, 0].sum() == 10
+
+    def test_usage_penalty(self):
+        # Equal weights, but q0 has high historical usage -> penalized.
+        out = run_np(10, [dict(deserved=0, request=10, usage=0.5),
+                          dict(deserved=0, request=10, usage=0.0)], k=1.0)
+        assert out[1, 0] > out[0, 0]
+
+    def test_multi_round_redistribution(self):
+        # q0 wants only 10 of its 45 proportional share; rounds hand the
+        # slack to q1.
+        out = run_np(90, [dict(deserved=0, request=10, oqw=1),
+                          dict(deserved=0, request=200, oqw=1)])
+        assert out[0, 0] == 10 and out[1, 0] == 80
+
+
+class TestParityNumpyJax:
+    @pytest.mark.parametrize("seed", range(8))
+    def test_random_flat_instances(self, seed):
+        rng = np.random.default_rng(seed)
+        q = int(rng.integers(1, 9))
+        queues = []
+        for i in range(q):
+            deserved = float(rng.choice([fs.UNLIMITED, 0, 5, 10, 20]))
+            limit = float(rng.choice([fs.UNLIMITED, fs.UNLIMITED, 15, 40]))
+            queues.append(dict(
+                deserved=deserved, limit=limit,
+                oqw=float(rng.choice([0, 1, 2, 3])),
+                request=float(rng.integers(0, 60)),
+                usage=float(rng.uniform(0, 0.3)),
+                priority=int(rng.choice([0, 0, 0, 5]))))
+        total = float(rng.integers(10, 200))
+        k = float(rng.choice([0.0, 0.5, 1.0]))
+        a = run_np(total, queues, k)
+        b = run_jax_flat(total, queues, k)
+        np.testing.assert_allclose(a, b, atol=1e-6, err_msg=f"queues={queues}")
+
+    def test_never_exceeds_total_or_limit(self):
+        rng = np.random.default_rng(42)
+        for _ in range(10):
+            q = int(rng.integers(2, 8))
+            queues = [dict(deserved=float(rng.choice([0, 10])),
+                           limit=float(rng.choice([fs.UNLIMITED, 25])),
+                           oqw=float(rng.choice([1, 2])),
+                           request=float(rng.integers(0, 50)))
+                      for _ in range(q)]
+            total = float(rng.integers(20, 100))
+            out = run_np(total, queues)
+            # Deserved quotas may oversubscribe the pool by design
+            # (resource_division.go:92-109 grants them unconditionally);
+            # only the over-quota phase is bounded by the remainder.
+            def requestable(qd):
+                if qd["limit"] == fs.UNLIMITED:
+                    return qd["request"]
+                return min(qd["limit"], qd["request"])
+
+            deserved_phase = sum(
+                min(qd["deserved"] if qd["deserved"] != fs.UNLIMITED
+                    else total, requestable(qd)) for qd in queues)
+            over_quota_given = out.sum(axis=0)[0] - deserved_phase
+            assert over_quota_given <= max(0.0, total - deserved_phase) + 1e-6
+            for i, qd in enumerate(queues):
+                if qd["limit"] != fs.UNLIMITED:
+                    # fair share may exceed limit only via deserved phase cap
+                    assert out[i, 0] <= max(qd["limit"], qd["deserved"]) + 1e-6
+
+
+class TestHierarchy:
+    def test_two_level_division(self):
+        # dept A (deserved 60) with teams a1 (w=1), a2 (w=2);
+        # dept B (deserved 40) fully requested.
+        parent = np.array([-1, -1, 0, 0], np.int64)
+        priority = np.zeros(4, np.int64)
+        hier = fs.QueueHierarchy.build(parent, priority, np.zeros(4),
+                                       ["A", "B", "a1", "a2"])
+        deserved = np.array([[60.0] * R, [40.0] * R,
+                             [0.0] * R, [0.0] * R])
+        limit = np.full((4, R), fs.UNLIMITED)
+        oqw = np.array([[1.0] * R, [1.0] * R, [1.0] * R, [2.0] * R])
+        leaf_request = np.array([[0.0] * R, [40.0] * R,
+                                 [60.0] * R, [60.0] * R])
+        request = fs.roll_up_requests(parent, leaf_request)
+        assert request[0, 0] == 120  # A aggregates children
+        out = fs.fair_share_levels(np.full(R, 100.0), 0.0, hier, deserved,
+                                   limit, oqw, request, np.zeros((4, R)))
+        assert out[0, 0] == 60 and out[1, 0] == 40
+        assert out[2, 0] == 20 and out[3, 0] == 40
+
+    def test_three_levels_and_bands(self):
+        # root children with different priorities, grandchildren split.
+        parent = np.array([-1, 0, 0, 1, 1], np.int64)
+        priority = np.array([0, 5, 0, 0, 0], np.int64)
+        hier = fs.QueueHierarchy.build(parent, priority, np.zeros(5),
+                                       list("rabcd"))
+        deserved = np.zeros((5, R))
+        deserved[0] = fs.UNLIMITED
+        limit = np.full((5, R), fs.UNLIMITED)
+        oqw = np.ones((5, R))
+        leaf_request = np.zeros((5, R))
+        leaf_request[3] = 30
+        leaf_request[4] = 50
+        leaf_request[2] = 100
+        request = fs.roll_up_requests(parent, leaf_request)
+        out = fs.fair_share_levels(np.full(R, 100.0), 0.0, hier, deserved,
+                                   limit, oqw, request, np.zeros((5, R)))
+        # Priority 5 child (idx 1, requesting 80 via children) wins the band.
+        assert out[1, 0] == 80
+        assert out[2, 0] == 20
+        assert out[3, 0] == 30 and out[4, 0] == 50
+
+
+class TestDominantShare:
+    def test_basic(self):
+        allocated = np.array([[10.0, 0.0, 2.0]])
+        allocatable = np.array([[100.0, 10.0, 4.0]])
+        total = np.array([100.0, 10.0, 8.0])
+        assert fs.dominant_share(allocated, allocatable, total)[0] == 0.5
+
+    def test_zero_allocatable_penalty(self):
+        allocated = np.array([[1.0, 0.0, 0.0]])
+        allocatable = np.array([[0.0, 10.0, 4.0]])
+        total = np.array([100.0, 10.0, 8.0])
+        assert fs.dominant_share(allocated, allocatable, total)[0] == 1000.0
