@@ -1,0 +1,197 @@
+"""Gang allocation kernel: the per-cycle hot loop as one jitted scan.
+
+The reference allocates task-by-task, job-by-job, each placement mutating
+node state before the next score, with checkpoint/rollback around each gang
+(pkg/scheduler/actions/common/allocate.go:20-163,
+framework/statement.go:44-61).  This kernel reproduces those semantics
+exactly as a ``lax.scan`` over the flattened task sequence:
+
+- carry = (idle, releasing, pod_room, per-job checkpoint of each, current
+  job id, current job ok-flag);
+- a job boundary commits (keeps) or rolls back (restores checkpoint) the
+  previous gang, mirroring Statement.Checkpoint/Rollback;
+- each step evaluates THIS task's predicate row and score row against the
+  *current* mutated state — the same greedy sequence the Go code walks, but
+  with the node loop fully vectorized on the MXU-friendly [N,R] tensors;
+- a task that fits nowhere fails its whole gang: remaining tasks are
+  skipped and the gang's placements are discarded (gang all-or-nothing).
+
+Tasks must arrive grouped by job (non-decreasing ``task_job``), ordered by
+the host-side job/task ordering plugins — order is policy, placement is
+mechanism; only the mechanism runs on device.
+
+Pipelining: a task that fits only on idle+releasing resources claims the
+releasing pool (status Pipelined host-side); allocated tasks claim idle.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .predicates import NO_LABEL, NO_TAINT
+from .scoring import (AVAILABILITY, BINPACK, MAX_HIGH_DENSITY, RESOURCE_TYPE,
+                      SPREAD)
+from ..api.resources import RES_CPU, RES_GPU
+
+EPS = 1e-9
+NEG = -1e18
+
+
+class AllocationResult(NamedTuple):
+    placements: jnp.ndarray    # [T] int32 node index, -1 = unplaced
+    pipelined: jnp.ndarray     # [T] bool, True = placed onto releasing pool
+    job_success: jnp.ndarray   # [J] bool — gang fully placed
+    node_idle: jnp.ndarray     # [N,R] post-allocation idle
+    node_releasing: jnp.ndarray  # [N,R] post-allocation releasing pool
+
+
+def _task_feasibility_row(idle, releasing, labels, taints, room,
+                          req, selector, tolerations):
+    """Predicate row for one task against current node state: [N] masks."""
+    sel_ok = jnp.all((selector[None, :] == NO_LABEL)
+                     | (selector[None, :] == labels), axis=-1)
+    tol = jnp.any(taints[:, :, None] == tolerations[None, None, :], axis=-1)
+    taint_ok = jnp.all((taints == NO_TAINT) | tol, axis=-1)
+    hard = sel_ok & taint_ok & (room >= 1.0)
+    fit_now = hard & jnp.all(req[None, :] <= idle + EPS, axis=-1)
+    fit_future = hard & jnp.all(req[None, :] <= idle + releasing + EPS,
+                                axis=-1)
+    return fit_now, fit_future
+
+
+def _task_score_row(allocatable, idle, req, fit_any, fit_now,
+                    gpu_strategy: int, cpu_strategy: int):
+    """Score row for one task (binpack/spread + resourcetype +
+    availability), matching ops.scoring term magnitudes."""
+    is_gpu_job = req[RES_GPU] > 0.0
+
+    def axis_score(res, strategy):
+        free = idle[:, res]
+        cap = allocatable[:, res]
+        has_res = cap > 0.0
+        if strategy == SPREAD:
+            return jnp.where(has_res, free / jnp.where(has_res, cap, 1.0),
+                             0.0)
+        valid = fit_any & has_res
+        min_free = jnp.min(jnp.where(valid, free, jnp.inf))
+        max_free = jnp.max(jnp.where(valid, free, -jnp.inf))
+        span = max_free - min_free
+        flat = span <= 0.0
+        score = MAX_HIGH_DENSITY * (
+            1.0 - (free - min_free) / jnp.where(flat, 1.0, span))
+        score = jnp.where(flat, MAX_HIGH_DENSITY, score)
+        return jnp.where(has_res, score, 0.0)
+
+    placement = jnp.where(is_gpu_job,
+                          axis_score(RES_GPU, gpu_strategy),
+                          axis_score(RES_CPU, cpu_strategy))
+    node_has_gpu = allocatable[:, RES_GPU] > 0.0
+    rtype = jnp.where(jnp.where(is_gpu_job, node_has_gpu, ~node_has_gpu),
+                      RESOURCE_TYPE, 0.0)
+    avail = jnp.where(fit_now, AVAILABILITY, 0.0)
+    return placement + rtype + avail
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("gpu_strategy", "cpu_strategy",
+                                    "allow_pipeline", "pipeline_only"))
+def allocate_jobs_kernel(node_allocatable, node_idle, node_releasing,
+                         node_labels, node_taints, node_pod_room,
+                         task_req, task_job, task_selector, task_tolerations,
+                         job_allowed, task_extra_scores=None,
+                         gpu_strategy: int = BINPACK,
+                         cpu_strategy: int = BINPACK,
+                         allow_pipeline: bool = True,
+                         pipeline_only: bool = False) -> AllocationResult:
+    """Place every job's gang greedily; roll failed gangs back.
+
+    job_allowed: [J] bool gate (e.g. queue capacity check, proportion
+    capacity_policy) — a gated-out job fails without touching state.
+    task_extra_scores: optional [T,N] additive score terms (topology,
+    nominated node) computed by other kernels.
+    pipeline_only: scenario-simulation mode — all placements pipeline
+    (statement.go ConvertAllAllocatedToPipelined semantics come free:
+    nothing claims idle).
+    """
+    T = task_req.shape[0]
+    if task_extra_scores is None:
+        task_extra_scores = jnp.zeros((T, node_allocatable.shape[0]))
+
+    class Carry(NamedTuple):
+        idle: jnp.ndarray
+        rel: jnp.ndarray
+        room: jnp.ndarray
+        ck_idle: jnp.ndarray
+        ck_rel: jnp.ndarray
+        ck_room: jnp.ndarray
+        cur_job: jnp.ndarray
+        cur_ok: jnp.ndarray
+
+    init = Carry(node_idle, node_releasing, node_pod_room,
+                 node_idle, node_releasing, node_pod_room,
+                 jnp.array(-1, jnp.int32), jnp.array(False))
+
+    def step(carry: Carry, t):
+        j = task_job[t]
+        new_job = j != carry.cur_job
+        # Job boundary: commit previous gang if it succeeded, else restore.
+        keep = jnp.where(new_job & ~carry.cur_ok, False, True)
+        idle = jnp.where(keep, carry.idle, carry.ck_idle)
+        rel = jnp.where(keep, carry.rel, carry.ck_rel)
+        room = jnp.where(keep, carry.room, carry.ck_room)
+        ck_idle = jnp.where(new_job, idle, carry.ck_idle)
+        ck_rel = jnp.where(new_job, rel, carry.ck_rel)
+        ck_room = jnp.where(new_job, room, carry.ck_room)
+        ok = jnp.where(new_job, job_allowed[j], carry.cur_ok)
+
+        req = task_req[t]
+        fit_now, fit_future = _task_feasibility_row(
+            idle, rel, node_labels, node_taints, room, req,
+            task_selector[t], task_tolerations[t])
+        if pipeline_only:
+            fit_now = jnp.zeros_like(fit_now)
+        feasible = fit_now | (fit_future if (allow_pipeline or pipeline_only)
+                              else jnp.zeros_like(fit_future))
+        score = _task_score_row(node_allocatable, idle, req, feasible,
+                                fit_now, gpu_strategy, cpu_strategy)
+        score = score + task_extra_scores[t]
+        found = ok & jnp.any(feasible)
+        best = jnp.argmax(jnp.where(feasible, score, NEG))
+        pipelined = found & ~fit_now[best]
+
+        one_hot = (jnp.arange(idle.shape[0]) == best) & found
+        take_idle = jnp.where((one_hot & ~pipelined)[:, None], req[None, :],
+                              0.0)
+        take_rel = jnp.where((one_hot & pipelined)[:, None], req[None, :],
+                             0.0)
+        idle = idle - take_idle
+        rel = rel - take_rel
+        room = room - one_hot.astype(room.dtype)
+
+        ok = ok & found
+        out = (jnp.where(found, best, -1).astype(jnp.int32), pipelined, found)
+        return Carry(idle, rel, room, ck_idle, ck_rel, ck_room,
+                     j.astype(jnp.int32), ok), out
+
+    carry, (placements, pipelined, found) = jax.lax.scan(
+        step, init, jnp.arange(T))
+
+    # Final gang commits or rolls back too.
+    idle = jnp.where(carry.cur_ok, carry.idle, carry.ck_idle)
+    rel = jnp.where(carry.cur_ok, carry.rel, carry.ck_rel)
+
+    num_jobs = job_allowed.shape[0]
+    placed_per_job = jax.ops.segment_sum(found.astype(jnp.int32), task_job,
+                                         num_segments=num_jobs)
+    tasks_per_job = jax.ops.segment_sum(jnp.ones(T, jnp.int32), task_job,
+                                        num_segments=num_jobs)
+    job_success = (tasks_per_job > 0) & (placed_per_job == tasks_per_job)
+    # Failed gangs contribute no placements.
+    valid = job_success[task_job]
+    placements = jnp.where(valid, placements, -1)
+    pipelined = pipelined & valid
+    return AllocationResult(placements, pipelined, job_success, idle, rel)

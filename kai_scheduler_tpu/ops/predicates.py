@@ -1,0 +1,71 @@
+"""Vectorized predicate masks: tasks × nodes feasibility in one shot.
+
+Replaces the reference's per-task-per-node predicate chain
+(pkg/scheduler/plugins/predicates/predicates.go:106,
+pkg/scheduler/k8s_internal/predicates/predicates.go:70-167 and
+NodeInfo.IsTaskAllocatable node_info.go:168) with dense tensor ops over the
+packed snapshot: resource capacity, node-selector/affinity label matching,
+taint/toleration, and pod-count room all evaluate as one [T, N] boolean
+program under jit.  The Go code runs these per candidate node inside the
+allocation loop; here the full mask is one fused XLA computation.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NO_LABEL = -1
+NO_TAINT = -1
+EPS = 1e-9
+
+
+@jax.jit
+def selector_mask(node_labels: jnp.ndarray,
+                  task_selector: jnp.ndarray) -> jnp.ndarray:
+    """[N,L] x [T,L] -> [T,N] bool: every constrained label matches.
+
+    A task entry of NO_LABEL means "don't care"; a node entry of NO_LABEL
+    means the label is absent (fails any constraint on that key).
+    """
+    t_sel = task_selector[:, None, :]   # [T,1,L]
+    n_lab = node_labels[None, :, :]     # [1,N,L]
+    ok = (t_sel == NO_LABEL) | (t_sel == n_lab)
+    return jnp.all(ok, axis=-1)
+
+
+@jax.jit
+def toleration_mask(node_taints: jnp.ndarray,
+                    task_tolerations: jnp.ndarray) -> jnp.ndarray:
+    """[N,Tt] x [T,Tl] -> [T,N] bool: every node taint is tolerated."""
+    taints = node_taints[None, :, :, None]        # [1,N,Tt,1]
+    tols = task_tolerations[:, None, None, :]     # [T,1,1,Tl]
+    tolerated = jnp.any(taints == tols, axis=-1)  # [T,N,Tt]
+    ok = (node_taints[None, :, :] == NO_TAINT) | tolerated
+    return jnp.all(ok, axis=-1)
+
+
+@jax.jit
+def capacity_mask(node_free: jnp.ndarray, task_req: jnp.ndarray
+                  ) -> jnp.ndarray:
+    """[N,R] x [T,R] -> [T,N] bool: request fits into free resources."""
+    return jnp.all(task_req[:, None, :] <= node_free[None, :, :] + EPS,
+                   axis=-1)
+
+
+@jax.jit
+def feasibility_masks(node_idle, node_releasing, node_labels, node_taints,
+                      node_pod_room, task_req, task_selector,
+                      task_tolerations):
+    """Full predicate evaluation.
+
+    Returns (fit_now, fit_future): [T,N] bool masks for allocation on idle
+    resources and for pipelining onto idle+releasing resources
+    (IsTaskAllocatable / IsTaskAllocatableOnReleasingOrIdle).
+    """
+    hard = (selector_mask(node_labels, task_selector)
+            & toleration_mask(node_taints, task_tolerations)
+            & (node_pod_room[None, :] >= 1.0))
+    fit_now = hard & capacity_mask(node_idle, task_req)
+    fit_future = hard & capacity_mask(node_idle + node_releasing, task_req)
+    return fit_now, fit_future

@@ -1,0 +1,123 @@
+"""Node scoring: the tasks × nodes score matrix as one fused computation.
+
+Replaces the reference's goroutine-per-node NodeOrderFn fan-out
+(pkg/scheduler/framework/session.go:234-265 OrderedNodesByTask) with a dense
+[T, N] score tensor.  Score terms and their magnitudes mirror
+pkg/scheduler/plugins/scores/scores.go so plugin precedence is preserved:
+
+  binpack/spread         <= 9       (MaxHighDensity, nodeplacement/pack.go:46)
+  resourcetype           10         (resourcetype/resource_type.go)
+  availability           100        (nodeavailability/nodeavailability.go:31)
+  gpu sharing            1000
+  topology               10000
+  k8s plugin scores      100000
+  nominated node         1000000
+
+Terms sum; the allocator picks argmax over feasible nodes (ties -> lowest
+node index, matching the deterministic first-best iteration order).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..api.resources import RES_CPU, RES_GPU
+
+MAX_HIGH_DENSITY = 9.0
+RESOURCE_TYPE = 10.0
+AVAILABILITY = 100.0
+GPU_SHARING = 1000.0
+TOPOLOGY = 10000.0
+K8S_PLUGINS = 100000.0
+NOMINATED_NODE = 1000000.0
+
+BINPACK = 0
+SPREAD = 1
+
+
+@functools.partial(jax.jit, static_argnames=("gpu_strategy", "cpu_strategy"))
+def placement_scores(node_allocatable, node_idle, task_req, fit_mask,
+                     gpu_strategy: int = BINPACK,
+                     cpu_strategy: int = BINPACK):
+    """Bin-pack / spread score per task x node (nodeplacement plugin).
+
+    Bin-pack (pack.go:46-66): over the task's *fitting* nodes that have the
+    job's dominant resource, scale free amount to [0, MaxHighDensity], higher
+    score for fuller nodes.  Spread (spread.go:16-37): free/capacity.  The
+    strategy applies per job resource type: GPU jobs score on the GPU axis,
+    CPU-only jobs on the CPU axis.
+    """
+    is_gpu_job = task_req[:, RES_GPU] > 0.0  # [T]
+
+    def axis_scores(res: int, strategy: int):
+        free = node_idle[:, res]            # [N]
+        cap = node_allocatable[:, res]      # [N]
+        has_res = cap > 0.0
+        valid = fit_mask & has_res[None, :]          # [T,N]
+        if strategy == SPREAD:
+            return jnp.where(has_res, free / jnp.where(has_res, cap, 1.0),
+                             0.0)[None, :] * jnp.ones(
+                                 (task_req.shape[0], 1))
+        big = jnp.inf
+        min_free = jnp.min(jnp.where(valid, free[None, :], big), axis=1)
+        max_free = jnp.max(jnp.where(valid, free[None, :], -big), axis=1)
+        span = max_free - min_free
+        flat = span <= 0.0  # all fitting nodes equal -> everyone max score
+        score = MAX_HIGH_DENSITY * (
+            1.0 - (free[None, :] - min_free[:, None])
+            / jnp.where(flat, 1.0, span)[:, None])
+        score = jnp.where(flat[:, None], MAX_HIGH_DENSITY, score)
+        return jnp.where(has_res[None, :], score, 0.0)
+
+    gpu_scores = axis_scores(RES_GPU, gpu_strategy)
+    cpu_scores = axis_scores(RES_CPU, cpu_strategy)
+    return jnp.where(is_gpu_job[:, None], gpu_scores, cpu_scores)
+
+
+@jax.jit
+def resource_type_scores(node_allocatable, task_req):
+    """CPU-only jobs prefer CPU-only nodes; GPU jobs prefer GPU nodes
+    (resourcetype plugin).  [T,N]."""
+    node_has_gpu = node_allocatable[:, RES_GPU] > 0.0   # [N]
+    is_gpu_job = task_req[:, RES_GPU] > 0.0             # [T]
+    match = jnp.where(is_gpu_job[:, None], node_has_gpu[None, :],
+                      ~node_has_gpu[None, :])
+    return jnp.where(match, RESOURCE_TYPE, 0.0)
+
+
+@jax.jit
+def availability_scores(fit_now):
+    """Nodes that can host the task right now beat pipelining candidates
+    (nodeavailability plugin).  [T,N]."""
+    return jnp.where(fit_now, AVAILABILITY, 0.0)
+
+
+@jax.jit
+def nominated_scores(task_nominated_node, num_nodes):
+    """Sticky boost for a previously nominated node (nominatednode plugin).
+    task_nominated_node: [T] int32 node index or -1."""
+    idx = jnp.arange(num_nodes)[None, :]
+    return jnp.where(task_nominated_node[:, None] == idx, NOMINATED_NODE, 0.0)
+
+
+@functools.partial(jax.jit, static_argnames=("gpu_strategy", "cpu_strategy"))
+def score_matrix(node_allocatable, node_idle, task_req, fit_now, fit_future,
+                 topology_scores=None, task_nominated_node=None,
+                 gpu_strategy: int = BINPACK, cpu_strategy: int = BINPACK):
+    """Composed [T,N] score: the device-side analog of summing every
+    registered NodeOrderFn (framework/session_plugins.go dispatchers)."""
+    score = placement_scores(node_allocatable, node_idle, task_req,
+                             fit_now | fit_future,
+                             gpu_strategy=gpu_strategy,
+                             cpu_strategy=cpu_strategy)
+    score = score + resource_type_scores(node_allocatable, task_req)
+    score = score + availability_scores(fit_now)
+    if topology_scores is not None:
+        score = score + topology_scores
+    if task_nominated_node is not None:
+        score = score + nominated_scores(task_nominated_node,
+                                         node_allocatable.shape[0])
+    return score

@@ -1,0 +1,188 @@
+"""Tests for the predicate/scoring/gang-allocation kernels — behavioral
+checks mirroring the reference's allocate-action integration tests
+(pkg/scheduler/actions/integration_tests/allocate)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from kai_scheduler_tpu.ops import predicates as P
+from kai_scheduler_tpu.ops import scoring as S
+from kai_scheduler_tpu.ops.allocate import allocate_jobs_kernel
+
+
+def make_nodes(free_gpus, cap=8, cpu=8000.0, mem=64e9):
+    """Nodes with given free GPU counts (used = cap - free)."""
+    n = len(free_gpus)
+    alloc = np.tile([cpu, mem, float(cap)], (n, 1))
+    idle = np.stack([[cpu, mem, float(g)] for g in free_gpus])
+    rel = np.zeros((n, 3))
+    labels = np.full((n, 1), -1, np.int32)
+    taints = np.full((n, 1), -1, np.int32)
+    room = np.full(n, 110.0)
+    return (jnp.asarray(alloc), jnp.asarray(idle), jnp.asarray(rel),
+            jnp.asarray(labels), jnp.asarray(taints), jnp.asarray(room))
+
+
+def make_tasks(reqs, jobs):
+    t = len(reqs)
+    req = np.stack([[1000.0, 1e9, float(g)] for g in reqs])
+    sel = np.full((t, 1), -1, np.int32)
+    tol = np.full((t, 1), -1, np.int32)
+    return (jnp.asarray(req), jnp.asarray(np.array(jobs, np.int32)),
+            jnp.asarray(sel), jnp.asarray(tol))
+
+
+def run(nodes, tasks, n_jobs, **kw):
+    job_allowed = kw.pop("job_allowed", np.ones(n_jobs, bool))
+    return allocate_jobs_kernel(*nodes, *tasks, jnp.asarray(job_allowed),
+                                **kw)
+
+
+class TestPredicates:
+    def test_capacity_and_selector(self):
+        node_labels = jnp.asarray(np.array([[0], [1]], np.int32))
+        task_sel = jnp.asarray(np.array([[0], [-1]], np.int32))
+        mask = P.selector_mask(node_labels, task_sel)
+        assert mask.tolist() == [[True, False], [True, True]]
+
+    def test_tolerations(self):
+        node_taints = jnp.asarray(np.array([[0, 1], [-1, -1]], np.int32))
+        task_tol = jnp.asarray(np.array([[0, -9], [0, 1]], np.int32))
+        mask = P.toleration_mask(node_taints, task_tol)
+        # task0 tolerates taint 0 only -> node0 (taints 0,1) fails.
+        assert mask.tolist() == [[False, True], [True, True]]
+
+    def test_feasibility_masks(self):
+        idle = jnp.asarray(np.array([[1000.0, 1e9, 2.0]]))
+        rel = jnp.asarray(np.array([[0.0, 0.0, 2.0]]))
+        labels = jnp.full((1, 1), -1, jnp.int32)
+        taints = jnp.full((1, 1), -1, jnp.int32)
+        room = jnp.ones(1)
+        req = jnp.asarray(np.array([[500.0, 1e8, 4.0]]))
+        sel = jnp.full((1, 1), -1, jnp.int32)
+        tol = jnp.full((1, 1), -1, jnp.int32)
+        now, fut = P.feasibility_masks(idle, rel, labels, taints, room,
+                                       req, sel, tol)
+        assert not bool(now[0, 0]) and bool(fut[0, 0])
+
+
+class TestScoring:
+    def test_binpack_prefers_fuller_node(self):
+        nodes = make_nodes([2, 6])
+        tasks = make_tasks([2], [0])
+        fit = jnp.ones((1, 2), bool)
+        score = S.placement_scores(nodes[0], nodes[1], tasks[0], fit)
+        assert score[0, 0] > score[0, 1]
+
+    def test_spread_prefers_emptier_node(self):
+        nodes = make_nodes([2, 6])
+        tasks = make_tasks([2], [0])
+        fit = jnp.ones((1, 2), bool)
+        score = S.placement_scores(nodes[0], nodes[1], tasks[0], fit,
+                                   gpu_strategy=S.SPREAD)
+        assert score[0, 1] > score[0, 0]
+
+    def test_resource_type_match(self):
+        alloc = jnp.asarray(np.array([[8000.0, 1e9, 8.0],
+                                      [8000.0, 1e9, 0.0]]))
+        req = jnp.asarray(np.array([[1000.0, 1e8, 0.0],
+                                    [1000.0, 1e8, 1.0]]))
+        score = S.resource_type_scores(alloc, req)
+        # CPU job prefers CPU-only node; GPU job prefers GPU node.
+        assert score[0, 1] > score[0, 0]
+        assert score[1, 0] > score[1, 1]
+
+
+class TestAllocateKernel:
+    def test_binpack_fills_fuller_node(self):
+        nodes = make_nodes([4, 6])
+        tasks = make_tasks([2, 2], [0, 1])
+        out = run(nodes, tasks, 2)
+        assert out.placements.tolist() == [0, 0]  # packs node0 (fuller)
+        assert out.job_success.tolist() == [True, True]
+        assert float(out.node_idle[0, 2]) == 0.0
+
+    def test_sequential_mutation_no_double_booking(self):
+        nodes = make_nodes([2, 2])
+        tasks = make_tasks([2, 2], [0, 0])
+        out = run(nodes, tasks, 1)
+        assert sorted(out.placements.tolist()) == [0, 1]
+        assert bool(out.job_success[0])
+
+    def test_gang_rollback_frees_resources_for_next_job(self):
+        # Job 0 needs 2x8 GPUs but only one node has 8 -> gang fails,
+        # rollback lets job 1 (1x8) land on the freed node.
+        nodes = make_nodes([8, 4])
+        tasks = make_tasks([8, 8, 8], [0, 0, 1])
+        out = run(nodes, tasks, 2)
+        assert out.job_success.tolist() == [False, True]
+        assert out.placements.tolist() == [-1, -1, 0]
+        assert float(out.node_idle[0, 2]) == 0.0
+
+    def test_pipeline_onto_releasing(self):
+        alloc, idle, rel, labels, taints, room = make_nodes([0])
+        rel = jnp.asarray(np.array([[0.0, 0.0, 4.0]]))
+        tasks = make_tasks([4], [0])
+        out = run((alloc, idle, rel, labels, taints, room), tasks, 1)
+        assert out.placements.tolist() == [0]
+        assert out.pipelined.tolist() == [True]
+        assert float(out.node_releasing[0, 2]) == 0.0
+
+    def test_no_pipeline_when_disallowed(self):
+        alloc, idle, rel, labels, taints, room = make_nodes([0])
+        rel = jnp.asarray(np.array([[0.0, 0.0, 4.0]]))
+        tasks = make_tasks([4], [0])
+        out = run((alloc, idle, rel, labels, taints, room), tasks, 1,
+                  allow_pipeline=False)
+        assert out.placements.tolist() == [-1]
+        assert not bool(out.job_success[0])
+
+    def test_job_allowed_gate(self):
+        nodes = make_nodes([8])
+        tasks = make_tasks([1], [0])
+        out = run(nodes, tasks, 1, job_allowed=np.array([False]))
+        assert out.placements.tolist() == [-1]
+        # Gated job leaves node state untouched.
+        assert float(out.node_idle[0, 2]) == 8.0
+
+    def test_pipeline_only_mode(self):
+        alloc, idle, rel, labels, taints, room = make_nodes([8])
+        rel = jnp.asarray(np.array([[0.0, 0.0, 2.0]]))
+        tasks = make_tasks([2], [0])
+        out = run((alloc, idle, rel, labels, taints, room), tasks, 1,
+                  pipeline_only=True)
+        assert out.pipelined.tolist() == [True]
+        # Idle untouched; claimed from releasing pool.
+        assert float(out.node_idle[0, 2]) == 8.0
+        assert float(out.node_releasing[0, 2]) == 0.0
+
+    def test_selector_respected(self):
+        alloc, idle, rel, _, taints, room = make_nodes([8, 8])
+        labels = jnp.asarray(np.array([[0], [1]], np.int32))
+        req, jobs, _, tol = make_tasks([1], [0])
+        sel = jnp.asarray(np.array([[1]], np.int32))
+        out = allocate_jobs_kernel(alloc, idle, rel, labels, taints, room,
+                                   req, jobs, sel, tol,
+                                   jnp.asarray(np.ones(1, bool)))
+        assert out.placements.tolist() == [1]
+
+    def test_many_jobs_interleaved_rollbacks(self):
+        # Alternating feasible/infeasible gangs; feasible ones must all land.
+        nodes = make_nodes([4, 4, 4])
+        reqs, jobs = [], []
+        for j in range(6):
+            if j % 2 == 0:
+                reqs += [2]          # feasible single
+                jobs += [j]
+            else:
+                reqs += [4, 4, 4, 4]  # infeasible gang (needs 16)
+                jobs += [j] * 4
+        tasks = make_tasks(reqs, jobs)
+        out = run(nodes, tasks, 6)
+        assert out.job_success.tolist() == [True, False, True, False, True,
+                                            False]
+        placed = [p for p in out.placements.tolist() if p >= 0]
+        assert len(placed) == 3
+        # 3 x 2 GPUs placed; binpack packs them onto as few nodes as possible.
+        assert float(out.node_idle[:, 2].sum()) == 6.0
