@@ -1,0 +1,27 @@
+"""Actions: the per-cycle algorithms (SURVEY.md §2.1; reference
+pkg/scheduler/actions/, registry actions/factory.go:31-37)."""
+
+from .allocate import AllocateAction
+
+_REGISTRY = {}
+
+
+def register_action(cls):
+    _REGISTRY[cls.name] = cls
+    return cls
+
+
+register_action(AllocateAction)
+
+
+def build_actions(names) -> list:
+    out = []
+    for name in names:
+        cls = _REGISTRY.get(name)
+        if cls is not None:
+            out.append(cls())
+    return out
+
+
+def registered_actions():
+    return sorted(_REGISTRY)

@@ -1,0 +1,186 @@
+"""Allocate action: gang-allocate pending jobs in DRF order.
+
+Mirrors pkg/scheduler/actions/allocate/allocate.go:46-116 +
+actions/common/allocate.go:20-163: jobs ordered per-queue by DRF, each job's
+task chunk placed all-or-nothing under a per-job statement, topology node
+subsets tried with checkpoint/rollback, elastic jobs re-enqueued chunk by
+chunk.  Placement proposals come from the device kernel
+(ops/allocate.allocate_jobs_kernel); fractional-accelerator tasks take the
+host path through the sharing-group state (gpu_sharing/gpuSharing.go:20).
+"""
+
+from __future__ import annotations
+
+from ..api.podgroup_info import PodGroupInfo
+from ..api.pod_status import PodStatus
+from .utils import INFINITE, JobsOrderByQueues
+
+
+class AllocateAction:
+    name = "allocate"
+
+    def execute(self, ssn) -> None:
+        jobs = [pg for pg in ssn.cluster.podgroups.values()
+                if pg.has_tasks_to_allocate() and pg.is_ready_for_scheduling()
+                # Jobs pointing at unknown queues can't be ordered or
+                # charged; skip them (snapshot.pack drops them too).
+                and pg.queue_id in ssn.cluster.queues]
+        order = JobsOrderByQueues(
+            ssn, jobs,
+            ssn.config.queue_depth_per_action.get(self.name, INFINITE))
+        failed_signatures: set[str] = set()
+
+        while not order.empty():
+            job = order.pop_next_job()
+            if job is None:
+                break
+            if (ssn.config.use_scheduling_signatures
+                    and job.scheduling_signature() in failed_signatures):
+                job.add_fit_error(
+                    "skipped: identical job already failed this cycle")
+                order.requeue_queue(job.queue_id)
+                continue
+            succeeded = attempt_to_allocate_job(ssn, job)
+            if succeeded:
+                if job.has_tasks_to_allocate():
+                    order.push_job(job)  # elastic: next chunk later
+                else:
+                    order.requeue_queue(job.queue_id)
+            else:
+                if ssn.config.use_scheduling_signatures:
+                    failed_signatures.add(job.scheduling_signature())
+                order.requeue_queue(job.queue_id)
+
+
+def attempt_to_allocate_job(ssn, job: PodGroupInfo,
+                            pipeline_only: bool = False,
+                            stmt=None, commit: bool = True) -> bool:
+    """One gang-chunk allocation attempt (actions/common/allocate.go:20).
+
+    Returns True iff the whole chunk placed; on failure everything this
+    attempt did is rolled back.
+    """
+    ssn.pre_job_allocation(job)
+    tasks = job.tasks_to_allocate(
+        subgroup_order_fn=ssn.pod_set_order_key,
+        task_order_fn=ssn.task_order_key,
+        real_allocation=not pipeline_only)
+    if not tasks:
+        return False
+
+    result = ssn.is_job_over_queue_capacity(job, tasks)
+    if not result.schedulable:
+        if not pipeline_only:
+            job.add_fit_error(result.message)
+        return False
+
+    own_stmt = stmt is None
+    if own_stmt:
+        stmt = ssn.statement()
+
+    for node_subset in ssn.subset_nodes(job, tasks):
+        cp = stmt.checkpoint()
+        if _allocate_tasks_on_subset(ssn, stmt, job, tasks, node_subset,
+                                     pipeline_only):
+            if own_stmt and commit:
+                stmt.commit()
+            return True
+        stmt.rollback(cp)
+
+    if own_stmt:
+        stmt.discard()
+    return False
+
+
+def _allocate_tasks_on_subset(ssn, stmt, job, tasks, node_subset,
+                              pipeline_only: bool) -> bool:
+    fractional = [t for t in tasks if t.is_fractional]
+    if fractional:
+        ok = _allocate_task_by_task(ssn, stmt, job, tasks, node_subset,
+                                    pipeline_only)
+    else:
+        proposal = ssn.propose_placements(
+            tasks, pipeline_only=pipeline_only, node_subset=node_subset)
+        if not proposal.success:
+            _record_chunk_failure(ssn, job, tasks)
+            return False
+        for task, node_name, pipelined in proposal.placements:
+            if pipelined or pipeline_only:
+                stmt.pipeline(task, node_name)
+            else:
+                stmt.allocate(task, node_name)
+        ok = True
+    if not ok:
+        return False
+    # Gang pipelining rule (job_info.go:443 + statement.go:483): once any
+    # member waits on releasing resources, the whole gang waits.
+    if job.should_pipeline():
+        stmt.convert_all_allocated_to_pipelined(job.uid)
+    return True
+
+
+def _allocate_task_by_task(ssn, stmt, job, tasks, node_subset,
+                           pipeline_only: bool) -> bool:
+    """Host path for chunks containing fractional-GPU tasks."""
+    for i, task in enumerate(tasks):
+        if task.is_fractional:
+            placed = _allocate_fractional(ssn, stmt, task, node_subset,
+                                          pipeline_only)
+        else:
+            proposal = ssn.propose_placements(
+                [task], pipeline_only=pipeline_only, node_subset=node_subset)
+            placed = proposal.success
+            if placed:
+                t, node_name, pipelined = proposal.placements[0]
+                if pipelined or pipeline_only:
+                    stmt.pipeline(t, node_name)
+                else:
+                    stmt.allocate(t, node_name)
+        if not placed:
+            _record_chunk_failure(ssn, job, tasks, failed_task=task,
+                                  placed_count=i)
+            return False
+    return True
+
+
+def _allocate_fractional(ssn, stmt, task, node_subset,
+                         pipeline_only: bool) -> bool:
+    """gpu_sharing.AllocateFractionalGPUTaskToNode (gpuSharing.go:20)."""
+    import numpy as np
+    # Restrict to real (non-padding) node rows.
+    scores = ssn.score_nodes_for_task(task)[:len(ssn.snapshot.node_names)]
+    order = np.argsort(-scores, kind="stable")
+    for node_idx in order:
+        if node_subset is not None and not node_subset[node_idx]:
+            continue
+        node = ssn.cluster.nodes[ssn.snapshot.node_names[int(node_idx)]]
+        if not pipeline_only and node.is_task_allocatable(task):
+            groups = node.find_gpu_groups_for_task(task,
+                                                   allow_releasing=False)
+            if groups is not None:
+                stmt.allocate(task, node.name, gpu_group=",".join(groups))
+                return True
+        if node.is_task_allocatable_on_releasing_or_idle(task):
+            groups = node.find_gpu_groups_for_task(task, allow_releasing=True)
+            if groups is not None:
+                stmt.pipeline(task, node.name, gpu_group=",".join(groups))
+                return True
+    return False
+
+
+def _record_chunk_failure(ssn, job, tasks, failed_task=None,
+                          placed_count: int | None = None) -> None:
+    """Explainability events (actions/common/allocate.go:198-234)."""
+    gang = any(ps.min_available > 1 for ps in job.pod_sets.values())
+    if failed_task is None:
+        msg = (f"Resources were not found for {len(tasks)} pods of job "
+               f"{job.namespace}/{job.name}")
+    elif gang:
+        msg = (f"Resources were found for {placed_count} pods while "
+               f"{len(tasks)} are required for gang scheduling of job "
+               f"{job.namespace}/{job.name}")
+    else:
+        msg = (f"Resources were not found for pod {failed_task.namespace}/"
+               f"{failed_task.name}")
+    job.add_fit_error(msg)
+    ssn.cache.record_event("Unschedulable", msg)

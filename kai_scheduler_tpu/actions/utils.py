@@ -1,0 +1,130 @@
+"""Action utilities: comparator heaps and per-queue job ordering.
+
+Mirrors pkg/scheduler/scheduler_util/priority_queue.go (binary heap with
+capacity) and pkg/scheduler/actions/utils/job_order_by_queue.go: a heap of
+queues ordered by the DRF queue comparator, each holding a heap of its jobs
+ordered by the composed job-order functions; popping yields the globally
+next job, and queues re-enter the heap with updated shares after each
+allocation.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+from typing import Callable, Iterable
+
+from ..api.podgroup_info import PodGroupInfo
+
+INFINITE = -1
+
+
+class PriorityQueue:
+    """Heap over a less(a, b) comparator with optional max size."""
+
+    def __init__(self, less: Callable, max_size: int = INFINITE):
+        self.less = less
+        self.max_size = max_size
+        self._items: list = []
+        self._counter = itertools.count()
+
+    class _Entry:
+        __slots__ = ("item", "less", "seq")
+
+        def __init__(self, item, less, seq):
+            self.item, self.less, self.seq = item, less, seq
+
+        def __lt__(self, other):
+            if self.less(self.item, other.item):
+                return True
+            if self.less(other.item, self.item):
+                return False
+            return self.seq < other.seq
+
+    def push(self, item) -> None:
+        entry = self._Entry(item, self.less, next(self._counter))
+        if self.max_size != INFINITE and len(self._items) >= self.max_size:
+            # Keep the best max_size items: replace the worst if the new
+            # item beats it (priority_queue.go bounded behavior).
+            worst = max(self._items)
+            if entry < worst:
+                self._items.remove(worst)
+                heapq.heapify(self._items)
+                heapq.heappush(self._items, entry)
+            return
+        heapq.heappush(self._items, entry)
+
+    def pop(self):
+        return heapq.heappop(self._items).item
+
+    def peek(self):
+        return self._items[0].item
+
+    def empty(self) -> bool:
+        return not self._items
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+
+class JobsOrderByQueues:
+    """The allocate/reclaim job iterator (job_order_by_queue.go).
+
+    Queues are ordered by ssn.compare_queues with each queue's *next job*
+    as context (DRF with the job's demand); jobs within a queue by
+    ssn.compare_jobs.  After a job is processed the queue is re-pushed so
+    ordering reflects updated shares.
+    """
+
+    def __init__(self, ssn, jobs: Iterable[PodGroupInfo],
+                 max_jobs_per_queue: int = INFINITE,
+                 victims_by_queue: dict | None = None):
+        self.ssn = ssn
+        self.victims_by_queue = victims_by_queue or {}
+        self._job_heaps: dict[str, PriorityQueue] = {}
+        for job in jobs:
+            heap = self._job_heaps.get(job.queue_id)
+            if heap is None:
+                heap = PriorityQueue(
+                    lambda a, b: ssn.compare_jobs(a, b) < 0,
+                    max_jobs_per_queue)
+                self._job_heaps[job.queue_id] = heap
+            heap.push(job)
+        self._queue_heap = PriorityQueue(self._queue_less)
+        for qid, heap in self._job_heaps.items():
+            if not heap.empty():
+                self._queue_heap.push(qid)
+
+    def _queue_less(self, l: str, r: str) -> bool:
+        l_job = self._peek_job(l)
+        r_job = self._peek_job(r)
+        return self.ssn.compare_queues(
+            l, r, l_job, r_job,
+            self.victims_by_queue.get(l), self.victims_by_queue.get(r)) < 0
+
+    def _peek_job(self, qid: str):
+        heap = self._job_heaps.get(qid)
+        return heap.peek() if heap and not heap.empty() else None
+
+    def empty(self) -> bool:
+        return self._queue_heap.empty()
+
+    def pop_next_job(self) -> PodGroupInfo | None:
+        """Pop the best job of the best queue; the queue leaves the heap
+        until push_job/done re-inserts it."""
+        while not self._queue_heap.empty():
+            qid = self._queue_heap.pop()
+            heap = self._job_heaps[qid]
+            if heap.empty():
+                continue
+            return heap.pop()
+        return None
+
+    def push_job(self, job: PodGroupInfo) -> None:
+        """Re-enqueue a job (e.g. elastic next chunk) and its queue."""
+        self._job_heaps[job.queue_id].push(job)
+        self._queue_heap.push(job.queue_id)
+
+    def requeue_queue(self, qid: str) -> None:
+        if not self._job_heaps[qid].empty():
+            self._queue_heap.push(qid)

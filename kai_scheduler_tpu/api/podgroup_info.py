@@ -164,8 +164,12 @@ class PodGroupInfo:
         for ps in self.pod_sets.values():
             has_pipelined = any(t.status == PodStatus.PIPELINED
                                 for t in ps.pods.values())
-            active_allocated = sum(1 for t in ps.pods.values()
-                                   if is_active_allocated(t.status))
+            # Pipelined members don't count toward the allocated quorum
+            # (the reference's if/elif excludes them, job_info.go:448-455).
+            active_allocated = sum(
+                1 for t in ps.pods.values()
+                if t.status != PodStatus.PIPELINED
+                and is_active_allocated(t.status))
             if has_pipelined and active_allocated < ps.min_available:
                 return True
         return False

@@ -134,11 +134,22 @@ def pack(cluster: ClusterInfo,
     # A job pointing at an unknown queue must not alias onto queue 0.
     jobs = [pg for pg in jobs if pg.queue_id in cluster.queues]
 
+    # Invalidate every stale row index first: a task dropped from this
+    # cycle's candidate set must not silently select another task's row.
+    for pg in cluster.podgroups.values():
+        for t in pg.pods.values():
+            t.tensor_idx = -1
+
+    # Pack every candidate task (not just the first gang chunk): actions
+    # may allocate a job in several chunks per cycle (elastic growth), and
+    # each chunk slices rows out of these arrays by tensor_idx.
     tasks: list[PodInfo] = []
     job_start, job_count = [], []
     for pg in jobs:
         start = len(tasks)
-        sel = pg.tasks_to_allocate(real_allocation=real_allocation)
+        sel = sorted((t for t in pg.pods.values()
+                      if pg._should_allocate(t, real_allocation)),
+                     key=lambda t: (t.name, t.uid))
         tasks.extend(sel)
         job_start.append(start)
         job_count.append(len(sel))

@@ -1,0 +1,61 @@
+"""Scheduler configuration: actions sequence + plugin tiers with args.
+
+Mirrors the reference's scheduler config YAML and embedded default
+(pkg/scheduler/conf, conf_util/scheduler_conf_util.go:36-61): an ordered
+actions string and plugin tiers, each plugin with an optional string-map of
+arguments, plus global knobs (kValue for usage-penalized fair share,
+staleness grace, queue depth per action).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+DEFAULT_PLUGINS = [
+    "predicates", "proportion", "priority", "nodeplacement", "elastic",
+    "taskorder", "subgrouporder", "nodeavailability", "resourcetype",
+    "gpupack", "gpusharingorder", "nominatednode", "minruntime",
+    "topology", "snapshot",
+]
+
+DEFAULT_ACTIONS = ["allocate", "consolidation", "reclaim", "preempt",
+                   "stalegangeviction"]
+
+
+@dataclass
+class PluginConfig:
+    name: str
+    args: dict = field(default_factory=dict)
+
+
+@dataclass
+class SchedulerConfig:
+    actions: list = field(default_factory=lambda: list(DEFAULT_ACTIONS))
+    plugins: list = field(default_factory=lambda: [
+        PluginConfig(p) for p in DEFAULT_PLUGINS])
+    # Usage-penalty coefficient k in w' = max(0, W' + k*(W' - U'))
+    # (resource_division.go:245).
+    k_value: float = 1.0
+    # Placement strategies per resource type (nodeplacement args).
+    gpu_placement_strategy: str = "binpack"
+    cpu_placement_strategy: str = "binpack"
+    # Gang staleness grace before eviction (stalegangeviction action).
+    default_staleness_grace_seconds: float = 60.0
+    # Max jobs considered per queue per action (queue depth).
+    queue_depth_per_action: dict = field(default_factory=dict)
+    # Reclaim saturation multiplier (reclaimable.go New).
+    saturation_multiplier: float = 1.0
+    # Scheduling-signature dedup of provably unschedulable jobs.
+    use_scheduling_signatures: bool = True
+    # Node-axis padding bucket to stabilize kernel shapes across cycles.
+    node_pad_bucket: int = 0
+
+    def plugin_args(self, name: str) -> dict:
+        for p in self.plugins:
+            if p.name == name:
+                return p.args
+        return {}
+
+    def has_plugin(self, name: str) -> bool:
+        return any(p.name == name for p in self.plugins)

@@ -1,0 +1,303 @@
+"""Session: one scheduling cycle's world view + composed extension points.
+
+Mirrors pkg/scheduler/framework/session.go: OpenSession snapshots the
+cluster, lets each configured plugin register callbacks, and hands the
+composed dispatchers to the actions.  The big departure from the reference:
+``OrderedNodesByTask``'s goroutine-per-node scoring loop (session.go:234)
+is replaced by the jitted gang-allocation kernel — the session keeps dense
+numpy mirrors of node state (single writer: the Statement) and calls the
+device kernel to propose placements for whole gangs at once.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..api.cluster_info import ClusterInfo
+from ..api.pod_info import PodInfo
+from ..api.podgroup_info import PodGroupInfo
+from ..api.snapshot import SnapshotTensors, pack
+from ..ops.allocate import allocate_jobs_kernel
+from ..ops.scoring import BINPACK
+from .statement import Statement
+
+
+@dataclass
+class SchedulableResult:
+    schedulable: bool = True
+    reason: str = ""
+    message: str = ""
+
+
+@dataclass
+class Proposal:
+    """A gang placement proposal from the device kernel."""
+    success: bool
+    placements: list  # [(task, node_name, pipelined)]
+
+
+class InMemoryCache:
+    """Side-effect executor for tests and offline replay — the analog of
+    cache.Bind/Evict (pkg/scheduler/cache/cache.go:267, evictor)."""
+
+    def __init__(self):
+        self.bound = []     # (task_uid, node_name)
+        self.evicted = []   # task_uid
+        self.events = []    # (kind, message)
+
+    def bind(self, task, node_name, bind_request) -> None:
+        self.bound.append((task.uid, node_name))
+
+    def evict(self, task) -> None:
+        self.evicted.append(task.uid)
+
+    def record_event(self, kind: str, message: str) -> None:
+        self.events.append((kind, message))
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+class Session:
+    def __init__(self, cluster: ClusterInfo, config=None, cache=None,
+                 queue_usage: dict | None = None):
+        from .conf import SchedulerConfig  # local import to avoid cycle
+        self.cluster = cluster
+        self.config = config or SchedulerConfig()
+        self.cache = cache or InMemoryCache()
+        self.queue_usage = queue_usage or {}
+        # --- extension points (session.go:51-95 function slices) ---
+        self.queue_order_fns: list[Callable] = []
+        self.job_order_fns: list[Callable] = []
+        self.task_order_fns: list[Callable] = []
+        self.pod_set_order_fns: list[Callable] = []
+        self.over_capacity_fns: list[Callable] = []
+        self.non_preemptible_over_quota_fns: list[Callable] = []
+        self.can_reclaim_fns: list[Callable] = []
+        self.reclaim_scenario_validators: list[Callable] = []
+        self.preempt_scenario_validators: list[Callable] = []
+        self.reclaim_victim_filters: list[Callable] = []
+        self.preempt_victim_filters: list[Callable] = []
+        self.allocate_handlers: list[Callable] = []
+        self.deallocate_handlers: list[Callable] = []
+        self.subset_nodes_fns: list[Callable] = []
+        self.extra_score_fns: list[Callable] = []
+        self.pre_job_allocation_fns: list[Callable] = []
+        self.gpu_order_fns: list[Callable] = []
+        self.plugins = []
+        # --- packed snapshot + mutable dense mirrors ---
+        pad = None
+        bucket = self.config.node_pad_bucket
+        if bucket:
+            pad = max(bucket, -(-len(cluster.nodes) // bucket) * bucket)
+        self.snapshot: SnapshotTensors = pack(
+            cluster, queue_usage=queue_usage, pad_nodes_to=pad)
+        self.node_idle = self.snapshot.node_idle.copy()
+        self.node_releasing = self.snapshot.node_releasing.copy()
+        self.node_room = self.snapshot.node_pod_room.copy()
+        self._node_index = {n: i for i, n in
+                            enumerate(self.snapshot.node_names)}
+        self.gpu_strategy = BINPACK
+        self.cpu_strategy = BINPACK
+        self.statements: list[Statement] = []
+
+    # -- lifecycle ---------------------------------------------------------
+    def open(self) -> "Session":
+        from ..plugins import build_plugins
+        self.plugins = build_plugins(self.config)
+        for plugin in self.plugins:
+            plugin.on_session_open(self)
+        return self
+
+    def close(self) -> None:
+        for plugin in self.plugins:
+            plugin.on_session_close(self)
+
+    def statement(self) -> Statement:
+        st = Statement(self)
+        self.statements.append(st)
+        return st
+
+    # -- dense-state sync (called by Statement) ----------------------------
+    def sync_node(self, node) -> None:
+        i = node.idx
+        if 0 <= i < self.node_idle.shape[0]:
+            self.node_idle[i] = node.idle
+            self.node_releasing[i] = node.releasing
+            self.node_room[i] = max(0, node.max_pods - len(node.pod_infos))
+
+    # -- composed dispatchers (session_plugins.go:117-300) -----------------
+    def compare_queues(self, l, r, l_job=None, r_job=None,
+                       l_victims=None, r_victims=None) -> int:
+        for fn in self.queue_order_fns:
+            res = fn(l, r, l_job, r_job, l_victims, r_victims)
+            if res != 0:
+                return res
+        return 0
+
+    def compare_jobs(self, l: PodGroupInfo, r: PodGroupInfo) -> int:
+        for fn in self.job_order_fns:
+            res = fn(l, r)
+            if res != 0:
+                return res
+        if l.creation_ts != r.creation_ts:
+            return -1 if l.creation_ts < r.creation_ts else 1
+        return -1 if l.uid < r.uid else (1 if l.uid > r.uid else 0)
+
+    def task_order_key(self, task: PodInfo):
+        return tuple(fn(task) for fn in self.task_order_fns) + (
+            task.name, task.uid)
+
+    def pod_set_order_key(self, ps):
+        return tuple(fn(ps) for fn in self.pod_set_order_fns) + (ps.name,)
+
+    def is_job_over_queue_capacity(self, job, tasks) -> SchedulableResult:
+        for fn in self.over_capacity_fns:
+            res = fn(job, tasks)
+            if not res.schedulable:
+                return res
+        return SchedulableResult()
+
+    def is_non_preemptible_over_quota(self, job, tasks) -> SchedulableResult:
+        for fn in self.non_preemptible_over_quota_fns:
+            res = fn(job, tasks)
+            if not res.schedulable:
+                return res
+        return SchedulableResult()
+
+    def can_reclaim_resources(self, job) -> bool:
+        return all(fn(job) for fn in self.can_reclaim_fns)
+
+    def validate_reclaim_scenario(self, scenario) -> bool:
+        return all(fn(scenario) for fn in self.reclaim_scenario_validators)
+
+    def validate_preempt_scenario(self, scenario) -> bool:
+        return all(fn(scenario) for fn in self.preempt_scenario_validators)
+
+    def filter_reclaim_victims(self, reclaimer, victims) -> list:
+        for fn in self.reclaim_victim_filters:
+            victims = fn(reclaimer, victims)
+        return victims
+
+    def filter_preempt_victims(self, preemptor, victims) -> list:
+        for fn in self.preempt_victim_filters:
+            victims = fn(preemptor, victims)
+        return victims
+
+    def fire_allocate_handlers(self, task: PodInfo) -> None:
+        for fn in self.allocate_handlers:
+            fn(task)
+
+    def fire_deallocate_handlers(self, task: PodInfo,
+                                 prev_status) -> None:
+        for fn in self.deallocate_handlers:
+            fn(task, prev_status)
+
+    def pre_job_allocation(self, job: PodGroupInfo) -> None:
+        for fn in self.pre_job_allocation_fns:
+            fn(job)
+
+    def subset_nodes(self, job, tasks) -> list:
+        """Topology plugin hook: ordered list of candidate node-index sets
+        (None = all nodes).  Mirrors ssn.SubsetNodesFn."""
+        for fn in self.subset_nodes_fns:
+            sets = fn(job, tasks)
+            if sets is not None:
+                return sets
+        return [None]
+
+    # -- device-kernel placement proposals ---------------------------------
+    def propose_placements(self, tasks: list[PodInfo],
+                           pipeline_only: bool = False,
+                           allow_pipeline: bool = True,
+                           node_subset: np.ndarray | None = None
+                           ) -> Proposal:
+        """Run the gang-allocation kernel for one job's task chunk against
+        the current (statement-mutated) node state."""
+        snap = self.snapshot
+        rows = [t.tensor_idx for t in tasks]
+        if any(r < 0 for r in rows):
+            return Proposal(False, [])
+        t = len(rows)
+        t_pad = _next_pow2(max(t, 1))
+        sel = np.asarray(rows, np.int64)
+
+        task_req = np.zeros((t_pad, snap.task_req.shape[1]))
+        task_req[:t] = snap.task_req[sel]
+        task_sel = np.full((t_pad, snap.task_selector.shape[1]), -1, np.int32)
+        task_sel[:t] = snap.task_selector[sel]
+        task_tol = np.full((t_pad, snap.task_tolerations.shape[1]), -1,
+                           np.int32)
+        task_tol[:t] = snap.task_tolerations[sel]
+        task_job = np.zeros(t_pad, np.int32)
+        task_job[t:] = 1  # padding rows belong to a gated-out dummy job
+        job_allowed = np.array([True, False])
+
+        extra = np.zeros((t_pad, self.node_idle.shape[0]))
+        for fn in self.extra_score_fns:
+            contrib = fn(tasks)
+            if contrib is not None:
+                extra[:t] += contrib
+        if node_subset is not None:
+            extra[:, ~node_subset] = -1e17  # mask out-of-subset nodes
+
+        result = allocate_jobs_kernel(
+            jnp.asarray(snap.node_allocatable), jnp.asarray(self.node_idle),
+            jnp.asarray(self.node_releasing),
+            jnp.asarray(snap.node_labels), jnp.asarray(snap.node_taints),
+            jnp.asarray(self.node_room),
+            jnp.asarray(task_req), jnp.asarray(task_job),
+            jnp.asarray(task_sel), jnp.asarray(task_tol),
+            jnp.asarray(job_allowed), jnp.asarray(extra),
+            gpu_strategy=self.gpu_strategy, cpu_strategy=self.cpu_strategy,
+            allow_pipeline=allow_pipeline, pipeline_only=pipeline_only)
+
+        if not bool(result.job_success[0]):
+            return Proposal(False, [])
+        placements = []
+        placed = np.asarray(result.placements[:t])
+        piped = np.asarray(result.pipelined[:t])
+        for i, task in enumerate(tasks):
+            node_idx = int(placed[i])
+            if node_idx < 0:
+                return Proposal(False, [])
+            if node_subset is not None and not node_subset[node_idx]:
+                return Proposal(False, [])
+            placements.append((task, snap.node_names[node_idx],
+                               bool(piped[i])))
+        return Proposal(True, placements)
+
+    def score_nodes_for_task(self, task: PodInfo) -> np.ndarray:
+        """[N] score row for host-side paths (fractional GPU placement)."""
+        from ..ops.predicates import feasibility_masks
+        from ..ops.scoring import score_matrix
+        snap = self.snapshot
+        if task.tensor_idx < 0:
+            return np.zeros(self.node_idle.shape[0])
+        sel = np.array([task.tensor_idx])
+        req = snap.task_req[sel]
+        # Fractional tasks: capacity-check the cpu/mem axes; GPU device fit
+        # is decided host-side by the sharing-group logic.
+        fit_now, fit_future = feasibility_masks(
+            jnp.asarray(self.node_idle), jnp.asarray(self.node_releasing),
+            jnp.asarray(snap.node_labels), jnp.asarray(snap.node_taints),
+            jnp.asarray(self.node_room), jnp.asarray(req),
+            jnp.asarray(snap.task_selector[sel]),
+            jnp.asarray(snap.task_tolerations[sel]))
+        score = score_matrix(
+            jnp.asarray(snap.node_allocatable), jnp.asarray(self.node_idle),
+            jnp.asarray(req), fit_now, fit_future,
+            gpu_strategy=self.gpu_strategy, cpu_strategy=self.cpu_strategy)
+        return np.asarray(score[0])
+
+    def node_index(self, name: str) -> int:
+        return self._node_index.get(name, -1)

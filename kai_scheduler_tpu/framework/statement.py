@@ -1,0 +1,172 @@
+"""Transactional statement: the in-session operation log.
+
+Mirrors pkg/scheduler/framework/statement.go: every mutation an action makes
+(Allocate/Pipeline/Evict) goes through here so preemption scenarios can
+checkpoint (:44), roll back (:48), convert allocations to pipelines (:483),
+and finally commit side effects (:536 — bind requests and evictions).
+
+The statement is also the single writer of the session's dense node-state
+mirrors: each op updates both the host object graph (NodeInfo/PodGroupInfo)
+and the packed numpy arrays the device kernels consume, keeping the two
+views exactly in sync.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import TYPE_CHECKING, Optional
+
+from ..api.cluster_info import BindRequest
+from ..api.pod_info import PodInfo
+from ..api.pod_status import PodStatus
+
+if TYPE_CHECKING:
+    from .session import Session
+
+
+@dataclass
+class _Op:
+    kind: str                      # allocate | pipeline | evict
+    task: PodInfo
+    node_name: str = ""
+    prev_status: PodStatus = PodStatus.PENDING
+    prev_node: str = ""
+    prev_gpu_group: str = ""
+    gpu_group: str = ""
+
+
+class Statement:
+    def __init__(self, session: "Session"):
+        self.session = session
+        self.ops: list[_Op] = []
+        self.committed = False
+
+    # -- mutations ---------------------------------------------------------
+    def allocate(self, task: PodInfo, node_name: str,
+                 gpu_group: str = "") -> None:
+        """Assign the task to a node on idle resources (statement.go:297)."""
+        self._place(task, node_name, PodStatus.ALLOCATED, gpu_group,
+                    "allocate")
+
+    def pipeline(self, task: PodInfo, node_name: str,
+                 gpu_group: str = "") -> None:
+        """Assign the task onto releasing resources (statement.go:197)."""
+        self._place(task, node_name, PodStatus.PIPELINED, gpu_group,
+                    "pipeline")
+
+    def _place(self, task: PodInfo, node_name: str, status: PodStatus,
+               gpu_group: str, kind: str) -> None:
+        node = self.session.cluster.nodes[node_name]
+        job = self.session.cluster.podgroups.get(task.job_id)
+        op = _Op(kind, task, node_name, prev_status=task.status,
+                 prev_node=task.node_name, prev_gpu_group=task.gpu_group,
+                 gpu_group=gpu_group)
+        task.node_name = node_name
+        task.gpu_group = gpu_group
+        if job is not None:
+            job.update_task_status(task, status)
+        else:
+            task.status = status
+        node.add_task(task)
+        self.session.sync_node(node)
+        self.session.fire_allocate_handlers(task)
+        self.ops.append(op)
+
+    def evict(self, task: PodInfo) -> None:
+        """Mark the task as releasing its resources (statement.go:63)."""
+        node = self.session.cluster.nodes.get(task.node_name)
+        job = self.session.cluster.podgroups.get(task.job_id)
+        op = _Op("evict", task, task.node_name, prev_status=task.status,
+                 prev_node=task.node_name, prev_gpu_group=task.gpu_group)
+        if node is not None:
+            node.remove_task(task)
+        if job is not None:
+            job.update_task_status(task, PodStatus.RELEASING)
+        else:
+            task.status = PodStatus.RELEASING
+        if node is not None:
+            node.add_task(task)
+            self.session.sync_node(node)
+        self.session.fire_deallocate_handlers(task, op.prev_status)
+        self.ops.append(op)
+
+    # -- undo --------------------------------------------------------------
+    def checkpoint(self) -> int:
+        return len(self.ops)
+
+    def rollback(self, checkpoint: int = 0) -> None:
+        while len(self.ops) > checkpoint:
+            self._undo(self.ops.pop())
+
+    def _undo(self, op: _Op) -> None:
+        task = op.task
+        node = self.session.cluster.nodes.get(op.node_name)
+        job = self.session.cluster.podgroups.get(task.job_id)
+        if op.kind in ("allocate", "pipeline"):
+            if node is not None:
+                node.remove_task(task)
+            self.session.fire_deallocate_handlers(task, task.status)
+            if job is not None:
+                job.update_task_status(task, op.prev_status)
+            else:
+                task.status = op.prev_status
+            task.node_name = op.prev_node
+            task.gpu_group = op.prev_gpu_group
+            if node is not None:
+                self.session.sync_node(node)
+        elif op.kind == "evict":
+            if node is not None:
+                node.remove_task(task)
+            if job is not None:
+                job.update_task_status(task, op.prev_status)
+            else:
+                task.status = op.prev_status
+            task.node_name = op.prev_node
+            task.gpu_group = op.prev_gpu_group
+            if node is not None:
+                node.add_task(task)
+                self.session.sync_node(node)
+            self.session.fire_allocate_handlers(task)
+
+    # -- pipelining conversion (statement.go:483) --------------------------
+    def convert_all_allocated_to_pipelined(self, job_id: str) -> None:
+        """Once any gang member pipelines, the whole gang must wait for the
+        releasing resources: demote this statement's Allocated ops."""
+        for op in self.ops:
+            if (op.kind == "allocate" and op.task.job_id == job_id
+                    and op.task.status == PodStatus.ALLOCATED):
+                node = self.session.cluster.nodes[op.task.node_name]
+                job = self.session.cluster.podgroups.get(job_id)
+                node.remove_task(op.task)
+                if job is not None:
+                    job.update_task_status(op.task, PodStatus.PIPELINED)
+                else:
+                    op.task.status = PodStatus.PIPELINED
+                node.add_task(op.task)
+                self.session.sync_node(node)
+                op.kind = "pipeline"
+
+    # -- commit (statement.go:536) -----------------------------------------
+    def commit(self) -> list[BindRequest]:
+        """Apply durable side effects: BindRequests for allocations,
+        evictions via the cache/evictor.  Pipelined tasks stay in-memory —
+        they bind in a later cycle once resources actually free."""
+        binds: list[BindRequest] = []
+        for op in self.ops:
+            if op.kind == "allocate":
+                br = BindRequest(
+                    pod_uid=op.task.uid, pod_name=op.task.name,
+                    namespace=op.task.namespace, node_name=op.node_name,
+                    gpu_groups=(op.gpu_group.split(",") if op.gpu_group
+                                else []))
+                binds.append(br)
+                self.session.cache.bind(op.task, op.node_name, br)
+            elif op.kind == "evict":
+                self.session.cache.evict(op.task)
+        self.committed = True
+        self.session.cluster.bind_requests.extend(binds)
+        return binds
+
+    def discard(self) -> None:
+        """Roll everything back (an action abandoning its statement)."""
+        self.rollback(0)

@@ -32,10 +32,8 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from .predicates import NO_LABEL, NO_TAINT
-from .scoring import (AVAILABILITY, BINPACK, MAX_HIGH_DENSITY, RESOURCE_TYPE,
-                      SPREAD)
-from ..api.resources import RES_CPU, RES_GPU
+from .predicates import feasibility_row
+from .scoring import BINPACK, score_row
 
 EPS = 1e-9
 NEG = -1e18
@@ -47,53 +45,6 @@ class AllocationResult(NamedTuple):
     job_success: jnp.ndarray   # [J] bool — gang fully placed
     node_idle: jnp.ndarray     # [N,R] post-allocation idle
     node_releasing: jnp.ndarray  # [N,R] post-allocation releasing pool
-
-
-def _task_feasibility_row(idle, releasing, labels, taints, room,
-                          req, selector, tolerations):
-    """Predicate row for one task against current node state: [N] masks."""
-    sel_ok = jnp.all((selector[None, :] == NO_LABEL)
-                     | (selector[None, :] == labels), axis=-1)
-    tol = jnp.any(taints[:, :, None] == tolerations[None, None, :], axis=-1)
-    taint_ok = jnp.all((taints == NO_TAINT) | tol, axis=-1)
-    hard = sel_ok & taint_ok & (room >= 1.0)
-    fit_now = hard & jnp.all(req[None, :] <= idle + EPS, axis=-1)
-    fit_future = hard & jnp.all(req[None, :] <= idle + releasing + EPS,
-                                axis=-1)
-    return fit_now, fit_future
-
-
-def _task_score_row(allocatable, idle, req, fit_any, fit_now,
-                    gpu_strategy: int, cpu_strategy: int):
-    """Score row for one task (binpack/spread + resourcetype +
-    availability), matching ops.scoring term magnitudes."""
-    is_gpu_job = req[RES_GPU] > 0.0
-
-    def axis_score(res, strategy):
-        free = idle[:, res]
-        cap = allocatable[:, res]
-        has_res = cap > 0.0
-        if strategy == SPREAD:
-            return jnp.where(has_res, free / jnp.where(has_res, cap, 1.0),
-                             0.0)
-        valid = fit_any & has_res
-        min_free = jnp.min(jnp.where(valid, free, jnp.inf))
-        max_free = jnp.max(jnp.where(valid, free, -jnp.inf))
-        span = max_free - min_free
-        flat = span <= 0.0
-        score = MAX_HIGH_DENSITY * (
-            1.0 - (free - min_free) / jnp.where(flat, 1.0, span))
-        score = jnp.where(flat, MAX_HIGH_DENSITY, score)
-        return jnp.where(has_res, score, 0.0)
-
-    placement = jnp.where(is_gpu_job,
-                          axis_score(RES_GPU, gpu_strategy),
-                          axis_score(RES_CPU, cpu_strategy))
-    node_has_gpu = allocatable[:, RES_GPU] > 0.0
-    rtype = jnp.where(jnp.where(is_gpu_job, node_has_gpu, ~node_has_gpu),
-                      RESOURCE_TYPE, 0.0)
-    avail = jnp.where(fit_now, AVAILABILITY, 0.0)
-    return placement + rtype + avail
 
 
 @functools.partial(jax.jit,
@@ -149,15 +100,15 @@ def allocate_jobs_kernel(node_allocatable, node_idle, node_releasing,
         ok = jnp.where(new_job, job_allowed[j], carry.cur_ok)
 
         req = task_req[t]
-        fit_now, fit_future = _task_feasibility_row(
+        fit_now, fit_future = feasibility_row(
             idle, rel, node_labels, node_taints, room, req,
             task_selector[t], task_tolerations[t])
         if pipeline_only:
             fit_now = jnp.zeros_like(fit_now)
         feasible = fit_now | (fit_future if (allow_pipeline or pipeline_only)
                               else jnp.zeros_like(fit_future))
-        score = _task_score_row(node_allocatable, idle, req, feasible,
-                                fit_now, gpu_strategy, cpu_strategy)
+        score = score_row(node_allocatable, idle, req, feasible,
+                          fit_now, gpu_strategy, cpu_strategy)
         score = score + task_extra_scores[t]
         found = ok & jnp.any(feasible)
         best = jnp.argmax(jnp.where(feasible, score, NEG))

@@ -5,9 +5,12 @@ Replaces the reference's per-task-per-node predicate chain
 pkg/scheduler/k8s_internal/predicates/predicates.go:70-167 and
 NodeInfo.IsTaskAllocatable node_info.go:168) with dense tensor ops over the
 packed snapshot: resource capacity, node-selector/affinity label matching,
-taint/toleration, and pod-count room all evaluate as one [T, N] boolean
-program under jit.  The Go code runs these per candidate node inside the
-allocation loop; here the full mask is one fused XLA computation.
+taint/toleration, and pod-count room all evaluate as one boolean program
+under jit.
+
+``feasibility_row`` is the canonical single-task implementation; the gang
+allocation kernel steps it per task against mutating node state, and the
+batch [T, N] form is its vmap — one definition, no drift between paths.
 """
 
 from __future__ import annotations
@@ -20,14 +23,44 @@ NO_TAINT = -1
 EPS = 1e-9
 
 
+def feasibility_row(idle, releasing, labels, taints, room,
+                    req, selector, tolerations):
+    """One task against all nodes: ([N,R] state, [R]/[L]/[Tl] task) ->
+    (fit_now [N], fit_future [N]).
+
+    fit_now: IsTaskAllocatable (idle resources); fit_future:
+    IsTaskAllocatableOnReleasingOrIdle (pipelining candidates).
+    """
+    sel_ok = jnp.all((selector[None, :] == NO_LABEL)
+                     | (selector[None, :] == labels), axis=-1)
+    tol = jnp.any(taints[:, :, None] == tolerations[None, None, :], axis=-1)
+    taint_ok = jnp.all((taints == NO_TAINT) | tol, axis=-1)
+    hard = sel_ok & taint_ok & (room >= 1.0)
+    fit_now = hard & jnp.all(req[None, :] <= idle + EPS, axis=-1)
+    fit_future = hard & jnp.all(req[None, :] <= idle + releasing + EPS,
+                                axis=-1)
+    return fit_now, fit_future
+
+
+@jax.jit
+def feasibility_masks(node_idle, node_releasing, node_labels, node_taints,
+                      node_pod_room, task_req, task_selector,
+                      task_tolerations):
+    """Batch predicate evaluation: vmap of feasibility_row over tasks.
+    Returns (fit_now, fit_future): [T,N] bool masks."""
+    return jax.vmap(
+        lambda req, sel, tol: feasibility_row(
+            node_idle, node_releasing, node_labels, node_taints,
+            node_pod_room, req, sel, tol)
+    )(task_req, task_selector, task_tolerations)
+
+
+# -- standalone sub-masks (used directly by tests/tools) --------------------
+
 @jax.jit
 def selector_mask(node_labels: jnp.ndarray,
                   task_selector: jnp.ndarray) -> jnp.ndarray:
-    """[N,L] x [T,L] -> [T,N] bool: every constrained label matches.
-
-    A task entry of NO_LABEL means "don't care"; a node entry of NO_LABEL
-    means the label is absent (fails any constraint on that key).
-    """
+    """[N,L] x [T,L] -> [T,N] bool: every constrained label matches."""
     t_sel = task_selector[:, None, :]   # [T,1,L]
     n_lab = node_labels[None, :, :]     # [1,N,L]
     ok = (t_sel == NO_LABEL) | (t_sel == n_lab)
@@ -51,21 +84,3 @@ def capacity_mask(node_free: jnp.ndarray, task_req: jnp.ndarray
     """[N,R] x [T,R] -> [T,N] bool: request fits into free resources."""
     return jnp.all(task_req[:, None, :] <= node_free[None, :, :] + EPS,
                    axis=-1)
-
-
-@jax.jit
-def feasibility_masks(node_idle, node_releasing, node_labels, node_taints,
-                      node_pod_room, task_req, task_selector,
-                      task_tolerations):
-    """Full predicate evaluation.
-
-    Returns (fit_now, fit_future): [T,N] bool masks for allocation on idle
-    resources and for pipelining onto idle+releasing resources
-    (IsTaskAllocatable / IsTaskAllocatableOnReleasingOrIdle).
-    """
-    hard = (selector_mask(node_labels, task_selector)
-            & toleration_mask(node_taints, task_tolerations)
-            & (node_pod_room[None, :] >= 1.0))
-    fit_now = hard & capacity_mask(node_idle, task_req)
-    fit_future = hard & capacity_mask(node_idle + node_releasing, task_req)
-    return fit_now, fit_future

@@ -6,15 +6,17 @@ Replaces the reference's goroutine-per-node NodeOrderFn fan-out
 pkg/scheduler/plugins/scores/scores.go so plugin precedence is preserved:
 
   binpack/spread         <= 9       (MaxHighDensity, nodeplacement/pack.go:46)
-  resourcetype           10         (resourcetype/resource_type.go)
+  resourcetype           10         (resourcetype plugin)
   availability           100        (nodeavailability/nodeavailability.go:31)
   gpu sharing            1000
   topology               10000
   k8s plugin scores      100000
   nominated node         1000000
 
-Terms sum; the allocator picks argmax over feasible nodes (ties -> lowest
-node index, matching the deterministic first-best iteration order).
+``score_row`` is the canonical single-task implementation; the gang
+allocation kernel steps it per task against mutating node state, and the
+batch [T, N] form is its vmap — one definition, no drift between the gang
+path and the fractional host path.
 """
 
 from __future__ import annotations
@@ -38,43 +40,56 @@ BINPACK = 0
 SPREAD = 1
 
 
+def score_row(allocatable, idle, req, fit_any, fit_now,
+              gpu_strategy: int, cpu_strategy: int):
+    """One task's [N] score: binpack/spread (per the job's dominant resource
+    type) + resourcetype match + availability boost.
+
+    Bin-pack (pack.go:46-66): over the task's *fitting* nodes that have the
+    resource, scale free amount to [0, MaxHighDensity], higher score for
+    fuller nodes; all-equal -> everyone gets the max.  Spread
+    (spread.go:16-37): free/capacity.
+    """
+    is_gpu_job = req[RES_GPU] > 0.0
+
+    def axis_score(res, strategy):
+        free = idle[:, res]
+        cap = allocatable[:, res]
+        has_res = cap > 0.0
+        if strategy == SPREAD:
+            return jnp.where(has_res, free / jnp.where(has_res, cap, 1.0),
+                             0.0)
+        valid = fit_any & has_res
+        min_free = jnp.min(jnp.where(valid, free, jnp.inf))
+        max_free = jnp.max(jnp.where(valid, free, -jnp.inf))
+        span = max_free - min_free
+        flat = span <= 0.0
+        score = MAX_HIGH_DENSITY * (
+            1.0 - (free - min_free) / jnp.where(flat, 1.0, span))
+        score = jnp.where(flat, MAX_HIGH_DENSITY, score)
+        return jnp.where(has_res, score, 0.0)
+
+    placement = jnp.where(is_gpu_job,
+                          axis_score(RES_GPU, gpu_strategy),
+                          axis_score(RES_CPU, cpu_strategy))
+    node_has_gpu = allocatable[:, RES_GPU] > 0.0
+    rtype = jnp.where(jnp.where(is_gpu_job, node_has_gpu, ~node_has_gpu),
+                      RESOURCE_TYPE, 0.0)
+    avail = jnp.where(fit_now, AVAILABILITY, 0.0)
+    return placement + rtype + avail
+
+
 @functools.partial(jax.jit, static_argnames=("gpu_strategy", "cpu_strategy"))
 def placement_scores(node_allocatable, node_idle, task_req, fit_mask,
                      gpu_strategy: int = BINPACK,
                      cpu_strategy: int = BINPACK):
-    """Bin-pack / spread score per task x node (nodeplacement plugin).
-
-    Bin-pack (pack.go:46-66): over the task's *fitting* nodes that have the
-    job's dominant resource, scale free amount to [0, MaxHighDensity], higher
-    score for fuller nodes.  Spread (spread.go:16-37): free/capacity.  The
-    strategy applies per job resource type: GPU jobs score on the GPU axis,
-    CPU-only jobs on the CPU axis.
-    """
-    is_gpu_job = task_req[:, RES_GPU] > 0.0  # [T]
-
-    def axis_scores(res: int, strategy: int):
-        free = node_idle[:, res]            # [N]
-        cap = node_allocatable[:, res]      # [N]
-        has_res = cap > 0.0
-        valid = fit_mask & has_res[None, :]          # [T,N]
-        if strategy == SPREAD:
-            return jnp.where(has_res, free / jnp.where(has_res, cap, 1.0),
-                             0.0)[None, :] * jnp.ones(
-                                 (task_req.shape[0], 1))
-        big = jnp.inf
-        min_free = jnp.min(jnp.where(valid, free[None, :], big), axis=1)
-        max_free = jnp.max(jnp.where(valid, free[None, :], -big), axis=1)
-        span = max_free - min_free
-        flat = span <= 0.0  # all fitting nodes equal -> everyone max score
-        score = MAX_HIGH_DENSITY * (
-            1.0 - (free[None, :] - min_free[:, None])
-            / jnp.where(flat, 1.0, span)[:, None])
-        score = jnp.where(flat[:, None], MAX_HIGH_DENSITY, score)
-        return jnp.where(has_res[None, :], score, 0.0)
-
-    gpu_scores = axis_scores(RES_GPU, gpu_strategy)
-    cpu_scores = axis_scores(RES_CPU, cpu_strategy)
-    return jnp.where(is_gpu_job[:, None], gpu_scores, cpu_scores)
+    """[T,N] binpack/spread-only term (no rtype/availability): vmap of the
+    placement part of score_row."""
+    full = jax.vmap(lambda req, fit: score_row(
+        node_allocatable, node_idle, req, fit, jnp.zeros_like(fit),
+        gpu_strategy, cpu_strategy))(task_req, fit_mask)
+    rtype = resource_type_scores(node_allocatable, task_req)
+    return full - rtype
 
 
 @jax.jit
@@ -107,14 +122,12 @@ def nominated_scores(task_nominated_node, num_nodes):
 def score_matrix(node_allocatable, node_idle, task_req, fit_now, fit_future,
                  topology_scores=None, task_nominated_node=None,
                  gpu_strategy: int = BINPACK, cpu_strategy: int = BINPACK):
-    """Composed [T,N] score: the device-side analog of summing every
-    registered NodeOrderFn (framework/session_plugins.go dispatchers)."""
-    score = placement_scores(node_allocatable, node_idle, task_req,
-                             fit_now | fit_future,
-                             gpu_strategy=gpu_strategy,
-                             cpu_strategy=cpu_strategy)
-    score = score + resource_type_scores(node_allocatable, task_req)
-    score = score + availability_scores(fit_now)
+    """Composed [T,N] score: vmap of score_row plus optional extra terms —
+    the device-side analog of summing every registered NodeOrderFn
+    (framework/session_plugins.go dispatchers)."""
+    score = jax.vmap(lambda req, fa, fn: score_row(
+        node_allocatable, node_idle, req, fa, fn,
+        gpu_strategy, cpu_strategy))(task_req, fit_now | fit_future, fit_now)
     if topology_scores is not None:
         score = score + topology_scores
     if task_nominated_node is not None:

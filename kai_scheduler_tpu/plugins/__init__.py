@@ -1,0 +1,16 @@
+"""Policy plugins (SURVEY.md §2.3): each registers callbacks into the
+Session; score-term plugins configure the device kernel instead of running
+per-node callbacks."""
+
+from .base import Plugin, build_plugins, register_plugin, registered_plugins
+
+# Import for registration side effects.
+from . import minruntime  # noqa: F401
+from . import ordering  # noqa: F401
+from . import placement  # noqa: F401
+from . import proportion  # noqa: F401
+from . import snapshot_plugin  # noqa: F401
+from . import topology  # noqa: F401
+
+__all__ = ["Plugin", "build_plugins", "register_plugin",
+           "registered_plugins"]

@@ -1,0 +1,48 @@
+"""Plugin protocol + registry.
+
+Mirrors pkg/scheduler/framework/plugins.go:31-63 (RegisterPluginBuilder) and
+the plugin interface (framework/interface.go:40-55): a plugin registers
+callbacks into the Session at open; tensor-term plugins additionally
+contribute score arrays to the device kernel.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+_REGISTRY: dict[str, Callable] = {}
+
+
+class Plugin:
+    name = "plugin"
+
+    def __init__(self, args: dict | None = None):
+        self.args = args or {}
+
+    def on_session_open(self, ssn) -> None:  # pragma: no cover - interface
+        pass
+
+    def on_session_close(self, ssn) -> None:
+        pass
+
+
+def register_plugin(name: str):
+    def deco(cls):
+        cls.name = name
+        _REGISTRY[name] = cls
+        return cls
+    return deco
+
+
+def build_plugins(config) -> list[Plugin]:
+    plugins = []
+    for pc in config.plugins:
+        builder = _REGISTRY.get(pc.name)
+        if builder is None:
+            continue
+        plugins.append(builder(pc.args))
+    return plugins
+
+
+def registered_plugins() -> list[str]:
+    return sorted(_REGISTRY)

@@ -1,0 +1,95 @@
+"""Ordering plugins: priority, elastic, task order, subgroup order,
+kubeflow/ray master-first.
+
+Mirrors pkg/scheduler/plugins/{priority,elastic,taskorder,subgrouporder,
+kubeflow,ray}: pure comparator registrations — all ordering policy stays
+host-side; only placement mechanics run on device.
+"""
+
+from __future__ import annotations
+
+import re
+
+from .base import Plugin, register_plugin
+
+
+@register_plugin("priority")
+class PriorityPlugin(Plugin):
+    """Jobs with higher PriorityClass value first (priority/priority.go)."""
+
+    def on_session_open(self, ssn) -> None:
+        ssn.job_order_fns.append(self.job_order)
+
+    @staticmethod
+    def job_order(l, r) -> int:
+        if l.priority != r.priority:
+            return -1 if l.priority > r.priority else 1
+        return 0
+
+
+@register_plugin("elastic")
+class ElasticPlugin(Plugin):
+    """Jobs below minAvailable schedule before jobs at/above it
+    (elastic/elastic.go:21-25) — grow starved gangs first."""
+
+    def on_session_open(self, ssn) -> None:
+        ssn.job_order_fns.append(self.job_order)
+
+    @staticmethod
+    def job_order(l, r) -> int:
+        l_below = l.num_active_used() < sum(
+            ps.min_available for ps in l.pod_sets.values())
+        r_below = r.num_active_used() < sum(
+            ps.min_available for ps in r.pod_sets.values())
+        if l_below and not r_below:
+            return -1
+        if r_below and not l_below:
+            return 1
+        return 0
+
+
+_TRAILING_INT = re.compile(r"(\d+)$")
+
+
+def pod_index_key(task) -> tuple:
+    """Order tasks by trailing ordinal (worker-0, worker-1, ...) for
+    deterministic gang placement (taskorder plugin)."""
+    m = _TRAILING_INT.search(task.name)
+    return (0, int(m.group(1))) if m else (1, 0)
+
+
+@register_plugin("taskorder")
+class TaskOrderPlugin(Plugin):
+    def on_session_open(self, ssn) -> None:
+        ssn.task_order_fns.append(pod_index_key)
+
+
+@register_plugin("subgrouporder")
+class SubGroupOrderPlugin(Plugin):
+    """Deterministic podset ordering within a gang (subgrouporder plugin)."""
+
+    def on_session_open(self, ssn) -> None:
+        ssn.pod_set_order_fns.append(lambda ps: ps.name)
+
+
+MASTER_HINTS = ("master", "launcher", "head", "ps", "chief", "driver")
+
+
+def master_first_key(task) -> int:
+    """Framework-aware ordering: coordinator pods before workers
+    (kubeflow/kubeflow.go, ray/ray.go)."""
+    name = f"{task.subgroup} {task.name}".lower()
+    return 0 if any(h in name for h in MASTER_HINTS) else 1
+
+
+@register_plugin("kubeflow")
+class KubeflowPlugin(Plugin):
+    def on_session_open(self, ssn) -> None:
+        ssn.task_order_fns.insert(0, master_first_key)
+
+
+@register_plugin("ray")
+class RayPlugin(Plugin):
+    def on_session_open(self, ssn) -> None:
+        if master_first_key not in ssn.task_order_fns:
+            ssn.task_order_fns.insert(0, master_first_key)

@@ -1,0 +1,86 @@
+"""Placement-policy plugins whose scoring lives in the device kernel.
+
+nodeplacement (binpack/spread strategy selection), nodeavailability,
+resourcetype, gpupack/gpuspread/gpusharingorder, nominatednode, predicates.
+The score formulas themselves are in ops/scoring.py — these plugins
+configure which terms apply, mirroring how the reference's plugins register
+NodeOrderFns that the session sums (session_plugins.go).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..ops.scoring import BINPACK, NOMINATED_NODE, SPREAD
+from ..api.pod_status import PodStatus
+from .base import Plugin, register_plugin
+
+
+@register_plugin("nodeplacement")
+class NodePlacementPlugin(Plugin):
+    """Strategy per resource type from args (nodeplacement.go:39-44)."""
+
+    def on_session_open(self, ssn) -> None:
+        gpu = self.args.get("gpu", ssn.config.gpu_placement_strategy)
+        cpu = self.args.get("cpu", ssn.config.cpu_placement_strategy)
+        ssn.gpu_strategy = SPREAD if gpu == "spread" else BINPACK
+        ssn.cpu_strategy = SPREAD if cpu == "spread" else BINPACK
+
+
+@register_plugin("nodeavailability")
+class NodeAvailabilityPlugin(Plugin):
+    """Availability term is always-on in the kernel; this plugin exists for
+    config parity (nodeavailability.go)."""
+
+
+@register_plugin("resourcetype")
+class ResourceTypePlugin(Plugin):
+    """Resource-type matching term is always-on in the kernel."""
+
+
+@register_plugin("predicates")
+class PredicatesPlugin(Plugin):
+    """Predicate masks are built into the kernel; the plugin contributes the
+    host-side pre-predicate (per-job constraint screening) hook
+    (predicates/predicates.go:74-89)."""
+
+
+@register_plugin("gpupack")
+class GpuPackPlugin(Plugin):
+    """Prefer packing fractions onto the fullest shared GPU
+    (gpupack plugin); this is NodeInfo.find_gpu_groups_for_task's default."""
+
+    def on_session_open(self, ssn) -> None:
+        ssn.gpu_group_pack = True
+
+
+@register_plugin("gpuspread")
+class GpuSpreadPlugin(Plugin):
+    def on_session_open(self, ssn) -> None:
+        ssn.gpu_group_pack = False
+
+
+@register_plugin("gpusharingorder")
+class GpuSharingOrderPlugin(Plugin):
+    """Prefer already-shared devices over minting new sharing groups —
+    encoded in find_gpu_groups_for_task (existing groups first)."""
+
+
+@register_plugin("nominatednode")
+class NominatedNodePlugin(Plugin):
+    """Sticky boost: a pipelined task re-scored in a later cycle strongly
+    prefers the node it was nominated to (nominatednode plugin)."""
+
+    def on_session_open(self, ssn) -> None:
+        self.ssn = ssn
+        ssn.extra_score_fns.append(self.extra_scores)
+
+    def extra_scores(self, tasks):
+        n = ssn_nodes = self.ssn.node_idle.shape[0]
+        out = np.zeros((len(tasks), n))
+        for i, t in enumerate(tasks):
+            if t.status == PodStatus.PIPELINED and t.node_name:
+                idx = self.ssn.node_index(t.node_name)
+                if idx >= 0:
+                    out[i, idx] = NOMINATED_NODE
+        return out

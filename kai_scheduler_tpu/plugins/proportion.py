@@ -1,0 +1,411 @@
+"""Proportion plugin: hierarchical DRF fairness, quota gates, reclaim rules.
+
+The policy heart of the scheduler, mirroring
+pkg/scheduler/plugins/proportion/ (proportion.go:99-124 registrations):
+
+- builds per-queue attributes (deserved/limit/over-quota-weight, allocated,
+  allocated-non-preemptible, request, historical usage) with parent-chain
+  roll-ups (proportion.go:378-401);
+- computes hierarchical fair share on-device via ops.fairshare;
+- registers the DRF queue-order comparator (queue_order/queue_order.go:19),
+  queue capacity gates (capacity_policy/), reclaim legality
+  (reclaimable/reclaimable.go + strategies.go), and allocate/deallocate
+  event handlers that keep queue shares current as statements mutate state.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..api import resources as rs
+from ..api.podgroup_info import PodGroupInfo
+from ..framework.session import SchedulableResult
+from ..ops import fairshare as fsops
+from .base import Plugin, register_plugin
+
+UNLIMITED = rs.UNLIMITED
+NO_FAIR_SHARE_DRF_MULTIPLIER = 1000.0
+
+
+@dataclass
+class QueueAttributes:
+    uid: str
+    name: str
+    parent: str | None
+    children: list
+    priority: int
+    creation_ts: float
+    deserved: np.ndarray
+    limit: np.ndarray
+    over_quota_weight: np.ndarray
+    allocated: np.ndarray = field(default_factory=rs.zeros)
+    allocated_non_preemptible: np.ndarray = field(default_factory=rs.zeros)
+    request: np.ndarray = field(default_factory=rs.zeros)
+    usage: np.ndarray = field(default_factory=rs.zeros)
+    fair_share: np.ndarray = field(default_factory=rs.zeros)
+
+    def allocatable_share(self) -> np.ndarray:
+        """GetAllocatableShare (resource_share.go:52-62)."""
+        base = np.maximum(self.deserved, self.fair_share)
+        capped = np.where(self.limit == UNLIMITED, base,
+                          np.minimum(self.limit, base))
+        return np.where(self.deserved == UNLIMITED, self.limit, capped)
+
+    def dominant_share(self, total: np.ndarray,
+                       extra_allocated: np.ndarray | None = None) -> float:
+        """GetDominantResourceShare (queue_resource_share.go:142-162)."""
+        allocated = self.allocated.copy()
+        if extra_allocated is not None:
+            allocated = allocated + extra_allocated
+        alloc_share = self.allocatable_share()
+        alloc_share = np.where(alloc_share == UNLIMITED, total, alloc_share)
+        vals = np.where(alloc_share > 0,
+                        allocated / np.where(alloc_share > 0, alloc_share, 1),
+                        allocated * NO_FAIR_SHARE_DRF_MULTIPLIER)
+        return float(vals.max())
+
+
+def _less(a: np.ndarray, b: np.ndarray) -> bool:
+    """ResourceQuantities.Less: strictly less in at least one dim, not
+    greater anywhere (treating UNLIMITED in b as +inf)."""
+    b_eff = np.where(b == UNLIMITED, np.inf, b)
+    a_eff = np.where(a == UNLIMITED, np.inf, a)
+    return bool(np.all(a_eff <= b_eff + 1e-9) and np.any(a_eff < b_eff - 1e-9))
+
+
+def _less_equal(a: np.ndarray, b: np.ndarray) -> bool:
+    b_eff = np.where(b == UNLIMITED, np.inf, b)
+    a_eff = np.where(a == UNLIMITED, np.inf, a)
+    return bool(np.all(a_eff <= b_eff + 1e-9))
+
+
+@register_plugin("proportion")
+class ProportionPlugin(Plugin):
+    def __init__(self, args=None):
+        super().__init__(args)
+        self.queues: dict[str, QueueAttributes] = {}
+        self.total = rs.zeros()
+        self.saturation_multiplier = 1.0
+
+    # -- session wiring ----------------------------------------------------
+    def on_session_open(self, ssn) -> None:
+        self.ssn = ssn
+        self.total = ssn.cluster.total_allocatable()
+        self.saturation_multiplier = ssn.config.saturation_multiplier
+        self._build_queue_attributes(ssn)
+        self._set_fair_share(ssn)
+        ssn.queue_order_fns.append(self.queue_order_fn)
+        ssn.over_capacity_fns.append(self.is_job_over_queue_capacity)
+        ssn.non_preemptible_over_quota_fns.append(
+            self.is_non_preemptible_over_quota)
+        ssn.can_reclaim_fns.append(self.can_reclaim_resources)
+        ssn.reclaim_scenario_validators.append(self.reclaim_scenario_valid)
+        ssn.allocate_handlers.append(self.on_allocate)
+        ssn.deallocate_handlers.append(self.on_deallocate)
+        ssn.proportion = self  # expose queue attrs to actions/metrics
+
+    def _build_queue_attributes(self, ssn) -> None:
+        cluster = ssn.cluster
+        self.queues = {}
+        for qid, q in cluster.queues.items():
+            self.queues[qid] = QueueAttributes(
+                uid=qid, name=q.name, parent=q.parent,
+                children=list(q.children), priority=q.priority,
+                creation_ts=q.creation_ts,
+                deserved=np.asarray(q.quota.deserved, float),
+                limit=np.asarray(q.quota.limit, float),
+                over_quota_weight=np.asarray(q.quota.over_quota_weight,
+                                             float),
+                usage=np.asarray(ssn.queue_usage.get(qid, rs.zeros()),
+                                 float))
+        # Roll allocated/non-preemptible/request up the parent chain
+        # (proportion.go:347-401).
+        for pg in cluster.podgroups.values():
+            if pg.queue_id not in self.queues:
+                continue
+            for t in pg.pods.values():
+                req = t.req_vec()
+                if t.is_active_allocated():
+                    self._walk(pg.queue_id, "allocated", req)
+                    self._walk(pg.queue_id, "request", req)
+                    if not pg.is_preemptible():
+                        self._walk(pg.queue_id, "allocated_non_preemptible",
+                                   req)
+                elif t.status.name in ("PENDING", "GATED"):
+                    self._walk(pg.queue_id, "request", req)
+
+    def _walk(self, qid: str, attr: str, req: np.ndarray) -> None:
+        q = self.queues.get(qid)
+        while q is not None:
+            setattr(q, attr, getattr(q, attr) + req)
+            q = self.queues.get(q.parent) if q.parent else None
+
+    def _set_fair_share(self, ssn) -> None:
+        """Run the hierarchical division kernel (proportion.go:403-440)."""
+        qids = sorted(self.queues)
+        index = {qid: i for i, qid in enumerate(qids)}
+        n = len(qids)
+        if n == 0:
+            return
+        parent = np.array([index.get(self.queues[q].parent, -1)
+                           if self.queues[q].parent else -1
+                           for q in qids], np.int64)
+        priority = np.array([self.queues[q].priority for q in qids])
+        creation = np.array([self.queues[q].creation_ts for q in qids])
+        hier = fsops.QueueHierarchy.build(parent, priority, creation, qids)
+        stack = lambda attr: np.stack(
+            [getattr(self.queues[q], attr) for q in qids])
+        fair = fsops.fair_share_levels(
+            self.total, ssn.config.k_value, hier,
+            stack("deserved"), stack("limit"), stack("over_quota_weight"),
+            stack("request"), stack("usage"))
+        for qid, i in index.items():
+            self.queues[qid].fair_share = fair[i]
+
+    # -- event handlers (proportion.go:446-476) ----------------------------
+    def on_allocate(self, task) -> None:
+        pg = self.ssn.cluster.podgroups.get(task.job_id)
+        if pg is None or pg.queue_id not in self.queues:
+            return
+        req = task.req_vec()
+        self._walk(pg.queue_id, "allocated", req)
+        if not pg.is_preemptible():
+            self._walk(pg.queue_id, "allocated_non_preemptible", req)
+
+    def on_deallocate(self, task, prev_status) -> None:
+        pg = self.ssn.cluster.podgroups.get(task.job_id)
+        if pg is None or pg.queue_id not in self.queues:
+            return
+        req = -task.req_vec()
+        self._walk(pg.queue_id, "allocated", req)
+        if not pg.is_preemptible():
+            self._walk(pg.queue_id, "allocated_non_preemptible", req)
+
+    # -- queue ordering (queue_order/queue_order.go:19-242) ----------------
+    def queue_order_fn(self, l: str, r: str, l_job, r_job,
+                       l_victims, r_victims) -> int:
+        lq, rq = self.queues[l], self.queues[r]
+
+        l_over = _less(lq.fair_share, lq.allocated)
+        r_over = _less(rq.fair_share, rq.allocated)
+        if not l_over and r_over:
+            return -1
+        if l_over and not r_over:
+            return 1
+
+        l_with_job = lq.allocated + _job_req(l_job)
+        r_with_job = rq.allocated + _job_req(r_job)
+        l_starved = _less_equal(l_with_job, lq.deserved)
+        r_starved = _less_equal(r_with_job, rq.deserved)
+        if l_starved and not r_starved:
+            return -1
+        if r_starved and not l_starved:
+            return 1
+
+        if lq.priority != rq.priority:
+            return -1 if lq.priority > rq.priority else 1
+
+        l_viol = _zero_share_violation(lq, l_with_job)
+        r_viol = _zero_share_violation(rq, r_with_job)
+        if l_viol and not r_viol:
+            return 1
+        if r_viol and not l_viol:
+            return -1
+
+        l_share = lq.dominant_share(
+            self.total, _job_req(l_job) - _victims_req(l_victims))
+        r_share = rq.dominant_share(
+            self.total, _job_req(r_job) - _victims_req(r_victims))
+        if l_share != r_share:
+            return -1 if l_share < r_share else 1
+
+        l_share0 = lq.dominant_share(self.total)
+        r_share0 = rq.dominant_share(self.total)
+        if l_share0 != r_share0:
+            return -1 if l_share0 < r_share0 else 1
+
+        la, ra = lq.allocatable_share(), rq.allocatable_share()
+        if _less(la, ra):
+            return -1
+        if _less(ra, la):
+            return 1
+
+        return -1 if lq.creation_ts <= rq.creation_ts else 1
+
+    # -- capacity gates (capacity_policy/) ---------------------------------
+    def is_job_over_queue_capacity(self, job: PodGroupInfo,
+                                   tasks) -> SchedulableResult:
+        res = self._over_limit(job, tasks)
+        if not res.schedulable:
+            return res
+        return self.is_non_preemptible_over_quota(job, tasks)
+
+    def _over_limit(self, job, tasks) -> SchedulableResult:
+        req = _tasks_req(tasks)
+        q = self.queues.get(job.queue_id)
+        while q is not None:
+            over = (q.limit != UNLIMITED) & (req > 1e-9) \
+                & (q.limit < q.allocated + req - 1e-9)
+            if np.any(over):
+                i = int(np.argmax(over))
+                return SchedulableResult(
+                    False, "OverLimit",
+                    f"queue {q.name} over limit on "
+                    f"{rs.RESOURCE_NAMES[i]}: limit {q.limit[i]:g}, "
+                    f"allocated {q.allocated[i]:g}, requested {req[i]:g}")
+            q = self.queues.get(q.parent) if q.parent else None
+        return SchedulableResult()
+
+    def is_non_preemptible_over_quota(self, job, tasks) -> SchedulableResult:
+        if job.is_preemptible():
+            return SchedulableResult()
+        req = _tasks_req(tasks)
+        q = self.queues.get(job.queue_id)
+        while q is not None:
+            deserved = np.where(q.deserved == UNLIMITED, np.inf, q.deserved)
+            if np.any(q.allocated_non_preemptible + req > deserved + 1e-9):
+                return SchedulableResult(
+                    False, "NonPreemptibleOverQuota",
+                    f"non-preemptible job over quota in queue {q.name}")
+            q = self.queues.get(q.parent) if q.parent else None
+        return SchedulableResult()
+
+    # -- reclaim legality (reclaimable/) -----------------------------------
+    def can_reclaim_resources(self, job: PodGroupInfo) -> bool:
+        """CanReclaimResources (reclaimable.go:30-55)."""
+        q = self.queues.get(job.queue_id)
+        if q is None:
+            return False
+        req = job.tasks_to_allocate_init_resource()
+        if not _less_equal(q.allocated + req, q.fair_share):
+            return False
+        if job.is_preemptible():
+            return True
+        return _less_equal(q.allocated_non_preemptible + req, q.deserved)
+
+    def reclaim_scenario_valid(self, scenario) -> bool:
+        """Reclaimable (reclaimable.go:57-165): simulate post-reclaim
+        allocations and check the strategy + sibling saturation rules."""
+        reclaimer = scenario.pending_job
+        victims_by_queue: dict[str, list[np.ndarray]] = {}
+        for vjob, vtasks in scenario.victims:
+            victims_by_queue.setdefault(vjob.queue_id, []).extend(
+                t.req_vec() for t in vtasks)
+
+        req = _tasks_req(scenario.pending_tasks)
+        remaining: dict[str, np.ndarray] = {}
+        involved: dict[str, set] = {}
+
+        def rem(qid):
+            if qid not in remaining:
+                remaining[qid] = self.queues[qid].allocated.copy()
+            return remaining[qid]
+
+        for qid, reqs in victims_by_queue.items():
+            if qid not in self.queues:
+                return False
+            reclaimee = self.queues[qid]
+            involved.setdefault(qid, set())
+            for v in reqs:
+                involved[qid] |= {i for i in range(rs.NUM_RES) if v[i] > 0}
+                if not self._fits_reclaim_strategy(req, reclaimer, reclaimee,
+                                                   rem(qid)):
+                    return False
+                # subtract up the chain
+                q = reclaimee
+                while q is not None:
+                    rem(q.uid)
+                    remaining[q.uid] = remaining[q.uid] - v
+                    involved.setdefault(q.uid, set()).update(involved[qid])
+                    q = self.queues.get(q.parent) if q.parent else None
+
+        # Reclaiming queue chain must stay within boundaries (:134-190).
+        involved_reclaimer = {i for i in range(rs.NUM_RES) if req[i] > 0}
+        q = self.queues.get(reclaimer.queue_id)
+        while q is not None:
+            my_remaining = remaining.get(q.uid, q.allocated.copy()) + req
+            for sib_id in list(remaining):
+                sib = self.queues.get(sib_id)
+                if sib is None or sib.parent != q.parent or sib.uid == q.uid:
+                    continue
+                inv = involved.get(sib_id, set()) | involved_reclaimer
+                if not self._saturation_lower(
+                        inv, my_remaining, q.fair_share,
+                        remaining.get(sib_id, sib.allocated), sib.fair_share):
+                    return False
+            if not reclaimer.is_preemptible():
+                deserved = np.where(q.deserved == UNLIMITED, np.inf,
+                                    q.deserved)
+                if np.any(q.allocated_non_preemptible + req > deserved + 1e-9):
+                    return False
+            q = self.queues.get(q.parent) if q.parent else None
+        return True
+
+    def _fits_reclaim_strategy(self, reclaimer_req, reclaimer_job, reclaimee,
+                               reclaimee_remaining) -> bool:
+        """strategies.go: MaintainFairShare OR GuaranteeDeservedQuota."""
+        # Maintain fair share: reclaimee currently over its allocatable share.
+        if not _less_equal(reclaimee_remaining, reclaimee.allocatable_share()):
+            return True
+        # Guarantee deserved quota: reclaimer stays under quota, reclaimee
+        # above quota in at least one resource.
+        rq = self.queues.get(reclaimer_job.queue_id)
+        if rq is None:
+            return False
+        if not _less_equal(rq.allocated + reclaimer_req, rq.deserved):
+            return False
+        return not _less_equal(reclaimee_remaining, reclaimee.deserved)
+
+    def _saturation_lower(self, involved, rec_alloc, rec_fair, sib_alloc,
+                          sib_fair) -> bool:
+        """isFairShareSaturationLowerPerResource (reclaimable.go:195-218)."""
+        for i in involved:
+            rf, sf = rec_fair[i], sib_fair[i]
+            if rf == UNLIMITED and sf == UNLIMITED:
+                continue
+            ratio_rec = _saturation_ratio(rec_alloc[i], rf)
+            ratio_sib = _saturation_ratio(sib_alloc[i], sf)
+            if (ratio_rec > 1 and sf > 0
+                    and ratio_rec * self.saturation_multiplier >= ratio_sib):
+                return False
+        return True
+
+
+def _saturation_ratio(allocated: float, fair: float) -> float:
+    if fair == 0:
+        return np.inf if allocated > 0 else 0.0
+    if fair == UNLIMITED:
+        return 0.0
+    return allocated / fair
+
+
+def _job_req(job) -> np.ndarray:
+    if job is None:
+        return rs.zeros()
+    return job.tasks_to_allocate_init_resource()
+
+
+def _victims_req(victims) -> np.ndarray:
+    if not victims:
+        return rs.zeros()
+    total = rs.zeros()
+    for vjob in victims:
+        for t in vjob.pods.values():
+            if t.is_active_allocated():
+                total += t.req_vec()
+    return total
+
+
+def _tasks_req(tasks) -> np.ndarray:
+    total = rs.zeros()
+    for t in tasks:
+        total += t.req_vec()
+    return total
+
+
+def _zero_share_violation(q: QueueAttributes,
+                          allocated_with_job: np.ndarray) -> bool:
+    alloc_share = q.allocatable_share()
+    return bool(np.any((alloc_share == 0) & (allocated_with_job > 0)))

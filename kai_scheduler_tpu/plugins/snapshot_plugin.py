@@ -1,0 +1,72 @@
+"""snapshot plugin: dump full cluster + config state for offline replay.
+
+Mirrors pkg/scheduler/plugins/snapshot/snapshot.go:79 (/get-snapshot): the
+serialized state feeds tools/snapshot_tool.py, which replays a production
+cycle deterministically.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from .base import Plugin, register_plugin
+
+
+def _jsonable(x):
+    if isinstance(x, np.ndarray):
+        return x.tolist()
+    return x
+
+
+@register_plugin("snapshot")
+class SnapshotPlugin(Plugin):
+    def on_session_open(self, ssn) -> None:
+        ssn.snapshot_dump = lambda: dump_cluster(ssn)
+
+
+def dump_cluster(ssn) -> dict:
+    cluster = ssn.cluster
+    return {
+        "now": cluster.now,
+        "config": {
+            "actions": list(ssn.config.actions),
+            "plugins": [p.name for p in ssn.config.plugins],
+            "k_value": ssn.config.k_value,
+        },
+        "nodes": [{
+            "name": n.name,
+            "allocatable": n.allocatable.tolist(),
+            "labels": n.labels,
+            "taints": sorted(n.taints),
+            "gpu_memory_per_device": n.gpu_memory_per_device,
+            "max_pods": n.max_pods,
+        } for n in cluster.nodes.values()],
+        "queues": [{
+            "uid": q.uid, "name": q.name, "parent": q.parent,
+            "priority": q.priority, "creation_ts": q.creation_ts,
+            "deserved": q.quota.deserved.tolist(),
+            "limit": q.quota.limit.tolist(),
+            "over_quota_weight": q.quota.over_quota_weight.tolist(),
+        } for q in cluster.queues.values()],
+        "podgroups": [{
+            "uid": pg.uid, "name": pg.name, "namespace": pg.namespace,
+            "queue": pg.queue_id, "priority": pg.priority,
+            "preemptible": pg.preemptible,
+            "pod_sets": [{"name": ps.name,
+                          "min_available": ps.min_available}
+                         for ps in pg.pod_sets.values()],
+            "pods": [{
+                "uid": t.uid, "name": t.name, "status": t.status.name,
+                "node": t.node_name, "subgroup": t.subgroup,
+                "req": t.req_vec().tolist(),
+                "node_selector": t.node_selector,
+                "tolerations": sorted(t.tolerations),
+            } for t in pg.pods.values()],
+        } for pg in cluster.podgroups.values()],
+    }
+
+
+def dump_json(ssn) -> str:
+    return json.dumps(dump_cluster(ssn), default=_jsonable, indent=1)

@@ -1,0 +1,25 @@
+"""Topology-aware scheduling (TAS) plugin — placeholder registration.
+
+The full domain-tree kernel (per-level segment aggregation of allocatable
+capacity, domain filtering and bin-pack ordering over node-sets, mirroring
+pkg/scheduler/plugins/topology/) lands with ops/topology.py; this module
+keeps the plugin name registered so configs carry it from day one.
+"""
+
+from __future__ import annotations
+
+from .base import Plugin, register_plugin
+
+
+@register_plugin("topology")
+class TopologyPlugin(Plugin):
+    def on_session_open(self, ssn) -> None:
+        if not ssn.cluster.topologies:
+            return
+        try:
+            from ..ops.topology import TopologySession
+        except ImportError:  # kernel not built yet: degrade to no-op
+            return
+        self._topo = TopologySession(ssn)
+        ssn.subset_nodes_fns.append(self._topo.subset_nodes)
+        ssn.extra_score_fns.append(self._topo.extra_scores)

@@ -1,0 +1,56 @@
+"""Scheduler daemon: the cycle driver.
+
+Mirrors pkg/scheduler/scheduler.go:54-147 (NewScheduler/Run/runOnce): once
+per period, snapshot the world, open a session (plugins register), run the
+configured actions in order, close the session.  The durable outputs are
+BindRequests and evictions applied through the cache.
+"""
+
+from __future__ import annotations
+
+import time
+
+from .actions import build_actions
+from .api.cluster_info import ClusterInfo
+from .framework.conf import SchedulerConfig
+from .framework.session import InMemoryCache, Session
+from .utils.metrics import METRICS
+
+
+class Scheduler:
+    def __init__(self, cluster_provider, config: SchedulerConfig | None = None,
+                 cache=None, usage_provider=None):
+        """cluster_provider: callable returning the current ClusterInfo
+        snapshot (the informer-cache analog); usage_provider: callable
+        returning per-queue normalized historical usage (usagedb analog)."""
+        self.cluster_provider = cluster_provider
+        self.config = config or SchedulerConfig()
+        self.cache = cache or InMemoryCache()
+        self.usage_provider = usage_provider
+        self.session_id = 0
+
+    def run_once(self) -> Session:
+        """One scheduling cycle (scheduler.go:113-138)."""
+        self.session_id += 1
+        t0 = time.perf_counter()
+        cluster = self.cluster_provider()
+        usage = self.usage_provider() if self.usage_provider else None
+        ssn = Session(cluster, self.config, self.cache, queue_usage=usage)
+        ssn.open()
+        try:
+            for action in build_actions(self.config.actions):
+                ta = time.perf_counter()
+                action.execute(ssn)
+                METRICS.observe(f"action_scheduling_latency_{action.name}",
+                                (time.perf_counter() - ta) * 1000.0)
+        finally:
+            ssn.close()
+        METRICS.observe("e2e_scheduling_latency_milliseconds",
+                        (time.perf_counter() - t0) * 1000.0)
+        return ssn
+
+    def run(self, cycles: int, period_seconds: float = 0.0) -> None:
+        for _ in range(cycles):
+            self.run_once()
+            if period_seconds:
+                time.sleep(period_seconds)

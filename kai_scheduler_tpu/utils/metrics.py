@@ -1,0 +1,89 @@
+"""In-process metrics registry with Prometheus-compatible naming.
+
+Mirrors pkg/scheduler/metrics/metrics.go's metric families
+(e2e_scheduling_latency_milliseconds, action/plugin latency histograms,
+queue fair-share/usage gauges, scenario counters).  Exported as a
+Prometheus text endpoint by the scheduler server; in-process consumers read
+the structured values directly.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import defaultdict
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Histogram:
+    buckets: list = field(default_factory=lambda: [
+        1, 2, 5, 10, 20, 50, 100, 200, 500, 1000, 2000, 5000, math.inf])
+    counts: dict = field(default_factory=lambda: defaultdict(int))
+    total: float = 0.0
+    n: int = 0
+
+    def observe(self, value: float) -> None:
+        for b in self.buckets:
+            if value <= b:
+                self.counts[b] += 1
+                break
+        self.total += value
+        self.n += 1
+
+    def quantile(self, q: float) -> float:
+        if self.n == 0:
+            return 0.0
+        target = q * self.n
+        acc = 0
+        for b in self.buckets:
+            acc += self.counts.get(b, 0)
+            if acc >= target:
+                return b
+        return self.buckets[-1]
+
+    @property
+    def mean(self) -> float:
+        return self.total / self.n if self.n else 0.0
+
+
+class Metrics:
+    def __init__(self):
+        self.histograms: dict[str, Histogram] = defaultdict(Histogram)
+        self.gauges: dict[str, float] = {}
+        self.counters: dict[str, float] = defaultdict(float)
+
+    def observe(self, name: str, value: float) -> None:
+        self.histograms[name].observe(value)
+
+    def set_gauge(self, name: str, value: float, **labels) -> None:
+        self.gauges[_key(name, labels)] = value
+
+    def inc(self, name: str, value: float = 1.0, **labels) -> None:
+        self.counters[_key(name, labels)] += value
+
+    def reset(self) -> None:
+        self.histograms.clear()
+        self.gauges.clear()
+        self.counters.clear()
+
+    def to_prometheus_text(self) -> str:
+        lines = []
+        for name, h in self.histograms.items():
+            lines.append(f"# TYPE {name} histogram")
+            lines.append(f"{name}_sum {h.total}")
+            lines.append(f"{name}_count {h.n}")
+        for key, v in self.gauges.items():
+            lines.append(f"{key} {v}")
+        for key, v in self.counters.items():
+            lines.append(f"{key} {v}")
+        return "\n".join(lines) + "\n"
+
+
+def _key(name: str, labels: dict) -> str:
+    if not labels:
+        return name
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return f"{name}{{{inner}}}"
+
+
+METRICS = Metrics()

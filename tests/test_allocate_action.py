@@ -1,0 +1,272 @@
+"""Integration tests for the allocate action over a real Session — the
+analog of pkg/scheduler/actions/integration_tests/allocate."""
+
+import numpy as np
+import pytest
+
+from kai_scheduler_tpu.api import PodStatus, resources as rs
+from tests.fixtures import (assert_placements, build_session, placements,
+                            run_action)
+
+
+class TestBasicAllocation:
+    def test_single_job_single_node(self):
+        ssn = build_session({
+            "nodes": {"n1": {"gpu": 8}},
+            "queues": {"default": {}},
+            "jobs": {"j1": {"tasks": [{"gpu": 2}]}},
+        })
+        run_action(ssn)
+        assert_placements(ssn, {"j1-0": ("n1", "ALLOCATED")})
+        assert ssn.cache.bound == [("j1-0", "n1")]
+        assert len(ssn.cluster.bind_requests) == 1
+
+    def test_binpack_two_jobs_one_node(self):
+        ssn = build_session({
+            "nodes": {"n1": {"gpu": 8}, "n2": {"gpu": 8}},
+            "jobs": {"j1": {"tasks": [{"gpu": 3}]},
+                     "j2": {"tasks": [{"gpu": 3}]}},
+            "queues": {"default": {}},
+        })
+        run_action(ssn)
+        p = placements(ssn)
+        assert p["j1-0"][0] == p["j2-0"][0]  # packed together
+
+    def test_unschedulable_records_fit_error(self):
+        ssn = build_session({
+            "nodes": {"n1": {"gpu": 2}},
+            "queues": {"default": {}},
+            "jobs": {"j1": {"tasks": [{"gpu": 4}]}},
+        })
+        run_action(ssn)
+        assert placements(ssn) == {}
+        job = ssn.cluster.podgroups["j1"]
+        assert job.fit_errors
+        assert any(k == "Unschedulable" for k, _ in ssn.cache.events)
+
+    def test_selector_and_taints(self):
+        ssn = build_session({
+            "nodes": {
+                "cpu1": {"gpu": 0, "labels": {"pool": "cpu"}},
+                "gpu1": {"gpu": 8, "labels": {"pool": "gpu"},
+                         "taints": ["dedicated"]},
+            },
+            "queues": {"default": {}},
+            "jobs": {
+                "cpujob": {"tasks": [{"cpu": "2", "gpu": 0}]},
+                "gpujob": {"tasks": [{"gpu": 1,
+                                      "selector": {"pool": "gpu"},
+                                      "tolerations": ["dedicated"]}]},
+                "blocked": {"tasks": [{"gpu": 1,
+                                       "selector": {"pool": "gpu"}}]},
+            },
+        })
+        run_action(ssn)
+        p = placements(ssn)
+        assert p["cpujob-0"][0] == "cpu1"  # resourcetype steers to CPU node
+        assert p["gpujob-0"][0] == "gpu1"
+        assert "blocked-0" not in p  # lacks toleration
+
+
+class TestGangSemantics:
+    def test_gang_all_or_nothing(self):
+        ssn = build_session({
+            "nodes": {"n1": {"gpu": 8}},
+            "queues": {"default": {}},
+            "jobs": {"gang": {"min_available": 3,
+                              "tasks": [{"gpu": 4}, {"gpu": 4}, {"gpu": 4}]}},
+        })
+        run_action(ssn)
+        assert placements(ssn) == {}
+        assert ssn.cluster.podgroups["gang"].fit_errors
+        # Node untouched after rollback.
+        assert ssn.cluster.nodes["n1"].used[rs.RES_GPU] == 0
+        assert np.all(ssn.node_idle[0] == ssn.snapshot.node_idle[0])
+
+    def test_gang_spanning_nodes(self):
+        ssn = build_session({
+            "nodes": {"n1": {"gpu": 8}, "n2": {"gpu": 8}},
+            "queues": {"default": {}},
+            "jobs": {"gang": {"min_available": 2,
+                              "tasks": [{"gpu": 6}, {"gpu": 6}]}},
+        })
+        run_action(ssn)
+        p = placements(ssn)
+        assert len(p) == 2
+        assert {p["gang-0"][0], p["gang-1"][0]} == {"n1", "n2"}
+
+    def test_elastic_grows_after_min(self):
+        ssn = build_session({
+            "nodes": {"n1": {"gpu": 8}},
+            "queues": {"default": {}},
+            "jobs": {"el": {"min_available": 2,
+                            "tasks": [{"gpu": 2}, {"gpu": 2}, {"gpu": 2},
+                                      {"gpu": 2}, {"gpu": 2}]}},
+        })
+        run_action(ssn)
+        # min chunk (2) + elastic chunks fill the node: 4 of 5 place.
+        assert len(placements(ssn)) == 4
+        assert ssn.cluster.nodes["n1"].idle[rs.RES_GPU] == 0
+
+
+class TestQuotaGates:
+    def test_over_limit_blocked(self):
+        ssn = build_session({
+            "nodes": {"n1": {"gpu": 8}},
+            "queues": {"q1": {"limit": dict(cpu="64", memory="1Ti", gpu=2)}},
+            "jobs": {"j1": {"queue": "q1", "tasks": [{"gpu": 4}]}},
+        })
+        run_action(ssn)
+        assert placements(ssn) == {}
+        assert "over limit" in ssn.cluster.podgroups["j1"].fit_errors[0].lower()
+
+    def test_non_preemptible_over_quota_blocked(self):
+        ssn = build_session({
+            "nodes": {"n1": {"gpu": 8}},
+            "queues": {"q1": {"deserved": dict(cpu="8", memory="64Gi",
+                                               gpu=2)}},
+            "jobs": {
+                "np1": {"queue": "q1", "preemptible": False,
+                        "tasks": [{"gpu": 2}]},
+                "np2": {"queue": "q1", "preemptible": False,
+                        "tasks": [{"gpu": 2}]},
+            },
+        })
+        run_action(ssn)
+        p = placements(ssn)
+        # Only one non-preemptible job fits under the 2-GPU quota.
+        assert len(p) == 1
+
+    def test_preemptible_can_exceed_quota(self):
+        ssn = build_session({
+            "nodes": {"n1": {"gpu": 8}},
+            "queues": {"q1": {"deserved": dict(cpu="8", memory="64Gi",
+                                               gpu=2)}},
+            "jobs": {"j1": {"queue": "q1", "tasks": [{"gpu": 2}]},
+                     "j2": {"queue": "q1", "tasks": [{"gpu": 2}]}},
+        })
+        run_action(ssn)
+        assert len(placements(ssn)) == 2  # over-quota but preemptible
+
+
+class TestDRFOrdering:
+    def test_starved_queue_first(self):
+        # q_poor has nothing allocated; q_rich has 4 GPUs running.
+        # Remaining 4 GPUs: q_poor's job must win them.
+        ssn = build_session({
+            "nodes": {"n1": {"gpu": 8}},
+            "queues": {"q_rich": {"deserved": dict(cpu="16", memory="128Gi",
+                                                   gpu=4)},
+                       "q_poor": {"deserved": dict(cpu="16", memory="128Gi",
+                                                   gpu=4)}},
+            "jobs": {
+                "running": {"queue": "q_rich",
+                            "tasks": [{"gpu": 4, "status": "RUNNING",
+                                       "node": "n1"}]},
+                "rich_pending": {"queue": "q_rich",
+                                 "tasks": [{"gpu": 4}]},
+                "poor_pending": {"queue": "q_poor",
+                                 "tasks": [{"gpu": 4}]},
+            },
+        })
+        run_action(ssn)
+        p = placements(ssn)
+        assert "poor_pending-0" in p
+        assert "rich_pending-0" not in p
+
+
+class TestFractionalGpu:
+    def test_two_halves_share_one_device(self):
+        ssn = build_session({
+            "nodes": {"n1": {"gpu": 2}},
+            "queues": {"default": {}},
+            "jobs": {"f1": {"tasks": [{"gpu_fraction": 0.5}]},
+                     "f2": {"tasks": [{"gpu_fraction": 0.5}]}},
+        })
+        run_action(ssn)
+        p = placements(ssn)
+        assert len(p) == 2
+        t1 = ssn.cluster.podgroups["f1"].pods["f1-0"]
+        t2 = ssn.cluster.podgroups["f2"].pods["f2-0"]
+        assert t1.gpu_group and t1.gpu_group == t2.gpu_group  # same device
+        node = ssn.cluster.nodes["n1"]
+        assert node.used[rs.RES_GPU] == 1.0  # one whole device charged
+
+    def test_fraction_and_whole_gpu_coexist(self):
+        ssn = build_session({
+            "nodes": {"n1": {"gpu": 2}},
+            "queues": {"default": {}},
+            "jobs": {"f1": {"tasks": [{"gpu_fraction": 0.7}]},
+                     "w1": {"tasks": [{"gpu": 1}]}},
+        })
+        run_action(ssn)
+        assert len(placements(ssn)) == 2
+        assert ssn.cluster.nodes["n1"].used[rs.RES_GPU] == 2.0
+
+
+class TestPipelining:
+    def test_pipeline_onto_releasing(self):
+        ssn = build_session({
+            "nodes": {"n1": {"gpu": 8}},
+            "queues": {"default": {}},
+            "jobs": {
+                "leaving": {"tasks": [{"gpu": 8, "status": "RELEASING",
+                                       "node": "n1"}]},
+                "waiting": {"tasks": [{"gpu": 8}]},
+            },
+        })
+        run_action(ssn)
+        assert_placements(ssn, {"waiting-0": ("n1", "PIPELINED")})
+        # Pipelined tasks don't produce bind requests yet.
+        assert ssn.cache.bound == []
+
+    def test_gang_converts_to_pipelined(self):
+        # One member fits idle, the other only fits releasing: both must
+        # end up pipelined (gang waits together).
+        ssn = build_session({
+            "nodes": {"n1": {"gpu": 4}, "n2": {"gpu": 4}},
+            "queues": {"default": {}},
+            "jobs": {
+                "leaving": {"tasks": [{"gpu": 4, "status": "RELEASING",
+                                       "node": "n2"}]},
+                "gang": {"min_available": 2,
+                         "tasks": [{"gpu": 4}, {"gpu": 4}]},
+            },
+        })
+        run_action(ssn)
+        p = placements(ssn)
+        statuses = {p[f"gang-{i}"][1] for i in range(2)}
+        assert statuses == {"PIPELINED"}
+
+
+class TestRobustness:
+    def test_unknown_queue_job_skipped(self):
+        """A job referencing a missing queue must not crash the cycle
+        (review finding)."""
+        ssn = build_session({
+            "nodes": {"n1": {"gpu": 8}},
+            "queues": {"default": {}, "other": {}},
+            "jobs": {"ok": {"queue": "default", "tasks": [{"gpu": 1}]},
+                     "lost": {"queue": "nonexistent",
+                              "tasks": [{"gpu": 1}]}},
+        })
+        run_action(ssn)
+        p = placements(ssn)
+        assert "ok-0" in p and "lost-0" not in p
+
+    def test_node_padding_bucket(self):
+        """node_pad_bucket pads kernel shapes without placing anything on
+        phantom nodes (review finding)."""
+        from kai_scheduler_tpu.framework import SchedulerConfig
+        cfg = SchedulerConfig(node_pad_bucket=16)
+        ssn = build_session({
+            "nodes": {"n1": {"gpu": 8}, "n2": {"gpu": 8}},
+            "queues": {"default": {}},
+            "jobs": {"j1": {"tasks": [{"gpu": 2}]},
+                     "frac": {"tasks": [{"gpu_fraction": 0.5}]}},
+        }, config=cfg)
+        assert ssn.snapshot.node_allocatable.shape[0] == 16
+        run_action(ssn)
+        p = placements(ssn)
+        assert {p[u][0] for u in p} <= {"n1", "n2"}
+        assert len(p) == 2
