@@ -2,6 +2,10 @@
 pkg/scheduler/actions/, registry actions/factory.go:31-37)."""
 
 from .allocate import AllocateAction
+from .consolidation import ConsolidationAction
+from .preempt import PreemptAction
+from .reclaim import ReclaimAction
+from .stalegangeviction import StaleGangEvictionAction
 
 _REGISTRY = {}
 
@@ -12,6 +16,10 @@ def register_action(cls):
 
 
 register_action(AllocateAction)
+register_action(ConsolidationAction)
+register_action(PreemptAction)
+register_action(ReclaimAction)
+register_action(StaleGangEvictionAction)
 
 
 def build_actions(names) -> list:

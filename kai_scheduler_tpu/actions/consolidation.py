@@ -1,0 +1,57 @@
+"""Consolidation action: defragment by relocating running preemptible pods.
+
+Mirrors pkg/scheduler/actions/consolidation/consolidation.go:32-128: for a
+pending job that won't fit as-is, try moving running preemptible pods onto
+other nodes to create contiguous room.  A solution is valid ONLY if every
+displaced pod is re-placed (allPodsReallocated :121-128) — consolidation
+never shrinks the running set.
+"""
+
+from __future__ import annotations
+
+from ..api.podgroup_info import PodGroupInfo
+from .solvers import solve_job
+from .utils import INFINITE, JobsOrderByQueues
+
+
+class ConsolidationAction:
+    name = "consolidation"
+
+    def execute(self, ssn) -> None:
+        pending = [pg for pg in ssn.cluster.podgroups.values()
+                   if pg.has_tasks_to_allocate()
+                   and pg.is_ready_for_scheduling()
+                   and pg.queue_id in ssn.cluster.queues]
+        if not pending:
+            return
+        order = JobsOrderByQueues(
+            ssn, pending,
+            ssn.config.queue_depth_per_action.get(self.name, INFINITE))
+
+        while not order.empty():
+            job = order.pop_next_job()
+            if job is None:
+                break
+            victims = collect_consolidation_victims(ssn, job)
+            if not victims:
+                order.requeue_queue(job.queue_id)
+                continue
+            solve_job(ssn, job, victims,
+                      lambda scenario: True, self.name,
+                      require_all_victims_replaced=True)
+            order.requeue_queue(job.queue_id)
+
+
+def collect_consolidation_victims(ssn, job: PodGroupInfo
+                                  ) -> list[PodGroupInfo]:
+    """Running preemptible jobs from any queue — candidates to shuffle, not
+    to kill (they must all land again)."""
+    victims = [
+        pg for pg in ssn.cluster.podgroups.values()
+        if pg.uid != job.uid
+        and pg.queue_id in ssn.cluster.queues
+        and pg.is_preemptible()
+        and pg.num_active_allocated() > 0
+    ]
+    victims.sort(key=lambda pg: (pg.priority, -pg.creation_ts))
+    return victims

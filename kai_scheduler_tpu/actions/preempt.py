@@ -1,0 +1,65 @@
+"""Preempt action: in-queue priority preemption.
+
+Mirrors pkg/scheduler/actions/preempt/preempt.go:46-161: a pending job may
+preempt strictly-lower-priority preemptible jobs in its OWN queue (:126-155
+victim filter); the scenario solver simulates eviction + re-placement and
+preempt validators (minruntime) approve.
+"""
+
+from __future__ import annotations
+
+from ..api.podgroup_info import PodGroupInfo
+from .solvers import solve_job
+from .utils import INFINITE, JobsOrderByQueues
+
+
+class PreemptAction:
+    name = "preempt"
+
+    def execute(self, ssn) -> None:
+        pending = [pg for pg in ssn.cluster.podgroups.values()
+                   if pg.has_tasks_to_allocate()
+                   and pg.is_ready_for_scheduling()
+                   and pg.queue_id in ssn.cluster.queues]
+        if not pending:
+            return
+        order = JobsOrderByQueues(
+            ssn, pending,
+            ssn.config.queue_depth_per_action.get(self.name, INFINITE))
+        failed_signatures: set[str] = set()
+
+        while not order.empty():
+            job = order.pop_next_job()
+            if job is None:
+                break
+            sig = job.scheduling_signature()
+            if ssn.config.use_scheduling_signatures \
+                    and sig in failed_signatures:
+                order.requeue_queue(job.queue_id)
+                continue
+            victims = collect_preempt_victims(ssn, job)
+            victims = ssn.filter_preempt_victims(job, victims)
+            if not victims:
+                order.requeue_queue(job.queue_id)
+                continue
+            result = solve_job(ssn, job, victims,
+                               ssn.validate_preempt_scenario, self.name)
+            if not result.success and ssn.config.use_scheduling_signatures:
+                failed_signatures.add(sig)
+            order.requeue_queue(job.queue_id)
+
+
+def collect_preempt_victims(ssn, preemptor: PodGroupInfo
+                            ) -> list[PodGroupInfo]:
+    """Same queue, strictly lower priority, preemptible, running
+    (preempt.go:126-155); lowest priority and newest evicted first."""
+    victims = [
+        pg for pg in ssn.cluster.podgroups.values()
+        if pg.queue_id == preemptor.queue_id
+        and pg.uid != preemptor.uid
+        and pg.is_preemptible()
+        and pg.priority < preemptor.priority
+        and pg.num_active_allocated() > 0
+    ]
+    victims.sort(key=lambda pg: (pg.priority, -pg.creation_ts))
+    return victims

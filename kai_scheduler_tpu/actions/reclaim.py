@@ -1,0 +1,90 @@
+"""Reclaim action: cross-queue fair-share enforcement.
+
+Mirrors pkg/scheduler/actions/reclaim/reclaim.go:47-143: for each pending
+job whose queue is under its fair share (CanReclaimResources gate), build
+the victim set from OTHER queues' preemptible running jobs, order victims
+weakest-claim-first, and run the scenario solver; validation is the
+proportion plugin's reclaimable rules + minruntime.  Scheduling-signature
+dedup skips lookalike jobs that already failed (:74-82).
+"""
+
+from __future__ import annotations
+
+from ..api.podgroup_info import PodGroupInfo
+from .solvers import solve_job
+from .utils import INFINITE, JobsOrderByQueues
+
+
+class ReclaimAction:
+    name = "reclaim"
+
+    def execute(self, ssn) -> None:
+        pending = [pg for pg in ssn.cluster.podgroups.values()
+                   if pg.has_tasks_to_allocate()
+                   and pg.is_ready_for_scheduling()
+                   and pg.queue_id in ssn.cluster.queues]
+        if not pending:
+            return
+        order = JobsOrderByQueues(
+            ssn, pending,
+            ssn.config.queue_depth_per_action.get(self.name, INFINITE))
+        failed_signatures: set[str] = set()
+
+        while not order.empty():
+            job = order.pop_next_job()
+            if job is None:
+                break
+            sig = job.scheduling_signature()
+            if ssn.config.use_scheduling_signatures \
+                    and sig in failed_signatures:
+                order.requeue_queue(job.queue_id)
+                continue
+            if not ssn.can_reclaim_resources(job):
+                order.requeue_queue(job.queue_id)
+                continue
+            victims = collect_reclaim_victims(ssn, job)
+            victims = ssn.filter_reclaim_victims(job, victims)
+            if not victims:
+                order.requeue_queue(job.queue_id)
+                continue
+            result = solve_job(ssn, job, victims,
+                               ssn.validate_reclaim_scenario, self.name)
+            if not result.success and ssn.config.use_scheduling_signatures:
+                failed_signatures.add(sig)
+            order.requeue_queue(job.queue_id)
+
+
+def collect_reclaim_victims(ssn, reclaimer: PodGroupInfo
+                            ) -> list[PodGroupInfo]:
+    """Other queues' running preemptible jobs (reclaim.go:123-143), ordered
+    so the weakest claims are tried first: queues with the highest dominant
+    share first, then reverse job order (newest / lowest priority first)."""
+    victims = []
+    for pg in ssn.cluster.podgroups.values():
+        if pg.queue_id == reclaimer.queue_id:
+            continue
+        if pg.queue_id not in ssn.cluster.queues:
+            continue
+        if not pg.is_preemptible():
+            continue
+        if pg.num_active_allocated() == 0:
+            continue
+        victims.append(pg)
+    prop = getattr(ssn, "proportion", None)
+
+    def key(pg):
+        share = 0.0
+        if prop is not None and pg.queue_id in prop.queues:
+            share = prop.queues[pg.queue_id].dominant_share(prop.total)
+        # Most-over-share queue first; within it, weakest claim (lowest
+        # priority, newest) first.
+        return (-share, ssn_job_rank(ssn, pg))
+
+    victims.sort(key=key)
+    return victims
+
+
+def ssn_job_rank(ssn, pg) -> float:
+    """Higher rank = stronger claim = evicted later.  Approximates the
+    reverse of the job order: priority, then age."""
+    return pg.priority * 1e12 - pg.creation_ts

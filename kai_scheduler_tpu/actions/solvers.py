@@ -1,0 +1,126 @@
+"""Scenario solvers: victim accumulation + simulated eviction/re-allocation.
+
+Mirrors pkg/scheduler/actions/common/solvers/ (JobSolver.Solve
+job_solver.go:47-90, PodAccumulatedScenarioBuilder pod_scenario_builder.go:
+33-147, byPodSolver by_pod_solver.go:63-239): to place a pending job at the
+expense of running work, victims are accumulated one job at a time from an
+ordered queue; each scenario is simulated on the live session under a
+statement — evict the victims, pipeline the pending job onto the released
+resources, try to re-place victims elsewhere — then validated by the
+plugins' scenario validators (DRF post-state, min-runtime, consolidation's
+all-replaced rule).  Success commits; failure rolls back and the builder
+grows the scenario.
+
+The simulation batches each re-allocation attempt through the device kernel
+(the "does this scenario fit" inner loop of SURVEY.md §7.6).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..api.pod_status import PodStatus
+from ..api.podgroup_info import PodGroupInfo
+from ..utils.metrics import METRICS
+from .allocate import attempt_to_allocate_job
+
+
+@dataclass
+class Scenario:
+    pending_job: PodGroupInfo
+    pending_tasks: list
+    victims: list = field(default_factory=list)  # [(job, [tasks])]
+
+    def victim_task_count(self) -> int:
+        return sum(len(ts) for _, ts in self.victims)
+
+
+class ScenarioBuilder:
+    """Accumulate victim jobs one at a time (pod_scenario_builder.go:79)."""
+
+    def __init__(self, pending_job: PodGroupInfo, pending_tasks: list,
+                 ordered_victims: list[PodGroupInfo]):
+        self.scenario = Scenario(pending_job, pending_tasks)
+        self._remaining = list(ordered_victims)
+
+    def has_next(self) -> bool:
+        return bool(self._remaining)
+
+    def next_scenario(self) -> Scenario:
+        victim = self._remaining.pop(0)
+        tasks = [t for t in victim.pods.values() if t.is_active_allocated()]
+        self.scenario.victims.append((victim, tasks))
+        return self.scenario
+
+
+@dataclass
+class SolverResult:
+    success: bool
+    evicted_jobs: list = field(default_factory=list)
+    scenarios_tried: int = 0
+
+
+def solve_job(ssn, pending_job: PodGroupInfo,
+              ordered_victims: list[PodGroupInfo],
+              validate, action_name: str,
+              require_all_victims_replaced: bool = False,
+              try_replace_victims: bool = True) -> SolverResult:
+    """Find the smallest victim prefix whose eviction lets pending_job
+    schedule, validated by ``validate(scenario)``.  Commits on success."""
+    tasks = pending_job.tasks_to_allocate(
+        subgroup_order_fn=ssn.pod_set_order_key,
+        task_order_fn=ssn.task_order_key, real_allocation=False)
+    if not tasks:
+        return SolverResult(False)
+    # Let plugins snapshot pre-simulation state for their validators.
+    ssn.on_job_solution_start()
+
+    builder = ScenarioBuilder(pending_job, tasks, ordered_victims)
+    tried = 0
+    while builder.has_next():
+        scenario = builder.next_scenario()
+        tried += 1
+        METRICS.inc("scenarios_simulation_by_action", action=action_name)
+        stmt = ssn.statement()
+        ok = _simulate(ssn, stmt, scenario, validate,
+                       require_all_victims_replaced, try_replace_victims)
+        if ok:
+            stmt.commit()
+            return SolverResult(True,
+                                [vj.uid for vj, _ in scenario.victims],
+                                tried)
+        stmt.discard()
+    return SolverResult(False, scenarios_tried=tried)
+
+
+def _simulate(ssn, stmt, scenario: Scenario, validate,
+              require_all_victims_replaced: bool,
+              try_replace_victims: bool) -> bool:
+    # 1. Evict every victim task (by_pod_solver.go:163).
+    for _, tasks in scenario.victims:
+        for task in tasks:
+            stmt.evict(task)
+
+    # 2. Pipeline the pending job onto the released resources (re-enters
+    # the allocate kernel in pipeline-only mode).
+    placed = attempt_to_allocate_job(ssn, scenario.pending_job,
+                                     pipeline_only=True, stmt=stmt,
+                                     commit=False)
+    if not placed:
+        return False
+
+    # 3. Re-place victims elsewhere if possible (pipelined); track failures.
+    all_replaced = True
+    if try_replace_victims:
+        for vjob, vtasks in scenario.victims:
+            replaced = attempt_to_allocate_job(ssn, vjob, pipeline_only=True,
+                                               stmt=stmt, commit=False)
+            if not replaced:
+                all_replaced = False
+    else:
+        all_replaced = False
+    if require_all_victims_replaced and not all_replaced:
+        return False
+
+    # 4. Plugin validation of the post-state (proportion DRF, minruntime).
+    return validate(scenario)

@@ -1,0 +1,34 @@
+"""Stale gang eviction: broken gangs don't hold resources forever.
+
+Mirrors pkg/scheduler/actions/stalegangeviction/stalegangeviction.go:29-90:
+a gang running below its minAvailable (stale, job_info.go:417) past the
+grace period has ALL its remaining pods evicted so the resources return to
+the pool and the gang can be rescheduled whole later.
+"""
+
+from __future__ import annotations
+
+
+class StaleGangEvictionAction:
+    name = "stalegangeviction"
+
+    def execute(self, ssn) -> None:
+        now = ssn.cluster.now
+        for job in list(ssn.cluster.podgroups.values()):
+            if not job.is_stale():
+                continue
+            grace = job.staleness_grace_seconds
+            if grace is None:
+                grace = ssn.config.default_staleness_grace_seconds
+            stale_since = job.last_start_ts
+            if stale_since is not None and (now - stale_since) < grace:
+                continue
+            stmt = ssn.statement()
+            for task in list(job.pods.values()):
+                if task.is_active_used():
+                    stmt.evict(task)
+            stmt.commit()
+            ssn.cache.record_event(
+                "StaleGangEvicted",
+                f"gang {job.namespace}/{job.name} below minAvailable for "
+                f">{grace}s; evicting {len(stmt.ops)} pods")

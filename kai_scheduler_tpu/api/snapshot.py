@@ -92,6 +92,7 @@ class SnapshotTensors:
     task_uids: list = field(default_factory=list)
     job_uids: list = field(default_factory=list)
     queue_uids: list = field(default_factory=list)
+    codec: "LabelCodec | None" = None
 
     @property
     def num_nodes(self) -> int:
@@ -105,10 +106,16 @@ class SnapshotTensors:
 def build_codec(cluster: ClusterInfo,
                 tasks: list[PodInfo]) -> LabelCodec:
     codec = LabelCodec()
-    # Only label keys that some task constrains need columns.
+    # Label keys constrained by ANY pod need columns — scenario simulation
+    # re-encodes evicted (non-candidate) tasks for re-placement, so the
+    # vocabulary must cover them too, not just this cycle's candidates.
     for t in tasks:
         for k in t.node_selector:
             codec.key_col(k)
+    for pg in cluster.podgroups.values():
+        for t in pg.pods.values():
+            for k in t.node_selector:
+                codec.key_col(k)
     for node in cluster.nodes.values():
         for k, v in node.labels.items():
             if k in codec.key_cols:
@@ -157,7 +164,9 @@ def pack(cluster: ClusterInfo,
     codec = build_codec(cluster, tasks)
     L = max(1, codec.num_cols)
     max_taints = max([len(n.taints) for n in cluster.nodes.values()] + [1])
-    max_tols = max([len(t.tolerations) for t in tasks] + [1])
+    # Toleration width covers every pod (scenario re-encoding needs it).
+    max_tols = max([len(t.tolerations) for pg in cluster.podgroups.values()
+                    for t in pg.pods.values()] + [1])
 
     node_names = cluster.node_order
     n = len(node_names)
@@ -244,4 +253,5 @@ def pack(cluster: ClusterInfo,
         queue_allocated=q_alloc, queue_requested=q_req, queue_usage=q_usage,
         node_names=list(node_names), task_uids=[t.uid for t in tasks],
         job_uids=[pg.uid for pg in jobs], queue_uids=queue_uids,
+        codec=codec,
     )

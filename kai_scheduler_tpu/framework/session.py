@@ -92,6 +92,7 @@ class Session:
         self.subset_nodes_fns: list[Callable] = []
         self.extra_score_fns: list[Callable] = []
         self.pre_job_allocation_fns: list[Callable] = []
+        self.job_solution_start_fns: list[Callable] = []
         self.gpu_order_fns: list[Callable] = []
         self.plugins = []
         # --- packed snapshot + mutable dense mirrors ---
@@ -206,6 +207,13 @@ class Session:
         for fn in self.pre_job_allocation_fns:
             fn(job)
 
+    def on_job_solution_start(self) -> None:
+        """Scenario solvers call this before simulating: plugins snapshot
+        any state the validators must read pre-simulation
+        (proportion.OnJobSolutionStartFn, proportion.go:131)."""
+        for fn in self.job_solution_start_fns:
+            fn()
+
     def subset_nodes(self, job, tasks) -> list:
         """Topology plugin hook: ordered list of candidate node-index sets
         (None = all nodes).  Mirrors ssn.SubsetNodesFn."""
@@ -224,20 +232,19 @@ class Session:
         """Run the gang-allocation kernel for one job's task chunk against
         the current (statement-mutated) node state."""
         snap = self.snapshot
-        rows = [t.tensor_idx for t in tasks]
-        if any(r < 0 for r in rows):
-            return Proposal(False, [])
-        t = len(rows)
+        t = len(tasks)
         t_pad = _next_pow2(max(t, 1))
-        sel = np.asarray(rows, np.int64)
 
         task_req = np.zeros((t_pad, snap.task_req.shape[1]))
-        task_req[:t] = snap.task_req[sel]
         task_sel = np.full((t_pad, snap.task_selector.shape[1]), -1, np.int32)
-        task_sel[:t] = snap.task_selector[sel]
         task_tol = np.full((t_pad, snap.task_tolerations.shape[1]), -1,
                            np.int32)
-        task_tol[:t] = snap.task_tolerations[sel]
+        for i, task in enumerate(tasks):
+            req, sel, tol = self._task_row(task)
+            if req is None:
+                return Proposal(False, [])
+            task_req[i], task_sel[i, :len(sel)] = req, sel
+            task_tol[i, :len(tol)] = tol
         task_job = np.zeros(t_pad, np.int32)
         task_job[t:] = 1  # padding rows belong to a gated-out dummy job
         job_allowed = np.array([True, False])
@@ -276,23 +283,48 @@ class Session:
                                bool(piped[i])))
         return Proposal(True, placements)
 
+    def _task_row(self, task: PodInfo):
+        """(req [R], selector [L], tolerations [Tl]) for any task: packed
+        rows for this cycle's candidates, codec re-encoding for others
+        (evicted victims in scenario simulation)."""
+        snap = self.snapshot
+        if task.tensor_idx >= 0:
+            i = task.tensor_idx
+            return (snap.task_req[i], snap.task_selector[i],
+                    snap.task_tolerations[i])
+        codec = snap.codec
+        sel = np.full(snap.task_selector.shape[1], -1, np.int32)
+        for k, v in task.node_selector.items():
+            col = codec.key_cols.get(k) if codec else None
+            if col is None:
+                return None, None, None
+            # A value no node carries can never match: poison code -2.
+            sel[col] = codec.value_codes.get((k, v), -2)
+        tol = np.full(snap.task_tolerations.shape[1], -1, np.int32)
+        j = 0
+        for t in sorted(task.tolerations):
+            code = codec.taint_codes.get(t) if codec else None
+            if code is not None and j < tol.shape[0]:
+                tol[j] = code
+                j += 1
+        return task.req_vec(), sel, tol
+
     def score_nodes_for_task(self, task: PodInfo) -> np.ndarray:
         """[N] score row for host-side paths (fractional GPU placement)."""
         from ..ops.predicates import feasibility_masks
         from ..ops.scoring import score_matrix
         snap = self.snapshot
-        if task.tensor_idx < 0:
+        req_row, sel_row, tol_row = self._task_row(task)
+        if req_row is None:
             return np.zeros(self.node_idle.shape[0])
-        sel = np.array([task.tensor_idx])
-        req = snap.task_req[sel]
+        req = req_row[None, :]
         # Fractional tasks: capacity-check the cpu/mem axes; GPU device fit
         # is decided host-side by the sharing-group logic.
         fit_now, fit_future = feasibility_masks(
             jnp.asarray(self.node_idle), jnp.asarray(self.node_releasing),
             jnp.asarray(snap.node_labels), jnp.asarray(snap.node_taints),
             jnp.asarray(self.node_room), jnp.asarray(req),
-            jnp.asarray(snap.task_selector[sel]),
-            jnp.asarray(snap.task_tolerations[sel]))
+            jnp.asarray(sel_row[None, :]), jnp.asarray(tol_row[None, :]))
         score = score_matrix(
             jnp.asarray(snap.node_allocatable), jnp.asarray(self.node_idle),
             jnp.asarray(req), fit_now, fit_future,

@@ -46,6 +46,14 @@ class QueueAttributes:
     usage: np.ndarray = field(default_factory=rs.zeros)
     fair_share: np.ndarray = field(default_factory=rs.zeros)
 
+    def clone(self) -> "QueueAttributes":
+        return QueueAttributes(
+            self.uid, self.name, self.parent, list(self.children),
+            self.priority, self.creation_ts, self.deserved.copy(),
+            self.limit.copy(), self.over_quota_weight.copy(),
+            self.allocated.copy(), self.allocated_non_preemptible.copy(),
+            self.request.copy(), self.usage.copy(), self.fair_share.copy())
+
     def allocatable_share(self) -> np.ndarray:
         """GetAllocatableShare (resource_share.go:52-62)."""
         base = np.maximum(self.deserved, self.fair_share)
@@ -104,7 +112,14 @@ class ProportionPlugin(Plugin):
         ssn.reclaim_scenario_validators.append(self.reclaim_scenario_valid)
         ssn.allocate_handlers.append(self.on_allocate)
         ssn.deallocate_handlers.append(self.on_deallocate)
+        ssn.job_solution_start_fns.append(self.on_job_solution_start)
+        self.sim_queues: dict[str, QueueAttributes] = self.queues
         ssn.proportion = self  # expose queue attrs to actions/metrics
+
+    def on_job_solution_start(self) -> None:
+        """Clone queue state before a scenario simulation so the validator
+        reads pre-eviction attributes (proportion.go:131-136)."""
+        self.sim_queues = {qid: q.clone() for qid, q in self.queues.items()}
 
     def _build_queue_attributes(self, ssn) -> None:
         cluster = ssn.cluster
@@ -288,6 +303,7 @@ class ProportionPlugin(Plugin):
     def reclaim_scenario_valid(self, scenario) -> bool:
         """Reclaimable (reclaimable.go:57-165): simulate post-reclaim
         allocations and check the strategy + sibling saturation rules."""
+        queues = self.sim_queues  # pre-simulation clone (OnJobSolutionStart)
         reclaimer = scenario.pending_job
         victims_by_queue: dict[str, list[np.ndarray]] = {}
         for vjob, vtasks in scenario.victims:
@@ -300,13 +316,13 @@ class ProportionPlugin(Plugin):
 
         def rem(qid):
             if qid not in remaining:
-                remaining[qid] = self.queues[qid].allocated.copy()
+                remaining[qid] = queues[qid].allocated.copy()
             return remaining[qid]
 
         for qid, reqs in victims_by_queue.items():
-            if qid not in self.queues:
+            if qid not in queues:
                 return False
-            reclaimee = self.queues[qid]
+            reclaimee = queues[qid]
             involved.setdefault(qid, set())
             for v in reqs:
                 involved[qid] |= {i for i in range(rs.NUM_RES) if v[i] > 0}
@@ -319,15 +335,15 @@ class ProportionPlugin(Plugin):
                     rem(q.uid)
                     remaining[q.uid] = remaining[q.uid] - v
                     involved.setdefault(q.uid, set()).update(involved[qid])
-                    q = self.queues.get(q.parent) if q.parent else None
+                    q = queues.get(q.parent) if q.parent else None
 
         # Reclaiming queue chain must stay within boundaries (:134-190).
         involved_reclaimer = {i for i in range(rs.NUM_RES) if req[i] > 0}
-        q = self.queues.get(reclaimer.queue_id)
+        q = queues.get(reclaimer.queue_id)
         while q is not None:
             my_remaining = remaining.get(q.uid, q.allocated.copy()) + req
             for sib_id in list(remaining):
-                sib = self.queues.get(sib_id)
+                sib = queues.get(sib_id)
                 if sib is None or sib.parent != q.parent or sib.uid == q.uid:
                     continue
                 inv = involved.get(sib_id, set()) | involved_reclaimer
@@ -340,7 +356,7 @@ class ProportionPlugin(Plugin):
                                     q.deserved)
                 if np.any(q.allocated_non_preemptible + req > deserved + 1e-9):
                     return False
-            q = self.queues.get(q.parent) if q.parent else None
+            q = queues.get(q.parent) if q.parent else None
         return True
 
     def _fits_reclaim_strategy(self, reclaimer_req, reclaimer_job, reclaimee,
@@ -351,7 +367,7 @@ class ProportionPlugin(Plugin):
             return True
         # Guarantee deserved quota: reclaimer stays under quota, reclaimee
         # above quota in at least one resource.
-        rq = self.queues.get(reclaimer_job.queue_id)
+        rq = self.sim_queues.get(reclaimer_job.queue_id)
         if rq is None:
             return False
         if not _less_equal(rq.allocated + reclaimer_req, rq.deserved):

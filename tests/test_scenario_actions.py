@@ -1,0 +1,224 @@
+"""Integration tests for reclaim / preempt / consolidation /
+stalegangeviction — analog of the reference's
+pkg/scheduler/actions/integration_tests/{reclaim,preempt,consolidation,
+stalegangeviction}."""
+
+import numpy as np
+import pytest
+
+from kai_scheduler_tpu.api import PodStatus, resources as rs
+from tests.fixtures import build_session, placements, run_action
+
+
+def statuses(ssn, job):
+    return {t.uid: t.status.name
+            for t in ssn.cluster.podgroups[job].pods.values()}
+
+
+class TestReclaim:
+    def _spec(self, **overrides):
+        spec = {
+            "nodes": {"n1": {"gpu": 8}},
+            "queues": {
+                "q_a": {"deserved": dict(cpu="16", memory="128Gi", gpu=4)},
+                "q_b": {"deserved": dict(cpu="16", memory="128Gi", gpu=4)},
+            },
+            "jobs": {
+                # q_a hogs the whole node.
+                "hog1": {"queue": "q_a",
+                         "tasks": [{"gpu": 4, "status": "RUNNING",
+                                    "node": "n1"}]},
+                "hog2": {"queue": "q_a", "creation_ts": 10.0,
+                         "tasks": [{"gpu": 4, "status": "RUNNING",
+                                    "node": "n1"}]},
+                # q_b starved, under fair share.
+                "starved": {"queue": "q_b", "tasks": [{"gpu": 4}]},
+            },
+        }
+        spec.update(overrides)
+        return spec
+
+    def test_reclaims_over_share_queue(self):
+        ssn = build_session(self._spec())
+        run_action(ssn, "reclaim")
+        # One hog evicted; starved job pipelined onto the freed node.
+        assert len(ssn.cache.evicted) == 1
+        st = statuses(ssn, "starved")
+        assert st["starved-0"] == "PIPELINED"
+        # The newer hog is the weaker claim.
+        assert ssn.cluster.podgroups["hog2"].pods["hog2-0"].status \
+            == PodStatus.RELEASING
+
+    def test_no_reclaim_when_within_fair_share(self):
+        # q_b already holds its fair share -> CanReclaimResources fails.
+        ssn = build_session({
+            "nodes": {"n1": {"gpu": 8}},
+            "queues": {
+                "q_a": {"deserved": dict(cpu="16", memory="128Gi", gpu=4)},
+                "q_b": {"deserved": dict(cpu="16", memory="128Gi", gpu=4)},
+            },
+            "jobs": {
+                "a_run": {"queue": "q_a",
+                          "tasks": [{"gpu": 4, "status": "RUNNING",
+                                     "node": "n1"}]},
+                "b_run": {"queue": "q_b",
+                          "tasks": [{"gpu": 4, "status": "RUNNING",
+                                     "node": "n1"}]},
+                "b_more": {"queue": "q_b", "tasks": [{"gpu": 4}]},
+            },
+        })
+        run_action(ssn, "reclaim")
+        assert ssn.cache.evicted == []
+
+    def test_non_preemptible_victims_protected(self):
+        spec = self._spec()
+        spec["jobs"]["hog1"]["preemptible"] = False
+        spec["jobs"]["hog2"]["preemptible"] = False
+        ssn = build_session(spec)
+        run_action(ssn, "reclaim")
+        assert ssn.cache.evicted == []
+
+    def test_minruntime_protects_young_victims(self):
+        spec = self._spec()
+        spec["now"] = 1000.0
+        spec["queues"]["q_a"]["reclaim_min_runtime"] = 600.0
+        for j in ("hog1", "hog2"):
+            spec["jobs"][j]["last_start_ts"] = 900.0  # 100s old < 600s
+        ssn = build_session(spec)
+        run_action(ssn, "reclaim")
+        assert ssn.cache.evicted == []
+
+
+class TestPreempt:
+    def _spec(self):
+        return {
+            "nodes": {"n1": {"gpu": 8}},
+            "queues": {"q": {"deserved": dict(cpu="32", memory="256Gi",
+                                              gpu=8)}},
+            "jobs": {
+                "low": {"queue": "q", "priority": 1,
+                        "tasks": [{"gpu": 8, "status": "RUNNING",
+                                   "node": "n1"}]},
+                "high": {"queue": "q", "priority": 10,
+                         "tasks": [{"gpu": 8}]},
+            },
+        }
+
+    def test_higher_priority_preempts(self):
+        ssn = build_session(self._spec())
+        run_action(ssn, "preempt")
+        assert len(ssn.cache.evicted) == 1
+        assert statuses(ssn, "high")["high-0"] == "PIPELINED"
+
+    def test_equal_priority_does_not_preempt(self):
+        spec = self._spec()
+        spec["jobs"]["high"]["priority"] = 1
+        ssn = build_session(spec)
+        run_action(ssn, "preempt")
+        assert ssn.cache.evicted == []
+
+    def test_cross_queue_never_preempts(self):
+        spec = self._spec()
+        spec["queues"]["q2"] = {}
+        spec["jobs"]["high"]["queue"] = "q2"
+        ssn = build_session(spec)
+        run_action(ssn, "preempt")
+        assert ssn.cache.evicted == []
+
+
+class TestConsolidation:
+    def test_relocates_to_make_room(self):
+        # Two 4-GPU pods spread across two 8-GPU nodes; an 8-GPU gang needs
+        # one node emptied.  Moving one pod to the other node frees it.
+        ssn = build_session({
+            "nodes": {"n1": {"gpu": 8}, "n2": {"gpu": 8}},
+            "queues": {"q": {}},
+            "jobs": {
+                "frag1": {"queue": "q",
+                          "tasks": [{"gpu": 4, "status": "RUNNING",
+                                     "node": "n1"}]},
+                "frag2": {"queue": "q",
+                          "tasks": [{"gpu": 4, "status": "RUNNING",
+                                     "node": "n2"}]},
+                "big": {"queue": "q", "tasks": [{"gpu": 8}]},
+            },
+        })
+        run_action(ssn, "consolidation")
+        # One frag pod moved (evicted + pipelined elsewhere); big pipelined.
+        assert len(ssn.cache.evicted) == 1
+        st = statuses(ssn, "big")
+        assert st["big-0"] == "PIPELINED"
+        # The displaced pod is re-placed, not lost.
+        moved = [pg for pg in ("frag1", "frag2")
+                 if any(t.status == PodStatus.PIPELINED
+                        for t in ssn.cluster.podgroups[pg].pods.values())]
+        assert len(moved) == 1
+
+    def test_no_solution_without_full_replacement(self):
+        # No room anywhere to re-place a displaced pod -> no consolidation.
+        ssn = build_session({
+            "nodes": {"n1": {"gpu": 8}, "n2": {"gpu": 8}},
+            "queues": {"q": {}},
+            "jobs": {
+                "f1": {"queue": "q", "tasks": [{"gpu": 8, "status": "RUNNING",
+                                                "node": "n1"}]},
+                "f2": {"queue": "q", "tasks": [{"gpu": 8, "status": "RUNNING",
+                                                "node": "n2"}]},
+                "big": {"queue": "q", "tasks": [{"gpu": 8}]},
+            },
+        })
+        run_action(ssn, "consolidation")
+        assert ssn.cache.evicted == []
+
+
+class TestStaleGangEviction:
+    def test_evicts_stale_gang_after_grace(self):
+        ssn = build_session({
+            "now": 1000.0,
+            "nodes": {"n1": {"gpu": 8}},
+            "queues": {"q": {}},
+            "jobs": {"gang": {
+                "queue": "q", "min_available": 3,
+                "last_start_ts": 100.0,  # stale for 900s > 60s grace
+                "tasks": [
+                    {"gpu": 2, "status": "RUNNING", "node": "n1"},
+                    {"gpu": 2, "status": "FAILED"},
+                    {"gpu": 2, "status": "FAILED"},
+                ]}},
+        })
+        run_action(ssn, "stalegangeviction")
+        assert len(ssn.cache.evicted) == 1  # the surviving pod
+        assert any(k == "StaleGangEvicted" for k, _ in ssn.cache.events)
+
+    def test_grace_period_respected(self):
+        ssn = build_session({
+            "now": 1000.0,
+            "nodes": {"n1": {"gpu": 8}},
+            "queues": {"q": {}},
+            "jobs": {"gang": {
+                "queue": "q", "min_available": 3,
+                "last_start_ts": 990.0,  # only 10s stale
+                "tasks": [
+                    {"gpu": 2, "status": "RUNNING", "node": "n1"},
+                    {"gpu": 2, "status": "FAILED"},
+                    {"gpu": 2, "status": "FAILED"},
+                ]}},
+        })
+        run_action(ssn, "stalegangeviction")
+        assert ssn.cache.evicted == []
+
+    def test_healthy_gang_untouched(self):
+        ssn = build_session({
+            "now": 1000.0,
+            "nodes": {"n1": {"gpu": 8}},
+            "queues": {"q": {}},
+            "jobs": {"gang": {
+                "queue": "q", "min_available": 2,
+                "last_start_ts": 100.0,
+                "tasks": [
+                    {"gpu": 2, "status": "RUNNING", "node": "n1"},
+                    {"gpu": 2, "status": "RUNNING", "node": "n1"},
+                ]}},
+        })
+        run_action(ssn, "stalegangeviction")
+        assert ssn.cache.evicted == []
