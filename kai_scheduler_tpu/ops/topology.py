@@ -1,0 +1,265 @@
+"""Topology-aware scheduling (TAS): domain trees as segment ops.
+
+Re-designs pkg/scheduler/plugins/topology/ for the device: the reference
+walks a pointer tree of domains per job (job_filtering.go:34-111,
+calcSubTreeFreeResources :192, calcNodeAccommodation :213,
+getJobAllocatableDomains :265, sortTree :460, getJobRatioToFreeResources
+:491); here every topology level is a segment-id vector over the node axis,
+so per-domain free-resource aggregation and gang-accommodation counting are
+``segment_sum``s over the packed node state — one fused kernel per level
+instead of a tree walk per job.
+
+Semantics preserved:
+- a domain fits a gang iff the gang's total request fits the domain's
+  idle+releasing pool AND enough whole pods fit stackwise on its nodes;
+- candidate levels run from the preferred level up to the required level
+  (calculateRelevantDomainLevels :381-424); required-only means exactly
+  that level; preferred-only climbs to the root;
+- fitting domains are ordered most-packed-first (ratio of requested to
+  free, descending — bin-pack, docs/topology/README.md:50-53), ties by
+  domain id;
+- a job with running pods and a required constraint is pinned to the
+  domains already hosting its pods (getRelevantDomainsWithAllocatedPods);
+- nodes inside preferred-level domains get a Topology-tier score boost
+  (node_scoring.go:17-55) scaled by domain rank.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .scoring import TOPOLOGY
+
+ROOT_LEVEL = "__root__"
+# Ratio assigned when a required resource doesn't exist in the domain.
+IMPOSSIBLE_RATIO = 1e9
+
+
+@dataclass
+class TopologyTree:
+    """Host-side encoding of one Topology CRD over the packed node axis."""
+    name: str
+    levels: list                      # deepest-last label keys, as in CRD
+    # Per level: [N] int32 domain index (-1 = node lacks the label chain).
+    node_domain: dict = field(default_factory=dict)   # level -> np.ndarray
+    domain_names: dict = field(default_factory=dict)  # level -> [id->path]
+
+    def num_domains(self, level: str) -> int:
+        return len(self.domain_names.get(level, []))
+
+
+def build_tree(name: str, levels: list, node_names: list,
+               node_labels_by_name: dict) -> TopologyTree:
+    """Group nodes into domains per level.  A domain's identity is the
+    label-value path from the top level down (topology_structs.go:20-94)."""
+    tree = TopologyTree(name, list(levels))
+    n = len(node_names)
+    # Root level: every node in domain 0.
+    tree.node_domain[ROOT_LEVEL] = np.zeros(n, np.int32)
+    tree.domain_names[ROOT_LEVEL] = ["root"]
+    path_so_far = [() for _ in range(n)]
+    for depth, label_key in enumerate(levels):
+        ids: dict[tuple, int] = {}
+        seg = np.full(n, -1, np.int32)
+        names = []
+        for i, node in enumerate(node_names):
+            value = node_labels_by_name.get(node, {}).get(label_key)
+            if value is None or path_so_far[i] is None:
+                path_so_far[i] = None
+                continue
+            path_so_far[i] = path_so_far[i] + (value,)
+            key = path_so_far[i]
+            if key not in ids:
+                ids[key] = len(names)
+                names.append("/".join(key))
+            seg[i] = ids[key]
+        tree.node_domain[label_key] = seg
+        tree.domain_names[label_key] = names
+    return tree
+
+
+@functools.partial(jax.jit, static_argnames=("num_domains",))
+def domain_aggregates(node_free, node_room, seg, max_pod_req, gang_size,
+                      num_domains: int):
+    """Per-domain (free [D,R], pod-accommodation count [D]).
+
+    Accommodation mirrors calcNodeAccommodation: per node, how many
+    max-sized gang pods stack into idle+releasing resources, summed over
+    the domain (capped at gang_size per node).
+    """
+    member = seg >= 0
+    seg_safe = jnp.where(member, seg, 0)
+    free = jax.ops.segment_sum(
+        jnp.where(member[:, None], node_free, 0.0), seg_safe,
+        num_segments=num_domains)
+    per_res = jnp.where(max_pod_req[None, :] > 0,
+                        jnp.floor(node_free / jnp.where(
+                            max_pod_req[None, :] > 0, max_pod_req[None, :],
+                            1.0)),
+                        jnp.inf)
+    fit = jnp.min(per_res, axis=1)
+    fit = jnp.minimum(fit, node_room)
+    fit = jnp.clip(fit, 0.0, gang_size)
+    pods = jax.ops.segment_sum(jnp.where(member, fit, 0.0), seg_safe,
+                               num_segments=num_domains)
+    return free, pods
+
+
+class TopologySession:
+    """Per-session TAS state: registered by the topology plugin."""
+
+    def __init__(self, ssn):
+        self.ssn = ssn
+        self.trees: dict[str, TopologyTree] = {}
+        node_labels = {name: ssn.cluster.nodes[name].labels
+                       for name in ssn.snapshot.node_names
+                       if name in ssn.cluster.nodes}
+        for name, spec in ssn.cluster.topologies.items():
+            levels = list(spec.get("levels", []))
+            self.trees[name] = build_tree(
+                name, levels, ssn.snapshot.node_names, node_labels)
+        # job uid -> [N] preferred-level score boosts (set by subset_nodes).
+        self._job_node_scores: dict[str, np.ndarray] = {}
+
+    # -- constraint resolution ---------------------------------------------
+    def _job_constraint(self, job):
+        topo_name = job.topology_name or next(iter(self.trees), None)
+        tree = self.trees.get(topo_name)
+        if tree is None:
+            return None
+        required = job.required_topology_level
+        preferred = job.preferred_topology_level
+        if not required and not preferred:
+            return None
+        return tree, required, preferred
+
+    def _relevant_levels(self, tree: TopologyTree, required, preferred):
+        """calculateRelevantDomainLevels: deepest -> root, collect from
+        preferred/required until required (inclusive)."""
+        ordered = list(reversed(tree.levels)) + [ROOT_LEVEL]
+        out, collecting = [], False
+        for level in ordered:
+            if level == preferred or level == required:
+                collecting = True
+            if collecting:
+                out.append(level)
+            if level == required:
+                break
+        return out
+
+    # -- the SubsetNodes extension point -----------------------------------
+    def subset_nodes(self, job, tasks):
+        constraint = self._job_constraint(job)
+        if constraint is None:
+            return None
+        tree, required, preferred = constraint
+        ssn = self.ssn
+        n_pad = ssn.node_idle.shape[0]
+        n = len(ssn.snapshot.node_names)
+
+        reqs = np.stack([ssn._task_row(t)[0] for t in tasks]) \
+            if tasks else np.zeros((1, ssn.node_idle.shape[1]))
+        total_req = reqs.sum(axis=0)
+        max_pod_req = reqs.max(axis=0)
+        gang_size = len(tasks)
+        node_free = (ssn.node_idle + ssn.node_releasing)[:n]
+        node_room = ssn.node_room[:n]
+
+        # Pin to domains already hosting the job's running pods
+        # (getRelevantDomainsWithAllocatedPods) when required is set.
+        pinned_domains = None
+        if required and required in tree.node_domain:
+            active_nodes = {t.node_name for t in job.pods.values()
+                            if t.is_active_allocated() and t.node_name}
+            if active_nodes:
+                seg_req = tree.node_domain[required]
+                pinned_domains = {
+                    int(seg_req[ssn.node_index(node)])
+                    for node in active_nodes
+                    if ssn.node_index(node) >= 0
+                    and seg_req[ssn.node_index(node)] >= 0}
+
+        candidates = []  # (level_rank, ratio, domain_name, mask)
+        self._job_node_scores.pop(job.uid, None)
+        for level_rank, level in enumerate(
+                self._relevant_levels(tree, required, preferred)):
+            seg = tree.node_domain.get(level)
+            if seg is None:
+                continue
+            d = tree.num_domains(level)
+            if d == 0:
+                continue
+            free, pods = domain_aggregates(
+                jnp.asarray(node_free), jnp.asarray(node_room),
+                jnp.asarray(seg), jnp.asarray(max_pod_req),
+                float(gang_size), d)
+            free = np.asarray(free)
+            pods = np.asarray(pods)
+            for dom in range(d):
+                if pinned_domains is not None and level == required \
+                        and dom not in pinned_domains:
+                    continue
+                if pods[dom] < gang_size:
+                    continue
+                if np.any(total_req > free[dom] + 1e-9):
+                    continue
+                ratio = _pack_ratio(total_req, free[dom])
+                mask = np.zeros(n_pad, bool)
+                mask[:n] = seg == dom
+                if pinned_domains is not None and level != required:
+                    # Sub/ancestor domains must intersect the pinned set.
+                    seg_req = tree.node_domain[required]
+                    pin_mask = np.isin(seg_req, list(pinned_domains))
+                    if not np.any(mask[:n] & pin_mask):
+                        continue
+                candidates.append(
+                    (level_rank, -ratio, tree.domain_names[level][dom],
+                     mask))
+
+        if not candidates:
+            job.add_fit_error(
+                f"no topology domain of {tree.name} can host the gang "
+                f"(required={required}, preferred={preferred})")
+            return []
+        candidates.sort(key=lambda c: (c[0], c[1], c[2]))
+
+        # Preferred-level boost: nodes of better-ranked preferred domains
+        # score higher (node_scoring.go).
+        if preferred:
+            boosts = np.zeros(n_pad)
+            rank = 0
+            for level_rank, _, _, mask in candidates:
+                if level_rank == 0:  # preferred level entries come first
+                    boosts = np.maximum(
+                        boosts, mask * (TOPOLOGY / (rank + 1)))
+                    rank += 1
+            self._job_node_scores[job.uid] = boosts
+
+        return [mask for _, _, _, mask in candidates]
+
+    # -- the extra-score extension point -----------------------------------
+    def extra_scores(self, tasks):
+        if not tasks:
+            return None
+        boosts = self._job_node_scores.get(tasks[0].job_id)
+        if boosts is None:
+            return None
+        return np.tile(boosts, (len(tasks), 1))
+
+
+def _pack_ratio(total_req: np.ndarray, free: np.ndarray) -> float:
+    """getJobRatioToFreeResources: dominant requested/free ratio."""
+    ratio = 0.0
+    for i in range(total_req.shape[0]):
+        if total_req[i] <= 0:
+            continue
+        if free[i] <= 0:
+            ratio = max(ratio, IMPOSSIBLE_RATIO)
+        else:
+            ratio = max(ratio, float(total_req[i] / free[i]))
+    return ratio

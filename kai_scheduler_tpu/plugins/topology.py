@@ -1,9 +1,8 @@
-"""Topology-aware scheduling (TAS) plugin — placeholder registration.
+"""Topology-aware scheduling (TAS) plugin.
 
-The full domain-tree kernel (per-level segment aggregation of allocatable
-capacity, domain filtering and bin-pack ordering over node-sets, mirroring
-pkg/scheduler/plugins/topology/) lands with ops/topology.py; this module
-keeps the plugin name registered so configs carry it from day one.
+Registers ops/topology.TopologySession's domain filtering as the
+SubsetNodes extension point and its preferred-level boosts as score terms
+(mirroring pkg/scheduler/plugins/topology/topology_plugin.go:43-50).
 """
 
 from __future__ import annotations
@@ -16,10 +15,7 @@ class TopologyPlugin(Plugin):
     def on_session_open(self, ssn) -> None:
         if not ssn.cluster.topologies:
             return
-        try:
-            from ..ops.topology import TopologySession
-        except ImportError:  # kernel not built yet: degrade to no-op
-            return
+        from ..ops.topology import TopologySession
         self._topo = TopologySession(ssn)
         ssn.subset_nodes_fns.append(self._topo.subset_nodes)
         ssn.extra_score_fns.append(self._topo.extra_scores)

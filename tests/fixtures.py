@@ -52,7 +52,10 @@ def build_cluster(spec: dict) -> ClusterInfo:
             priority=j.get("priority", 0),
             min_available=j.get("min_available", 1),
             preemptible=j.get("preemptible", True),
-            creation_ts=j.get("creation_ts", 0.0))
+            creation_ts=j.get("creation_ts", 0.0),
+            topology_name=j.get("topology"),
+            required_topology_level=j.get("required_topology_level"),
+            preferred_topology_level=j.get("preferred_topology_level"))
         pg.last_start_ts = j.get("last_start_ts")
         if "pod_sets" in j:
             pg.set_pod_sets([PodSet(ps["name"], ps["min_available"])

@@ -1,0 +1,133 @@
+"""Topology-aware scheduling tests — analog of the reference's
+test/e2e/.../topology suites and plugins/topology unit tests."""
+
+import numpy as np
+import pytest
+
+from kai_scheduler_tpu.ops.topology import ROOT_LEVEL, build_tree
+from tests.fixtures import build_session, placements, run_action
+
+
+def rack_zone_cluster(gpus_free=None):
+    """4 nodes in 2 zones x 2 racks; gpus_free overrides idle GPUs by
+    pre-placing running pods."""
+    nodes = {}
+    for i in range(4):
+        zone = f"z{i // 2}"
+        rack = f"r{i}"  # one rack per node here; rack within zone
+        nodes[f"n{i}"] = {"gpu": 8, "labels": {"zone": zone, "rack": rack}}
+    spec = {
+        "nodes": nodes,
+        "queues": {"default": {}},
+        "topologies": {"topo": {"levels": ["zone", "rack"]}},
+        "jobs": {},
+    }
+    if gpus_free:
+        for i, free in enumerate(gpus_free):
+            used = 8 - free
+            if used > 0:
+                spec["jobs"][f"filler{i}"] = {
+                    "tasks": [{"gpu": used, "status": "RUNNING",
+                               "node": f"n{i}"}]}
+    return spec
+
+
+class TestBuildTree:
+    def test_domains(self):
+        labels = {"n0": {"zone": "z0", "rack": "r0"},
+                  "n1": {"zone": "z0", "rack": "r1"},
+                  "n2": {"zone": "z1", "rack": "r0"},
+                  "n3": {}}
+        tree = build_tree("t", ["zone", "rack"], ["n0", "n1", "n2", "n3"],
+                          labels)
+        assert tree.num_domains("zone") == 2
+        # rack domains are per-zone paths: z0/r0, z0/r1, z1/r0.
+        assert tree.num_domains("rack") == 3
+        assert tree.node_domain["zone"].tolist()[:3] == [0, 0, 1]
+        assert tree.node_domain["rack"][3] == -1  # unlabeled node excluded
+        assert tree.node_domain[ROOT_LEVEL].tolist() == [0, 0, 0, 0]
+
+
+class TestRequiredLevel:
+    def test_gang_confined_to_zone(self):
+        spec = rack_zone_cluster()
+        spec["jobs"]["gang"] = {
+            "min_available": 2, "topology": "topo",
+            "required_topology_level": "zone",
+            "tasks": [{"gpu": 8}, {"gpu": 8}],
+        }
+        ssn = build_session(spec)
+        run_action(ssn)
+        p = placements(ssn)
+        zones = {ssn.cluster.nodes[p[f"gang-{i}"][0]].labels["zone"]
+                 for i in range(2)}
+        assert len(zones) == 1  # whole gang in one zone
+
+    def test_no_zone_fits_fails(self):
+        # Each zone has only 8 free GPUs; gang needs 16 in one zone.
+        spec = rack_zone_cluster(gpus_free=[8, 0, 8, 0])
+        spec["jobs"]["gang"] = {
+            "min_available": 2, "topology": "topo",
+            "required_topology_level": "zone",
+            "tasks": [{"gpu": 8}, {"gpu": 8}],
+        }
+        ssn = build_session(spec)
+        run_action(ssn)
+        assert all(not uid.startswith("gang")
+                   for uid in placements(ssn))
+        assert any("topology" in e for e in
+                   ssn.cluster.podgroups["gang"].fit_errors)
+
+    def test_without_constraint_gang_spans_zones(self):
+        spec = rack_zone_cluster(gpus_free=[8, 0, 8, 0])
+        spec["jobs"]["gang"] = {
+            "min_available": 2,
+            "tasks": [{"gpu": 8}, {"gpu": 8}],
+        }
+        ssn = build_session(spec)
+        run_action(ssn)
+        assert len([u for u in placements(ssn) if u.startswith("gang")]) == 2
+
+
+class TestPreferredLevel:
+    def test_prefers_tightest_fitting_rack(self):
+        # rack n1 has exactly 4 free (tight fit); n0 has 8.
+        spec = rack_zone_cluster(gpus_free=[8, 4, 8, 8])
+        spec["jobs"]["j"] = {
+            "topology": "topo",
+            "preferred_topology_level": "rack",
+            "tasks": [{"gpu": 4}],
+        }
+        ssn = build_session(spec)
+        run_action(ssn)
+        assert placements(ssn)["j-0"][0] == "n1"  # packed into tight rack
+
+    def test_preferred_falls_back_to_coarser_level(self):
+        # No single rack fits the 2x8 gang, but zone z0 does.
+        spec = rack_zone_cluster()
+        spec["jobs"]["gang"] = {
+            "min_available": 2, "topology": "topo",
+            "preferred_topology_level": "rack",
+            "tasks": [{"gpu": 8}, {"gpu": 8}],
+        }
+        ssn = build_session(spec)
+        run_action(ssn)
+        p = placements(ssn)
+        assert len([u for u in p if u.startswith("gang")]) == 2
+
+
+class TestPinnedDomains:
+    def test_running_pods_pin_required_domain(self):
+        # Job has a running pod in z1; required=zone forces new pods there.
+        spec = rack_zone_cluster()
+        spec["jobs"]["grow"] = {
+            "min_available": 1, "topology": "topo",
+            "required_topology_level": "zone",
+            "tasks": [{"gpu": 2, "status": "RUNNING", "node": "n2"},
+                      {"gpu": 2}],
+        }
+        ssn = build_session(spec)
+        run_action(ssn)
+        p = placements(ssn)
+        node = p["grow-1"][0]
+        assert ssn.cluster.nodes[node].labels["zone"] == "z1"
