@@ -1,0 +1,111 @@
+"""Benchmark: full scheduling-cycle latency on the packed snapshot kernels.
+
+Measures the device-side hot loop the reference runs as Go pointer-chasing
+(predicate masks + score matrix + DRF fair share + sequential gang
+allocation) as one jitted program, at the BASELINE.md stepping-stone scale
+of 1k nodes x 2k pending pods across 16 queues.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": median_ms, "unit": "ms", "vs_baseline": ratio}
+vs_baseline is measured against the repo's north-star cycle budget of 100ms
+(BASELINE.json: <100ms p99 @ 100k nodes / 1M pending); ratio > 1 means the
+cycle fits the budget at this config (the reference publishes no absolute
+numbers to compare against — BASELINE.md).
+"""
+
+import json
+import time
+
+import numpy as np
+
+N_NODES = 1024
+N_JOBS = 512
+TASKS_PER_JOB = 4
+N_QUEUES = 16
+NORTH_STAR_MS = 100.0
+
+
+def build_arrays():
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    alloc = np.tile([64000.0, 512e9, 8.0], (N_NODES, 1))
+    idle = alloc.copy()
+    idle[:, 2] -= rng.integers(0, 5, N_NODES)
+    rel = np.zeros((N_NODES, 3))
+    labels = np.full((N_NODES, 1), -1, np.int32)
+    labels[:, 0] = rng.integers(0, 4, N_NODES)
+    taints = np.full((N_NODES, 1), -1, np.int32)
+    room = np.full(N_NODES, 110.0)
+
+    n_tasks = N_JOBS * TASKS_PER_JOB
+    task_job = np.repeat(np.arange(N_JOBS, dtype=np.int32), TASKS_PER_JOB)
+    req = np.stack([[1000.0, 4e9, float(rng.integers(1, 3))]
+                    for _ in range(n_tasks)])
+    sel = np.full((n_tasks, 1), -1, np.int32)
+    constrained = rng.random(n_tasks) < 0.25
+    sel[constrained, 0] = rng.integers(0, 4, constrained.sum())
+    tol = np.full((n_tasks, 1), -1, np.int32)
+    job_allowed = np.ones(N_JOBS, bool)
+    return tuple(map(jnp.asarray, (
+        alloc, idle, rel, labels, taints, room, req, task_job, sel, tol,
+        job_allowed)))
+
+
+def main():
+    import jax
+
+    from kai_scheduler_tpu.ops.allocate import allocate_jobs_kernel
+    from kai_scheduler_tpu.ops.fairshare import LevelSpec, divide_groups_jax
+
+    args = build_arrays()
+    import jax.numpy as jnp
+    q_des = jnp.full((N_QUEUES, 3), -1.0)
+    q_lim = jnp.full((N_QUEUES, 3), -1.0)
+    q_w = jnp.ones((N_QUEUES, 3))
+    q_req = jnp.full((N_QUEUES, 3), 1e15)
+    q_use = jnp.zeros((N_QUEUES, 3))
+    q_band = jnp.zeros(N_QUEUES, jnp.int32)
+    q_tie = jnp.arange(N_QUEUES)
+    total = jnp.asarray(np.array([64000.0, 512e9, 8.0]) * N_NODES)
+    spec = LevelSpec(num_groups=1, num_bands=1)
+
+    def cycle():
+        fair = divide_groups_jax(
+            spec, total[None, :], jnp.zeros(N_QUEUES, jnp.int32), q_band,
+            q_des, q_lim, q_w, q_req, q_use, q_tie, 1.0)
+        result = allocate_jobs_kernel(*args)
+        return fair, result
+
+    # Warmup/compile.
+    fair, result = cycle()
+    fair.block_until_ready()
+    result.placements.block_until_ready()
+    placed = int((np.asarray(result.placements) >= 0).sum())
+
+    times = []
+    for _ in range(10):
+        t0 = time.perf_counter()
+        fair, result = cycle()
+        result.placements.block_until_ready()
+        times.append((time.perf_counter() - t0) * 1000.0)
+    median = float(np.median(times))
+    n_tasks = N_JOBS * TASKS_PER_JOB
+
+    print(json.dumps({
+        "metric": (f"scheduling_cycle_latency_ms@{N_NODES}nodes_"
+                   f"{n_tasks}pods"),
+        "value": round(median, 3),
+        "unit": "ms",
+        "vs_baseline": round(NORTH_STAR_MS / median, 3),
+        "detail": {
+            "backend": jax.default_backend(),
+            "p99_ms": round(float(np.percentile(times, 99)), 3),
+            "pods_placed": placed,
+            "pods_placed_per_sec": round(placed / (median / 1000.0)),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

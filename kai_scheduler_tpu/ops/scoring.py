@@ -41,7 +41,7 @@ SPREAD = 1
 
 
 def score_row(allocatable, idle, req, fit_any, fit_now,
-              gpu_strategy: int, cpu_strategy: int):
+              gpu_strategy: int, cpu_strategy: int, minmax=None):
     """One task's [N] score: binpack/spread (per the job's dominant resource
     type) + resourcetype match + availability boost.
 
@@ -49,6 +49,10 @@ def score_row(allocatable, idle, req, fit_any, fit_now,
     resource, scale free amount to [0, MaxHighDensity], higher score for
     fuller nodes; all-equal -> everyone gets the max.  Spread
     (spread.go:16-37): free/capacity.
+
+    ``minmax``: optional [2,R] (min_free, max_free) over the task's valid
+    nodes — the multi-chip kernel passes collective-reduced global values so
+    each node shard scores against the same scale (parallel/sharded.py).
     """
     is_gpu_job = req[RES_GPU] > 0.0
 
@@ -59,9 +63,12 @@ def score_row(allocatable, idle, req, fit_any, fit_now,
         if strategy == SPREAD:
             return jnp.where(has_res, free / jnp.where(has_res, cap, 1.0),
                              0.0)
-        valid = fit_any & has_res
-        min_free = jnp.min(jnp.where(valid, free, jnp.inf))
-        max_free = jnp.max(jnp.where(valid, free, -jnp.inf))
+        if minmax is not None:
+            min_free, max_free = minmax[0, res], minmax[1, res]
+        else:
+            valid = fit_any & has_res
+            min_free = jnp.min(jnp.where(valid, free, jnp.inf))
+            max_free = jnp.max(jnp.where(valid, free, -jnp.inf))
         span = max_free - min_free
         flat = span <= 0.0
         score = MAX_HIGH_DENSITY * (

@@ -1,0 +1,13 @@
+"""Multi-chip scaling: device meshes + sharded cycle kernels.
+
+The reference scales by sharding the CLUSTER across scheduler instances
+(SchedulingShard CRD, cluster-level SPMD — SURVEY.md §2.6.4); here the same
+axis — the node dimension of the packed snapshot — shards across TPU chips
+inside one jitted program, with XLA collectives over ICI replacing the
+API-server partition."""
+
+from .mesh import cluster_mesh, node_sharding
+from .sharded import sharded_allocate_jobs, sharded_cycle_step
+
+__all__ = ["cluster_mesh", "node_sharding", "sharded_allocate_jobs",
+           "sharded_cycle_step"]
