@@ -1,0 +1,16 @@
+"""Companion controllers (SURVEY.md §2.4): the fleet around the scheduler,
+communicating only through API objects."""
+
+from .admission import Admission, AdmissionError
+from .binder import Binder
+from .cache_builder import ClusterCache
+from .kubeapi import InMemoryKubeAPI, make_pod, owner_ref
+from .nodescaleadjuster import NodeScaleAdjuster
+from .operator import ShardSpec, System, SystemConfig
+from .podgrouper import PodGrouper
+from .status_controllers import PodGroupController, QueueController
+
+__all__ = ["Admission", "AdmissionError", "Binder", "ClusterCache",
+           "InMemoryKubeAPI", "make_pod", "owner_ref", "NodeScaleAdjuster",
+           "ShardSpec", "System", "SystemConfig", "PodGrouper",
+           "PodGroupController", "QueueController"]

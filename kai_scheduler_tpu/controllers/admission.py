@@ -1,0 +1,72 @@
+"""Admission: mutating + validating webhooks for incoming pods.
+
+Mirrors pkg/admission/ (plugin interface plugins/plugins.go:13-17; webhooks
+webhook/v1alpha2/{gpusharing,podhooks,runtimeenforcement}): normalize
+fractional-GPU requests expressed as annotations into scheduler-readable
+form, enforce the scheduler runtime class, and validate queue labels.
+"""
+
+from __future__ import annotations
+
+GPU_FRACTION_ANNOTATION = "gpu-fraction"
+GPU_MEMORY_ANNOTATION = "gpu-memory"
+QUEUE_LABEL = "kai.scheduler/queue"
+
+
+class AdmissionError(Exception):
+    pass
+
+
+class Admission:
+    def __init__(self, api=None, require_queue_label: bool = False,
+                 scheduler_name: str = "kai-scheduler"):
+        self.api = api
+        self.require_queue_label = require_queue_label
+        self.scheduler_name = scheduler_name
+        if api is not None:
+            api.watch("Pod", self._on_pod)
+
+    def _on_pod(self, event_type: str, pod: dict) -> None:
+        if event_type != "ADDED":
+            return
+        self.mutate(pod)
+        self.validate(pod)
+
+    # -- mutating webhook (gpusharing) --------------------------------------
+    def mutate(self, pod: dict) -> dict:
+        ann = pod.get("metadata", {}).get("annotations", {})
+        spec = pod.setdefault("spec", {})
+        if GPU_FRACTION_ANNOTATION in ann or GPU_MEMORY_ANNOTATION in ann:
+            # Fractional pods must not also request whole devices; the
+            # scheduler accounts their device share via the annotation.
+            for c in spec.get("containers", []):
+                requests = c.setdefault("resources", {}).setdefault(
+                    "requests", {})
+                requests.pop("nvidia.com/gpu", None)
+        spec.setdefault("schedulerName", self.scheduler_name)
+        return pod
+
+    # -- validating webhook --------------------------------------------------
+    def validate(self, pod: dict) -> None:
+        ann = pod.get("metadata", {}).get("annotations", {})
+        if GPU_FRACTION_ANNOTATION in ann:
+            try:
+                f = float(ann[GPU_FRACTION_ANNOTATION])
+            except ValueError:
+                raise AdmissionError(
+                    f"gpu-fraction must be a number, got "
+                    f"{ann[GPU_FRACTION_ANNOTATION]!r}")
+            if not 0.0 < f < 1.0:
+                raise AdmissionError(
+                    f"gpu-fraction must be in (0, 1), got {f}")
+            if GPU_MEMORY_ANNOTATION in ann:
+                raise AdmissionError(
+                    "gpu-fraction and gpu-memory are mutually exclusive")
+        labels = pod.get("metadata", {}).get("labels", {})
+        if self.require_queue_label and QUEUE_LABEL not in labels:
+            raise AdmissionError(f"pod missing required label {QUEUE_LABEL}")
+        if self.api is not None and QUEUE_LABEL in labels:
+            if self.api.get_opt("Queue", labels[QUEUE_LABEL]) is None \
+                    and self.require_queue_label:
+                raise AdmissionError(
+                    f"queue {labels[QUEUE_LABEL]!r} does not exist")

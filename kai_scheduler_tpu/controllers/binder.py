@@ -1,0 +1,158 @@
+"""Binder: consumes BindRequest objects and executes the actual binding.
+
+Mirrors pkg/binder/ (BindRequestReconciler bindrequest_controller.go:89,
+Binder.Bind binder.go:42-128): volume-binding / resource-claim pre-bind
+plugin phase, fractional-GPU reservation (a reservation pod per shared
+device in the reservation namespace, docs/gpu-sharing/README.md:12), then
+the pods/binding call; retries with a backoff limit and rollback of
+reservations on failure.
+"""
+
+from __future__ import annotations
+
+from .kubeapi import InMemoryKubeAPI, NotFound
+
+RESERVATION_NAMESPACE = "kai-resource-reservation"
+GPU_GROUP_ANNOTATION = "kai.scheduler/gpu-group"
+GPU_FRACTION_ANNOTATION = "kai.scheduler/gpu-fraction"
+
+
+class BindPlugin:
+    """Pre-bind/post-bind plugin interface (pkg/binder/plugins/)."""
+
+    def pre_bind(self, api, pod, node_name, bind_request) -> None:
+        pass
+
+    def post_bind(self, api, pod, node_name, bind_request) -> None:
+        pass
+
+
+class VolumeBindingPlugin(BindPlugin):
+    """Binds pending PVCs referenced by the pod to the chosen node's
+    storage (k8s-plugins/volumebinding analog, simplified to the object
+    model of the in-memory API)."""
+
+    def pre_bind(self, api, pod, node_name, bind_request) -> None:
+        for vol in pod.get("spec", {}).get("volumes", []) or []:
+            claim = vol.get("persistentVolumeClaim", {}).get("claimName")
+            if not claim:
+                continue
+            pvc = api.get_opt("PersistentVolumeClaim", claim,
+                              pod["metadata"].get("namespace", "default"))
+            if pvc is not None and not pvc.get("status", {}).get("phase") \
+                    == "Bound":
+                pvc.setdefault("status", {})["phase"] = "Bound"
+                pvc.setdefault("metadata", {}).setdefault(
+                    "annotations", {})["volume.kubernetes.io/selected-node"] \
+                    = node_name
+                api.update(pvc)
+
+
+class ResourceClaimPlugin(BindPlugin):
+    """Writes DRA-style resource-claim allocations at bind time
+    (k8s-plugins/dynamicresources analog)."""
+
+    def pre_bind(self, api, pod, node_name, bind_request) -> None:
+        for claim_name in bind_request.get("spec", {}).get(
+                "resourceClaims", []) or []:
+            claim = api.get_opt("ResourceClaim", claim_name,
+                                pod["metadata"].get("namespace", "default"))
+            if claim is not None:
+                claim.setdefault("status", {})["allocated"] = True
+                claim["status"]["nodeName"] = node_name
+                api.update(claim)
+
+
+class Binder:
+    def __init__(self, api: InMemoryKubeAPI, plugins=None,
+                 backoff_limit: int = 3):
+        self.api = api
+        self.plugins = plugins if plugins is not None else [
+            VolumeBindingPlugin(), ResourceClaimPlugin()]
+        self.backoff_limit = backoff_limit
+        api.watch("BindRequest", self._on_bind_request)
+
+    def _on_bind_request(self, event_type: str, br: dict) -> None:
+        if event_type == "DELETED":
+            return
+        status = br.setdefault("status", {})
+        if status.get("phase") in ("Succeeded", "Failed"):
+            return
+        try:
+            self._bind(br)
+            status["phase"] = "Succeeded"
+        except Exception as exc:  # retry with backoff limit
+            attempts = status.get("attempts", 0) + 1
+            status["attempts"] = attempts
+            if attempts >= br.get("spec", {}).get("backoffLimit",
+                                                  self.backoff_limit):
+                status["phase"] = "Failed"
+                status["reason"] = str(exc)
+                self._rollback(br)
+            else:
+                status["phase"] = "Pending"
+                self.api._emit("MODIFIED", br)  # requeue
+        self.api.update(br)
+
+    def _bind(self, br: dict) -> None:
+        spec = br["spec"]
+        ns = br["metadata"].get("namespace", "default")
+        pod = self.api.get("Pod", spec["podName"], ns)
+        node_name = spec["selectedNode"]
+        node = self.api.get("Node", node_name, "default")
+
+        for plugin in self.plugins:
+            plugin.pre_bind(self.api, pod, node_name, br)
+
+        gpu_groups = spec.get("selectedGPUGroups") or []
+        if gpu_groups:
+            self._reserve_gpus(pod, node_name, gpu_groups, spec)
+
+        # The pods/binding call.
+        pod["spec"]["nodeName"] = node_name
+        pod.setdefault("status", {})["phase"] = "Running"
+        self.api.update(pod)
+
+        for plugin in self.plugins:
+            plugin.post_bind(self.api, pod, node_name, br)
+
+    def _reserve_gpus(self, pod: dict, node_name: str, gpu_groups: list,
+                      spec: dict) -> None:
+        """Fractional binding: ensure a reservation pod holds each shared
+        device (binder.go:111 + binding/resourcereservation/)."""
+        for group in gpu_groups:
+            name = f"reservation-{group}"
+            existing = self.api.get_opt("Pod", name, RESERVATION_NAMESPACE)
+            if existing is None:
+                self.api.create({
+                    "kind": "Pod",
+                    "metadata": {"name": name,
+                                 "namespace": RESERVATION_NAMESPACE,
+                                 "labels": {"app": "kai-resource-"
+                                            "reservation",
+                                            GPU_GROUP_ANNOTATION: group}},
+                    "spec": {"nodeName": node_name, "containers": [
+                        {"name": "reservation", "resources": {
+                            "requests": {"nvidia.com/gpu": 1}}}]},
+                    "status": {"phase": "Running"},
+                })
+        ann = pod["metadata"].setdefault("annotations", {})
+        ann[GPU_GROUP_ANNOTATION] = ",".join(gpu_groups)
+        if spec.get("gpuFraction"):
+            ann[GPU_FRACTION_ANNOTATION] = str(spec["gpuFraction"])
+
+    def _rollback(self, br: dict) -> None:
+        """Failed bind: release reservations taken for this request
+        (Binder.Rollback, binder.go:86)."""
+        for group in br.get("spec", {}).get("selectedGPUGroups") or []:
+            name = f"reservation-{group}"
+            pod = self.api.get_opt("Pod", name, RESERVATION_NAMESPACE)
+            if pod is not None and not self._group_in_use(group, br):
+                self.api.delete("Pod", name, RESERVATION_NAMESPACE)
+
+    def _group_in_use(self, group: str, exclude_br: dict) -> bool:
+        for pod in self.api.list("Pod"):
+            ann = pod["metadata"].get("annotations", {})
+            if group in ann.get(GPU_GROUP_ANNOTATION, "").split(","):
+                return True
+        return False

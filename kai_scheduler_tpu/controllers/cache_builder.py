@@ -1,0 +1,212 @@
+"""Cluster cache: API objects -> ClusterInfo snapshots.
+
+The L1 layer (SURVEY.md §1): mirrors pkg/scheduler/cache/ +
+cache/cluster_info/cluster_info.go:118 — aggregate watched objects and
+build the immutable per-cycle ClusterInfo the framework schedules against.
+Also executes the scheduler's side effects against the API (Bind ->
+BindRequest object, Evict -> pod deletion + condition), playing the role of
+cache.Bind/Evictor for the embedded deployment.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+from ..api import (ClusterInfo, NodeInfo, PodGroupInfo, PodInfo, PodSet,
+                   PodStatus, QueueInfo, QueueQuota, resources as rs)
+from ..api.resources import ResourceRequirements
+from .admission import GPU_FRACTION_ANNOTATION, GPU_MEMORY_ANNOTATION
+from .binder import GPU_GROUP_ANNOTATION
+from .kubeapi import InMemoryKubeAPI
+from .podgrouper import POD_GROUP_LABEL, SUBGROUP_LABEL
+
+PHASE_TO_STATUS = {
+    "Pending": PodStatus.PENDING,
+    "Running": PodStatus.RUNNING,
+    "Succeeded": PodStatus.SUCCEEDED,
+    "Failed": PodStatus.FAILED,
+}
+
+
+def _requests_to_reqreq(pod: dict) -> ResourceRequirements:
+    cpu_milli = mem = gpu = 0.0
+    for c in pod.get("spec", {}).get("containers", []):
+        req = c.get("resources", {}).get("requests", {})
+        if "cpu" in req:
+            cpu_milli += rs.parse_cpu(req["cpu"])
+        if "memory" in req:
+            mem += rs.parse_memory(req["memory"])
+        if "nvidia.com/gpu" in req:
+            gpu += float(req["nvidia.com/gpu"])
+    ann = pod.get("metadata", {}).get("annotations", {})
+    fraction = float(ann.get(GPU_FRACTION_ANNOTATION, 0) or 0)
+    gpu_memory = ann.get(GPU_MEMORY_ANNOTATION)
+    return ResourceRequirements.from_spec(
+        cpu=cpu_milli / 1000.0 if cpu_milli else None,
+        memory=mem if mem else None,
+        gpu=gpu, gpu_fraction=fraction, gpu_memory=gpu_memory)
+
+
+def _quota_vec(spec: dict | None):
+    if not spec:
+        return None
+    return dict(cpu=spec.get("cpu"), memory=spec.get("memory"),
+                gpu=spec.get("gpu", 0))
+
+
+class ClusterCache:
+    """Watches the API and snapshots ClusterInfo each cycle."""
+
+    def __init__(self, api: InMemoryKubeAPI, now_fn=None):
+        self.api = api
+        self.now_fn = now_fn or (lambda: 0.0)
+
+    # -- snapshot ------------------------------------------------------------
+    def snapshot(self) -> ClusterInfo:
+        nodes = {}
+        for n in self.api.list("Node"):
+            spec = n.get("status", {}).get("allocatable", {})
+            gpu_mem = n.get("metadata", {}).get("annotations", {}).get(
+                "nvidia.com/gpu.memory")
+            nodes[n["metadata"]["name"]] = NodeInfo(
+                n["metadata"]["name"],
+                rs.vec_from_spec(spec.get("cpu", "0"),
+                                 spec.get("memory", "0"),
+                                 float(spec.get("nvidia.com/gpu", 0))),
+                labels=n.get("metadata", {}).get("labels", {}),
+                taints={t["key"] for t in n.get("spec", {}).get(
+                    "taints", [])},
+                gpu_memory_per_device=rs.parse_memory(gpu_mem)
+                if gpu_mem else 16 * 2 ** 30,
+                max_pods=int(spec.get("pods", 110)))
+
+        queues = {}
+        for q in self.api.list("Queue"):
+            spec = q.get("spec", {})
+            queues[q["metadata"]["name"]] = QueueInfo(
+                q["metadata"]["name"],
+                parent=spec.get("parentQueue"),
+                priority=spec.get("priority", 0),
+                creation_ts=float(q["metadata"].get("creationTimestamp",
+                                                    0) or 0),
+                quota=QueueQuota.from_spec(
+                    deserved=_quota_vec(spec.get("deserved")),
+                    limit=_quota_vec(spec.get("limit")),
+                    over_quota_weight=spec.get("overQuotaWeight", 1.0)),
+                preempt_min_runtime=spec.get("preemptMinRuntime"),
+                reclaim_min_runtime=spec.get("reclaimMinRuntime"))
+        for name, q in queues.items():
+            if q.parent and name not in queues.get(q.parent, QueueInfo(
+                    q.parent)).children:
+                if q.parent in queues:
+                    queues[q.parent].children.append(name)
+
+        podgroups: dict[str, PodGroupInfo] = {}
+        for pg_obj in self.api.list("PodGroup"):
+            spec = pg_obj.get("spec", {})
+            name = pg_obj["metadata"]["name"]
+            topo = spec.get("topology") or {}
+            pg = PodGroupInfo(
+                name, name,
+                namespace=pg_obj["metadata"].get("namespace", "default"),
+                queue_id=spec.get("queue", "default"),
+                priority=spec.get("priority", 50),
+                min_available=spec.get("minMember", 1),
+                preemptible=spec.get("preemptible", True),
+                creation_ts=float(pg_obj["metadata"].get(
+                    "creationTimestamp", 0) or 0),
+                topology_name=topo.get("name"),
+                required_topology_level=topo.get("required"),
+                preferred_topology_level=topo.get("preferred"))
+            pod_sets = spec.get("podSets") or []
+            if pod_sets:
+                pg.set_pod_sets([PodSet(ps["name"], ps["minAvailable"])
+                                 for ps in pod_sets])
+            pg.last_start_ts = pg_obj.get("status", {}).get(
+                "lastStartTimestamp")
+            podgroups[name] = pg
+
+        for pod in self.api.list("Pod"):
+            group = pod["metadata"].get("labels", {}).get(POD_GROUP_LABEL)
+            if not group or group not in podgroups:
+                continue
+            phase = pod.get("status", {}).get("phase", "Pending")
+            status = PHASE_TO_STATUS.get(phase, PodStatus.UNKNOWN)
+            if pod["metadata"].get("deletionTimestamp"):
+                status = PodStatus.RELEASING
+            task = PodInfo(
+                uid=pod["metadata"].get("uid", pod["metadata"]["name"]),
+                name=pod["metadata"]["name"],
+                namespace=pod["metadata"].get("namespace", "default"),
+                subgroup=pod["metadata"].get("labels", {}).get(
+                    SUBGROUP_LABEL, "default"),
+                res_req=_requests_to_reqreq(pod),
+                status=status,
+                node_name=pod.get("spec", {}).get("nodeName", ""),
+                node_selector=pod.get("spec", {}).get("nodeSelector", {}),
+                tolerations={t["key"] for t in pod.get("spec", {}).get(
+                    "tolerations", [])})
+            gpu_group = pod["metadata"].get("annotations", {}).get(
+                GPU_GROUP_ANNOTATION)
+            if gpu_group:
+                task.gpu_group = gpu_group
+            podgroups[group].add_task(task)
+
+        topologies = {}
+        for topo in self.api.list("Topology"):
+            topologies[topo["metadata"]["name"]] = {
+                "levels": [lvl["nodeLabel"] for lvl in
+                           topo.get("spec", {}).get("levels", [])]}
+
+        return ClusterInfo(nodes, podgroups, queues, topologies,
+                           now=self.now_fn())
+
+    # -- side-effect executor (framework Session cache interface) ------------
+    def bind(self, task, node_name: str, bind_request) -> None:
+        """Create the BindRequest object the binder consumes
+        (cache/cache.go:267-290)."""
+        self.api.create({
+            "kind": "BindRequest",
+            "metadata": {"name": f"bind-{task.uid}",
+                         "namespace": task.namespace},
+            "spec": {"podName": task.name, "podUid": task.uid,
+                     "selectedNode": node_name,
+                     "selectedGPUGroups": bind_request.gpu_groups,
+                     "gpuFraction": task.res_req.gpu_fraction or None,
+                     "backoffLimit": bind_request.backoff_limit},
+            "status": {"phase": "Pending"},
+        })
+
+    def evict(self, task) -> None:
+        """Delete the pod + patch the eviction condition
+        (cache/evictor/default_evictor.go:24-45)."""
+        pod = self.api.get_opt("Pod", task.name, task.namespace)
+        if pod is not None:
+            pod.setdefault("status", {}).setdefault("conditions", []).append(
+                {"type": "TerminationByKaiScheduler", "status": "True",
+                 "reason": "Evicted"})
+            pod["metadata"]["deletionTimestamp"] = str(self.now_fn())
+            self.api.update(pod)
+
+    def record_event(self, kind: str, message: str) -> None:
+        self.api.create({
+            "kind": "Event",
+            "metadata": {"name": f"evt-{next(_EVENT_SEQ)}"},
+            "spec": {"reason": kind, "message": message},
+        })
+
+    def gc_stale_bind_requests(self) -> int:
+        """Stale BindRequest GC (cache/cache.go:371): drop requests whose
+        pod vanished or already bound."""
+        removed = 0
+        for br in self.api.list("BindRequest"):
+            ns = br["metadata"].get("namespace", "default")
+            pod = self.api.get_opt("Pod", br["spec"]["podName"], ns)
+            done = br.get("status", {}).get("phase") == "Succeeded"
+            if pod is None or (done and pod.get("spec", {}).get("nodeName")):
+                self.api.delete("BindRequest", br["metadata"]["name"], ns)
+                removed += 1
+        return removed
+
+
+_EVENT_SEQ = itertools.count()

@@ -1,0 +1,169 @@
+"""In-memory API server: the controllers' communication substrate.
+
+The reference's controller fleet communicates exclusively through the
+Kubernetes API server (watches in, CRDs out — SURVEY.md §2.6.5).  This
+module provides that substrate for embedded/offline deployments and tests:
+a typed object store with create/update/patch/delete, resource versions,
+and watch queues that reconcilers drain.  A real-cluster deployment swaps
+this for a kubernetes client exposing the same interface.
+
+Objects are plain dicts shaped like K8s manifests:
+  {"kind", "metadata": {"name", "namespace", "uid", "labels", ...},
+   "spec": {...}, "status": {...}}
+"""
+
+from __future__ import annotations
+
+import itertools
+import uuid
+from collections import defaultdict
+from typing import Callable
+
+
+class Conflict(Exception):
+    pass
+
+
+class NotFound(Exception):
+    pass
+
+
+def obj_key(obj: dict) -> tuple:
+    md = obj.get("metadata", {})
+    return (obj["kind"], md.get("namespace", "default"), md["name"])
+
+
+class InMemoryKubeAPI:
+    def __init__(self):
+        self.objects: dict[tuple, dict] = {}
+        self._rv = itertools.count(1)
+        self._watchers: dict[str, list[Callable]] = defaultdict(list)
+        self._pending: list[tuple] = []  # (event_type, obj) queue
+
+    # -- CRUD --------------------------------------------------------------
+    def create(self, obj: dict) -> dict:
+        md = obj.setdefault("metadata", {})
+        md.setdefault("namespace", "default")
+        md.setdefault("uid", uuid.uuid4().hex[:12])
+        md["resourceVersion"] = str(next(self._rv))
+        key = obj_key(obj)
+        if key in self.objects:
+            raise Conflict(f"{key} already exists")
+        self.objects[key] = obj
+        self._emit("ADDED", obj)
+        return obj
+
+    def get(self, kind: str, name: str, namespace: str = "default") -> dict:
+        key = (kind, namespace, name)
+        if key not in self.objects:
+            raise NotFound(str(key))
+        return self.objects[key]
+
+    def get_opt(self, kind: str, name: str,
+                namespace: str = "default") -> dict | None:
+        return self.objects.get((kind, namespace, name))
+
+    def list(self, kind: str, namespace: str | None = None,
+             label_selector: dict | None = None) -> list[dict]:
+        out = []
+        for (k, ns, _), obj in self.objects.items():
+            if k != kind:
+                continue
+            if namespace is not None and ns != namespace:
+                continue
+            if label_selector:
+                labels = obj.get("metadata", {}).get("labels", {})
+                if any(labels.get(lk) != lv
+                       for lk, lv in label_selector.items()):
+                    continue
+            out.append(obj)
+        return sorted(out, key=lambda o: o["metadata"]["name"])
+
+    def update(self, obj: dict) -> dict:
+        key = obj_key(obj)
+        if key not in self.objects:
+            raise NotFound(str(key))
+        obj["metadata"]["resourceVersion"] = str(next(self._rv))
+        self.objects[key] = obj
+        self._emit("MODIFIED", obj)
+        return obj
+
+    def patch(self, kind: str, name: str, patch: dict,
+              namespace: str = "default") -> dict:
+        obj = self.get(kind, name, namespace)
+        _deep_merge(obj, patch)
+        return self.update(obj)
+
+    def delete(self, kind: str, name: str,
+               namespace: str = "default") -> None:
+        key = (kind, namespace, name)
+        obj = self.objects.pop(key, None)
+        if obj is not None:
+            self._emit("DELETED", obj)
+
+    # -- watch -------------------------------------------------------------
+    def watch(self, kind: str, handler: Callable) -> None:
+        """handler(event_type, obj); delivered on drain()."""
+        self._watchers[kind].append(handler)
+
+    def _emit(self, event_type: str, obj: dict) -> None:
+        self._pending.append((event_type, obj))
+
+    def drain(self, max_rounds: int = 100) -> int:
+        """Deliver queued events until quiescent (reconcilers may create
+        new objects while handling events).  Returns events delivered."""
+        delivered = 0
+        for _ in range(max_rounds):
+            if not self._pending:
+                break
+            batch, self._pending = self._pending, []
+            for event_type, obj in batch:
+                for handler in list(self._watchers.get(obj["kind"], ())):
+                    handler(event_type, obj)
+                delivered += 1
+        return delivered
+
+
+def _deep_merge(dst: dict, src: dict) -> None:
+    for k, v in src.items():
+        if isinstance(v, dict) and isinstance(dst.get(k), dict):
+            _deep_merge(dst[k], v)
+        elif v is None:
+            dst.pop(k, None)
+        else:
+            dst[k] = v
+
+
+def make_pod(name: str, namespace: str = "default", owner: dict | None = None,
+             labels: dict | None = None, annotations: dict | None = None,
+             cpu: str = "1", memory: str = "1Gi", gpu: float = 0,
+             queue: str | None = None, phase: str = "Pending",
+             node_name: str = "", node_selector: dict | None = None,
+             tolerations: list | None = None, **extra_spec) -> dict:
+    """Test/controller helper to build a pod manifest."""
+    md = {"name": name, "namespace": namespace,
+          "labels": dict(labels or {}),
+          "annotations": dict(annotations or {})}
+    if owner:
+        md["ownerReferences"] = [owner]
+    if queue:
+        md["labels"]["kai.scheduler/queue"] = queue
+    spec = {"containers": [{"name": "main", "resources": {"requests": {
+        "cpu": cpu, "memory": memory,
+        **({"nvidia.com/gpu": gpu} if gpu else {})}}}],
+        **extra_spec}
+    if node_name:
+        spec["nodeName"] = node_name
+    if node_selector:
+        spec["nodeSelector"] = dict(node_selector)
+    if tolerations:
+        spec["tolerations"] = [{"key": t} if isinstance(t, str) else t
+                               for t in tolerations]
+    return {"kind": "Pod", "metadata": md, "spec": spec,
+            "status": {"phase": phase}}
+
+
+def owner_ref(kind: str, name: str, uid: str = "",
+              api_version: str = "v1", controller: bool = True) -> dict:
+    return {"kind": kind, "name": name, "uid": uid or uuid.uuid4().hex[:12],
+            "apiVersion": api_version, "controller": controller}

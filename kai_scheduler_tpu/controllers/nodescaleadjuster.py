@@ -1,0 +1,54 @@
+"""Node-scale adjuster: make autoscalers see fractional-GPU demand.
+
+Mirrors pkg/nodescaleadjuster/ (scale_adjuster.go:47-176): cluster
+autoscalers can't reason about fraction annotations, so for every
+unschedulable fractional pod the adjuster creates a whole-GPU "scaling pod"
+in the scale-adjust namespace; once the real pod schedules (or goes away)
+the scaling pod is removed.  A cooldown avoids thrash (consts/consts.go).
+"""
+
+from __future__ import annotations
+
+SCALING_NAMESPACE = "kai-scale-adjust"
+GPU_FRACTION_ANNOTATION = "gpu-fraction"
+SCALING_POD_LABEL = "kai.scheduler/scaling-pod-for"
+COOL_DOWN_SECONDS = 60.0
+
+
+class NodeScaleAdjuster:
+    def __init__(self, api, now_fn=None):
+        self.api = api
+        self.now_fn = now_fn or (lambda: 0.0)
+        self._last_created: dict[str, float] = {}
+        api.watch("Pod", self._on_pod)
+
+    def _on_pod(self, event_type: str, pod: dict) -> None:
+        if pod["metadata"].get("namespace") == SCALING_NAMESPACE:
+            return
+        ann = pod.get("metadata", {}).get("annotations", {})
+        if GPU_FRACTION_ANNOTATION not in ann:
+            return
+        uid = pod["metadata"].get("uid", pod["metadata"]["name"])
+        scaling_name = f"scaling-pod-{uid}"
+        unschedulable = (event_type != "DELETED"
+                         and pod.get("status", {}).get("phase") == "Pending"
+                         and not pod.get("spec", {}).get("nodeName"))
+        existing = self.api.get_opt("Pod", scaling_name, SCALING_NAMESPACE)
+        if unschedulable and existing is None:
+            now = self.now_fn()
+            if now - self._last_created.get(uid, -1e18) < COOL_DOWN_SECONDS:
+                return
+            self._last_created[uid] = now
+            # A whole-GPU sleeper pod the autoscaler can count
+            # (cmd/scalingpod's image analog).
+            self.api.create({
+                "kind": "Pod",
+                "metadata": {"name": scaling_name,
+                             "namespace": SCALING_NAMESPACE,
+                             "labels": {SCALING_POD_LABEL: uid}},
+                "spec": {"containers": [{"name": "sleeper", "resources": {
+                    "requests": {"nvidia.com/gpu": 1}}}]},
+                "status": {"phase": "Pending"},
+            })
+        elif not unschedulable and existing is not None:
+            self.api.delete("Pod", scaling_name, SCALING_NAMESPACE)

@@ -1,0 +1,88 @@
+"""Operator: assemble and run the whole system from one Config.
+
+Mirrors pkg/operator/ (Config CRD -> operands for every service,
+SchedulingShard CRD -> one scheduler instance per node-pool shard,
+schedulingshard_types.go:66-95).  In the embedded deployment the operands
+are in-process controllers sharing one API; shards become multiple
+Scheduler instances filtered by the shard's node-pool label selector.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..framework.conf import SchedulerConfig
+from ..scheduler import Scheduler
+from .admission import Admission
+from .binder import Binder
+from .cache_builder import ClusterCache
+from .kubeapi import InMemoryKubeAPI
+from .nodescaleadjuster import NodeScaleAdjuster
+from .podgrouper import PodGrouper
+from .status_controllers import PodGroupController, QueueController
+
+
+@dataclass
+class ShardSpec:
+    """SchedulingShard: one scheduler per node-pool partition."""
+    name: str = "default"
+    node_pool_label: str | None = None    # label key
+    node_pool_value: str | None = None    # label value selecting the pool
+    config: SchedulerConfig = field(default_factory=SchedulerConfig)
+
+
+@dataclass
+class SystemConfig:
+    shards: list = field(default_factory=lambda: [ShardSpec()])
+    require_queue_label: bool = False
+    now_fn: object = None
+
+
+class System:
+    """The full controller fleet over one API server."""
+
+    def __init__(self, config: SystemConfig | None = None,
+                 api: InMemoryKubeAPI | None = None):
+        self.config = config or SystemConfig()
+        self.api = api or InMemoryKubeAPI()
+        now_fn = self.config.now_fn or (lambda: 0.0)
+        # Operands (pkg/operator/operands/*).
+        self.admission = Admission(
+            self.api, require_queue_label=self.config.require_queue_label)
+        self.podgrouper = PodGrouper(self.api)
+        self.podgroup_controller = PodGroupController(self.api)
+        self.queue_controller = QueueController(self.api)
+        self.binder = Binder(self.api)
+        self.scale_adjuster = NodeScaleAdjuster(self.api, now_fn)
+        self.cache = ClusterCache(self.api, now_fn)
+        self.schedulers = []
+        for shard in self.config.shards:
+            cache = ClusterCache(self.api, now_fn)
+            provider = self._shard_provider(cache, shard)
+            self.schedulers.append(
+                Scheduler(provider, shard.config, cache=cache))
+
+    def _shard_provider(self, cache: ClusterCache, shard: ShardSpec):
+        def provider():
+            cluster = cache.snapshot()
+            if shard.node_pool_label:
+                cluster.nodes = {
+                    name: node for name, node in cluster.nodes.items()
+                    if node.labels.get(shard.node_pool_label)
+                    == shard.node_pool_value}
+                # Re-index nodes for the packed tensors.
+                cluster.node_order = sorted(cluster.nodes)
+                for i, name in enumerate(cluster.node_order):
+                    cluster.nodes[name].idx = i
+            return cluster
+        return provider
+
+    def run_cycle(self) -> None:
+        """One end-to-end tick: drain controller events, run every shard's
+        scheduling cycle, drain the binder's work."""
+        self.api.drain()
+        for scheduler in self.schedulers:
+            scheduler.run_once()
+        self.api.drain()
+        self.cache.gc_stale_bind_requests()
+        self.api.drain()

@@ -1,0 +1,123 @@
+"""PodGrouper controller: pods -> PodGroups.
+
+Mirrors pkg/podgrouper/pod_controller.go:70-162: watch pods, walk the owner
+chain to the top owner, look up the kind's grouper (models/groupers.py),
+and create/update the PodGroup object; label the pod with its group (and
+subgroup when the workload defines pod sets).
+"""
+
+from __future__ import annotations
+
+from ..models import group_workload
+from .kubeapi import InMemoryKubeAPI, NotFound
+
+POD_GROUP_LABEL = "kai.scheduler/pod-group"
+SUBGROUP_LABEL = "kai.scheduler/subgroup"
+
+
+class PodGrouper:
+    def __init__(self, api: InMemoryKubeAPI):
+        self.api = api
+        api.watch("Pod", self._on_pod)
+
+    def _on_pod(self, event_type: str, pod: dict) -> None:
+        if event_type == "DELETED":
+            return
+        if pod.get("spec", {}).get("schedulerName",
+                                   "kai-scheduler") != "kai-scheduler":
+            return
+        top_owner, chain = self.resolve_top_owner(pod)
+        meta = group_workload(top_owner, pod, self.api)
+        self._ensure_podgroup(meta, pod)
+
+    def resolve_top_owner(self, pod: dict):
+        """Walk ownerReferences to the root (pkg/podgrouper/topowner/)."""
+        chain = []
+        current = pod
+        ns = pod["metadata"].get("namespace", "default")
+        seen = set()
+        while True:
+            refs = current.get("metadata", {}).get("ownerReferences", [])
+            controller_refs = [r for r in refs if r.get("controller", True)]
+            if not controller_refs:
+                break
+            ref = controller_refs[0]
+            key = (ref.get("kind"), ref.get("name"))
+            if key in seen:
+                break
+            seen.add(key)
+            parent = self.api.get_opt(ref["kind"], ref["name"], ns)
+            if parent is None:
+                # Owner object not stored: synthesize from the reference.
+                parent = {"kind": ref["kind"],
+                          "apiVersion": ref.get("apiVersion", "v1"),
+                          "metadata": {"name": ref["name"],
+                                       "uid": ref.get("uid", "0"),
+                                       "namespace": ns,
+                                       "labels": pod["metadata"].get(
+                                           "labels", {})}}
+                chain.append(parent)
+                current = parent
+                continue
+            chain.append(parent)
+            current = parent
+        return (chain[-1] if chain else pod), chain
+
+    def _ensure_podgroup(self, meta, pod: dict) -> None:
+        existing = self.api.get_opt("PodGroup", meta.name, meta.namespace)
+        desired = {
+            "kind": "PodGroup",
+            "metadata": {"name": meta.name, "namespace": meta.namespace,
+                         "labels": {}},
+            "spec": {
+                "queue": meta.queue,
+                "minMember": meta.min_member,
+                "priorityClassName": meta.priority_class,
+                "priority": meta.priority,
+                "preemptible": meta.preemptible,
+                "podSets": [{"name": ps.name,
+                             "minAvailable": ps.min_available}
+                            for ps in meta.pod_sets],
+                "topology": {
+                    "name": meta.topology_name,
+                    "required": meta.required_topology_level,
+                    "preferred": meta.preferred_topology_level,
+                } if meta.topology_name or meta.required_topology_level
+                or meta.preferred_topology_level else None,
+                "owner": meta.owner,
+            },
+            "status": existing.get("status", {"phase": "Pending"})
+            if existing else {"phase": "Pending"},
+        }
+        if existing is None:
+            self.api.create(desired)
+        elif existing["spec"] != desired["spec"]:
+            existing["spec"] = desired["spec"]
+            self.api.update(existing)
+        # Label the pod with its group (+ subgroup when determinable).
+        labels = pod["metadata"].setdefault("labels", {})
+        changed = labels.get(POD_GROUP_LABEL) != meta.name
+        labels[POD_GROUP_LABEL] = meta.name
+        if meta.pod_sets and SUBGROUP_LABEL not in labels:
+            subgroup = self._infer_subgroup(meta, pod)
+            if subgroup:
+                labels[SUBGROUP_LABEL] = subgroup
+                changed = True
+        if changed:
+            self.api.update(pod)
+
+    @staticmethod
+    def _infer_subgroup(meta, pod: dict) -> str | None:
+        """Match the pod to a pod set by role substring in its name/labels
+        (per-kind groupers label pods with their replica role)."""
+        role = pod["metadata"].get("labels", {}).get(
+            "training.kubeflow.org/replica-type") \
+            or pod["metadata"].get("labels", {}).get("ray.io/node-type")
+        names = [ps.name for ps in meta.pod_sets]
+        if role and role.lower() in names:
+            return role.lower()
+        pod_name = pod["metadata"]["name"].lower()
+        for name in names:
+            if name in pod_name:
+                return name
+        return None

@@ -1,0 +1,40 @@
+"""Resource-reservation agent: runs "inside" the GPU reservation pod.
+
+Mirrors cmd/resourcereservation + pkg/resourcereservation/{discovery,
+patcher,poddetails} (pod_patcher.go:46): the reservation pod discovers
+which physical device it was given (NVML-exposed env in the reference; the
+node's device table here) and patches the device id onto itself so
+fractional pods sharing the group can target the same device.
+"""
+
+from __future__ import annotations
+
+GPU_DEVICE_ANNOTATION = "kai.scheduler/reserved-gpu-device"
+
+
+class ReservationAgent:
+    def __init__(self, api, device_of_pod=None):
+        """device_of_pod: callable(pod) -> device id; defaults to a
+        deterministic per-node counter (the fake NVML)."""
+        self.api = api
+        self.device_of_pod = device_of_pod or self._default_discovery
+        self._per_node_counter: dict[str, int] = {}
+        api.watch("Pod", self._on_pod)
+
+    def _default_discovery(self, pod: dict) -> str:
+        node = pod.get("spec", {}).get("nodeName", "unknown")
+        idx = self._per_node_counter.get(node, 0)
+        self._per_node_counter[node] = idx + 1
+        return f"GPU-{node}-{idx}"
+
+    def _on_pod(self, event_type: str, pod: dict) -> None:
+        if event_type == "DELETED":
+            return
+        labels = pod.get("metadata", {}).get("labels", {})
+        if labels.get("app") != "kai-resource-reservation":
+            return
+        ann = pod["metadata"].setdefault("annotations", {})
+        if GPU_DEVICE_ANNOTATION in ann:
+            return
+        ann[GPU_DEVICE_ANNOTATION] = self.device_of_pod(pod)
+        self.api.update(pod)

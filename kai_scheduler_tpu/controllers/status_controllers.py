@@ -1,0 +1,110 @@
+"""PodGroup and Queue status controllers.
+
+- PodGroupController mirrors pkg/podgroupcontroller/controllers/
+  pod_group_controller.go:56 + status_updater.go:24-62: keep
+  PodGroup.status (phase, pod counts) in sync with observed pods.
+- QueueController mirrors pkg/queuecontroller/: aggregate allocated /
+  requested resources from PodGroups into Queue.status, maintain
+  childQueues back-references, and export queue metrics.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+
+from ..utils.metrics import METRICS
+from .kubeapi import InMemoryKubeAPI
+from .podgrouper import POD_GROUP_LABEL
+
+RUNNING_PHASES = ("Running", "Succeeded")
+
+
+class PodGroupController:
+    def __init__(self, api: InMemoryKubeAPI):
+        self.api = api
+        api.watch("Pod", self._on_pod)
+        api.watch("PodGroup", self._on_podgroup)
+
+    def _on_pod(self, event_type: str, pod: dict) -> None:
+        group = pod.get("metadata", {}).get("labels", {}).get(
+            POD_GROUP_LABEL)
+        if group:
+            pg = self.api.get_opt(
+                "PodGroup", group,
+                pod["metadata"].get("namespace", "default"))
+            if pg is not None:
+                self._reconcile(pg)
+
+    def _on_podgroup(self, event_type: str, pg: dict) -> None:
+        if event_type != "DELETED":
+            self._reconcile(pg)
+
+    def _reconcile(self, pg: dict) -> None:
+        ns = pg["metadata"].get("namespace", "default")
+        pods = [p for p in self.api.list("Pod", namespace=ns)
+                if p["metadata"].get("labels", {}).get(POD_GROUP_LABEL)
+                == pg["metadata"]["name"]]
+        counts = defaultdict(int)
+        for p in pods:
+            counts[p.get("status", {}).get("phase", "Pending")] += 1
+        running = counts["Running"]
+        min_member = pg.get("spec", {}).get("minMember", 1)
+        if counts["Succeeded"] and running == 0 and counts["Pending"] == 0:
+            phase = "Completed"
+        elif running >= min_member:
+            phase = "Running"
+        elif running > 0:
+            phase = "Partial"
+        else:
+            phase = "Pending"
+        status = {"phase": phase,
+                  "running": running,
+                  "pending": counts["Pending"],
+                  "succeeded": counts["Succeeded"],
+                  "failed": counts["Failed"]}
+        if pg.get("status") != status:
+            pg["status"] = status
+            self.api.update(pg)
+
+
+class QueueController:
+    def __init__(self, api: InMemoryKubeAPI):
+        self.api = api
+        api.watch("PodGroup", self._on_change)
+        api.watch("Queue", self._on_change)
+
+    def _on_change(self, event_type: str, obj: dict) -> None:
+        self.reconcile_all()
+
+    def reconcile_all(self) -> None:
+        queues = {q["metadata"]["name"]: q for q in self.api.list("Queue")}
+        # childQueues back-references (childqueues_updater/).
+        children = defaultdict(list)
+        for name, q in queues.items():
+            parent = q.get("spec", {}).get("parentQueue")
+            if parent:
+                children[parent].append(name)
+        # Aggregated allocation from PodGroups (resource_updater/).
+        allocated = defaultdict(lambda: defaultdict(float))
+        requested = defaultdict(lambda: defaultdict(float))
+        for pg in self.api.list("PodGroup"):
+            queue = pg.get("spec", {}).get("queue")
+            if queue not in queues:
+                continue
+            st = pg.get("status", {})
+            running = st.get("running", 0)
+            pending = st.get("pending", 0)
+            allocated[queue]["pods"] += running
+            requested[queue]["pods"] += running + pending
+        for name, q in queues.items():
+            status = {
+                "childQueues": sorted(children.get(name, [])),
+                "allocated": dict(allocated.get(name, {})),
+                "requested": dict(requested.get(name, {})),
+            }
+            if q.get("status") != status:
+                q["status"] = status
+                self.api.update(q)
+            METRICS.set_gauge("queue_allocated_pods",
+                              status["allocated"].get("pods", 0),
+                              queue=name)

@@ -1,0 +1,14 @@
+"""Workload models: the pod-grouping rules for every supported kind.
+
+This is the framework's "model family" layer — the analog of the
+reference's podgrouper plugin hub (pkg/podgrouper/podgrouper/hub/
+hub.go:101-334), which maps ~30 workload GroupVersionKinds to groupers
+that derive PodGroup metadata (gang minimum, queue, priority,
+preemptibility, subgroup structure) from the workload's spec.
+"""
+
+from .groupers import (GROUPER_TABLE, PodGroupMetadata, PodSetSpec,
+                       group_workload, resolve_grouper)
+
+__all__ = ["GROUPER_TABLE", "PodGroupMetadata", "PodSetSpec",
+           "group_workload", "resolve_grouper"]

@@ -1,0 +1,388 @@
+"""Grouper table: workload kind -> PodGroup metadata.
+
+Re-implements the behavior of pkg/podgrouper/podgrouper/hub/hub.go:101-334
+and its per-kind plugins (pkg/podgrouper/podgrouper/plugins/*): given a
+pod's top owner object, derive the PodGroup that should schedule it —
+gang minimum, queue, priority class, preemptibility, pod sets / subgroup
+hierarchy, and topology constraints.
+
+Workload objects are manifest-shaped dicts ({"kind", "apiVersion",
+"metadata", "spec"}).  The table is keyed by (group, kind) with version
+wildcards, exactly like the reference's GVK map.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+QUEUE_LABEL = "kai.scheduler/queue"
+NODE_POOL_LABEL = "kai.scheduler/node-pool"
+MIN_AVAILABLE_ANNOTATION = "kai.scheduler/min-available"
+TOPOLOGY_ANNOTATION = "kai.scheduler/topology"
+TOPOLOGY_REQUIRED_ANNOTATION = "kai.scheduler/topology-required-placement"
+TOPOLOGY_PREFERRED_ANNOTATION = "kai.scheduler/topology-preferred-placement"
+DEFAULT_QUEUE = "default"
+
+# Priority-class defaults per workload family (defaultgrouper
+# calcPriorityClassWithDefaults; values follow the scheduler's well-known
+# classes: train is preemptible, build/interactive and inference are not).
+TRAIN = ("train", 50, True)
+BUILD = ("build", 100, False)
+INFERENCE = ("inference", 125, False)
+
+PRIORITY_CLASS_VALUES = {"train": 50, "build": 100, "interactive": 100,
+                         "inference": 125}
+
+
+@dataclass
+class PodSetSpec:
+    name: str
+    min_available: int
+    parent: str | None = None
+
+
+@dataclass
+class PodGroupMetadata:
+    name: str
+    namespace: str = "default"
+    queue: str = DEFAULT_QUEUE
+    priority_class: str = "train"
+    priority: int = 50
+    preemptible: bool = True
+    min_member: int = 1
+    pod_sets: list = field(default_factory=list)      # [PodSetSpec]
+    subgroup_tree: list = field(default_factory=list)  # [SubGroupNode-like]
+    topology_name: str | None = None
+    required_topology_level: str | None = None
+    preferred_topology_level: str | None = None
+    owner: dict | None = None
+
+
+def _md(obj) -> dict:
+    return obj.get("metadata", {})
+
+
+def _labels(obj) -> dict:
+    return _md(obj).get("labels", {})
+
+
+def _annotations(obj) -> dict:
+    return _md(obj).get("annotations", {})
+
+
+def _spec(obj) -> dict:
+    return obj.get("spec", {})
+
+
+def _base(owner: dict, pod: dict | None,
+          defaults=TRAIN) -> PodGroupMetadata:
+    """defaultgrouper.GetPodGroupMetadata: name pg-<owner>-<uid>, queue from
+    the queue label (owner first, then pod), priority from the explicit
+    priorityClassName or the family default."""
+    md = _md(owner)
+    name = f"pg-{md.get('name', 'unknown')}-{md.get('uid', '0')}"
+    queue = (_labels(owner).get(QUEUE_LABEL)
+             or (pod and _labels(pod).get(QUEUE_LABEL))
+             or DEFAULT_QUEUE)
+    pclass, prio, preemptible = defaults
+    explicit = (_spec(owner).get("priorityClassName")
+                or (pod and _spec(pod).get("priorityClassName")))
+    if explicit:
+        pclass = explicit
+        prio = PRIORITY_CLASS_VALUES.get(explicit, prio)
+        preemptible = explicit == "train" or explicit not in \
+            PRIORITY_CLASS_VALUES and preemptible
+    meta = PodGroupMetadata(
+        name=name, namespace=md.get("namespace", "default"), queue=queue,
+        priority_class=pclass, priority=prio, preemptible=preemptible,
+        owner={"kind": owner.get("kind"), "name": md.get("name"),
+               "uid": md.get("uid")})
+    ann = _annotations(owner)
+    if MIN_AVAILABLE_ANNOTATION in ann:
+        meta.min_member = int(ann[MIN_AVAILABLE_ANNOTATION])
+    meta.topology_name = ann.get(TOPOLOGY_ANNOTATION)
+    meta.required_topology_level = ann.get(TOPOLOGY_REQUIRED_ANNOTATION)
+    meta.preferred_topology_level = ann.get(TOPOLOGY_PREFERRED_ANNOTATION)
+    return meta
+
+
+# --------------------------------------------------------------------------
+# per-kind groupers
+# --------------------------------------------------------------------------
+
+def default_grouper(owner, pod, api=None):
+    return _base(owner, pod)
+
+
+def deployment_grouper(owner, pod, api=None):
+    """apps/v1 Deployment (plugins/deployment): each replica is an
+    independent inference-style pod group (no gang across replicas)."""
+    meta = _base(owner, pod, defaults=INFERENCE)
+    if pod is not None:
+        meta.name = f"pg-{_md(pod).get('name')}-{_md(pod).get('uid', '0')}"
+    meta.min_member = 1
+    return meta
+
+
+def k8s_job_grouper(owner, pod, api=None):
+    """batch/v1 Job (plugins/job): one pod group for the whole job;
+    gang only when explicitly annotated."""
+    meta = _base(owner, pod)
+    return meta
+
+
+def cronjob_grouper(owner, pod, api=None):
+    """batch/v1 CronJob (plugins/cronjobs): group per spawned Job run."""
+    meta = _base(owner, pod)
+    if pod is not None:
+        for ref in _md(pod).get("ownerReferences", []):
+            if ref.get("kind") == "Job":
+                meta.name = f"pg-{ref['name']}-{ref.get('uid', '0')}"
+    return meta
+
+
+def _replica_specs_min_member(owner, specs_key: str = "replicaSpecs"):
+    spec = _spec(owner)
+    run_policy = spec.get("runPolicy", {})
+    min_available = run_policy.get("schedulingPolicy", {}).get(
+        "minAvailable")
+    specs = (spec.get(specs_key) or spec.get("tfReplicaSpecs")
+             or spec.get("pytorchReplicaSpecs") or spec.get("xgbReplicaSpecs")
+             or spec.get("jaxReplicaSpecs") or spec.get("mpiReplicaSpecs")
+             or {})
+    total = 0
+    pod_sets = []
+    for role, rs in specs.items():
+        replicas = int(rs.get("replicas", 1))
+        total += replicas
+        pod_sets.append(PodSetSpec(role.lower(), replicas))
+    if min_available is not None:
+        return int(min_available), []
+    return max(total, 1), pod_sets
+
+
+def kubeflow_grouper(owner, pod, api=None):
+    """kubeflow.org TFJob/PyTorchJob/XGBoostJob/JAXJob
+    (plugins/kubeflow + per-kind wrappers): gang over all replicas unless
+    runPolicy.schedulingPolicy.minAvailable overrides."""
+    meta = _base(owner, pod)
+    meta.min_member, meta.pod_sets = _replica_specs_min_member(owner)
+    return meta
+
+
+def mpi_grouper(owner, pod, api=None):
+    """kubeflow MPIJob v1/v2beta1 (plugins/mpi): launcher + workers gang."""
+    meta = _base(owner, pod)
+    spec = _spec(owner)
+    specs = spec.get("mpiReplicaSpecs", {})
+    total, pod_sets = 0, []
+    for role, rs in specs.items():
+        replicas = int(rs.get("replicas", 1))
+        total += replicas
+        pod_sets.append(PodSetSpec(role.lower(), replicas))
+    min_available = spec.get("runPolicy", {}).get(
+        "schedulingPolicy", {}).get("minAvailable")
+    meta.min_member = int(min_available) if min_available else max(total, 1)
+    meta.pod_sets = pod_sets if not min_available else []
+    return meta
+
+
+def notebook_grouper(owner, pod, api=None):
+    """kubeflow Notebook (plugins/notebook): interactive, non-preemptible."""
+    return _base(owner, pod, defaults=BUILD)
+
+
+def ray_grouper(owner, pod, api=None):
+    """ray.io RayCluster/RayJob/RayService (plugins/ray): gang = head +
+    sum of workerGroup minReplicas; RayJob/RayService wrap a cluster spec."""
+    meta = _base(owner, pod)
+    spec = _spec(owner)
+    cluster = (spec.get("rayClusterSpec") or spec.get("rayClusterConfig")
+               or spec)
+    workers = 0
+    for wg in cluster.get("workerGroupSpecs", []) or []:
+        workers += int(wg.get("minReplicas", wg.get("replicas", 0)))
+    meta.min_member = 1 + workers  # head node + workers
+    meta.pod_sets = [PodSetSpec("head", 1)] + (
+        [PodSetSpec("workers", workers)] if workers else [])
+    return meta
+
+
+def jobset_grouper(owner, pod, api=None):
+    """jobset.x-k8s.io JobSet (plugins/jobset): gang across replicated
+    jobs (replicas x parallelism each)."""
+    meta = _base(owner, pod)
+    total = 0
+    pod_sets = []
+    for rj in _spec(owner).get("replicatedJobs", []) or []:
+        replicas = int(rj.get("replicas", 1))
+        parallelism = int(rj.get("template", {}).get("spec", {})
+                          .get("parallelism", 1))
+        count = replicas * parallelism
+        total += count
+        pod_sets.append(PodSetSpec(rj.get("name", "job"), count))
+    meta.min_member = max(total, 1)
+    meta.pod_sets = pod_sets
+    return meta
+
+
+def lws_grouper(owner, pod, api=None):
+    """leaderworkerset.x-k8s.io LeaderWorkerSet (plugins/leader_worker_set):
+    each replica group is a gang of size leaderWorkerTemplate.size."""
+    meta = _base(owner, pod)
+    size = int(_spec(owner).get("leaderWorkerTemplate", {}).get("size", 1))
+    meta.min_member = size
+    # One group per LWS replica index; the pod's group index label picks it.
+    if pod is not None:
+        idx = _labels(pod).get("leaderworkerset.sigs.k8s.io/group-index",
+                               "0")
+        meta.name = f"{meta.name}-{idx}"
+    return meta
+
+
+def grove_grouper(owner, pod, api=None):
+    """grove.io PodGangSet/PodCliqueSet (plugins/grove): hierarchical gangs
+    — each clique is a podset with its own minimum under one gang tree."""
+    meta = _base(owner, pod)
+    spec = _spec(owner)
+    cliques = (spec.get("template", {}).get("cliques")
+               or spec.get("cliques") or [])
+    total = 0
+    pod_sets = []
+    for clique in cliques:
+        name = clique.get("name", f"clique{len(pod_sets)}")
+        cspec = clique.get("spec", clique)
+        n = int(cspec.get("minReplicas", cspec.get("replicas", 1)))
+        total += n
+        pod_sets.append(PodSetSpec(name, n))
+    meta.min_member = max(total, 1)
+    meta.pod_sets = pod_sets
+    return meta
+
+
+def spark_grouper(owner, pod, api=None):
+    """Spark driver/executor pods (plugins/spark): driver first, one group
+    per application id."""
+    meta = _base(owner, pod, defaults=TRAIN)
+    if pod is not None:
+        app = _labels(pod).get("spark-app-selector")
+        if app:
+            meta.name = f"pg-spark-{app}"
+    return meta
+
+
+def pod_grouper(owner, pod, api=None):
+    """Bare pods (plugins/podjob): a pod group per pod; spark pods route to
+    the spark grouper."""
+    if pod is not None and _labels(pod).get("spark-app-selector"):
+        return spark_grouper(owner, pod, api)
+    meta = _base(owner, pod)
+    meta.min_member = 1
+    return meta
+
+
+def knative_grouper(owner, pod, api=None):
+    """serving.knative.dev Service (plugins/knative): inference service;
+    optional gang per revision."""
+    return _base(owner, pod, defaults=INFERENCE)
+
+
+def kubevirt_grouper(owner, pod, api=None):
+    """kubevirt.io VirtualMachineInstance: interactive VM."""
+    return _base(owner, pod, defaults=BUILD)
+
+
+def aml_grouper(owner, pod, api=None):
+    return _base(owner, pod)
+
+
+def spotrequest_grouper(owner, pod, api=None):
+    return _base(owner, pod)
+
+
+def skip_top_owner_grouper(owner, pod, api=None):
+    """Argo Workflow / TrainJob / DynamoGraphDeployment
+    (plugins/skiptopowner): the top owner only carries metadata; group by
+    the NEXT owner in the pod's chain using its kind's grouper."""
+    if pod is not None:
+        for ref in _md(pod).get("ownerReferences", []):
+            if ref.get("kind") != owner.get("kind"):
+                child = None
+                if api is not None:
+                    child = api.get_opt(ref["kind"], ref["name"],
+                                        _md(pod).get("namespace", "default"))
+                if child is None:
+                    child = {"kind": ref.get("kind"),
+                             "apiVersion": ref.get("apiVersion", "v1"),
+                             "metadata": {"name": ref["name"],
+                                          "uid": ref.get("uid", "0"),
+                                          "namespace": _md(pod).get(
+                                              "namespace", "default"),
+                                          "labels": _labels(owner)}}
+                grouper = resolve_grouper(child.get("apiVersion", "v1"),
+                                          child.get("kind", "Pod"))
+                meta = grouper(child, pod, api)
+                # Queue/topology metadata propagates from the true top owner.
+                if _labels(owner).get(QUEUE_LABEL):
+                    meta.queue = _labels(owner)[QUEUE_LABEL]
+                return meta
+    return _base(owner, pod)
+
+
+# --------------------------------------------------------------------------
+# the table (hub.go:122-334)
+# --------------------------------------------------------------------------
+
+GROUPER_TABLE = {
+    ("apps", "Deployment"): deployment_grouper,
+    ("apps", "StatefulSet"): default_grouper,
+    ("apps", "ReplicaSet"): default_grouper,
+    ("batch", "Job"): k8s_job_grouper,
+    ("batch", "CronJob"): cronjob_grouper,
+    ("", "Pod"): pod_grouper,
+    ("machinelearning.seldon.io", "SeldonDeployment"): default_grouper,
+    ("kubevirt.io", "VirtualMachineInstance"): kubevirt_grouper,
+    ("kubeflow.org", "TFJob"): kubeflow_grouper,
+    ("kubeflow.org", "PyTorchJob"): kubeflow_grouper,
+    ("kubeflow.org", "XGBoostJob"): kubeflow_grouper,
+    ("kubeflow.org", "JAXJob"): kubeflow_grouper,
+    ("kubeflow.org", "MPIJob"): mpi_grouper,
+    ("kubeflow.org", "Notebook"): notebook_grouper,
+    ("kubeflow.org", "ScheduledWorkflow"): default_grouper,
+    ("trainer.kubeflow.org", "TrainJob"): skip_top_owner_grouper,
+    ("ray.io", "RayCluster"): ray_grouper,
+    ("ray.io", "RayJob"): ray_grouper,
+    ("ray.io", "RayService"): ray_grouper,
+    ("jobset.x-k8s.io", "JobSet"): jobset_grouper,
+    ("leaderworkerset.x-k8s.io", "LeaderWorkerSet"): lws_grouper,
+    ("grove.io", "PodGangSet"): grove_grouper,
+    ("grove.io", "PodCliqueSet"): grove_grouper,
+    ("nvidia.com", "DynamoGraphDeployment"): skip_top_owner_grouper,
+    ("argoproj.io", "Workflow"): skip_top_owner_grouper,
+    ("serving.knative.dev", "Service"): knative_grouper,
+    ("sparkoperator.k8s.io", "SparkApplication"): spark_grouper,
+    ("amlarc.azureml.com", "AmlJob"): aml_grouper,
+    ("workspace.devfile.io", "DevWorkspace"): default_grouper,
+    ("tekton.dev", "PipelineRun"): default_grouper,
+    ("tekton.dev", "TaskRun"): default_grouper,
+    ("egx.nvidia.io", "SPOTRequest"): spotrequest_grouper,
+    ("run.ai", "RunaiJob"): k8s_job_grouper,
+    ("run.ai", "TrainingWorkload"): skip_top_owner_grouper,
+    ("run.ai", "InferenceWorkload"): skip_top_owner_grouper,
+    ("run.ai", "DistributedWorkload"): skip_top_owner_grouper,
+    ("run.ai", "InteractiveWorkload"): skip_top_owner_grouper,
+    ("run.ai", "DistributedInferenceWorkload"): skip_top_owner_grouper,
+}
+
+
+def resolve_grouper(api_version: str, kind: str):
+    group = api_version.split("/")[0] if "/" in api_version else ""
+    return GROUPER_TABLE.get((group, kind), default_grouper)
+
+
+def group_workload(owner: dict, pod: dict | None = None,
+                   api=None) -> PodGroupMetadata:
+    """Entry point: derive PodGroup metadata for a pod's top owner."""
+    grouper = resolve_grouper(owner.get("apiVersion", "v1"),
+                              owner.get("kind", "Pod"))
+    return grouper(owner, pod, api)

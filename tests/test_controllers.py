@@ -1,0 +1,239 @@
+"""Controller-fleet tests: the envtest-ring analog — pods flow through
+admission -> podgrouper -> scheduler -> binder over the in-memory API
+(reference: pkg/env-tests/, pkg/binder|podgrouper integration_tests)."""
+
+import pytest
+
+from kai_scheduler_tpu.controllers import (Admission, AdmissionError,
+                                           InMemoryKubeAPI, System,
+                                           SystemConfig, make_pod, owner_ref)
+from kai_scheduler_tpu.controllers.resourcereservation import (
+    GPU_DEVICE_ANNOTATION, ReservationAgent)
+from kai_scheduler_tpu.models import group_workload
+
+
+def make_node(api, name, gpu=8, cpu="32", mem="256Gi", labels=None):
+    api.create({"kind": "Node",
+                "metadata": {"name": name, "labels": labels or {}},
+                "spec": {},
+                "status": {"allocatable": {"cpu": cpu, "memory": mem,
+                                           "nvidia.com/gpu": gpu,
+                                           "pods": 110}}})
+
+
+def make_queue(api, name, deserved=None, parent=None):
+    api.create({"kind": "Queue", "metadata": {"name": name},
+                "spec": {"deserved": deserved, "parentQueue": parent}})
+
+
+class TestGroupers:
+    def test_pytorch_job_gang(self):
+        owner = {"kind": "PyTorchJob", "apiVersion": "kubeflow.org/v1",
+                 "metadata": {"name": "train", "uid": "u1",
+                              "labels": {"kai.scheduler/queue": "team-a"}},
+                 "spec": {"pytorchReplicaSpecs": {
+                     "Master": {"replicas": 1},
+                     "Worker": {"replicas": 3}}}}
+        meta = group_workload(owner)
+        assert meta.min_member == 4
+        assert meta.queue == "team-a"
+        assert {ps.name: ps.min_available for ps in meta.pod_sets} == \
+            {"master": 1, "worker": 3}
+
+    def test_ray_cluster_min_replicas(self):
+        owner = {"kind": "RayCluster", "apiVersion": "ray.io/v1",
+                 "metadata": {"name": "rc", "uid": "u2"},
+                 "spec": {"workerGroupSpecs": [
+                     {"minReplicas": 2, "replicas": 4},
+                     {"minReplicas": 1}]}}
+        meta = group_workload(owner)
+        assert meta.min_member == 4  # head + 2 + 1
+
+    def test_jobset(self):
+        owner = {"kind": "JobSet", "apiVersion": "jobset.x-k8s.io/v1alpha2",
+                 "metadata": {"name": "js", "uid": "u3"},
+                 "spec": {"replicatedJobs": [
+                     {"name": "driver", "replicas": 1},
+                     {"name": "workers", "replicas": 2,
+                      "template": {"spec": {"parallelism": 4}}}]}}
+        meta = group_workload(owner)
+        assert meta.min_member == 9
+
+    def test_deployment_per_pod_groups(self):
+        owner = {"kind": "Deployment", "apiVersion": "apps/v1",
+                 "metadata": {"name": "web", "uid": "u4"},
+                 "spec": {"replicas": 3}}
+        pod = make_pod("web-abc123", owner=owner_ref("Deployment", "web"))
+        meta = group_workload(owner, pod)
+        assert meta.min_member == 1
+        assert "web-abc123" in meta.name
+        assert not meta.preemptible  # inference default
+
+    def test_grove_hierarchical(self):
+        owner = {"kind": "PodGangSet", "apiVersion": "grove.io/v1alpha1",
+                 "metadata": {"name": "gang", "uid": "u5"},
+                 "spec": {"template": {"cliques": [
+                     {"name": "prefill", "spec": {"minReplicas": 2}},
+                     {"name": "decode", "spec": {"minReplicas": 4}}]}}}
+        meta = group_workload(owner)
+        assert meta.min_member == 6
+        assert [ps.name for ps in meta.pod_sets] == ["prefill", "decode"]
+
+    def test_skip_top_owner_argo(self):
+        api = InMemoryKubeAPI()
+        wf = {"kind": "Workflow", "apiVersion": "argoproj.io/v1alpha1",
+              "metadata": {"name": "wf", "uid": "u6",
+                           "labels": {"kai.scheduler/queue": "batch"}},
+              "spec": {}}
+        pod = make_pod("wf-step-1", owner=owner_ref("Pod", "step"))
+        pod["metadata"]["ownerReferences"] = [
+            owner_ref("Job", "wf-step", api_version="batch/v1")]
+        meta = group_workload(wf, pod, api)
+        # Grouped by the inner Job, but the workflow's queue propagates.
+        assert meta.queue == "batch"
+
+
+class TestAdmission:
+    def test_fraction_normalization(self):
+        adm = Admission()
+        pod = make_pod("p1", gpu=1, annotations={"gpu-fraction": "0.5"})
+        adm.mutate(pod)
+        reqs = pod["spec"]["containers"][0]["resources"]["requests"]
+        assert "nvidia.com/gpu" not in reqs
+        assert pod["spec"]["schedulerName"] == "kai-scheduler"
+
+    def test_invalid_fraction_rejected(self):
+        adm = Admission()
+        for bad in ("1.5", "0", "abc"):
+            pod = make_pod("p1", annotations={"gpu-fraction": bad})
+            with pytest.raises(AdmissionError):
+                adm.validate(pod)
+
+    def test_fraction_and_memory_exclusive(self):
+        adm = Admission()
+        pod = make_pod("p1", annotations={"gpu-fraction": "0.5",
+                                          "gpu-memory": "8Gi"})
+        with pytest.raises(AdmissionError):
+            adm.validate(pod)
+
+
+class TestEndToEnd:
+    def _system(self):
+        system = System(SystemConfig())
+        make_node(system.api, "n1", gpu=8)
+        make_node(system.api, "n2", gpu=8)
+        make_queue(system.api, "team-a",
+                   deserved=dict(cpu="64", memory="512Gi", gpu=16))
+        return system
+
+    def test_pytorch_job_flows_to_bound_pods(self):
+        system = self._system()
+        api = system.api
+        job = {"kind": "PyTorchJob", "apiVersion": "kubeflow.org/v1",
+               "metadata": {"name": "train", "uid": "tj1",
+                            "labels": {"kai.scheduler/queue": "team-a"}},
+               "spec": {"pytorchReplicaSpecs": {"Master": {"replicas": 1},
+                                                "Worker": {"replicas": 2}}}}
+        api.create(job)
+        ref = owner_ref("PyTorchJob", "train", uid="tj1",
+                        api_version="kubeflow.org/v1")
+        for i, role in enumerate(["master", "worker", "worker"]):
+            pod = make_pod(f"train-{role}-{i}", owner=ref, gpu=2,
+                           labels={"training.kubeflow.org/replica-type":
+                                   role})
+            api.create(pod)
+
+        system.run_cycle()
+
+        pgs = api.list("PodGroup")
+        assert len(pgs) == 1
+        assert pgs[0]["spec"]["minMember"] == 3
+        bound = [p for p in api.list("Pod")
+                 if p["spec"].get("nodeName")
+                 and p["metadata"]["namespace"] == "default"]
+        assert len(bound) == 3
+        brs = api.list("BindRequest")
+        assert all(br["status"]["phase"] == "Succeeded" for br in brs)
+        # PodGroup status converges to Running.
+        system.run_cycle()
+        assert api.list("PodGroup")[0]["status"]["phase"] == "Running"
+
+    def test_gang_too_big_stays_pending(self):
+        system = self._system()
+        api = system.api
+        job = {"kind": "PyTorchJob", "apiVersion": "kubeflow.org/v1",
+               "metadata": {"name": "big", "uid": "tj2",
+                            "labels": {"kai.scheduler/queue": "team-a"}},
+               "spec": {"pytorchReplicaSpecs": {"Worker": {"replicas": 3}}}}
+        api.create(job)
+        ref = owner_ref("PyTorchJob", "big", uid="tj2",
+                        api_version="kubeflow.org/v1")
+        for i in range(3):
+            api.create(make_pod(f"big-worker-{i}", owner=ref, gpu=8,
+                                labels={"training.kubeflow.org/"
+                                        "replica-type": "worker"}))
+        system.run_cycle()
+        bound = [p for p in api.list("Pod") if p["spec"].get("nodeName")]
+        # 3x8 GPUs > 16 available: gang must not partially bind.
+        assert bound == []
+
+    def test_fractional_pod_creates_reservation(self):
+        system = self._system()
+        agent = ReservationAgent(system.api)
+        api = system.api
+        pod = make_pod("frac-1", annotations={"gpu-fraction": "0.5"},
+                       queue="team-a")
+        api.create(pod)
+        system.run_cycle()
+        reservations = api.list("Pod",
+                                namespace="kai-resource-reservation")
+        assert len(reservations) == 1
+        assert GPU_DEVICE_ANNOTATION in \
+            reservations[0]["metadata"]["annotations"]
+        p = api.get("Pod", "frac-1")
+        assert p["spec"].get("nodeName")
+        assert p["metadata"]["annotations"].get("kai.scheduler/gpu-group")
+
+    def test_queue_status_aggregation(self):
+        system = self._system()
+        api = system.api
+        api.create(make_pod("solo", queue="team-a", gpu=1))
+        system.run_cycle()
+        system.run_cycle()
+        q = api.get("Queue", "team-a")
+        assert q["status"]["allocated"].get("pods") == 1
+
+    def test_scale_adjuster_creates_scaling_pod(self):
+        system = self._system()
+        api = system.api
+        # A fractional pod that can't schedule (no GPUs at all).
+        for node in api.list("Node"):
+            node["status"]["allocatable"]["nvidia.com/gpu"] = 0
+            api.update(node)
+        api.create(make_pod("frac-stuck",
+                            annotations={"gpu-fraction": "0.5"},
+                            queue="team-a"))
+        system.run_cycle()
+        scaling = api.list("Pod", namespace="kai-scale-adjust")
+        assert len(scaling) == 1
+        reqs = scaling[0]["spec"]["containers"][0]["resources"]["requests"]
+        assert reqs["nvidia.com/gpu"] == 1
+
+
+class TestShards:
+    def test_node_pool_partition(self):
+        from kai_scheduler_tpu.controllers import ShardSpec
+        config = SystemConfig(shards=[
+            ShardSpec("pool-a", "pool", "a"),
+            ShardSpec("pool-b", "pool", "b"),
+        ])
+        system = System(config)
+        api = system.api
+        make_node(api, "a1", labels={"pool": "a"})
+        make_node(api, "b1", labels={"pool": "b"})
+        make_queue(api, "q")
+        api.create(make_pod("pod-a", queue="q", gpu=1,
+                            node_selector={"pool": "a"}))
+        system.run_cycle()
+        p = api.get("Pod", "pod-a")
+        assert p["spec"].get("nodeName") == "a1"
