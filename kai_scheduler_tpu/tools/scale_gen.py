@@ -1,0 +1,122 @@
+"""Scale harness: synthetic clusters + the scale-test scenarios.
+
+The KWOK-ring analog (docs/scale-tests/README.md, test/e2e/scale/
+kwok_test.go:128-520): generate virtual clusters of N nodes and pending-job
+waves, run the scenarios the reference measures (cluster fill, whole-GPU
+allocation, distributed gangs, reclaim latency, burst), and log durations.
+
+Usage:
+  python -m kai_scheduler_tpu.tools.scale_gen --nodes 500 --scenario fill
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+from ..framework import SchedulerConfig
+from ..scheduler import Scheduler
+from ..utils.cluster_spec import build_cluster
+
+
+def gen_spec(n_nodes: int, n_queues: int = 4, seed: int = 0,
+             gpu_per_node: int = 8) -> dict:
+    rng = np.random.default_rng(seed)
+    nodes = {f"node-{i:05d}": {
+        "gpu": gpu_per_node, "cpu": "64", "mem": "512Gi",
+        "labels": {"zone": f"z{i % 8}", "rack": f"r{i % 64}"}}
+        for i in range(n_nodes)}
+    total_gpu = n_nodes * gpu_per_node
+    queues = {f"q{i}": {"deserved": dict(
+        cpu=str(64 * n_nodes // n_queues),
+        memory=f"{512 * n_nodes // n_queues}Gi",
+        gpu=total_gpu // n_queues)} for i in range(n_queues)}
+    return {"nodes": nodes, "queues": queues, "jobs": {},
+            "topologies": {"dc": {"levels": ["zone", "rack"]}}}
+
+
+def add_job_wave(spec: dict, count: int, gpus: int = 1, gang: int = 1,
+                 prefix: str = "job", seed: int = 0) -> None:
+    rng = np.random.default_rng(seed)
+    queues = list(spec["queues"])
+    for i in range(count):
+        spec["jobs"][f"{prefix}-{i:06d}"] = {
+            "queue": queues[int(rng.integers(len(queues)))],
+            "min_available": gang,
+            "tasks": [{"gpu": gpus, "cpu": "1", "mem": "1Gi"}] * gang,
+        }
+
+
+def run_scenario(scenario: str, n_nodes: int, seed: int = 0) -> dict:
+    spec = gen_spec(n_nodes, seed=seed)
+    gpu_capacity = n_nodes * 8
+
+    if scenario == "fill":
+        add_job_wave(spec, gpu_capacity, gpus=1, prefix="fill", seed=seed)
+    elif scenario == "whole-gpu":
+        add_job_wave(spec, n_nodes, gpus=8, prefix="whole", seed=seed)
+    elif scenario == "distributed":
+        add_job_wave(spec, n_nodes // 4, gpus=8, gang=4, prefix="dist",
+                     seed=seed)
+    elif scenario == "burst":
+        add_job_wave(spec, gpu_capacity * 2, gpus=1, prefix="burst",
+                     seed=seed)
+    elif scenario == "reclaim":
+        # Fill from one queue, then measure a starved queue reclaiming.
+        add_job_wave(spec, gpu_capacity, gpus=1, prefix="hog", seed=seed)
+        for j in spec["jobs"].values():
+            j["queue"] = "q0"
+    else:
+        raise SystemExit(f"unknown scenario {scenario!r}")
+
+    cluster = build_cluster(spec)
+    sched = Scheduler(lambda: cluster, SchedulerConfig())
+    t0 = time.perf_counter()
+    ssn = sched.run_once()
+    first_cycle = time.perf_counter() - t0
+
+    result = {"scenario": scenario, "nodes": n_nodes,
+              "jobs": len(spec["jobs"]),
+              "first_cycle_s": round(first_cycle, 3),
+              "pods_bound": len(ssn.cache.bound)}
+
+    if scenario == "reclaim":
+        # The fill wave (all in q0) is now allocated; inject a starved
+        # queue's jobs into the live cluster and measure the reclaim cycle.
+        from ..api.podgroup_info import PodGroupInfo
+        from ..api.pod_info import PodInfo
+        from ..api.resources import ResourceRequirements
+        for i in range(8):
+            pg = PodGroupInfo(f"starved-{i}", f"starved-{i}",
+                              queue_id="q1")
+            pg.add_task(PodInfo(
+                uid=f"starved-{i}-0", name=f"starved-{i}-0",
+                res_req=ResourceRequirements.from_spec("1", "1Gi", 4)))
+            cluster.podgroups[pg.uid] = pg
+        t1 = time.perf_counter()
+        ssn2 = sched.run_once()
+        result["reclaim_cycle_s"] = round(time.perf_counter() - t1, 3)
+        result["evictions"] = len(ssn2.cache.evicted)
+    else:
+        t1 = time.perf_counter()
+        sched.run_once()
+        result["steady_cycle_s"] = round(time.perf_counter() - t1, 3)
+    return result
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=500)
+    ap.add_argument("--scenario", default="fill",
+                    choices=("fill", "whole-gpu", "distributed", "burst",
+                             "reclaim"))
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+    print(json.dumps(run_scenario(args.scenario, args.nodes, args.seed)))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,118 @@
+"""Snapshot tool: deterministic offline replay of one scheduling cycle.
+
+Mirrors cmd/snapshot-tool (main.go:35-60): load a snapshot produced by the
+snapshot plugin (plugins/snapshot_plugin.dump_cluster), rebuild the cluster
+state, run the configured actions through the real framework, and report
+what would have happened — with optional per-phase timing for profiling.
+
+Usage:
+  python -m kai_scheduler_tpu.tools.snapshot_tool --input snap.json [--time]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+from ..api import (ClusterInfo, NodeInfo, PodGroupInfo, PodInfo, PodSet,
+                   PodStatus, QueueInfo, QueueQuota)
+from ..api.resources import ResourceRequirements
+from ..framework import SchedulerConfig
+from ..scheduler import Scheduler
+
+
+def load_cluster(snapshot: dict) -> tuple[ClusterInfo, SchedulerConfig]:
+    nodes = {}
+    for n in snapshot.get("nodes", []):
+        nodes[n["name"]] = NodeInfo(
+            n["name"], np.array(n["allocatable"], float),
+            labels=n.get("labels", {}), taints=set(n.get("taints", ())),
+            gpu_memory_per_device=n.get("gpu_memory_per_device", 0.0),
+            max_pods=n.get("max_pods", 110))
+    queues = {}
+    for q in snapshot.get("queues", []):
+        queues[q["uid"]] = QueueInfo(
+            q["uid"], name=q.get("name", q["uid"]), parent=q.get("parent"),
+            priority=q.get("priority", 0),
+            creation_ts=q.get("creation_ts", 0.0),
+            quota=QueueQuota(
+                deserved=np.array(q["deserved"], float),
+                limit=np.array(q["limit"], float),
+                over_quota_weight=np.array(q["over_quota_weight"], float)))
+    for name, q in queues.items():
+        if q.parent and q.parent in queues:
+            queues[q.parent].children.append(name)
+    podgroups = {}
+    for pg_d in snapshot.get("podgroups", []):
+        pg = PodGroupInfo(
+            pg_d["uid"], pg_d["name"], namespace=pg_d.get("namespace",
+                                                          "default"),
+            queue_id=pg_d.get("queue", "default"),
+            priority=pg_d.get("priority", 0),
+            preemptible=pg_d.get("preemptible", True))
+        if pg_d.get("pod_sets"):
+            pg.set_pod_sets([PodSet(ps["name"], ps["min_available"])
+                             for ps in pg_d["pod_sets"]])
+        for p in pg_d.get("pods", []):
+            req = np.array(p["req"], float)
+            task = PodInfo(
+                uid=p["uid"], name=p["name"],
+                namespace=pg_d.get("namespace", "default"),
+                subgroup=p.get("subgroup", "default"),
+                status=PodStatus[p.get("status", "PENDING").upper()],
+                node_name=p.get("node", ""),
+                node_selector=p.get("node_selector", {}),
+                tolerations=set(p.get("tolerations", ())),
+                res_req=ResourceRequirements(base=req))
+            pg.add_task(task)
+        podgroups[pg.uid] = pg
+    config_d = snapshot.get("config", {})
+    config = SchedulerConfig(k_value=config_d.get("k_value", 1.0))
+    if config_d.get("actions"):
+        config.actions = list(config_d["actions"])
+    return ClusterInfo(nodes, podgroups, queues,
+                       now=snapshot.get("now", 0.0)), config
+
+
+def replay(snapshot: dict, show_timing: bool = False) -> dict:
+    cluster, config = load_cluster(snapshot)
+    sched = Scheduler(lambda: cluster, config)
+    t0 = time.perf_counter()
+    ssn = sched.run_once()
+    elapsed = (time.perf_counter() - t0) * 1000.0
+    report = {
+        "cycle_ms": round(elapsed, 2),
+        "bind_requests": [
+            {"pod": br.pod_name, "node": br.node_name}
+            for br in ssn.cluster.bind_requests],
+        "evictions": list(ssn.cache.evicted),
+        "events": [{"reason": k, "message": m} for k, m in
+                   ssn.cache.events],
+        "fit_errors": {pg.name: pg.fit_errors
+                       for pg in ssn.cluster.podgroups.values()
+                       if pg.fit_errors},
+    }
+    if show_timing:
+        from ..utils.metrics import METRICS
+        report["action_latency_ms"] = {
+            name: round(h.mean, 2)
+            for name, h in METRICS.histograms.items()
+            if name.startswith("action_")}
+    return report
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--input", required=True)
+    ap.add_argument("--time", action="store_true")
+    args = ap.parse_args(argv)
+    with open(args.input) as f:
+        snapshot = json.load(f)
+    print(json.dumps(replay(snapshot, args.time), indent=1))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,123 @@
+"""Declarative cluster spec builder.
+
+Mirrors the reference's pkg/scheduler/test_utils (TestTopologyBasic +
+BuildSession): a dict-driven spec builds nodes/queues/podgroups into a
+ClusterInfo, or a full live Session over it.  Shared by the test suite and
+the offline simulators (cmd/fairshare-simulator-style harnesses).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..api import (ClusterInfo, NodeInfo, PodGroupInfo,
+                                   PodInfo, PodSet, PodStatus, QueueInfo,
+                                   QueueQuota, resources as rs)
+from ..api.resources import ResourceRequirements
+from ..framework import SchedulerConfig, Session
+
+
+def build_cluster(spec: dict) -> ClusterInfo:
+    """spec = {nodes: {name: {cpu, mem, gpu, labels, taints, gpu_memory}},
+    queues: {name: {deserved, limit, oqw, parent, priority}},
+    jobs: {name: {queue, min_available, priority, preemptible, pod_sets,
+                  tasks: [{name, cpu, mem, gpu, gpu_fraction, status, node,
+                           subgroup, selector, tolerations}]}}}"""
+    nodes = {}
+    for name, n in spec.get("nodes", {}).items():
+        nodes[name] = NodeInfo(
+            name,
+            rs.vec_from_spec(n.get("cpu", "32"), n.get("mem", "256Gi"),
+                             n.get("gpu", 8)),
+            labels=n.get("labels"), taints=set(n.get("taints", ())),
+            gpu_memory_per_device=rs.parse_memory(n["gpu_memory"])
+            if "gpu_memory" in n else 16 * 2 ** 30,
+            max_pods=n.get("max_pods", 110))
+
+    queues = {}
+    for name, q in spec.get("queues", {"default": {}}).items():
+        queues[name] = QueueInfo(
+            name, parent=q.get("parent"), priority=q.get("priority", 0),
+            creation_ts=q.get("creation_ts", 0.0),
+            quota=QueueQuota.from_spec(
+                deserved=q.get("deserved"), limit=q.get("limit"),
+                over_quota_weight=q.get("oqw", 1.0)),
+            preempt_min_runtime=q.get("preempt_min_runtime"),
+            reclaim_min_runtime=q.get("reclaim_min_runtime"))
+    for name, q in queues.items():
+        if q.parent and name not in queues[q.parent].children:
+            queues[q.parent].children.append(name)
+
+    podgroups = {}
+    for name, j in spec.get("jobs", {}).items():
+        pg = PodGroupInfo(
+            name, name, queue_id=j.get("queue", "default"),
+            priority=j.get("priority", 0),
+            min_available=j.get("min_available", 1),
+            preemptible=j.get("preemptible", True),
+            creation_ts=j.get("creation_ts", 0.0),
+            topology_name=j.get("topology"),
+            required_topology_level=j.get("required_topology_level"),
+            preferred_topology_level=j.get("preferred_topology_level"))
+        pg.last_start_ts = j.get("last_start_ts")
+        if "pod_sets" in j:
+            pg.set_pod_sets([PodSet(ps["name"], ps["min_available"])
+                             for ps in j["pod_sets"]])
+        for i, t in enumerate(j.get("tasks", [])):
+            task = PodInfo(
+                uid=t.get("uid", f"{name}-{i}"),
+                name=t.get("name", f"{name}-{i}"),
+                subgroup=t.get("subgroup", "default"),
+                status=PodStatus[t.get("status", "PENDING").upper()],
+                node_name=t.get("node", ""),
+                node_selector=t.get("selector", {}),
+                tolerations=set(t.get("tolerations", ())),
+                res_req=ResourceRequirements.from_spec(
+                    t.get("cpu", "1"), t.get("mem", "1Gi"), t.get("gpu", 0),
+                    gpu_fraction=t.get("gpu_fraction", 0.0),
+                    gpu_memory=t.get("gpu_memory")))
+            if t.get("gpu_group"):
+                task.gpu_group = t["gpu_group"]
+            pg.add_task(task)
+        podgroups[name] = pg
+
+    return ClusterInfo(nodes, podgroups, queues,
+                       topologies=spec.get("topologies", {}),
+                       now=spec.get("now", 1000.0))
+
+
+def build_session(spec: dict, config: SchedulerConfig | None = None
+                  ) -> Session:
+    cluster = build_cluster(spec)
+    ssn = Session(cluster, config or SchedulerConfig(),
+                  queue_usage=spec.get("queue_usage"))
+    return ssn.open()
+
+
+def run_action(ssn: Session, action_name: str = "allocate") -> None:
+    from ..actions import build_actions
+    for action in build_actions([action_name]):
+        action.execute(ssn)
+
+
+def placements(ssn: Session) -> dict:
+    """task uid -> (node_name, status_name) for all placed tasks."""
+    out = {}
+    for pg in ssn.cluster.podgroups.values():
+        for t in pg.pods.values():
+            if t.node_name:
+                out[t.uid] = (t.node_name, t.status.name)
+    return out
+
+
+def assert_placements(ssn: Session, expected: dict) -> None:
+    """expected: uid -> node name, or uid -> (node, status)."""
+    actual = placements(ssn)
+    for uid, want in expected.items():
+        assert uid in actual, f"task {uid} not placed; placed={actual}"
+        node, status = actual[uid]
+        if isinstance(want, tuple):
+            assert (node, status) == want, \
+                f"{uid}: got {(node, status)}, want {want}"
+        else:
+            assert node == want, f"{uid}: got {node}, want {want}"

@@ -1,0 +1,108 @@
+"""Historical-usage store for time-based fair share.
+
+Mirrors pkg/scheduler/cache/usagedb/ (UsageLister usagedb.go:20-138,
+client resolver hub.go:26-69, prometheus impl prometheus.go:29-113 with
+sliding/tumbling/cron windows and half-life decay, params
+api/interface.go:44-49): the scheduler fetches per-queue normalized
+historical usage each cycle and feeds it into the fair-share usage penalty
+``w' = max(0, W' + k(W' - U'))``.
+
+The in-memory implementation doubles as the "fake" client and as the
+record-keeping engine for the time-based simulator; a metrics-backed
+client can plug in through the same resolver.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import defaultdict, deque
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..api import resources as rs
+
+
+@dataclass
+class UsageParams:
+    half_life_period_seconds: float | None = None  # decay; None = flat
+    window_size_seconds: float = 3600.0
+    window_type: str = "sliding"  # sliding | tumbling
+    fetch_interval_seconds: float = 60.0
+    staleness_period_seconds: float = 300.0
+
+
+class UsageLister:
+    """Interface: queue_usage(now) -> {queue: [NUM_RES] normalized}."""
+
+    def queue_usage(self, now: float) -> dict:
+        raise NotImplementedError
+
+
+class InMemoryUsageDB(UsageLister):
+    """Sliding/tumbling-window usage with half-life decay.
+
+    record(now, queue, allocated_vec) each cycle; queue_usage(now) returns
+    usage normalized by cluster capacity (the division algorithm expects
+    U' in capacity units — resource_division.go:242).
+    """
+
+    def __init__(self, params: UsageParams | None = None,
+                 cluster_capacity: np.ndarray | None = None):
+        self.params = params or UsageParams()
+        self.cluster_capacity = cluster_capacity
+        self._samples: dict[str, deque] = defaultdict(deque)  # (t, vec)
+        self.last_fetch_ts: float | None = None
+
+    def record(self, now: float, queue: str, allocated: np.ndarray,
+               duration: float = 1.0) -> None:
+        self._samples[queue].append((now, allocated.copy() * duration))
+
+    def _decay(self, age: float) -> float:
+        hl = self.params.half_life_period_seconds
+        if not hl:
+            return 1.0
+        return 0.5 ** (age / hl)
+
+    def queue_usage(self, now: float) -> dict:
+        self.last_fetch_ts = now
+        out = {}
+        window = self.params.window_size_seconds
+        if self.params.window_type == "tumbling":
+            window_start = math.floor(now / window) * window
+        else:
+            window_start = now - window
+        for queue, samples in self._samples.items():
+            while samples and samples[0][0] < window_start:
+                samples.popleft()
+            total = rs.zeros()
+            weight_total = 0.0
+            for t, vec in samples:
+                w = self._decay(now - t)
+                total += vec * w
+                weight_total += w
+            if weight_total > 0:
+                total = total / weight_total
+            if self.cluster_capacity is not None:
+                cap = np.where(self.cluster_capacity > 0,
+                               self.cluster_capacity, 1.0)
+                total = total / cap
+            out[queue] = total
+        return out
+
+    def is_stale(self, now: float) -> bool:
+        return (self.last_fetch_ts is not None
+                and now - self.last_fetch_ts
+                > self.params.staleness_period_seconds)
+
+
+def resolve_usage_client(spec: str | None,
+                         params: UsageParams | None = None) -> UsageLister | None:
+    """Client resolver (hub.go:26-69): scheme-based selection.  'memory://'
+    and 'fake://' map to the in-memory store; unknown schemes return None
+    (usage penalty disabled)."""
+    if not spec:
+        return None
+    if spec.startswith(("memory://", "fake://")):
+        return InMemoryUsageDB(params)
+    return None

@@ -1,0 +1,127 @@
+"""Tests for the offline tools ring (fairshare simulator, time-based
+simulator, snapshot replay, scale harness) and the usage DB."""
+
+import json
+
+import numpy as np
+import pytest
+
+from kai_scheduler_tpu.plugins.snapshot_plugin import dump_cluster
+from kai_scheduler_tpu.tools.fairshare_simulator import simulate
+from kai_scheduler_tpu.tools.scale_gen import gen_spec, run_scenario
+from kai_scheduler_tpu.tools.snapshot_tool import replay
+from kai_scheduler_tpu.tools.time_fairshare_simulator import run as time_run
+from kai_scheduler_tpu.utils.cluster_spec import build_session
+from kai_scheduler_tpu.utils.usagedb import (InMemoryUsageDB, UsageParams,
+                                             resolve_usage_client)
+
+
+class TestFairshareSimulator:
+    PAYLOAD = {
+        "totalResource": {"cpu": 100, "memory": 100, "gpu": 100},
+        "kValue": 1.0,
+        "queues": [
+            {"name": "A", "deserved": {"cpu": 30, "memory": 30, "gpu": 30},
+             "request": {"cpu": 80, "memory": 80, "gpu": 80}},
+            {"name": "B", "deserved": {"cpu": 30, "memory": 30, "gpu": 30},
+             "request": {"cpu": 80, "memory": 80, "gpu": 80},
+             "overQuotaWeight": {"cpu": 2, "memory": 2, "gpu": 2}},
+        ],
+    }
+
+    def test_backends_agree(self):
+        a = simulate(self.PAYLOAD, "numpy")
+        b = simulate(self.PAYLOAD, "jax")
+        for q in ("A", "B"):
+            for r in ("cpu", "memory", "gpu"):
+                assert a["queues"][q]["fairShare"][r] == pytest.approx(
+                    b["queues"][q]["fairShare"][r], abs=1e-6)
+
+    def test_weighted_overquota(self):
+        out = simulate(self.PAYLOAD, "numpy")["queues"]
+        # 40 over-quota split 1:2 -> A gets ~13, B ~27 (+30 deserved each).
+        assert out["B"]["fairShare"]["gpu"] > out["A"]["fairShare"]["gpu"]
+        assert out["A"]["fairShare"]["gpu"] + \
+            out["B"]["fairShare"]["gpu"] == pytest.approx(100)
+
+    def test_hierarchical_payload(self):
+        payload = {
+            "totalResource": {"cpu": 100, "memory": 100, "gpu": 100},
+            "queues": [
+                {"name": "dept", "deserved": {"cpu": 100, "memory": 100,
+                                              "gpu": 100}},
+                # deserved=0: children compete purely over-quota (an
+                # UNLIMITED deserved would grant each min(pool, request)
+                # unconditionally, matching resource_division.go:100-104).
+                {"name": "team1", "parent": "dept",
+                 "deserved": {"cpu": 0, "memory": 0, "gpu": 0},
+                 "request": {"cpu": 60, "memory": 60, "gpu": 60}},
+                {"name": "team2", "parent": "dept",
+                 "deserved": {"cpu": 0, "memory": 0, "gpu": 0},
+                 "request": {"cpu": 60, "memory": 60, "gpu": 60}},
+            ],
+        }
+        for backend in ("numpy", "jax"):
+            out = simulate(payload, backend)["queues"]
+            assert out["team1"]["fairShare"]["gpu"] == pytest.approx(50)
+            assert out["team2"]["fairShare"]["gpu"] == pytest.approx(50)
+
+
+class TestUsageDB:
+    def test_half_life_decay(self):
+        db = InMemoryUsageDB(UsageParams(half_life_period_seconds=100.0,
+                                         window_size_seconds=1000.0))
+        db.record(0.0, "q", np.array([0.0, 0.0, 10.0]))
+        old = db.queue_usage(0.0)["q"][2]
+        decayed = db.queue_usage(100.0)["q"][2]
+        assert decayed == pytest.approx(old)  # single sample renormalizes
+        db.record(100.0, "q", np.array([0.0, 0.0, 0.0]))
+        mixed = db.queue_usage(100.0)["q"][2]
+        # old sample at half weight vs fresh zero: mean < 10 * 0.5/(1.5)+..
+        assert mixed < old
+
+    def test_window_expiry(self):
+        db = InMemoryUsageDB(UsageParams(window_size_seconds=50.0))
+        db.record(0.0, "q", np.array([0, 0, 10.0]))
+        assert db.queue_usage(100.0).get("q", np.zeros(3))[2] == 0
+
+    def test_resolver(self):
+        assert resolve_usage_client("memory://") is not None
+        assert resolve_usage_client("prometheus://x") is None
+        assert resolve_usage_client(None) is None
+
+
+class TestTimeBasedSimulator:
+    def test_equal_queues_converge(self):
+        rows = time_run(cycles=5, period=60.0)
+        last = {r["queue"]: r for r in rows if r["cycle"] == 4}
+        assert last["q_a"]["fair_share_gpu"] == pytest.approx(
+            last["q_b"]["fair_share_gpu"])
+        assert last["q_a"]["allocated_gpu"] + \
+            last["q_b"]["allocated_gpu"] == 32
+
+
+class TestSnapshotReplay:
+    def test_dump_and_replay(self):
+        ssn = build_session({
+            "nodes": {"n1": {"gpu": 8}},
+            "queues": {"q": {}},
+            "jobs": {"j1": {"queue": "q", "tasks": [{"gpu": 2}]},
+                     "big": {"queue": "q", "tasks": [{"gpu": 16}]}},
+        })
+        snap = json.loads(json.dumps(dump_cluster(ssn)))
+        report = replay(snap)
+        assert [b["pod"] for b in report["bind_requests"]] == ["j1-0"]
+        assert "big" in report["fit_errors"]
+
+
+class TestScaleHarness:
+    def test_gen_spec_shape(self):
+        spec = gen_spec(32)
+        assert len(spec["nodes"]) == 32
+        assert "dc" in spec["topologies"]
+
+    def test_distributed_scenario(self):
+        out = run_scenario("distributed", 16)
+        assert out["pods_bound"] == 16  # 4 gangs x 4 pods
+        assert out["steady_cycle_s"] < out["first_cycle_s"]
