@@ -1,0 +1,280 @@
+"""Grouped gang allocation: scan over task GROUPS, not tasks.
+
+The exact kernel (ops/allocate.py) pays a fixed while-loop step cost per
+task (~50us/step on TPU, dominating cycle latency: 2048 tasks ~ 100ms).
+Real gangs are overwhelmingly runs of IDENTICAL tasks (same request,
+selector, tolerations) — the same observation behind the reference's
+scheduling-signature representors (job_info.go:547,
+minimal_job_comparison.go).  This kernel scores once per identical-task
+run, computes an analytic *fill plan*, bulk-updates node state, and emits
+the plan as at most ``max_group`` compact (node, count, pipelined)
+segments — so the scan length is the number of GROUPS, cutting step count
+by the mean gang size.
+
+Equivalence to the sequential greedy (tested against the exact kernel):
+- under bin-pack, greedy fills the best-scoring node to capacity before
+  moving on, and filling one node never reorders the rest (their free
+  amounts are untouched; the min/max scale shifts monotonically), so the
+  greedy sequence equals "sort nodes by initial score, fill in order";
+- the availability tier is preserved by two fill phases: idle capacity on
+  fit-now nodes first, then (if pipelining) leftover idle+releasing
+  capacity in the same order;
+- per-node capacity = floor(min_r free_r / req_r) bounded by pod room;
+- gang failure (demand exceeds total capacity) rolls the job back at the
+  next job boundary, exactly like the per-task kernel.
+
+Spread strategy round-robins as nodes fill and must use the exact kernel.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .allocate import NEG, AllocationResult
+from .predicates import feasibility_row
+from .scoring import BINPACK, score_row
+
+
+def group_tasks(task_req: np.ndarray, task_job: np.ndarray,
+                task_selector: np.ndarray, task_tolerations: np.ndarray):
+    """Host-side prep: run-length groups over identical adjacent tasks.
+
+    Returns (group_of_task [T], group_req [G,R], group_sel [G,L],
+    group_tol [G,Tl], group_count [G], group_job [G]).
+    """
+    t = task_req.shape[0]
+    group_of_task = np.zeros(t, np.int32)
+    reqs, sels, tols, counts, jobs = [], [], [], [], []
+    prev = None
+    for i in range(t):
+        key = (int(task_job[i]), task_req[i].tobytes(),
+               task_selector[i].tobytes(), task_tolerations[i].tobytes())
+        if key != prev:
+            prev = key
+            reqs.append(task_req[i])
+            sels.append(task_selector[i])
+            tols.append(task_tolerations[i])
+            jobs.append(int(task_job[i]))
+            counts.append(0)
+        counts[-1] += 1
+        group_of_task[i] = len(counts) - 1
+    return (group_of_task, np.stack(reqs), np.stack(sels), np.stack(tols),
+            np.array(counts, np.float64), np.array(jobs, np.int32))
+
+
+def _compact(take_sorted, order, max_group: int):
+    """Gather the nonzero fill segments (in order) into [max_group] slots."""
+    flag = take_sorted > 0
+    slot = jnp.cumsum(flag) - 1
+    slot = jnp.where(flag, slot, max_group)  # dropped when out of range
+    nodes = jnp.full(max_group, -1, jnp.int32).at[slot].set(
+        order, mode="drop")
+    counts = jnp.zeros(max_group).at[slot].set(take_sorted, mode="drop")
+    return nodes, counts
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("max_group", "gpu_strategy",
+                                    "cpu_strategy", "allow_pipeline",
+                                    "pipeline_only"))
+def allocate_groups_kernel(node_allocatable, node_idle, node_releasing,
+                           node_labels, node_taints, node_pod_room,
+                           group_req, group_sel, group_tol, group_count,
+                           group_job, job_allowed, max_group: int,
+                           gpu_strategy: int = BINPACK,
+                           cpu_strategy: int = BINPACK,
+                           allow_pipeline: bool = True,
+                           pipeline_only: bool = False):
+    """Scan over groups; per group emit up to max_group fill segments.
+
+    Returns (seg_nodes [G,K], seg_counts [G,K], seg_pipe [G,K] — phase-B
+    segments marked pipelined, group_placed [G], job_success [J],
+    node_idle', node_releasing').
+    """
+    G = group_req.shape[0]
+    N = node_allocatable.shape[0]
+    K = max_group
+
+    class Carry(NamedTuple):
+        idle: jnp.ndarray
+        rel: jnp.ndarray
+        room: jnp.ndarray
+        ck_idle: jnp.ndarray
+        ck_rel: jnp.ndarray
+        ck_room: jnp.ndarray
+        cur_job: jnp.ndarray
+        cur_ok: jnp.ndarray
+
+    init = Carry(node_idle, node_releasing, node_pod_room,
+                 node_idle, node_releasing, node_pod_room,
+                 jnp.array(-1, jnp.int32), jnp.array(False))
+
+    def step(carry: Carry, g):
+        j = group_job[g]
+        new_job = j != carry.cur_job
+        keep = jnp.where(new_job & ~carry.cur_ok, False, True)
+        idle = jnp.where(keep, carry.idle, carry.ck_idle)
+        rel = jnp.where(keep, carry.rel, carry.ck_rel)
+        room = jnp.where(keep, carry.room, carry.ck_room)
+        ck_idle = jnp.where(new_job, idle, carry.ck_idle)
+        ck_rel = jnp.where(new_job, rel, carry.ck_rel)
+        ck_room = jnp.where(new_job, room, carry.ck_room)
+        ok = jnp.where(new_job, job_allowed[j], carry.cur_ok)
+
+        req = group_req[g]
+        count = jnp.where(ok, group_count[g], 0.0)
+
+        fit_now, fit_future = feasibility_row(
+            idle, rel, node_labels, node_taints, room, req,
+            group_sel[g], group_tol[g])
+        if pipeline_only:
+            fit_now = jnp.zeros_like(fit_now)
+        feasible = fit_now | (fit_future if (allow_pipeline or pipeline_only)
+                              else jnp.zeros_like(fit_future))
+        score = score_row(node_allocatable, idle, req, feasible, fit_now,
+                          gpu_strategy, cpu_strategy)
+        score = jnp.where(feasible, score, NEG)
+        order = jnp.argsort(-score, stable=True).astype(jnp.int32)
+
+        safe_req = jnp.where(req > 0, req, 1.0)
+        cap_now_f = jnp.min(jnp.where(req[None, :] > 0,
+                                      jnp.floor(idle / safe_req[None, :]),
+                                      jnp.inf), axis=1)
+        cap_tot_f = jnp.min(jnp.where(
+            req[None, :] > 0,
+            jnp.floor((idle + rel) / safe_req[None, :]), jnp.inf), axis=1)
+        cap_now = jnp.where(fit_now, jnp.minimum(cap_now_f, room), 0.0)
+        cap_tot = jnp.where(feasible, jnp.minimum(cap_tot_f, room), 0.0)
+        cap_now = jnp.clip(cap_now, 0.0, count)
+        cap_tot = jnp.clip(cap_tot, 0.0, count)
+
+        cap_now_sorted = cap_now[order]
+        cap_tot_sorted = cap_tot[order]
+        pref_a = jnp.cumsum(cap_now_sorted)
+        take_a = jnp.clip(count - (pref_a - cap_now_sorted), 0.0,
+                          cap_now_sorted)
+        total_now = take_a.sum()
+        cap_b_sorted = cap_tot_sorted - take_a
+        remaining = jnp.maximum(count - total_now, 0.0)
+        pref_b = jnp.cumsum(cap_b_sorted)
+        take_b = jnp.clip(remaining - (pref_b - cap_b_sorted), 0.0,
+                          cap_b_sorted)
+        if not (allow_pipeline or pipeline_only):
+            take_b = jnp.zeros_like(take_b)
+        placed = total_now + take_b.sum()
+
+        n_now = jnp.zeros(N).at[order].set(take_a)
+        n_pipe = jnp.zeros(N).at[order].set(take_b)
+        idle = idle - n_now[:, None] * req[None, :]
+        rel = rel - n_pipe[:, None] * req[None, :]
+        room = room - n_now - n_pipe
+
+        nodes_a, counts_a = _compact(take_a, order, K)
+        nodes_b, counts_b = _compact(take_b, order, K)
+        # Merge phases: A segments first, then B (pipelined) in the slots
+        # after A's.
+        a_used = (counts_a > 0).sum()
+        slot_b = jnp.arange(K) + a_used
+        seg_nodes = nodes_a.at[slot_b].set(
+            jnp.where(counts_b > 0, nodes_b, -1), mode="drop")
+        seg_counts = counts_a.at[slot_b].set(counts_b, mode="drop")
+        seg_pipe = (jnp.arange(K) >= a_used) & (seg_counts > 0)
+
+        ok = ok & (placed >= count)
+        return (Carry(idle, rel, room, ck_idle, ck_rel, ck_room,
+                      j.astype(jnp.int32), ok),
+                (seg_nodes, seg_counts, seg_pipe, placed))
+
+    carry, (seg_nodes, seg_counts, seg_pipe, group_placed) = jax.lax.scan(
+        step, init, jnp.arange(G))
+    idle = jnp.where(carry.cur_ok, carry.idle, carry.ck_idle)
+    rel = jnp.where(carry.cur_ok, carry.rel, carry.ck_rel)
+
+    num_jobs = job_allowed.shape[0]
+    placed_per_job = jax.ops.segment_sum(group_placed, group_job,
+                                         num_segments=num_jobs)
+    count_per_job = jax.ops.segment_sum(group_count, group_job,
+                                        num_segments=num_jobs)
+    job_success = (count_per_job > 0) & (placed_per_job >= count_per_job) \
+        & job_allowed
+    return (seg_nodes, seg_counts, seg_pipe, group_placed, job_success,
+            idle, rel)
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("max_group", "gpu_strategy",
+                                    "cpu_strategy", "allow_pipeline",
+                                    "pipeline_only"))
+def _allocate_groups_packed(*args, **kw):
+    """Kernel + single-buffer packing: a remote device pays a full RTT per
+    fetched buffer, so everything the host needs returns as ONE array."""
+    (seg_nodes, seg_counts, seg_pipe, group_placed, job_success,
+     idle, rel) = allocate_groups_kernel(*args, **kw)
+    g, k = seg_nodes.shape
+    packed = jnp.concatenate([
+        seg_nodes.astype(jnp.float32).ravel(),
+        seg_counts.astype(jnp.float32).ravel(),
+        seg_pipe.astype(jnp.float32).ravel(),
+        job_success.astype(jnp.float32).ravel(),
+    ])
+    return packed, idle, rel
+
+
+def allocate_grouped(node_arrays, task_req, task_job, task_selector,
+                     task_tolerations, job_allowed,
+                     gpu_strategy: int = BINPACK,
+                     cpu_strategy: int = BINPACK,
+                     allow_pipeline: bool = True,
+                     pipeline_only: bool = False) -> AllocationResult:
+    """Host wrapper: group prep -> group-scan kernel -> per-task expansion.
+
+    Drop-in equivalent of ops.allocate.allocate_jobs_kernel for bin-pack
+    strategies.
+    """
+    np_req = np.asarray(task_req)
+    np_job = np.asarray(task_job)
+    np_sel = np.asarray(task_selector)
+    np_tol = np.asarray(task_tolerations)
+    (group_of_task, g_req, g_sel, g_tol, g_count,
+     g_job) = group_tasks(np_req, np_job, np_sel, np_tol)
+    max_group = _next_pow2(int(g_count.max()) if len(g_count) else 1)
+
+    packed, idle, rel = _allocate_groups_packed(
+        *node_arrays, jnp.asarray(g_req), jnp.asarray(g_sel),
+        jnp.asarray(g_tol), jnp.asarray(g_count), jnp.asarray(g_job),
+        jnp.asarray(job_allowed), max_group=max_group,
+        gpu_strategy=gpu_strategy, cpu_strategy=cpu_strategy,
+        allow_pipeline=allow_pipeline, pipeline_only=pipeline_only)
+    packed = np.asarray(packed)  # ONE device->host fetch
+    g, k = len(g_count), max_group
+    seg_nodes = packed[:g * k].reshape(g, k).astype(np.int32)
+    seg_counts = packed[g * k:2 * g * k].reshape(g, k).astype(np.int64)
+    seg_pipe = packed[2 * g * k:3 * g * k].reshape(g, k) > 0.5
+    success = packed[3 * g * k:3 * g * k + len(job_allowed)] > 0.5
+    T = np_req.shape[0]
+    placements = np.full(T, -1, np.int32)
+    pipelined = np.zeros(T, bool)
+    t = 0
+    for g in range(len(g_count)):
+        k = int(g_count[g])
+        if success[g_job[g]]:
+            nodes = np.repeat(seg_nodes[g], seg_counts[g])
+            pipes = np.repeat(seg_pipe[g], seg_counts[g])
+            n = min(len(nodes), k)
+            placements[t:t + n] = nodes[:n]
+            pipelined[t:t + n] = pipes[:n]
+        t += k
+    return AllocationResult(placements, pipelined,
+                            jnp.asarray(success), idle, rel)

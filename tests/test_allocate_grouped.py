@@ -1,0 +1,103 @@
+"""Grouped-allocation kernel: parity with the exact per-task kernel on
+bin-pack configs (identical-task gangs are the hot path)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kai_scheduler_tpu.ops.allocate import allocate_jobs_kernel
+from kai_scheduler_tpu.ops.allocate_grouped import allocate_grouped
+
+
+def make_instance(seed, n_nodes=24, n_jobs=6, max_gang=5, releasing=True):
+    rng = np.random.default_rng(seed)
+    alloc = np.tile([8000.0, 64e9, 8.0], (n_nodes, 1))
+    idle = alloc.copy()
+    idle[:, 2] -= rng.integers(0, 6, n_nodes)
+    rel = np.zeros((n_nodes, 3))
+    if releasing:
+        rel[:, 2] = rng.integers(0, 3, n_nodes)
+    labels = np.full((n_nodes, 1), -1, np.int32)
+    labels[: n_nodes // 2, 0] = 0
+    taints = np.full((n_nodes, 1), -1, np.int32)
+    room = np.full(n_nodes, 110.0)
+
+    reqs, jobs, sels = [], [], []
+    for j in range(n_jobs):
+        gang = int(rng.integers(1, max_gang + 1))
+        gpu = float(rng.integers(1, 4))
+        sel = 0 if rng.random() < 0.3 else -1
+        for _ in range(gang):
+            reqs.append([1000.0, 1e9, gpu])
+            jobs.append(j)
+            sels.append(sel)
+    req = np.array(reqs)
+    task_job = np.array(jobs, np.int32)
+    sel = np.array(sels, np.int32)[:, None]
+    tol = np.full((len(reqs), 1), -1, np.int32)
+    job_allowed = np.ones(n_jobs, bool)
+    if n_jobs > 2:
+        job_allowed[int(rng.integers(n_jobs))] = False
+    nodes = (jnp.asarray(alloc), jnp.asarray(idle), jnp.asarray(rel),
+             jnp.asarray(labels), jnp.asarray(taints), jnp.asarray(room))
+    tasks = (jnp.asarray(req), jnp.asarray(task_job), jnp.asarray(sel),
+             jnp.asarray(tol))
+    return nodes, tasks, jnp.asarray(job_allowed)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_parity_with_exact_kernel(seed):
+    nodes, tasks, job_allowed = make_instance(seed)
+    exact = allocate_jobs_kernel(*nodes, *tasks, job_allowed)
+    grouped = allocate_grouped(nodes, *tasks, job_allowed)
+    np.testing.assert_array_equal(np.asarray(exact.job_success),
+                                  np.asarray(grouped.job_success))
+    np.testing.assert_array_equal(np.asarray(exact.placements),
+                                  np.asarray(grouped.placements))
+    np.testing.assert_array_equal(np.asarray(exact.pipelined),
+                                  np.asarray(grouped.pipelined))
+    np.testing.assert_allclose(np.asarray(exact.node_idle),
+                               np.asarray(grouped.node_idle))
+
+
+def test_large_gang_fills_in_binpack_order():
+    nodes, _, _ = make_instance(0, n_nodes=4, n_jobs=1)
+    alloc, _, _, labels, taints, room = nodes
+    idle = jnp.asarray(np.tile([8000.0, 64e9, 8.0], (4, 1)))
+    rel = jnp.zeros((4, 3))
+    req = np.tile([100.0, 1e8, 2.0], (16, 1))
+    task_job = np.zeros(16, np.int32)
+    sel = np.full((16, 1), -1, np.int32)
+    tol = np.full((16, 1), -1, np.int32)
+    out = allocate_grouped(
+        (alloc, idle, rel, labels, taints, room),
+        jnp.asarray(req), jnp.asarray(task_job), jnp.asarray(sel),
+        jnp.asarray(tol), jnp.asarray(np.ones(1, bool)))
+    assert bool(out.job_success[0])
+    counts = np.bincount(np.asarray(out.placements), minlength=4)
+    assert counts.tolist() == [4, 4, 4, 4]
+    assert float(out.node_idle[:, 2].sum()) == 0.0
+
+
+def test_pipeline_phase_marks_tasks():
+    """Gang larger than idle capacity pipelines the overflow onto
+    releasing resources, in the same fill order."""
+    alloc = jnp.asarray(np.tile([8000.0, 64e9, 8.0], (2, 1)))
+    idle = jnp.asarray(np.array([[8000.0, 64e9, 4.0],
+                                 [8000.0, 64e9, 0.0]]))
+    rel = jnp.asarray(np.array([[0.0, 0.0, 0.0], [0.0, 0.0, 8.0]]))
+    labels = jnp.full((2, 1), -1, jnp.int32)
+    taints = jnp.full((2, 1), -1, jnp.int32)
+    room = jnp.full(2, 110.0)
+    req = np.tile([100.0, 1e8, 2.0], (5, 1))
+    out = allocate_grouped(
+        (alloc, idle, rel, labels, taints, room),
+        jnp.asarray(req), jnp.asarray(np.zeros(5, np.int32)),
+        jnp.asarray(np.full((5, 1), -1, np.int32)),
+        jnp.asarray(np.full((5, 1), -1, np.int32)),
+        jnp.asarray(np.ones(1, bool)))
+    assert bool(out.job_success[0])
+    p = np.asarray(out.placements)
+    piped = np.asarray(out.pipelined)
+    assert (p[:2] == 0).all() and not piped[:2].any()  # idle capacity first
+    assert (p[2:] == 1).all() and piped[2:].all()      # overflow pipelines
