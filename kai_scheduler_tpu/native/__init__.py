@@ -1,0 +1,11 @@
+"""Native runtime components (C++, loaded via ctypes).
+
+Builds on first import with the system toolchain; consumers fall back to
+the pure-numpy path when no compiler is available (the public API is
+identical either way).
+"""
+
+from .build import load_statestore_lib
+from .statestore import NativeNodeTable, native_available
+
+__all__ = ["NativeNodeTable", "native_available", "load_statestore_lib"]

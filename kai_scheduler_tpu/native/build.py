@@ -1,0 +1,63 @@
+"""Build + load the native state store (g++ -> shared lib, cached)."""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+
+_SRC = os.path.join(os.path.dirname(__file__), "statestore.cpp")
+_LIB_CACHE: dict = {}
+
+
+def _lib_path() -> str:
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    cache_dir = os.environ.get(
+        "KAI_NATIVE_CACHE",
+        os.path.join(tempfile.gettempdir(), "kai_scheduler_tpu_native"))
+    os.makedirs(cache_dir, exist_ok=True)
+    return os.path.join(cache_dir, f"statestore-{digest}.so")
+
+
+def load_statestore_lib():
+    """Compile (if needed) and dlopen the state store; None if no
+    toolchain."""
+    if "lib" in _LIB_CACHE:
+        return _LIB_CACHE["lib"]
+    path = _lib_path()
+    if not os.path.exists(path):
+        try:
+            subprocess.run(
+                ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC,
+                 "-o", path],
+                check=True, capture_output=True, timeout=120)
+        except (OSError, subprocess.SubprocessError):
+            _LIB_CACHE["lib"] = None
+            return None
+    lib = ctypes.CDLL(path)
+    d = ctypes.POINTER(ctypes.c_double)
+    lib.ss_create.restype = ctypes.c_void_p
+    lib.ss_create.argtypes = [ctypes.c_int64, ctypes.c_int64]
+    lib.ss_destroy.argtypes = [ctypes.c_void_p]
+    lib.ss_set_node.argtypes = [ctypes.c_void_p, ctypes.c_int64, d,
+                                ctypes.c_double]
+    lib.ss_add_task.argtypes = [ctypes.c_void_p, ctypes.c_int64, d,
+                                ctypes.c_int]
+    lib.ss_remove_task.argtypes = [ctypes.c_void_p, ctypes.c_int64, d,
+                                   ctypes.c_int]
+    for name in ("ss_idle", "ss_allocatable", "ss_used", "ss_releasing",
+                 "ss_room"):
+        fn = getattr(lib, name)
+        fn.restype = d
+        fn.argtypes = [ctypes.c_void_p]
+    lib.ss_n_nodes.restype = ctypes.c_int64
+    lib.ss_n_nodes.argtypes = [ctypes.c_void_p]
+    lib.ss_bulk_load.argtypes = [ctypes.c_void_p, d, d, d, d]
+    lib.ss_clone.restype = ctypes.c_void_p
+    lib.ss_clone.argtypes = [ctypes.c_void_p]
+    lib.ss_restore.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+    _LIB_CACHE["lib"] = lib
+    return lib

@@ -1,0 +1,114 @@
+"""ctypes wrapper: NativeNodeTable with zero-copy numpy views.
+
+The Session's dense node mirrors (framework/session.py) can be backed by
+this table: statement ops become O(1) native calls, checkpoint/rollback of
+the whole table is a native memcpy, and the arrays the device kernels
+consume are views over the C buffers (no per-cycle Python packing loop).
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from .build import load_statestore_lib
+
+STATUS_ALLOCATED = 0
+STATUS_RELEASING = 1
+STATUS_PIPELINED = 2
+
+
+def native_available() -> bool:
+    return load_statestore_lib() is not None
+
+
+def _as_dptr(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+
+
+class NativeNodeTable:
+    def __init__(self, n_nodes: int, n_res: int):
+        self._lib = load_statestore_lib()
+        if self._lib is None:
+            raise RuntimeError("native toolchain unavailable")
+        self.n_nodes = n_nodes
+        self.n_res = n_res
+        self._handle = ctypes.c_void_p(self._lib.ss_create(n_nodes, n_res))
+        self._checkpoints: list = []
+
+    def __del__(self):
+        lib = getattr(self, "_lib", None)
+        if lib is not None and getattr(self, "_handle", None):
+            for cp in self._checkpoints:
+                lib.ss_destroy(cp)
+            lib.ss_destroy(self._handle)
+
+    # -- loading -----------------------------------------------------------
+    def set_node(self, i: int, allocatable: np.ndarray,
+                 max_pods: float) -> None:
+        a = np.ascontiguousarray(allocatable, np.float64)
+        self._lib.ss_set_node(self._handle, i, _as_dptr(a), max_pods)
+
+    def bulk_load(self, allocatable, used, releasing, room) -> None:
+        a = np.ascontiguousarray(allocatable, np.float64)
+        u = np.ascontiguousarray(used, np.float64)
+        r = np.ascontiguousarray(releasing, np.float64)
+        m = np.ascontiguousarray(room, np.float64)
+        self._lib.ss_bulk_load(self._handle, _as_dptr(a), _as_dptr(u),
+                               _as_dptr(r), _as_dptr(m))
+
+    # -- accounting --------------------------------------------------------
+    def add_task(self, node_idx: int, req: np.ndarray, status: int) -> None:
+        r = np.ascontiguousarray(req, np.float64)
+        self._lib.ss_add_task(self._handle, node_idx, _as_dptr(r), status)
+
+    def remove_task(self, node_idx: int, req: np.ndarray,
+                    status: int) -> None:
+        r = np.ascontiguousarray(req, np.float64)
+        self._lib.ss_remove_task(self._handle, node_idx, _as_dptr(r),
+                                 status)
+
+    # -- views (zero-copy over the C buffers) ------------------------------
+    def _view(self, ptr, shape):
+        size = int(np.prod(shape))
+        buf = np.ctypeslib.as_array(ptr, shape=(size,))
+        return buf.reshape(shape)
+
+    @property
+    def idle(self) -> np.ndarray:
+        ptr = self._lib.ss_idle(self._handle)  # refreshes derived table
+        return self._view(ptr, (self.n_nodes, self.n_res))
+
+    @property
+    def allocatable(self) -> np.ndarray:
+        return self._view(self._lib.ss_allocatable(self._handle),
+                          (self.n_nodes, self.n_res))
+
+    @property
+    def used(self) -> np.ndarray:
+        return self._view(self._lib.ss_used(self._handle),
+                          (self.n_nodes, self.n_res))
+
+    @property
+    def releasing(self) -> np.ndarray:
+        return self._view(self._lib.ss_releasing(self._handle),
+                          (self.n_nodes, self.n_res))
+
+    @property
+    def room(self) -> np.ndarray:
+        return self._view(self._lib.ss_room(self._handle), (self.n_nodes,))
+
+    # -- checkpoint / rollback (native memcpy) -----------------------------
+    def checkpoint(self) -> int:
+        cp = ctypes.c_void_p(self._lib.ss_clone(self._handle))
+        self._checkpoints.append(cp)
+        return len(self._checkpoints) - 1
+
+    def rollback(self, checkpoint_id: int) -> None:
+        cp = self._checkpoints[checkpoint_id]
+        self._lib.ss_restore(self._handle, cp)
+        # Drop this checkpoint and everything after it.
+        for extra in self._checkpoints[checkpoint_id:]:
+            self._lib.ss_destroy(extra)
+        del self._checkpoints[checkpoint_id:]

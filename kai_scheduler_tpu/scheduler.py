@@ -28,6 +28,7 @@ class Scheduler:
         self.cache = cache or InMemoryCache()
         self.usage_provider = usage_provider
         self.session_id = 0
+        self.last_session = None  # kept for introspection endpoints
 
     def run_once(self) -> Session:
         """One scheduling cycle (scheduler.go:113-138)."""
@@ -47,6 +48,7 @@ class Scheduler:
             ssn.close()
         METRICS.observe("e2e_scheduling_latency_milliseconds",
                         (time.perf_counter() - t0) * 1000.0)
+        self.last_session = ssn
         return ssn
 
     def run(self, cycles: int, period_seconds: float = 0.0) -> None:

@@ -1,0 +1,169 @@
+"""Scheduler daemon entry point: flags, HTTP endpoints, leader election.
+
+Mirrors cmd/scheduler/app (RunApp server.go:103, options
+options.go, leader election server.go:196-240, /metrics :184-187, pprof
+profiling/profiler.go) for the embedded deployment: a CLI that assembles
+the System (operator), runs the scheduling loop, and serves observability
+endpoints:
+
+  GET /metrics        Prometheus text (utils/metrics.py)
+  GET /get-snapshot   full cluster+config dump (snapshot plugin)
+  GET /job-order      current job ordering per queue (reflectjoborder)
+  GET /healthz
+
+Leader election uses an fcntl file lock as the lease analog — exactly one
+scheduler process per shard advances; the rest block until the leader
+dies.
+"""
+
+from __future__ import annotations
+
+import argparse
+import fcntl
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .controllers import ShardSpec, System, SystemConfig
+from .framework.conf import SchedulerConfig
+from .plugins.snapshot_plugin import dump_cluster
+from .utils.logging import LOG, init_loggers
+from .utils.metrics import METRICS
+
+
+class LeaderElector:
+    """flock-based lease (the coordination.Lease analog)."""
+
+    def __init__(self, lock_path: str):
+        self.lock_path = lock_path
+        self._fh = None
+
+    def acquire(self, poll_seconds: float = 1.0) -> None:
+        self._fh = open(self.lock_path, "a+")
+        while True:
+            try:
+                fcntl.flock(self._fh, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                self._fh.seek(0)
+                self._fh.truncate()
+                self._fh.write(str(os.getpid()))
+                self._fh.flush()
+                return
+            except BlockingIOError:
+                time.sleep(poll_seconds)
+
+    def release(self) -> None:
+        if self._fh is not None:
+            fcntl.flock(self._fh, fcntl.LOCK_UN)
+            self._fh.close()
+            self._fh = None
+
+
+def _make_handler(server_state):
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            if self.path == "/metrics":
+                body = METRICS.to_prometheus_text().encode()
+                ctype = "text/plain"
+            elif self.path == "/healthz":
+                body = b"ok"
+                ctype = "text/plain"
+            elif self.path == "/get-snapshot":
+                ssn = server_state.get("last_session")
+                body = json.dumps(
+                    dump_cluster(ssn) if ssn else {}).encode()
+                ctype = "application/json"
+            elif self.path == "/job-order":
+                body = json.dumps(
+                    server_state.get("job_order", {})).encode()
+                ctype = "application/json"
+            else:
+                self.send_error(404)
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):
+            pass
+
+    return Handler
+
+
+def _job_order_dump(ssn) -> dict:
+    """reflectjoborder analog: expose the queue/job ordering."""
+    from .actions.utils import JobsOrderByQueues
+    jobs = [pg for pg in ssn.cluster.podgroups.values()
+            if pg.has_tasks_to_allocate() and pg.queue_id
+            in ssn.cluster.queues]
+    order = JobsOrderByQueues(ssn, jobs)
+    out = []
+    while not order.empty():
+        job = order.pop_next_job()
+        if job is None:
+            break
+        out.append({"job": job.name, "queue": job.queue_id})
+        order.requeue_queue(job.queue_id)
+        if len(out) > 1000:
+            break
+    return {"order": out}
+
+
+def run_app(argv=None) -> None:
+    ap = argparse.ArgumentParser("kai-scheduler-tpu")
+    ap.add_argument("--schedule-period", type=float, default=1.0)
+    ap.add_argument("--http-port", type=int, default=8080)
+    ap.add_argument("--verbosity", "-v", type=int, default=0)
+    ap.add_argument("--leader-elect", action="store_true")
+    ap.add_argument("--lock-file", default="/tmp/kai-scheduler-tpu.lock")
+    ap.add_argument("--node-pool-label", default=None)
+    ap.add_argument("--node-pool", default=None)
+    ap.add_argument("--k-value", type=float, default=1.0)
+    ap.add_argument("--actions", default=None,
+                    help="comma-separated action order override")
+    ap.add_argument("--cycles", type=int, default=0,
+                    help="stop after N cycles (0 = forever)")
+    args = ap.parse_args(argv)
+
+    init_loggers(args.verbosity)
+    config = SchedulerConfig(k_value=args.k_value)
+    if args.actions:
+        config.actions = [a.strip() for a in args.actions.split(",")]
+    system = System(SystemConfig(shards=[ShardSpec(
+        "default", args.node_pool_label, args.node_pool, config)]))
+
+    if args.leader_elect:
+        LOG.info("waiting for leadership (%s)", args.lock_file)
+        elector = LeaderElector(args.lock_file)
+        elector.acquire()
+        LOG.info("became leader")
+
+    state: dict = {}
+    handler = _make_handler(state)
+    httpd = ThreadingHTTPServer(("127.0.0.1", args.http_port), handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    LOG.info("serving http on :%d", httpd.server_port)
+
+    cycle = 0
+    try:
+        while True:
+            system.run_cycle()
+            if system.schedulers:
+                # Keep the last session around for introspection endpoints.
+                ssn = system.schedulers[0].last_session
+                if ssn is not None:
+                    state["last_session"] = ssn
+                    state["job_order"] = _job_order_dump(ssn)
+            cycle += 1
+            if args.cycles and cycle >= args.cycles:
+                break
+            time.sleep(args.schedule_period)
+    finally:
+        httpd.shutdown()
+
+
+if __name__ == "__main__":
+    run_app()

@@ -1,0 +1,67 @@
+"""Leveled, scoped logging.
+
+Mirrors pkg/scheduler/log (zap-based InfraLogger with verbosity levels and
+per-session / per-action child loggers): numeric verbosity levels on top of
+the stdlib logger, with scope-tagged children created per scheduling
+session and action.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+_BASE = logging.getLogger("kai_scheduler_tpu")
+_VERBOSITY = 0
+
+
+def init_loggers(verbosity: int = 0, stream=None) -> None:
+    global _VERBOSITY
+    _VERBOSITY = verbosity
+    if not _BASE.handlers:
+        handler = logging.StreamHandler(stream or sys.stderr)
+        handler.setFormatter(logging.Formatter(
+            "%(asctime)s %(levelname).1s %(name)s: %(message)s"))
+        _BASE.addHandler(handler)
+    _BASE.setLevel(logging.DEBUG if verbosity > 0 else logging.INFO)
+
+
+class ScopedLogger:
+    """V-leveled logger: log.v(6).info(...) only emits when verbosity>=6."""
+
+    def __init__(self, scope: str = ""):
+        self.scope = scope
+        self._logger = _BASE.getChild(scope) if scope else _BASE
+
+    def child(self, scope: str) -> "ScopedLogger":
+        full = f"{self.scope}.{scope}" if self.scope else scope
+        return ScopedLogger(full)
+
+    def v(self, level: int) -> "_LevelProxy":
+        return _LevelProxy(self._logger, enabled=_VERBOSITY >= level)
+
+    def info(self, msg, *args):
+        self._logger.info(msg, *args)
+
+    def warning(self, msg, *args):
+        self._logger.warning(msg, *args)
+
+    def error(self, msg, *args):
+        self._logger.error(msg, *args)
+
+
+class _LevelProxy:
+    def __init__(self, logger, enabled: bool):
+        self._logger = logger
+        self._enabled = enabled
+
+    def info(self, msg, *args):
+        if self._enabled:
+            self._logger.debug(msg, *args)
+
+
+LOG = ScopedLogger()
+
+
+def session_logger(session_id: int) -> ScopedLogger:
+    return LOG.child(f"session-{session_id}")
