@@ -94,8 +94,10 @@ def attempt_to_allocate_job(ssn, job: PodGroupInfo,
 
 def _allocate_tasks_on_subset(ssn, stmt, job, tasks, node_subset,
                               pipeline_only: bool) -> bool:
-    fractional = [t for t in tasks if t.is_fractional]
-    if fractional:
+    # Fractional tasks and DRA-claim tasks need host-side state the kernel
+    # doesn't model (sharing groups, claim bindings): task-by-task path.
+    host_path = any(t.is_fractional or t.resource_claims for t in tasks)
+    if host_path:
         ok = _allocate_task_by_task(ssn, stmt, job, tasks, node_subset,
                                     pipeline_only)
     else:
@@ -126,6 +128,9 @@ def _allocate_task_by_task(ssn, stmt, job, tasks, node_subset,
         if task.is_fractional:
             placed = _allocate_fractional(ssn, stmt, task, node_subset,
                                           pipeline_only)
+        elif task.resource_claims:
+            placed = _allocate_with_claims(ssn, stmt, task, node_subset,
+                                           pipeline_only)
         else:
             proposal = ssn.propose_placements(
                 [task], pipeline_only=pipeline_only, node_subset=node_subset)
@@ -165,6 +170,30 @@ def _allocate_fractional(ssn, stmt, task, node_subset,
             if groups is not None:
                 stmt.pipeline(task, node.name, gpu_group=",".join(groups))
                 return True
+    return False
+
+
+def _allocate_with_claims(ssn, stmt, task, node_subset,
+                          pipeline_only: bool) -> bool:
+    """DRA path: best-scoring node where every referenced claim is
+    available (dynamicresources.go PrePredicate + assume)."""
+    import numpy as np
+    dra = next((p for p in ssn.plugins
+                if p.name == "dynamicresources"), None)
+    scores = ssn.score_nodes_for_task(task)[:len(ssn.snapshot.node_names)]
+    order = np.argsort(-scores, kind="stable")
+    for node_idx in order:
+        if node_subset is not None and not node_subset[node_idx]:
+            continue
+        node = ssn.cluster.nodes[ssn.snapshot.node_names[int(node_idx)]]
+        if dra is not None and not dra.claims_schedulable(task, node.name):
+            continue
+        if not pipeline_only and node.is_task_allocatable(task):
+            stmt.allocate(task, node.name)
+            return True
+        if node.is_task_allocatable_on_releasing_or_idle(task):
+            stmt.pipeline(task, node.name)
+            return True
     return False
 
 

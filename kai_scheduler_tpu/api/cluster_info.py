@@ -38,11 +38,14 @@ class ClusterInfo:
                  podgroups: dict[str, PodGroupInfo] | None = None,
                  queues: dict[str, QueueInfo] | None = None,
                  topologies: dict | None = None,
-                 now: float = 0.0):
+                 now: float = 0.0,
+                 resource_claims: dict | None = None):
         self.nodes: dict[str, NodeInfo] = nodes or {}
         self.podgroups: dict[str, PodGroupInfo] = podgroups or {}
         self.queues: dict[str, QueueInfo] = queues or {}
         self.topologies: dict = topologies or {}
+        # DRA claims: name -> {"device_class", "allocated", "node"}.
+        self.resource_claims: dict = resource_claims or {}
         self.bind_requests: list[BindRequest] = []
         self.now = now
         # Stable orderings for tensor packing.
@@ -103,4 +106,5 @@ class ClusterInfo:
         return ClusterInfo(
             bare_nodes,
             {uid: pg.clone() for uid, pg in self.podgroups.items()},
-            dict(self.queues), dict(self.topologies), self.now)
+            dict(self.queues), dict(self.topologies), self.now,
+            {k: dict(v) for k, v in self.resource_claims.items()})

@@ -35,6 +35,11 @@ class PodInfo:
     accepted_resource_types: Optional[set] = None       # None = any
     # Fraction bookkeeping
     gpu_group: str = ""  # shared-GPU group id once placed fractionally
+    # Dynamic Resource Allocation: referenced claim names.
+    resource_claims: list = field(default_factory=list)
+    # Inter-pod affinity: job uids to co-locate with / keep away from.
+    pod_affinity_peers: list = field(default_factory=list)
+    pod_anti_affinity_peers: list = field(default_factory=list)
     # Index into the packed task tensor for the current snapshot.
     tensor_idx: int = -1
 
@@ -61,5 +66,9 @@ class PodInfo:
             tolerations=set(self.tolerations),
             accepted_resource_types=(set(self.accepted_resource_types)
                                      if self.accepted_resource_types else None),
-            gpu_group=self.gpu_group, tensor_idx=self.tensor_idx,
+            gpu_group=self.gpu_group,
+            resource_claims=list(self.resource_claims),
+            pod_affinity_peers=list(self.pod_affinity_peers),
+            pod_anti_affinity_peers=list(self.pod_anti_affinity_peers),
+            tensor_idx=self.tensor_idx,
         )

@@ -15,8 +15,8 @@ from dataclasses import dataclass, field
 DEFAULT_PLUGINS = [
     "predicates", "proportion", "priority", "nodeplacement", "elastic",
     "taskorder", "subgrouporder", "nodeavailability", "resourcetype",
-    "gpupack", "gpusharingorder", "nominatednode", "minruntime",
-    "topology", "snapshot",
+    "gpupack", "gpusharingorder", "nominatednode", "podaffinity",
+    "minruntime", "dynamicresources", "topology", "snapshot",
 ]
 
 DEFAULT_ACTIONS = ["allocate", "consolidation", "reclaim", "preempt",

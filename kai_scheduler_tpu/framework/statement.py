@@ -159,6 +159,11 @@ class Statement:
                     namespace=op.task.namespace, node_name=op.node_name,
                     gpu_groups=(op.gpu_group.split(",") if op.gpu_group
                                 else []))
+                # Plugin mutation hook (DRA claim lists etc. —
+                # BindRequestMutate, dynamicresources.go:252).
+                for mutator in getattr(self.session,
+                                       "bind_request_mutators", []):
+                    mutator(op.task, br)
                 binds.append(br)
                 self.session.cache.bind(op.task, op.node_name, br)
             elif op.kind == "evict":

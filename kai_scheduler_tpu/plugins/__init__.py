@@ -5,9 +5,11 @@ per-node callbacks."""
 from .base import Plugin, build_plugins, register_plugin, registered_plugins
 
 # Import for registration side effects.
+from . import dynamicresources  # noqa: F401
 from . import minruntime  # noqa: F401
 from . import ordering  # noqa: F401
 from . import placement  # noqa: F401
+from . import podaffinity  # noqa: F401
 from . import proportion  # noqa: F401
 from . import snapshot_plugin  # noqa: F401
 from . import topology  # noqa: F401

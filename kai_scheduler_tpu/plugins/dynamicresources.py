@@ -1,0 +1,62 @@
+"""Dynamic Resource Allocation (DRA) plugin.
+
+Mirrors pkg/scheduler/plugins/dynamicresources/dynamicresources.go:59-87:
+tasks may reference ResourceClaims; a claim must be allocatable (or already
+allocated to a compatible node) for the task to schedule, claims are
+assumed/unassumed in-session as statements allocate/rollback, and the
+claim names ride the BindRequest so the binder can write the allocation
+status at bind time (allocateResourceClaim :252).
+
+Claims live in the info model as ``task.resource_claims``: a list of claim
+names resolved against ``cluster.resource_claims`` ({name: {"device_class",
+"allocated", "node"}}).
+"""
+
+from __future__ import annotations
+
+from .base import Plugin, register_plugin
+
+
+@register_plugin("dynamicresources")
+class DynamicResourcesPlugin(Plugin):
+    def on_session_open(self, ssn) -> None:
+        self.ssn = ssn
+        self.claims = getattr(ssn.cluster, "resource_claims", {})
+        if not self.claims:
+            return
+        # In-session assumed allocations: claim -> node (rolled back with
+        # the statement via the deallocate handler).
+        self.assumed: dict[str, str] = {}
+        ssn.allocate_handlers.append(self.on_allocate)
+        ssn.deallocate_handlers.append(self.on_deallocate)
+        ssn.bind_request_mutators = getattr(ssn, "bind_request_mutators",
+                                            [])
+        ssn.bind_request_mutators.append(self.mutate_bind_request)
+
+    def task_claims(self, task) -> list:
+        return getattr(task, "resource_claims", []) or []
+
+    def claims_schedulable(self, task, node_name: str) -> bool:
+        """PrePredicate analog: every referenced claim must be free, already
+        assumed on this node, or bound to this node."""
+        for name in self.task_claims(task):
+            claim = self.claims.get(name)
+            if claim is None:
+                return False
+            node = claim.get("node") or self.assumed.get(name)
+            if node and node != node_name:
+                return False
+        return True
+
+    def on_allocate(self, task) -> None:
+        for name in self.task_claims(task):
+            self.assumed[name] = task.node_name
+
+    def on_deallocate(self, task, prev_status) -> None:
+        for name in self.task_claims(task):
+            self.assumed.pop(name, None)
+
+    def mutate_bind_request(self, task, bind_request) -> None:
+        claims = self.task_claims(task)
+        if claims:
+            bind_request.resource_claims = list(claims)

@@ -1,0 +1,52 @@
+"""Pod-affinity plugin: inter-pod affinity/anti-affinity score terms.
+
+Mirrors pkg/scheduler/plugins/podaffinity (NodeOrder + predicate assist) at
+the granularity the tensor path supports: tasks carry
+``pod_affinity_peers`` (job uids to co-locate with) and
+``pod_anti_affinity_peers`` (job uids to avoid); nodes hosting peers gain
+or lose score.  Gang-internal affinity (co-locating a job's own pods) is
+served by bin-pack already.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .base import Plugin, register_plugin
+
+AFFINITY_SCORE = 50.0  # between placement (<=9+10) and availability (100)
+
+
+@register_plugin("podaffinity")
+class PodAffinityPlugin(Plugin):
+    def on_session_open(self, ssn) -> None:
+        self.ssn = ssn
+        ssn.extra_score_fns.append(self.extra_scores)
+
+    def _job_nodes(self, job_uid: str) -> set:
+        pg = self.ssn.cluster.podgroups.get(job_uid)
+        if pg is None:
+            return set()
+        return {self.ssn.node_index(t.node_name)
+                for t in pg.pods.values()
+                if t.is_active_allocated() and t.node_name}
+
+    def extra_scores(self, tasks):
+        n = self.ssn.node_idle.shape[0]
+        out = None
+        for i, task in enumerate(tasks):
+            peers = getattr(task, "pod_affinity_peers", None) or []
+            anti = getattr(task, "pod_anti_affinity_peers", None) or []
+            if not peers and not anti:
+                continue
+            if out is None:
+                out = np.zeros((len(tasks), n))
+            for uid in peers:
+                for idx in self._job_nodes(uid):
+                    if idx >= 0:
+                        out[i, idx] += AFFINITY_SCORE
+            for uid in anti:
+                for idx in self._job_nodes(uid):
+                    if idx >= 0:
+                        out[i, idx] -= AFFINITY_SCORE
+        return out

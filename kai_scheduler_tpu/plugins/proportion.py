@@ -176,8 +176,20 @@ class ProportionPlugin(Plugin):
             self.total, ssn.config.k_value, hier,
             stack("deserved"), stack("limit"), stack("over_quota_weight"),
             stack("request"), stack("usage"))
+        from ..utils.metrics import METRICS
         for qid, i in index.items():
             self.queues[qid].fair_share = fair[i]
+            # Queue fair-share/usage gauges (metrics.UpdateQueueFairShare,
+            # resource_division.go:44-90).
+            q = self.queues[qid]
+            METRICS.set_gauge("queue_fair_share_gpu",
+                              float(q.fair_share[rs.RES_GPU]), queue=qid)
+            METRICS.set_gauge(
+                "queue_fair_share_cpu_cores",
+                float(q.fair_share[rs.RES_CPU]) / rs.MILLI_CPU_TO_CORES,
+                queue=qid)
+            METRICS.set_gauge("queue_allocated_gpus",
+                              float(q.allocated[rs.RES_GPU]), queue=qid)
 
     # -- event handlers (proportion.go:446-476) ----------------------------
     def on_allocate(self, task) -> None:

@@ -78,12 +78,16 @@ def build_cluster(spec: dict) -> ClusterInfo:
                     gpu_memory=t.get("gpu_memory")))
             if t.get("gpu_group"):
                 task.gpu_group = t["gpu_group"]
+            task.resource_claims = list(t.get("resource_claims", ()))
+            task.pod_affinity_peers = list(t.get("affinity", ()))
+            task.pod_anti_affinity_peers = list(t.get("anti_affinity", ()))
             pg.add_task(task)
         podgroups[name] = pg
 
     return ClusterInfo(nodes, podgroups, queues,
                        topologies=spec.get("topologies", {}),
-                       now=spec.get("now", 1000.0))
+                       now=spec.get("now", 1000.0),
+                       resource_claims=spec.get("resource_claims", {}))
 
 
 def build_session(spec: dict, config: SchedulerConfig | None = None

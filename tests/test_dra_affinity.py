@@ -1,0 +1,100 @@
+"""DRA (dynamicresources) and pod-affinity plugin tests."""
+
+import numpy as np
+import pytest
+
+from tests.fixtures import build_session, placements, run_action
+
+
+class TestDRA:
+    def test_claim_pins_task_to_node(self):
+        ssn = build_session({
+            "nodes": {"n1": {"gpu": 8}, "n2": {"gpu": 8}},
+            "queues": {"q": {}},
+            "resource_claims": {
+                "claim-a": {"device_class": "gpu", "node": "n2"}},
+            "jobs": {"j": {"queue": "q",
+                           "tasks": [{"gpu": 1,
+                                      "resource_claims": ["claim-a"]}]}},
+        })
+        run_action(ssn)
+        # The claim is already bound to n2: the task must follow it.
+        assert placements(ssn)["j-0"][0] == "n2"
+
+    def test_unknown_claim_blocks(self):
+        ssn = build_session({
+            "nodes": {"n1": {"gpu": 8}},
+            "queues": {"q": {}},
+            "jobs": {"j": {"queue": "q",
+                           "tasks": [{"gpu": 1,
+                                      "resource_claims": ["missing"]}]}},
+        })
+        run_action(ssn)
+        assert placements(ssn) == {}
+
+    def test_claim_conflict_serializes(self):
+        """Two jobs referencing one unbound claim: only the first gets it
+        this cycle (the claim is assumed in-session)."""
+        ssn = build_session({
+            "nodes": {"n1": {"gpu": 8}, "n2": {"gpu": 8}},
+            "queues": {"q": {}},
+            "resource_claims": {"shared": {"device_class": "gpu"}},
+            "jobs": {
+                "a": {"queue": "q",
+                      "tasks": [{"gpu": 1, "resource_claims": ["shared"]}]},
+                "b": {"queue": "q",
+                      "tasks": [{"gpu": 1, "resource_claims": ["shared"]}]},
+            },
+        })
+        run_action(ssn)
+        p = placements(ssn)
+        assert len(p) == 2
+        # Both placed, but on the SAME node (the claim's assumed node).
+        assert p["a-0"][0] == p["b-0"][0]
+
+    def test_bind_request_carries_claims(self):
+        ssn = build_session({
+            "nodes": {"n1": {"gpu": 8}},
+            "queues": {"q": {}},
+            "resource_claims": {"c1": {"device_class": "gpu"}},
+            "jobs": {"j": {"queue": "q",
+                           "tasks": [{"gpu": 1,
+                                      "resource_claims": ["c1"]}]}},
+        })
+        run_action(ssn)
+        br = ssn.cluster.bind_requests[0]
+        assert getattr(br, "resource_claims", None) == ["c1"]
+
+
+class TestPodAffinity:
+    def test_affinity_attracts(self):
+        ssn = build_session({
+            "nodes": {"n1": {"gpu": 8}, "n2": {"gpu": 8}},
+            "queues": {"q": {}},
+            "jobs": {
+                "anchor": {"queue": "q",
+                           "tasks": [{"gpu": 1, "status": "RUNNING",
+                                      "node": "n2"}]},
+                "friend": {"queue": "q",
+                           "tasks": [{"gpu": 1, "affinity": ["anchor"]}]},
+            },
+        })
+        run_action(ssn)
+        assert placements(ssn)["friend-0"][0] == "n2"
+
+    def test_anti_affinity_repels(self):
+        ssn = build_session({
+            "nodes": {"n1": {"gpu": 8}, "n2": {"gpu": 8}},
+            "queues": {"q": {}},
+            "jobs": {
+                "anchor": {"queue": "q",
+                           "tasks": [{"gpu": 7, "status": "RUNNING",
+                                      "node": "n1"}]},
+                # binpack alone would co-locate with anchor on n1.
+                "loner": {"queue": "q",
+                          "tasks": [{"gpu": 1,
+                                     "anti_affinity": ["anchor"]}]},
+            },
+        })
+        run_action(ssn)
+        assert placements(ssn)["loner-0"][0] == "n2"
