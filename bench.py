@@ -7,9 +7,9 @@ configs:
 
 - primary: 1024 nodes x 2048 pending pods (512 gangs of 4, mixed
   requests/selectors) through the exact per-task kernel;
-- large-gang: 8192 nodes x 98304 pending pods (1024 gangs of 96) through
-  the grouped fill-plan kernel (ops/allocate_grouped.py) — the regime the
-  100k-node/1M-pod north star lives in.
+- large-gang: 98304 nodes x 1,048,576 pending pods (1024 gangs of 1024)
+  through the grouped fill-plan kernel (ops/allocate_grouped.py) — the
+  north-star scale of BASELINE.json on a single chip.
 
 Prints ONE JSON line:
   {"metric": ..., "value": median_ms, "unit": "ms", "vs_baseline": ratio}
@@ -32,9 +32,10 @@ TASKS_PER_JOB = 4
 N_QUEUES = 16
 NORTH_STAR_MS = 100.0
 
-BIG_NODES = 8192
+# North-star-scale config (BASELINE.json): ~100k nodes / 1M pending pods.
+BIG_NODES = 98304
 BIG_JOBS = 1024
-BIG_GANG = 96
+BIG_GANG = 1024
 
 
 def build_arrays(n_nodes=N_NODES, n_jobs=N_JOBS, gang=TASKS_PER_JOB,

@@ -48,23 +48,24 @@ def group_tasks(task_req: np.ndarray, task_job: np.ndarray,
     group_tol [G,Tl], group_count [G], group_job [G]).
     """
     t = task_req.shape[0]
-    group_of_task = np.zeros(t, np.int32)
-    reqs, sels, tols, counts, jobs = [], [], [], [], []
-    prev = None
-    for i in range(t):
-        key = (int(task_job[i]), task_req[i].tobytes(),
-               task_selector[i].tobytes(), task_tolerations[i].tobytes())
-        if key != prev:
-            prev = key
-            reqs.append(task_req[i])
-            sels.append(task_selector[i])
-            tols.append(task_tolerations[i])
-            jobs.append(int(task_job[i]))
-            counts.append(0)
-        counts[-1] += 1
-        group_of_task[i] = len(counts) - 1
-    return (group_of_task, np.stack(reqs), np.stack(sels), np.stack(tols),
-            np.array(counts, np.float64), np.array(jobs, np.int32))
+    if t == 0:
+        return (np.zeros(0, np.int32), np.zeros((0, task_req.shape[1])),
+                np.zeros((0, task_selector.shape[1]), np.int32),
+                np.zeros((0, task_tolerations.shape[1]), np.int32),
+                np.zeros(0), np.zeros(0, np.int32))
+    change = np.zeros(t, bool)
+    change[0] = True
+    change[1:] = (
+        (task_job[1:] != task_job[:-1])
+        | (task_req[1:] != task_req[:-1]).any(axis=1)
+        | (task_selector[1:] != task_selector[:-1]).any(axis=1)
+        | (task_tolerations[1:] != task_tolerations[:-1]).any(axis=1))
+    group_of_task = (np.cumsum(change) - 1).astype(np.int32)
+    starts = np.flatnonzero(change)
+    counts = np.diff(np.append(starts, t)).astype(np.float64)
+    return (group_of_task, task_req[starts], task_selector[starts],
+            task_tolerations[starts], counts,
+            task_job[starts].astype(np.int32))
 
 
 def _compact(take_sorted, order, max_group: int):
@@ -139,7 +140,15 @@ def allocate_groups_kernel(node_allocatable, node_idle, node_releasing,
         score = score_row(node_allocatable, idle, req, feasible, fit_now,
                           gpu_strategy, cpu_strategy)
         score = jnp.where(feasible, score, NEG)
-        order = jnp.argsort(-score, stable=True).astype(jnp.int32)
+        # Top-K selection instead of a full sort: every feasible node has
+        # capacity >= 1 task (fit_now or fit_future implies one fits), so
+        # the K = max_group best-scoring nodes always carry enough capacity
+        # for a gang of <= max_group tasks — the fill can never reach rank
+        # K+1.  top_k is stable (ties -> lower index), matching the exact
+        # kernel's argmax tie-break.
+        k_sel = min(K, N)
+        _, order = jax.lax.top_k(score, k_sel)  # stable: ties -> low index
+        order = order.astype(jnp.int32)
 
         safe_req = jnp.where(req > 0, req, 1.0)
         cap_now_f = jnp.min(jnp.where(req[None, :] > 0,
