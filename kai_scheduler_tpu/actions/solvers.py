@@ -36,21 +36,53 @@ class Scenario:
 
 
 class ScenarioBuilder:
-    """Accumulate victim jobs one at a time (pod_scenario_builder.go:79)."""
+    """Accumulate victims one step at a time (pod_scenario_builder.go:79).
+
+    Elastic victims shrink before they die (proportion.getVictimResources
+    splitVictimTasks): a job running above its gang minimum first offers
+    only its surplus tasks; the core gang joins the scenario in a later
+    step if the surplus wasn't enough.
+    """
 
     def __init__(self, pending_job: PodGroupInfo, pending_tasks: list,
                  ordered_victims: list[PodGroupInfo]):
         self.scenario = Scenario(pending_job, pending_tasks)
-        self._remaining = list(ordered_victims)
+        self._steps: list = []
+        for victim in ordered_victims:
+            elastic, core = _split_victim_tasks(victim)
+            if elastic:
+                self._steps.append((victim, elastic))
+            if core:
+                self._steps.append((victim, core))
 
     def has_next(self) -> bool:
-        return bool(self._remaining)
+        return bool(self._steps)
 
     def next_scenario(self) -> Scenario:
-        victim = self._remaining.pop(0)
-        tasks = [t for t in victim.pods.values() if t.is_active_allocated()]
-        self.scenario.victims.append((victim, tasks))
+        victim, tasks = self._steps.pop(0)
+        for i, (vjob, vtasks) in enumerate(self.scenario.victims):
+            if vjob.uid == victim.uid:
+                self.scenario.victims[i] = (vjob, vtasks + tasks)
+                break
+        else:
+            self.scenario.victims.append((victim, tasks))
         return self.scenario
+
+
+def _split_victim_tasks(victim: PodGroupInfo):
+    """(elastic surplus tasks, core gang tasks), newest surplus first."""
+    elastic, core = [], []
+    for ps in victim.pod_sets.values():
+        active = sorted(
+            (t for t in ps.pods.values() if t.is_active_allocated()),
+            key=lambda t: (t.name, t.uid))
+        surplus = len(active) - ps.min_available
+        if surplus > 0:
+            elastic.extend(active[ps.min_available:])
+            core.extend(active[:ps.min_available])
+        else:
+            core.extend(active)
+    return elastic, core
 
 
 @dataclass

@@ -125,21 +125,36 @@ def humanize(v: np.ndarray) -> str:
             f"mem={v[RES_MEM] / MEMORY_TO_GB:g}GB gpu={v[RES_GPU]:g}")
 
 
+_MIG_RE = re.compile(r"mig-(\d+)g\.(\d+)gb$")
+
+
+def parse_mig_profile(resource_name: str) -> tuple[float, float]:
+    """(gpu slices, memory bytes) from a MIG resource name like
+    "nvidia.com/mig-1g.5gb" (resources.ExtractGpuAndMemoryFromMigResourceName
+    — each 'g' slice counts as one GPU unit for quota math,
+    allocation_info.go:80-84)."""
+    m = _MIG_RE.search(resource_name)
+    if not m:
+        raise ValueError(f"not a MIG resource name: {resource_name!r}")
+    return float(m.group(1)), float(m.group(2)) * 1e9
+
+
 @dataclass
 class ResourceRequirements:
     """A task's resource request, including fractional-accelerator forms.
 
     Mirrors resource_info.ResourceRequirements / GpuResourceRequirement
     (reference: pkg/scheduler/api/resource_info/resource_requirment.go):
-    a task requests either N whole GPUs, a fraction of one GPU, or a GPU
+    a task requests either N whole GPUs, a fraction of one GPU, a GPU
     memory amount (converted to a fraction against node GPU memory at
-    snapshot time).
+    snapshot time), or MIG profile instances.
     """
 
     base: np.ndarray = field(default_factory=zeros)  # cpu/mem (+whole gpus)
     gpu_fraction: float = 0.0      # 0 < f < 1 when sharing one device
     gpu_memory_bytes: float = 0.0  # alternative fractional form
     num_fraction_devices: int = 1  # multi-fraction gangs (rare)
+    mig_resources: dict = field(default_factory=dict)  # profile -> count
 
     @property
     def is_fractional(self) -> bool:
@@ -167,21 +182,27 @@ class ResourceRequirements:
             else:
                 frac = 1.0
             v[RES_GPU] = frac * self.num_fraction_devices
+        for profile, count in self.mig_resources.items():
+            slices, _mem = parse_mig_profile(profile)
+            v[RES_GPU] += slices * count
         return v
 
     @classmethod
     def from_spec(cls, cpu=None, memory=None, gpu: float = 0.0,
                   gpu_fraction: float = 0.0, gpu_memory=None,
-                  num_fraction_devices: int = 1) -> "ResourceRequirements":
+                  num_fraction_devices: int = 1,
+                  mig: dict | None = None) -> "ResourceRequirements":
         base = vec_from_spec(cpu, memory, gpu if gpu_fraction == 0.0 else 0.0)
         return cls(
             base=base,
             gpu_fraction=float(gpu_fraction),
             gpu_memory_bytes=parse_memory(gpu_memory) if gpu_memory else 0.0,
             num_fraction_devices=num_fraction_devices,
+            mig_resources=dict(mig or {}),
         )
 
     def clone(self) -> "ResourceRequirements":
         return ResourceRequirements(self.base.copy(), self.gpu_fraction,
                                     self.gpu_memory_bytes,
-                                    self.num_fraction_devices)
+                                    self.num_fraction_devices,
+                                    dict(self.mig_resources))

@@ -30,6 +30,7 @@ PHASE_TO_STATUS = {
 
 def _requests_to_reqreq(pod: dict) -> ResourceRequirements:
     cpu_milli = mem = gpu = 0.0
+    mig: dict = {}
     for c in pod.get("spec", {}).get("containers", []):
         req = c.get("resources", {}).get("requests", {})
         if "cpu" in req:
@@ -38,13 +39,16 @@ def _requests_to_reqreq(pod: dict) -> ResourceRequirements:
             mem += rs.parse_memory(req["memory"])
         if "nvidia.com/gpu" in req:
             gpu += float(req["nvidia.com/gpu"])
+        for name, qty in req.items():
+            if "mig-" in name:
+                mig[name] = mig.get(name, 0) + int(qty)
     ann = pod.get("metadata", {}).get("annotations", {})
     fraction = float(ann.get(GPU_FRACTION_ANNOTATION, 0) or 0)
     gpu_memory = ann.get(GPU_MEMORY_ANNOTATION)
     return ResourceRequirements.from_spec(
         cpu=cpu_milli / 1000.0 if cpu_milli else None,
         memory=mem if mem else None,
-        gpu=gpu, gpu_fraction=fraction, gpu_memory=gpu_memory)
+        gpu=gpu, gpu_fraction=fraction, gpu_memory=gpu_memory, mig=mig)
 
 
 def _quota_vec(spec: dict | None):

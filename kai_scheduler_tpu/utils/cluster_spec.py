@@ -75,7 +75,8 @@ def build_cluster(spec: dict) -> ClusterInfo:
                 res_req=ResourceRequirements.from_spec(
                     t.get("cpu", "1"), t.get("mem", "1Gi"), t.get("gpu", 0),
                     gpu_fraction=t.get("gpu_fraction", 0.0),
-                    gpu_memory=t.get("gpu_memory")))
+                    gpu_memory=t.get("gpu_memory"),
+                    mig=t.get("mig")))
             if t.get("gpu_group"):
                 task.gpu_group = t["gpu_group"]
             task.resource_claims = list(t.get("resource_claims", ()))

@@ -1,0 +1,109 @@
+"""Elastic shrink-before-kill reclaim, MIG requests, and 2-level
+hierarchical queue reclaim (BASELINE config #3 behavior)."""
+
+import numpy as np
+import pytest
+
+from kai_scheduler_tpu.api import PodStatus, resources as rs
+from kai_scheduler_tpu.api.resources import parse_mig_profile
+from tests.fixtures import build_session, placements, run_action
+
+
+class TestElasticVictims:
+    def test_elastic_job_shrinks_before_dying(self):
+        """An elastic victim running 4 pods with min_available=2 loses only
+        its surplus when that frees enough (reclaimable shrink,
+        docs/elastic)."""
+        ssn = build_session({
+            "nodes": {"n1": {"gpu": 8}},
+            "queues": {
+                "q_a": {"deserved": dict(cpu="16", memory="128Gi", gpu=4)},
+                "q_b": {"deserved": dict(cpu="16", memory="128Gi", gpu=4)},
+            },
+            "jobs": {
+                "elastic": {"queue": "q_a", "min_available": 2,
+                            "tasks": [{"gpu": 2, "status": "RUNNING",
+                                       "node": "n1"}] * 4},
+                "starved": {"queue": "q_b", "tasks": [{"gpu": 4}]},
+            },
+        })
+        run_action(ssn, "reclaim")
+        # Exactly the 2 surplus pods evicted; core gang survives.
+        assert len(ssn.cache.evicted) == 2
+        el = ssn.cluster.podgroups["elastic"]
+        running = [t for t in el.pods.values()
+                   if t.status == PodStatus.RUNNING]
+        assert len(running) == 2
+        assert placements(ssn)["starved-0"][1] == "PIPELINED"
+
+
+class TestMig:
+    def test_parse_profiles(self):
+        assert parse_mig_profile("nvidia.com/mig-1g.5gb") == (1.0, 5e9)
+        assert parse_mig_profile("nvidia.com/mig-3g.20gb") == (3.0, 20e9)
+        with pytest.raises(ValueError):
+            parse_mig_profile("nvidia.com/gpu")
+
+    def test_mig_request_accounting(self):
+        """MIG slices charge g-units against the GPU axis
+        (allocation_info.go:80-84)."""
+        ssn = build_session({
+            "nodes": {"n1": {"gpu": 8}},
+            "queues": {"q": {}},
+            "jobs": {"mig": {"queue": "q",
+                             "tasks": [{"cpu": "1", "mem": "1Gi",
+                                        "mig": {"nvidia.com/mig-3g.20gb": 2}
+                                        }]}},
+        })
+        run_action(ssn)
+        assert placements(ssn)["mig-0"][0] == "n1"
+        assert ssn.cluster.nodes["n1"].used[rs.RES_GPU] == 6.0
+
+    def test_mig_over_capacity_blocked(self):
+        ssn = build_session({
+            "nodes": {"n1": {"gpu": 2}},
+            "queues": {"q": {}},
+            "jobs": {"mig": {"queue": "q",
+                             "tasks": [{"mig": {"nvidia.com/mig-3g.20gb": 1}
+                                        }]}},
+        })
+        run_action(ssn)
+        assert placements(ssn) == {}
+
+
+class TestHierarchicalReclaim:
+    def test_two_level_queue_reclaim(self):
+        """Departments with team sub-queues: a starved team in dept B
+        reclaims from dept A's over-share team."""
+        ssn = build_session({
+            "nodes": {"n1": {"gpu": 8}},
+            "queues": {
+                "dept_a": {"deserved": dict(cpu="16", memory="128Gi",
+                                            gpu=4)},
+                "dept_b": {"deserved": dict(cpu="16", memory="128Gi",
+                                            gpu=4)},
+                "team_a1": {"parent": "dept_a",
+                            "deserved": dict(cpu="16", memory="128Gi",
+                                             gpu=4)},
+                "team_b1": {"parent": "dept_b",
+                            "deserved": dict(cpu="16", memory="128Gi",
+                                             gpu=4)},
+            },
+            "jobs": {
+                "hog1": {"queue": "team_a1",
+                         "tasks": [{"gpu": 4, "status": "RUNNING",
+                                    "node": "n1"}]},
+                "hog2": {"queue": "team_a1", "creation_ts": 5.0,
+                         "tasks": [{"gpu": 4, "status": "RUNNING",
+                                    "node": "n1"}]},
+                "starved": {"queue": "team_b1", "tasks": [{"gpu": 4}]},
+            },
+        })
+        run_action(ssn, "reclaim")
+        assert len(ssn.cache.evicted) == 1
+        assert placements(ssn)["starved-0"][1] == "PIPELINED"
+        # Fair shares computed hierarchically: team fair share bounded by
+        # its department's.
+        attrs = ssn.proportion.queues
+        assert attrs["team_a1"].fair_share[rs.RES_GPU] <= \
+            attrs["dept_a"].fair_share[rs.RES_GPU] + 1e-9
