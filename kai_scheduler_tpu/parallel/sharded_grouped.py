@@ -1,0 +1,260 @@
+"""Multi-chip grouped gang allocation: fill plans over a sharded node axis.
+
+Combines the two scaling ideas of this framework:
+- ops/allocate_grouped.py: one analytic fill plan per run of identical
+  tasks (scan length = number of groups);
+- parallel/sharded.py: the node axis sharded across chips with ICI
+  collectives replacing global reductions.
+
+Per group, each shard computes its local candidate top-K; an all_gather
+merges the (score, global index, capacities) triples and a second top-K —
+stable, so ties keep global-index order — yields the global candidate set,
+on which the two-phase fill plan computes REPLICATED take amounts; each
+shard then scatters the takes it owns into its local node state.  The
+gathered working set is [devices x K], independent of cluster size: the
+per-group cost stays flat as nodes scale out across chips.
+
+Exactness matches allocate_grouped (and therefore the per-task kernel):
+every feasible node carries >= 1 task of capacity, so K = max_group
+candidates suffice.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.allocate import NEG, AllocationResult
+from ..ops.allocate_grouped import _next_pow2, group_tasks
+from ..ops.predicates import feasibility_row
+from ..ops.scoring import BINPACK, score_row
+from .mesh import NODE_AXIS
+from .sharded import _global_minmax
+
+
+def sharded_allocate_groups_kernel(mesh, node_allocatable, node_idle,
+                                   node_releasing, node_labels, node_taints,
+                                   node_pod_room, group_req, group_sel,
+                                   group_tol, group_count, group_job,
+                                   job_allowed, max_group: int,
+                                   gpu_strategy: int = BINPACK,
+                                   cpu_strategy: int = BINPACK,
+                                   allow_pipeline: bool = True):
+    """Returns (seg_nodes [G,K] global ids, seg_counts [G,K],
+    seg_pipe [G,K], group_placed [G], job_success [J], idle', rel')."""
+    n = node_allocatable.shape[0]
+    d = mesh.devices.size
+    assert n % d == 0, f"node axis {n} must divide mesh size {d}"
+    G = group_req.shape[0]
+    K = max_group
+
+    from jax.sharding import PartitionSpec as P
+    node_spec = P(NODE_AXIS)
+    rep = P()
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(node_spec,) * 6 + (rep,) * 6,
+        out_specs=(rep, rep, rep, rep, node_spec, node_spec),
+        check_vma=False)
+    def run(alloc, idle, rel, labels, taints, room,
+            g_req, g_sel, g_tol, g_count, g_job, j_allowed):
+        n_local = alloc.shape[0]
+        my_dev = jax.lax.axis_index(NODE_AXIS)
+        offset = my_dev * n_local
+        k_local = min(K, n_local)
+
+        class Carry(NamedTuple):
+            idle: jnp.ndarray
+            rel: jnp.ndarray
+            room: jnp.ndarray
+            ck_idle: jnp.ndarray
+            ck_rel: jnp.ndarray
+            ck_room: jnp.ndarray
+            cur_job: jnp.ndarray
+            cur_ok: jnp.ndarray
+
+        init = Carry(idle, rel, room, idle, rel, room,
+                     jnp.array(-1, jnp.int32), jnp.array(False))
+
+        def step(carry: Carry, g):
+            j = g_job[g]
+            new_job = j != carry.cur_job
+            keep = jnp.where(new_job & ~carry.cur_ok, False, True)
+            c_idle = jnp.where(keep, carry.idle, carry.ck_idle)
+            c_rel = jnp.where(keep, carry.rel, carry.ck_rel)
+            c_room = jnp.where(keep, carry.room, carry.ck_room)
+            ck_idle = jnp.where(new_job, c_idle, carry.ck_idle)
+            ck_rel = jnp.where(new_job, c_rel, carry.ck_rel)
+            ck_room = jnp.where(new_job, c_room, carry.ck_room)
+            ok = jnp.where(new_job, j_allowed[j], carry.cur_ok)
+
+            req = g_req[g]
+            count = jnp.where(ok, g_count[g], 0.0)
+
+            fit_now, fit_future = feasibility_row(
+                c_idle, c_rel, labels, taints, c_room, req, g_sel[g],
+                g_tol[g])
+            feasible = fit_now | (fit_future if allow_pipeline
+                                  else jnp.zeros_like(fit_future))
+            minmax = _global_minmax(c_idle, feasible, NODE_AXIS)
+            score = score_row(alloc, c_idle, req, feasible, fit_now,
+                              gpu_strategy, cpu_strategy, minmax=minmax)
+            score = jnp.where(feasible, score, NEG)
+
+            safe_req = jnp.where(req > 0, req, 1.0)
+            cap_now_f = jnp.min(
+                jnp.where(req[None, :] > 0,
+                          jnp.floor(c_idle / safe_req[None, :]), jnp.inf),
+                axis=1)
+            cap_tot_f = jnp.min(
+                jnp.where(req[None, :] > 0,
+                          jnp.floor((c_idle + c_rel) / safe_req[None, :]),
+                          jnp.inf), axis=1)
+            cap_now = jnp.where(fit_now, jnp.minimum(cap_now_f, c_room),
+                                0.0)
+            cap_tot = jnp.where(feasible, jnp.minimum(cap_tot_f, c_room),
+                                0.0)
+            cap_now = jnp.clip(cap_now, 0.0, count)
+            cap_tot = jnp.clip(cap_tot, 0.0, count)
+
+            # Local candidates -> global merge over ICI.
+            l_score, l_idx = jax.lax.top_k(score, k_local)
+            cand_scores = jax.lax.all_gather(l_score, NODE_AXIS).ravel()
+            cand_gidx = jax.lax.all_gather(l_idx + offset,
+                                           NODE_AXIS).ravel()
+            cand_now = jax.lax.all_gather(cap_now[l_idx],
+                                          NODE_AXIS).ravel()
+            cand_tot = jax.lax.all_gather(cap_tot[l_idx],
+                                          NODE_AXIS).ravel()
+            k_glob = min(K, cand_scores.shape[0])
+            # Stable second top-k keeps (device, local-rank) order, which
+            # is global-index order among score ties.
+            g_score, pick = jax.lax.top_k(cand_scores, k_glob)
+            order_gidx = cand_gidx[pick]
+            sel_now = jnp.where(g_score > NEG / 2, cand_now[pick], 0.0)
+            sel_tot = jnp.where(g_score > NEG / 2, cand_tot[pick], 0.0)
+
+            # Replicated two-phase fill plan on the candidate set.
+            pref_a = jnp.cumsum(sel_now)
+            take_a = jnp.clip(count - (pref_a - sel_now), 0.0, sel_now)
+            total_now = take_a.sum()
+            cap_b = sel_tot - take_a
+            remaining = jnp.maximum(count - total_now, 0.0)
+            pref_b = jnp.cumsum(cap_b)
+            take_b = jnp.clip(remaining - (pref_b - cap_b), 0.0, cap_b)
+            if not allow_pipeline:
+                take_b = jnp.zeros_like(take_b)
+            placed = total_now + take_b.sum()
+
+            # Scatter the takes this shard owns.
+            local_pos = order_gidx - offset
+            mine = (local_pos >= 0) & (local_pos < n_local)
+            safe_pos = jnp.clip(local_pos, 0, n_local - 1)
+            n_now = jnp.zeros(n_local).at[safe_pos].add(
+                jnp.where(mine, take_a, 0.0))
+            n_pipe = jnp.zeros(n_local).at[safe_pos].add(
+                jnp.where(mine, take_b, 0.0))
+            c_idle = c_idle - n_now[:, None] * req[None, :]
+            c_rel = c_rel - n_pipe[:, None] * req[None, :]
+            c_room = c_room - n_now - n_pipe
+
+            # Compact segments (pad to K for a static output shape).
+            pad = K - k_glob
+            seg_nodes_a = jnp.pad(
+                jnp.where(take_a > 0, order_gidx, -1), (0, pad),
+                constant_values=-1)
+            seg_take_a = jnp.pad(take_a, (0, pad))
+            seg_nodes_b = jnp.pad(
+                jnp.where(take_b > 0, order_gidx, -1), (0, pad),
+                constant_values=-1)
+            seg_take_b = jnp.pad(take_b, (0, pad))
+
+            ok = ok & (placed >= count)
+            return (Carry(c_idle, c_rel, c_room, ck_idle, ck_rel, ck_room,
+                          j.astype(jnp.int32), ok),
+                    (seg_nodes_a, seg_take_a, seg_nodes_b, seg_take_b,
+                     placed))
+
+        carry, outs = jax.lax.scan(step, init, jnp.arange(G))
+        seg_nodes_a, seg_take_a, seg_nodes_b, seg_take_b, placed = outs
+        f_idle = jnp.where(carry.cur_ok, carry.idle, carry.ck_idle)
+        f_rel = jnp.where(carry.cur_ok, carry.rel, carry.ck_rel)
+        packed = jnp.concatenate([
+            seg_nodes_a.astype(jnp.float32).ravel(),
+            seg_take_a.astype(jnp.float32).ravel(),
+            seg_nodes_b.astype(jnp.float32).ravel(),
+            seg_take_b.astype(jnp.float32).ravel(),
+        ])
+        return packed, placed, jnp.zeros(()), jnp.zeros(()), f_idle, f_rel
+
+    packed, group_placed, _, _, idle_out, rel_out = run(
+        node_allocatable, node_idle, node_releasing, node_labels,
+        node_taints, node_pod_room, group_req, group_sel, group_tol,
+        group_count, group_job, job_allowed)
+
+    num_jobs = job_allowed.shape[0]
+    placed_per_job = jax.ops.segment_sum(group_placed, group_job,
+                                         num_segments=num_jobs)
+    count_per_job = jax.ops.segment_sum(group_count, group_job,
+                                        num_segments=num_jobs)
+    job_success = (count_per_job > 0) & (placed_per_job >= count_per_job) \
+        & job_allowed
+    return packed, group_placed, job_success, idle_out, rel_out
+
+
+def sharded_allocate_grouped(mesh, node_arrays, task_req, task_job,
+                             task_selector, task_tolerations, job_allowed,
+                             gpu_strategy: int = BINPACK,
+                             cpu_strategy: int = BINPACK,
+                             allow_pipeline: bool = True
+                             ) -> AllocationResult:
+    """Host wrapper mirroring ops.allocate_grouped.allocate_grouped for a
+    device mesh."""
+    np_req = np.asarray(task_req)
+    np_job = np.asarray(task_job)
+    np_sel = np.asarray(task_selector)
+    np_tol = np.asarray(task_tolerations)
+    (group_of_task, g_req, g_sel, g_tol, g_count,
+     g_job) = group_tasks(np_req, np_job, np_sel, np_tol)
+    max_group = _next_pow2(int(g_count.max()) if len(g_count) else 1)
+
+    packed, group_placed, job_success, idle, rel = \
+        sharded_allocate_groups_kernel(
+            mesh, *node_arrays, jnp.asarray(g_req), jnp.asarray(g_sel),
+            jnp.asarray(g_tol), jnp.asarray(g_count), jnp.asarray(g_job),
+            jnp.asarray(job_allowed), max_group,
+            gpu_strategy=gpu_strategy, cpu_strategy=cpu_strategy,
+            allow_pipeline=allow_pipeline)
+
+    packed = np.asarray(packed)
+    g, k = len(g_count), max_group
+    seg_nodes_a = packed[:g * k].reshape(g, k).astype(np.int32)
+    seg_take_a = packed[g * k:2 * g * k].reshape(g, k).astype(np.int64)
+    seg_nodes_b = packed[2 * g * k:3 * g * k].reshape(g, k).astype(np.int32)
+    seg_take_b = packed[3 * g * k:4 * g * k].reshape(g, k).astype(np.int64)
+    success = np.asarray(job_success)
+
+    T = np_req.shape[0]
+    placements = np.full(T, -1, np.int32)
+    pipelined = np.zeros(T, bool)
+    t = 0
+    for gi in range(g):
+        count = int(g_count[gi])
+        if success[g_job[gi]]:
+            nodes = np.concatenate([
+                np.repeat(seg_nodes_a[gi], seg_take_a[gi]),
+                np.repeat(seg_nodes_b[gi], seg_take_b[gi])])
+            pipes = np.concatenate([
+                np.zeros(seg_take_a[gi].sum(), bool),
+                np.ones(seg_take_b[gi].sum(), bool)])
+            m = min(len(nodes), count)
+            placements[t:t + m] = nodes[:m]
+            pipelined[t:t + m] = pipes[:m]
+        t += count
+    return AllocationResult(placements, pipelined, jnp.asarray(success),
+                            idle, rel)

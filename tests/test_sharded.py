@@ -92,3 +92,53 @@ class TestShardedCycleStep:
         assert out["result"].placements.shape == (t,)
         # Everything feasible should be placed.
         assert int((out["result"].placements >= 0).sum()) > 0
+
+
+class TestShardedGrouped:
+    @pytest.mark.parametrize("seed", range(3))
+    def test_matches_single_chip_grouped(self, seed):
+        from kai_scheduler_tpu.ops.allocate_grouped import allocate_grouped
+        from kai_scheduler_tpu.parallel.sharded_grouped import (
+            sharded_allocate_grouped)
+
+        rng = np.random.default_rng(seed)
+        mesh = cluster_mesh()
+        n_nodes = 16 * mesh.devices.size
+        # Identical-task gangs (the grouped kernels' domain).
+        alloc = np.tile([8000.0, 64e9, 8.0], (n_nodes, 1))
+        idle = alloc.copy()
+        idle[:, 2] -= rng.integers(0, 6, n_nodes)
+        rel = np.zeros((n_nodes, 3))
+        rel[:, 2] = rng.integers(0, 2, n_nodes)
+        labels = np.full((n_nodes, 1), -1, np.int32)
+        labels[: n_nodes // 2, 0] = 0
+        taints = np.full((n_nodes, 1), -1, np.int32)
+        room = np.full(n_nodes, 110.0)
+        reqs, jobs, sels = [], [], []
+        for j in range(5):
+            gang = int(rng.integers(1, 9))
+            gpu = float(rng.integers(1, 4))
+            sel = 0 if rng.random() < 0.3 else -1
+            for _ in range(gang):
+                reqs.append([1000.0, 1e9, gpu])
+                jobs.append(j)
+                sels.append(sel)
+        req = np.array(reqs)
+        task_job = np.array(jobs, np.int32)
+        sel = np.array(sels, np.int32)[:, None]
+        tol = np.full((len(reqs), 1), -1, np.int32)
+        ja = np.ones(5, bool)
+        ja[int(rng.integers(5))] = False
+        nodes = tuple(jnp.asarray(x)
+                      for x in (alloc, idle, rel, labels, taints, room))
+        tasks = tuple(jnp.asarray(x) for x in (req, task_job, sel, tol))
+
+        single = allocate_grouped(nodes, *tasks, jnp.asarray(ja))
+        multi = sharded_allocate_grouped(mesh, nodes, *tasks,
+                                         jnp.asarray(ja))
+        np.testing.assert_array_equal(np.asarray(single.job_success),
+                                      np.asarray(multi.job_success))
+        np.testing.assert_array_equal(single.placements, multi.placements)
+        np.testing.assert_array_equal(single.pipelined, multi.pipelined)
+        np.testing.assert_allclose(np.asarray(single.node_idle),
+                                   np.asarray(multi.node_idle))
