@@ -199,6 +199,27 @@ class ClusterCache:
             "spec": {"reason": kind, "message": message},
         })
 
+    def update_job_statuses(self, ssn) -> None:
+        """Push scheduling explanations onto PodGroup statuses
+        (status_updater markPodGroupUnschedulable,
+        default_status_updater.go:295)."""
+        for pg in ssn.cluster.podgroups.values():
+            if not pg.fit_errors:
+                continue
+            obj = self.api.get_opt("PodGroup", pg.uid, pg.namespace)
+            if obj is None:
+                continue
+            status = obj.setdefault("status", {})
+            conditions = [c for c in status.get("conditions", [])
+                          if c.get("type") != "Unschedulable"]
+            conditions.append({
+                "type": "Unschedulable", "status": "True",
+                "reason": "SchedulingFailed",
+                "message": pg.fit_errors[-1],
+            })
+            status["conditions"] = conditions
+            self.api.update(obj)
+
     def gc_stale_bind_requests(self) -> int:
         """Stale BindRequest GC (cache/cache.go:371): drop requests whose
         pod vanished or already bound."""

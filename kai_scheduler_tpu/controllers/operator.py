@@ -36,6 +36,15 @@ class SystemConfig:
     shards: list = field(default_factory=lambda: [ShardSpec()])
     require_queue_label: bool = False
     now_fn: object = None
+    # Time-based fairness: usage-db client spec ("memory://", None = off)
+    # and its window/decay parameters (cache/usagedb params analog).
+    usage_db: str | None = None
+    usage_params: object = None
+    # Feature gates (pkg/common/feature_gates analog).
+    feature_gates: dict = field(default_factory=dict)
+
+    def gate(self, name: str, default: bool = True) -> bool:
+        return bool(self.feature_gates.get(name, default))
 
 
 class System:
@@ -55,12 +64,21 @@ class System:
         self.binder = Binder(self.api)
         self.scale_adjuster = NodeScaleAdjuster(self.api, now_fn)
         self.cache = ClusterCache(self.api, now_fn)
+        self._now_fn = now_fn
+        # Historical-usage store for time-based fairness.
+        from ..utils.usagedb import resolve_usage_client
+        self.usage_db = resolve_usage_client(self.config.usage_db,
+                                             self.config.usage_params)
+        usage_provider = (
+            (lambda: self.usage_db.queue_usage(now_fn()))
+            if self.usage_db else None)
         self.schedulers = []
         for shard in self.config.shards:
             cache = ClusterCache(self.api, now_fn)
             provider = self._shard_provider(cache, shard)
             self.schedulers.append(
-                Scheduler(provider, shard.config, cache=cache))
+                Scheduler(provider, shard.config, cache=cache,
+                          usage_provider=usage_provider))
 
     def _shard_provider(self, cache: ClusterCache, shard: ShardSpec):
         def provider():
@@ -82,7 +100,13 @@ class System:
         scheduling cycle, drain the binder's work."""
         self.api.drain()
         for scheduler in self.schedulers:
-            scheduler.run_once()
+            ssn = scheduler.run_once()
+            scheduler.cache.update_job_statuses(ssn)
+            if self.usage_db is not None \
+                    and getattr(ssn, "proportion", None) is not None:
+                for qid, attrs in ssn.proportion.queues.items():
+                    self.usage_db.record(self._now_fn(), qid,
+                                         attrs.allocated)
         self.api.drain()
         self.cache.gc_stale_bind_requests()
         self.api.drain()

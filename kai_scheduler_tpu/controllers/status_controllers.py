@@ -62,8 +62,14 @@ class PodGroupController:
                   "pending": counts["Pending"],
                   "succeeded": counts["Succeeded"],
                   "failed": counts["Failed"]}
-        if pg.get("status") != status:
-            pg["status"] = status
+        current = pg.get("status", {})
+        # Preserve fields other writers own (scheduler conditions,
+        # lastStartTimestamp) — reconcile only the counters/phase.
+        merged = {**current, **status}
+        if phase == "Running" and "lastStartTimestamp" not in current:
+            merged["lastStartTimestamp"] = None
+        if current != merged:
+            pg["status"] = merged
             self.api.update(pg)
 
 

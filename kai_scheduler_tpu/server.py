@@ -126,14 +126,26 @@ def run_app(argv=None) -> None:
                     help="comma-separated action order override")
     ap.add_argument("--cycles", type=int, default=0,
                     help="stop after N cycles (0 = forever)")
+    ap.add_argument("--profile-dir", default=None,
+                    help="write a JAX profiler trace of the run here "
+                         "(the pprof/Pyroscope analog)")
+    ap.add_argument("--usage-db", default=None,
+                    help="usage client spec for time-based fairness, "
+                         "e.g. memory://")
     args = ap.parse_args(argv)
 
     init_loggers(args.verbosity)
     config = SchedulerConfig(k_value=args.k_value)
     if args.actions:
         config.actions = [a.strip() for a in args.actions.split(",")]
-    system = System(SystemConfig(shards=[ShardSpec(
-        "default", args.node_pool_label, args.node_pool, config)]))
+    system = System(SystemConfig(
+        shards=[ShardSpec("default", args.node_pool_label, args.node_pool,
+                          config)],
+        usage_db=args.usage_db))
+
+    if args.profile_dir:
+        import jax
+        jax.profiler.start_trace(args.profile_dir)
 
     if args.leader_elect:
         LOG.info("waiting for leadership (%s)", args.lock_file)
@@ -162,6 +174,9 @@ def run_app(argv=None) -> None:
                 break
             time.sleep(args.schedule_period)
     finally:
+        if args.profile_dir:
+            import jax
+            jax.profiler.stop_trace()
         httpd.shutdown()
 
 

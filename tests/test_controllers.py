@@ -237,3 +237,33 @@ class TestShards:
         system.run_cycle()
         p = api.get("Pod", "pod-a")
         assert p["spec"].get("nodeName") == "a1"
+
+
+class TestExplainabilityAndUsage:
+    def test_unschedulable_condition_on_podgroup(self):
+        system = System(SystemConfig())
+        api = system.api
+        make_node(api, "n1", gpu=2)
+        make_queue(api, "q")
+        api.create(make_pod("toolarge", queue="q", gpu=8))
+        system.run_cycle()
+        pgs = api.list("PodGroup")
+        conds = pgs[0]["status"].get("conditions", [])
+        assert any(c["type"] == "Unschedulable" and "Resources" in
+                   c["message"] for c in conds)
+
+    def test_usage_db_records_allocations(self):
+        system = System(SystemConfig(usage_db="memory://"))
+        api = system.api
+        make_node(api, "n1", gpu=8)
+        make_queue(api, "q")
+        api.create(make_pod("p1", queue="q", gpu=4))
+        system.run_cycle()
+        system.run_cycle()
+        usage = system.usage_db.queue_usage(0.0)
+        assert usage["q"][2] > 0  # GPU usage recorded for the queue
+
+    def test_feature_gate_accessor(self):
+        cfg = SystemConfig(feature_gates={"newThing": False})
+        assert not cfg.gate("newThing")
+        assert cfg.gate("defaultOn")
