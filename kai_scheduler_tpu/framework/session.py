@@ -257,6 +257,44 @@ class Session:
         if node_subset is not None:
             extra[:, ~node_subset] = -1e17  # mask out-of-subset nodes
 
+        # Homogeneous chunks with no extra score terms take the grouped
+        # fill-plan kernel: one scan step instead of one per task.
+        homogeneous = (
+            t > 1 and node_subset is None and not extra.any()
+            and self.gpu_strategy == BINPACK
+            and self.cpu_strategy == BINPACK
+            and (task_req[1:t] == task_req[0]).all()
+            and (task_sel[1:t] == task_sel[0]).all()
+            and (task_tol[1:t] == task_tol[0]).all())
+        if homogeneous:
+            from ..ops.allocate_grouped import allocate_grouped
+            node_arrays = (
+                jnp.asarray(snap.node_allocatable),
+                jnp.asarray(self.node_idle),
+                jnp.asarray(self.node_releasing),
+                jnp.asarray(snap.node_labels),
+                jnp.asarray(snap.node_taints),
+                jnp.asarray(self.node_room))
+            result = allocate_grouped(
+                node_arrays, task_req[:t], np.zeros(t, np.int32),
+                task_sel[:t], task_tol[:t], np.ones(1, bool),
+                gpu_strategy=self.gpu_strategy,
+                cpu_strategy=self.cpu_strategy,
+                allow_pipeline=allow_pipeline,
+                pipeline_only=pipeline_only)
+            if not bool(result.job_success[0]):
+                return Proposal(False, [])
+            placements = []
+            placed = np.asarray(result.placements)
+            piped = np.asarray(result.pipelined)
+            for i, task in enumerate(tasks):
+                node_idx = int(placed[i])
+                if node_idx < 0:
+                    return Proposal(False, [])
+                placements.append((task, snap.node_names[node_idx],
+                                   bool(piped[i])))
+            return Proposal(True, placements)
+
         result = allocate_jobs_kernel(
             jnp.asarray(snap.node_allocatable), jnp.asarray(self.node_idle),
             jnp.asarray(self.node_releasing),
