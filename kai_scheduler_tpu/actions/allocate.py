@@ -78,6 +78,41 @@ def attempt_to_allocate_job(ssn, job: PodGroupInfo,
     if own_stmt:
         stmt = ssn.statement()
 
+    # Per-subgroup topology constraints (allocateSubGroupSet recursion,
+    # actions/common/allocate.go:38): each constrained podset resolves its
+    # own node subsets; the chunk succeeds only if every podset lands.
+    per_podset = any(ps.has_own_topology_constraint()
+                     for ps in job.pod_sets.values())
+    if per_podset:
+        cp_all = stmt.checkpoint()
+        ok = True
+        for ps_name in sorted({t.subgroup for t in tasks},
+                              key=lambda n: ssn.pod_set_order_key(
+                                  job.pod_sets[n])):
+            sub_tasks = [t for t in tasks if t.subgroup == ps_name]
+            podset = job.pod_sets[ps_name]
+            placed = False
+            for node_subset in ssn.subset_nodes(job, sub_tasks, podset):
+                cp = stmt.checkpoint()
+                if _allocate_tasks_on_subset(ssn, stmt, job, sub_tasks,
+                                             node_subset, pipeline_only):
+                    placed = True
+                    break
+                stmt.rollback(cp)
+            if not placed:
+                ok = False
+                break
+        if ok:
+            if job.should_pipeline():
+                stmt.convert_all_allocated_to_pipelined(job.uid)
+            if own_stmt and commit:
+                stmt.commit()
+            return True
+        stmt.rollback(cp_all)
+        if own_stmt:
+            stmt.discard()
+        return False
+
     for node_subset in ssn.subset_nodes(job, tasks):
         cp = stmt.checkpoint()
         if _allocate_tasks_on_subset(ssn, stmt, job, tasks, node_subset,

@@ -24,13 +24,28 @@ from .pod_status import PodStatus, is_active_allocated, is_active_used, is_alive
 
 
 class PodSet:
-    """Leaf subgroup: a set of interchangeable tasks with a gang minimum."""
+    """Leaf subgroup: a set of interchangeable tasks with a gang minimum.
 
-    def __init__(self, name: str, min_available: int, parent: str | None = None):
+    May carry its own topology constraint (subgroup_info.SubGroupInfo
+    TopologyConstraint — Grove cliques pin e.g. prefill and decode to
+    different racks of one zone)."""
+
+    def __init__(self, name: str, min_available: int,
+                 parent: str | None = None,
+                 topology_name: str | None = None,
+                 required_topology_level: str | None = None,
+                 preferred_topology_level: str | None = None):
         self.name = name
         self.min_available = int(min_available)
         self.parent = parent  # name of parent SubGroupSet node, None = root
+        self.topology_name = topology_name
+        self.required_topology_level = required_topology_level
+        self.preferred_topology_level = preferred_topology_level
         self.pods: dict[str, PodInfo] = {}
+
+    def has_own_topology_constraint(self) -> bool:
+        return bool(self.required_topology_level
+                    or self.preferred_topology_level)
 
     def add(self, task: PodInfo) -> None:
         self.pods[task.uid] = task
@@ -279,8 +294,10 @@ class PodGroupInfo:
             1, self.preemptible, self.creation_ts,
             self.staleness_grace_seconds, self.required_topology_level,
             self.preferred_topology_level, self.topology_name)
-        pg.pod_sets = {n: PodSet(p.name, p.min_available, p.parent)
-                       for n, p in self.pod_sets.items()}
+        pg.pod_sets = {
+            n: PodSet(p.name, p.min_available, p.parent, p.topology_name,
+                      p.required_topology_level, p.preferred_topology_level)
+            for n, p in self.pod_sets.items()}
         pg.subgroup_nodes = {
             n: SubGroupNode(s.name, s.parent, list(s.children),
                             list(s.pod_sets), s.required_level,

@@ -124,8 +124,15 @@ class ClusterCache:
                 preferred_topology_level=topo.get("preferred"))
             pod_sets = spec.get("podSets") or []
             if pod_sets:
-                pg.set_pod_sets([PodSet(ps["name"], ps["minAvailable"])
-                                 for ps in pod_sets])
+                pg.set_pod_sets([
+                    PodSet(ps["name"], ps["minAvailable"],
+                           topology_name=(ps.get("topology") or {}).get(
+                               "name"),
+                           required_topology_level=(
+                               ps.get("topology") or {}).get("required"),
+                           preferred_topology_level=(
+                               ps.get("topology") or {}).get("preferred"))
+                    for ps in pod_sets])
             pg.last_start_ts = pg_obj.get("status", {}).get(
                 "lastStartTimestamp")
             podgroups[name] = pg

@@ -214,11 +214,12 @@ class Session:
         for fn in self.job_solution_start_fns:
             fn()
 
-    def subset_nodes(self, job, tasks) -> list:
+    def subset_nodes(self, job, tasks, podset=None) -> list:
         """Topology plugin hook: ordered list of candidate node-index sets
-        (None = all nodes).  Mirrors ssn.SubsetNodesFn."""
+        (None = all nodes).  Mirrors ssn.SubsetNodesFn; ``podset`` scopes
+        the constraint to one subgroup (allocateSubGroupSet recursion)."""
         for fn in self.subset_nodes_fns:
-            sets = fn(job, tasks)
+            sets = fn(job, tasks, podset)
             if sets is not None:
                 return sets
         return [None]

@@ -127,13 +127,19 @@ class TopologySession:
         self._job_node_scores: dict[str, np.ndarray] = {}
 
     # -- constraint resolution ---------------------------------------------
-    def _job_constraint(self, job):
-        topo_name = job.topology_name or next(iter(self.trees), None)
-        tree = self.trees.get(topo_name)
-        if tree is None:
-            return None
+    def _job_constraint(self, job, podset=None):
+        """Podset-level constraints override the job-level ones
+        (subgroup TopologyConstraint, topology_plugin.go)."""
         required = job.required_topology_level
         preferred = job.preferred_topology_level
+        topo_name = job.topology_name
+        if podset is not None and podset.has_own_topology_constraint():
+            required = podset.required_topology_level
+            preferred = podset.preferred_topology_level
+            topo_name = podset.topology_name or topo_name
+        tree = self.trees.get(topo_name or next(iter(self.trees), ""))
+        if tree is None:
+            return None
         if not required and not preferred:
             return None
         return tree, required, preferred
@@ -153,8 +159,8 @@ class TopologySession:
         return out
 
     # -- the SubsetNodes extension point -----------------------------------
-    def subset_nodes(self, job, tasks):
-        constraint = self._job_constraint(job)
+    def subset_nodes(self, job, tasks, podset=None):
+        constraint = self._job_constraint(job, podset)
         if constraint is None:
             return None
         tree, required, preferred = constraint
@@ -170,11 +176,14 @@ class TopologySession:
         node_free = (ssn.node_idle + ssn.node_releasing)[:n]
         node_room = ssn.node_room[:n]
 
-        # Pin to domains already hosting the job's running pods
-        # (getRelevantDomainsWithAllocatedPods) when required is set.
+        # Pin to domains already hosting running pods of the podset(s)
+        # being allocated (getRelevantDomainsWithAllocatedPods takes the
+        # podSets under allocation, not the whole job) when required is set.
         pinned_domains = None
         if required and required in tree.node_domain:
-            active_nodes = {t.node_name for t in job.pods.values()
+            pods = (podset.pods.values() if podset is not None
+                    else job.pods.values())
+            active_nodes = {t.node_name for t in pods
                             if t.is_active_allocated() and t.node_name}
             if active_nodes:
                 seg_req = tree.node_domain[required]

@@ -61,8 +61,14 @@ def build_cluster(spec: dict) -> ClusterInfo:
             preferred_topology_level=j.get("preferred_topology_level"))
         pg.last_start_ts = j.get("last_start_ts")
         if "pod_sets" in j:
-            pg.set_pod_sets([PodSet(ps["name"], ps["min_available"])
-                             for ps in j["pod_sets"]])
+            pg.set_pod_sets([
+                PodSet(ps["name"], ps["min_available"],
+                       topology_name=ps.get("topology"),
+                       required_topology_level=ps.get(
+                           "required_topology_level"),
+                       preferred_topology_level=ps.get(
+                           "preferred_topology_level"))
+                for ps in j["pod_sets"]])
         for i, t in enumerate(j.get("tasks", [])):
             task = PodInfo(
                 uid=t.get("uid", f"{name}-{i}"),

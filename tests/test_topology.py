@@ -131,3 +131,48 @@ class TestPinnedDomains:
         p = placements(ssn)
         node = p["grow-1"][0]
         assert ssn.cluster.nodes[node].labels["zone"] == "z1"
+
+
+class TestSubgroupConstraints:
+    def test_cliques_pin_to_separate_racks(self):
+        """Grove-style gang: each clique confined to its own rack, both
+        cliques must land (per-subgroup SubsetNodes recursion)."""
+        spec = rack_zone_cluster()
+        spec["jobs"]["dynamo"] = {
+            "topology": "topo",
+            "pod_sets": [
+                {"name": "prefill", "min_available": 2,
+                 "required_topology_level": "rack"},
+                {"name": "decode", "min_available": 2,
+                 "required_topology_level": "rack"},
+            ],
+            "tasks": ([{"gpu": 4, "subgroup": "prefill"}] * 2
+                      + [{"gpu": 4, "subgroup": "decode"}] * 2),
+        }
+        ssn = build_session(spec)
+        run_action(ssn)
+        p = placements(ssn)
+        assert len(p) == 4
+        prefill_nodes = {p[f"dynamo-{i}"][0] for i in range(2)}
+        decode_nodes = {p[f"dynamo-{i}"][0] for i in range(2, 4)}
+        # Each clique within ONE rack (here: one node per rack).
+        assert len(prefill_nodes) == 1 and len(decode_nodes) == 1
+
+    def test_subgroup_constraint_failure_rolls_back_whole_gang(self):
+        # decode needs a rack with 8 free GPUs; none has after prefill
+        # takes its rack -> entire job must not place.
+        spec = rack_zone_cluster(gpus_free=[8, 4, 4, 4])
+        spec["jobs"]["dynamo"] = {
+            "topology": "topo",
+            "pod_sets": [
+                {"name": "prefill", "min_available": 1,
+                 "required_topology_level": "rack"},
+                {"name": "decode", "min_available": 2,
+                 "required_topology_level": "rack"},
+            ],
+            "tasks": ([{"gpu": 8, "subgroup": "prefill"}]
+                      + [{"gpu": 4, "subgroup": "decode"}] * 2),
+        }
+        ssn = build_session(spec)
+        run_action(ssn)
+        assert all(not u.startswith("dynamo") for u in placements(ssn))
