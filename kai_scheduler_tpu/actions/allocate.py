@@ -11,6 +11,8 @@ host path through the sharing-group state (gpu_sharing/gpuSharing.go:20).
 
 from __future__ import annotations
 
+import numpy as np
+
 from ..api.podgroup_info import PodGroupInfo
 from ..api.pod_status import PodStatus
 from .utils import INFINITE, JobsOrderByQueues
@@ -25,6 +27,13 @@ class AllocateAction:
                 # Jobs pointing at unknown queues can't be ordered or
                 # charged; skip them (snapshot.pack drops them too).
                 and pg.queue_id in ssn.cluster.queues]
+
+        threshold = ssn.config.bulk_allocation_threshold
+        if threshold and len(jobs) >= threshold:
+            jobs = _execute_bulk(ssn, jobs)
+            if not jobs:
+                return
+
         order = JobsOrderByQueues(
             ssn, jobs,
             ssn.config.queue_depth_per_action.get(self.name, INFINITE))
@@ -50,6 +59,158 @@ class AllocateAction:
                 if ssn.config.use_scheduling_signatures:
                     failed_signatures.add(job.scheduling_signature())
                 order.requeue_queue(job.queue_id)
+
+
+def _execute_bulk(ssn, jobs):
+    """Bulk mode: place every plain pending gang through one kernel call
+    per round.
+
+    The DRF job order is computed once per round (vs the reference's
+    re-order after every job) — the round loop converges to the same
+    fixpoint because queue shares update as placements apply and the next
+    round re-orders.  Jobs needing host-side state (fractional tasks, DRA
+    claims, topology subsets, extra score terms) fall back to the per-job
+    path; returns those leftovers.
+    """
+
+    from ..ops.scoring import BINPACK
+
+    # The grouped kernel implements bin-pack only and carries no extra
+    # score terms; other configurations use the per-job path wholesale.
+    if ssn.gpu_strategy != BINPACK or ssn.cpu_strategy != BINPACK:
+        return jobs
+
+    leftovers = []
+    eligible = []
+    for pg in jobs:
+        tasks = pg.tasks_to_allocate(
+            subgroup_order_fn=ssn.pod_set_order_key,
+            task_order_fn=ssn.task_order_key)
+        host_side = (
+            not tasks
+            or any(t.is_fractional or t.resource_claims for t in tasks)
+            or any(ps.has_own_topology_constraint()
+                   for ps in pg.pod_sets.values())
+            or pg.required_topology_level or pg.preferred_topology_level
+            # Nominated-node stickiness / affinity peers are extra score
+            # terms the grouped kernel doesn't model.
+            or any(t.status == PodStatus.PIPELINED
+                   for t in pg.pods.values())
+            or any(t.pod_affinity_peers or t.pod_anti_affinity_peers
+                   for t in tasks))
+        (leftovers if host_side else eligible).append(pg)
+
+    for _ in range(ssn.config.bulk_allocation_max_rounds):
+        pending = [pg for pg in eligible if pg.has_tasks_to_allocate()]
+        if not pending:
+            break
+        # One DRF ordering pass for the round.
+        order = JobsOrderByQueues(ssn, pending)
+        ordered = []
+        while not order.empty():
+            job = order.pop_next_job()
+            if job is None:
+                break
+            ordered.append(job)
+            order.requeue_queue(job.queue_id)
+            if len(ordered) >= len(pending):
+                break
+
+        # Gate sequentially with projected allocations so one round cannot
+        # admit a whole queue past its limit: each admitted job's resources
+        # are charged onto the queue attrs during gating and reverted after
+        # (the statements re-apply them for the jobs that actually place).
+        prop = getattr(ssn, "proportion", None)
+        chunks, job_allowed, charged = [], [], []
+        for pg in ordered:
+            tasks = pg.tasks_to_allocate(
+                subgroup_order_fn=ssn.pod_set_order_key,
+                task_order_fn=ssn.task_order_key)
+            gate = ssn.is_job_over_queue_capacity(pg, tasks).schedulable \
+                if tasks else False
+            chunks.append(tasks)
+            job_allowed.append(gate)
+            if gate and prop is not None and tasks:
+                req = np.sum([t.req_vec() for t in tasks], axis=0)
+                prop._walk(pg.queue_id, "allocated", req)
+                if not pg.is_preemptible():
+                    prop._walk(pg.queue_id, "allocated_non_preemptible",
+                               req)
+                charged.append((pg, req))
+        for pg, req in charged:
+            prop._walk(pg.queue_id, "allocated", -req)
+            if not pg.is_preemptible():
+                prop._walk(pg.queue_id, "allocated_non_preemptible", -req)
+        if not any(job_allowed):
+            break
+
+        # Pack all chunks into one kernel call.
+        rows_req, rows_sel, rows_tol, task_jobs, flat_tasks = \
+            [], [], [], [], []
+        ok = True
+        for j, tasks in enumerate(chunks):
+            for t in tasks:
+                req, sel, tol = ssn._task_row(t)
+                if req is None:
+                    ok = False
+                    break
+                rows_req.append(req)
+                rows_sel.append(sel)
+                rows_tol.append(tol)
+                task_jobs.append(j)
+                flat_tasks.append(t)
+            if not ok:
+                break
+        if not ok or not flat_tasks:
+            break
+
+        from ..ops.allocate_grouped import allocate_grouped
+        result = allocate_grouped(
+            ssn._device_arrays(),
+            np.stack(rows_req), np.array(task_jobs, np.int32),
+            np.stack(rows_sel), np.stack(rows_tol),
+            np.array(job_allowed),
+            gpu_strategy=ssn.gpu_strategy, cpu_strategy=ssn.cpu_strategy)
+
+        success = np.asarray(result.job_success)
+        placements = np.asarray(result.placements)
+        pipelined = np.asarray(result.pipelined)
+        progressed = False
+        ti = 0
+        for j, tasks in enumerate(chunks):
+            n = len(tasks)
+            if success[j]:
+                stmt = ssn.statement()
+                for i, task in enumerate(tasks):
+                    node_name = ssn.snapshot.node_names[
+                        int(placements[ti + i])]
+                    if pipelined[ti + i]:
+                        stmt.pipeline(task, node_name)
+                    else:
+                        stmt.allocate(task, node_name)
+                if ordered[j].should_pipeline():
+                    stmt.convert_all_allocated_to_pipelined(ordered[j].uid)
+                stmt.commit()
+                progressed = True
+            ti += n
+        if not progressed:
+            # Record failures for explainability; leave retries to the
+            # scenario actions.
+            for j, tasks in enumerate(chunks):
+                if not success[j] and tasks:
+                    _record_chunk_failure(ssn, ordered[j], tasks)
+            break
+
+    # Unplaced jobs need fit errors for explainability (and the
+    # consolidation action only considers jobs that failed here).
+    for pg in eligible:
+        if pg.has_tasks_to_allocate() and not pg.fit_errors:
+            tasks = pg.tasks_to_allocate(
+                subgroup_order_fn=ssn.pod_set_order_key,
+                task_order_fn=ssn.task_order_key)
+            if tasks:
+                _record_chunk_failure(ssn, pg, tasks)
+    return leftovers
 
 
 def attempt_to_allocate_job(ssn, job: PodGroupInfo,
@@ -84,12 +245,20 @@ def attempt_to_allocate_job(ssn, job: PodGroupInfo,
     per_podset = any(ps.has_own_topology_constraint()
                      for ps in job.pod_sets.values())
     if per_podset:
+        from ..api.pod_info import DEFAULT_SUBGROUP
+
+        def effective_podset(name: str) -> str:
+            # Tasks with undeclared subgroups are indexed into the default
+            # podset (PodGroupInfo._index_task); resolve the same way.
+            return name if name in job.pod_sets else DEFAULT_SUBGROUP
+
         cp_all = stmt.checkpoint()
         ok = True
-        for ps_name in sorted({t.subgroup for t in tasks},
+        for ps_name in sorted({effective_podset(t.subgroup) for t in tasks},
                               key=lambda n: ssn.pod_set_order_key(
                                   job.pod_sets[n])):
-            sub_tasks = [t for t in tasks if t.subgroup == ps_name]
+            sub_tasks = [t for t in tasks
+                         if effective_podset(t.subgroup) == ps_name]
             podset = job.pod_sets[ps_name]
             placed = False
             for node_subset in ssn.subset_nodes(job, sub_tasks, podset):
@@ -186,7 +355,6 @@ def _allocate_task_by_task(ssn, stmt, job, tasks, node_subset,
 def _allocate_fractional(ssn, stmt, task, node_subset,
                          pipeline_only: bool) -> bool:
     """gpu_sharing.AllocateFractionalGPUTaskToNode (gpuSharing.go:20)."""
-    import numpy as np
     # Restrict to real (non-padding) node rows.
     scores = ssn.score_nodes_for_task(task)[:len(ssn.snapshot.node_names)]
     order = np.argsort(-scores, kind="stable")
@@ -212,7 +380,6 @@ def _allocate_with_claims(ssn, stmt, task, node_subset,
                           pipeline_only: bool) -> bool:
     """DRA path: best-scoring node where every referenced claim is
     available (dynamicresources.go PrePredicate + assume)."""
-    import numpy as np
     dra = next((p for p in ssn.plugins
                 if p.name == "dynamicresources"), None)
     scores = ssn.score_nodes_for_task(task)[:len(ssn.snapshot.node_names)]

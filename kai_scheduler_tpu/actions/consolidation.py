@@ -9,6 +9,8 @@ never shrinks the running set.
 
 from __future__ import annotations
 
+import numpy as np
+
 from ..api.podgroup_info import PodGroupInfo
 from .solvers import solve_job
 from .utils import INFINITE, JobsOrderByQueues
@@ -27,18 +29,41 @@ class ConsolidationAction:
         order = JobsOrderByQueues(
             ssn, pending,
             ssn.config.queue_depth_per_action.get(self.name, INFINITE))
+        failed_signatures: set = set()
 
         while not order.empty():
             job = order.pop_next_job()
             if job is None:
                 break
+            sig = job.scheduling_signature()
+            if ssn.config.use_scheduling_signatures \
+                    and sig in failed_signatures:
+                order.requeue_queue(job.queue_id)
+                continue
+            # Relocation conserves total free resources: if the gang does
+            # not fit the cluster's aggregate idle+releasing space, no
+            # amount of defragmentation can host it.
+            tasks = job.tasks_to_allocate(
+                subgroup_order_fn=ssn.pod_set_order_key,
+                task_order_fn=ssn.task_order_key, real_allocation=False)
+            total_req = np.sum([t.req_vec() for t in tasks], axis=0) \
+                if tasks else None
+            total_free = ssn.node_idle.sum(axis=0) \
+                + ssn.node_releasing.sum(axis=0)
+            if total_req is None or np.any(total_req > total_free + 1e-9):
+                if ssn.config.use_scheduling_signatures:
+                    failed_signatures.add(sig)
+                order.requeue_queue(job.queue_id)
+                continue
             victims = collect_consolidation_victims(ssn, job)
             if not victims:
                 order.requeue_queue(job.queue_id)
                 continue
-            solve_job(ssn, job, victims,
-                      lambda scenario: True, self.name,
-                      require_all_victims_replaced=True)
+            result = solve_job(ssn, job, victims,
+                               lambda scenario: True, self.name,
+                               require_all_victims_replaced=True)
+            if not result.success and ssn.config.use_scheduling_signatures:
+                failed_signatures.add(sig)
             order.requeue_queue(job.queue_id)
 
 

@@ -29,6 +29,10 @@ class ReclaimAction:
             ssn, pending,
             ssn.config.queue_depth_per_action.get(self.name, INFINITE))
         failed_signatures: set[str] = set()
+        # Victim survey is expensive (scans every podgroup, ranks by queue
+        # dominant share): compute once and invalidate only when a
+        # successful reclaim changes the cluster.
+        survey = None
 
         while not order.empty():
             job = order.pop_next_job()
@@ -42,27 +46,40 @@ class ReclaimAction:
             if not ssn.can_reclaim_resources(job):
                 order.requeue_queue(job.queue_id)
                 continue
-            victims = collect_reclaim_victims(ssn, job)
+            if survey is None:
+                survey = survey_reclaim_victims(ssn)
+            victims = [pg for pg in survey
+                       if pg.queue_id != job.queue_id]
             victims = ssn.filter_reclaim_victims(job, victims)
             if not victims:
                 order.requeue_queue(job.queue_id)
                 continue
             result = solve_job(ssn, job, victims,
                                ssn.validate_reclaim_scenario, self.name)
-            if not result.success and ssn.config.use_scheduling_signatures:
+            if result.success:
+                # Incremental survey maintenance: evicted victims leave the
+                # candidate pool; queue-share drift is tolerated until the
+                # next full cycle (the reference re-sorts per job, but the
+                # order is advisory — validators stay exact).
+                # Elastic victims may have only shed surplus tasks; keep
+                # them as candidates while their core gang still runs.
+                gone = {uid for uid in result.evicted_jobs
+                        if ssn.cluster.podgroups[uid]
+                        .num_active_allocated() == 0}
+                survey = [pg for pg in survey if pg.uid not in gone]
+            elif ssn.config.use_scheduling_signatures:
                 failed_signatures.add(sig)
             order.requeue_queue(job.queue_id)
 
 
-def collect_reclaim_victims(ssn, reclaimer: PodGroupInfo
-                            ) -> list[PodGroupInfo]:
-    """Other queues' running preemptible jobs (reclaim.go:123-143), ordered
+def survey_reclaim_victims(ssn) -> list[PodGroupInfo]:
+    """All queues' running preemptible jobs (reclaim.go:123-143), ordered
     so the weakest claims are tried first: queues with the highest dominant
-    share first, then reverse job order (newest / lowest priority first)."""
+    share first, then reverse job order (newest / lowest priority first).
+    Per-reclaimer filtering (own queue) happens at use site; dominant
+    shares are computed once per queue here."""
     victims = []
     for pg in ssn.cluster.podgroups.values():
-        if pg.queue_id == reclaimer.queue_id:
-            continue
         if pg.queue_id not in ssn.cluster.queues:
             continue
         if not pg.is_preemptible():
@@ -71,17 +88,23 @@ def collect_reclaim_victims(ssn, reclaimer: PodGroupInfo
             continue
         victims.append(pg)
     prop = getattr(ssn, "proportion", None)
+    queue_share = {}
+    if prop is not None:
+        for qid in {pg.queue_id for pg in victims}:
+            if qid in prop.queues:
+                queue_share[qid] = prop.queues[qid].dominant_share(
+                    prop.total)
 
-    def key(pg):
-        share = 0.0
-        if prop is not None and pg.queue_id in prop.queues:
-            share = prop.queues[pg.queue_id].dominant_share(prop.total)
-        # Most-over-share queue first; within it, weakest claim (lowest
-        # priority, newest) first.
-        return (-share, ssn_job_rank(ssn, pg))
-
-    victims.sort(key=key)
+    victims.sort(key=lambda pg: (-queue_share.get(pg.queue_id, 0.0),
+                                 ssn_job_rank(ssn, pg)))
     return victims
+
+
+def collect_reclaim_victims(ssn, reclaimer: PodGroupInfo
+                            ) -> list[PodGroupInfo]:
+    """Compatibility helper: per-reclaimer view of the survey."""
+    return [pg for pg in survey_reclaim_victims(ssn)
+            if pg.queue_id != reclaimer.queue_id]
 
 
 def ssn_job_rank(ssn, pg) -> float:

@@ -19,6 +19,8 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
+import numpy as np
+
 from ..api.pod_status import PodStatus
 from ..api.podgroup_info import PodGroupInfo
 from ..utils.metrics import METRICS
@@ -104,44 +106,76 @@ def solve_job(ssn, pending_job: PodGroupInfo,
         task_order_fn=ssn.task_order_key, real_allocation=False)
     if not tasks:
         return SolverResult(False)
+
+    # Cheap infeasibility precheck: even evicting every candidate victim
+    # cannot create more than (idle + releasing + victim resources); a
+    # pending job larger than that can never be solved — skip simulating.
+    ordered_victims = ordered_victims[:ssn.config.max_victims_considered]
+    total_req = np.sum([t.req_vec() for t in tasks], axis=0)
+    budget = ssn.node_idle.sum(axis=0) + ssn.node_releasing.sum(axis=0)
+    for vjob in ordered_victims:
+        for t in vjob.pods.values():
+            if t.is_active_allocated():
+                budget = budget + t.req_vec()
+    if np.any(total_req > budget + 1e-9):
+        return SolverResult(False)
+
     # Let plugins snapshot pre-simulation state for their validators.
     ssn.on_job_solution_start()
 
     builder = ScenarioBuilder(pending_job, tasks, ordered_victims)
     tried = 0
-    while builder.has_next():
+    # One statement across scenarios: evictions accumulate incrementally
+    # (by_pod_solver keeps recorded victims evicted and rolls back only
+    # the allocation attempt); the attempt itself is checkpointed.
+    stmt = ssn.statement()
+    while builder.has_next() and tried < ssn.config.max_scenarios_per_job:
         scenario = builder.next_scenario()
+        # Validators depend only on the scenario's composition (victim
+        # resources vs queue shares, min-runtimes) — check them BEFORE
+        # paying for placement simulation.  Cheap validation rejections do
+        # not consume the simulation budget.
+        if not validate(scenario):
+            continue
         tried += 1
         METRICS.inc("scenarios_simulation_by_action", action=action_name)
-        stmt = ssn.statement()
-        ok = _simulate(ssn, stmt, scenario, validate,
-                       require_all_victims_replaced, try_replace_victims)
+        # Evict any victims added since the last simulated scenario.
+        new_tasks = _unevicted_tasks(scenario, stmt)
+        for task in new_tasks:
+            stmt.evict(task)
+        cp = stmt.checkpoint()
+        ok = _simulate_attempt(ssn, stmt, scenario,
+                               require_all_victims_replaced,
+                               try_replace_victims)
         if ok:
             stmt.commit()
             return SolverResult(True,
                                 [vj.uid for vj, _ in scenario.victims],
                                 tried)
-        stmt.discard()
+        stmt.rollback(cp)
+    stmt.discard()
     return SolverResult(False, scenarios_tried=tried)
 
 
-def _simulate(ssn, stmt, scenario: Scenario, validate,
-              require_all_victims_replaced: bool,
-              try_replace_victims: bool) -> bool:
-    # 1. Evict every victim task (by_pod_solver.go:163).
-    for _, tasks in scenario.victims:
-        for task in tasks:
-            stmt.evict(task)
+def _unevicted_tasks(scenario: Scenario, stmt) -> list:
+    evicted = {op.task.uid for op in stmt.ops if op.kind == "evict"}
+    out = []
+    for _, vtasks in scenario.victims:
+        out.extend(t for t in vtasks if t.uid not in evicted)
+    return out
 
-    # 2. Pipeline the pending job onto the released resources (re-enters
-    # the allocate kernel in pipeline-only mode).
+
+def _simulate_attempt(ssn, stmt, scenario: Scenario,
+                      require_all_victims_replaced: bool,
+                      try_replace_victims: bool) -> bool:
+    """Try to place the pending job (and re-place victims) on top of the
+    statement's accumulated evictions."""
     placed = attempt_to_allocate_job(ssn, scenario.pending_job,
                                      pipeline_only=True, stmt=stmt,
                                      commit=False)
     if not placed:
         return False
 
-    # 3. Re-place victims elsewhere if possible (pipelined); track failures.
     all_replaced = True
     if try_replace_victims:
         for vjob, vtasks in scenario.victims:
@@ -153,6 +187,4 @@ def _simulate(ssn, stmt, scenario: Scenario, validate,
         all_replaced = False
     if require_all_victims_replaced and not all_replaced:
         return False
-
-    # 4. Plugin validation of the post-state (proportion DRF, minruntime).
-    return validate(scenario)
+    return True

@@ -32,14 +32,21 @@ ALIVE = S.ALLOCATED | S.PIPELINED | S.BINDING | S.BOUND | S.RUNNING | S.PENDING 
 BOUND_STATUSES = S.ALLOCATED | S.BOUND | S.RUNNING | S.RELEASING
 ALLOCATED_STATUSES = S.ALLOCATED | S.BOUND | S.BINDING | S.RUNNING
 
+# Plain-int masks: IntFlag.__and__ costs ~1us per call through the enum
+# machinery, and these predicates run millions of times per cycle in the
+# scenario solvers.
+_ACTIVE_USED = int(ACTIVE_USED)
+_ACTIVE_ALLOCATED = int(ACTIVE_ALLOCATED)
+_ALIVE = int(ALIVE)
+
 
 def is_active_used(s: PodStatus) -> bool:
-    return bool(s & ACTIVE_USED)
+    return bool(s.value & _ACTIVE_USED)
 
 
 def is_active_allocated(s: PodStatus) -> bool:
-    return bool(s & ACTIVE_ALLOCATED)
+    return bool(s.value & _ACTIVE_ALLOCATED)
 
 
 def is_alive(s: PodStatus) -> bool:
-    return bool(s & ALIVE)
+    return bool(s.value & _ALIVE)

@@ -46,10 +46,20 @@ class SchedulerConfig:
     queue_depth_per_action: dict = field(default_factory=dict)
     # Reclaim saturation multiplier (reclaimable.go New).
     saturation_multiplier: float = 1.0
+    # Scenario-simulation bounds (worst-case cycle latency control; the
+    # metric scenarios_simulation_by_action tracks actual usage).
+    max_scenarios_per_job: int = 16
+    max_victims_considered: int = 32
     # Scheduling-signature dedup of provably unschedulable jobs.
     use_scheduling_signatures: bool = True
     # Node-axis padding bucket to stabilize kernel shapes across cycles.
     node_pad_bucket: int = 0
+    # Bulk allocation: when at least this many plain jobs are pending,
+    # the allocate action places them all through ONE kernel call per
+    # round (job order fixed per round) instead of one call per job.
+    # 0 disables bulk mode.
+    bulk_allocation_threshold: int = 32
+    bulk_allocation_max_rounds: int = 8
 
     def plugin_args(self, name: str) -> dict:
         for p in self.plugins:

@@ -110,6 +110,11 @@ class Session:
         self.gpu_strategy = BINPACK
         self.cpu_strategy = BINPACK
         self.statements: list[Statement] = []
+        # Device-array cache: static snapshot arrays upload once; mutable
+        # state arrays re-upload only after a statement touched them.
+        self._static_dev: dict = {}
+        self._state_dev: dict = {}
+        self._state_dirty = True
 
     # -- lifecycle ---------------------------------------------------------
     def open(self) -> "Session":
@@ -135,6 +140,28 @@ class Session:
             self.node_idle[i] = node.idle
             self.node_releasing[i] = node.releasing
             self.node_room[i] = max(0, node.max_pods - len(node.pod_infos))
+            self._state_dirty = True
+
+    def _device_arrays(self):
+        """(allocatable, idle, releasing, labels, taints, room) as device
+        arrays, re-uploading mutable state only when dirty."""
+        snap = self.snapshot
+        if not self._static_dev:
+            self._static_dev = {
+                "alloc": jnp.asarray(snap.node_allocatable),
+                "labels": jnp.asarray(snap.node_labels),
+                "taints": jnp.asarray(snap.node_taints),
+            }
+        if self._state_dirty or not self._state_dev:
+            self._state_dev = {
+                "idle": jnp.asarray(self.node_idle),
+                "rel": jnp.asarray(self.node_releasing),
+                "room": jnp.asarray(self.node_room),
+            }
+            self._state_dirty = False
+        s, st = self._static_dev, self._state_dev
+        return (s["alloc"], st["idle"], st["rel"], s["labels"], s["taints"],
+                st["room"])
 
     # -- composed dispatchers (session_plugins.go:117-300) -----------------
     def compare_queues(self, l, r, l_job=None, r_job=None,
@@ -269,13 +296,7 @@ class Session:
             and (task_tol[1:t] == task_tol[0]).all())
         if homogeneous:
             from ..ops.allocate_grouped import allocate_grouped
-            node_arrays = (
-                jnp.asarray(snap.node_allocatable),
-                jnp.asarray(self.node_idle),
-                jnp.asarray(self.node_releasing),
-                jnp.asarray(snap.node_labels),
-                jnp.asarray(snap.node_taints),
-                jnp.asarray(self.node_room))
+            node_arrays = self._device_arrays()
             result = allocate_grouped(
                 node_arrays, task_req[:t], np.zeros(t, np.int32),
                 task_sel[:t], task_tol[:t], np.ones(1, bool),
@@ -297,10 +318,7 @@ class Session:
             return Proposal(True, placements)
 
         result = allocate_jobs_kernel(
-            jnp.asarray(snap.node_allocatable), jnp.asarray(self.node_idle),
-            jnp.asarray(self.node_releasing),
-            jnp.asarray(snap.node_labels), jnp.asarray(snap.node_taints),
-            jnp.asarray(self.node_room),
+            *self._device_arrays(),
             jnp.asarray(task_req), jnp.asarray(task_job),
             jnp.asarray(task_sel), jnp.asarray(task_tol),
             jnp.asarray(job_allowed), jnp.asarray(extra),
@@ -357,16 +375,14 @@ class Session:
         if req_row is None:
             return np.zeros(self.node_idle.shape[0])
         req = req_row[None, :]
+        alloc, idle, rel, labels, taints, room = self._device_arrays()
         # Fractional tasks: capacity-check the cpu/mem axes; GPU device fit
         # is decided host-side by the sharing-group logic.
         fit_now, fit_future = feasibility_masks(
-            jnp.asarray(self.node_idle), jnp.asarray(self.node_releasing),
-            jnp.asarray(snap.node_labels), jnp.asarray(snap.node_taints),
-            jnp.asarray(self.node_room), jnp.asarray(req),
+            idle, rel, labels, taints, room, jnp.asarray(req),
             jnp.asarray(sel_row[None, :]), jnp.asarray(tol_row[None, :]))
         score = score_matrix(
-            jnp.asarray(snap.node_allocatable), jnp.asarray(self.node_idle),
-            jnp.asarray(req), fit_now, fit_future,
+            alloc, idle, jnp.asarray(req), fit_now, fit_future,
             gpu_strategy=self.gpu_strategy, cpu_strategy=self.cpu_strategy)
         return np.asarray(score[0])
 

@@ -270,3 +270,78 @@ class TestRobustness:
         p = placements(ssn)
         assert {p[u][0] for u in p} <= {"n1", "n2"}
         assert len(p) == 2
+
+
+class TestBulkAllocation:
+    def test_bulk_respects_queue_limit(self):
+        """A round of bulk allocation must not admit a queue past its
+        limit (review finding)."""
+        from kai_scheduler_tpu.framework import SchedulerConfig
+        cfg = SchedulerConfig(bulk_allocation_threshold=1)
+        spec = {
+            "nodes": {f"n{i}": {"gpu": 8} for i in range(8)},
+            "queues": {"capped": {"limit": dict(cpu="1000", memory="10Ti",
+                                                gpu=8)}},
+            "jobs": {f"j{i:02d}": {"queue": "capped",
+                                   "tasks": [{"gpu": 1}]}
+                     for i in range(40)},
+        }
+        ssn = build_session(spec, config=cfg)
+        run_action(ssn)
+        assert len(placements(ssn)) == 8  # hard limit holds in bulk mode
+
+    def test_bulk_matches_per_job_results(self):
+        spec = {
+            "nodes": {f"n{i}": {"gpu": 8} for i in range(4)},
+            "queues": {"q": {}},
+            "jobs": {f"j{i:02d}": {"min_available": 2,
+                                   "queue": "q",
+                                   "tasks": [{"gpu": 2}] * 2}
+                     for i in range(8)},
+        }
+        from kai_scheduler_tpu.framework import SchedulerConfig
+        bulk = build_session(spec, config=SchedulerConfig(
+            bulk_allocation_threshold=1))
+        run_action(bulk)
+        per_job = build_session(spec, config=SchedulerConfig(
+            bulk_allocation_threshold=0))
+        run_action(per_job)
+        assert placements(bulk) == placements(per_job)
+
+    def test_spread_strategy_bypasses_bulk(self):
+        from kai_scheduler_tpu.framework import SchedulerConfig
+        cfg = SchedulerConfig(bulk_allocation_threshold=1,
+                              gpu_placement_strategy="spread")
+        spec = {
+            "nodes": {f"n{i}": {"gpu": 8} for i in range(2)},
+            "queues": {"q": {}},
+            "jobs": {f"j{i}": {"queue": "q", "tasks": [{"gpu": 1}]}
+                     for i in range(4)},
+        }
+        ssn = build_session(spec, config=cfg)
+        run_action(ssn)
+        p = placements(ssn)
+        nodes_used = [p[u][0] for u in sorted(p)]
+        # Spread: jobs alternate nodes instead of packing one.
+        assert len(set(nodes_used)) == 2
+
+    def test_stray_subgroup_does_not_crash(self):
+        """A task naming an undeclared subgroup lands in the default
+        podset instead of crashing the cycle (review finding)."""
+        spec = {
+            "nodes": {f"n{i}": {"gpu": 8,
+                                "labels": {"rack": f"r{i}"}}
+                      for i in range(2)},
+            "queues": {"q": {}},
+            "topologies": {"topo": {"levels": ["rack"]}},
+            "jobs": {"j": {
+                "queue": "q", "topology": "topo",
+                "pod_sets": [{"name": "workers", "min_available": 1,
+                              "required_topology_level": "rack"}],
+                "tasks": [{"gpu": 1, "subgroup": "workers"},
+                          {"gpu": 1, "subgroup": "stray"}],
+            }},
+        }
+        ssn = build_session(spec)
+        run_action(ssn)  # must not raise
+        assert len(placements(ssn)) == 2

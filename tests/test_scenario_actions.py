@@ -143,6 +143,9 @@ class TestConsolidation:
                 "big": {"queue": "q", "tasks": [{"gpu": 8}]},
             },
         })
+        # Production order: allocate fails the job first (recording the fit
+        # error consolidation now requires), then consolidation relocates.
+        run_action(ssn, "allocate")
         run_action(ssn, "consolidation")
         # One frag pod moved (evicted + pipelined elsewhere); big pipelined.
         assert len(ssn.cache.evicted) == 1
