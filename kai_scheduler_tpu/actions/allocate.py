@@ -104,17 +104,32 @@ def _execute_bulk(ssn, jobs):
         pending = [pg for pg in eligible if pg.has_tasks_to_allocate()]
         if not pending:
             break
-        # One DRF ordering pass for the round.
-        order = JobsOrderByQueues(ssn, pending)
-        ordered = []
-        while not order.empty():
-            job = order.pop_next_job()
-            if job is None:
-                break
-            ordered.append(job)
-            order.requeue_queue(job.queue_id)
-            if len(ordered) >= len(pending):
-                break
+        # One DRF ordering pass for the round: sort by precomputed
+        # (queue key, job key) tuples when plugins provide key functions
+        # (pairwise comparators cost milliseconds each at scale);
+        # comparator heaps remain the strict path.
+        if ssn.queue_key_fn is not None and ssn.job_key_fns:
+            by_queue: dict = {}
+            for pg in pending:
+                by_queue.setdefault(pg.queue_id, []).append(pg)
+            queue_keys = {}
+            for qid, qjobs in by_queue.items():
+                qjobs.sort(key=ssn.job_sort_key)
+                queue_keys[qid] = ssn.queue_key_fn(qid, qjobs[0])
+            ordered = sorted(
+                pending, key=lambda pg: (queue_keys[pg.queue_id],
+                                         ssn.job_sort_key(pg)))
+        else:
+            order = JobsOrderByQueues(ssn, pending)
+            ordered = []
+            while not order.empty():
+                job = order.pop_next_job()
+                if job is None:
+                    break
+                ordered.append(job)
+                order.requeue_queue(job.queue_id)
+                if len(ordered) >= len(pending):
+                    break
 
         # Gate sequentially with projected allocations so one round cannot
         # admit a whole queue past its limit: each admitted job's resources

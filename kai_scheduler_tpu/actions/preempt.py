@@ -27,6 +27,9 @@ class PreemptAction:
             ssn, pending,
             ssn.config.queue_depth_per_action.get(self.name, INFINITE))
         failed_signatures: set[str] = set()
+        # Per-queue victim survey, maintained incrementally (the per-job
+        # rescan of every podgroup dominates cycle time at scale).
+        survey: dict | None = None
 
         while not order.empty():
             job = order.pop_next_job()
@@ -37,29 +40,44 @@ class PreemptAction:
                     and sig in failed_signatures:
                 order.requeue_queue(job.queue_id)
                 continue
-            victims = collect_preempt_victims(ssn, job)
+            if survey is None:
+                survey = survey_preempt_victims(ssn)
+            victims = [pg for pg in survey.get(job.queue_id, [])
+                       if pg.priority < job.priority and pg.uid != job.uid]
             victims = ssn.filter_preempt_victims(job, victims)
             if not victims:
                 order.requeue_queue(job.queue_id)
                 continue
             result = solve_job(ssn, job, victims,
                                ssn.validate_preempt_scenario, self.name)
-            if not result.success and ssn.config.use_scheduling_signatures:
+            if result.success:
+                gone = {uid for uid in result.evicted_jobs
+                        if ssn.cluster.podgroups[uid]
+                        .num_active_allocated() == 0}
+                survey[job.queue_id] = [
+                    pg for pg in survey.get(job.queue_id, [])
+                    if pg.uid not in gone]
+            elif ssn.config.use_scheduling_signatures:
                 failed_signatures.add(sig)
             order.requeue_queue(job.queue_id)
 
 
+def survey_preempt_victims(ssn) -> dict:
+    """queue -> running preemptible jobs ordered weakest-first (lowest
+    priority, newest); per-preemptor filtering happens at use site
+    (preempt.go:126-155)."""
+    survey: dict[str, list] = {}
+    for pg in ssn.cluster.podgroups.values():
+        if pg.is_preemptible() and pg.num_active_allocated() > 0:
+            survey.setdefault(pg.queue_id, []).append(pg)
+    for victims in survey.values():
+        victims.sort(key=lambda pg: (pg.priority, -pg.creation_ts))
+    return survey
+
+
 def collect_preempt_victims(ssn, preemptor: PodGroupInfo
                             ) -> list[PodGroupInfo]:
-    """Same queue, strictly lower priority, preemptible, running
-    (preempt.go:126-155); lowest priority and newest evicted first."""
-    victims = [
-        pg for pg in ssn.cluster.podgroups.values()
-        if pg.queue_id == preemptor.queue_id
-        and pg.uid != preemptor.uid
-        and pg.is_preemptible()
-        and pg.priority < preemptor.priority
-        and pg.num_active_allocated() > 0
-    ]
-    victims.sort(key=lambda pg: (pg.priority, -pg.creation_ts))
-    return victims
+    """Compatibility helper: per-preemptor view of the survey."""
+    return [pg for pg in survey_preempt_victims(ssn).get(
+        preemptor.queue_id, [])
+        if pg.priority < preemptor.priority and pg.uid != preemptor.uid]

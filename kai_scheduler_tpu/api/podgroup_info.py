@@ -114,6 +114,7 @@ class PodGroupInfo:
         # caches (invalidated on status change, job_info.go:281)
         self._tasks_to_allocate: Optional[list[PodInfo]] = None
         self._signature: Optional[str] = None
+        self._init_resource: Optional[np.ndarray] = None
 
     # -- structure ---------------------------------------------------------
     def set_pod_sets(self, pod_sets: Iterable[PodSet],
@@ -145,6 +146,7 @@ class PodGroupInfo:
     def invalidate_caches(self) -> None:
         self._tasks_to_allocate = None
         self._signature = None
+        self._init_resource = None
 
     # -- aggregate state ---------------------------------------------------
     def num_active_used(self) -> int:
@@ -254,9 +256,16 @@ class PodGroupInfo:
                    for t in self.pods.values())
 
     def tasks_to_allocate_init_resource(self, **kw) -> np.ndarray:
+        """Total request of the next chunk; cached like the reference's
+        tasksToAllocateInitResource (allocation_info.go:92) — queue
+        ordering evaluates it once per comparison otherwise."""
+        if self._init_resource is not None and not kw:
+            return self._init_resource
         total = rs.zeros()
         for t in self.tasks_to_allocate(real_allocation=False, **kw):
             total += t.req_vec()
+        if not kw:
+            self._init_resource = total
         return total
 
     # -- scheduling-constraints signature ----------------------------------

@@ -78,6 +78,11 @@ class Session:
         # --- extension points (session.go:51-95 function slices) ---
         self.queue_order_fns: list[Callable] = []
         self.job_order_fns: list[Callable] = []
+        # Key-function mirrors of the comparators: plugins that can express
+        # their ordering as a sort key register here too, letting bulk
+        # paths sort by precomputed tuples instead of pairwise callbacks.
+        self.job_key_fns: list[Callable] = []
+        self.queue_key_fn: Callable | None = None
         self.task_order_fns: list[Callable] = []
         self.pod_set_order_fns: list[Callable] = []
         self.over_capacity_fns: list[Callable] = []
@@ -171,6 +176,10 @@ class Session:
             if res != 0:
                 return res
         return 0
+
+    def job_sort_key(self, job: PodGroupInfo):
+        return tuple(fn(job) for fn in self.job_key_fns) + (
+            job.creation_ts, job.uid)
 
     def compare_jobs(self, l: PodGroupInfo, r: PodGroupInfo) -> int:
         for fn in self.job_order_fns:

@@ -19,12 +19,18 @@ class PriorityPlugin(Plugin):
 
     def on_session_open(self, ssn) -> None:
         ssn.job_order_fns.append(self.job_order)
+        ssn.job_key_fns.append(lambda job: -job.priority)
 
     @staticmethod
     def job_order(l, r) -> int:
         if l.priority != r.priority:
             return -1 if l.priority > r.priority else 1
         return 0
+
+
+def _below_min(job) -> int:
+    return 0 if job.num_active_used() < sum(
+        ps.min_available for ps in job.pod_sets.values()) else 1
 
 
 @register_plugin("elastic")
@@ -34,6 +40,7 @@ class ElasticPlugin(Plugin):
 
     def on_session_open(self, ssn) -> None:
         ssn.job_order_fns.append(self.job_order)
+        ssn.job_key_fns.append(_below_min)
 
     @staticmethod
     def job_order(l, r) -> int:

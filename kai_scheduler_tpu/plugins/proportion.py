@@ -105,6 +105,7 @@ class ProportionPlugin(Plugin):
         self._build_queue_attributes(ssn)
         self._set_fair_share(ssn)
         ssn.queue_order_fns.append(self.queue_order_fn)
+        ssn.queue_key_fn = self.queue_sort_key
         ssn.over_capacity_fns.append(self.is_job_over_queue_capacity)
         ssn.non_preemptible_over_quota_fns.append(
             self.is_non_preemptible_over_quota)
@@ -209,6 +210,26 @@ class ProportionPlugin(Plugin):
         self._walk(pg.queue_id, "allocated", req)
         if not pg.is_preemptible():
             self._walk(pg.queue_id, "allocated_non_preemptible", req)
+
+    def queue_sort_key(self, qid: str, peek_job) -> tuple:
+        """Scalar key mirroring queue_order_fn's comparator stages, for
+        bulk-mode sorting (pairwise numpy comparisons are too slow at
+        thousands of queues x jobs).  The allocatable-share tie-break
+        collapses to a sum — a total-order approximation of the partial
+        order the comparator uses."""
+        q = self.queues[qid]
+        over = _less(q.fair_share, q.allocated)
+        with_job = q.allocated + _job_req(peek_job)
+        starved = _less_equal(with_job, q.deserved)
+        viol = _zero_share_violation(q, with_job)
+        share_with_job = q.dominant_share(self.total,
+                                          _job_req(peek_job))
+        share0 = q.dominant_share(self.total)
+        alloc_sum = float(np.where(q.allocatable_share() == UNLIMITED,
+                                   self.total,
+                                   q.allocatable_share()).sum())
+        return (over, not starved, -q.priority, viol, share_with_job,
+                share0, -alloc_sum, q.creation_ts)
 
     # -- queue ordering (queue_order/queue_order.go:19-242) ----------------
     def queue_order_fn(self, l: str, r: str, l_job, r_job,
