@@ -21,7 +21,6 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
-from ..api.pod_status import PodStatus
 from ..api.podgroup_info import PodGroupInfo
 from ..utils.metrics import METRICS
 from .allocate import attempt_to_allocate_job

@@ -20,7 +20,7 @@ import numpy as np
 
 from . import resources as rs
 from .pod_info import DEFAULT_SUBGROUP, PodInfo
-from .pod_status import PodStatus, is_active_allocated, is_active_used, is_alive
+from .pod_status import PodStatus, is_active_allocated, is_alive
 
 
 class PodSet:

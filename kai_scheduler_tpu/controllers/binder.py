@@ -10,7 +10,7 @@ reservations on failure.
 
 from __future__ import annotations
 
-from .kubeapi import InMemoryKubeAPI, NotFound
+from .kubeapi import InMemoryKubeAPI
 
 RESERVATION_NAMESPACE = "kai-resource-reservation"
 GPU_GROUP_ANNOTATION = "kai.scheduler/gpu-group"

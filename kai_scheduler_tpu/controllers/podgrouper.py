@@ -9,7 +9,7 @@ subgroup when the workload defines pod sets).
 from __future__ import annotations
 
 from ..models import group_workload
-from .kubeapi import InMemoryKubeAPI, NotFound
+from .kubeapi import InMemoryKubeAPI
 
 POD_GROUP_LABEL = "kai.scheduler/pod-group"
 SUBGROUP_LABEL = "kai.scheduler/subgroup"

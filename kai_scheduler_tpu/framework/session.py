@@ -11,9 +11,8 @@ device kernel to propose placements for whole gangs at once.
 
 from __future__ import annotations
 
-import functools
-from dataclasses import dataclass, field
-from typing import Callable, Optional
+from dataclasses import dataclass
+from typing import Callable
 
 import jax.numpy as jnp
 import numpy as np

@@ -13,8 +13,8 @@ views exactly in sync.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
-from typing import TYPE_CHECKING, Optional
+from dataclasses import dataclass
+from typing import TYPE_CHECKING
 
 from ..api.cluster_info import BindRequest
 from ..api.pod_info import PodInfo

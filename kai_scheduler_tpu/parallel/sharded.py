@@ -19,9 +19,8 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
-from ..api.resources import NUM_RES
 from ..ops.allocate import NEG, AllocationResult
 from ..ops.predicates import feasibility_row
 from ..ops.scoring import BINPACK, score_row

@@ -11,7 +11,6 @@ from __future__ import annotations
 import time
 
 from .actions import build_actions
-from .api.cluster_info import ClusterInfo
 from .framework.conf import SchedulerConfig
 from .framework.session import InMemoryCache, Session
 from .utils.metrics import METRICS

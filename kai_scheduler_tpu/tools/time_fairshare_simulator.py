@@ -16,8 +16,6 @@ import argparse
 import csv
 import sys
 
-import numpy as np
-
 from ..api import resources as rs
 from ..framework import SchedulerConfig
 from ..scheduler import Scheduler

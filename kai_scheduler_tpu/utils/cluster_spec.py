@@ -8,8 +8,6 @@ the offline simulators (cmd/fairshare-simulator-style harnesses).
 
 from __future__ import annotations
 
-import numpy as np
-
 from ..api import (ClusterInfo, NodeInfo, PodGroupInfo,
                                    PodInfo, PodSet, PodStatus, QueueInfo,
                                    QueueQuota, resources as rs)

@@ -1,0 +1,130 @@
+"""Statement transaction tests — analog of the reference's
+framework/statement_test.go + statement_checkpoint_test.go: op log
+semantics, checkpoint/rollback nesting, pipelining conversion, commit
+side effects, and queue-share bookkeeping under undo."""
+
+import numpy as np
+import pytest
+
+from kai_scheduler_tpu.api import PodStatus, resources as rs
+from tests.fixtures import build_session
+
+
+def session():
+    return build_session({
+        "nodes": {"n1": {"gpu": 8}, "n2": {"gpu": 8}},
+        "queues": {"q": {"deserved": dict(cpu="16", memory="128Gi",
+                                          gpu=8)}},
+        "jobs": {
+            "j1": {"queue": "q", "tasks": [{"gpu": 2}, {"gpu": 2}]},
+            "running": {"queue": "q",
+                        "tasks": [{"gpu": 4, "status": "RUNNING",
+                                   "node": "n2"}]},
+        },
+    })
+
+
+def task(ssn, job, i):
+    return ssn.cluster.podgroups[job].pods[f"{job}-{i}"]
+
+
+class TestAllocateRollback:
+    def test_allocate_then_rollback_restores_everything(self):
+        ssn = session()
+        t = task(ssn, "j1", 0)
+        node = ssn.cluster.nodes["n1"]
+        stmt = ssn.statement()
+        cp = stmt.checkpoint()
+        stmt.allocate(t, "n1")
+        assert t.status == PodStatus.ALLOCATED
+        assert node.used[rs.RES_GPU] == 2
+        assert ssn.proportion.queues["q"].allocated[rs.RES_GPU] == 6
+        assert ssn.node_idle[ssn.node_index("n1")][rs.RES_GPU] == 6
+        stmt.rollback(cp)
+        assert t.status == PodStatus.PENDING
+        assert t.node_name == ""
+        assert node.used[rs.RES_GPU] == 0
+        assert ssn.proportion.queues["q"].allocated[rs.RES_GPU] == 4
+        assert ssn.node_idle[ssn.node_index("n1")][rs.RES_GPU] == 8
+
+    def test_nested_checkpoints(self):
+        ssn = session()
+        stmt = ssn.statement()
+        t0, t1 = task(ssn, "j1", 0), task(ssn, "j1", 1)
+        cp0 = stmt.checkpoint()
+        stmt.allocate(t0, "n1")
+        cp1 = stmt.checkpoint()
+        stmt.allocate(t1, "n1")
+        stmt.rollback(cp1)  # only t1 undone
+        assert t0.status == PodStatus.ALLOCATED
+        assert t1.status == PodStatus.PENDING
+        stmt.rollback(cp0)
+        assert t0.status == PodStatus.PENDING
+
+    def test_evict_and_undo(self):
+        ssn = session()
+        t = task(ssn, "running", 0)
+        node = ssn.cluster.nodes["n2"]
+        stmt = ssn.statement()
+        cp = stmt.checkpoint()
+        stmt.evict(t)
+        assert t.status == PodStatus.RELEASING
+        assert node.releasing[rs.RES_GPU] == 4
+        assert ssn.proportion.queues["q"].allocated[rs.RES_GPU] == 0
+        stmt.rollback(cp)
+        assert t.status == PodStatus.RUNNING
+        assert node.releasing[rs.RES_GPU] == 0
+        assert ssn.proportion.queues["q"].allocated[rs.RES_GPU] == 4
+
+    def test_pipeline_claims_releasing(self):
+        ssn = session()
+        victim = task(ssn, "running", 0)
+        t = task(ssn, "j1", 0)
+        stmt = ssn.statement()
+        stmt.evict(victim)
+        stmt.pipeline(t, "n2")
+        node = ssn.cluster.nodes["n2"]
+        assert t.status == PodStatus.PIPELINED
+        assert node.releasing[rs.RES_GPU] == 2  # 4 releasing - 2 claimed
+        stmt.rollback(0)
+        assert node.releasing[rs.RES_GPU] == 0
+        assert victim.status == PodStatus.RUNNING
+
+
+class TestConvertToPipelined:
+    def test_converts_only_this_jobs_allocations(self):
+        ssn = session()
+        t0, t1 = task(ssn, "j1", 0), task(ssn, "j1", 1)
+        stmt = ssn.statement()
+        stmt.allocate(t0, "n1")
+        stmt.pipeline(t1, "n1")
+        stmt.convert_all_allocated_to_pipelined("j1")
+        assert t0.status == PodStatus.PIPELINED
+        node = ssn.cluster.nodes["n1"]
+        # Both now claim future resources, not idle.
+        assert node.used[rs.RES_GPU] == 0
+        assert node.releasing[rs.RES_GPU] == -4
+
+
+class TestCommit:
+    def test_commit_emits_binds_and_evictions(self):
+        ssn = session()
+        t = task(ssn, "j1", 0)
+        victim = task(ssn, "running", 0)
+        stmt = ssn.statement()
+        stmt.allocate(t, "n1")
+        stmt.evict(victim)
+        binds = stmt.commit()
+        assert [(b.pod_name, b.node_name) for b in binds] == [("j1-0",
+                                                              "n1")]
+        assert ssn.cache.bound == [("j1-0", "n1")]
+        assert ssn.cache.evicted == ["running-0"]
+
+    def test_discard_undoes_all(self):
+        ssn = session()
+        t = task(ssn, "j1", 0)
+        stmt = ssn.statement()
+        stmt.allocate(t, "n1")
+        stmt.discard()
+        assert t.status == PodStatus.PENDING
+        assert ssn.cache.bound == []
