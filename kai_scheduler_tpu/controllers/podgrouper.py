@@ -75,9 +75,16 @@ class PodGrouper:
                 "priorityClassName": meta.priority_class,
                 "priority": meta.priority,
                 "preemptible": meta.preemptible,
-                "podSets": [{"name": ps.name,
-                             "minAvailable": ps.min_available}
-                            for ps in meta.pod_sets],
+                "podSets": [{
+                    "name": ps.name,
+                    "minAvailable": ps.min_available,
+                    **({"topology": {
+                        "name": ps.topology_name,
+                        "required": ps.required_topology_level,
+                        "preferred": ps.preferred_topology_level,
+                    }} if (ps.required_topology_level
+                           or ps.preferred_topology_level) else {}),
+                } for ps in meta.pod_sets],
                 "topology": {
                     "name": meta.topology_name,
                     "required": meta.required_topology_level,

@@ -39,6 +39,10 @@ class PodSetSpec:
     name: str
     min_available: int
     parent: str | None = None
+    # Per-subgroup topology constraint (Grove clique topologyConstraint).
+    topology_name: str | None = None
+    required_topology_level: str | None = None
+    preferred_topology_level: str | None = None
 
 
 @dataclass
@@ -254,7 +258,12 @@ def grove_grouper(owner, pod, api=None):
         cspec = clique.get("spec", clique)
         n = int(cspec.get("minReplicas", cspec.get("replicas", 1)))
         total += n
-        pod_sets.append(PodSetSpec(name, n))
+        topo = cspec.get("topologyConstraint", {}) or {}
+        pod_sets.append(PodSetSpec(
+            name, n,
+            topology_name=topo.get("topology"),
+            required_topology_level=topo.get("requiredLevel"),
+            preferred_topology_level=topo.get("preferredLevel")))
     meta.min_member = max(total, 1)
     meta.pod_sets = pod_sets
     return meta

@@ -267,3 +267,50 @@ class TestExplainabilityAndUsage:
         cfg = SystemConfig(feature_gates={"newThing": False})
         assert not cfg.gate("newThing")
         assert cfg.gate("defaultOn")
+
+
+class TestGroveEndToEnd:
+    def test_podgangset_cliques_flow_to_rack_pinned_pods(self):
+        """Grove PodGangSet with per-clique rack constraints: pods group
+        into one gang with podSets, and each clique lands in one rack."""
+        system = System(SystemConfig())
+        api = system.api
+        for i in range(4):
+            make_node(api, f"n{i}", gpu=8,
+                      labels={"rack": f"r{i}"})
+        api.create({"kind": "Topology", "metadata": {"name": "dc"},
+                    "spec": {"levels": [{"nodeLabel": "rack"}]}})
+        make_queue(api, "q")
+        gang = {"kind": "PodGangSet", "apiVersion": "grove.io/v1alpha1",
+                "metadata": {"name": "dynamo", "uid": "dg1",
+                             "labels": {"kai.scheduler/queue": "q"}},
+                "spec": {"template": {"cliques": [
+                    {"name": "prefill",
+                     "spec": {"minReplicas": 2,
+                              "topologyConstraint": {
+                                  "topology": "dc",
+                                  "requiredLevel": "rack"}}},
+                    {"name": "decode",
+                     "spec": {"minReplicas": 2,
+                              "topologyConstraint": {
+                                  "topology": "dc",
+                                  "requiredLevel": "rack"}}},
+                ]}}}
+        api.create(gang)
+        ref = owner_ref("PodGangSet", "dynamo", uid="dg1",
+                        api_version="grove.io/v1alpha1")
+        for clique in ("prefill", "decode"):
+            for i in range(2):
+                api.create(make_pod(f"dynamo-{clique}-{i}", owner=ref,
+                                    gpu=4))
+        system.run_cycle()
+        pg = api.list("PodGroup")[0]
+        assert pg["spec"]["minMember"] == 4
+        podsets = {ps["name"]: ps for ps in pg["spec"]["podSets"]}
+        assert podsets["prefill"]["topology"]["required"] == "rack"
+        bound = {p["metadata"]["name"]: p["spec"].get("nodeName")
+                 for p in api.list("Pod") if p["spec"].get("nodeName")}
+        assert len(bound) == 4
+        prefill_racks = {bound[f"dynamo-prefill-{i}"] for i in range(2)}
+        decode_racks = {bound[f"dynamo-decode-{i}"] for i in range(2)}
+        assert len(prefill_racks) == 1 and len(decode_racks) == 1
