@@ -95,10 +95,47 @@ class System:
             return cluster
         return provider
 
+    def reconcile_shards(self) -> bool:
+        """Operator reconciliation: SchedulingShard objects in the API
+        drive the scheduler fleet (schedulingshard_types.go:66-95 — one
+        scheduler per shard with per-shard args and node-pool label).
+        Returns True when the fleet changed."""
+        shard_objs = self.api.list("SchedulingShard")
+        if not shard_objs:
+            return False
+        shards = []
+        for obj in shard_objs:
+            spec = obj.get("spec", {})
+            config = SchedulerConfig.from_dict(spec.get("args", {}))
+            shards.append(ShardSpec(
+                obj["metadata"]["name"],
+                spec.get("nodePoolLabelKey"),
+                spec.get("nodePoolLabelValue"),
+                config))
+        current = [(s.name, s.node_pool_label, s.node_pool_value)
+                   for s in self.config.shards]
+        desired = [(s.name, s.node_pool_label, s.node_pool_value)
+                   for s in shards]
+        if current == desired:
+            return False
+        self.config.shards = shards
+        usage_provider = (
+            (lambda: self.usage_db.queue_usage(self._now_fn()))
+            if self.usage_db else None)
+        self.schedulers = []
+        for shard in shards:
+            cache = ClusterCache(self.api, self._now_fn)
+            provider = self._shard_provider(cache, shard)
+            self.schedulers.append(
+                Scheduler(provider, shard.config, cache=cache,
+                          usage_provider=usage_provider))
+        return True
+
     def run_cycle(self) -> None:
         """One end-to-end tick: drain controller events, run every shard's
         scheduling cycle, drain the binder's work."""
         self.api.drain()
+        self.reconcile_shards()
         for scheduler in self.schedulers:
             ssn = scheduler.run_once()
             scheduler.cache.update_job_statuses(ssn)

@@ -69,3 +69,44 @@ class SchedulerConfig:
 
     def has_plugin(self, name: str) -> bool:
         return any(p.name == name for p in self.plugins)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "SchedulerConfig":
+        """Build from the scheduler-config document shape the reference
+        embeds (conf_util/scheduler_conf_util.go:36-61): an ``actions``
+        string plus plugin tiers with optional argument maps."""
+        config = cls()
+        if "actions" in d:
+            actions = d["actions"]
+            if isinstance(actions, str):
+                actions = [a.strip() for a in actions.split(",")]
+            config.actions = list(actions)
+        tiers = d.get("tiers") or []
+        plugins = []
+        for tier in tiers:
+            for p in tier.get("plugins", []):
+                if isinstance(p, str):
+                    plugins.append(PluginConfig(p))
+                else:
+                    plugins.append(PluginConfig(p["name"],
+                                                p.get("arguments", {})))
+        if plugins:
+            config.plugins = plugins
+        for key in ("k_value", "gpu_placement_strategy",
+                    "cpu_placement_strategy",
+                    "default_staleness_grace_seconds",
+                    "saturation_multiplier", "use_scheduling_signatures",
+                    "node_pad_bucket", "bulk_allocation_threshold",
+                    "max_scenarios_per_job", "max_victims_considered"):
+            if key in d:
+                setattr(config, key, d[key])
+        if "queue_depth_per_action" in d:
+            config.queue_depth_per_action = dict(d["queue_depth_per_action"])
+        return config
+
+    @classmethod
+    def from_file(cls, path: str) -> "SchedulerConfig":
+        """Load a YAML (or JSON) scheduler config document."""
+        import yaml
+        with open(path) as f:
+            return cls.from_dict(yaml.safe_load(f) or {})

@@ -348,3 +348,65 @@ class TestTimeAwareFairness:
         # session and penalizes greedy's over-quota weight.
         ssn = system.schedulers[0].last_session
         assert ssn.queue_usage  # usage provider wired through
+
+
+class TestOperatorAndConfig:
+    def test_scheduling_shard_objects_drive_fleet(self):
+        system = System(SystemConfig())
+        api = system.api
+        make_node(api, "a1", labels={"pool": "a"})
+        make_node(api, "b1", labels={"pool": "b"})
+        make_queue(api, "q")
+        api.create({"kind": "SchedulingShard",
+                    "metadata": {"name": "shard-a"},
+                    "spec": {"nodePoolLabelKey": "pool",
+                             "nodePoolLabelValue": "a"}})
+        api.create({"kind": "SchedulingShard",
+                    "metadata": {"name": "shard-b"},
+                    "spec": {"nodePoolLabelKey": "pool",
+                             "nodePoolLabelValue": "b",
+                             "args": {"k_value": 2.0}}})
+        api.create(make_pod("p-b", queue="q", gpu=1,
+                            node_selector={"pool": "b"}))
+        system.run_cycle()
+        assert len(system.schedulers) == 2
+        assert system.schedulers[1].config.k_value == 2.0
+        p = api.get("Pod", "p-b")
+        assert p["spec"].get("nodeName") == "b1"
+
+    def test_scheduler_config_from_yaml(self, tmp_path):
+        from kai_scheduler_tpu.framework import SchedulerConfig
+        path = tmp_path / "conf.yaml"
+        path.write_text("""
+actions: allocate, reclaim
+tiers:
+  - plugins:
+      - predicates
+      - proportion
+      - name: nodeplacement
+        arguments: {gpu: spread}
+k_value: 0.5
+""")
+        cfg = SchedulerConfig.from_file(str(path))
+        assert cfg.actions == ["allocate", "reclaim"]
+        assert cfg.k_value == 0.5
+        assert cfg.plugin_args("nodeplacement") == {"gpu": "spread"}
+
+    def test_stateless_restart_converges(self):
+        """The scheduler holds no durable state: rebuilding the whole
+        System over the same API reaches the same placements (the
+        checkpoint/resume story, SURVEY.md §5)."""
+        system = System(SystemConfig())
+        api = system.api
+        make_node(api, "n1", gpu=8)
+        make_queue(api, "q")
+        api.create(make_pod("p1", queue="q", gpu=2))
+        system.run_cycle()
+        placed = api.get("Pod", "p1")["spec"].get("nodeName")
+        assert placed == "n1"
+        # "Crash": build a brand-new System over the surviving API objects.
+        reborn = System(SystemConfig(), api=api)
+        api.create(make_pod("p2", queue="q", gpu=2))
+        reborn.run_cycle()
+        assert api.get("Pod", "p1")["spec"].get("nodeName") == "n1"
+        assert api.get("Pod", "p2")["spec"].get("nodeName") == "n1"
