@@ -1,0 +1,44 @@
+"""Pallas kernel parity tests (run in interpreter mode on CPU; the same
+kernels compile for real TPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kai_scheduler_tpu.ops.pallas_kernels import (task_row_pallas,
+                                                  task_row_reference)
+
+
+def make_inputs(seed, n=512):
+    rng = np.random.default_rng(seed)
+    idle = np.tile([8000.0, 64e9, 8.0], (n, 1))
+    idle[:, 2] -= rng.integers(0, 9, n)
+    rel = np.zeros((n, 3))
+    rel[:, 2] = rng.integers(0, 3, n)
+    labels = rng.integers(-1, 3, (n, 2)).astype(np.int32)
+    taints = np.where(rng.random((n, 1)) < 0.2, 0, -1).astype(np.int32)
+    room = rng.integers(0, 111, n).astype(np.float64)
+    alloc = np.tile([8000.0, 64e9, 8.0], (n, 1))
+    req = np.array([1000.0, 1e9, float(rng.integers(1, 4))])
+    sel = np.array([rng.integers(-1, 3), -1], np.int32)
+    tol = np.array([0], np.int32) if rng.random() < 0.5 else \
+        np.array([-1], np.int32)
+    return (jnp.asarray(req), jnp.asarray(sel), jnp.asarray(tol),
+            jnp.asarray(idle), jnp.asarray(rel), jnp.asarray(labels),
+            jnp.asarray(taints), jnp.asarray(room), jnp.asarray(alloc))
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_pallas_row_matches_reference(seed):
+    req, sel, tol, idle, rel, labels, taints, room, alloc = \
+        make_inputs(seed)
+    ref = task_row_reference(req, sel, tol, idle, rel, labels, taints,
+                             room)
+    out = task_row_pallas(req, sel, tol, idle, rel, labels, taints, room,
+                          alloc)
+    for name, a, b in zip(("fit_now", "fit_future", "cap_now", "cap_tot"),
+                          ref, out):
+        np.testing.assert_allclose(
+            np.asarray(a, dtype=np.float32), np.asarray(b, np.float32),
+            err_msg=name, atol=1e-5)
