@@ -135,6 +135,8 @@ class ClusterCache:
                     for ps in pod_sets])
             pg.last_start_ts = pg_obj.get("status", {}).get(
                 "lastStartTimestamp")
+            pg.node_pool = pg_obj["metadata"].get("labels", {}).get(
+                "kai.scheduler/node-pool")
             podgroups[name] = pg
 
         for pod in self.api.list("Pod"):

@@ -92,6 +92,13 @@ class System:
                 cluster.node_order = sorted(cluster.nodes)
                 for i, name in enumerate(cluster.node_order):
                     cluster.nodes[name].idx = i
+                # Workloads partition with the shard too: a pool-labeled
+                # PodGroup belongs to exactly one shard's scheduler, so two
+                # shards never race to bind the same unconstrained pod.
+                cluster.podgroups = {
+                    uid: pg for uid, pg in cluster.podgroups.items()
+                    if getattr(pg, "node_pool", None)
+                    == shard.node_pool_value}
             return cluster
         return provider
 

@@ -13,6 +13,7 @@ from .kubeapi import InMemoryKubeAPI
 
 POD_GROUP_LABEL = "kai.scheduler/pod-group"
 SUBGROUP_LABEL = "kai.scheduler/subgroup"
+NODE_POOL_LABEL = "kai.scheduler/node-pool"
 
 
 class PodGrouper:
@@ -65,10 +66,15 @@ class PodGrouper:
 
     def _ensure_podgroup(self, meta, pod: dict) -> None:
         existing = self.api.get_opt("PodGroup", meta.name, meta.namespace)
+        # Shard routing: the workload's node-pool label rides the PodGroup
+        # so exactly one shard's scheduler owns it (SchedulingShard
+        # partitioning; unlabeled workloads belong to the default shard).
+        node_pool = pod["metadata"].get("labels", {}).get(NODE_POOL_LABEL)
         desired = {
             "kind": "PodGroup",
             "metadata": {"name": meta.name, "namespace": meta.namespace,
-                         "labels": {}},
+                         "labels": ({NODE_POOL_LABEL: node_pool}
+                                    if node_pool else {})},
             "spec": {
                 "queue": meta.queue,
                 "minMember": meta.min_member,

@@ -233,10 +233,15 @@ class TestShards:
         make_node(api, "b1", labels={"pool": "b"})
         make_queue(api, "q")
         api.create(make_pod("pod-a", queue="q", gpu=1,
+                            labels={"kai.scheduler/node-pool": "a"},
                             node_selector={"pool": "a"}))
+        # An unlabeled pod belongs to no pool shard: it must NOT be bound
+        # by either shard (no cross-shard double scheduling).
+        api.create(make_pod("pod-free", queue="q", gpu=1))
         system.run_cycle()
         p = api.get("Pod", "pod-a")
         assert p["spec"].get("nodeName") == "a1"
+        assert not api.get("Pod", "pod-free")["spec"].get("nodeName")
 
 
 class TestExplainabilityAndUsage:
@@ -367,6 +372,7 @@ class TestOperatorAndConfig:
                              "nodePoolLabelValue": "b",
                              "args": {"k_value": 2.0}}})
         api.create(make_pod("p-b", queue="q", gpu=1,
+                            labels={"kai.scheduler/node-pool": "b"},
                             node_selector={"pool": "b"}))
         system.run_cycle()
         assert len(system.schedulers) == 2
