@@ -54,6 +54,9 @@ class SchedulerConfig:
     use_scheduling_signatures: bool = True
     # Node-axis padding bucket to stabilize kernel shapes across cycles.
     node_pad_bucket: int = 0
+    # Back the session's dense node mirrors with the native C++ state
+    # store when the toolchain is available (native/statestore.cpp).
+    use_native_store: bool = True
     # Bulk allocation: when at least this many plain jobs are pending,
     # the allocate action places them all through ONE kernel call per
     # round (job order fixed per round) instead of one call per job.

@@ -106,9 +106,28 @@ class Session:
             pad = max(bucket, -(-len(cluster.nodes) // bucket) * bucket)
         self.snapshot: SnapshotTensors = pack(
             cluster, queue_usage=queue_usage, pad_nodes_to=pad)
-        self.node_idle = self.snapshot.node_idle.copy()
-        self.node_releasing = self.snapshot.node_releasing.copy()
-        self.node_room = self.snapshot.node_pod_room.copy()
+        # Dense mutable mirrors: backed by the native C++ state store when
+        # available (contiguous C-owned tables, zero-copy views), else
+        # plain numpy.
+        self._native = None
+        if self.config.use_native_store:
+            try:
+                from ..native import NativeNodeTable, native_available
+                if native_available():
+                    snap = self.snapshot
+                    table = NativeNodeTable(snap.node_allocatable.shape[0],
+                                            snap.node_allocatable.shape[1])
+                    table.bulk_load(
+                        snap.node_allocatable,
+                        snap.node_allocatable - snap.node_idle,
+                        snap.node_releasing, snap.node_pod_room)
+                    self._native = table
+            except Exception:
+                self._native = None
+        if self._native is None:
+            self._np_idle = self.snapshot.node_idle.copy()
+            self._np_releasing = self.snapshot.node_releasing.copy()
+            self._np_room = self.snapshot.node_pod_room.copy()
         self._node_index = {n: i for i, n in
                             enumerate(self.snapshot.node_names)}
         self.gpu_strategy = BINPACK
@@ -137,13 +156,40 @@ class Session:
         self.statements.append(st)
         return st
 
-    # -- dense-state sync (called by Statement) ----------------------------
+    # -- dense mirrors (single writer: the Statement via sync_node) --------
+    @property
+    def node_idle(self) -> np.ndarray:
+        if self._native is not None:
+            return self._native.idle
+        return self._np_idle
+
+    @property
+    def node_releasing(self) -> np.ndarray:
+        if self._native is not None:
+            return self._native.releasing
+        return self._np_releasing
+
+    @property
+    def node_room(self) -> np.ndarray:
+        if self._native is not None:
+            return self._native.room
+        return self._np_room
+
     def sync_node(self, node) -> None:
         i = node.idx
-        if 0 <= i < self.node_idle.shape[0]:
-            self.node_idle[i] = node.idle
-            self.node_releasing[i] = node.releasing
-            self.node_room[i] = max(0, node.max_pods - len(node.pod_infos))
+        if i < 0:
+            return
+        if self._native is not None:
+            if i < self._native.n_nodes:
+                self._native.used[i] = node.used
+                self._native.releasing[i] = node.releasing
+                self._native.room[i] = max(
+                    0, node.max_pods - len(node.pod_infos))
+                self._state_dirty = True
+        elif i < self._np_idle.shape[0]:
+            self._np_idle[i] = node.idle
+            self._np_releasing[i] = node.releasing
+            self._np_room[i] = max(0, node.max_pods - len(node.pod_infos))
             self._state_dirty = True
 
     def _device_arrays(self):
