@@ -1,0 +1,86 @@
+"""Asynchronous status updater: deduplicated API writes off the cycle path.
+
+Mirrors pkg/scheduler/cache/status_updater/ (default_status_updater.go:
+101-347 + concurrency.go:38-57): status patches and events queue up during
+the scheduling cycle and N worker threads apply them to the API server,
+with in-flight deduplication so a newer patch for the same object
+supersedes a queued older one instead of racing it.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+
+class AsyncStatusUpdater:
+    def __init__(self, api, num_workers: int = 4):
+        self.api = api
+        self._queue: "queue.Queue" = queue.Queue()
+        self._inflight: dict = {}     # key -> latest payload (dedup)
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._workers = [
+            threading.Thread(target=self._worker, daemon=True,
+                             name=f"status-updater-{i}")
+            for i in range(num_workers)]
+        for w in self._workers:
+            w.start()
+
+    # -- enqueue -----------------------------------------------------------
+    def patch_status(self, kind: str, name: str, namespace: str,
+                     status_patch: dict) -> None:
+        key = (kind, namespace, name)
+        with self._lock:
+            fresh = key not in self._inflight
+            self._inflight[key] = status_patch
+        if fresh:
+            self._queue.put(key)
+
+    def record_event(self, reason: str, message: str,
+                     about: tuple | None = None) -> None:
+        key = ("Event", reason, message, about)
+        with self._lock:
+            if key in self._inflight:
+                return
+            self._inflight[key] = {"reason": reason, "message": message,
+                                   "about": about}
+        self._queue.put(key)
+
+    # -- workers -----------------------------------------------------------
+    def _worker(self) -> None:
+        while not self._stop.is_set():
+            try:
+                key = self._queue.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            try:
+                with self._lock:
+                    payload = self._inflight.pop(key, None)
+                if payload is None:
+                    continue
+                if key[0] == "Event":
+                    self.api.create({
+                        "kind": "Event",
+                        "metadata": {"name": f"evt-{id(payload):x}-"
+                                             f"{abs(hash(key)) % 10**8}"},
+                        "spec": {"reason": payload["reason"],
+                                 "message": payload["message"]},
+                    })
+                else:
+                    kind, namespace, name = key
+                    self.api.patch(kind, name, {"status": payload},
+                                   namespace)
+            except Exception:
+                pass  # object vanished; the next cycle re-derives status
+            finally:
+                self._queue.task_done()
+
+    def flush(self, timeout: float = 5.0) -> None:
+        """Wait for queued work to drain (tests / shutdown)."""
+        self._queue.join()
+
+    def stop(self) -> None:
+        self._stop.set()
+        for w in self._workers:
+            w.join(timeout=1.0)

@@ -416,3 +416,30 @@ k_value: 0.5
         reborn.run_cycle()
         assert api.get("Pod", "p1")["spec"].get("nodeName") == "n1"
         assert api.get("Pod", "p2")["spec"].get("nodeName") == "n1"
+
+
+class TestGpuMemoryRequests:
+    def test_gpu_memory_annotation_becomes_fraction(self):
+        """A gpu-memory request resolves against the node's per-device
+        memory into a sharing fraction (gpu-memory flow e2e)."""
+        system = System(SystemConfig())
+        api = system.api
+        api.create({"kind": "Node",
+                    "metadata": {"name": "n1", "annotations": {
+                        "nvidia.com/gpu.memory": "16Gi"}},
+                    "spec": {},
+                    "status": {"allocatable": {"cpu": "32",
+                                               "memory": "256Gi",
+                                               "nvidia.com/gpu": 2,
+                                               "pods": 110}}})
+        make_queue(api, "q")
+        # Two 8Gi pods = two halves of one 16Gi device.
+        for i in range(2):
+            api.create(make_pod(f"m{i}", queue="q",
+                                annotations={"gpu-memory": "8Gi"}))
+        system.run_cycle()
+        pods = [api.get("Pod", f"m{i}") for i in range(2)]
+        assert all(p["spec"].get("nodeName") == "n1" for p in pods)
+        groups = {p["metadata"]["annotations"].get(
+            "kai.scheduler/gpu-group") for p in pods}
+        assert len(groups) == 1 and None not in groups  # same device

@@ -1,0 +1,45 @@
+"""Async status updater: worker-pool writes with in-flight dedup
+(cache/status_updater concurrency analog)."""
+
+import time
+
+from kai_scheduler_tpu.controllers import InMemoryKubeAPI
+from kai_scheduler_tpu.controllers.status_updater import AsyncStatusUpdater
+
+
+def test_patches_apply_asynchronously():
+    api = InMemoryKubeAPI()
+    api.create({"kind": "PodGroup", "metadata": {"name": "pg"},
+                "spec": {}, "status": {"phase": "Pending"}})
+    upd = AsyncStatusUpdater(api, num_workers=2)
+    upd.patch_status("PodGroup", "pg", "default", {"phase": "Running"})
+    upd.flush()
+    assert api.get("PodGroup", "pg")["status"]["phase"] == "Running"
+    upd.stop()
+
+
+def test_inflight_dedup_keeps_latest():
+    api = InMemoryKubeAPI()
+    api.create({"kind": "PodGroup", "metadata": {"name": "pg"},
+                "spec": {}, "status": {}})
+    upd = AsyncStatusUpdater(api, num_workers=1)
+    # Hold the dedup lock (reentrant) so the worker cannot pop payloads
+    # while the three patches queue up.
+    with upd._lock:
+        for phase in ("A", "B", "C"):
+            upd.patch_status("PodGroup", "pg", "default", {"phase": phase})
+    upd.flush()
+    # Only the latest queued payload lands (no A-then-C interleaving).
+    assert api.get("PodGroup", "pg")["status"]["phase"] == "C"
+    upd.stop()
+
+
+def test_events_flow():
+    api = InMemoryKubeAPI()
+    upd = AsyncStatusUpdater(api)
+    upd.record_event("Unschedulable", "no nodes fit")
+    upd.flush()
+    events = api.list("Event")
+    assert len(events) == 1
+    assert events[0]["spec"]["reason"] == "Unschedulable"
+    upd.stop()
