@@ -61,9 +61,13 @@ def _quota_vec(spec: dict | None):
 class ClusterCache:
     """Watches the API and snapshots ClusterInfo each cycle."""
 
-    def __init__(self, api: InMemoryKubeAPI, now_fn=None):
+    def __init__(self, api: InMemoryKubeAPI, now_fn=None,
+                 status_updater=None):
         self.api = api
         self.now_fn = now_fn or (lambda: 0.0)
+        # Optional async worker pool for status/event writes
+        # (controllers/status_updater.py); synchronous when absent.
+        self.status_updater = status_updater
 
     # -- snapshot ------------------------------------------------------------
     def snapshot(self) -> ClusterInfo:
@@ -202,6 +206,9 @@ class ClusterCache:
             self.api.update(pod)
 
     def record_event(self, kind: str, message: str) -> None:
+        if self.status_updater is not None:
+            self.status_updater.record_event(kind, message)
+            return
         self.api.create({
             "kind": "Event",
             "metadata": {"name": f"evt-{next(_EVENT_SEQ)}"},
@@ -211,23 +218,28 @@ class ClusterCache:
     def update_job_statuses(self, ssn) -> None:
         """Push scheduling explanations onto PodGroup statuses
         (status_updater markPodGroupUnschedulable,
-        default_status_updater.go:295)."""
+        default_status_updater.go:295); routed through the async worker
+        pool when one is attached."""
         for pg in ssn.cluster.podgroups.values():
             if not pg.fit_errors:
                 continue
             obj = self.api.get_opt("PodGroup", pg.uid, pg.namespace)
             if obj is None:
                 continue
-            status = obj.setdefault("status", {})
-            conditions = [c for c in status.get("conditions", [])
-                          if c.get("type") != "Unschedulable"]
+            conditions = [c for c in obj.get("status", {}).get(
+                "conditions", []) if c.get("type") != "Unschedulable"]
             conditions.append({
                 "type": "Unschedulable", "status": "True",
                 "reason": "SchedulingFailed",
                 "message": pg.fit_errors[-1],
             })
-            status["conditions"] = conditions
-            self.api.update(obj)
+            if self.status_updater is not None:
+                self.status_updater.patch_status(
+                    "PodGroup", pg.uid, pg.namespace,
+                    {"conditions": conditions})
+            else:
+                obj.setdefault("status", {})["conditions"] = conditions
+                self.api.update(obj)
 
     def gc_stale_bind_requests(self) -> int:
         """Stale BindRequest GC (cache/cache.go:371): drop requests whose

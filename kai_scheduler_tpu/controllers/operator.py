@@ -63,7 +63,10 @@ class System:
         self.queue_controller = QueueController(self.api)
         self.binder = Binder(self.api)
         self.scale_adjuster = NodeScaleAdjuster(self.api, now_fn)
-        self.cache = ClusterCache(self.api, now_fn)
+        from .status_updater import AsyncStatusUpdater
+        self.status_updater = AsyncStatusUpdater(self.api)
+        self.cache = ClusterCache(self.api, now_fn,
+                                  status_updater=self.status_updater)
         self._now_fn = now_fn
         # Historical-usage store for time-based fairness.
         from ..utils.usagedb import resolve_usage_client
@@ -74,7 +77,8 @@ class System:
             if self.usage_db else None)
         self.schedulers = []
         for shard in self.config.shards:
-            cache = ClusterCache(self.api, now_fn)
+            cache = ClusterCache(self.api, now_fn,
+                                 status_updater=self.status_updater)
             provider = self._shard_provider(cache, shard)
             self.schedulers.append(
                 Scheduler(provider, shard.config, cache=cache,
@@ -131,7 +135,8 @@ class System:
             if self.usage_db else None)
         self.schedulers = []
         for shard in shards:
-            cache = ClusterCache(self.api, self._now_fn)
+            cache = ClusterCache(self.api, self._now_fn,
+                                 status_updater=self.status_updater)
             provider = self._shard_provider(cache, shard)
             self.schedulers.append(
                 Scheduler(provider, shard.config, cache=cache,
@@ -152,5 +157,6 @@ class System:
                     self.usage_db.record(self._now_fn(), qid,
                                          attrs.allocated)
         self.api.drain()
+        self.status_updater.flush()
         self.cache.gc_stale_bind_requests()
         self.api.drain()
