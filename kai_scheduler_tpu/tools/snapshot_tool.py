@@ -108,10 +108,22 @@ def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--input", required=True)
     ap.add_argument("--time", action="store_true")
+    ap.add_argument("--profile", default=None,
+                    help="write a cProfile dump of the replayed cycle "
+                         "(snapshot-tool's CPU-profile flag analog)")
     args = ap.parse_args(argv)
     with open(args.input) as f:
         snapshot = json.load(f)
-    print(json.dumps(replay(snapshot, args.time), indent=1))
+    if args.profile:
+        import cProfile
+        profiler = cProfile.Profile()
+        profiler.enable()
+        report = replay(snapshot, args.time)
+        profiler.disable()
+        profiler.dump_stats(args.profile)
+    else:
+        report = replay(snapshot, args.time)
+    print(json.dumps(report, indent=1))
 
 
 if __name__ == "__main__":

@@ -345,3 +345,32 @@ class TestBulkAllocation:
         ssn = build_session(spec)
         run_action(ssn)  # must not raise
         assert len(placements(ssn)) == 2
+
+
+class TestApplyingOptions:
+    def test_queue_depth_per_action_limits_jobs(self):
+        """queue depth caps how many jobs per queue one action considers
+        (applying_options suite analog; SchedulingShard QueueDepthPerAction)."""
+        from kai_scheduler_tpu.framework import SchedulerConfig
+        cfg = SchedulerConfig(queue_depth_per_action={"allocate": 2},
+                              bulk_allocation_threshold=0)
+        spec = {
+            "nodes": {"n1": {"gpu": 8}},
+            "queues": {"q": {}},
+            "jobs": {f"j{i}": {"queue": "q", "creation_ts": float(i),
+                               "tasks": [{"gpu": 1}]}
+                     for i in range(6)},
+        }
+        ssn = build_session(spec, config=cfg)
+        run_action(ssn)
+        # Only the 2 oldest jobs were considered despite capacity for 6.
+        assert len(placements(ssn)) == 2
+
+    def test_actions_order_respected(self):
+        """A custom actions list runs only what it names."""
+        from kai_scheduler_tpu.framework import SchedulerConfig
+        from kai_scheduler_tpu.actions import build_actions
+        cfg = SchedulerConfig()
+        cfg.actions = ["allocate"]
+        names = [a.name for a in build_actions(cfg.actions)]
+        assert names == ["allocate"]

@@ -1,0 +1,99 @@
+"""Sweep the full workload-grouper table (models/groupers.py) — every
+supported kind produces sane PodGroup metadata (the podgrouper plugin
+unit-test ring, pkg/podgrouper/.../plugins/*_test.go analog)."""
+
+import pytest
+
+from kai_scheduler_tpu.models import GROUPER_TABLE, group_workload
+from kai_scheduler_tpu.models.groupers import PRIORITY_CLASS_VALUES
+
+
+def make_owner(group, kind, spec=None, labels=None):
+    api_version = f"{group}/v1" if group else "v1"
+    return {"kind": kind, "apiVersion": api_version,
+            "metadata": {"name": "w", "uid": "u1",
+                         "labels": labels or {}},
+            "spec": spec or {}}
+
+
+ALL_KINDS = sorted(GROUPER_TABLE, key=str)
+
+
+@pytest.mark.parametrize("group,kind", ALL_KINDS)
+def test_every_kind_produces_metadata(group, kind):
+    owner = make_owner(group, kind,
+                       labels={"kai.scheduler/queue": "teams"})
+    meta = group_workload(owner)
+    assert meta.name
+    assert meta.min_member >= 1
+    assert meta.queue == "teams"
+    assert meta.priority == PRIORITY_CLASS_VALUES.get(
+        meta.priority_class, meta.priority)
+
+
+class TestSpecificSemantics:
+    def test_mpi_launcher_plus_workers(self):
+        owner = make_owner("kubeflow.org", "MPIJob", {
+            "mpiReplicaSpecs": {"Launcher": {"replicas": 1},
+                                "Worker": {"replicas": 8}}})
+        meta = group_workload(owner)
+        assert meta.min_member == 9
+        assert {ps.name for ps in meta.pod_sets} == {"launcher", "worker"}
+
+    def test_mpi_scheduling_policy_overrides(self):
+        owner = make_owner("kubeflow.org", "MPIJob", {
+            "mpiReplicaSpecs": {"Worker": {"replicas": 8}},
+            "runPolicy": {"schedulingPolicy": {"minAvailable": 4}}})
+        assert group_workload(owner).min_member == 4
+
+    def test_lws_group_size_and_index(self):
+        from kai_scheduler_tpu.controllers import make_pod, owner_ref
+        owner = make_owner("leaderworkerset.x-k8s.io", "LeaderWorkerSet",
+                           {"leaderWorkerTemplate": {"size": 5}})
+        pod = make_pod("lws-0-3", owner=owner_ref("LeaderWorkerSet", "w"),
+                       labels={"leaderworkerset.sigs.k8s.io/group-index":
+                               "2"})
+        meta = group_workload(owner, pod)
+        assert meta.min_member == 5
+        assert meta.name.endswith("-2")  # one gang per LWS replica group
+
+    def test_notebook_is_non_preemptible(self):
+        owner = make_owner("kubeflow.org", "Notebook")
+        meta = group_workload(owner)
+        assert not meta.preemptible
+        assert meta.priority_class == "build"
+
+    def test_knative_service_inference_defaults(self):
+        owner = make_owner("serving.knative.dev", "Service")
+        meta = group_workload(owner)
+        assert meta.priority_class == "inference"
+        assert not meta.preemptible
+
+    def test_explicit_priority_class_wins(self):
+        owner = make_owner("batch", "Job",
+                           {"priorityClassName": "inference"})
+        meta = group_workload(owner)
+        assert meta.priority == 125
+
+    def test_min_available_annotation_override(self):
+        owner = make_owner("batch", "Job")
+        owner["metadata"]["annotations"] = {
+            "kai.scheduler/min-available": "7"}
+        assert group_workload(owner).min_member == 7
+
+    def test_spark_groups_by_app_selector(self):
+        from kai_scheduler_tpu.controllers import make_pod
+        owner = make_owner("", "Pod")
+        pod = make_pod("spark-exec-1",
+                       labels={"spark-app-selector": "app-42"})
+        meta = group_workload(owner, pod)
+        assert meta.name == "pg-spark-app-42"
+
+    def test_topology_annotations_flow(self):
+        owner = make_owner("batch", "Job")
+        owner["metadata"]["annotations"] = {
+            "kai.scheduler/topology": "dc",
+            "kai.scheduler/topology-required-placement": "rack"}
+        meta = group_workload(owner)
+        assert meta.topology_name == "dc"
+        assert meta.required_topology_level == "rack"
