@@ -117,10 +117,10 @@ def main(argv=None):
     if args.profile:
         import cProfile
         profiler = cProfile.Profile()
-        profiler.enable()
-        report = replay(snapshot, args.time)
-        profiler.disable()
-        profiler.dump_stats(args.profile)
+        try:
+            report = profiler.runcall(replay, snapshot, args.time)
+        finally:
+            profiler.dump_stats(args.profile)
     else:
         report = replay(snapshot, args.time)
     print(json.dumps(report, indent=1))

@@ -367,10 +367,8 @@ class TestApplyingOptions:
         assert len(placements(ssn)) == 2
 
     def test_actions_order_respected(self):
-        """A custom actions list runs only what it names."""
-        from kai_scheduler_tpu.framework import SchedulerConfig
+        """A custom actions list runs exactly what it names, in order."""
         from kai_scheduler_tpu.actions import build_actions
-        cfg = SchedulerConfig()
-        cfg.actions = ["allocate"]
-        names = [a.name for a in build_actions(cfg.actions)]
-        assert names == ["allocate"]
+        names = [a.name for a in build_actions(
+            ["reclaim", "allocate", "preempt"])]
+        assert names == ["reclaim", "allocate", "preempt"]
