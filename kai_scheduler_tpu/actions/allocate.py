@@ -96,8 +96,8 @@ def _execute_bulk(ssn, jobs):
             # terms the grouped kernel doesn't model.
             or any(t.status == PodStatus.PIPELINED
                    for t in pg.pods.values())
-            or any(t.pod_affinity_peers or t.pod_anti_affinity_peers
-                   for t in tasks))
+            or any(t.nominated_node or t.pod_affinity_peers
+                   or t.pod_anti_affinity_peers for t in tasks))
         (leftovers if host_side else eligible).append(pg)
 
     for _ in range(ssn.config.bulk_allocation_max_rounds):

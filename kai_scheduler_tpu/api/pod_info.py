@@ -35,6 +35,8 @@ class PodInfo:
     accepted_resource_types: Optional[set] = None       # None = any
     # Fraction bookkeeping
     gpu_group: str = ""  # shared-GPU group id once placed fractionally
+    # Nominated node carried across cycles for pipelined assignments.
+    nominated_node: str = ""
     # Dynamic Resource Allocation: referenced claim names.
     resource_claims: list = field(default_factory=list)
     # Inter-pod affinity: job uids to co-locate with / keep away from.
@@ -66,7 +68,7 @@ class PodInfo:
             tolerations=set(self.tolerations),
             accepted_resource_types=(set(self.accepted_resource_types)
                                      if self.accepted_resource_types else None),
-            gpu_group=self.gpu_group,
+            gpu_group=self.gpu_group, nominated_node=self.nominated_node,
             resource_claims=list(self.resource_claims),
             pod_affinity_peers=list(self.pod_affinity_peers),
             pod_anti_affinity_peers=list(self.pod_anti_affinity_peers),

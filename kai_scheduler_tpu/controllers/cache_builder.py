@@ -68,6 +68,9 @@ class ClusterCache:
         # Optional async worker pool for status/event writes
         # (controllers/status_updater.py); synchronous when absent.
         self.status_updater = status_updater
+        # In-memory pipelined assignments surviving between cycles
+        # (Cache.TaskPipelined): pod uid -> (node, gpu_group).
+        self._pipelined: dict = {}
 
     # -- snapshot ------------------------------------------------------------
     def snapshot(self) -> ClusterInfo:
@@ -143,6 +146,7 @@ class ClusterCache:
                 "kai.scheduler/node-pool")
             podgroups[name] = pg
 
+        seen_uids = set()
         for pod in self.api.list("Pod"):
             group = pod["metadata"].get("labels", {}).get(POD_GROUP_LABEL)
             if not group or group not in podgroups:
@@ -167,7 +171,22 @@ class ClusterCache:
                 GPU_GROUP_ANNOTATION)
             if gpu_group:
                 task.gpu_group = gpu_group
+            if task.status == PodStatus.PENDING:
+                seen_uids.add(task.uid)
+            # A remembered pipelined assignment becomes a nomination: the
+            # task stays schedulable, the nominated-node boost steers it
+            # back to its node, and it binds the moment idle resources
+            # free there (re-pipelining otherwise keeps the memory fresh).
+            if task.status == PodStatus.PENDING \
+                    and task.uid in self._pipelined:
+                node_name, _pgroup = self._pipelined[task.uid]
+                if node_name in nodes:
+                    task.nominated_node = node_name
             podgroups[group].add_task(task)
+        # Forget assignments for pods that vanished or already bound.
+        self._pipelined = {
+            uid: v for uid, v in self._pipelined.items()
+            if uid in seen_uids}  # seen = still pending this snapshot
 
         topologies = {}
         for topo in self.api.list("Topology"):
@@ -193,6 +212,12 @@ class ClusterCache:
                      "backoffLimit": bind_request.backoff_limit},
             "status": {"phase": "Pending"},
         })
+
+    def task_pipelined(self, task, node_name: str,
+                       gpu_group: str = "") -> None:
+        """Remember a pipelined assignment between cycles
+        (Cache.TaskPipelined, cache/interface.go:44)."""
+        self._pipelined[task.uid] = (node_name, gpu_group)
 
     def evict(self, task) -> None:
         """Delete the pod + patch the eviction condition

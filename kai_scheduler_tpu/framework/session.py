@@ -48,6 +48,10 @@ class InMemoryCache:
         self.bound = []     # (task_uid, node_name)
         self.evicted = []   # task_uid
         self.events = []    # (kind, message)
+        self.pipelined = []  # (task_uid, node_name)
+
+    def task_pipelined(self, task, node_name, gpu_group="") -> None:
+        self.pipelined.append((task.uid, node_name))
 
     def bind(self, task, node_name, bind_request) -> None:
         self.bound.append((task.uid, node_name))

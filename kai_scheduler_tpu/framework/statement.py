@@ -166,6 +166,14 @@ class Statement:
                     mutator(op.task, br)
                 binds.append(br)
                 self.session.cache.bind(op.task, op.node_name, br)
+            elif op.kind == "pipeline":
+                # Pipelined assignments persist in the cache across cycles
+                # (Cache.TaskPipelined, cache/interface.go:36-50) so the
+                # next snapshot rebuilds them.
+                task_pipelined = getattr(self.session.cache,
+                                         "task_pipelined", None)
+                if task_pipelined is not None:
+                    task_pipelined(op.task, op.node_name, op.gpu_group)
             elif op.kind == "evict":
                 self.session.cache.evict(op.task)
         self.committed = True

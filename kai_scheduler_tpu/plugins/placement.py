@@ -76,11 +76,15 @@ class NominatedNodePlugin(Plugin):
         ssn.extra_score_fns.append(self.extra_scores)
 
     def extra_scores(self, tasks):
-        n = ssn_nodes = self.ssn.node_idle.shape[0]
-        out = np.zeros((len(tasks), n))
+        n = self.ssn.node_idle.shape[0]
+        out = None
         for i, t in enumerate(tasks):
-            if t.status == PodStatus.PIPELINED and t.node_name:
-                idx = self.ssn.node_index(t.node_name)
+            nominated = t.nominated_node or (
+                t.node_name if t.status == PodStatus.PIPELINED else "")
+            if nominated:
+                idx = self.ssn.node_index(nominated)
                 if idx >= 0:
+                    if out is None:
+                        out = np.zeros((len(tasks), n))
                     out[i, idx] = NOMINATED_NODE
         return out

@@ -443,3 +443,38 @@ class TestGpuMemoryRequests:
         groups = {p["metadata"]["annotations"].get(
             "kai.scheduler/gpu-group") for p in pods}
         assert len(groups) == 1 and None not in groups  # same device
+
+
+class TestPipelinedAcrossCycles:
+    def test_pipelined_pod_binds_after_victim_leaves(self):
+        """Cycle 1 pipelines a pending pod onto a releasing node (via
+        reclaim); the assignment survives in the cache and the pod binds
+        on that node once the victim is gone (Cache.TaskPipelined flow)."""
+        system = System(SystemConfig())
+        api = system.api
+        make_node(api, "n1", gpu=8)
+        make_node(api, "n2", gpu=8)
+        make_queue(api, "q_a", deserved=dict(cpu="32", memory="256Gi",
+                                             gpu=8))
+        make_queue(api, "q_b", deserved=dict(cpu="32", memory="256Gi",
+                                             gpu=8))
+        # q_a hogs both nodes; q_b's pod must reclaim.
+        for i, node in enumerate(["n1", "n1", "n2", "n2"]):
+            api.create(make_pod(f"hog{i}", queue="q_a", gpu=4,
+                                node_name=node, phase="Running"))
+        system.run_cycle()  # podgroups materialize for the running hogs
+        api.create(make_pod("starved", queue="q_b", gpu=8))
+        system.run_cycle()
+        # Reclaim evicted hogs and pipelined 'starved' onto their node.
+        assert any(sc.cache._pipelined for sc in system.schedulers)
+        evicted = [p for p in api.list("Pod")
+                   if p["metadata"].get("deletionTimestamp")]
+        assert evicted
+        victim_node = evicted[0]["spec"]["nodeName"]
+        # The victims actually terminate (API deletion completes).
+        for p in evicted:
+            api.delete("Pod", p["metadata"]["name"],
+                       p["metadata"].get("namespace", "default"))
+        system.run_cycle()
+        p = api.get("Pod", "starved")
+        assert p["spec"].get("nodeName") == victim_node
