@@ -478,3 +478,46 @@ class TestPipelinedAcrossCycles:
         system.run_cycle()
         p = api.get("Pod", "starved")
         assert p["spec"].get("nodeName") == victim_node
+
+
+class TestDeletionAndBinderFailure:
+    def test_deleted_pod_mid_flight_is_gced(self):
+        """Pod vanishes between scheduling and binding: the BindRequest is
+        garbage-collected instead of wedging the binder
+        (deletion_tests + stale BindRequest GC, cache.go:371)."""
+        system = System(SystemConfig())
+        api = system.api
+        make_node(api, "n1")
+        make_queue(api, "q")
+        api.create(make_pod("ghost", queue="q", gpu=1))
+        api.drain()
+        # Schedule without draining the binder, then delete the pod.
+        for sched in system.schedulers:
+            sched.run_once()
+        api.delete("Pod", "ghost")
+        # Binder reconcile fails (pod gone); GC removes the request.
+        api.drain()
+        system.cache.gc_stale_bind_requests()
+        assert api.list("BindRequest") == []
+
+    def test_bind_failure_retries_then_fails_with_rollback(self):
+        """Bind to a nonexistent node retries up to the backoff limit and
+        ends Failed, releasing the GPU reservation it took
+        (bindrequest_controller + Binder.Rollback)."""
+        from kai_scheduler_tpu.controllers.binder import (
+            RESERVATION_NAMESPACE)
+        system = System(SystemConfig())
+        api = system.api
+        api.create({"kind": "BindRequest",
+                    "metadata": {"name": "bad-bind"},
+                    "spec": {"podName": "nope", "podUid": "x",
+                             "selectedNode": "missing-node",
+                             "selectedGPUGroups": ["grp-1"],
+                             "backoffLimit": 2},
+                    "status": {"phase": "Pending"}})
+        api.drain()
+        br = api.get("BindRequest", "bad-bind")
+        assert br["status"]["phase"] == "Failed"
+        assert br["status"]["attempts"] >= 2
+        # No reservation pod survives the rollback.
+        assert api.list("Pod", namespace=RESERVATION_NAMESPACE) == []
