@@ -82,7 +82,7 @@ def _compact(take_sorted, order, max_group: int):
 @functools.partial(jax.jit,
                    static_argnames=("max_group", "gpu_strategy",
                                     "cpu_strategy", "allow_pipeline",
-                                    "pipeline_only"))
+                                    "pipeline_only", "single_group_jobs"))
 def allocate_groups_kernel(node_allocatable, node_idle, node_releasing,
                            node_labels, node_taints, node_pod_room,
                            group_req, group_sel, group_tol, group_count,
@@ -90,12 +90,18 @@ def allocate_groups_kernel(node_allocatable, node_idle, node_releasing,
                            gpu_strategy: int = BINPACK,
                            cpu_strategy: int = BINPACK,
                            allow_pipeline: bool = True,
-                           pipeline_only: bool = False):
+                           pipeline_only: bool = False,
+                           single_group_jobs: bool = False):
     """Scan over groups; per group emit up to max_group fill segments.
 
     Returns (seg_nodes [G,K], seg_counts [G,K], seg_pipe [G,K] — phase-B
     segments marked pipelined, group_placed [G], job_success [J],
     node_idle', node_releasing').
+
+    ``single_group_jobs``: every job consists of exactly one group, so a
+    failed gang never has prior groups to roll back — the checkpoint
+    carries are dropped entirely (a failing group's own take is zeroed by
+    its capacity gate).  The host wrapper enables this automatically.
     """
     G = group_req.shape[0]
     N = node_allocatable.shape[0]
@@ -111,21 +117,29 @@ def allocate_groups_kernel(node_allocatable, node_idle, node_releasing,
         cur_job: jnp.ndarray
         cur_ok: jnp.ndarray
 
+    zero = jnp.zeros(())
     init = Carry(node_idle, node_releasing, node_pod_room,
-                 node_idle, node_releasing, node_pod_room,
+                 zero if single_group_jobs else node_idle,
+                 zero if single_group_jobs else node_releasing,
+                 zero if single_group_jobs else node_pod_room,
                  jnp.array(-1, jnp.int32), jnp.array(False))
 
     def step(carry: Carry, g):
         j = group_job[g]
         new_job = j != carry.cur_job
-        keep = jnp.where(new_job & ~carry.cur_ok, False, True)
-        idle = jnp.where(keep, carry.idle, carry.ck_idle)
-        rel = jnp.where(keep, carry.rel, carry.ck_rel)
-        room = jnp.where(keep, carry.room, carry.ck_room)
-        ck_idle = jnp.where(new_job, idle, carry.ck_idle)
-        ck_rel = jnp.where(new_job, rel, carry.ck_rel)
-        ck_room = jnp.where(new_job, room, carry.ck_room)
-        ok = jnp.where(new_job, job_allowed[j], carry.cur_ok)
+        if single_group_jobs:
+            idle, rel, room = carry.idle, carry.rel, carry.room
+            ck_idle, ck_rel, ck_room = zero, zero, zero
+            ok = job_allowed[j]
+        else:
+            keep = jnp.where(new_job & ~carry.cur_ok, False, True)
+            idle = jnp.where(keep, carry.idle, carry.ck_idle)
+            rel = jnp.where(keep, carry.rel, carry.ck_rel)
+            room = jnp.where(keep, carry.room, carry.ck_room)
+            ck_idle = jnp.where(new_job, idle, carry.ck_idle)
+            ck_rel = jnp.where(new_job, rel, carry.ck_rel)
+            ck_room = jnp.where(new_job, room, carry.ck_room)
+            ok = jnp.where(new_job, job_allowed[j], carry.cur_ok)
 
         req = group_req[g]
         count = jnp.where(ok, group_count[g], 0.0)
@@ -177,6 +191,13 @@ def allocate_groups_kernel(node_allocatable, node_idle, node_releasing,
             take_b = jnp.zeros_like(take_b)
         placed = total_now + take_b.sum()
 
+        if single_group_jobs:
+            # A failed gang must leave no trace: zero its takes in-step
+            # (there is no later boundary to roll back at).
+            gang_ok = placed >= count
+            take_a = jnp.where(gang_ok, take_a, 0.0)
+            take_b = jnp.where(gang_ok, take_b, 0.0)
+
         n_now = jnp.zeros(N).at[order].set(take_a)
         n_pipe = jnp.zeros(N).at[order].set(take_b)
         idle = idle - n_now[:, None] * req[None, :]
@@ -201,8 +222,11 @@ def allocate_groups_kernel(node_allocatable, node_idle, node_releasing,
 
     carry, (seg_nodes, seg_counts, seg_pipe, group_placed) = jax.lax.scan(
         step, init, jnp.arange(G))
-    idle = jnp.where(carry.cur_ok, carry.idle, carry.ck_idle)
-    rel = jnp.where(carry.cur_ok, carry.rel, carry.ck_rel)
+    if single_group_jobs:
+        idle, rel = carry.idle, carry.rel
+    else:
+        idle = jnp.where(carry.cur_ok, carry.idle, carry.ck_idle)
+        rel = jnp.where(carry.cur_ok, carry.rel, carry.ck_rel)
 
     num_jobs = job_allowed.shape[0]
     placed_per_job = jax.ops.segment_sum(group_placed, group_job,
@@ -225,7 +249,7 @@ def _next_pow2(n: int) -> int:
 @functools.partial(jax.jit,
                    static_argnames=("max_group", "gpu_strategy",
                                     "cpu_strategy", "allow_pipeline",
-                                    "pipeline_only"))
+                                    "pipeline_only", "single_group_jobs"))
 def _allocate_groups_packed(*args, **kw):
     """Kernel + single-buffer packing: a remote device pays a full RTT per
     fetched buffer, so everything the host needs returns as ONE array."""
@@ -259,13 +283,17 @@ def allocate_grouped(node_arrays, task_req, task_job, task_selector,
     (group_of_task, g_req, g_sel, g_tol, g_count,
      g_job) = group_tasks(np_req, np_job, np_sel, np_tol)
     max_group = _next_pow2(int(g_count.max()) if len(g_count) else 1)
+    # Homogeneous gangs: one group per job lets the kernel drop its
+    # checkpoint carries entirely.
+    single = len(g_job) == len(set(g_job.tolist()))
 
     packed, idle, rel = _allocate_groups_packed(
         *node_arrays, jnp.asarray(g_req), jnp.asarray(g_sel),
         jnp.asarray(g_tol), jnp.asarray(g_count), jnp.asarray(g_job),
         jnp.asarray(job_allowed), max_group=max_group,
         gpu_strategy=gpu_strategy, cpu_strategy=cpu_strategy,
-        allow_pipeline=allow_pipeline, pipeline_only=pipeline_only)
+        allow_pipeline=allow_pipeline, pipeline_only=pipeline_only,
+        single_group_jobs=single)
     packed = np.asarray(packed)  # ONE device->host fetch
     g, k = len(g_count), max_group
     seg_nodes = packed[:g * k].reshape(g, k).astype(np.int32)
