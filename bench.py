@@ -146,6 +146,9 @@ def main():
         "detail": {
             "backend": jax.default_backend(),
             "rtt_ms": round(rtt_ms, 1),
+            # Derived: the cycle's device-side cost after subtracting this
+            # environment's measured transfer round trip.
+            "est_device_ms": round(max(0.0, median - rtt_ms), 3),
             "p99_ms": round(float(np.percentile(times, 99)), 3),
             "pods_placed": placed,
             "pods_placed_per_sec": round(placed / (median / 1000.0)),

@@ -19,10 +19,15 @@ class AdmissionError(Exception):
 
 class Admission:
     def __init__(self, api=None, require_queue_label: bool = False,
-                 scheduler_name: str = "kai-scheduler"):
+                 scheduler_name: str = "kai-scheduler",
+                 enforced_runtime_class: str | None = None):
+        """enforced_runtime_class: fraction pods get this runtimeClassName
+        stamped so the node runtime routes them through the sharing stack
+        (runtimeenforcement webhook analog)."""
         self.api = api
         self.require_queue_label = require_queue_label
         self.scheduler_name = scheduler_name
+        self.enforced_runtime_class = enforced_runtime_class
         if api is not None:
             api.watch("Pod", self._on_pod)
 
@@ -43,6 +48,8 @@ class Admission:
                 requests = c.setdefault("resources", {}).setdefault(
                     "requests", {})
                 requests.pop("nvidia.com/gpu", None)
+            if self.enforced_runtime_class:
+                spec["runtimeClassName"] = self.enforced_runtime_class
         spec.setdefault("schedulerName", self.scheduler_name)
         return pod
 

@@ -521,3 +521,26 @@ class TestDeletionAndBinderFailure:
         assert br["status"]["attempts"] >= 2
         # No reservation pod survives the rollback.
         assert api.list("Pod", namespace=RESERVATION_NAMESPACE) == []
+
+
+class TestAdmissionRuntimeAndMetrics:
+    def test_runtime_class_enforced_for_fractions(self):
+        adm = Admission(enforced_runtime_class="kai-gpu-sharing")
+        pod = make_pod("p1", annotations={"gpu-fraction": "0.5"})
+        adm.mutate(pod)
+        assert pod["spec"]["runtimeClassName"] == "kai-gpu-sharing"
+        plain = make_pod("p2", gpu=1)
+        adm.mutate(plain)
+        assert "runtimeClassName" not in plain["spec"]
+
+    def test_metrics_expose_queue_gauges(self):
+        from kai_scheduler_tpu.utils.metrics import METRICS
+        METRICS.reset()
+        system = System(SystemConfig())
+        make_node(system.api, "n1")
+        make_queue(system.api, "q")
+        system.api.create(make_pod("p1", queue="q", gpu=1))
+        system.run_cycle()
+        text = METRICS.to_prometheus_text()
+        assert 'queue_fair_share_gpu{queue="q"}' in text
+        assert "e2e_scheduling_latency_milliseconds" in text
