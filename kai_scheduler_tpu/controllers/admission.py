@@ -31,8 +31,13 @@ class Admission:
         if api is not None:
             api.watch("Pod", self._on_pod)
 
+    UTILITY_NAMESPACES = ("kai-resource-reservation", "kai-scale-adjust")
+
     def _on_pod(self, event_type: str, pod: dict) -> None:
         if event_type != "ADDED":
+            return
+        if pod.get("metadata", {}).get("namespace") \
+                in self.UTILITY_NAMESPACES:
             return
         self.mutate(pod)
         self.validate(pod)

@@ -21,8 +21,14 @@ class PodGrouper:
         self.api = api
         api.watch("Pod", self._on_pod)
 
+    UTILITY_NAMESPACES = ("kai-resource-reservation", "kai-scale-adjust")
+
     def _on_pod(self, event_type: str, pod: dict) -> None:
         if event_type == "DELETED":
+            return
+        # Utility pods (GPU reservations, autoscaler scaling pods) are not
+        # workloads: no grouping, no PodGroup.
+        if pod["metadata"].get("namespace") in self.UTILITY_NAMESPACES:
             return
         if pod.get("spec", {}).get("schedulerName",
                                    "kai-scheduler") != "kai-scheduler":
@@ -121,16 +127,23 @@ class PodGrouper:
 
     @staticmethod
     def _infer_subgroup(meta, pod: dict) -> str | None:
-        """Match the pod to a pod set by role substring in its name/labels
-        (per-kind groupers label pods with their replica role)."""
+        """Match the pod to a pod set by role label or name substring
+        (per-kind groupers label pods with their replica role).  Podset
+        names may be plural forms of the per-pod role ("workers" vs
+        "rc-worker-0"), so singular stems match too."""
         role = pod["metadata"].get("labels", {}).get(
             "training.kubeflow.org/replica-type") \
             or pod["metadata"].get("labels", {}).get("ray.io/node-type")
         names = [ps.name for ps in meta.pod_sets]
-        if role and role.lower() in names:
-            return role.lower()
+        if role:
+            role = role.lower()
+            if role in names:
+                return role
+            for name in names:
+                if name.rstrip("s") == role or name == role + "s":
+                    return name
         pod_name = pod["metadata"]["name"].lower()
         for name in names:
-            if name in pod_name:
+            if name in pod_name or name.rstrip("s") in pod_name:
                 return name
         return None
