@@ -544,3 +544,55 @@ class TestAdmissionRuntimeAndMetrics:
         text = METRICS.to_prometheus_text()
         assert 'queue_fair_share_gpu{queue="q"}' in text
         assert "e2e_scheduling_latency_milliseconds" in text
+
+
+class TestMixedWorkloadScenario:
+    def test_kubeflow_ray_and_fractions_all_bind(self):
+        """The final-drive scenario as regression: a PyTorchJob gang, a
+        RayCluster (plural podset names vs singular pod roles), and
+        fraction pods all bind in one cycle with no utility PodGroups."""
+        system = System(SystemConfig())
+        api = system.api
+        for i in range(4):
+            make_node(api, f"n{i}", gpu=8, labels={"rack": f"r{i}"})
+        for q in ("prod", "research"):
+            make_queue(api, q,
+                       deserved=dict(cpu="128", memory="1Ti", gpu=16))
+        api.create({"kind": "PyTorchJob", "apiVersion": "kubeflow.org/v1",
+                    "metadata": {"name": "train", "uid": "tj",
+                                 "labels": {"kai.scheduler/queue": "prod"}},
+                    "spec": {"pytorchReplicaSpecs": {
+                        "Master": {"replicas": 1},
+                        "Worker": {"replicas": 3}}}})
+        ref = owner_ref("PyTorchJob", "train", uid="tj",
+                        api_version="kubeflow.org/v1")
+        for i, role in enumerate(["master", "worker", "worker", "worker"]):
+            api.create(make_pod(
+                f"train-{role}-{i}", owner=ref, gpu=3,
+                labels={"training.kubeflow.org/replica-type": role}))
+        api.create({"kind": "RayCluster", "apiVersion": "ray.io/v1",
+                    "metadata": {"name": "rc", "uid": "rc",
+                                 "labels": {"kai.scheduler/queue":
+                                            "research"}},
+                    "spec": {"workerGroupSpecs": [{"minReplicas": 2}]}})
+        rref = owner_ref("RayCluster", "rc", uid="rc",
+                         api_version="ray.io/v1")
+        for name in ("rc-head", "rc-worker-0", "rc-worker-1"):
+            api.create(make_pod(name, owner=rref, gpu=2))
+        for i in range(2):
+            api.create(make_pod(f"frac-{i}", queue="research",
+                                annotations={"gpu-fraction": "0.5"}))
+        system.run_cycle()
+        bound = [p for p in api.list("Pod")
+                 if p["spec"].get("nodeName")
+                 and p["metadata"]["namespace"] == "default"]
+        assert len(bound) == 9
+        pg_names = [pg["metadata"]["name"] for pg in api.list("PodGroup")]
+        assert not any(n.startswith(("pg-scaling", "pg-reservation"))
+                       for n in pg_names)
+        phases = {pg["metadata"]["name"]: pg["status"]["phase"]
+                  for pg in api.list("PodGroup")}
+        system.run_cycle()
+        phases = {pg["metadata"]["name"]: pg["status"]["phase"]
+                  for pg in api.list("PodGroup")}
+        assert all(p == "Running" for p in phases.values()), phases
