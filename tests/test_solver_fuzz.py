@@ -1,0 +1,127 @@
+"""Scenario-solver fuzz on tiny clusters.
+
+Two guarantees checked across random victim mixes (elastic splits,
+min-runtime windows, priorities):
+1. soundness — whenever reclaim/preempt commits a solution, every cycle
+   invariant still holds (no oversubscription, gangs intact, accounting
+   consistent);
+2. a completeness floor — when evicting any SINGLE victim would make the
+   pending job fit and pass validation, the greedy prefix solver must find
+   some solution (it tries victims one at a time, so a one-victim solution
+   is always within its search space).
+"""
+
+import numpy as np
+import pytest
+
+from kai_scheduler_tpu.api import PodStatus, resources as rs
+from tests.fixtures import build_session, placements, run_action
+
+
+def random_contended_spec(seed):
+    rng = np.random.default_rng(seed)
+    n_nodes = int(rng.integers(1, 4))
+    nodes = {f"n{i}": {"gpu": 8, "cpu": "32", "mem": "256Gi"}
+             for i in range(n_nodes)}
+    queues = {
+        "q_a": {"deserved": dict(cpu="32", memory="256Gi",
+                                 gpu=int(rng.integers(2, 8)))},
+        "q_b": {"deserved": dict(cpu="32", memory="256Gi",
+                                 gpu=int(rng.integers(2, 8)))},
+    }
+    jobs = {}
+    # Fill the cluster with q_a victims of varied shapes.
+    node_free = {f"n{i}": 8 for i in range(n_nodes)}
+    v = 0
+    for node, free in node_free.items():
+        while free > 0 and v < 12:
+            gpu = int(min(free, rng.integers(1, 5)))
+            extra = int(rng.integers(0, 2))
+            min_avail = 1
+            tasks = [{"gpu": gpu, "status": "RUNNING", "node": node}]
+            jobs[f"victim{v}"] = {
+                "queue": "q_a", "min_available": min_avail,
+                "priority": int(rng.choice([0, 50])),
+                "last_start_ts": float(rng.choice([0.0, 990.0])),
+                "tasks": tasks,
+            }
+            free -= gpu
+            v += 1
+    # The starved reclaimer in q_b.
+    want = int(rng.integers(1, 9))
+    jobs["starved"] = {"queue": "q_b", "tasks": [{"gpu": want}]}
+    spec = {"now": 1000.0, "nodes": nodes, "queues": queues, "jobs": jobs}
+    if rng.random() < 0.5:
+        spec["queues"]["q_a"]["reclaim_min_runtime"] = 100.0
+    return spec, want
+
+
+def check_invariants(ssn):
+    for node in ssn.cluster.nodes.values():
+        assert rs.less_equal(node.used, node.allocatable), node
+        i = ssn.node_index(node.name)
+        np.testing.assert_allclose(ssn.node_idle[i], node.idle, atol=1e-6)
+    for pg in ssn.cluster.podgroups.values():
+        for ps in pg.pod_sets.values():
+            active = ps.num_active_allocated()
+            if 0 < active < min(ps.min_available, len(ps.pods)):
+                pre = sum(1 for t in ps.pods.values()
+                          if t.status in (PodStatus.RUNNING,
+                                          PodStatus.RELEASING))
+                assert active >= pre or active == 0, \
+                    f"gang {pg.name} split"
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_reclaim_soundness(seed):
+    spec, _ = random_contended_spec(seed)
+    ssn = build_session(spec)
+    run_action(ssn, "reclaim")
+    check_invariants(ssn)
+    # Evictions and pipelines must balance: every pipelined pod of the
+    # reclaimer fits within idle+releasing of its node.
+    for pg in ssn.cluster.podgroups.values():
+        for t in pg.pods.values():
+            if t.status == PodStatus.PIPELINED:
+                node = ssn.cluster.nodes[t.node_name]
+                assert np.all(node.idle + node.releasing >= -1e-6)
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_single_victim_completeness(seed):
+    spec, want = random_contended_spec(seed + 50)
+    # Oracle: find whether ANY single victim's eviction frees enough on
+    # one node AND the reclaim rules would allow it.
+    ssn = build_session(spec)
+    prop = ssn.proportion
+    starved = ssn.cluster.podgroups["starved"]
+    if not ssn.can_reclaim_resources(starved):
+        return  # gate closed: nothing to assert
+    min_runtime = spec["queues"]["q_a"].get("reclaim_min_runtime")
+    single_solution = False
+    for uid, pg in ssn.cluster.podgroups.items():
+        if not uid.startswith("victim"):
+            continue
+        task = next(iter(pg.pods.values()))
+        if min_runtime is not None and pg.last_start_ts is not None \
+                and (ssn.cluster.now - pg.last_start_ts) < min_runtime:
+            continue  # protected victim
+        node = ssn.cluster.nodes[task.node_name]
+        freed = node.idle[rs.RES_GPU] + task.req_vec()[rs.RES_GPU]
+        if freed < want:
+            continue
+        # DRF legality: q_a must remain reclaimable per the strategies —
+        # approximate with the plugin's own validator on a 1-victim
+        # scenario.
+        from kai_scheduler_tpu.actions.solvers import Scenario
+        ssn.on_job_solution_start()
+        scenario = Scenario(starved, list(starved.pods.values()),
+                            [(pg, [task])])
+        if ssn.validate_reclaim_scenario(scenario):
+            single_solution = True
+            break
+    run_action(ssn, "reclaim")
+    if single_solution:
+        st = ssn.cluster.podgroups["starved"].pods["starved-0"].status
+        assert st == PodStatus.PIPELINED, \
+            f"solver missed an available 1-victim solution (seed {seed})"
