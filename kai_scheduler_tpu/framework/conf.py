@@ -57,6 +57,10 @@ class SchedulerConfig:
     # Back the session's dense node mirrors with the native C++ state
     # store when the toolchain is available (native/statestore.cpp).
     use_native_store: bool = True
+    # Multi-chip: shard the node axis of the bulk-allocation kernel over
+    # this many devices (0 = single chip).  The node axis pads to a mesh
+    # multiple automatically.
+    mesh_devices: int = 0
     # Bulk allocation: when at least this many plain jobs are pending,
     # the allocate action places them all through ONE kernel call per
     # round (job order fixed per round) instead of one call per job.

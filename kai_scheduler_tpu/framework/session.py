@@ -108,6 +108,16 @@ class Session:
         bucket = self.config.node_pad_bucket
         if bucket:
             pad = max(bucket, -(-len(cluster.nodes) // bucket) * bucket)
+        # A device mesh needs the node axis divisible by its size.
+        self.mesh = None
+        if self.config.mesh_devices:
+            import jax
+            d = min(self.config.mesh_devices, len(jax.devices()))
+            if d > 1:
+                from ..parallel import cluster_mesh
+                self.mesh = cluster_mesh(d)
+                base = pad or max(len(cluster.nodes), 1)
+                pad = -(-base // d) * d
         self.snapshot: SnapshotTensors = pack(
             cluster, queue_usage=queue_usage, pad_nodes_to=pad)
         # Dense mutable mirrors: backed by the native C++ state store when

@@ -596,3 +596,26 @@ class TestMixedWorkloadScenario:
         phases = {pg["metadata"]["name"]: pg["status"]["phase"]
                   for pg in api.list("PodGroup")}
         assert all(p == "Running" for p in phases.values()), phases
+
+
+class TestVolumeBinding:
+    def test_pvc_binds_to_selected_node(self):
+        """The binder's volume-binding pre-bind phase binds pending PVCs
+        and stamps the selected node (k8s-plugins/volumebinding analog)."""
+        system = System(SystemConfig())
+        api = system.api
+        make_node(api, "n1")
+        make_queue(api, "q")
+        api.create({"kind": "PersistentVolumeClaim",
+                    "metadata": {"name": "data"},
+                    "spec": {}, "status": {"phase": "Pending"}})
+        pod = make_pod("stateful", queue="q", gpu=1)
+        pod["spec"]["volumes"] = [
+            {"name": "data", "persistentVolumeClaim": {"claimName": "data"}}]
+        api.create(pod)
+        system.run_cycle()
+        pvc = api.get("PersistentVolumeClaim", "data")
+        assert pvc["status"]["phase"] == "Bound"
+        assert pvc["metadata"]["annotations"][
+            "volume.kubernetes.io/selected-node"] == "n1"
+        assert api.get("Pod", "stateful")["spec"]["nodeName"] == "n1"

@@ -142,3 +142,28 @@ class TestShardedGrouped:
         np.testing.assert_array_equal(single.pipelined, multi.pipelined)
         np.testing.assert_allclose(np.asarray(single.node_idle),
                                    np.asarray(multi.node_idle))
+
+
+class TestMeshConfiguredSession:
+    def test_bulk_allocation_over_mesh_matches_single_chip(self):
+        """A session configured with mesh_devices runs bulk allocation
+        through the sharded kernel and reaches identical placements."""
+        from kai_scheduler_tpu.framework import SchedulerConfig
+        from tests.fixtures import build_session, placements, run_action
+
+        spec = {
+            "nodes": {f"n{i:02d}": {"gpu": 8} for i in range(12)},
+            "queues": {"q": {}},
+            "jobs": {f"j{i:02d}": {"queue": "q", "min_available": 3,
+                                   "tasks": [{"gpu": 2}] * 3}
+                     for i in range(10)},
+        }
+        single = build_session(spec, config=SchedulerConfig(
+            bulk_allocation_threshold=1))
+        run_action(single)
+        meshy = build_session(spec, config=SchedulerConfig(
+            bulk_allocation_threshold=1, mesh_devices=8))
+        assert meshy.mesh is not None
+        assert meshy.snapshot.node_allocatable.shape[0] % 8 == 0
+        run_action(meshy)
+        assert placements(single) == placements(meshy)
