@@ -179,26 +179,21 @@ def _execute_bulk(ssn, jobs):
         if not ok or not flat_tasks:
             break
 
+        import functools as _functools
         if ssn.mesh is not None:
             # Multi-chip: node axis sharded over the configured mesh
             # (parallel/sharded_grouped.py; bit-identical to single-chip).
             from ..parallel.sharded_grouped import sharded_allocate_grouped
-            result = sharded_allocate_grouped(
-                ssn.mesh, ssn._device_arrays(),
-                np.stack(rows_req), np.array(task_jobs, np.int32),
-                np.stack(rows_sel), np.stack(rows_tol),
-                np.array(job_allowed),
-                gpu_strategy=ssn.gpu_strategy,
-                cpu_strategy=ssn.cpu_strategy)
+            kernel = _functools.partial(sharded_allocate_grouped, ssn.mesh)
         else:
             from ..ops.allocate_grouped import allocate_grouped
-            result = allocate_grouped(
-                ssn._device_arrays(),
-                np.stack(rows_req), np.array(task_jobs, np.int32),
-                np.stack(rows_sel), np.stack(rows_tol),
-                np.array(job_allowed),
-                gpu_strategy=ssn.gpu_strategy,
-                cpu_strategy=ssn.cpu_strategy)
+            kernel = allocate_grouped
+        result = kernel(
+            ssn._device_arrays(),
+            np.stack(rows_req), np.array(task_jobs, np.int32),
+            np.stack(rows_sel), np.stack(rows_tol),
+            np.array(job_allowed),
+            gpu_strategy=ssn.gpu_strategy, cpu_strategy=ssn.cpu_strategy)
 
         success = np.asarray(result.job_success)
         placements = np.asarray(result.placements)

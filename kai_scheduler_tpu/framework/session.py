@@ -118,6 +118,12 @@ class Session:
                 self.mesh = cluster_mesh(d)
                 base = pad or max(len(cluster.nodes), 1)
                 pad = -(-base // d) * d
+            else:
+                from ..utils.logging import LOG
+                LOG.warning(
+                    "mesh_devices=%d requested but only %d JAX device(s) "
+                    "available; running single-chip",
+                    self.config.mesh_devices, len(jax.devices()))
         self.snapshot: SnapshotTensors = pack(
             cluster, queue_usage=queue_usage, pad_nodes_to=pad)
         # Dense mutable mirrors: backed by the native C++ state store when

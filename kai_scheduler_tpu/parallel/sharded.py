@@ -38,6 +38,9 @@ def _global_minmax(free_local, valid_local, axis_name):
     return jnp.stack([mn, mx])
 
 
+@functools.partial(jax.jit,
+                   static_argnames=("mesh", "gpu_strategy", "cpu_strategy",
+                                    "allow_pipeline"))
 def sharded_allocate_jobs(mesh, node_allocatable, node_idle, node_releasing,
                           node_labels, node_taints, node_pod_room,
                           task_req, task_job, task_selector,

@@ -36,6 +36,9 @@ from .mesh import NODE_AXIS
 from .sharded import _global_minmax
 
 
+@functools.partial(jax.jit,
+                   static_argnames=("mesh", "max_group", "gpu_strategy",
+                                    "cpu_strategy", "allow_pipeline"))
 def sharded_allocate_groups_kernel(mesh, node_allocatable, node_idle,
                                    node_releasing, node_labels, node_taints,
                                    node_pod_room, group_req, group_sel,
@@ -45,7 +48,10 @@ def sharded_allocate_groups_kernel(mesh, node_allocatable, node_idle,
                                    cpu_strategy: int = BINPACK,
                                    allow_pipeline: bool = True):
     """Returns (seg_nodes [G,K] global ids, seg_counts [G,K],
-    seg_pipe [G,K], group_placed [G], job_success [J], idle', rel')."""
+    seg_pipe [G,K], group_placed [G], job_success [J], idle', rel').
+
+    Jitted with the mesh static: repeated rounds reuse the compiled
+    executable instead of re-tracing the shard_map closure per call."""
     n = node_allocatable.shape[0]
     d = mesh.devices.size
     assert n % d == 0, f"node axis {n} must divide mesh size {d}"
@@ -227,7 +233,7 @@ def sharded_allocate_grouped(mesh, node_arrays, task_req, task_job,
         sharded_allocate_groups_kernel(
             mesh, *node_arrays, jnp.asarray(g_req), jnp.asarray(g_sel),
             jnp.asarray(g_tol), jnp.asarray(g_count), jnp.asarray(g_job),
-            jnp.asarray(job_allowed), max_group,
+            jnp.asarray(job_allowed), max_group=max_group,
             gpu_strategy=gpu_strategy, cpu_strategy=cpu_strategy,
             allow_pipeline=allow_pipeline)
 
