@@ -158,5 +158,6 @@ class System:
                                          attrs.allocated)
         self.api.drain()
         self.status_updater.flush()
+        self.queue_controller.reconcile_if_dirty()
         self.cache.gc_stale_bind_requests()
         self.api.drain()

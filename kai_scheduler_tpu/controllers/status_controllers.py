@@ -22,18 +22,27 @@ RUNNING_PHASES = ("Running", "Succeeded")
 class PodGroupController:
     def __init__(self, api: InMemoryKubeAPI):
         self.api = api
+        # Incremental pod index: (namespace, group) -> {pod name: phase}.
+        # Re-listing every pod per event is quadratic at scale.
+        self._pods_by_group: dict = defaultdict(dict)
         api.watch("Pod", self._on_pod)
         api.watch("PodGroup", self._on_podgroup)
 
     def _on_pod(self, event_type: str, pod: dict) -> None:
-        group = pod.get("metadata", {}).get("labels", {}).get(
-            POD_GROUP_LABEL)
-        if group:
-            pg = self.api.get_opt(
-                "PodGroup", group,
-                pod["metadata"].get("namespace", "default"))
-            if pg is not None:
-                self._reconcile(pg)
+        md = pod.get("metadata", {})
+        ns = md.get("namespace", "default")
+        group = md.get("labels", {}).get(POD_GROUP_LABEL)
+        if not group:
+            return
+        key = (ns, group)
+        if event_type == "DELETED":
+            self._pods_by_group[key].pop(md["name"], None)
+        else:
+            self._pods_by_group[key][md["name"]] = pod.get(
+                "status", {}).get("phase", "Pending")
+        pg = self.api.get_opt("PodGroup", group, ns)
+        if pg is not None:
+            self._reconcile(pg)
 
     def _on_podgroup(self, event_type: str, pg: dict) -> None:
         if event_type != "DELETED":
@@ -41,12 +50,11 @@ class PodGroupController:
 
     def _reconcile(self, pg: dict) -> None:
         ns = pg["metadata"].get("namespace", "default")
-        pods = [p for p in self.api.list("Pod", namespace=ns)
-                if p["metadata"].get("labels", {}).get(POD_GROUP_LABEL)
-                == pg["metadata"]["name"]]
+        phases = self._pods_by_group.get(
+            (ns, pg["metadata"]["name"]), {})
         counts = defaultdict(int)
-        for p in pods:
-            counts[p.get("status", {}).get("phase", "Pending")] += 1
+        for phase in phases.values():
+            counts[phase] += 1
         running = counts["Running"]
         min_member = pg.get("spec", {}).get("minMember", 1)
         if counts["Succeeded"] and running == 0 and counts["Pending"] == 0:
@@ -76,11 +84,20 @@ class PodGroupController:
 class QueueController:
     def __init__(self, api: InMemoryKubeAPI):
         self.api = api
+        self._dirty = False
         api.watch("PodGroup", self._on_change)
         api.watch("Queue", self._on_change)
 
     def _on_change(self, event_type: str, obj: dict) -> None:
-        self.reconcile_all()
+        # Debounced: queue aggregation scans every PodGroup, so running it
+        # per event is quadratic during drains — mark dirty and let
+        # reconcile_if_dirty() (called once per cycle) do the sweep.
+        self._dirty = True
+
+    def reconcile_if_dirty(self) -> None:
+        if self._dirty:
+            self._dirty = False
+            self.reconcile_all()
 
     def reconcile_all(self) -> None:
         queues = {q["metadata"]["name"]: q for q in self.api.list("Queue")}
