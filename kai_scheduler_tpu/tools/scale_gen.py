@@ -107,14 +107,50 @@ def run_scenario(scenario: str, n_nodes: int, seed: int = 0) -> dict:
     return result
 
 
+def run_system_scenario(n_nodes: int, n_pods: int) -> dict:
+    """Full-fleet variant: pods flow through admission, grouping,
+    scheduling, and binding over the in-memory API (the KWOK ring's
+    real-control-plane analog)."""
+    from ..controllers import System, SystemConfig, make_pod
+
+    system = System(SystemConfig())
+    api = system.api
+    t0 = time.perf_counter()
+    for i in range(n_nodes):
+        api.create({"kind": "Node",
+                    "metadata": {"name": f"node-{i:05d}"},
+                    "spec": {},
+                    "status": {"allocatable": {
+                        "cpu": "64", "memory": "512Gi",
+                        "nvidia.com/gpu": 8, "pods": 110}}})
+    api.create({"kind": "Queue", "metadata": {"name": "q"}, "spec": {}})
+    for i in range(n_pods):
+        api.create(make_pod(f"pod-{i:06d}", queue="q", gpu=2))
+    setup_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    system.run_cycle()
+    cycle_s = time.perf_counter() - t0
+    bound = len([p for p in api.list("Pod")
+                 if p["spec"].get("nodeName")])
+    return {"scenario": "system-fill", "nodes": n_nodes, "pods": n_pods,
+            "setup_s": round(setup_s, 2), "cycle_s": round(cycle_s, 2),
+            "pods_bound": bound}
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--nodes", type=int, default=500)
     ap.add_argument("--scenario", default="fill",
                     choices=("fill", "whole-gpu", "distributed", "burst",
-                             "reclaim"))
+                             "reclaim", "system-fill"))
+    ap.add_argument("--pods", type=int, default=0,
+                    help="pod count for system-fill (default 2x nodes)")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args(argv)
+    if args.scenario == "system-fill":
+        print(json.dumps(run_system_scenario(
+            args.nodes, args.pods or args.nodes * 2)))
+        return
     print(json.dumps(run_scenario(args.scenario, args.nodes, args.seed)))
 
 

@@ -125,3 +125,32 @@ class TestScaleHarness:
         out = run_scenario("distributed", 16)
         assert out["pods_bound"] == 16  # 4 gangs x 4 pods
         assert out["steady_cycle_s"] < out["first_cycle_s"]
+
+
+class TestSimulatorHttp:
+    def test_http_simulate_endpoint(self):
+        import json
+        import threading
+        import urllib.request
+        from http.server import HTTPServer
+        from kai_scheduler_tpu.tools.fairshare_simulator import _Handler
+
+        server = HTTPServer(("127.0.0.1", 0), _Handler)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        try:
+            body = json.dumps(TestFairshareSimulator.PAYLOAD).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.server_port}/simulate",
+                data=body, headers={"Content-Type": "application/json"})
+            out = json.loads(urllib.request.urlopen(req).read())
+            assert out["queues"]["A"]["fairShare"]["gpu"] > 0
+            # Unknown path -> 404.
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.server_port}/nope",
+                    data=b"{}")
+                assert False, "expected 404"
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+        finally:
+            server.shutdown()
