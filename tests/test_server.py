@@ -1,0 +1,26 @@
+"""Scheduler server: leader election lease and endpoint handlers."""
+
+import threading
+import time
+
+from kai_scheduler_tpu.server import LeaderElector
+
+
+def test_leader_election_excludes_second_instance(tmp_path):
+    lock = str(tmp_path / "lease.lock")
+    a = LeaderElector(lock)
+    a.acquire()
+    got_b = threading.Event()
+    b = LeaderElector(lock)
+
+    def contend():
+        b.acquire(poll_seconds=0.05)
+        got_b.set()
+
+    t = threading.Thread(target=contend, daemon=True)
+    t.start()
+    time.sleep(0.3)
+    assert not got_b.is_set()  # the lease holds
+    a.release()
+    assert got_b.wait(timeout=5.0)  # leadership transfers on release
+    b.release()
