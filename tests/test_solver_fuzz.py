@@ -125,3 +125,47 @@ def test_single_victim_completeness(seed):
         st = ssn.cluster.podgroups["starved"].pods["starved-0"].status
         assert st == PodStatus.PIPELINED, \
             f"solver missed an available 1-victim solution (seed {seed})"
+
+
+def random_priority_spec(seed):
+    """One queue, mixed priorities: preemption fodder."""
+    rng = np.random.default_rng(seed)
+    n_nodes = int(rng.integers(1, 3))
+    nodes = {f"n{i}": {"gpu": 8, "cpu": "32", "mem": "256Gi"}
+             for i in range(n_nodes)}
+    jobs = {}
+    v = 0
+    for i in range(n_nodes):
+        free = 8
+        while free > 0 and v < 8:
+            gpu = int(min(free, rng.integers(1, 5)))
+            jobs[f"victim{v}"] = {
+                "queue": "q", "priority": int(rng.choice([0, 10, 50])),
+                "preemptible": bool(rng.random() < 0.85),
+                "tasks": [{"gpu": gpu, "status": "RUNNING",
+                           "node": f"n{i}"}],
+            }
+            free -= gpu
+            v += 1
+    jobs["urgent"] = {"queue": "q", "priority": 100,
+                      "tasks": [{"gpu": int(rng.integers(1, 9))}]}
+    return {"now": 1000.0, "nodes": nodes,
+            "queues": {"q": {"deserved": dict(cpu="64", memory="512Gi",
+                                              gpu=8 * n_nodes)}},
+            "jobs": jobs}
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_preempt_soundness(seed):
+    spec = random_priority_spec(seed)
+    ssn = build_session(spec)
+    run_action(ssn, "preempt")
+    check_invariants(ssn)
+    # Priority discipline: only strictly-lower-priority preemptible jobs
+    # may have been evicted.
+    urgent_prio = 100
+    for pg in ssn.cluster.podgroups.values():
+        for t in pg.pods.values():
+            if t.status == PodStatus.RELEASING:
+                assert pg.priority < urgent_prio
+                assert pg.is_preemptible()
