@@ -163,7 +163,7 @@ def test_preempt_soundness(seed):
     check_invariants(ssn)
     # Priority discipline: only strictly-lower-priority preemptible jobs
     # may have been evicted.
-    urgent_prio = 100
+    urgent_prio = ssn.cluster.podgroups["urgent"].priority
     for pg in ssn.cluster.podgroups.values():
         for t in pg.pods.values():
             if t.status == PodStatus.RELEASING:
