@@ -80,15 +80,25 @@ class ClusterInfo:
                     out[pg.queue_id] += t.req_vec()
         return out
 
+    def min_node_gpu_memory(self) -> float:
+        """Smallest per-GPU memory across nodes that report one — the
+        divisor for converting gpu-memory requests into device fractions
+        (ssn.ClusterInfo.MinNodeGPUMemory in the reference)."""
+        mems = [n.gpu_memory_per_device for n in self.nodes.values()
+                if n.gpu_memory_per_device > 0]
+        return min(mems) if mems else 0.0
+
     def queue_requested(self) -> dict[str, np.ndarray]:
-        """Per-leaf-queue total demand (alive tasks)."""
+        """Per-leaf-queue total demand (allocated + Pending tasks; Gated
+        pods are excluded, matching proportion.go's Request roll-up)."""
+        min_gpu_mem = self.min_node_gpu_memory()
         out = {qid: rs.zeros() for qid in self.queues}
         for pg in self.podgroups.values():
             if pg.queue_id not in out:
                 continue
             for t in pg.pods.values():
-                if t.status in (PodStatus.PENDING, PodStatus.GATED) or t.is_active_allocated():
-                    out[pg.queue_id] += t.req_vec()
+                if t.status == PodStatus.PENDING or t.is_active_allocated():
+                    out[pg.queue_id] += t.req_vec(min_gpu_mem)
         return out
 
     def pending_jobs(self) -> list[PodGroupInfo]:

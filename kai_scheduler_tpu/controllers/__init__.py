@@ -2,8 +2,10 @@
 communicating only through API objects."""
 
 from .admission import Admission, AdmissionError
+from .apiserver import KubeAPIServer
 from .binder import Binder
 from .cache_builder import ClusterCache
+from .httpclient import HTTPKubeAPI
 from .kubeapi import InMemoryKubeAPI, make_pod, owner_ref
 from .nodescaleadjuster import NodeScaleAdjuster
 from .operator import ShardSpec, System, SystemConfig
@@ -11,6 +13,7 @@ from .podgrouper import PodGrouper
 from .status_controllers import PodGroupController, QueueController
 
 __all__ = ["Admission", "AdmissionError", "Binder", "ClusterCache",
-           "InMemoryKubeAPI", "make_pod", "owner_ref", "NodeScaleAdjuster",
-           "ShardSpec", "System", "SystemConfig", "PodGrouper",
-           "PodGroupController", "QueueController"]
+           "HTTPKubeAPI", "InMemoryKubeAPI", "KubeAPIServer", "make_pod",
+           "owner_ref", "NodeScaleAdjuster", "ShardSpec", "System",
+           "SystemConfig", "PodGrouper", "PodGroupController",
+           "QueueController"]

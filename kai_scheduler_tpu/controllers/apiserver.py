@@ -1,0 +1,255 @@
+"""HTTP API server: the real-cluster communication substrate.
+
+Serves the ``InMemoryKubeAPI`` object store over a Kubernetes-style REST +
+watch protocol so that controllers in OTHER processes (or on other hosts)
+can run the exact same code paths they use in-process.  This is the analog
+of the reference fleet's dependence on a live apiserver — informers and
+clientsets in ``/root/reference/pkg/apis/client/``, watch-config in
+``pkg/scheduler/scheduler.go:141-147`` — rebuilt as a compact HTTP server
+over the typed store instead of etcd.
+
+Protocol (JSON bodies everywhere):
+
+  POST   /apis/{kind}                      create
+  GET    /apis/{kind}?namespace=&labelSelector=k=v,k2=v2   list
+  GET    /apis/{kind}/{namespace}/{name}   get
+  PUT    /apis/{kind}/{namespace}/{name}   update (replace)
+  PATCH  /apis/{kind}/{namespace}/{name}   strategic-merge patch
+  DELETE /apis/{kind}/{namespace}/{name}   delete
+  GET    /watch?since={seq}                chunked stream of events
+  GET    /healthz
+
+The watch stream emits one JSON object per line:
+``{"seq": N, "type": "ADDED|MODIFIED|DELETED", "object": {...}}``
+plus periodic ``{"type": "HEARTBEAT", "seq": N}`` keep-alives.  ``seq`` is
+a server-side monotonic event sequence (the resourceVersion analog for
+watch resumption): a client reconnecting with ``since=N`` replays every
+event after N from the ring buffer, exactly like an informer re-list.
+
+Errors map to status codes: 404 NotFound, 409 Conflict — the HTTP client
+(httpclient.py) converts them back into the same exceptions
+``InMemoryKubeAPI`` raises, so callers cannot tell the substrates apart.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from collections import deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from .kubeapi import Conflict, InMemoryKubeAPI, NotFound
+
+EVENT_LOG_CAPACITY = 100_000
+HEARTBEAT_SECONDS = 1.0
+
+
+class EventLog:
+    """Bounded, sequenced event history for watch resumption."""
+
+    def __init__(self, capacity: int = EVENT_LOG_CAPACITY):
+        self._events: deque = deque(maxlen=capacity)
+        self._seq = 0
+        self.cond = threading.Condition()
+
+    def append(self, event_type: str, obj: dict) -> None:
+        with self.cond:
+            self._seq += 1
+            self._events.append((self._seq, event_type, obj))
+            self.cond.notify_all()
+
+    @property
+    def seq(self) -> int:
+        with self.cond:
+            return self._seq
+
+    def since(self, seq: int) -> list:
+        with self.cond:
+            return [e for e in self._events if e[0] > seq]
+
+
+class KubeAPIServer:
+    """Serve an InMemoryKubeAPI over HTTP with watch streaming.
+
+    All store mutations are serialized under one lock (the apiserver is the
+    consistency point, as in Kubernetes); events drain into the EventLog
+    immediately after each mutation so watchers observe every transition in
+    order.
+    """
+
+    def __init__(self, api: InMemoryKubeAPI | None = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.api = api or InMemoryKubeAPI()
+        self.log = EventLog()
+        self.lock = threading.RLock()
+        self.api.watch_any(lambda et, obj: self.log.append(et, obj))
+        handler = _make_handler(self)
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_port
+
+    @property
+    def url(self) -> str:
+        host, port = self.httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "KubeAPIServer":
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+    # -- handlers (called under self.lock) ---------------------------------
+    def handle(self, method: str, kind: str, namespace: str | None,
+               name: str | None, query: dict, body: dict | None):
+        api = self.api
+        with self.lock:
+            try:
+                if method == "POST":
+                    out = api.create(body)
+                elif method == "GET" and name is None:
+                    sel = _parse_selector(query.get("labelSelector"))
+                    out = {"items": api.list(kind,
+                                             namespace=query.get("namespace"),
+                                             label_selector=sel)}
+                elif method == "GET":
+                    out = api.get(kind, name, namespace)
+                elif method == "PUT":
+                    out = api.update(body)
+                elif method == "PATCH":
+                    out = api.patch(kind, name, body, namespace)
+                elif method == "DELETE":
+                    api.delete(kind, name, namespace)
+                    out = {}
+                else:
+                    return 405, {"error": f"bad method {method}"}
+            except NotFound as e:
+                return 404, {"error": str(e)}
+            except Conflict as e:
+                return 409, {"error": str(e)}
+            # Push events to the log right away so watch streams are live
+            # even when no in-process controller calls drain().
+            api.drain()
+        return 200, out
+
+
+def _parse_selector(raw: str | None) -> dict | None:
+    if not raw:
+        return None
+    out = {}
+    for part in raw.split(","):
+        if "=" in part:
+            k, v = part.split("=", 1)
+            out[k] = v
+    return out
+
+
+def _make_handler(server: "KubeAPIServer"):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def _send_json(self, code: int, payload: dict) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _read_body(self) -> dict | None:
+            length = int(self.headers.get("Content-Length") or 0)
+            if not length:
+                return None
+            return json.loads(self.rfile.read(length))
+
+        def _route(self, method: str) -> None:
+            parsed = urlparse(self.path)
+            query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+            parts = [p for p in parsed.path.split("/") if p]
+            if parsed.path == "/healthz":
+                self._send_json(200, {"ok": True})
+                return
+            if parsed.path.startswith("/watch"):
+                self._stream_watch(int(query.get("since", 0)))
+                return
+            if not parts or parts[0] != "apis" or len(parts) < 2:
+                self._send_json(404, {"error": "unknown route"})
+                return
+            kind = parts[1]
+            namespace = parts[2] if len(parts) > 2 else None
+            name = parts[3] if len(parts) > 3 else None
+            code, payload = server.handle(
+                method, kind, namespace or "default",
+                name, query, self._read_body())
+            self._send_json(code, payload)
+
+        def _stream_watch(self, since: int) -> None:
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-ndjson")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+
+            def send_line(payload: dict) -> None:
+                line = (json.dumps(payload) + "\n").encode()
+                self.wfile.write(f"{len(line):x}\r\n".encode())
+                self.wfile.write(line + b"\r\n")
+                self.wfile.flush()
+
+            seq = since
+            try:
+                while True:
+                    events = server.log.since(seq)
+                    for eseq, etype, obj in events:
+                        send_line({"seq": eseq, "type": etype, "object": obj})
+                        seq = eseq
+                    with server.log.cond:
+                        if server.log.seq == seq:
+                            server.log.cond.wait(timeout=HEARTBEAT_SECONDS)
+                    if not events:
+                        send_line({"type": "HEARTBEAT", "seq": seq})
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                return
+
+        def do_GET(self):
+            self._route("GET")
+
+        def do_POST(self):
+            self._route("POST")
+
+        def do_PUT(self):
+            self._route("PUT")
+
+        def do_PATCH(self):
+            self._route("PATCH")
+
+        def do_DELETE(self):
+            self._route("DELETE")
+
+        def log_message(self, *args):
+            pass
+
+    return Handler
+
+
+def main(argv=None) -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser("kai-apiserver")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8443)
+    args = ap.parse_args(argv)
+    server = KubeAPIServer(host=args.host, port=args.port)
+    print(f"kai-apiserver listening on {server.url}", flush=True)
+    server.httpd.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -41,11 +41,13 @@ class VolumeBindingPlugin(BindPlugin):
                               pod["metadata"].get("namespace", "default"))
             if pvc is not None and not pvc.get("status", {}).get("phase") \
                     == "Bound":
-                pvc.setdefault("status", {})["phase"] = "Bound"
-                pvc.setdefault("metadata", {}).setdefault(
-                    "annotations", {})["volume.kubernetes.io/selected-node"] \
-                    = node_name
-                api.update(pvc)
+                ns = pod["metadata"].get("namespace", "default")
+                api.patch(
+                    "PersistentVolumeClaim", claim,
+                    {"status": {"phase": "Bound"},
+                     "metadata": {"annotations": {
+                         "volume.kubernetes.io/selected-node": node_name}}},
+                    ns)
 
 
 class ResourceClaimPlugin(BindPlugin):
@@ -58,9 +60,10 @@ class ResourceClaimPlugin(BindPlugin):
             claim = api.get_opt("ResourceClaim", claim_name,
                                 pod["metadata"].get("namespace", "default"))
             if claim is not None:
-                claim.setdefault("status", {})["allocated"] = True
-                claim["status"]["nodeName"] = node_name
-                api.update(claim)
+                api.patch(
+                    "ResourceClaim", claim_name,
+                    {"status": {"allocated": True, "nodeName": node_name}},
+                    pod["metadata"].get("namespace", "default"))
 
 
 class Binder:
@@ -91,8 +94,19 @@ class Binder:
                 self._rollback(br)
             else:
                 status["phase"] = "Pending"
-                self.api._emit("MODIFIED", br)  # requeue
-        self.api.update(br)
+                self._requeue(br)
+        ns = br["metadata"].get("namespace", "default")
+        self.api.patch("BindRequest", br["metadata"]["name"],
+                       {"status": status}, ns)
+
+    def _requeue(self, br: dict) -> None:
+        """Re-enqueue a failed request for the next reconcile pass
+        (controller-runtime Requeue analog).  The in-memory API exposes a
+        direct event re-emit; over HTTP the status PATCH below already
+        produces a MODIFIED event that re-triggers this watcher."""
+        emit = getattr(self.api, "_emit", None)
+        if emit is not None:
+            emit("MODIFIED", br)
 
     def _bind(self, br: dict) -> None:
         spec = br["spec"]
@@ -111,7 +125,9 @@ class Binder:
         # The pods/binding call.
         pod["spec"]["nodeName"] = node_name
         pod.setdefault("status", {})["phase"] = "Running"
-        self.api.update(pod)
+        self.api.patch("Pod", pod["metadata"]["name"],
+                       {"spec": {"nodeName": node_name},
+                        "status": {"phase": "Running"}}, ns)
 
         for plugin in self.plugins:
             plugin.post_bind(self.api, pod, node_name, br)

@@ -224,11 +224,15 @@ class ClusterCache:
         (cache/evictor/default_evictor.go:24-45)."""
         pod = self.api.get_opt("Pod", task.name, task.namespace)
         if pod is not None:
-            pod.setdefault("status", {}).setdefault("conditions", []).append(
+            conditions = list(pod.get("status", {}).get("conditions", []))
+            conditions.append(
                 {"type": "TerminationByKaiScheduler", "status": "True",
                  "reason": "Evicted"})
-            pod["metadata"]["deletionTimestamp"] = str(self.now_fn())
-            self.api.update(pod)
+            self.api.patch(
+                "Pod", task.name,
+                {"status": {"conditions": conditions},
+                 "metadata": {"deletionTimestamp": str(self.now_fn())}},
+                task.namespace)
 
     def record_event(self, kind: str, message: str) -> None:
         if self.status_updater is not None:
@@ -263,8 +267,9 @@ class ClusterCache:
                     "PodGroup", pg.uid, pg.namespace,
                     {"conditions": conditions})
             else:
-                obj.setdefault("status", {})["conditions"] = conditions
-                self.api.update(obj)
+                self.api.patch("PodGroup", pg.uid,
+                               {"status": {"conditions": conditions}},
+                               pg.namespace)
 
     def gc_stale_bind_requests(self) -> int:
         """Stale BindRequest GC (cache/cache.go:371): drop requests whose

@@ -83,6 +83,13 @@ class InMemoryKubeAPI:
         key = obj_key(obj)
         if key not in self.objects:
             raise NotFound(str(key))
+        # Optimistic concurrency: a stale resourceVersion loses the write
+        # race (K8s update semantics; what makes Lease elections safe).
+        current = self.objects[key]
+        sent_rv = obj.get("metadata", {}).get("resourceVersion")
+        if (obj is not current and sent_rv is not None
+                and sent_rv != current["metadata"].get("resourceVersion")):
+            raise Conflict(f"{key} resourceVersion {sent_rv} is stale")
         obj["metadata"]["resourceVersion"] = str(next(self._rv))
         self.objects[key] = obj
         self._emit("MODIFIED", obj)
@@ -106,6 +113,11 @@ class InMemoryKubeAPI:
         """handler(event_type, obj); delivered on drain()."""
         self._watchers[kind].append(handler)
 
+    def watch_any(self, handler: Callable) -> None:
+        """handler(event_type, obj) for EVERY kind; delivered on drain().
+        Used by the HTTP apiserver to fan events out to remote watchers."""
+        self._watchers["*"].append(handler)
+
     def _emit(self, event_type: str, obj: dict) -> None:
         self._pending.append((event_type, obj))
 
@@ -120,8 +132,28 @@ class InMemoryKubeAPI:
             for event_type, obj in batch:
                 for handler in list(self._watchers.get(obj["kind"], ())):
                     handler(event_type, obj)
+                for handler in list(self._watchers.get("*", ())):
+                    handler(event_type, obj)
                 delivered += 1
         return delivered
+
+
+def replace_status(api, kind: str, name: str, status: dict,
+                   namespace: str = "default", attempts: int = 5) -> None:
+    """Replace an object's whole status subresource with optimistic-
+    concurrency retry.  Use instead of patch() when the new status must
+    DROP keys/entries — a merge-patch cannot clear a map (an empty dict
+    deep-merges to a no-op)."""
+    for _ in range(attempts):
+        obj = api.get(kind, name, namespace)
+        obj["status"] = status
+        try:
+            api.update(obj)
+            return
+        except Conflict:
+            continue
+    raise Conflict(f"replace_status({kind}/{namespace}/{name}): "
+                   f"{attempts} stale-write retries exhausted")
 
 
 def _deep_merge(dst: dict, src: dict) -> None:

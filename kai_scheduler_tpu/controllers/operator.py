@@ -59,7 +59,7 @@ class System:
         self.admission = Admission(
             self.api, require_queue_label=self.config.require_queue_label)
         self.podgrouper = PodGrouper(self.api)
-        self.podgroup_controller = PodGroupController(self.api)
+        self.podgroup_controller = PodGroupController(self.api, now_fn)
         self.queue_controller = QueueController(self.api)
         self.binder = Binder(self.api)
         self.scale_adjuster = NodeScaleAdjuster(self.api, now_fn)

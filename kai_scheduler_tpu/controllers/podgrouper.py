@@ -97,22 +97,30 @@ class PodGrouper:
                     }} if (ps.required_topology_level
                            or ps.preferred_topology_level) else {}),
                 } for ps in meta.pod_sets],
-                "topology": {
+                # Key omitted entirely when absent: a None value in a
+                # merge-patch means "delete", which would make the
+                # spec comparison below unequal forever.
+                **({"topology": {
                     "name": meta.topology_name,
                     "required": meta.required_topology_level,
                     "preferred": meta.preferred_topology_level,
-                } if meta.topology_name or meta.required_topology_level
-                or meta.preferred_topology_level else None,
+                }} if (meta.topology_name or meta.required_topology_level
+                       or meta.preferred_topology_level) else {}),
                 "owner": meta.owner,
             },
             "status": existing.get("status", {"phase": "Pending"})
             if existing else {"phase": "Pending"},
         }
+        # None-valued fields (priorityClassName on unprioritized workloads,
+        # legacy stored topology: None) are equivalent to absent ones: strip
+        # both sides so a merge-patch (which deletes None keys) converges.
+        desired["spec"] = _strip_nones(desired["spec"])
         if existing is None:
             self.api.create(desired)
-        elif existing["spec"] != desired["spec"]:
-            existing["spec"] = desired["spec"]
-            self.api.update(existing)
+        elif _strip_nones(existing["spec"]) != desired["spec"]:
+            self.api.patch("PodGroup", existing["metadata"]["name"],
+                           {"spec": desired["spec"]},
+                           existing["metadata"].get("namespace", "default"))
         # Label the pod with its group (+ subgroup when determinable).
         labels = pod["metadata"].setdefault("labels", {})
         changed = labels.get(POD_GROUP_LABEL) != meta.name
@@ -123,7 +131,9 @@ class PodGrouper:
                 labels[SUBGROUP_LABEL] = subgroup
                 changed = True
         if changed:
-            self.api.update(pod)
+            self.api.patch("Pod", pod["metadata"]["name"],
+                           {"metadata": {"labels": labels}},
+                           pod["metadata"].get("namespace", "default"))
 
     @staticmethod
     def _infer_subgroup(meta, pod: dict) -> str | None:
@@ -147,3 +157,12 @@ class PodGrouper:
             if name in pod_name or name.rstrip("s") in pod_name:
                 return name
         return None
+
+
+def _strip_nones(obj):
+    """Recursively drop None-valued dict entries (absent == None here)."""
+    if isinstance(obj, dict):
+        return {k: _strip_nones(v) for k, v in obj.items() if v is not None}
+    if isinstance(obj, list):
+        return [_strip_nones(v) for v in obj]
+    return obj

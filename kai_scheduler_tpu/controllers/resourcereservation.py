@@ -37,4 +37,8 @@ class ReservationAgent:
         if GPU_DEVICE_ANNOTATION in ann:
             return
         ann[GPU_DEVICE_ANNOTATION] = self.device_of_pod(pod)
-        self.api.update(pod)
+        self.api.patch(
+            "Pod", pod["metadata"]["name"],
+            {"metadata": {"annotations": {
+                GPU_DEVICE_ANNOTATION: ann[GPU_DEVICE_ANNOTATION]}}},
+            pod["metadata"].get("namespace", "default"))

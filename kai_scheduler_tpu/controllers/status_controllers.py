@@ -13,15 +13,16 @@ from __future__ import annotations
 from collections import defaultdict
 
 from ..utils.metrics import METRICS
-from .kubeapi import InMemoryKubeAPI
+from .kubeapi import InMemoryKubeAPI, replace_status
 from .podgrouper import POD_GROUP_LABEL
 
 RUNNING_PHASES = ("Running", "Succeeded")
 
 
 class PodGroupController:
-    def __init__(self, api: InMemoryKubeAPI):
+    def __init__(self, api: InMemoryKubeAPI, now_fn=None):
         self.api = api
+        self.now_fn = now_fn or (lambda: 0.0)
         # Incremental pod index: (namespace, group) -> {pod name: phase}.
         # Re-listing every pod per event is quadratic at scale.
         self._pods_by_group: dict = defaultdict(dict)
@@ -74,11 +75,14 @@ class PodGroupController:
         # Preserve fields other writers own (scheduler conditions,
         # lastStartTimestamp) — reconcile only the counters/phase.
         merged = {**current, **status}
+        # A real timestamp, not None: a None value in a merge-patch means
+        # "delete key", which would re-trigger this reconcile forever.
         if phase == "Running" and "lastStartTimestamp" not in current:
-            merged["lastStartTimestamp"] = None
+            merged["lastStartTimestamp"] = float(self.now_fn())
         if current != merged:
-            pg["status"] = merged
-            self.api.update(pg)
+            self.api.patch("PodGroup", pg["metadata"]["name"],
+                           {"status": merged},
+                           pg["metadata"].get("namespace", "default"))
 
 
 class QueueController:
@@ -126,8 +130,10 @@ class QueueController:
                 "requested": dict(requested.get(name, {})),
             }
             if q.get("status") != status:
-                q["status"] = status
-                self.api.update(q)
+                # Full replace: aggregation maps must be able to shrink
+                # back to empty, which a merge-patch cannot express.
+                replace_status(self.api, "Queue", name, status,
+                               q["metadata"].get("namespace", "default"))
             METRICS.set_gauge("queue_allocated_pods",
                               status["allocated"].get("pods", 0),
                               queue=name)

@@ -96,6 +96,7 @@ class ProportionPlugin(Plugin):
         self.queues: dict[str, QueueAttributes] = {}
         self.total = rs.zeros()
         self.saturation_multiplier = 1.0
+        self.min_gpu_mem = 0.0
 
     # -- session wiring ----------------------------------------------------
     def on_session_open(self, ssn) -> None:
@@ -137,19 +138,24 @@ class ProportionPlugin(Plugin):
                 usage=np.asarray(ssn.queue_usage.get(qid, rs.zeros()),
                                  float))
         # Roll allocated/non-preemptible/request up the parent chain
-        # (proportion.go:347-401).
+        # (proportion.go:347-401).  Pending gpu-memory requests are charged
+        # gpu_memory / MinNodeGPUMemory devices rather than a whole GPU.
+        min_gpu_mem = self.min_gpu_mem = cluster.min_node_gpu_memory()
         for pg in cluster.podgroups.values():
             if pg.queue_id not in self.queues:
                 continue
             for t in pg.pods.values():
-                req = t.req_vec()
+                req = t.req_vec(min_gpu_mem)
                 if t.is_active_allocated():
                     self._walk(pg.queue_id, "allocated", req)
                     self._walk(pg.queue_id, "request", req)
                     if not pg.is_preemptible():
                         self._walk(pg.queue_id, "allocated_non_preemptible",
                                    req)
-                elif t.status.name in ("PENDING", "GATED"):
+                elif t.status.name == "PENDING":
+                    # Only Pending (not Gated) demand counts toward Request
+                    # (proportion.go updateQueuesCurrentResourceUsage) —
+                    # unschedulable gated pods must not inflate fair share.
                     self._walk(pg.queue_id, "request", req)
 
     def _walk(self, qid: str, attr: str, req: np.ndarray) -> None:
@@ -197,7 +203,9 @@ class ProportionPlugin(Plugin):
         pg = self.ssn.cluster.podgroups.get(task.job_id)
         if pg is None or pg.queue_id not in self.queues:
             return
-        req = task.req_vec()
+        # Same gpu-memory divisor as the roll-up, or within-cycle
+        # allocated totals drift from the snapshot's accounting.
+        req = task.req_vec(self.min_gpu_mem)
         self._walk(pg.queue_id, "allocated", req)
         if not pg.is_preemptible():
             self._walk(pg.queue_id, "allocated_non_preemptible", req)
@@ -206,7 +214,7 @@ class ProportionPlugin(Plugin):
         pg = self.ssn.cluster.podgroups.get(task.job_id)
         if pg is None or pg.queue_id not in self.queues:
             return
-        req = -task.req_vec()
+        req = -task.req_vec(self.min_gpu_mem)
         self._walk(pg.queue_id, "allocated", req)
         if not pg.is_preemptible():
             self._walk(pg.queue_id, "allocated_non_preemptible", req)
@@ -228,8 +236,11 @@ class ProportionPlugin(Plugin):
         alloc_sum = float(np.where(q.allocatable_share() == UNLIMITED,
                                    self.total,
                                    q.allocatable_share()).sum())
+        # +alloc_sum: the smaller allocatable share wins the tie-break,
+        # matching queue_order_fn and prioritizeBasedOnAllocatableShare
+        # (queue_order.go).
         return (over, not starved, -q.priority, viol, share_with_job,
-                share0, -alloc_sum, q.creation_ts)
+                share0, alloc_sum, q.creation_ts)
 
     # -- queue ordering (queue_order/queue_order.go:19-242) ----------------
     def queue_order_fn(self, l: str, r: str, l_job, r_job,

@@ -11,9 +11,14 @@ endpoints:
   GET /job-order      current job ordering per queue (reflectjoborder)
   GET /healthz
 
-Leader election uses an fcntl file lock as the lease analog — exactly one
-scheduler process per shard advances; the rest block until the leader
-dies.
+Leader election comes in two flavors:
+
+- ``--leader-elect`` with no ``--api-server``: an fcntl file lock.
+  **Single-machine scope only** — flock serializes processes sharing one
+  filesystem; two replicas on different hosts would both become leader.
+- ``--leader-elect`` with ``--api-server URL``: a distributed coordination
+  Lease through the shared API store (utils/leaderelect.py), matching the
+  reference's Lease-based election (server.go:196-240) across hosts.
 """
 
 from __future__ import annotations
@@ -34,7 +39,9 @@ from .utils.metrics import METRICS
 
 
 class LeaderElector:
-    """flock-based lease (the coordination.Lease analog)."""
+    """flock-based lease. SINGLE-MACHINE ONLY: flock serializes processes
+    on one host's filesystem; use utils.leaderelect.LeaseElector (backed by
+    the shared API store) for multi-host deployments."""
 
     def __init__(self, lock_path: str):
         self.lock_path = lock_path
@@ -119,6 +126,12 @@ def run_app(argv=None) -> None:
     ap.add_argument("--verbosity", "-v", type=int, default=0)
     ap.add_argument("--leader-elect", action="store_true")
     ap.add_argument("--lock-file", default="/tmp/kai-scheduler-tpu.lock")
+    ap.add_argument("--api-server", default=None,
+                    help="URL of a kai-apiserver; the fleet then runs over "
+                         "HTTP instead of the embedded in-memory API, and "
+                         "--leader-elect uses a distributed Lease")
+    ap.add_argument("--lease-name", default="kai-scheduler")
+    ap.add_argument("--lease-duration", type=float, default=15.0)
     ap.add_argument("--node-pool-label", default=None)
     ap.add_argument("--node-pool", default=None)
     ap.add_argument("--k-value", type=float, default=1.0)
@@ -138,20 +151,34 @@ def run_app(argv=None) -> None:
     config = SchedulerConfig(k_value=args.k_value)
     if args.actions:
         config.actions = [a.strip() for a in args.actions.split(",")]
-    system = System(SystemConfig(
-        shards=[ShardSpec("default", args.node_pool_label, args.node_pool,
-                          config)],
-        usage_db=args.usage_db))
+    api = None
+    if args.api_server:
+        from .controllers.httpclient import HTTPKubeAPI
+        api = HTTPKubeAPI(args.api_server)
 
     if args.profile_dir:
         import jax
         jax.profiler.start_trace(args.profile_dir)
 
+    lease_elector = None
     if args.leader_elect:
-        LOG.info("waiting for leadership (%s)", args.lock_file)
-        elector = LeaderElector(args.lock_file)
-        elector.acquire()
+        if api is not None:
+            from .utils.leaderelect import LeaseElector
+            identity = f"{os.uname().nodename}-{os.getpid()}"
+            LOG.info("waiting for Lease %s as %s", args.lease_name, identity)
+            lease_elector = LeaseElector(api, args.lease_name, identity,
+                                         lease_duration=args.lease_duration)
+            lease_elector.acquire()
+        else:
+            LOG.info("waiting for leadership (%s)", args.lock_file)
+            elector = LeaderElector(args.lock_file)
+            elector.acquire()
         LOG.info("became leader")
+
+    system = System(SystemConfig(
+        shards=[ShardSpec("default", args.node_pool_label, args.node_pool,
+                          config)],
+        usage_db=args.usage_db), api=api)
 
     state: dict = {}
     handler = _make_handler(state)
@@ -162,6 +189,12 @@ def run_app(argv=None) -> None:
     cycle = 0
     try:
         while True:
+            if lease_elector is not None and not lease_elector.is_leader:
+                # The Lease was stolen or could not be renewed: stop
+                # scheduling immediately (split-brain guard) and exit so
+                # the supervisor restarts us as a candidate.
+                LOG.warning("lost leadership; stopping scheduling loop")
+                break
             system.run_cycle()
             if system.schedulers:
                 # Keep the last session around for introspection endpoints.

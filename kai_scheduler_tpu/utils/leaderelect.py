@@ -1,0 +1,161 @@
+"""Distributed leader election over coordination Lease objects.
+
+Mirrors the reference scheduler's leader election
+(``cmd/scheduler/app/server.go:196-240``: resourcelock.LeasesResourceLock
+with LeaseDuration 15s / RenewDeadline 10s / RetryPeriod 2s): candidates
+race to create-or-take a ``Lease`` object through the API (in-memory or
+HTTP — any object store with create/get/update + Conflict on stale
+resourceVersion), the holder renews on a timer, and a candidate takes over
+once ``renewTime + leaseDurationSeconds`` has elapsed.  Because the lease
+lives in the shared API store, election works across processes and hosts —
+unlike the flock elector in ``server.py``, which only serializes schedulers
+on one machine.
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+import time
+
+from ..controllers.kubeapi import Conflict, NotFound
+
+LEASE_KIND = "Lease"
+DEFAULT_NAMESPACE = "kai-system"
+
+
+class TransientRenewError(Exception):
+    """Renewal failed for a reason that may heal (apiserver unreachable);
+    the holder keeps retrying until its lease would have expired anyway."""
+
+
+class LeaseElector:
+    def __init__(self, api, name: str, identity: str,
+                 namespace: str = DEFAULT_NAMESPACE,
+                 lease_duration: float = 15.0,
+                 retry_period: float = 2.0,
+                 clock=time.time):
+        self.api = api
+        self.name = name
+        self.identity = identity
+        self.namespace = namespace
+        self.lease_duration = lease_duration
+        self.retry_period = retry_period
+        self.clock = clock
+        self._renew_thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        self.is_leader = False
+
+    # -- one acquisition attempt ------------------------------------------
+    def try_acquire(self) -> bool:
+        now = self.clock()
+        spec = {"holderIdentity": self.identity,
+                "leaseDurationSeconds": self.lease_duration,
+                "acquireTime": now, "renewTime": now}
+        try:
+            lease = self.api.get(LEASE_KIND, self.name, self.namespace)
+        except NotFound:
+            try:
+                self.api.create({"kind": LEASE_KIND,
+                                 "metadata": {"name": self.name,
+                                              "namespace": self.namespace},
+                                 "spec": spec})
+                return True
+            except Conflict:
+                return False
+        # Work on a copy: mutating the store's own dict would bypass the
+        # resourceVersion conflict check that makes the CAS race safe
+        # (in-memory get() returns the live stored object).
+        lease = copy.deepcopy(lease)
+        holder = lease["spec"].get("holderIdentity")
+        renew = float(lease["spec"].get("renewTime", 0))
+        duration = float(lease["spec"].get("leaseDurationSeconds",
+                                           self.lease_duration))
+        if holder == self.identity:
+            pass  # re-acquire our own lease (restart with same identity)
+        elif holder and now < renew + duration:
+            return False  # current holder is live
+        lease["spec"].update(spec)
+        try:
+            self.api.update(lease)
+            return True
+        except (Conflict, NotFound):
+            return False
+
+    def renew(self) -> bool:
+        """Refresh renewTime; False if the lease was stolen (we must stop
+        leading immediately, like losing the apiserver lease).  Raises
+        TransientRenewError on transport failures — the renewal loop keeps
+        retrying those until the lease itself would have expired."""
+        try:
+            try:
+                lease = self.api.get(LEASE_KIND, self.name, self.namespace)
+            except NotFound:
+                return self.try_acquire()
+            lease = copy.deepcopy(lease)
+            if lease["spec"].get("holderIdentity") != self.identity:
+                return False
+            lease["spec"]["renewTime"] = self.clock()
+            try:
+                self.api.update(lease)
+                return True
+            except Conflict:
+                return False
+            except NotFound:
+                return self.try_acquire()
+        except Exception as exc:  # transport error: apiserver unreachable
+            raise TransientRenewError(str(exc)) from exc
+
+    # -- blocking/looping API ---------------------------------------------
+    def acquire(self, timeout: float | None = None) -> bool:
+        """Block until leadership is won (or timeout); then start the
+        background renewal loop."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while not self._stop.is_set():
+            if self.try_acquire():
+                self.is_leader = True
+                self._start_renewal()
+                return True
+            if deadline is not None and time.monotonic() >= deadline:
+                return False
+            time.sleep(self.retry_period)
+        return False
+
+    def _start_renewal(self) -> None:
+        self._stop.clear()
+
+        def loop():
+            last_success = time.monotonic()
+            while not self._stop.wait(self.retry_period):
+                try:
+                    ok = self.renew()
+                except TransientRenewError:
+                    # Keep retrying while our lease is still live; once it
+                    # would have expired another candidate may hold it, so
+                    # stand down (renewDeadline semantics, server.go:60-63).
+                    if time.monotonic() - last_success < self.lease_duration:
+                        continue
+                    ok = False
+                if not ok:
+                    self.is_leader = False
+                    return
+                last_success = time.monotonic()
+
+        self._renew_thread = threading.Thread(target=loop, daemon=True)
+        self._renew_thread.start()
+
+    def release(self) -> None:
+        """Stop renewing and hand the lease off immediately."""
+        self._stop.set()
+        if self._renew_thread is not None:
+            self._renew_thread.join(timeout=self.retry_period * 2)
+        if self.is_leader:
+            try:
+                lease = self.api.get(LEASE_KIND, self.name, self.namespace)
+                if lease["spec"].get("holderIdentity") == self.identity:
+                    lease["spec"]["holderIdentity"] = ""
+                    lease["spec"]["renewTime"] = 0
+                    self.api.update(lease)
+            except (NotFound, Conflict):
+                pass
+        self.is_leader = False

@@ -1,0 +1,157 @@
+"""Prometheus-backed historical-usage client for time-based fairness.
+
+Mirrors pkg/scheduler/cache/usagedb/prometheus/prometheus.go:29-113: the
+scheduler's own queue-allocation gauges (kai_queue_allocated_*) are scraped
+by Prometheus; each fetch builds a window query — sliding
+(``sum_over_time((m)[window:resolution])`` via /api/v1/query, :217-229) or
+tumbling/cron (``sum_over_time(m)`` over a /api/v1/query_range from the
+last window reset, :231-250) — optionally multiplied by the exponential
+half-life decay term ``0.5^((now - time()) / half_life)`` (:290-299), and
+normalizes per-queue usage by cluster capacity from
+``sum(kube_node_status_capacity{resource=...})`` (:70-76,140-143).
+
+Transport is stdlib urllib against the Prometheus HTTP API; the fetch-loop
+caching + staleness semantics of usagedb.go (defaultFetchInterval 1m,
+staleness 5x) live here too, so the scheduler reads a cached snapshot
+between fetches and degrades to "no usage data" when stale.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import time
+import urllib.parse
+import urllib.request
+
+import numpy as np
+
+from ..api import resources as rs
+from .logging import LOG
+from .usagedb import UsageLister, UsageParams
+
+QUEUE_NAME_LABEL = "queue_name"
+
+# Resource axis -> (allocation metric param/default, capacity param/default);
+# prometheus.go:64-76.
+DEFAULT_ALLOCATION_METRICS = {
+    rs.RES_GPU: ("gpuAllocationMetric", "kai_queue_allocated_gpus"),
+    rs.RES_CPU: ("cpuAllocationMetric", "kai_queue_allocated_cpu_cores"),
+    rs.RES_MEM: ("memoryAllocationMetric", "kai_queue_allocated_memory_bytes"),
+}
+DEFAULT_CAPACITY_METRICS = {
+    rs.RES_GPU: ("gpuCapacityMetric",
+                 'sum(kube_node_status_capacity{resource="nvidia_com_gpu"})'),
+    rs.RES_CPU: ("cpuCapacityMetric",
+                 'sum(kube_node_status_capacity{resource="cpu"})'),
+    rs.RES_MEM: ("memoryCapacityMetric",
+                 'sum(kube_node_status_capacity{resource="memory"})'),
+}
+
+
+class PrometheusUsageClient(UsageLister):
+    def __init__(self, address: str, params: UsageParams | None = None,
+                 extra: dict | None = None, now_fn=time.time):
+        self.address = address.rstrip("/")
+        self.params = params or UsageParams()
+        extra = extra or {}
+        self.now_fn = now_fn
+        self.query_timeout = float(extra.get("usageQueryTimeout", 10.0))
+        self.resolution = float(extra.get("queryResolution", 60.0))
+        self.allocation_metrics = {
+            i: extra.get(key, default)
+            for i, (key, default) in DEFAULT_ALLOCATION_METRICS.items()}
+        self.capacity_metrics = {
+            i: extra.get(key, default)
+            for i, (key, default) in DEFAULT_CAPACITY_METRICS.items()}
+        # Tumbling windows anchor at an explicit start time (prometheus.go
+        # requires TumblingWindowStartTime when WindowType == tumbling).
+        self.tumbling_start = float(extra.get("tumblingWindowStartTime", 0.0))
+        # Fetch-loop cache (usagedb.go:17-40).
+        self.fetch_interval = self.params.fetch_interval_seconds
+        self._cached: dict | None = None
+        self.last_fetch_ts: float | None = None
+
+    # -- query building ----------------------------------------------------
+    def _decay_expr(self, metric: str) -> str:
+        hl = self.params.half_life_period_seconds
+        if not hl:
+            return metric
+        now = int(self.now_fn())
+        return f"(({metric}) * (0.5^(({now} - time()) / {hl:f})))"
+
+    def _latest_reset_time(self, now: float) -> float:
+        window = self.params.window_size_seconds
+        elapsed = now - self.tumbling_start
+        return self.tumbling_start + math.floor(elapsed / window) * window
+
+    def _http_get(self, path: str, query_params: dict) -> dict:
+        qs = urllib.parse.urlencode(query_params)
+        with urllib.request.urlopen(f"{self.address}{path}?{qs}",
+                                    timeout=self.query_timeout) as resp:
+            payload = json.loads(resp.read())
+        if payload.get("status") != "success":
+            raise RuntimeError(f"prometheus error: {payload}")
+        return payload["data"]
+
+    def _query_window(self, metric: str) -> list:
+        """Run the windowed query; returns a list of
+        (labels, summed value) samples."""
+        decayed = self._decay_expr(metric)
+        if self.params.window_type == "sliding":
+            window = int(self.params.window_size_seconds)
+            step = int(self.resolution)
+            expr = f"sum_over_time(({decayed})[{window}s:{step}s])"
+            data = self._http_get("/api/v1/query",
+                                  {"query": expr, "time": self.now_fn()})
+            return [(r["metric"], float(r["value"][1]))
+                    for r in data.get("result", [])]
+        # Tumbling: sum since the last window reset.  Expressed as a valid
+        # PromQL subquery over the elapsed-since-reset range (the Go
+        # reference's bare sum_over_time over a range query is not valid
+        # PromQL — this realizes the same sum-since-reset semantics).
+        now = self.now_fn()
+        since = max(int(now - self._latest_reset_time(now)),
+                    int(self.resolution))
+        step = int(self.resolution)
+        expr = f"sum_over_time(({decayed})[{since}s:{step}s])"
+        data = self._http_get("/api/v1/query",
+                              {"query": expr, "time": now})
+        return [(r["metric"], float(r["value"][1]))
+                for r in data.get("result", [])]
+
+    # -- fetch + normalize (GetResourceUsage, prometheus.go:113-147) -------
+    def fetch(self) -> dict:
+        usage: dict[str, np.ndarray] = {}
+        for i in range(rs.NUM_RES):
+            samples = self._query_window(self.capacity_metrics[i])
+            capacity = samples[0][1] if samples else 1.0
+            if capacity <= 0:
+                capacity = 1.0
+            for labels, value in self._query_window(
+                    self.allocation_metrics[i]):
+                queue = labels.get(QUEUE_NAME_LABEL, "")
+                if not queue:
+                    continue
+                vec = usage.setdefault(queue, rs.zeros())
+                vec[i] = value / capacity
+        return usage
+
+    # -- UsageLister surface ----------------------------------------------
+    def queue_usage(self, now: float) -> dict:
+        if (self._cached is not None and self.last_fetch_ts is not None
+                and now - self.last_fetch_ts < self.fetch_interval):
+            return self._cached
+        try:
+            self._cached = self.fetch()
+            self.last_fetch_ts = now
+        except Exception as exc:  # keep serving the cache until stale
+            LOG.warning("prometheus usage fetch failed: %s", exc)
+            if self._cached is None or self.is_stale(now):
+                return {}
+        return self._cached or {}
+
+    def is_stale(self, now: float) -> bool:
+        return (self.last_fetch_ts is None
+                or now - self.last_fetch_ts
+                > self.params.staleness_period_seconds)

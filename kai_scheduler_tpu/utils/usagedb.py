@@ -38,6 +38,11 @@ class UsageLister:
     def queue_usage(self, now: float) -> dict:
         raise NotImplementedError
 
+    def record(self, now: float, queue: str, allocated: np.ndarray,
+               duration: float = 1.0) -> None:
+        """Ingest one cycle's allocation sample.  No-op for clients whose
+        history lives elsewhere (Prometheus scrapes the gauges itself)."""
+
 
 class InMemoryUsageDB(UsageLister):
     """Sliding/tumbling-window usage with half-life decay.
@@ -99,10 +104,16 @@ class InMemoryUsageDB(UsageLister):
 def resolve_usage_client(spec: str | None,
                          params: UsageParams | None = None) -> UsageLister | None:
     """Client resolver (hub.go:26-69): scheme-based selection.  'memory://'
-    and 'fake://' map to the in-memory store; unknown schemes return None
-    (usage penalty disabled)."""
+    and 'fake://' map to the in-memory store; 'prometheus://host:port'
+    (or 'prometheus+https://...') to the Prometheus HTTP-API client;
+    unknown schemes return None (usage penalty disabled)."""
     if not spec:
         return None
     if spec.startswith(("memory://", "fake://")):
         return InMemoryUsageDB(params)
+    if spec.startswith(("prometheus://", "prometheus+https://")):
+        from .prometheus_usage import PrometheusUsageClient
+        scheme = "https" if spec.startswith("prometheus+https") else "http"
+        address = spec.split("://", 1)[1]
+        return PrometheusUsageClient(f"{scheme}://{address}", params)
     return None

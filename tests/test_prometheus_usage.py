@@ -1,0 +1,169 @@
+"""Prometheus usage-DB client against a stub Prometheus HTTP API
+(prometheus.go:29-113 behavior: windowed queries, half-life decay term,
+capacity normalization, queue_name label extraction, fetch caching)."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+import numpy as np
+import pytest
+
+from kai_scheduler_tpu.api import resources as rs
+from kai_scheduler_tpu.utils.prometheus_usage import PrometheusUsageClient
+from kai_scheduler_tpu.utils.usagedb import UsageParams, resolve_usage_client
+
+
+class StubProm:
+    """Records queries; answers with canned vectors/matrices."""
+
+    def __init__(self):
+        self.queries = []
+        self.range_queries = []
+        # metric substring -> list of (labels, value)
+        self.vectors = {}
+
+        stub = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                parsed = urlparse(self.path)
+                q = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+                expr = q.get("query", "")
+                if parsed.path == "/api/v1/query":
+                    stub.queries.append(expr)
+                    result = [{"metric": labels, "value": [0, str(val)]}
+                              for labels, val in stub._match(expr)]
+                    payload = {"status": "success",
+                               "data": {"resultType": "vector",
+                                        "result": result}}
+                else:
+                    stub.range_queries.append(q)
+                    result = [{"metric": labels,
+                               "values": [[0, str(val)], [60, str(val)]]}
+                              for labels, val in stub._match(expr)]
+                    payload = {"status": "success",
+                               "data": {"resultType": "matrix",
+                                        "result": result}}
+                body = json.dumps(payload).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    def _match(self, expr):
+        for key, samples in self.vectors.items():
+            if key in expr:
+                return samples
+        return []
+
+    @property
+    def url(self):
+        return f"http://127.0.0.1:{self.httpd.server_port}"
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+@pytest.fixture()
+def prom():
+    s = StubProm()
+    s.vectors = {
+        "kai_queue_allocated_gpus": [({"queue_name": "team-a"}, 40.0),
+                                     ({"queue_name": "team-b"}, 10.0)],
+        "kai_queue_allocated_cpu_cores": [({"queue_name": "team-a"}, 320.0)],
+        "kai_queue_allocated_memory_bytes": [],
+        "nvidia_com_gpu": [({}, 80.0)],
+        'resource="cpu"': [({}, 640.0)],
+        'resource="memory"': [({}, 1e12)],
+    }
+    yield s
+    s.stop()
+
+
+class TestSlidingWindow:
+    def test_normalized_usage_per_queue(self, prom):
+        client = PrometheusUsageClient(
+            prom.url, UsageParams(window_size_seconds=3600), now_fn=lambda: 1e6)
+        usage = client.queue_usage(1e6)
+        assert set(usage) == {"team-a", "team-b"}
+        np.testing.assert_allclose(usage["team-a"][rs.RES_GPU], 0.5)
+        np.testing.assert_allclose(usage["team-a"][rs.RES_CPU], 0.5)
+        np.testing.assert_allclose(usage["team-b"][rs.RES_GPU], 0.125)
+        # Sliding window shape: sum_over_time((m)[3600s:60s]).
+        assert any("sum_over_time" in q and "[3600s:60s]" in q
+                   for q in prom.queries)
+
+    def test_half_life_adds_decay_term(self, prom):
+        client = PrometheusUsageClient(
+            prom.url,
+            UsageParams(window_size_seconds=3600,
+                        half_life_period_seconds=7200),
+            now_fn=lambda: 1e6)
+        client.queue_usage(1e6)
+        assert any("0.5^((1000000 - time()) / 7200" in q
+                   for q in prom.queries)
+
+    def test_fetch_caching_and_staleness(self, prom):
+        clock = {"t": 1e6}
+        client = PrometheusUsageClient(
+            prom.url,
+            UsageParams(window_size_seconds=3600,
+                        fetch_interval_seconds=60,
+                        staleness_period_seconds=300),
+            now_fn=lambda: clock["t"])
+        client.queue_usage(clock["t"])
+        n = len(prom.queries)
+        # Within the fetch interval: served from cache, no new queries.
+        client.queue_usage(clock["t"] + 10)
+        assert len(prom.queries) == n
+        # After the interval: refetches.
+        client.queue_usage(clock["t"] + 61)
+        assert len(prom.queries) > n
+        assert not client.is_stale(clock["t"] + 70)
+        assert client.is_stale(clock["t"] + 61 + 301)
+
+    def test_fetch_failure_serves_cache_until_stale(self, prom):
+        client = PrometheusUsageClient(
+            prom.url,
+            UsageParams(window_size_seconds=3600,
+                        fetch_interval_seconds=10,
+                        staleness_period_seconds=300),
+            now_fn=lambda: 1e6)
+        first = client.queue_usage(1e6)
+        assert first
+        prom.stop()  # backend gone
+        assert client.queue_usage(1e6 + 20) == first   # cached
+        assert client.queue_usage(1e6 + 400) == {}     # stale -> no data
+
+
+class TestTumblingWindow:
+    def test_subquery_since_last_reset(self, prom):
+        client = PrometheusUsageClient(
+            prom.url,
+            UsageParams(window_size_seconds=1000, window_type="tumbling"),
+            extra={"tumblingWindowStartTime": 0},
+            now_fn=lambda: 2500.0)
+        usage = client.queue_usage(2500.0)
+        # Reset boundary floor(2500/1000)*1000 = 2000 -> 500s window.
+        assert any("[500s:60s]" in q for q in prom.queries)
+        np.testing.assert_allclose(usage["team-a"][rs.RES_GPU], 0.5)
+
+
+class TestResolver:
+    def test_prometheus_scheme(self, prom):
+        host = prom.url.split("//", 1)[1]
+        client = resolve_usage_client(f"prometheus://{host}")
+        assert isinstance(client, PrometheusUsageClient)
+        assert client.address == prom.url
+        # record() is a no-op (Prometheus scrapes the gauges itself).
+        client.record(0.0, "q", rs.zeros())

@@ -87,7 +87,11 @@ class TestUsageDB:
 
     def test_resolver(self):
         assert resolve_usage_client("memory://") is not None
-        assert resolve_usage_client("prometheus://x") is None
+        from kai_scheduler_tpu.utils.prometheus_usage import (
+            PrometheusUsageClient)
+        assert isinstance(resolve_usage_client("prometheus://x"),
+                          PrometheusUsageClient)
+        assert resolve_usage_client("unknown://x") is None
         assert resolve_usage_client(None) is None
 
 
