@@ -7,7 +7,7 @@ podgroup_info, queue_info, cluster_info) — see SURVEY.md §2.2.
 from . import resources
 from .cluster_info import BindRequest, ClusterInfo
 from .node_info import NodeInfo
-from .pod_info import DEFAULT_SUBGROUP, PodInfo
+from .pod_info import DEFAULT_SUBGROUP, AffinityTerm, PodInfo
 from .pod_status import PodStatus
 from .podgroup_info import PodGroupInfo, PodSet, SubGroupNode
 from .queue_info import QueueInfo, QueueQuota

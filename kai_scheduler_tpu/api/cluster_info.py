@@ -69,24 +69,41 @@ class ClusterInfo:
             return rs.zeros()
         return np.sum([n.allocatable for n in self.nodes.values()], axis=0)
 
+    def task_gpu_memory_context(self, task) -> float:
+        """Per-GPU memory divisor for a task's gpu-memory request: its
+        node's when placed, the cluster minimum otherwise (the reference's
+        minNodeGPUMemory fallback)."""
+        node = self.nodes.get(task.node_name) if task.node_name else None
+        if node is not None and node.gpu_memory_per_device > 0:
+            return node.gpu_memory_per_device
+        return self.min_node_gpu_memory()
+
     def queue_allocated(self) -> dict[str, np.ndarray]:
-        """Per-leaf-queue sum of active-allocated task requests."""
+        """Per-leaf-queue sum of active-allocated task requests.
+        gpu-memory tasks charge device fractions against their node's
+        per-GPU memory — the same normalization queue_requested uses, so
+        the two aggregates stay comparable."""
         out = {qid: rs.zeros() for qid in self.queues}
         for pg in self.podgroups.values():
             if pg.queue_id not in out:
                 continue
             for t in pg.pods.values():
                 if t.is_active_allocated():
-                    out[pg.queue_id] += t.req_vec()
+                    out[pg.queue_id] += t.req_vec(
+                        self.task_gpu_memory_context(t))
         return out
 
     def min_node_gpu_memory(self) -> float:
         """Smallest per-GPU memory across nodes that report one — the
         divisor for converting gpu-memory requests into device fractions
-        (ssn.ClusterInfo.MinNodeGPUMemory in the reference)."""
-        mems = [n.gpu_memory_per_device for n in self.nodes.values()
-                if n.gpu_memory_per_device > 0]
-        return min(mems) if mems else 0.0
+        (ssn.ClusterInfo.MinNodeGPUMemory in the reference).  Memoized:
+        node hardware is immutable within a snapshot."""
+        cached = getattr(self, "_min_gpu_mem", None)
+        if cached is None:
+            mems = [n.gpu_memory_per_device for n in self.nodes.values()
+                    if n.gpu_memory_per_device > 0]
+            cached = self._min_gpu_mem = min(mems) if mems else 0.0
+        return cached
 
     def queue_requested(self) -> dict[str, np.ndarray]:
         """Per-leaf-queue total demand (allocated + Pending tasks; Gated

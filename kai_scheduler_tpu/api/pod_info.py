@@ -19,6 +19,53 @@ DEFAULT_SUBGROUP = "default"
 
 
 @dataclass
+class AffinityTerm:
+    """One inter-pod (anti-)affinity term: a label selector over pods plus
+    the topology key defining the co-location domain (the
+    requiredDuringSchedulingIgnoredDuringExecution /
+    preferredDuringScheduling term shape the upstream InterPodAffinity
+    plugin consumes; reference wires it via
+    k8s_internal/predicates/predicates.go:70-167).
+
+    ``expressions`` carries labelSelector.matchExpressions entries
+    (``{"key", "operator", "values"}`` with In/NotIn/Exists/DoesNotExist),
+    AND-ed with the matchLabels equality selector exactly as upstream
+    metav1.LabelSelector does."""
+    selector: dict          # pod-label key -> required value (matchLabels)
+    topology_key: str       # node-label key defining the domain
+    weight: float = 1.0     # preferred terms only
+    expressions: list = field(default_factory=list)
+
+    def matches(self, labels: dict) -> bool:
+        if not all(labels.get(k) == v for k, v in self.selector.items()):
+            return False
+        for expr in self.expressions:
+            key = expr.get("key")
+            op = expr.get("operator")
+            values = expr.get("values") or []
+            if op == "In":
+                if labels.get(key) not in values:
+                    return False
+            elif op == "NotIn":
+                if key in labels and labels[key] in values:
+                    return False
+            elif op == "Exists":
+                if key not in labels:
+                    return False
+            elif op == "DoesNotExist":
+                if key in labels:
+                    return False
+            else:  # unknown operator: match nothing (loud, never too-wide)
+                return False
+        return True
+
+    def clone(self) -> "AffinityTerm":
+        return AffinityTerm(dict(self.selector), self.topology_key,
+                            self.weight,
+                            [dict(e) for e in self.expressions])
+
+
+@dataclass
 class PodInfo:
     uid: str
     name: str
@@ -39,9 +86,15 @@ class PodInfo:
     nominated_node: str = ""
     # Dynamic Resource Allocation: referenced claim names.
     resource_claims: list = field(default_factory=list)
-    # Inter-pod affinity: job uids to co-locate with / keep away from.
+    # Inter-pod affinity: job uids to co-locate with / keep away from
+    # (coarse fast path), plus full label-selector+topologyKey terms.
     pod_affinity_peers: list = field(default_factory=list)
     pod_anti_affinity_peers: list = field(default_factory=list)
+    labels: dict = field(default_factory=dict)
+    affinity_terms: list = field(default_factory=list)        # required
+    anti_affinity_terms: list = field(default_factory=list)   # required
+    preferred_affinity_terms: list = field(default_factory=list)
+    preferred_anti_affinity_terms: list = field(default_factory=list)
     # Index into the packed task tensor for the current snapshot.
     tensor_idx: int = -1
 
@@ -72,5 +125,13 @@ class PodInfo:
             resource_claims=list(self.resource_claims),
             pod_affinity_peers=list(self.pod_affinity_peers),
             pod_anti_affinity_peers=list(self.pod_anti_affinity_peers),
+            labels=dict(self.labels),
+            affinity_terms=[t.clone() for t in self.affinity_terms],
+            anti_affinity_terms=[t.clone()
+                                 for t in self.anti_affinity_terms],
+            preferred_affinity_terms=[
+                t.clone() for t in self.preferred_affinity_terms],
+            preferred_anti_affinity_terms=[
+                t.clone() for t in self.preferred_anti_affinity_terms],
             tensor_idx=self.tensor_idx,
         )

@@ -33,6 +33,7 @@ Errors map to status codes: 404 NotFound, 409 Conflict — the HTTP client
 
 from __future__ import annotations
 
+import copy
 import json
 import threading
 from collections import deque
@@ -54,6 +55,11 @@ class EventLog:
         self.cond = threading.Condition()
 
     def append(self, event_type: str, obj: dict) -> None:
+        # Deep copy at emit time: the store's live dict keeps mutating
+        # under later patches, and the streamer serializes outside the
+        # server lock — a snapshot keeps replayed history faithful and
+        # json.dumps race-free.
+        obj = copy.deepcopy(obj)
         with self.cond:
             self._seq += 1
             self._events.append((self._seq, event_type, obj))
@@ -63,6 +69,12 @@ class EventLog:
     def seq(self) -> int:
         with self.cond:
             return self._seq
+
+    def oldest(self) -> int:
+        """Seq number just before the oldest retained event: a client
+        resuming from anything older has lost events to ring eviction."""
+        with self.cond:
+            return self._seq - len(self._events)
 
     def since(self, seq: int) -> list:
         with self.cond:
@@ -205,6 +217,20 @@ def _make_handler(server: "KubeAPIServer"):
 
             seq = since
             try:
+                # Resumption from before the ring buffer's horizon: the
+                # missed events are gone (K8s answers 410 Gone and the
+                # informer re-lists).  Signal TOO_OLD, then replay the
+                # entire current store as SYNC events so the client's
+                # handlers converge on current state.
+                if seq < server.log.oldest():
+                    with server.lock:
+                        snapshot = [copy.deepcopy(o) for o in
+                                    server.api.objects.values()]
+                        seq = server.log.seq
+                    send_line({"type": "TOO_OLD", "seq": seq})
+                    for obj in snapshot:
+                        send_line({"type": "SYNC", "object": obj,
+                                   "seq": seq})
                 while True:
                     events = server.log.since(seq)
                     for eseq, etype, obj in events:

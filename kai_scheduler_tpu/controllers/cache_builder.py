@@ -51,6 +51,42 @@ def _requests_to_reqreq(pod: dict) -> ResourceRequirements:
         gpu=gpu, gpu_fraction=fraction, gpu_memory=gpu_memory, mig=mig)
 
 
+def _parse_pod_affinity(task: PodInfo, affinity: dict) -> None:
+    """Parse pod (anti-)affinity terms from the manifest's
+    spec.affinity.podAffinity/podAntiAffinity into AffinityTerms
+    (matchLabels + topologyKey; the shape upstream InterPodAffinity
+    consumes)."""
+    from ..api import AffinityTerm
+
+    def parse_term(term: dict, weight: float = 1.0):
+        sel = term.get("labelSelector") or {}
+        if not term.get("topologyKey"):
+            return None
+        return AffinityTerm(dict(sel.get("matchLabels") or {}),
+                            term["topologyKey"], weight,
+                            [dict(e) for e in
+                             sel.get("matchExpressions") or []])
+
+    def terms(block: dict, required_key: str, preferred_key: str):
+        req = [t for t in (parse_term(term)
+                           for term in block.get(required_key) or [])
+               if t is not None]
+        pref = [t for t in (parse_term(entry.get("podAffinityTerm") or {},
+                                       float(entry.get("weight", 1)))
+                            for entry in block.get(preferred_key) or [])
+                if t is not None]
+        return req, pref
+
+    aff = affinity.get("podAffinity") or {}
+    anti = affinity.get("podAntiAffinity") or {}
+    required = "requiredDuringSchedulingIgnoredDuringExecution"
+    preferred = "preferredDuringSchedulingIgnoredDuringExecution"
+    task.affinity_terms, task.preferred_affinity_terms = \
+        terms(aff, required, preferred)
+    task.anti_affinity_terms, task.preferred_anti_affinity_terms = \
+        terms(anti, required, preferred)
+
+
 def _quota_vec(spec: dict | None):
     if not spec:
         return None
@@ -166,7 +202,10 @@ class ClusterCache:
                 node_name=pod.get("spec", {}).get("nodeName", ""),
                 node_selector=pod.get("spec", {}).get("nodeSelector", {}),
                 tolerations={t["key"] for t in pod.get("spec", {}).get(
-                    "tolerations", [])})
+                    "tolerations", [])},
+                labels=dict(pod["metadata"].get("labels", {})))
+            _parse_pod_affinity(task, pod.get("spec", {}).get(
+                "affinity", {}))
             gpu_group = pod["metadata"].get("annotations", {}).get(
                 GPU_GROUP_ANNOTATION)
             if gpu_group:

@@ -140,12 +140,17 @@ class HTTPKubeAPI:
                         event = json.loads(raw)
                         self._watch_seq = max(self._watch_seq,
                                               int(event.get("seq", 0)))
-                        if event.get("type") == "HEARTBEAT":
+                        etype = event.get("type")
+                        if etype == "HEARTBEAT":
                             self._synced.set()
                             continue
+                        if etype == "TOO_OLD":
+                            continue  # SYNC replay follows
+                        # SYNC = re-list replay after ring-buffer eviction;
+                        # handlers see it as a MODIFIED convergence event.
+                        etype = "MODIFIED" if etype == "SYNC" else etype
                         with self._pending_lock:
-                            self._pending.append(
-                                (event["type"], event["object"]))
+                            self._pending.append((etype, event["object"]))
             except (urllib.error.URLError, OSError,
                     json.JSONDecodeError):
                 if self._stop.is_set():

@@ -118,8 +118,15 @@ class PodGrouper:
         if existing is None:
             self.api.create(desired)
         elif _strip_nones(existing["spec"]) != desired["spec"]:
+            # Keys dropped from the desired spec (e.g. topology constraints
+            # removed from the workload) must be deleted explicitly: a
+            # merge-patch only deletes what it Nones out.
+            patch_spec = dict(desired["spec"])
+            for key in existing["spec"]:
+                if key not in patch_spec:
+                    patch_spec[key] = None
             self.api.patch("PodGroup", existing["metadata"]["name"],
-                           {"spec": desired["spec"]},
+                           {"spec": patch_spec},
                            existing["metadata"].get("namespace", "default"))
         # Label the pod with its group (+ subgroup when determinable).
         labels = pod["metadata"].setdefault("labels", {})

@@ -99,6 +99,10 @@ class Session:
         self.deallocate_handlers: list[Callable] = []
         self.subset_nodes_fns: list[Callable] = []
         self.extra_score_fns: list[Callable] = []
+        # Hard [T,N] feasibility contributions (podaffinity terms,
+        # upstream predicates) and self-anti-affinity domain rows.
+        self.hard_node_mask_fns: list[Callable] = []
+        self.anti_domain_fns: list[Callable] = []
         self.pre_job_allocation_fns: list[Callable] = []
         self.job_solution_start_fns: list[Callable] = []
         self.gpu_order_fns: list[Callable] = []
@@ -351,7 +355,8 @@ class Session:
         task_job[t:] = 1  # padding rows belong to a gated-out dummy job
         job_allowed = np.array([True, False])
 
-        extra = np.zeros((t_pad, self.node_idle.shape[0]))
+        n_nodes = self.node_idle.shape[0]
+        extra = np.zeros((t_pad, n_nodes))
         for fn in self.extra_score_fns:
             contrib = fn(tasks)
             if contrib is not None:
@@ -359,10 +364,26 @@ class Session:
         if node_subset is not None:
             extra[:, ~node_subset] = -1e17  # mask out-of-subset nodes
 
+        # Hard per-task node masks (inter-pod affinity terms, upstream
+        # predicate verdicts): False = infeasible, enforced in-kernel.
+        mask = None
+        for fn in self.hard_node_mask_fns:
+            contrib = fn(tasks)
+            if contrib is not None:
+                mask = contrib if mask is None else (mask & contrib)
+        # Self-anti-affinity domain rows (spread-one-per-domain gangs).
+        anti_dom = None
+        for fn in self.anti_domain_fns:
+            contrib = fn(tasks)
+            if contrib is not None:
+                anti_dom = contrib
+                break
+
         # Homogeneous chunks with no extra score terms take the grouped
         # fill-plan kernel: one scan step instead of one per task.
         homogeneous = (
             t > 1 and node_subset is None and not extra.any()
+            and mask is None and anti_dom is None
             and self.gpu_strategy == BINPACK
             and self.cpu_strategy == BINPACK
             and (task_req[1:t] == task_req[0]).all()
@@ -391,11 +412,28 @@ class Session:
                                    bool(piped[i])))
             return Proposal(True, placements)
 
+        mask_pad = None
+        if mask is not None:
+            mask_pad = np.ones((t_pad, n_nodes), bool)
+            mask_pad[:t] = mask
+        dom_pad = None
+        if anti_dom is not None:
+            doms, marks, avoids = anti_dom
+            d = np.full((t_pad, n_nodes), -1, np.int32)
+            d[:t] = doms
+            m = np.zeros(t_pad, bool)
+            m[:t] = marks
+            a = np.zeros(t_pad, bool)
+            a[:t] = avoids
+            dom_pad = (jnp.asarray(d), jnp.asarray(m), jnp.asarray(a))
         result = allocate_jobs_kernel(
             *self._device_arrays(),
             jnp.asarray(task_req), jnp.asarray(task_job),
             jnp.asarray(task_sel), jnp.asarray(task_tol),
             jnp.asarray(job_allowed), jnp.asarray(extra),
+            task_node_mask=(None if mask_pad is None
+                            else jnp.asarray(mask_pad)),
+            task_anti_domain=dom_pad,
             gpu_strategy=self.gpu_strategy, cpu_strategy=self.cpu_strategy,
             allow_pipeline=allow_pipeline, pipeline_only=pipeline_only)
 

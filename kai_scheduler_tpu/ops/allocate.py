@@ -54,6 +54,7 @@ def allocate_jobs_kernel(node_allocatable, node_idle, node_releasing,
                          node_labels, node_taints, node_pod_room,
                          task_req, task_job, task_selector, task_tolerations,
                          job_allowed, task_extra_scores=None,
+                         task_node_mask=None, task_anti_domain=None,
                          gpu_strategy: int = BINPACK,
                          cpu_strategy: int = BINPACK,
                          allow_pipeline: bool = True,
@@ -64,13 +65,34 @@ def allocate_jobs_kernel(node_allocatable, node_idle, node_releasing,
     capacity_policy) — a gated-out job fails without touching state.
     task_extra_scores: optional [T,N] additive score terms (topology,
     nominated node) computed by other kernels.
+    task_node_mask: optional [T,N] bool hard predicate (inter-pod affinity
+    terms against existing pods, upstream-predicate verdicts): a False
+    node is infeasible for that task, not merely low-scored.
+    task_anti_domain: optional (dom [T,N] int32, marks [T] bool,
+    avoids [T] bool) — in-gang REQUIRED anti-affinity for ONE term.
+    ``dom`` maps nodes to the term's topology domains (-1 = no domain);
+    a task with ``marks`` creates a pod matching the term's selector, a
+    task with ``avoids`` carries the term.  Within a gang, K8s semantics
+    (incl. symmetry) reduce to: an avoider cannot enter a domain where a
+    marker already landed, and a marker cannot enter a domain where an
+    avoider already landed.  Blocked state lives in the scan carry and
+    resets at each job boundary, so rollback is automatic.
     pipeline_only: scenario-simulation mode — all placements pipeline
     (statement.go ConvertAllAllocatedToPipelined semantics come free:
     nothing claims idle).
     """
     T = task_req.shape[0]
+    N = node_allocatable.shape[0]
     if task_extra_scores is None:
-        task_extra_scores = jnp.zeros((T, node_allocatable.shape[0]))
+        task_extra_scores = jnp.zeros((T, N))
+    if task_node_mask is None:
+        task_node_mask = jnp.ones((T, N), bool)
+    if task_anti_domain is None:
+        anti_dom = jnp.full((T, N), -1, jnp.int32)
+        anti_marks = jnp.zeros(T, bool)
+        anti_avoids = jnp.zeros(T, bool)
+    else:
+        anti_dom, anti_marks, anti_avoids = task_anti_domain
 
     class Carry(NamedTuple):
         idle: jnp.ndarray
@@ -81,10 +103,15 @@ def allocate_jobs_kernel(node_allocatable, node_idle, node_releasing,
         ck_room: jnp.ndarray
         cur_job: jnp.ndarray
         cur_ok: jnp.ndarray
+        # Self-anti-affinity: domains closed to avoiders (a marker landed)
+        # and to markers (an avoider landed; upstream symmetry).
+        blocked_avoiders: jnp.ndarray  # [N] bool
+        blocked_markers: jnp.ndarray   # [N] bool
 
     init = Carry(node_idle, node_releasing, node_pod_room,
                  node_idle, node_releasing, node_pod_room,
-                 jnp.array(-1, jnp.int32), jnp.array(False))
+                 jnp.array(-1, jnp.int32), jnp.array(False),
+                 jnp.zeros(N, bool), jnp.zeros(N, bool))
 
     def step(carry: Carry, t):
         j = task_job[t]
@@ -98,6 +125,8 @@ def allocate_jobs_kernel(node_allocatable, node_idle, node_releasing,
         ck_rel = jnp.where(new_job, rel, carry.ck_rel)
         ck_room = jnp.where(new_job, room, carry.ck_room)
         ok = jnp.where(new_job, job_allowed[j], carry.cur_ok)
+        blocked_avoiders = jnp.where(new_job, False, carry.blocked_avoiders)
+        blocked_markers = jnp.where(new_job, False, carry.blocked_markers)
 
         req = task_req[t]
         fit_now, fit_future = feasibility_row(
@@ -107,6 +136,9 @@ def allocate_jobs_kernel(node_allocatable, node_idle, node_releasing,
             fit_now = jnp.zeros_like(fit_now)
         feasible = fit_now | (fit_future if (allow_pipeline or pipeline_only)
                               else jnp.zeros_like(fit_future))
+        feasible = feasible & task_node_mask[t] \
+            & ~(anti_avoids[t] & blocked_avoiders) \
+            & ~(anti_marks[t] & blocked_markers)
         score = score_row(node_allocatable, idle, req, feasible,
                           fit_now, gpu_strategy, cpu_strategy)
         score = score + task_extra_scores[t]
@@ -123,10 +155,19 @@ def allocate_jobs_kernel(node_allocatable, node_idle, node_releasing,
         rel = rel - take_rel
         room = room - one_hot.astype(room.dtype)
 
+        # Self-anti-affinity: close the winning node's whole topology
+        # domain to the complementary role for the rest of the gang.
+        dom_row = anti_dom[t]
+        won_dom = dom_row[best]
+        in_dom = found & (won_dom >= 0) & (dom_row == won_dom)
+        blocked_avoiders = blocked_avoiders | (anti_marks[t] & in_dom)
+        blocked_markers = blocked_markers | (anti_avoids[t] & in_dom)
+
         ok = ok & found
         out = (jnp.where(found, best, -1).astype(jnp.int32), pipelined, found)
         return Carry(idle, rel, room, ck_idle, ck_rel, ck_room,
-                     j.astype(jnp.int32), ok), out
+                     j.astype(jnp.int32), ok,
+                     blocked_avoiders, blocked_markers), out
 
     carry, (placements, pipelined, found) = jax.lax.scan(
         step, init, jnp.arange(T))

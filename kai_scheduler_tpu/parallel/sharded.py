@@ -45,6 +45,7 @@ def sharded_allocate_jobs(mesh, node_allocatable, node_idle, node_releasing,
                           node_labels, node_taints, node_pod_room,
                           task_req, task_job, task_selector,
                           task_tolerations, job_allowed,
+                          task_node_mask=None,
                           gpu_strategy: int = BINPACK,
                           cpu_strategy: int = BINPACK,
                           allow_pipeline: bool = True) -> AllocationResult:
@@ -52,11 +53,17 @@ def sharded_allocate_jobs(mesh, node_allocatable, node_idle, node_releasing,
 
     Node arrays shard over the mesh's ``nodes`` axis (their leading
     dimension must divide evenly); task/job arrays replicate.
+    task_node_mask ([T,N] hard feasibility, e.g. inter-pod affinity)
+    shards over its node axis.  Self-gang anti-affinity domain rows are
+    not supported here — the action layer keeps such jobs on the
+    single-chip kernel.
     """
     n = node_allocatable.shape[0]
     d = mesh.devices.size
     assert n % d == 0, f"node axis {n} must divide mesh size {d}"
     t = task_req.shape[0]
+    if task_node_mask is None:
+        task_node_mask = jnp.ones((t, n), bool)
 
     node_spec = P(NODE_AXIS)
     rep = P()
@@ -64,11 +71,11 @@ def sharded_allocate_jobs(mesh, node_allocatable, node_idle, node_releasing,
     @functools.partial(
         jax.shard_map, mesh=mesh,
         in_specs=(node_spec, node_spec, node_spec, node_spec, node_spec,
-                  node_spec, rep, rep, rep, rep, rep),
+                  node_spec, rep, rep, rep, rep, rep, P(None, NODE_AXIS)),
         out_specs=(rep, rep, rep, node_spec, node_spec),
         check_vma=False)
     def run(alloc, idle, rel, labels, taints, room,
-            treq, tjob, tsel, ttol, jallowed):
+            treq, tjob, tsel, ttol, jallowed, tmask):
         n_local = alloc.shape[0]
         my_dev = jax.lax.axis_index(NODE_AXIS)
         offset = my_dev * n_local
@@ -104,6 +111,7 @@ def sharded_allocate_jobs(mesh, node_allocatable, node_idle, node_releasing,
                 ttol[ti])
             feasible = fit_now | (fit_future if allow_pipeline
                                   else jnp.zeros_like(fit_future))
+            feasible = feasible & tmask[ti]
             minmax = _global_minmax(c_idle, feasible, NODE_AXIS)
             score = score_row(alloc, c_idle, req, feasible, fit_now,
                               gpu_strategy, cpu_strategy, minmax=minmax)
@@ -154,7 +162,7 @@ def sharded_allocate_jobs(mesh, node_allocatable, node_idle, node_releasing,
     placements, pipelined, found, idle_out, rel_out = run(
         node_allocatable, node_idle, node_releasing, node_labels,
         node_taints, node_pod_room, task_req, task_job, task_selector,
-        task_tolerations, job_allowed)
+        task_tolerations, job_allowed, task_node_mask)
 
     num_jobs = job_allowed.shape[0]
     placed = jax.ops.segment_sum(found.astype(jnp.int32), task_job,

@@ -1,11 +1,29 @@
-"""Pod-affinity plugin: inter-pod affinity/anti-affinity score terms.
+"""Pod-affinity plugin: full inter-pod (anti-)affinity semantics.
 
-Mirrors pkg/scheduler/plugins/podaffinity (NodeOrder + predicate assist) at
-the granularity the tensor path supports: tasks carry
-``pod_affinity_peers`` (job uids to co-locate with) and
-``pod_anti_affinity_peers`` (job uids to avoid); nodes hosting peers gain
-or lose score.  Gang-internal affinity (co-locating a job's own pods) is
-served by bin-pack already.
+Mirrors the reference's use of the upstream InterPodAffinity plugin
+(pkg/scheduler/k8s_internal/predicates/predicates.go:70-167 wires
+PreFilter/Filter; pkg/scheduler/api/pod_affinity/ keeps per-node pod
+affinity metadata) re-designed for the tensor path: every
+(selector, topologyKey) term becomes a [N] node mask via domain
+occupancy — "does this node's domain contain a pod matching the
+selector" — computed once per proposal from the live cluster state.
+
+Semantics covered:
+- REQUIRED pod affinity: the task may only go where a matching pod's
+  domain is (bootstrap rule: if no pod matches anywhere but the task's
+  own labels match the term, any node is allowed — the upstream rule that
+  lets the first pod of a self-affine group schedule).
+- REQUIRED pod anti-affinity: domains containing matching pods are
+  excluded; SYMMETRY is honored — an existing pod's anti-affinity term
+  also repels an incoming task that matches it (upstream
+  haveAffinityTermsWithPods symmetry).
+- Self-gang anti-affinity (every member repels its siblings —
+  spread-one-per-domain): enforced inside the allocation kernel via
+  ``task_anti_domain`` rows (ops/allocate.py gang_blocked carry), since
+  the static mask cannot see in-gang placements.
+- PREFERRED terms contribute ±weight-scaled score on matching domains.
+- Legacy coarse peers (``pod_affinity_peers`` job-uid lists) keep their
+  score behavior.
 """
 
 from __future__ import annotations
@@ -15,14 +33,156 @@ import numpy as np
 from .base import Plugin, register_plugin
 
 AFFINITY_SCORE = 50.0  # between placement (<=9+10) and availability (100)
+HOSTNAME_KEY = "kubernetes.io/hostname"
 
 
 @register_plugin("podaffinity")
 class PodAffinityPlugin(Plugin):
     def on_session_open(self, ssn) -> None:
         self.ssn = ssn
+        self._domain_cache: dict = {}
         ssn.extra_score_fns.append(self.extra_scores)
+        ssn.hard_node_mask_fns.append(self.hard_masks)
+        ssn.anti_domain_fns.append(self.anti_domains)
 
+    # -- domain encoding ---------------------------------------------------
+    def _domains(self, topology_key: str) -> tuple[np.ndarray, int]:
+        """[N] int32 domain id per node for one topology key (-1 = node
+        lacks the label).  hostname is every node its own domain.
+        Node labels are immutable within a session, so memoized."""
+        cached = self._domain_cache.get(topology_key)
+        if cached is not None:
+            return cached
+        cluster = self.ssn.cluster
+        names = self.ssn.snapshot.node_names
+        n = self.ssn.node_idle.shape[0]
+        dom = np.full(n, -1, np.int32)
+        ids: dict[str, int] = {}
+        for i, name in enumerate(names):
+            node = cluster.nodes.get(name)
+            if node is None:
+                continue
+            if topology_key == HOSTNAME_KEY:
+                value = name
+            else:
+                value = node.labels.get(topology_key)
+            if value is None:
+                continue
+            dom[i] = ids.setdefault(value, len(ids))
+        self._domain_cache[topology_key] = (dom, len(ids))
+        return dom, len(ids)
+
+    def _active_pods(self):
+        """(labels, node_idx, anti_terms, job_id) for every active
+        allocated pod currently on a snapshot node."""
+        out = []
+        for pg in self.ssn.cluster.podgroups.values():
+            for task in pg.pods.values():
+                if not task.is_active_allocated() or not task.node_name:
+                    continue
+                idx = self.ssn.node_index(task.node_name)
+                if idx < 0:
+                    continue
+                out.append((task.labels, idx,
+                            getattr(task, "anti_affinity_terms", []),
+                            task.job_id))
+        return out
+
+    def _term_mask(self, term, pods, exclude_job: str | None = None
+                   ) -> np.ndarray:
+        """[N] bool: nodes whose domain holds a pod matching the term."""
+        dom, n_dom = self._domains(term.topology_key)
+        if n_dom == 0:
+            return np.zeros(self.ssn.node_idle.shape[0], bool)
+        has = np.zeros(n_dom, bool)
+        for labels, idx, _anti, job_id in pods:
+            if exclude_job is not None and job_id == exclude_job:
+                continue
+            if dom[idx] >= 0 and term.matches(labels):
+                has[dom[idx]] = True
+        mask = np.zeros(dom.shape[0], bool)
+        valid = dom >= 0
+        mask[valid] = has[dom[valid]]
+        return mask
+
+    # -- hard masks (required terms) ---------------------------------------
+    def hard_masks(self, tasks):
+        needs = any(
+            getattr(t, "affinity_terms", None)
+            or getattr(t, "anti_affinity_terms", None)
+            for t in tasks)
+        pods = None
+        sym_repellers = None
+        if not needs:
+            # Symmetry can constrain label-bearing tasks even without own
+            # terms — only scan when some existing pod has anti terms.
+            pods = self._active_pods()
+            if not any(anti for _l, _i, anti, _j in pods):
+                return None
+        if pods is None:
+            pods = self._active_pods()
+
+        n = self.ssn.node_idle.shape[0]
+        out = np.ones((len(tasks), n), bool)
+        touched = False
+        for i, task in enumerate(tasks):
+            row = out[i]
+            for term in getattr(task, "affinity_terms", []) or []:
+                mask = self._term_mask(term, pods)
+                if not mask.any() and term.matches(task.labels):
+                    continue  # bootstrap: first self-affine pod
+                row &= mask
+                touched = True
+            for term in getattr(task, "anti_affinity_terms", []) or []:
+                # Own gang's already-running pods are handled here too
+                # (RemovePod on evicted victims keeps them out of `pods`).
+                row &= ~self._term_mask(term, pods)
+                touched = True
+            # Anti-affinity symmetry: existing pods' anti terms repel a
+            # matching incoming task from their domains.
+            if sym_repellers is None:
+                sym_repellers = [
+                    (labels, idx, term)
+                    for labels, idx, anti, _j in pods for term in anti]
+            for _labels, idx, term in sym_repellers:
+                if term.matches(task.labels):
+                    dom, n_dom = self._domains(term.topology_key)
+                    if dom[idx] >= 0:
+                        row &= ~(dom == dom[idx])
+                        touched = True
+        return out if touched else None
+
+    # -- self-gang anti-affinity domains -----------------------------------
+    def anti_domains(self, tasks):
+        """(dom [T,N], marks [T], avoids [T]) for in-gang REQUIRED
+        anti-affinity: a term some chunk member carries that some chunk
+        member's labels match.  One term per chunk (multiple distinct
+        in-gang terms are rare; the first active one wins — cross-gang
+        enforcement still comes from hard_masks)."""
+        term = None
+        for task in tasks:
+            for t2 in getattr(task, "anti_affinity_terms", []) or []:
+                if any(t2.matches(x.labels) for x in tasks):
+                    term = t2
+                    break
+            if term is not None:
+                break
+        if term is None:
+            return None
+        dom, n_dom = self._domains(term.topology_key)
+        if n_dom == 0:
+            return None
+        doms = np.tile(dom, (len(tasks), 1))
+        marks = np.array([term.matches(t.labels) for t in tasks])
+        avoids = np.array([
+            any(t3.topology_key == term.topology_key
+                and t3.selector == term.selector
+                and t3.expressions == term.expressions
+                for t3 in getattr(t, "anti_affinity_terms", []) or [])
+            for t in tasks])
+        return doms, marks, avoids
+
+    # -- scores (preferred terms + legacy peers) ---------------------------
     def _job_nodes(self, job_uid: str) -> set:
         pg = self.ssn.cluster.podgroups.get(job_uid)
         if pg is None:
@@ -34,10 +194,14 @@ class PodAffinityPlugin(Plugin):
     def extra_scores(self, tasks):
         n = self.ssn.node_idle.shape[0]
         out = None
+        pods = None
         for i, task in enumerate(tasks):
             peers = getattr(task, "pod_affinity_peers", None) or []
             anti = getattr(task, "pod_anti_affinity_peers", None) or []
-            if not peers and not anti:
+            pref = getattr(task, "preferred_affinity_terms", None) or []
+            pref_anti = getattr(task, "preferred_anti_affinity_terms",
+                                None) or []
+            if not (peers or anti or pref or pref_anti):
                 continue
             if out is None:
                 out = np.zeros((len(tasks), n))
@@ -49,4 +213,13 @@ class PodAffinityPlugin(Plugin):
                 for idx in self._job_nodes(uid):
                     if idx >= 0:
                         out[i, idx] -= AFFINITY_SCORE
+            if pref or pref_anti:
+                if pods is None:
+                    pods = self._active_pods()
+                for term in pref:
+                    out[i] += (term.weight * AFFINITY_SCORE
+                               * self._term_mask(term, pods))
+                for term in pref_anti:
+                    out[i] -= (term.weight * AFFINITY_SCORE
+                               * self._term_mask(term, pods))
         return out

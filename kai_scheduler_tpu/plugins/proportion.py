@@ -145,7 +145,10 @@ class ProportionPlugin(Plugin):
             if pg.queue_id not in self.queues:
                 continue
             for t in pg.pods.values():
-                req = t.req_vec(min_gpu_mem)
+                # Placed tasks resolve gpu-memory against their node's
+                # per-GPU memory; pending ones against the cluster minimum.
+                req = t.req_vec(cluster.task_gpu_memory_context(t)
+                                if t.node_name else min_gpu_mem)
                 if t.is_active_allocated():
                     self._walk(pg.queue_id, "allocated", req)
                     self._walk(pg.queue_id, "request", req)
@@ -203,9 +206,10 @@ class ProportionPlugin(Plugin):
         pg = self.ssn.cluster.podgroups.get(task.job_id)
         if pg is None or pg.queue_id not in self.queues:
             return
-        # Same gpu-memory divisor as the roll-up, or within-cycle
+        # Same gpu-memory normalization as the roll-up, or within-cycle
         # allocated totals drift from the snapshot's accounting.
-        req = task.req_vec(self.min_gpu_mem)
+        req = task.req_vec(self.ssn.cluster.task_gpu_memory_context(task)
+                           if task.node_name else self.min_gpu_mem)
         self._walk(pg.queue_id, "allocated", req)
         if not pg.is_preemptible():
             self._walk(pg.queue_id, "allocated_non_preemptible", req)
@@ -214,7 +218,8 @@ class ProportionPlugin(Plugin):
         pg = self.ssn.cluster.podgroups.get(task.job_id)
         if pg is None or pg.queue_id not in self.queues:
             return
-        req = -task.req_vec(self.min_gpu_mem)
+        req = -task.req_vec(self.ssn.cluster.task_gpu_memory_context(task)
+                            if task.node_name else self.min_gpu_mem)
         self._walk(pg.queue_id, "allocated", req)
         if not pg.is_preemptible():
             self._walk(pg.queue_id, "allocated_non_preemptible", req)

@@ -15,6 +15,13 @@ from ..api.resources import ResourceRequirements
 from ..framework import SchedulerConfig, Session
 
 
+def _terms(raw) -> list:
+    from ..api import AffinityTerm
+    return [AffinityTerm(dict(r["selector"]), r["topology_key"],
+                         float(r.get("weight", 1.0)))
+            for r in (raw or ())]
+
+
 def build_cluster(spec: dict) -> ClusterInfo:
     """spec = {nodes: {name: {cpu, mem, gpu, labels, taints, gpu_memory}},
     queues: {name: {deserved, limit, oqw, parent, priority}},
@@ -86,6 +93,15 @@ def build_cluster(spec: dict) -> ClusterInfo:
             task.resource_claims = list(t.get("resource_claims", ()))
             task.pod_affinity_peers = list(t.get("affinity", ()))
             task.pod_anti_affinity_peers = list(t.get("anti_affinity", ()))
+            # Full (anti-)affinity terms: {selector, topology_key[, weight]}
+            # dicts, mirroring matchLabels + topologyKey.
+            task.labels = dict(t.get("labels", {}))
+            task.affinity_terms = _terms(t.get("affinity_terms"))
+            task.anti_affinity_terms = _terms(t.get("anti_affinity_terms"))
+            task.preferred_affinity_terms = _terms(
+                t.get("preferred_affinity_terms"))
+            task.preferred_anti_affinity_terms = _terms(
+                t.get("preferred_anti_affinity_terms"))
             pg.add_task(task)
         podgroups[name] = pg
 

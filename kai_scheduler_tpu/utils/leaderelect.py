@@ -109,7 +109,9 @@ class LeaseElector:
     # -- blocking/looping API ---------------------------------------------
     def acquire(self, timeout: float | None = None) -> bool:
         """Block until leadership is won (or timeout); then start the
-        background renewal loop."""
+        background renewal loop.  Re-entrant after release(): a candidate
+        that stood down may re-enter the election."""
+        self._stop.clear()
         deadline = None if timeout is None else time.monotonic() + timeout
         while not self._stop.is_set():
             if self.try_acquire():

@@ -238,3 +238,42 @@ class TestLeaseElection:
             if child.poll() is None:
                 child.kill()
             child.wait()
+
+
+class TestWatchTooOld:
+    def test_sync_replay_after_ring_eviction(self, server):
+        """A client resuming from before the ring horizon gets a TOO_OLD
+        marker followed by SYNC events replaying current state."""
+        from kai_scheduler_tpu.controllers import apiserver as apimod
+        server.log._events = server.log._events.__class__(maxlen=4)
+        c = HTTPKubeAPI(server.url)
+        seen = []
+        c.watch("Queue", lambda et, obj: seen.append(
+            (et, obj["metadata"]["name"])))
+        for i in range(8):
+            c.create({"kind": "Queue", "metadata": {"name": f"q{i}"},
+                      "spec": {}})
+        # Simulate a long-disconnected client: seq far behind the horizon.
+        c._stop.set()
+        time.sleep(0.05)
+        c._watch_seq = 0
+        c._stop.clear()
+        c._ensure_watch_thread()
+        deadline = time.monotonic() + 5.0
+        names = set()
+        while time.monotonic() < deadline and len(names) < 8:
+            c.drain()
+            names = {n for _et, n in seen}
+            time.sleep(0.02)
+        assert names == {f"q{i}" for i in range(8)}
+        c.close()
+
+
+class TestElectorReacquire:
+    def test_acquire_after_release(self, client):
+        e = LeaseElector(client, "sched", "x", lease_duration=5,
+                         retry_period=0.05)
+        assert e.acquire(timeout=2)
+        e.release()
+        assert e.acquire(timeout=2), "elector must be re-entrant"
+        e.release()

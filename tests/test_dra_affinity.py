@@ -98,3 +98,269 @@ class TestPodAffinity:
         })
         run_action(ssn)
         assert placements(ssn)["loner-0"][0] == "n2"
+
+
+class TestAffinityTerms:
+    """Full label-selector + topologyKey semantics (upstream
+    InterPodAffinity via k8s_internal/predicates/predicates.go:70-167),
+    mirroring the reference's actions/integration_tests affinity cases."""
+
+    ZONES = {"n1": {"gpu": 8, "labels": {"zone": "a"}},
+             "n2": {"gpu": 8, "labels": {"zone": "a"}},
+             "n3": {"gpu": 8, "labels": {"zone": "b"}},
+             "n4": {"gpu": 8, "labels": {"zone": "b"}}}
+
+    def test_required_affinity_follows_matching_pod_domain(self):
+        ssn = build_session({
+            "nodes": dict(self.ZONES),
+            "queues": {"q": {}},
+            "jobs": {
+                "anchor": {"queue": "q",
+                           "tasks": [{"gpu": 1, "status": "RUNNING",
+                                      "node": "n3",
+                                      "labels": {"app": "db"}}]},
+                "web": {"queue": "q", "tasks": [{
+                    "gpu": 1,
+                    "affinity_terms": [{"selector": {"app": "db"},
+                                        "topology_key": "zone"}]}]},
+            },
+        })
+        run_action(ssn)
+        # Must land in zone b (n3/n4) where the db pod lives.
+        assert placements(ssn)["web-0"][0] in ("n3", "n4")
+
+    def test_required_affinity_hostname_colocates(self):
+        ssn = build_session({
+            "nodes": dict(self.ZONES),
+            "queues": {"q": {}},
+            "jobs": {
+                "anchor": {"queue": "q",
+                           "tasks": [{"gpu": 1, "status": "RUNNING",
+                                      "node": "n4",
+                                      "labels": {"app": "db"}}]},
+                "web": {"queue": "q", "tasks": [{
+                    "gpu": 1,
+                    "affinity_terms": [{
+                        "selector": {"app": "db"},
+                        "topology_key": "kubernetes.io/hostname"}]}]},
+            },
+        })
+        run_action(ssn)
+        assert placements(ssn)["web-0"][0] == "n4"
+
+    def test_required_affinity_unsatisfiable_blocks_gang(self):
+        ssn = build_session({
+            "nodes": dict(self.ZONES),
+            "queues": {"q": {}},
+            "jobs": {"web": {"queue": "q", "tasks": [{
+                "gpu": 1,
+                "affinity_terms": [{"selector": {"app": "absent"},
+                                    "topology_key": "zone"}]}]}},
+        })
+        run_action(ssn)
+        assert placements(ssn) == {}
+
+    def test_bootstrap_self_affine_group_schedules(self):
+        """No pod matches anywhere, but the task's own labels match its
+        term: upstream allows it anywhere (first pod of the group)."""
+        ssn = build_session({
+            "nodes": dict(self.ZONES),
+            "queues": {"q": {}},
+            "jobs": {"grp": {"queue": "q", "tasks": [{
+                "gpu": 1, "labels": {"app": "grp"},
+                "affinity_terms": [{"selector": {"app": "grp"},
+                                    "topology_key": "zone"}]}]}},
+        })
+        run_action(ssn)
+        assert "grp-0" in placements(ssn)
+
+    def test_required_anti_affinity_excludes_domain(self):
+        ssn = build_session({
+            "nodes": dict(self.ZONES),
+            "queues": {"q": {}},
+            "jobs": {
+                "noisy": {"queue": "q",
+                          "tasks": [{"gpu": 1, "status": "RUNNING",
+                                     "node": "n1",
+                                     "labels": {"app": "noisy"}}]},
+                "quiet": {"queue": "q", "tasks": [{
+                    "gpu": 1,
+                    "anti_affinity_terms": [{"selector": {"app": "noisy"},
+                                             "topology_key": "zone"}]}]},
+            },
+        })
+        run_action(ssn)
+        # Whole zone a (n1, n2) is excluded.
+        assert placements(ssn)["quiet-0"][0] in ("n3", "n4")
+
+    def test_anti_affinity_symmetry_repels_incoming_match(self):
+        """An EXISTING pod's anti-affinity term repels a matching incoming
+        task (upstream symmetry), even though the task has no terms."""
+        ssn = build_session({
+            "nodes": dict(self.ZONES),
+            "queues": {"q": {}},
+            "jobs": {
+                "guard": {"queue": "q",
+                          "tasks": [{"gpu": 1, "status": "RUNNING",
+                                     "node": "n2",
+                                     "anti_affinity_terms": [{
+                                         "selector": {"tier": "batch"},
+                                         "topology_key": "zone"}]}]},
+                "batch": {"queue": "q", "tasks": [{
+                    "gpu": 1, "labels": {"tier": "batch"}}]},
+            },
+        })
+        run_action(ssn)
+        assert placements(ssn)["batch-0"][0] in ("n3", "n4")
+
+    def test_self_gang_anti_affinity_spreads_one_per_zone(self):
+        """A gang whose members repel each other by zone: each of the two
+        zones receives exactly one pod (in-kernel gang_blocked carry)."""
+        task = {"gpu": 1, "labels": {"app": "spread"},
+                "anti_affinity_terms": [{"selector": {"app": "spread"},
+                                         "topology_key": "zone"}]}
+        ssn = build_session({
+            "nodes": dict(self.ZONES),
+            "queues": {"q": {}},
+            "jobs": {"spread": {"queue": "q", "min_available": 2,
+                                "tasks": [dict(task), dict(task)]}},
+        })
+        run_action(ssn)
+        p = placements(ssn)
+        zones = {"n1": "a", "n2": "a", "n3": "b", "n4": "b"}
+        assert len(p) == 2
+        assert {zones[p["spread-0"][0]], zones[p["spread-1"][0]]} == \
+            {"a", "b"}
+
+    def test_self_gang_anti_affinity_gang_fails_when_domains_exhausted(self):
+        """Three members, two zones, all mutually repelling: the gang
+        cannot fit and must roll back entirely."""
+        task = {"gpu": 1, "labels": {"app": "spread"},
+                "anti_affinity_terms": [{"selector": {"app": "spread"},
+                                         "topology_key": "zone"}]}
+        ssn = build_session({
+            "nodes": dict(self.ZONES),
+            "queues": {"q": {}},
+            "jobs": {"spread": {"queue": "q", "min_available": 3,
+                                "tasks": [dict(task), dict(task),
+                                          dict(task)]}},
+        })
+        run_action(ssn)
+        assert placements(ssn) == {}
+
+    def test_preferred_affinity_steers_without_blocking(self):
+        ssn = build_session({
+            "nodes": dict(self.ZONES),
+            "queues": {"q": {}},
+            "jobs": {
+                "anchor": {"queue": "q",
+                           "tasks": [{"gpu": 1, "status": "RUNNING",
+                                      "node": "n3",
+                                      "labels": {"app": "cache"}}]},
+                "web": {"queue": "q", "tasks": [{
+                    "gpu": 1,
+                    "preferred_affinity_terms": [{
+                        "selector": {"app": "cache"},
+                        "topology_key": "zone", "weight": 10}]}]},
+            },
+        })
+        run_action(ssn)
+        assert placements(ssn)["web-0"][0] in ("n3", "n4")
+
+
+class TestAffinityManifestParsing:
+    def test_pod_manifest_affinity_flows_to_placement(self):
+        """spec.affinity on a pod manifest is parsed by the cache and
+        enforced by the scheduler (pod lands in the anchor's zone)."""
+        from kai_scheduler_tpu.controllers import (InMemoryKubeAPI, System,
+                                                   SystemConfig, make_pod)
+        system = System(SystemConfig())
+        api = system.api
+        for name, zone in [("n1", "a"), ("n2", "b")]:
+            api.create({"kind": "Node",
+                        "metadata": {"name": name,
+                                     "labels": {"zone": zone}},
+                        "spec": {},
+                        "status": {"allocatable": {
+                            "cpu": "32", "memory": "256Gi",
+                            "nvidia.com/gpu": 8, "pods": 110}}})
+        api.create({"kind": "Queue", "metadata": {"name": "q"},
+                    "spec": {"deserved": {"cpu": "64", "memory": "512Gi",
+                                          "gpu": 16}}})
+        anchor = make_pod("anchor", queue="q", gpu=1, phase="Running",
+                          node_name="n2", labels={"app": "db"})
+        api.create(anchor)
+        pod = make_pod("web", queue="q", gpu=1)
+        pod["spec"]["affinity"] = {"podAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": [{
+                "labelSelector": {"matchLabels": {"app": "db"}},
+                "topologyKey": "zone"}]}}
+        api.create(pod)
+        system.run_cycle()
+        assert api.get("Pod", "web")["spec"].get("nodeName") == "n2"
+
+
+class TestAffinityReviewRegressions:
+    ZONES = {"n1": {"gpu": 8, "labels": {"zone": "a"}},
+             "n2": {"gpu": 8, "labels": {"zone": "b"}}}
+
+    def test_heterogeneous_gang_nonmatching_member_unconstrained(self):
+        """A gang member that neither carries nor matches the anti term
+        may co-locate freely (K8s permits it)."""
+        ssn = build_session({
+            "nodes": {"n1": {"gpu": 8, "labels": {"zone": "a"}}},
+            "queues": {"q": {}},
+            "jobs": {"mix": {"queue": "q", "min_available": 2, "tasks": [
+                {"gpu": 1, "labels": {"app": "spread"},
+                 "anti_affinity_terms": [{"selector": {"app": "spread"},
+                                          "topology_key": "zone"}]},
+                {"gpu": 1, "labels": {"app": "other"}}]}},
+        })
+        run_action(ssn)
+        p = placements(ssn)
+        # Single zone: the unconstrained member still fits next to the
+        # termed one; with the old whole-gang block this gang failed.
+        assert len(p) == 2
+
+    def test_matching_member_without_term_respects_symmetry(self):
+        """A member whose labels match a sibling's anti term cannot share
+        the sibling's domain even though it has no terms itself."""
+        task_termed = {"gpu": 1, "labels": {"app": "s"},
+                       "anti_affinity_terms": [{"selector": {"app": "s"},
+                                                "topology_key": "zone"}]}
+        task_plain = {"gpu": 1, "labels": {"app": "s"}}
+        ssn = build_session({
+            "nodes": dict(self.ZONES),
+            "queues": {"q": {}},
+            "jobs": {"mix": {"queue": "q", "min_available": 2,
+                             "tasks": [dict(task_plain),
+                                       dict(task_termed)]}},
+        })
+        run_action(ssn)
+        p = placements(ssn)
+        zones = {"n1": "a", "n2": "b"}
+        assert len(p) == 2
+        assert zones[p["mix-0"][0]] != zones[p["mix-1"][0]]
+
+    def test_match_expressions_selector(self):
+        """matchExpressions (In operator) selectors are honored, not
+        silently widened to match-all."""
+        ssn = build_session({
+            "nodes": dict(self.ZONES),
+            "queues": {"q": {}},
+            "jobs": {
+                "running": {"queue": "q",
+                            "tasks": [{"gpu": 1, "status": "RUNNING",
+                                       "node": "n1",
+                                       "labels": {"tier": "web"}}]},
+                "incoming": {"queue": "q", "tasks": [{"gpu": 1}]},
+            },
+        })
+        # Manually attach a matchExpressions anti term to the incoming pod.
+        from kai_scheduler_tpu.api import AffinityTerm
+        task = ssn.cluster.podgroups["incoming"].pods["incoming-0"]
+        task.anti_affinity_terms = [AffinityTerm(
+            {}, "zone", expressions=[
+                {"key": "tier", "operator": "In", "values": ["web"]}])]
+        run_action(ssn)
+        assert placements(ssn)["incoming-0"][0] == "n2"
