@@ -80,6 +80,16 @@ def _execute_bulk(ssn, jobs):
     if ssn.gpu_strategy != BINPACK or ssn.cpu_strategy != BINPACK:
         return jobs
 
+    # Anti-affinity symmetry: existing pods' anti terms can repel incoming
+    # pods the bulk kernel knows nothing about.  Collect the active terms
+    # once and gate only jobs a term could actually match — a single guard
+    # pod must not knock every labeled job off the fleet path.
+    repeller_terms = [
+        term
+        for pg in ssn.cluster.podgroups.values()
+        for t in pg.pods.values() if t.is_active_allocated()
+        for term in t.anti_affinity_terms]
+
     leftovers = []
     eligible = []
     for pg in jobs:
@@ -88,7 +98,8 @@ def _execute_bulk(ssn, jobs):
             task_order_fn=ssn.task_order_key)
         host_side = (
             not tasks
-            or any(t.is_fractional or t.resource_claims for t in tasks)
+            or any(t.is_fractional or t.resource_claims
+                   or t.res_req.mig_resources for t in tasks)
             or any(ps.has_own_topology_constraint()
                    for ps in pg.pod_sets.values())
             or pg.required_topology_level or pg.preferred_topology_level
@@ -97,7 +108,16 @@ def _execute_bulk(ssn, jobs):
             or any(t.status == PodStatus.PIPELINED
                    for t in pg.pods.values())
             or any(t.nominated_node or t.pod_affinity_peers
-                   or t.pod_anti_affinity_peers for t in tasks))
+                   or t.pod_anti_affinity_peers for t in tasks)
+            # Hard node masks (affinity terms, host ports, bound PVCs)
+            # are enforced per-proposal; the bulk kernel doesn't model
+            # them, so such jobs take the per-job path.
+            or any(t.affinity_terms or t.anti_affinity_terms
+                   or t.preferred_affinity_terms
+                   or t.preferred_anti_affinity_terms
+                   or t.host_ports or t.pvc_names
+                   or any(term.matches(t.labels, t.namespace)
+                          for term in repeller_terms) for t in tasks))
         (leftovers if host_side else eligible).append(pg)
 
     for _ in range(ssn.config.bulk_allocation_max_rounds):
@@ -142,6 +162,7 @@ def _execute_bulk(ssn, jobs):
                 subgroup_order_fn=ssn.pod_set_order_key,
                 task_order_fn=ssn.task_order_key)
             gate = ssn.is_job_over_queue_capacity(pg, tasks).schedulable \
+                and ssn.check_pre_predicates(tasks).schedulable \
                 if tasks else False
             chunks.append(tasks)
             job_allowed.append(gate)
@@ -258,6 +279,13 @@ def attempt_to_allocate_job(ssn, job: PodGroupInfo,
             job.add_fit_error(result.message)
         return False
 
+    result = ssn.check_pre_predicates(tasks)
+    if not result.schedulable:
+        if not pipeline_only:
+            job.add_fit_error(result.message)
+            ssn.cache.record_event("Unschedulable", result.message)
+        return False
+
     own_stmt = stmt is None
     if own_stmt:
         stmt = ssn.statement()
@@ -323,7 +351,8 @@ def _allocate_tasks_on_subset(ssn, stmt, job, tasks, node_subset,
                               pipeline_only: bool) -> bool:
     # Fractional tasks and DRA-claim tasks need host-side state the kernel
     # doesn't model (sharing groups, claim bindings): task-by-task path.
-    host_path = any(t.is_fractional or t.resource_claims for t in tasks)
+    host_path = any(t.is_fractional or t.resource_claims
+                    or t.res_req.mig_resources for t in tasks)
     if host_path:
         ok = _allocate_task_by_task(ssn, stmt, job, tasks, node_subset,
                                     pipeline_only)
@@ -358,6 +387,9 @@ def _allocate_task_by_task(ssn, stmt, job, tasks, node_subset,
         elif task.resource_claims:
             placed = _allocate_with_claims(ssn, stmt, task, node_subset,
                                            pipeline_only)
+        elif task.res_req.mig_resources:
+            placed = _allocate_mig(ssn, stmt, task, node_subset,
+                                   pipeline_only)
         else:
             proposal = ssn.propose_placements(
                 [task], pipeline_only=pipeline_only, node_subset=node_subset)
@@ -381,8 +413,11 @@ def _allocate_fractional(ssn, stmt, task, node_subset,
     # Restrict to real (non-padding) node rows.
     scores = ssn.score_nodes_for_task(task)[:len(ssn.snapshot.node_names)]
     order = np.argsort(-scores, kind="stable")
+    hard_mask = ssn.compute_hard_mask([task])
     for node_idx in order:
         if node_subset is not None and not node_subset[node_idx]:
+            continue
+        if hard_mask is not None and not hard_mask[0][node_idx]:
             continue
         node = ssn.cluster.nodes[ssn.snapshot.node_names[int(node_idx)]]
         if not pipeline_only and node.is_task_allocatable(task):
@@ -399,6 +434,30 @@ def _allocate_fractional(ssn, stmt, task, node_subset,
     return False
 
 
+def _allocate_mig(ssn, stmt, task, node_subset,
+                  pipeline_only: bool) -> bool:
+    """MIG path: best-scoring node whose per-profile inventory fits
+    (node_info.has_mig_room over the nvidia.com/mig-* scalar resources;
+    reference: resource_info.go:153-165 scalar accounting — MIG devices
+    are pre-partitioned inventory, never draws on the whole-GPU pool)."""
+    scores = ssn.score_nodes_for_task(task)[:len(ssn.snapshot.node_names)]
+    order = np.argsort(-scores, kind="stable")
+    hard_mask = ssn.compute_hard_mask([task])
+    for node_idx in order:
+        if node_subset is not None and not node_subset[node_idx]:
+            continue
+        if hard_mask is not None and not hard_mask[0][node_idx]:
+            continue
+        node = ssn.cluster.nodes[ssn.snapshot.node_names[int(node_idx)]]
+        if not pipeline_only and node.is_task_allocatable(task):
+            stmt.allocate(task, node.name)
+            return True
+        if node.is_task_allocatable_on_releasing_or_idle(task):
+            stmt.pipeline(task, node.name)
+            return True
+    return False
+
+
 def _allocate_with_claims(ssn, stmt, task, node_subset,
                           pipeline_only: bool) -> bool:
     """DRA path: best-scoring node where every referenced claim is
@@ -407,8 +466,11 @@ def _allocate_with_claims(ssn, stmt, task, node_subset,
                 if p.name == "dynamicresources"), None)
     scores = ssn.score_nodes_for_task(task)[:len(ssn.snapshot.node_names)]
     order = np.argsort(-scores, kind="stable")
+    hard_mask = ssn.compute_hard_mask([task])
     for node_idx in order:
         if node_subset is not None and not node_subset[node_idx]:
+            continue
+        if hard_mask is not None and not hard_mask[0][node_idx]:
             continue
         node = ssn.cluster.nodes[ssn.snapshot.node_names[int(node_idx)]]
         if dra is not None and not dra.claims_schedulable(task, node.name):

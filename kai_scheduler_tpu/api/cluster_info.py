@@ -39,13 +39,20 @@ class ClusterInfo:
                  queues: dict[str, QueueInfo] | None = None,
                  topologies: dict | None = None,
                  now: float = 0.0,
-                 resource_claims: dict | None = None):
+                 resource_claims: dict | None = None,
+                 config_maps: set | None = None,
+                 pvcs: dict | None = None):
         self.nodes: dict[str, NodeInfo] = nodes or {}
         self.podgroups: dict[str, PodGroupInfo] = podgroups or {}
         self.queues: dict[str, QueueInfo] = queues or {}
         self.topologies: dict = topologies or {}
         # DRA claims: name -> {"device_class", "allocated", "node"}.
         self.resource_claims: dict = resource_claims or {}
+        # ConfigMap predicate inventory: {(namespace, name)}.
+        self.config_maps: set = set(config_maps or ())
+        # PVC inventory for the schedule-time VolumeBinding filter:
+        # (namespace, name) -> {"bound_node": str | None}.
+        self.pvcs: dict = dict(pvcs or {})
         self.bind_requests: list[BindRequest] = []
         self.now = now
         # Stable orderings for tensor packing.
@@ -128,10 +135,13 @@ class ClusterInfo:
         bare_nodes = {
             name: NodeInfo(node.name, node.allocatable.copy(),
                            dict(node.labels), set(node.taints),
-                           node.gpu_memory_per_device, node.max_pods, node.idx)
+                           node.gpu_memory_per_device, node.max_pods,
+                           node.idx, dict(node.mig_capacity))
             for name, node in self.nodes.items()}
         return ClusterInfo(
             bare_nodes,
             {uid: pg.clone() for uid, pg in self.podgroups.items()},
             dict(self.queues), dict(self.topologies), self.now,
-            {k: dict(v) for k, v in self.resource_claims.items()})
+            {k: dict(v) for k, v in self.resource_claims.items()},
+            set(self.config_maps),
+            {k: dict(v) for k, v in self.pvcs.items()})

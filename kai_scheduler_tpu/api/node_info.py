@@ -53,7 +53,8 @@ class NodeInfo:
     def __init__(self, name: str, allocatable: np.ndarray,
                  labels: dict | None = None, taints: set | None = None,
                  gpu_memory_per_device: float = 0.0,
-                 max_pods: int = 110, idx: int = -1):
+                 max_pods: int = 110, idx: int = -1,
+                 mig_capacity: dict | None = None):
         self.name = name
         self.idx = idx
         self.allocatable = allocatable.astype(np.float64)
@@ -65,6 +66,11 @@ class NodeInfo:
         self.max_pods = max_pods
         self.pod_infos: dict[str, PodInfo] = {}
         self.gpu_sharing_groups: dict[str, GpuSharingGroup] = {}
+        # MIG inventory: per-profile scalar resources the node advertises
+        # (pre-partitioned by the GPU operator; nvidia.com/mig-Ng.Mgb).
+        self.mig_capacity: dict[str, float] = dict(mig_capacity or {})
+        self.mig_used: dict[str, float] = {}
+        self.mig_releasing: dict[str, float] = {}
 
     # -- derived quantities ------------------------------------------------
     @property
@@ -74,9 +80,11 @@ class NodeInfo:
     def clone(self) -> "NodeInfo":
         n = NodeInfo(self.name, self.allocatable.copy(), dict(self.labels),
                      set(self.taints), self.gpu_memory_per_device,
-                     self.max_pods, self.idx)
+                     self.max_pods, self.idx, dict(self.mig_capacity))
         n.used = self.used.copy()
         n.releasing = self.releasing.copy()
+        n.mig_used = dict(self.mig_used)
+        n.mig_releasing = dict(self.mig_releasing)
         n.pod_infos = {uid: p for uid, p in self.pod_infos.items()}
         n.gpu_sharing_groups = {
             gid: GpuSharingGroup(g.group_id, dict(g.pods))
@@ -90,7 +98,8 @@ class NodeInfo:
         Fractional tasks charge cpu/mem here; their GPU devices are charged
         whole-device per sharing group by _add_to_gpu_group.
         """
-        req = task.req_vec(self.gpu_memory_per_device)
+        req = task.res_req.to_vec(self.gpu_memory_per_device,
+                                  mig_as_gpu=False)
         if task.is_fractional and task.gpu_group:
             req = req.copy()
             req[rs.RES_GPU] = 0.0
@@ -101,11 +110,14 @@ class NodeInfo:
         if task.status == PodStatus.RELEASING:
             self.releasing += req
             self.used += req
+            self._mig_account(task, used=+1, releasing=+1)
         elif task.status == PodStatus.PIPELINED:
             # Pipelined tasks claim resources that are still being released.
             self.releasing -= req
+            self._mig_account(task, releasing=-1)
         elif task.is_active_allocated():
             self.used += req
+            self._mig_account(task, used=+1)
         self.pod_infos[task.uid] = task
         if task.is_fractional and task.gpu_group:
             self._add_to_gpu_group(task)
@@ -115,13 +127,39 @@ class NodeInfo:
         if task.status == PodStatus.RELEASING:
             self.releasing -= req
             self.used -= req
+            self._mig_account(task, used=-1, releasing=-1)
         elif task.status == PodStatus.PIPELINED:
             self.releasing += req
+            self._mig_account(task, releasing=+1)
         elif task.is_active_allocated():
             self.used -= req
+            self._mig_account(task, used=-1)
         self.pod_infos.pop(task.uid, None)
         if task.is_fractional and task.gpu_group:
             self._remove_from_gpu_group(task)
+
+    def _mig_account(self, task: PodInfo, used: int = 0,
+                     releasing: int = 0) -> None:
+        """Per-profile MIG scalar accounting (resource_info.go:153-165
+        scalarResources add/sub), mirroring the vector used/releasing."""
+        for profile, count in task.res_req.mig_resources.items():
+            if used:
+                self.mig_used[profile] = \
+                    self.mig_used.get(profile, 0.0) + used * count
+            if releasing:
+                self.mig_releasing[profile] = \
+                    self.mig_releasing.get(profile, 0.0) + releasing * count
+
+    def has_mig_room(self, task: PodInfo, allow_releasing: bool) -> bool:
+        """Every requested profile fits the node's remaining inventory."""
+        for profile, count in task.res_req.mig_resources.items():
+            free = self.mig_capacity.get(profile, 0.0) \
+                - self.mig_used.get(profile, 0.0)
+            if allow_releasing:
+                free += self.mig_releasing.get(profile, 0.0)
+            if count > free + 1e-9:
+                return False
+        return True
 
     # -- allocatability ----------------------------------------------------
     def is_task_allocatable(self, task: PodInfo) -> bool:
@@ -133,6 +171,8 @@ class NodeInfo:
             return False
         if task.is_fractional:
             return self._fits_fraction(task, allow_releasing=False)
+        if not self.has_mig_room(task, allow_releasing=False):
+            return False
         return rs.less_equal(self._req(task), self.idle)
 
     def is_task_allocatable_on_releasing_or_idle(self, task: PodInfo) -> bool:
@@ -144,6 +184,8 @@ class NodeInfo:
             return False
         if task.is_fractional:
             return self._fits_fraction(task, allow_releasing=True)
+        if not self.has_mig_room(task, allow_releasing=True):
+            return False
         return rs.less_equal(self._req(task), self.idle + self.releasing)
 
     # -- fractional GPU groups (host-side, sparse) -------------------------
@@ -249,6 +291,12 @@ class NodeInfo:
         for i, rn in enumerate(rs.RESOURCE_NAMES):
             if req[i] > idle[i] + 1e-9:
                 parts.append(f"insufficient {rn}: requested {req[i]:g}, idle {idle[i]:g}")
+        for profile, count in task.res_req.mig_resources.items():
+            free = self.mig_capacity.get(profile, 0.0) \
+                - self.mig_used.get(profile, 0.0)
+            if count > free + 1e-9:
+                parts.append(f"insufficient {profile}: requested {count:g}, "
+                             f"free {free:g}")
         if len(self.pod_infos) >= self.max_pods:
             parts.append(f"node is at max pods ({self.max_pods})")
         return "; ".join(parts) or "node did not satisfy predicates"

@@ -30,13 +30,22 @@ class AffinityTerm:
     ``expressions`` carries labelSelector.matchExpressions entries
     (``{"key", "operator", "values"}`` with In/NotIn/Exists/DoesNotExist),
     AND-ed with the matchLabels equality selector exactly as upstream
-    metav1.LabelSelector does."""
+    metav1.LabelSelector does.
+
+    ``namespaces`` scopes which pods the term can match — resolved at
+    parse time to the manifest's explicit list or the owning pod's own
+    namespace (upstream defaults a term without namespaces/
+    namespaceSelector to the incoming pod's namespace)."""
     selector: dict          # pod-label key -> required value (matchLabels)
     topology_key: str       # node-label key defining the domain
     weight: float = 1.0     # preferred terms only
     expressions: list = field(default_factory=list)
+    namespaces: list = field(default_factory=list)  # empty = any (legacy)
 
-    def matches(self, labels: dict) -> bool:
+    def matches(self, labels: dict, namespace: str | None = None) -> bool:
+        if (self.namespaces and namespace is not None
+                and namespace not in self.namespaces):
+            return False
         if not all(labels.get(k) == v for k, v in self.selector.items()):
             return False
         for expr in self.expressions:
@@ -62,7 +71,8 @@ class AffinityTerm:
     def clone(self) -> "AffinityTerm":
         return AffinityTerm(dict(self.selector), self.topology_key,
                             self.weight,
-                            [dict(e) for e in self.expressions])
+                            [dict(e) for e in self.expressions],
+                            list(self.namespaces))
 
 
 @dataclass
@@ -91,6 +101,10 @@ class PodInfo:
     pod_affinity_peers: list = field(default_factory=list)
     pod_anti_affinity_peers: list = field(default_factory=list)
     labels: dict = field(default_factory=dict)
+    # Upstream-predicate inputs (k8s_internal/predicates/predicates.go):
+    host_ports: set = field(default_factory=set)   # (protocol, port)
+    required_configmaps: list = field(default_factory=list)
+    pvc_names: list = field(default_factory=list)
     affinity_terms: list = field(default_factory=list)        # required
     anti_affinity_terms: list = field(default_factory=list)   # required
     preferred_affinity_terms: list = field(default_factory=list)
@@ -126,6 +140,9 @@ class PodInfo:
             pod_affinity_peers=list(self.pod_affinity_peers),
             pod_anti_affinity_peers=list(self.pod_anti_affinity_peers),
             labels=dict(self.labels),
+            host_ports=set(self.host_ports),
+            required_configmaps=list(self.required_configmaps),
+            pvc_names=list(self.pvc_names),
             affinity_terms=[t.clone() for t in self.affinity_terms],
             anti_affinity_terms=[t.clone()
                                  for t in self.anti_affinity_terms],

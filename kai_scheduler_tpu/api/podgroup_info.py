@@ -284,7 +284,17 @@ class PodGroupInfo:
             h.update(f"{ps_name}:{ps.min_available}".encode())
             reqs = sorted(
                 (tuple(t.req_vec()), tuple(sorted(t.node_selector.items())),
-                 tuple(sorted(t.tolerations)))
+                 tuple(sorted(t.tolerations)),
+                 # Every other schedulability input must disambiguate, or
+                 # the identical-failed-job skip wrongly fences out jobs
+                 # differing only in these.
+                 tuple(sorted(t.res_req.mig_resources.items())),
+                 tuple(sorted(t.host_ports)),
+                 tuple(sorted(t.required_configmaps)),
+                 tuple(sorted(t.pvc_names)),
+                 tuple(sorted(t.resource_claims)),
+                 repr(t.affinity_terms), repr(t.anti_affinity_terms),
+                 tuple(sorted(t.labels.items())))
                 for t in ps.pods.values() if t.status == PodStatus.PENDING)
             h.update(repr(reqs).encode())
         self._signature = h.hexdigest()

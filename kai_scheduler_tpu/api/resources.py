@@ -166,12 +166,19 @@ class ResourceRequirements:
             return self.gpu_fraction * self.num_fraction_devices
         return float(self.base[RES_GPU])
 
-    def to_vec(self, node_gpu_memory: float = 0.0) -> np.ndarray:
+    def to_vec(self, node_gpu_memory: float = 0.0,
+               mig_as_gpu: bool = True) -> np.ndarray:
         """Dense vector for capacity accounting.
 
         ``gpu_memory_bytes`` requests are resolved against a node's per-GPU
         memory when known; otherwise they count as a whole device (the
         conservative choice the reference makes via minNodeGPUMemory).
+
+        ``mig_as_gpu``: MIG profile instances count their 'g' slices toward
+        the GPU axis for QUEUE quota math (allocation_info.go:80-84).  Node
+        fit must pass False: MIG devices are separate per-profile scalar
+        inventory on the node (resource_info.go:153-165 scalarResources),
+        not draws from its whole-GPU pool.
         """
         v = self.base.copy()
         if self.gpu_fraction > 0.0:
@@ -182,9 +189,10 @@ class ResourceRequirements:
             else:
                 frac = 1.0
             v[RES_GPU] = frac * self.num_fraction_devices
-        for profile, count in self.mig_resources.items():
-            slices, _mem = parse_mig_profile(profile)
-            v[RES_GPU] += slices * count
+        if mig_as_gpu:
+            for profile, count in self.mig_resources.items():
+                slices, _mem = parse_mig_profile(profile)
+                v[RES_GPU] += slices * count
         return v
 
     @classmethod

@@ -198,7 +198,10 @@ def pack(cluster: ClusterInfo,
     job_index = {pg.uid: j for j, pg in enumerate(jobs)}
     for i, t in enumerate(tasks):
         t.tensor_idx = i
-        task_req[i] = t.req_vec()
+        # Node-fit vector: MIG profiles are per-node scalar inventory
+        # checked host-side, not whole-GPU draws (MIG jobs route to the
+        # host path in actions/allocate).
+        task_req[i] = t.res_req.to_vec(mig_as_gpu=False)
         task_job[i] = job_index[t.job_id]
         for k, v in t.node_selector.items():
             task_sel[i, codec.key_cols[k]] = codec.value_code(k, v)

@@ -231,6 +231,9 @@ def _make_handler(server: "KubeAPIServer"):
                     for obj in snapshot:
                         send_line({"type": "SYNC", "object": obj,
                                    "seq": seq})
+                    # The client diffs the replay against the keys it has
+                    # seen to synthesize DELETED for vanished objects.
+                    send_line({"type": "SYNC_END", "seq": seq})
                 while True:
                     events = server.log.since(seq)
                     for eseq, etype, obj in events:

@@ -62,10 +62,14 @@ def _parse_pod_affinity(task: PodInfo, affinity: dict) -> None:
         sel = term.get("labelSelector") or {}
         if not term.get("topologyKey"):
             return None
+        # No explicit namespaces -> the pod's own namespace (upstream
+        # default scoping).
+        namespaces = list(term.get("namespaces") or [task.namespace])
         return AffinityTerm(dict(sel.get("matchLabels") or {}),
                             term["topologyKey"], weight,
                             [dict(e) for e in
-                             sel.get("matchExpressions") or []])
+                             sel.get("matchExpressions") or []],
+                            namespaces)
 
     def terms(block: dict, required_key: str, preferred_key: str):
         req = [t for t in (parse_term(term)
@@ -85,6 +89,35 @@ def _parse_pod_affinity(task: PodInfo, affinity: dict) -> None:
         terms(aff, required, preferred)
     task.anti_affinity_terms, task.preferred_anti_affinity_terms = \
         terms(anti, required, preferred)
+
+
+def _parse_pod_predicates(task: PodInfo, pod: dict) -> None:
+    """Upstream-predicate inputs from the manifest: hostPorts
+    (nodeports adapter), required ConfigMaps (config_maps.go
+    getAllRequiredConfigMapNames: env/envFrom/volumes, skipping
+    optional refs), and referenced PVCs (volume_binding.go)."""
+    spec = pod.get("spec", {})
+    for c in spec.get("containers") or []:
+        for port in c.get("ports") or []:
+            host_port = port.get("hostPort")
+            if host_port:
+                task.host_ports.add(
+                    (port.get("protocol", "TCP"), int(host_port)))
+        for env_from in c.get("envFrom") or []:
+            ref = env_from.get("configMapRef") or {}
+            if ref.get("name") and not ref.get("optional"):
+                task.required_configmaps.append(ref["name"])
+        for env in c.get("env") or []:
+            ref = (env.get("valueFrom") or {}).get("configMapKeyRef") or {}
+            if ref.get("name") and not ref.get("optional"):
+                task.required_configmaps.append(ref["name"])
+    for vol in spec.get("volumes") or []:
+        cm = vol.get("configMap") or {}
+        if cm.get("name") and not cm.get("optional"):
+            task.required_configmaps.append(cm["name"])
+        claim = (vol.get("persistentVolumeClaim") or {}).get("claimName")
+        if claim:
+            task.pvc_names.append(claim)
 
 
 def _quota_vec(spec: dict | None):
@@ -125,7 +158,9 @@ class ClusterCache:
                     "taints", [])},
                 gpu_memory_per_device=rs.parse_memory(gpu_mem)
                 if gpu_mem else 16 * 2 ** 30,
-                max_pods=int(spec.get("pods", 110)))
+                max_pods=int(spec.get("pods", 110)),
+                mig_capacity={k: float(v) for k, v in spec.items()
+                              if k.startswith("nvidia.com/mig-")})
 
         queues = {}
         for q in self.api.list("Queue"):
@@ -206,6 +241,7 @@ class ClusterCache:
                 labels=dict(pod["metadata"].get("labels", {})))
             _parse_pod_affinity(task, pod.get("spec", {}).get(
                 "affinity", {}))
+            _parse_pod_predicates(task, pod)
             gpu_group = pod["metadata"].get("annotations", {}).get(
                 GPU_GROUP_ANNOTATION)
             if gpu_group:
@@ -233,8 +269,20 @@ class ClusterCache:
                 "levels": [lvl["nodeLabel"] for lvl in
                            topo.get("spec", {}).get("levels", [])]}
 
+        config_maps = {
+            (cm["metadata"].get("namespace", "default"),
+             cm["metadata"]["name"])
+            for cm in self.api.list("ConfigMap")}
+        pvcs = {}
+        for pvc in self.api.list("PersistentVolumeClaim"):
+            md = pvc["metadata"]
+            pvcs[(md.get("namespace", "default"), md["name"])] = {
+                "bound_node": md.get("annotations", {}).get(
+                    "volume.kubernetes.io/selected-node")}
+
         return ClusterInfo(nodes, podgroups, queues, topologies,
-                           now=self.now_fn())
+                           now=self.now_fn(),
+                           config_maps=config_maps, pvcs=pvcs)
 
     # -- side-effect executor (framework Session cache interface) ------------
     def bind(self, task, node_name: str, bind_request) -> None:

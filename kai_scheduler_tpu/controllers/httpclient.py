@@ -37,6 +37,10 @@ class HTTPKubeAPI:
         self._watchers: dict[str, list[Callable]] = defaultdict(list)
         self._pending: list[tuple] = []
         self._pending_lock = threading.Lock()
+        # Keys observed via watch events; used to synthesize DELETED after
+        # a TOO_OLD re-list (an informer diffs its store the same way).
+        self._known: dict[tuple, dict] = {}
+        self._syncing: set | None = None
         self._watch_thread: threading.Thread | None = None
         self._watch_seq = 0
         self._stop = threading.Event()
@@ -145,17 +149,42 @@ class HTTPKubeAPI:
                             self._synced.set()
                             continue
                         if etype == "TOO_OLD":
-                            continue  # SYNC replay follows
-                        # SYNC = re-list replay after ring-buffer eviction;
-                        # handlers see it as a MODIFIED convergence event.
-                        etype = "MODIFIED" if etype == "SYNC" else etype
+                            self._syncing = set()
+                            continue
+                        if etype == "SYNC_END":
+                            self._finish_sync()
+                            continue
+                        obj = event["object"]
+                        key = obj_key(obj)
+                        if etype == "SYNC":
+                            # Re-list replay after ring-buffer eviction;
+                            # handlers see a MODIFIED convergence event.
+                            if self._syncing is not None:
+                                self._syncing.add(key)
+                            etype = "MODIFIED"
+                        if etype == "DELETED":
+                            self._known.pop(key, None)
+                        else:
+                            self._known[key] = obj
                         with self._pending_lock:
-                            self._pending.append((etype, event["object"]))
+                            self._pending.append((etype, obj))
             except (urllib.error.URLError, OSError,
                     json.JSONDecodeError):
                 if self._stop.is_set():
                     return
                 time.sleep(0.2)  # reconnect; seq resumes the stream
+
+    def _finish_sync(self) -> None:
+        """After a TOO_OLD re-list: objects we knew about that did NOT
+        appear in the SYNC replay were deleted while the DELETED events
+        fell off the ring — synthesize them (informer re-list diffing)."""
+        if self._syncing is None:
+            return
+        vanished = [key for key in self._known if key not in self._syncing]
+        with self._pending_lock:
+            for key in vanished:
+                self._pending.append(("DELETED", self._known.pop(key)))
+        self._syncing = None
 
     def drain(self, max_rounds: int = 100) -> int:
         """Deliver queued watch events to handlers on this thread."""

@@ -103,6 +103,10 @@ class Session:
         # upstream predicates) and self-anti-affinity domain rows.
         self.hard_node_mask_fns: list[Callable] = []
         self.anti_domain_fns: list[Callable] = []
+        self.affinity_domain_fns: list[Callable] = []
+        # Cluster-level PreFilters (ConfigMap/MaxNodePoolResources/PVC
+        # existence): fail a task before any node scan.
+        self.pre_predicate_fns: list[Callable] = []
         self.pre_job_allocation_fns: list[Callable] = []
         self.job_solution_start_fns: list[Callable] = []
         self.gpu_order_fns: list[Callable] = []
@@ -156,6 +160,7 @@ class Session:
                             enumerate(self.snapshot.node_names)}
         self.gpu_strategy = BINPACK
         self.cpu_strategy = BINPACK
+        self.mutation_count = 0
         self.statements: list[Statement] = []
         # Device-array cache: static snapshot arrays upload once; mutable
         # state arrays re-upload only after a statement touched them.
@@ -200,6 +205,10 @@ class Session:
         return self._np_room
 
     def sync_node(self, node) -> None:
+        # Monotonic mutation tick: plugins key their cluster-scan caches
+        # (active pods, occupied host ports) on it so repeated per-task
+        # mask computations don't rescan an unchanged cluster.
+        self.mutation_count += 1
         i = node.idx
         if i < 0:
             return
@@ -271,6 +280,28 @@ class Session:
             res = fn(job, tasks)
             if not res.schedulable:
                 return res
+        return SchedulableResult()
+
+    def compute_hard_mask(self, tasks) -> "np.ndarray | None":
+        """AND of every hard_node_mask_fns contribution: [T,N] bool or
+        None when unconstrained.  Host-side allocation paths (fractional,
+        MIG, DRA) consult this too — the kernel and host paths must agree
+        on feasibility."""
+        mask = None
+        for fn in self.hard_node_mask_fns:
+            contrib = fn(tasks)
+            if contrib is not None:
+                mask = contrib if mask is None else (mask & contrib)
+        return mask
+
+    def check_pre_predicates(self, tasks) -> SchedulableResult:
+        """Run cluster-level PreFilter predicates over a job's tasks
+        (PrePredicateFn per task, predicates.go PreFilter chain)."""
+        for fn in self.pre_predicate_fns:
+            for task in tasks:
+                res = fn(task)
+                if not res.schedulable:
+                    return res
         return SchedulableResult()
 
     def is_non_preemptible_over_quota(self, job, tasks) -> SchedulableResult:
@@ -366,11 +397,7 @@ class Session:
 
         # Hard per-task node masks (inter-pod affinity terms, upstream
         # predicate verdicts): False = infeasible, enforced in-kernel.
-        mask = None
-        for fn in self.hard_node_mask_fns:
-            contrib = fn(tasks)
-            if contrib is not None:
-                mask = contrib if mask is None else (mask & contrib)
+        mask = self.compute_hard_mask(tasks)
         # Self-anti-affinity domain rows (spread-one-per-domain gangs).
         anti_dom = None
         for fn in self.anti_domain_fns:
@@ -378,12 +405,19 @@ class Session:
             if contrib is not None:
                 anti_dom = contrib
                 break
+        # In-gang required-affinity domain rows (co-locate gangs).
+        aff_dom = None
+        for fn in self.affinity_domain_fns:
+            contrib = fn(tasks)
+            if contrib is not None:
+                aff_dom = contrib
+                break
 
         # Homogeneous chunks with no extra score terms take the grouped
         # fill-plan kernel: one scan step instead of one per task.
         homogeneous = (
             t > 1 and node_subset is None and not extra.any()
-            and mask is None and anti_dom is None
+            and mask is None and anti_dom is None and aff_dom is None
             and self.gpu_strategy == BINPACK
             and self.cpu_strategy == BINPACK
             and (task_req[1:t] == task_req[0]).all()
@@ -426,6 +460,21 @@ class Session:
             a = np.zeros(t_pad, bool)
             a[:t] = avoids
             dom_pad = (jnp.asarray(d), jnp.asarray(m), jnp.asarray(a))
+        aff_pad = None
+        if aff_dom is not None:
+            doms, marks, avoids, static_ok, boot = aff_dom
+            d = np.full((t_pad, n_nodes), -1, np.int32)
+            d[:t] = doms
+            m = np.zeros(t_pad, bool)
+            m[:t] = marks
+            a = np.zeros(t_pad, bool)
+            a[:t] = avoids
+            st = np.ones((t_pad, n_nodes), bool)
+            st[:t] = static_ok
+            b = np.zeros(t_pad, bool)
+            b[:t] = boot
+            aff_pad = (jnp.asarray(d), jnp.asarray(m), jnp.asarray(a),
+                       jnp.asarray(st), jnp.asarray(b))
         result = allocate_jobs_kernel(
             *self._device_arrays(),
             jnp.asarray(task_req), jnp.asarray(task_job),
@@ -434,6 +483,7 @@ class Session:
             task_node_mask=(None if mask_pad is None
                             else jnp.asarray(mask_pad)),
             task_anti_domain=dom_pad,
+            task_aff_domain=aff_pad,
             gpu_strategy=self.gpu_strategy, cpu_strategy=self.cpu_strategy,
             allow_pipeline=allow_pipeline, pipeline_only=pipeline_only)
 

@@ -55,6 +55,7 @@ def allocate_jobs_kernel(node_allocatable, node_idle, node_releasing,
                          task_req, task_job, task_selector, task_tolerations,
                          job_allowed, task_extra_scores=None,
                          task_node_mask=None, task_anti_domain=None,
+                         task_aff_domain=None,
                          gpu_strategy: int = BINPACK,
                          cpu_strategy: int = BINPACK,
                          allow_pipeline: bool = True,
@@ -77,6 +78,14 @@ def allocate_jobs_kernel(node_allocatable, node_idle, node_releasing,
     marker already landed, and a marker cannot enter a domain where an
     avoider already landed.  Blocked state lives in the scan carry and
     resets at each job boundary, so rollback is automatic.
+    task_aff_domain: optional (dom [T,N] int32, marks [T] bool,
+    avoids [T] bool, static_ok [T,N] bool, bootstrap [T] bool) — in-gang
+    REQUIRED affinity for ONE term.  An avoider may sit only in a domain
+    holding a matching pod: one that held a match before the cycle
+    (``static_ok``) OR one a gang marker landed in this scan
+    (accumulated union).  ``bootstrap`` flags the upstream first-pod rule:
+    a self-matching avoider may open a fresh domain while the gang has
+    placed no marker yet.
     pipeline_only: scenario-simulation mode — all placements pipeline
     (statement.go ConvertAllAllocatedToPipelined semantics come free:
     nothing claims idle).
@@ -93,6 +102,15 @@ def allocate_jobs_kernel(node_allocatable, node_idle, node_releasing,
         anti_avoids = jnp.zeros(T, bool)
     else:
         anti_dom, anti_marks, anti_avoids = task_anti_domain
+    if task_aff_domain is None:
+        aff_dom = jnp.full((T, N), -1, jnp.int32)
+        aff_marks = jnp.zeros(T, bool)
+        aff_avoids = jnp.zeros(T, bool)
+        aff_static = jnp.ones((T, N), bool)
+        aff_boot = jnp.zeros(T, bool)
+    else:
+        aff_dom, aff_marks, aff_avoids, aff_static, aff_boot = \
+            task_aff_domain
 
     class Carry(NamedTuple):
         idle: jnp.ndarray
@@ -107,11 +125,16 @@ def allocate_jobs_kernel(node_allocatable, node_idle, node_releasing,
         # and to markers (an avoider landed; upstream symmetry).
         blocked_avoiders: jnp.ndarray  # [N] bool
         blocked_markers: jnp.ndarray   # [N] bool
+        # Self-affinity: union of domains gang markers landed in, and
+        # whether any marker has landed yet (bootstrap gate).
+        aff_union: jnp.ndarray         # [N] bool
+        any_marker: jnp.ndarray        # scalar bool
 
     init = Carry(node_idle, node_releasing, node_pod_room,
                  node_idle, node_releasing, node_pod_room,
                  jnp.array(-1, jnp.int32), jnp.array(False),
-                 jnp.zeros(N, bool), jnp.zeros(N, bool))
+                 jnp.zeros(N, bool), jnp.zeros(N, bool),
+                 jnp.zeros(N, bool), jnp.array(False))
 
     def step(carry: Carry, t):
         j = task_job[t]
@@ -127,6 +150,8 @@ def allocate_jobs_kernel(node_allocatable, node_idle, node_releasing,
         ok = jnp.where(new_job, job_allowed[j], carry.cur_ok)
         blocked_avoiders = jnp.where(new_job, False, carry.blocked_avoiders)
         blocked_markers = jnp.where(new_job, False, carry.blocked_markers)
+        aff_union = jnp.where(new_job, False, carry.aff_union)
+        any_marker = jnp.where(new_job, False, carry.any_marker)
 
         req = task_req[t]
         fit_now, fit_future = feasibility_row(
@@ -139,6 +164,11 @@ def allocate_jobs_kernel(node_allocatable, node_idle, node_releasing,
         feasible = feasible & task_node_mask[t] \
             & ~(anti_avoids[t] & blocked_avoiders) \
             & ~(anti_marks[t] & blocked_markers)
+        # Required affinity: an avoider needs a matching pod in its domain
+        # — pre-existing (static), placed by this gang (union), or itself
+        # under the first-pod bootstrap rule.
+        aff_ok = aff_static[t] | aff_union | (aff_boot[t] & ~any_marker)
+        feasible = feasible & jnp.where(aff_avoids[t], aff_ok, True)
         score = score_row(node_allocatable, idle, req, feasible,
                           fit_now, gpu_strategy, cpu_strategy)
         score = score + task_extra_scores[t]
@@ -163,11 +193,18 @@ def allocate_jobs_kernel(node_allocatable, node_idle, node_releasing,
         blocked_avoiders = blocked_avoiders | (anti_marks[t] & in_dom)
         blocked_markers = blocked_markers | (anti_avoids[t] & in_dom)
 
+        a_row = aff_dom[t]
+        a_won = a_row[best]
+        a_in_dom = found & (a_won >= 0) & (a_row == a_won)
+        aff_union = aff_union | (aff_marks[t] & a_in_dom)
+        any_marker = any_marker | (aff_marks[t] & found)
+
         ok = ok & found
         out = (jnp.where(found, best, -1).astype(jnp.int32), pipelined, found)
         return Carry(idle, rel, room, ck_idle, ck_rel, ck_room,
                      j.astype(jnp.int32), ok,
-                     blocked_avoiders, blocked_markers), out
+                     blocked_avoiders, blocked_markers,
+                     aff_union, any_marker), out
 
     carry, (placements, pipelined, found) = jax.lax.scan(
         step, init, jnp.arange(T))

@@ -10,6 +10,7 @@ from . import minruntime  # noqa: F401
 from . import ordering  # noqa: F401
 from . import placement  # noqa: F401
 from . import podaffinity  # noqa: F401
+from . import predicates_ext  # noqa: F401
 from . import proportion  # noqa: F401
 from . import snapshot_plugin  # noqa: F401
 from . import topology  # noqa: F401

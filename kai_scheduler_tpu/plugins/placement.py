@@ -38,11 +38,10 @@ class ResourceTypePlugin(Plugin):
     """Resource-type matching term is always-on in the kernel."""
 
 
-@register_plugin("predicates")
-class PredicatesPlugin(Plugin):
-    """Predicate masks are built into the kernel; the plugin contributes the
-    host-side pre-predicate (per-job constraint screening) hook
-    (predicates/predicates.go:74-89)."""
+# "predicates" is registered by plugins/predicates_ext.py: selector/taint/
+# capacity masks live in the kernel; the plugin carries the upstream
+# adapters (NodePorts, VolumeBinding filter, ConfigMap,
+# MaxNodePoolResources).
 
 
 @register_plugin("gpupack")

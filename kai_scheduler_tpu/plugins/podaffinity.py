@@ -4,23 +4,24 @@ Mirrors the reference's use of the upstream InterPodAffinity plugin
 (pkg/scheduler/k8s_internal/predicates/predicates.go:70-167 wires
 PreFilter/Filter; pkg/scheduler/api/pod_affinity/ keeps per-node pod
 affinity metadata) re-designed for the tensor path: every
-(selector, topologyKey) term becomes a [N] node mask via domain
-occupancy — "does this node's domain contain a pod matching the
-selector" — computed once per proposal from the live cluster state.
+(selector, topologyKey, namespaces) term becomes a [N] node mask via
+domain occupancy — "does this node's domain contain a pod matching the
+selector" — computed from the live cluster state and memoized on the
+session's mutation tick.
 
 Semantics covered:
 - REQUIRED pod affinity: the task may only go where a matching pod's
-  domain is (bootstrap rule: if no pod matches anywhere but the task's
-  own labels match the term, any node is allowed — the upstream rule that
-  lets the first pod of a self-affine group schedule).
+  domain is.  When the match can come from the task's own gang (a chunk
+  member matches the term), enforcement moves INTO the allocation kernel
+  (ops/allocate.py task_aff_domain: union-of-marker-domains + the
+  upstream first-pod bootstrap rule), since a static mask cannot see
+  in-gang placements.
 - REQUIRED pod anti-affinity: domains containing matching pods are
   excluded; SYMMETRY is honored — an existing pod's anti-affinity term
-  also repels an incoming task that matches it (upstream
-  haveAffinityTermsWithPods symmetry).
-- Self-gang anti-affinity (every member repels its siblings —
-  spread-one-per-domain): enforced inside the allocation kernel via
-  ``task_anti_domain`` rows (ops/allocate.py gang_blocked carry), since
-  the static mask cannot see in-gang placements.
+  also repels an incoming task that matches it.  In-gang spread runs in
+  the kernel (task_anti_domain marker/avoider carry).
+- Namespace scoping: a term matches only pods in its resolved namespace
+  list (the owner pod's own namespace unless the manifest listed some).
 - PREFERRED terms contribute ±weight-scaled score on matching domains.
 - Legacy coarse peers (``pod_affinity_peers`` job-uid lists) keep their
   score behavior.
@@ -36,14 +37,22 @@ AFFINITY_SCORE = 50.0  # between placement (<=9+10) and availability (100)
 HOSTNAME_KEY = "kubernetes.io/hostname"
 
 
+def _same_term(a, b) -> bool:
+    return (a.topology_key == b.topology_key and a.selector == b.selector
+            and a.expressions == b.expressions
+            and a.namespaces == b.namespaces)
+
+
 @register_plugin("podaffinity")
 class PodAffinityPlugin(Plugin):
     def on_session_open(self, ssn) -> None:
         self.ssn = ssn
         self._domain_cache: dict = {}
+        self._pods_cache = (-1, None)  # (mutation_count, pods)
         ssn.extra_score_fns.append(self.extra_scores)
         ssn.hard_node_mask_fns.append(self.hard_masks)
         ssn.anti_domain_fns.append(self.anti_domains)
+        ssn.affinity_domain_fns.append(self.affinity_domains)
 
     # -- domain encoding ---------------------------------------------------
     def _domains(self, topology_key: str) -> tuple[np.ndarray, int]:
@@ -73,8 +82,12 @@ class PodAffinityPlugin(Plugin):
         return dom, len(ids)
 
     def _active_pods(self):
-        """(labels, node_idx, anti_terms, job_id) for every active
-        allocated pod currently on a snapshot node."""
+        """(labels, namespace, node_idx, anti_terms, job_id) for every
+        active allocated pod on a snapshot node; memoized per session
+        mutation tick (statements bump it on every state change)."""
+        tick = self.ssn.mutation_count
+        if self._pods_cache[0] == tick:
+            return self._pods_cache[1]
         out = []
         for pg in self.ssn.cluster.podgroups.values():
             for task in pg.pods.values():
@@ -83,86 +96,79 @@ class PodAffinityPlugin(Plugin):
                 idx = self.ssn.node_index(task.node_name)
                 if idx < 0:
                     continue
-                out.append((task.labels, idx,
+                out.append((task.labels, task.namespace, idx,
                             getattr(task, "anti_affinity_terms", []),
                             task.job_id))
+        self._pods_cache = (tick, out)
         return out
 
-    def _term_mask(self, term, pods, exclude_job: str | None = None
-                   ) -> np.ndarray:
+    def _term_mask(self, term, pods) -> np.ndarray:
         """[N] bool: nodes whose domain holds a pod matching the term."""
         dom, n_dom = self._domains(term.topology_key)
         if n_dom == 0:
             return np.zeros(self.ssn.node_idle.shape[0], bool)
         has = np.zeros(n_dom, bool)
-        for labels, idx, _anti, job_id in pods:
-            if exclude_job is not None and job_id == exclude_job:
-                continue
-            if dom[idx] >= 0 and term.matches(labels):
+        for labels, ns, idx, _anti, _job in pods:
+            if dom[idx] >= 0 and term.matches(labels, ns):
                 has[dom[idx]] = True
         mask = np.zeros(dom.shape[0], bool)
         valid = dom >= 0
         mask[valid] = has[dom[valid]]
         return mask
 
-    # -- hard masks (required terms) ---------------------------------------
+    @staticmethod
+    def _in_gang(term, tasks) -> bool:
+        """Can the term be satisfied/violated by the chunk itself?"""
+        return any(term.matches(x.labels, x.namespace) for x in tasks)
+
+    # -- hard masks (required terms vs EXISTING pods) ----------------------
     def hard_masks(self, tasks):
-        needs = any(
+        has_own_terms = any(
             getattr(t, "affinity_terms", None)
             or getattr(t, "anti_affinity_terms", None)
             for t in tasks)
-        pods = None
-        sym_repellers = None
-        if not needs:
-            # Symmetry can constrain label-bearing tasks even without own
-            # terms — only scan when some existing pod has anti terms.
-            pods = self._active_pods()
-            if not any(anti for _l, _i, anti, _j in pods):
-                return None
-        if pods is None:
-            pods = self._active_pods()
+        pods = self._active_pods()
+        if not has_own_terms and not any(
+                anti for _l, _n, _i, anti, _j in pods):
+            return None
 
         n = self.ssn.node_idle.shape[0]
         out = np.ones((len(tasks), n), bool)
         touched = False
+        sym_repellers = [
+            (labels, ns, idx, term)
+            for labels, ns, idx, anti, _j in pods for term in anti]
         for i, task in enumerate(tasks):
             row = out[i]
             for term in getattr(task, "affinity_terms", []) or []:
-                mask = self._term_mask(term, pods)
-                if not mask.any() and term.matches(task.labels):
-                    continue  # bootstrap: first self-affine pod
-                row &= mask
+                if self._in_gang(term, tasks):
+                    continue  # enforced in-kernel via affinity_domains
+                row &= self._term_mask(term, pods)
                 touched = True
             for term in getattr(task, "anti_affinity_terms", []) or []:
-                # Own gang's already-running pods are handled here too
-                # (RemovePod on evicted victims keeps them out of `pods`).
                 row &= ~self._term_mask(term, pods)
                 touched = True
             # Anti-affinity symmetry: existing pods' anti terms repel a
             # matching incoming task from their domains.
-            if sym_repellers is None:
-                sym_repellers = [
-                    (labels, idx, term)
-                    for labels, idx, anti, _j in pods for term in anti]
-            for _labels, idx, term in sym_repellers:
-                if term.matches(task.labels):
+            for _labels, _ns, idx, term in sym_repellers:
+                if term.matches(task.labels, task.namespace):
                     dom, n_dom = self._domains(term.topology_key)
                     if dom[idx] >= 0:
                         row &= ~(dom == dom[idx])
                         touched = True
         return out if touched else None
 
-    # -- self-gang anti-affinity domains -----------------------------------
+    # -- in-gang REQUIRED anti-affinity ------------------------------------
     def anti_domains(self, tasks):
-        """(dom [T,N], marks [T], avoids [T]) for in-gang REQUIRED
-        anti-affinity: a term some chunk member carries that some chunk
-        member's labels match.  One term per chunk (multiple distinct
-        in-gang terms are rare; the first active one wins — cross-gang
-        enforcement still comes from hard_masks)."""
+        """(dom [T,N], marks [T], avoids [T]) for a required anti term
+        some chunk member carries that some chunk member matches.  One
+        term per chunk (multiple distinct in-gang terms are rare; the
+        first active one wins — cross-gang enforcement still comes from
+        hard_masks)."""
         term = None
         for task in tasks:
             for t2 in getattr(task, "anti_affinity_terms", []) or []:
-                if any(t2.matches(x.labels) for x in tasks):
+                if self._in_gang(t2, tasks):
                     term = t2
                     break
             if term is not None:
@@ -173,14 +179,48 @@ class PodAffinityPlugin(Plugin):
         if n_dom == 0:
             return None
         doms = np.tile(dom, (len(tasks), 1))
-        marks = np.array([term.matches(t.labels) for t in tasks])
+        marks = np.array([term.matches(t.labels, t.namespace)
+                          for t in tasks])
         avoids = np.array([
-            any(t3.topology_key == term.topology_key
-                and t3.selector == term.selector
-                and t3.expressions == term.expressions
+            any(_same_term(t3, term)
                 for t3 in getattr(t, "anti_affinity_terms", []) or [])
             for t in tasks])
         return doms, marks, avoids
+
+    # -- in-gang REQUIRED affinity -----------------------------------------
+    def affinity_domains(self, tasks):
+        """(dom [T,N], marks, avoids, static_ok [T,N], bootstrap [T]) for
+        a required affinity term satisfiable by the chunk itself: avoiders
+        must share a domain with a matching pod — pre-existing
+        (static_ok), placed by this gang (kernel union), or themselves
+        under the upstream first-pod bootstrap rule."""
+        term = None
+        for task in tasks:
+            for t2 in getattr(task, "affinity_terms", []) or []:
+                if self._in_gang(t2, tasks):
+                    term = t2
+                    break
+            if term is not None:
+                break
+        if term is None:
+            return None
+        dom, n_dom = self._domains(term.topology_key)
+        if n_dom == 0:
+            return None
+        pods = self._active_pods()
+        static_row = self._term_mask(term, pods)
+        t_count = len(tasks)
+        doms = np.tile(dom, (t_count, 1))
+        static_ok = np.tile(static_row, (t_count, 1))
+        marks = np.array([term.matches(t.labels, t.namespace)
+                          for t in tasks])
+        avoids = np.array([
+            any(_same_term(t3, term)
+                for t3 in getattr(t, "affinity_terms", []) or [])
+            for t in tasks])
+        no_existing = not static_row.any()
+        bootstrap = marks & avoids & no_existing
+        return doms, marks, avoids, static_ok, bootstrap
 
     # -- scores (preferred terms + legacy peers) ---------------------------
     def _job_nodes(self, job_uid: str) -> set:

@@ -1,0 +1,140 @@
+"""Upstream-predicate plugin: NodePorts, schedule-time VolumeBinding,
+ConfigMap, MaxNodePoolResources.
+
+Mirrors the reference's upstream-plugin adapters
+(pkg/scheduler/k8s_internal/predicates/predicates.go:70-167 wires
+NodePorts/VolumeBinding; config_maps.go and maxNodeResources.go are its
+own PreFilter-only predicates) re-designed for the tensor path: node-level
+filters contribute hard [T,N] masks (session.hard_node_mask_fns), and
+cluster-level PreFilters run once per job through
+session.pre_predicate_fns, failing fast with the reference's
+unschedulable-message shapes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..api import resources as rs
+from ..framework.session import SchedulableResult
+from .base import Plugin, register_plugin
+
+
+@register_plugin("predicates")
+class UpstreamPredicatesPlugin(Plugin):
+    def on_session_open(self, ssn) -> None:
+        self.ssn = ssn
+        # MaxNodePoolResources: element-wise max over the shard's nodes
+        # (maxNodeResources.go:41-43 SetMaxResource).
+        nodes = list(ssn.cluster.nodes.values())
+        self.max_alloc = (np.max([n.allocatable for n in nodes], axis=0)
+                          if nodes else rs.zeros())
+        self.max_mig: dict[str, float] = {}
+        for n in nodes:
+            for profile, count in n.mig_capacity.items():
+                self.max_mig[profile] = max(
+                    self.max_mig.get(profile, 0.0), count)
+        self._ports_cache = (-1, None)  # (mutation_count, ports)
+        ssn.pre_predicate_fns.append(self.pre_predicate)
+        ssn.hard_node_mask_fns.append(self.node_masks)
+
+    # -- PreFilters (cluster-level, once per task) -------------------------
+    def pre_predicate(self, task) -> SchedulableResult:
+        res = self._max_node_resources(task)
+        if not res.schedulable:
+            return res
+        res = self._configmaps_exist(task)
+        if not res.schedulable:
+            return res
+        return self._pvcs_exist(task)
+
+    def _max_node_resources(self, task) -> SchedulableResult:
+        """maxNodeResources.go PreFilter: no single node in the pool can
+        ever fit the request -> unschedulable without scanning nodes."""
+        req = task.res_req.to_vec(mig_as_gpu=False)
+        for i, name in enumerate(rs.RESOURCE_NAMES):
+            if req[i] > self.max_alloc[i] + 1e-9:
+                return SchedulableResult(
+                    False, "MaxNodePoolResources",
+                    f"pod {task.namespace}/{task.name} requires "
+                    f"{req[i]:g} {name}; max available in a single node "
+                    f"in this node-pool is {self.max_alloc[i]:g}")
+        for profile, count in task.res_req.mig_resources.items():
+            if count > self.max_mig.get(profile, 0.0) + 1e-9:
+                return SchedulableResult(
+                    False, "MaxNodePoolResources",
+                    f"no node in this node-pool has {count:g} x {profile}")
+        return SchedulableResult()
+
+    def _configmaps_exist(self, task) -> SchedulableResult:
+        """config_maps.go PreFilter: every required (non-optional)
+        ConfigMap must exist."""
+        missing = [cm for cm in task.required_configmaps
+                   if (task.namespace, cm) not in self.ssn.cluster.config_maps]
+        if missing:
+            return SchedulableResult(
+                False, "ConfigMap",
+                f"Missing required configmaps: {missing}")
+        return SchedulableResult()
+
+    def _pvcs_exist(self, task) -> SchedulableResult:
+        """volume_binding.go filter, cluster-level half: referenced PVCs
+        must exist (unbound WaitForFirstConsumer ones bind later)."""
+        missing = [name for name in task.pvc_names
+                   if (task.namespace, name) not in self.ssn.cluster.pvcs]
+        if missing:
+            return SchedulableResult(
+                False, "VolumeBinding",
+                f"pod {task.namespace}/{task.name} references missing "
+                f"PersistentVolumeClaims: {missing}")
+        return SchedulableResult()
+
+    # -- node-level filters as hard masks ----------------------------------
+    def node_masks(self, tasks):
+        needs = any(t.host_ports or t.pvc_names for t in tasks)
+        if not needs:
+            return None
+        n = self.ssn.node_idle.shape[0]
+        out = np.ones((len(tasks), n), bool)
+        ports_by_node = None
+        for i, task in enumerate(tasks):
+            if task.host_ports:
+                if ports_by_node is None:
+                    ports_by_node = self._ports_by_node()
+                for j in range(n):
+                    if ports_by_node[j] & task.host_ports:
+                        out[i, j] = False
+            for pvc_name in task.pvc_names:
+                pvc = self.ssn.cluster.pvcs.get(
+                    (task.namespace, pvc_name))
+                bound = (pvc or {}).get("bound_node")
+                if bound:
+                    # Local/bound volume: the pod must follow it
+                    # (volume_binding.go node-affinity filter).
+                    idx = self.ssn.node_index(bound)
+                    keep = np.zeros(n, bool)
+                    if idx >= 0:
+                        keep[idx] = True
+                    out[i] &= keep
+        return out
+
+    def _ports_by_node(self) -> list[set]:
+        """Occupied (protocol, hostPort) pairs per node (nodeports.go:
+        Fits against NodeInfo.UsedPorts); memoized per session mutation
+        tick."""
+        tick = self.ssn.mutation_count
+        if self._ports_cache[0] == tick:
+            return self._ports_cache[1]
+        n = self.ssn.node_idle.shape[0]
+        out = [set() for _ in range(n)]
+        for pg in self.ssn.cluster.podgroups.values():
+            for t in pg.pods.values():
+                if not t.host_ports or not t.node_name:
+                    continue
+                if not t.is_active_allocated():
+                    continue
+                idx = self.ssn.node_index(t.node_name)
+                if idx >= 0:
+                    out[idx] |= t.host_ports
+        self._ports_cache = (tick, out)
+        return out

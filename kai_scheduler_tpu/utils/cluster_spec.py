@@ -18,7 +18,9 @@ from ..framework import SchedulerConfig, Session
 def _terms(raw) -> list:
     from ..api import AffinityTerm
     return [AffinityTerm(dict(r["selector"]), r["topology_key"],
-                         float(r.get("weight", 1.0)))
+                         float(r.get("weight", 1.0)),
+                         [dict(e) for e in r.get("expressions", ())],
+                         list(r.get("namespaces", ["default"])))
             for r in (raw or ())]
 
 
@@ -37,7 +39,8 @@ def build_cluster(spec: dict) -> ClusterInfo:
             labels=n.get("labels"), taints=set(n.get("taints", ())),
             gpu_memory_per_device=rs.parse_memory(n["gpu_memory"])
             if "gpu_memory" in n else 16 * 2 ** 30,
-            max_pods=n.get("max_pods", 110))
+            max_pods=n.get("max_pods", 110),
+            mig_capacity=n.get("mig_capacity"))
 
     queues = {}
     for name, q in spec.get("queues", {"default": {}}).items():
@@ -96,6 +99,11 @@ def build_cluster(spec: dict) -> ClusterInfo:
             # Full (anti-)affinity terms: {selector, topology_key[, weight]}
             # dicts, mirroring matchLabels + topologyKey.
             task.labels = dict(t.get("labels", {}))
+            task.host_ports = {(pp.get("protocol", "TCP"), pp["port"])
+                               if isinstance(pp, dict) else ("TCP", pp)
+                               for pp in t.get("host_ports", ())}
+            task.required_configmaps = list(t.get("configmaps", ()))
+            task.pvc_names = list(t.get("pvcs", ()))
             task.affinity_terms = _terms(t.get("affinity_terms"))
             task.anti_affinity_terms = _terms(t.get("anti_affinity_terms"))
             task.preferred_affinity_terms = _terms(
@@ -105,10 +113,16 @@ def build_cluster(spec: dict) -> ClusterInfo:
             pg.add_task(task)
         podgroups[name] = pg
 
-    return ClusterInfo(nodes, podgroups, queues,
-                       topologies=spec.get("topologies", {}),
-                       now=spec.get("now", 1000.0),
-                       resource_claims=spec.get("resource_claims", {}))
+    return ClusterInfo(
+        nodes, podgroups, queues,
+        topologies=spec.get("topologies", {}),
+        now=spec.get("now", 1000.0),
+        resource_claims=spec.get("resource_claims", {}),
+        config_maps={(ns_name if isinstance(ns_name, tuple)
+                      else ("default", ns_name))
+                     for ns_name in spec.get("config_maps", ())},
+        pvcs={(k if isinstance(k, tuple) else ("default", k)): dict(v)
+              for k, v in spec.get("pvcs", {}).items()})
 
 
 def build_session(spec: dict, config: SchedulerConfig | None = None

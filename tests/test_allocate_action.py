@@ -41,7 +41,9 @@ class TestBasicAllocation:
         run_action(ssn)
         assert placements(ssn) == {}
         job = ssn.cluster.podgroups["j1"]
-        assert job.fit_errors
+        # MaxNodePoolResources fails fast with the reference's specific
+        # message shape (maxNodeResources.go buildUnschedulableMessage).
+        assert any("node-pool" in e for e in job.fit_errors)
         assert any(k == "Unschedulable" for k, _ in ssn.cache.events)
 
     def test_selector_and_taints(self):

@@ -277,3 +277,35 @@ class TestElectorReacquire:
         e.release()
         assert e.acquire(timeout=2), "elector must be re-entrant"
         e.release()
+
+
+class TestSyncDeletions:
+    def test_too_old_replay_synthesizes_deletes(self, server):
+        """Objects deleted while their DELETED events fell off the ring
+        are synthesized from the SYNC diff (informer re-list semantics)."""
+        server.log._events = server.log._events.__class__(maxlen=4)
+        c = HTTPKubeAPI(server.url)
+        events = []
+        c.watch("Queue", lambda et, obj: events.append(
+            (et, obj["metadata"]["name"])))
+        c.create({"kind": "Queue", "metadata": {"name": "doomed"},
+                  "spec": {}})
+        c.wait_for_events()
+        c.drain()
+        assert ("ADDED", "doomed") in events
+        # Disconnect; delete + churn past the ring capacity.
+        c._stop.set()
+        time.sleep(0.05)
+        c.delete("Queue", "doomed")
+        for i in range(6):
+            c.create({"kind": "Queue", "metadata": {"name": f"fill{i}"},
+                      "spec": {}})
+        c._stop.clear()
+        c._ensure_watch_thread()
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and \
+                ("DELETED", "doomed") not in events:
+            c.drain()
+            time.sleep(0.02)
+        assert ("DELETED", "doomed") in events
+        c.close()

@@ -254,8 +254,10 @@ class TestExplainabilityAndUsage:
         system.run_cycle()
         pgs = api.list("PodGroup")
         conds = pgs[0]["status"].get("conditions", [])
-        assert any(c["type"] == "Unschedulable" and "Resources" in
-                   c["message"] for c in conds)
+        assert any(c["type"] == "Unschedulable"
+                   and ("Resources" in c["message"]
+                        or "node-pool" in c["message"])
+                   for c in conds)
 
     def test_usage_db_records_allocations(self):
         system = System(SystemConfig(usage_db="memory://"))

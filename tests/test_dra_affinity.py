@@ -364,3 +364,95 @@ class TestAffinityReviewRegressions:
                 {"key": "tier", "operator": "In", "values": ["web"]}])]
         run_action(ssn)
         assert placements(ssn)["incoming-0"][0] == "n2"
+
+
+class TestInGangRequiredAffinity:
+    ZONES = {"n1": {"gpu": 8, "labels": {"zone": "a"}},
+             "n2": {"gpu": 8, "labels": {"zone": "a"}},
+             "n3": {"gpu": 8, "labels": {"zone": "b"}},
+             "n4": {"gpu": 8, "labels": {"zone": "b"}}}
+
+    def test_self_affine_gang_colocates_in_one_zone(self):
+        """Required self-affinity must hold WITHIN a gang: both members
+        land in the same zone even when each node only fits one member."""
+        task = {"gpu": 8, "labels": {"app": "grp"},
+                "affinity_terms": [{"selector": {"app": "grp"},
+                                    "topology_key": "zone"}]}
+        ssn = build_session({
+            "nodes": dict(self.ZONES),
+            "queues": {"q": {}},
+            "jobs": {"grp": {"queue": "q", "min_available": 2,
+                             "tasks": [dict(task), dict(task)]}},
+        })
+        run_action(ssn)
+        p = placements(ssn)
+        zones = {"n1": "a", "n2": "a", "n3": "b", "n4": "b"}
+        assert len(p) == 2
+        assert zones[p["grp-0"][0]] == zones[p["grp-1"][0]]
+
+    def test_self_affine_gang_joins_existing_match_domain(self):
+        """With an existing matching pod in zone b, the whole gang must
+        co-locate in zone b (no fresh bootstrap domain allowed)."""
+        task = {"gpu": 4, "labels": {"app": "grp"},
+                "affinity_terms": [{"selector": {"app": "grp"},
+                                    "topology_key": "zone"}]}
+        ssn = build_session({
+            "nodes": dict(self.ZONES),
+            "queues": {"q": {}},
+            "jobs": {
+                "anchor": {"queue": "q",
+                           "tasks": [{"gpu": 1, "status": "RUNNING",
+                                      "node": "n3",
+                                      "labels": {"app": "grp"}}]},
+                "grp": {"queue": "q", "min_available": 2,
+                        "tasks": [dict(task), dict(task)]},
+            },
+        })
+        run_action(ssn)
+        p = placements(ssn)
+        assert p["grp-0"][0] in ("n3", "n4")
+        assert p["grp-1"][0] in ("n3", "n4")
+
+    def test_self_affine_gang_too_big_for_any_zone_fails(self):
+        """Three 8-GPU members but each zone holds only two nodes: the
+        co-location requirement must fail the gang atomically."""
+        task = {"gpu": 8, "labels": {"app": "grp"},
+                "affinity_terms": [{"selector": {"app": "grp"},
+                                    "topology_key": "zone"}]}
+        ssn = build_session({
+            "nodes": dict(self.ZONES),
+            "queues": {"q": {}},
+            "jobs": {"grp": {"queue": "q", "min_available": 3,
+                             "tasks": [dict(task), dict(task),
+                                       dict(task)]}},
+        })
+        run_action(ssn)
+        assert placements(ssn) == {}
+
+
+class TestAffinityNamespaceScoping:
+    def test_terms_scope_to_own_namespace(self):
+        """A term without explicit namespaces matches only pods in the
+        owner's namespace: another tenant's app=db pod must not repel."""
+        from kai_scheduler_tpu.api import AffinityTerm
+        ssn = build_session({
+            "nodes": {"n1": {"gpu": 8, "labels": {"zone": "a"}},
+                      "n2": {"gpu": 1, "labels": {"zone": "b"}}},
+            "queues": {"q": {}},
+            "jobs": {
+                "other": {"queue": "q",
+                          "tasks": [{"gpu": 7, "status": "RUNNING",
+                                     "node": "n1",
+                                     "labels": {"app": "db"}}]},
+                "mine": {"queue": "q", "tasks": [{"gpu": 1}]},
+            },
+        })
+        other = ssn.cluster.podgroups["other"].pods["other-0"]
+        other.namespace = "tenant-b"
+        mine = ssn.cluster.podgroups["mine"].pods["mine-0"]
+        # Anti term scoped to mine's namespace (default): tenant-b's db
+        # pod is out of scope, so the fuller n1 (binpack) stays legal.
+        mine.anti_affinity_terms = [AffinityTerm(
+            {"app": "db"}, "zone", namespaces=["default"])]
+        run_action(ssn)
+        assert placements(ssn)["mine-0"][0] == "n1"

@@ -45,10 +45,13 @@ class TestMig:
             parse_mig_profile("nvidia.com/gpu")
 
     def test_mig_request_accounting(self):
-        """MIG slices charge g-units against the GPU axis
-        (allocation_info.go:80-84)."""
+        """MIG instances draw on per-profile node inventory
+        (resource_info.go:153-165); queue quota math still charges
+        g-slices as GPU units (allocation_info.go:80-84, covered by
+        to_vec(mig_as_gpu=True))."""
         ssn = build_session({
-            "nodes": {"n1": {"gpu": 8}},
+            "nodes": {"n1": {"gpu": 8, "mig_capacity": {
+                "nvidia.com/mig-3g.20gb": 2}}},
             "queues": {"q": {}},
             "jobs": {"mig": {"queue": "q",
                              "tasks": [{"cpu": "1", "mem": "1Gi",
@@ -57,14 +60,18 @@ class TestMig:
         })
         run_action(ssn)
         assert placements(ssn)["mig-0"][0] == "n1"
-        assert ssn.cluster.nodes["n1"].used[rs.RES_GPU] == 6.0
+        node = ssn.cluster.nodes["n1"]
+        # Whole-GPU pool untouched; profile inventory exhausted.
+        assert node.used[rs.RES_GPU] == 0.0
+        assert node.mig_used["nvidia.com/mig-3g.20gb"] == 2
 
     def test_mig_over_capacity_blocked(self):
         ssn = build_session({
-            "nodes": {"n1": {"gpu": 2}},
+            "nodes": {"n1": {"gpu": 2, "mig_capacity": {
+                "nvidia.com/mig-3g.20gb": 1}}},
             "queues": {"q": {}},
             "jobs": {"mig": {"queue": "q",
-                             "tasks": [{"mig": {"nvidia.com/mig-3g.20gb": 1}
+                             "tasks": [{"mig": {"nvidia.com/mig-3g.20gb": 2}
                                         }]}},
         })
         run_action(ssn)

@@ -1,0 +1,125 @@
+"""MIG placement: per-profile node inventory + quota-slice accounting.
+
+Reference behavior: MIG devices are pre-partitioned per-node scalar
+resources (nvidia.com/mig-Ng.Mgb) accounted per profile
+(resource_info.go:153-165); for QUEUE quota math each profile instance
+counts its 'g' slices as GPU units (allocation_info.go:80-84)."""
+
+import numpy as np
+
+from kai_scheduler_tpu.api import resources as rs
+from tests.fixtures import build_session, placements, run_action
+
+
+class TestMigNodeFit:
+    def test_mig_pod_lands_on_node_with_inventory(self):
+        ssn = build_session({
+            "nodes": {
+                "plain": {"gpu": 8},
+                "mig": {"gpu": 0,
+                        "mig_capacity": {"nvidia.com/mig-1g.5gb": 4}},
+            },
+            "queues": {"q": {}},
+            "jobs": {"j": {"queue": "q", "tasks": [
+                {"cpu": "1", "mem": "1Gi",
+                 "mig": {"nvidia.com/mig-1g.5gb": 1}}]}},
+        })
+        run_action(ssn)
+        assert placements(ssn)["j-0"][0] == "mig"
+
+    def test_inventory_exhaustion_blocks(self):
+        ssn = build_session({
+            "nodes": {"mig": {"gpu": 0,
+                              "mig_capacity": {"nvidia.com/mig-1g.5gb": 2}}},
+            "queues": {"q": {}},
+            "jobs": {"j": {"queue": "q", "tasks": [
+                {"mig": {"nvidia.com/mig-1g.5gb": 1}},
+                {"mig": {"nvidia.com/mig-1g.5gb": 1}},
+                {"mig": {"nvidia.com/mig-1g.5gb": 1}}]}},
+        })
+        run_action(ssn)
+        p = placements(ssn)
+        # min_available=1: two fit, the third must not over-commit.
+        assert len(p) == 2
+
+    def test_profiles_are_independent_inventories(self):
+        ssn = build_session({
+            "nodes": {"mig": {"gpu": 0, "mig_capacity": {
+                "nvidia.com/mig-1g.5gb": 1,
+                "nvidia.com/mig-3g.20gb": 1}}},
+            "queues": {"q": {}},
+            "jobs": {
+                "small2": {"queue": "q", "tasks": [
+                    {"mig": {"nvidia.com/mig-1g.5gb": 1}},
+                    {"mig": {"nvidia.com/mig-1g.5gb": 1}}]},
+                "big": {"queue": "q", "tasks": [
+                    {"mig": {"nvidia.com/mig-3g.20gb": 1}}]},
+            },
+        })
+        run_action(ssn)
+        p = placements(ssn)
+        # Only one 1g.5gb instance exists; the 3g.20gb one is separate.
+        assert "big-0" in p
+        assert sum(uid.startswith("small2") for uid in p) == 1
+
+    def test_mig_does_not_draw_on_whole_gpu_pool(self):
+        """A MIG request must not consume nvidia.com/gpu devices, and a
+        whole-GPU pod must not consume MIG inventory."""
+        ssn = build_session({
+            "nodes": {"both": {"gpu": 1, "mig_capacity": {
+                "nvidia.com/mig-2g.10gb": 1}}},
+            "queues": {"q": {}},
+            "jobs": {
+                "mig": {"queue": "q", "tasks": [
+                    {"mig": {"nvidia.com/mig-2g.10gb": 1}}]},
+                "whole": {"queue": "q", "tasks": [{"gpu": 1}]},
+            },
+        })
+        run_action(ssn)
+        p = placements(ssn)
+        assert "mig-0" in p and "whole-0" in p
+
+    def test_mig_slices_count_toward_queue_quota(self):
+        """Quota algebra: a 3g profile instance charges 3 GPU units
+        (allocation_info.go:80-84) — a 2-GPU deserved queue with a
+        non-preemptible job cannot take a 3g instance."""
+        ssn = build_session({
+            "nodes": {"mig": {"gpu": 0, "mig_capacity": {
+                "nvidia.com/mig-3g.20gb": 2}}},
+            "queues": {"q": {"deserved": {"gpu": 2}}},
+            "jobs": {"j": {"queue": "q", "preemptible": False,
+                           "tasks": [
+                               {"mig": {"nvidia.com/mig-3g.20gb": 1}}]}},
+        })
+        run_action(ssn)
+        assert placements(ssn) == {}
+
+    def test_req_vec_mig_as_gpu_flag(self):
+        from kai_scheduler_tpu.api.resources import ResourceRequirements
+        r = ResourceRequirements.from_spec(
+            cpu="1", memory="1Gi", mig={"nvidia.com/mig-3g.20gb": 2})
+        assert r.to_vec()[rs.RES_GPU] == 6.0
+        assert r.to_vec(mig_as_gpu=False)[rs.RES_GPU] == 0.0
+
+
+class TestMigFleet:
+    def test_mig_pod_binds_through_fleet(self):
+        from kai_scheduler_tpu.controllers import (InMemoryKubeAPI, System,
+                                                   SystemConfig, make_pod)
+        system = System(SystemConfig())
+        api = system.api
+        api.create({"kind": "Node", "metadata": {"name": "mig-node"},
+                    "spec": {},
+                    "status": {"allocatable": {
+                        "cpu": "32", "memory": "256Gi",
+                        "nvidia.com/mig-1g.5gb": 4, "pods": 110}}})
+        api.create({"kind": "Queue", "metadata": {"name": "q"},
+                    "spec": {"deserved": {"cpu": "32", "memory": "256Gi",
+                                          "gpu": 8}}})
+        pod = make_pod("mig-pod", queue="q")
+        pod["spec"]["containers"][0]["resources"]["requests"][
+            "nvidia.com/mig-1g.5gb"] = 1
+        api.create(pod)
+        system.run_cycle()
+        assert api.get("Pod", "mig-pod")["spec"].get("nodeName") == \
+            "mig-node"

@@ -1,0 +1,204 @@
+"""Upstream predicate adapters: NodePorts, schedule-time VolumeBinding,
+ConfigMap, MaxNodePoolResources (k8s_internal/predicates/predicates.go,
+config_maps.go, maxNodeResources.go, volume_binding.go)."""
+
+from tests.fixtures import build_session, placements, run_action
+
+
+class TestNodePorts:
+    def test_host_port_conflict_excludes_node(self):
+        ssn = build_session({
+            "nodes": {"n1": {"gpu": 8}, "n2": {"gpu": 8}},
+            "queues": {"q": {}},
+            "jobs": {
+                "web": {"queue": "q",
+                        "tasks": [{"gpu": 7, "status": "RUNNING",
+                                   "node": "n1", "host_ports": [8080]}]},
+                # binpack would prefer the fuller n1; the port collides.
+                "web2": {"queue": "q",
+                         "tasks": [{"gpu": 1, "host_ports": [8080]}]},
+            },
+        })
+        run_action(ssn)
+        assert placements(ssn)["web2-0"][0] == "n2"
+
+    def test_different_ports_do_not_conflict(self):
+        ssn = build_session({
+            "nodes": {"n1": {"gpu": 8}},
+            "queues": {"q": {}},
+            "jobs": {
+                "a": {"queue": "q",
+                      "tasks": [{"gpu": 1, "status": "RUNNING",
+                                 "node": "n1", "host_ports": [8080]}]},
+                "b": {"queue": "q",
+                      "tasks": [{"gpu": 1, "host_ports": [9090]}]},
+            },
+        })
+        run_action(ssn)
+        assert placements(ssn)["b-0"][0] == "n1"
+
+    def test_port_conflict_everywhere_blocks(self):
+        ssn = build_session({
+            "nodes": {"n1": {"gpu": 8}},
+            "queues": {"q": {}},
+            "jobs": {
+                "a": {"queue": "q",
+                      "tasks": [{"gpu": 1, "status": "RUNNING",
+                                 "node": "n1", "host_ports": [8080]}]},
+                "b": {"queue": "q",
+                      "tasks": [{"gpu": 1, "host_ports": [8080]}]},
+            },
+        })
+        run_action(ssn)
+        assert "b-0" not in placements(ssn)
+
+
+class TestVolumeBinding:
+    def test_bound_pvc_pins_pod_to_node(self):
+        ssn = build_session({
+            "nodes": {"n1": {"gpu": 8}, "n2": {"gpu": 8}},
+            "queues": {"q": {}},
+            "pvcs": {"data": {"bound_node": "n2"}},
+            "jobs": {"j": {"queue": "q",
+                           "tasks": [{"gpu": 1, "pvcs": ["data"]}]}},
+        })
+        run_action(ssn)
+        assert placements(ssn)["j-0"][0] == "n2"
+
+    def test_unbound_pvc_schedules_anywhere(self):
+        ssn = build_session({
+            "nodes": {"n1": {"gpu": 8}},
+            "queues": {"q": {}},
+            "pvcs": {"data": {"bound_node": None}},
+            "jobs": {"j": {"queue": "q",
+                           "tasks": [{"gpu": 1, "pvcs": ["data"]}]}},
+        })
+        run_action(ssn)
+        assert "j-0" in placements(ssn)
+
+    def test_missing_pvc_blocks_with_fit_error(self):
+        ssn = build_session({
+            "nodes": {"n1": {"gpu": 8}},
+            "queues": {"q": {}},
+            "jobs": {"j": {"queue": "q",
+                           "tasks": [{"gpu": 1, "pvcs": ["absent"]}]}},
+        })
+        run_action(ssn)
+        assert placements(ssn) == {}
+        errors = ssn.cluster.podgroups["j"].fit_errors
+        assert any("absent" in e for e in errors)
+
+
+class TestConfigMapPredicate:
+    def test_missing_configmap_blocks(self):
+        ssn = build_session({
+            "nodes": {"n1": {"gpu": 8}},
+            "queues": {"q": {}},
+            "config_maps": {"present"},
+            "jobs": {
+                "ok": {"queue": "q",
+                       "tasks": [{"gpu": 1, "configmaps": ["present"]}]},
+                "bad": {"queue": "q",
+                        "tasks": [{"gpu": 1, "configmaps": ["absent"]}]},
+            },
+        })
+        run_action(ssn)
+        p = placements(ssn)
+        assert "ok-0" in p and "bad-0" not in p
+        errors = ssn.cluster.podgroups["bad"].fit_errors
+        assert any("absent" in e for e in errors)
+
+
+class TestMaxNodePoolResources:
+    def test_oversized_request_fails_fast_with_message(self):
+        ssn = build_session({
+            "nodes": {"n1": {"gpu": 8}, "n2": {"gpu": 8}},
+            "queues": {"q": {}},
+            "jobs": {"huge": {"queue": "q", "tasks": [{"gpu": 16}]}},
+        })
+        run_action(ssn)
+        assert placements(ssn) == {}
+        errors = ssn.cluster.podgroups["huge"].fit_errors
+        assert any("node-pool" in e for e in errors)
+
+    def test_oversized_mig_request_fails_fast(self):
+        ssn = build_session({
+            "nodes": {"n1": {"gpu": 0, "mig_capacity": {
+                "nvidia.com/mig-1g.5gb": 2}}},
+            "queues": {"q": {}},
+            "jobs": {"j": {"queue": "q", "tasks": [
+                {"mig": {"nvidia.com/mig-1g.5gb": 3}}]}},
+        })
+        run_action(ssn)
+        assert placements(ssn) == {}
+
+
+class TestFleetPredicates:
+    def test_host_port_and_configmap_flow_through_manifests(self):
+        from kai_scheduler_tpu.controllers import (InMemoryKubeAPI, System,
+                                                   SystemConfig, make_pod)
+        system = System(SystemConfig())
+        api = system.api
+        api.create({"kind": "Node", "metadata": {"name": "n1"}, "spec": {},
+                    "status": {"allocatable": {"cpu": "32",
+                                               "memory": "256Gi",
+                                               "nvidia.com/gpu": 8,
+                                               "pods": 110}}})
+        api.create({"kind": "Queue", "metadata": {"name": "q"},
+                    "spec": {"deserved": {"cpu": "32", "memory": "256Gi",
+                                          "gpu": 8}}})
+        api.create({"kind": "ConfigMap", "metadata": {"name": "settings"},
+                    "data": {}})
+        pod = make_pod("app", queue="q", gpu=1)
+        pod["spec"]["containers"][0]["ports"] = [{"hostPort": 8080}]
+        pod["spec"]["containers"][0]["envFrom"] = [
+            {"configMapRef": {"name": "settings"}}]
+        api.create(pod)
+        # Second pod with the same host port: must stay pending.
+        pod2 = make_pod("app2", queue="q", gpu=1)
+        pod2["spec"]["containers"][0]["ports"] = [{"hostPort": 8080}]
+        api.create(pod2)
+        # Third pod requiring a missing configmap: must stay pending.
+        pod3 = make_pod("app3", queue="q", gpu=1)
+        pod3["spec"]["containers"][0]["envFrom"] = [
+            {"configMapRef": {"name": "nope"}}]
+        api.create(pod3)
+        system.run_cycle()
+        system.run_cycle()
+        assert api.get("Pod", "app")["spec"].get("nodeName") == "n1"
+        assert not api.get("Pod", "app2")["spec"].get("nodeName")
+        assert not api.get("Pod", "app3")["spec"].get("nodeName")
+
+
+class TestHostPathMaskEnforcement:
+    def test_consolidation_cannot_steal_host_port(self):
+        """Scenario simulation must honor hard masks on the host paths:
+        consolidation may not evict a port-holding MIG pod and hand its
+        hostPort to the pending pod (the victim could never be re-placed)."""
+        from kai_scheduler_tpu.controllers import (System, SystemConfig,
+                                                   make_pod)
+        system = System(SystemConfig())
+        api = system.api
+        api.create({"kind": "Node", "metadata": {"name": "mig1"},
+                    "spec": {},
+                    "status": {"allocatable": {
+                        "cpu": "32", "memory": "256Gi",
+                        "nvidia.com/mig-1g.5gb": 4, "pods": 110}}})
+        api.create({"kind": "Queue", "metadata": {"name": "q"},
+                    "spec": {"deserved": {"cpu": "32", "memory": "256Gi",
+                                          "gpu": 8}}})
+        pod = make_pod("migpod", queue="q")
+        pod["spec"]["containers"][0]["resources"]["requests"][
+            "nvidia.com/mig-1g.5gb"] = 2
+        pod["spec"]["containers"][0]["ports"] = [{"hostPort": 7070}]
+        api.create(pod)
+        pod2 = make_pod("portclash", queue="q")
+        pod2["spec"]["containers"][0]["ports"] = [{"hostPort": 7070}]
+        api.create(pod2)
+        for _ in range(3):
+            system.run_cycle()
+        p1 = api.get("Pod", "migpod")
+        p2 = api.get("Pod", "portclash")
+        assert p1["spec"].get("nodeName") == "mig1"
+        assert not p1["metadata"].get("deletionTimestamp")
+        assert not p2["spec"].get("nodeName")
