@@ -39,7 +39,7 @@ BIG_GANG = 1024
 
 
 def build_arrays(n_nodes=N_NODES, n_jobs=N_JOBS, gang=TASKS_PER_JOB,
-                 seed=0):
+                 seed=0, placeable=False):
     import jax.numpy as jnp
 
     rng = np.random.default_rng(seed)
@@ -54,14 +54,25 @@ def build_arrays(n_nodes=N_NODES, n_jobs=N_JOBS, gang=TASKS_PER_JOB,
 
     n_tasks = n_jobs * gang
     task_job = np.repeat(np.arange(n_jobs, dtype=np.int32), gang)
-    req = np.repeat(np.stack(
-        [[1000.0, 4e9, float(rng.integers(1, 3))] for _ in range(n_jobs)]),
-        gang, axis=0)
-    sel = np.full((n_tasks, 1), -1, np.int32)
-    constrained = rng.random(n_jobs) < 0.25
-    job_sel = np.full(n_jobs, -1, np.int64)
-    job_sel[constrained] = rng.integers(0, 4, constrained.sum())
-    sel[:, 0] = np.repeat(job_sel, gang)
+    if placeable:
+        # A demand the cluster can actually host (BENCH honesty: measuring
+        # throughput on a >50%-infeasible workload muddies pods/sec): half
+        # the gangs are 1-GPU trainers, half are CPU-only services, sized
+        # within the cluster's idle GPU/CPU/memory pools.
+        gpu_job = np.arange(n_jobs) % 2 == 0
+        req = np.repeat(np.stack(
+            [[1000.0, 4e9, 1.0 if gpu_job[j] else 0.0]
+             for j in range(n_jobs)]), gang, axis=0)
+        sel = np.full((n_tasks, 1), -1, np.int32)
+    else:
+        req = np.repeat(np.stack(
+            [[1000.0, 4e9, float(rng.integers(1, 3))]
+             for _ in range(n_jobs)]), gang, axis=0)
+        sel = np.full((n_tasks, 1), -1, np.int32)
+        constrained = rng.random(n_jobs) < 0.25
+        job_sel = np.full(n_jobs, -1, np.int64)
+        job_sel[constrained] = rng.integers(0, 4, constrained.sum())
+        sel[:, 0] = np.repeat(job_sel, gang)
     tol = np.full((n_tasks, 1), -1, np.int32)
     job_allowed = np.ones(n_jobs, bool)
     return tuple(map(jnp.asarray, (
@@ -125,12 +136,14 @@ def main():
     n_tasks = N_JOBS * TASKS_PER_JOB
 
     # --- large-gang config: grouped fill-plan kernel ------------------------
-    big = build_arrays(BIG_NODES, BIG_JOBS, BIG_GANG)
+    # Placeable demand (every gang can host) so pods/sec measures real
+    # placement throughput, not failed-gang rollback speed.
+    big = build_arrays(BIG_NODES, BIG_JOBS, BIG_GANG, placeable=True)
     nodes, tasks = big[:6], big[6:10]
     out = allocate_grouped(nodes, *tasks, big[10])  # warm
     big_placed = int((out.placements >= 0).sum())
     big_times = []
-    for _ in range(3):
+    for _ in range(5):
         t0 = time.perf_counter()
         allocate_grouped(nodes, *tasks, big[10])
         big_times.append((time.perf_counter() - t0) * 1000.0)

@@ -351,8 +351,12 @@ def _allocate_tasks_on_subset(ssn, stmt, job, tasks, node_subset,
                               pipeline_only: bool) -> bool:
     # Fractional tasks and DRA-claim tasks need host-side state the kernel
     # doesn't model (sharing groups, claim bindings): task-by-task path.
+    # host_ports: a static chunk mask cannot stop two gang members from
+    # sharing a node's port; the per-task path re-masks after each
+    # placement (mutation tick) and does.
     host_path = any(t.is_fractional or t.resource_claims
-                    or t.res_req.mig_resources for t in tasks)
+                    or t.res_req.mig_resources or t.host_ports
+                    for t in tasks)
     if host_path:
         ok = _allocate_task_by_task(ssn, stmt, job, tasks, node_subset,
                                     pipeline_only)

@@ -46,8 +46,10 @@ class ConsolidationAction:
             tasks = job.tasks_to_allocate(
                 subgroup_order_fn=ssn.pod_set_order_key,
                 task_order_fn=ssn.task_order_key, real_allocation=False)
-            total_req = np.sum([t.req_vec() for t in tasks], axis=0) \
-                if tasks else None
+            # Node-fit vector (MIG excluded from the GPU axis): MIG
+            # inventory is per-profile and host-checked in simulation.
+            total_req = np.sum([t.res_req.to_vec(mig_as_gpu=False)
+                                for t in tasks], axis=0) if tasks else None
             total_free = ssn.node_idle.sum(axis=0) \
                 + ssn.node_releasing.sum(axis=0)
             if total_req is None or np.any(total_req > total_free + 1e-9):

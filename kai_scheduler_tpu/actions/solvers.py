@@ -110,12 +110,13 @@ def solve_job(ssn, pending_job: PodGroupInfo,
     # cannot create more than (idle + releasing + victim resources); a
     # pending job larger than that can never be solved — skip simulating.
     ordered_victims = ordered_victims[:ssn.config.max_victims_considered]
-    total_req = np.sum([t.req_vec() for t in tasks], axis=0)
+    total_req = np.sum([t.res_req.to_vec(mig_as_gpu=False)
+                        for t in tasks], axis=0)
     budget = ssn.node_idle.sum(axis=0) + ssn.node_releasing.sum(axis=0)
     for vjob in ordered_victims:
         for t in vjob.pods.values():
             if t.is_active_allocated():
-                budget = budget + t.req_vec()
+                budget = budget + t.res_req.to_vec(mig_as_gpu=False)
     if np.any(total_req > budget + 1e-9):
         return SolverResult(False)
 

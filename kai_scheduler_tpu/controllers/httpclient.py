@@ -142,9 +142,15 @@ class HTTPKubeAPI:
                         if self._stop.is_set():
                             return
                         event = json.loads(raw)
-                        self._watch_seq = max(self._watch_seq,
-                                              int(event.get("seq", 0)))
                         etype = event.get("type")
+                        # The cursor advances past a TOO_OLD replay only
+                        # once SYNC_END lands: a disconnect mid-replay
+                        # then resumes from the OLD seq, triggering a
+                        # fresh complete replay instead of silently
+                        # skipping the unreplayed remainder.
+                        if etype not in ("TOO_OLD", "SYNC", "SYNC_END"):
+                            self._watch_seq = max(self._watch_seq,
+                                                  int(event.get("seq", 0)))
                         if etype == "HEARTBEAT":
                             self._synced.set()
                             continue
@@ -153,6 +159,8 @@ class HTTPKubeAPI:
                             continue
                         if etype == "SYNC_END":
                             self._finish_sync()
+                            self._watch_seq = max(self._watch_seq,
+                                                  int(event.get("seq", 0)))
                             continue
                         obj = event["object"]
                         key = obj_key(obj)

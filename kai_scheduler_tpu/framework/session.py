@@ -526,7 +526,7 @@ class Session:
             if code is not None and j < tol.shape[0]:
                 tol[j] = code
                 j += 1
-        return task.req_vec(), sel, tol
+        return task.res_req.to_vec(mig_as_gpu=False), sel, tol
 
     def score_nodes_for_task(self, task: PodInfo) -> np.ndarray:
         """[N] score row for host-side paths (fractional GPU placement)."""

@@ -68,15 +68,90 @@ def group_tasks(task_req: np.ndarray, task_job: np.ndarray,
             task_job[starts].astype(np.int32))
 
 
-def _compact(take_sorted, order, max_group: int):
-    """Gather the nonzero fill segments (in order) into [max_group] slots."""
-    flag = take_sorted > 0
+def _compact(take, key, max_group: int):
+    """Gather the nonzero fill segments into [max_group] slots, ordered
+    by descending score (ascending node index among ties) so per-task
+    expansion matches the exact kernel's placement sequence.  Only the
+    <= max_group compacted slots are sorted — the full node axis never
+    is."""
+    n = take.shape[0]
+    flag = take > 0
     slot = jnp.cumsum(flag) - 1
     slot = jnp.where(flag, slot, max_group)  # dropped when out of range
     nodes = jnp.full(max_group, -1, jnp.int32).at[slot].set(
-        order, mode="drop")
-    counts = jnp.zeros(max_group).at[slot].set(take_sorted, mode="drop")
-    return nodes, counts
+        jnp.arange(n, dtype=jnp.int32), mode="drop")
+    counts = jnp.zeros(max_group, take.dtype).at[slot].set(
+        take, mode="drop")
+    # Slots are in ascending node index; complementing the unsigned key
+    # makes a stable ascending argsort yield descending score with the
+    # ascending-index tie-break.
+    seg_key = jnp.where(nodes >= 0, key[jnp.clip(nodes, 0)],
+                        jnp.zeros((), key.dtype))
+    order = jnp.argsort(~seg_key, stable=True)
+    return nodes[order], counts[order]
+
+
+def _score_keys(score):
+    """Order-preserving unsigned-integer keys for float scores: key(a) >
+    key(b) iff a > b.  (levels, utype) size the radix select below."""
+    if score.dtype == jnp.float64:
+        bits = jax.lax.bitcast_convert_type(score, jnp.uint64)
+        key = jnp.where(bits >> jnp.uint64(63) == 1, ~bits,
+                        bits | jnp.uint64(1 << 63))
+        return key, 8, jnp.uint64
+    bits = jax.lax.bitcast_convert_type(score.astype(jnp.float32),
+                                        jnp.uint32)
+    key = jnp.where(bits >> jnp.uint32(31) == 1, ~bits,
+                    bits | jnp.uint32(1 << 31))
+    return key, 4, jnp.uint32
+
+
+def _fill_by_score(key, levels, utype, cap, count):
+    """Exact greedy fill WITHOUT sorting: distribute ``count`` units over
+    nodes in descending-score order (ascending index among ties), each
+    node bounded by ``cap``.
+
+    The fill is monotone in score, so it is fully described by a threshold
+    key: nodes strictly above it take their whole capacity, nodes at it
+    split the remainder in index order.  The threshold is found by
+    radix-select — per 8-bit digit, a capacity histogram via a one-hot
+    matmul (MXU-friendly; no sort, no top_k, no scatter) and a 256-wide
+    scan.  Replaces the per-step ``lax.top_k`` over the full node axis,
+    which lowers to a full sort per scan step and dominated large-cluster
+    cycle latency.
+    """
+    n_bits = levels * 8
+    ar = jnp.arange(256)
+    prefix = jnp.zeros((), utype)
+    above = jnp.zeros((), cap.dtype)
+    for level in range(levels):
+        shift = n_bits - 8 * (level + 1)
+        digit = ((key >> utype(shift)) & utype(0xFF)).astype(jnp.int32)
+        if level == 0:
+            capw = cap
+        else:
+            in_prefix = (key >> utype(n_bits - 8 * level)) == prefix
+            capw = jnp.where(in_prefix, cap, 0.0)
+        onehot = (digit[:, None] == ar[None, :]).astype(cap.dtype)
+        hist = capw @ onehot                       # [256] capacity per digit
+        ge = jnp.cumsum(hist[::-1])[::-1]          # capacity(digit >= d)
+        gt = ge - hist                             # capacity(digit >  d)
+        need = count - above                       # invariant: need > 0
+        crossing = (gt < need) & (need <= ge)
+        # Unique crossing digit when total capacity suffices; else fall to
+        # digit 0 (everything ends up full-taken, clipped by cap).
+        d_star = jnp.where(crossing.any(), jnp.argmax(crossing),
+                           0).astype(jnp.int32)
+        above = above + gt[d_star]
+        prefix = (prefix << utype(8)) | d_star.astype(utype)
+    take_full = jnp.where(key > prefix, cap, 0.0)
+    eqcap = jnp.where(key == prefix, cap, 0.0)
+    rem = jnp.maximum(count - above, 0.0)
+    pref = jnp.cumsum(eqcap)
+    take_eq = jnp.clip(rem - (pref - eqcap), 0.0, eqcap)
+    # count <= 0 (gated/fully-satisfied): the no-crossing fallback above
+    # would otherwise full-take everything.
+    return jnp.where(count > 0, take_full + take_eq, 0.0)
 
 
 @functools.partial(jax.jit,
@@ -154,15 +229,7 @@ def allocate_groups_kernel(node_allocatable, node_idle, node_releasing,
         score = score_row(node_allocatable, idle, req, feasible, fit_now,
                           gpu_strategy, cpu_strategy)
         score = jnp.where(feasible, score, NEG)
-        # Top-K selection instead of a full sort: every feasible node has
-        # capacity >= 1 task (fit_now or fit_future implies one fits), so
-        # the K = max_group best-scoring nodes always carry enough capacity
-        # for a gang of <= max_group tasks — the fill can never reach rank
-        # K+1.  top_k is stable (ties -> lower index), matching the exact
-        # kernel's argmax tie-break.
-        k_sel = min(K, N)
-        _, order = jax.lax.top_k(score, k_sel)  # stable: ties -> low index
-        order = order.astype(jnp.int32)
+        key, levels, utype = _score_keys(score)
 
         safe_req = jnp.where(req > 0, req, 1.0)
         cap_now_f = jnp.min(jnp.where(req[None, :] > 0,
@@ -176,17 +243,14 @@ def allocate_groups_kernel(node_allocatable, node_idle, node_releasing,
         cap_now = jnp.clip(cap_now, 0.0, count)
         cap_tot = jnp.clip(cap_tot, 0.0, count)
 
-        cap_now_sorted = cap_now[order]
-        cap_tot_sorted = cap_tot[order]
-        pref_a = jnp.cumsum(cap_now_sorted)
-        take_a = jnp.clip(count - (pref_a - cap_now_sorted), 0.0,
-                          cap_now_sorted)
+        # Exact greedy fill, sort-free: phase A on idle capacity in score
+        # order, then phase B (pipelining) on the leftover releasing
+        # capacity in the same order.
+        take_a = _fill_by_score(key, levels, utype, cap_now, count)
         total_now = take_a.sum()
-        cap_b_sorted = cap_tot_sorted - take_a
+        cap_b = cap_tot - take_a
         remaining = jnp.maximum(count - total_now, 0.0)
-        pref_b = jnp.cumsum(cap_b_sorted)
-        take_b = jnp.clip(remaining - (pref_b - cap_b_sorted), 0.0,
-                          cap_b_sorted)
+        take_b = _fill_by_score(key, levels, utype, cap_b, remaining)
         if not (allow_pipeline or pipeline_only):
             take_b = jnp.zeros_like(take_b)
         placed = total_now + take_b.sum()
@@ -198,14 +262,12 @@ def allocate_groups_kernel(node_allocatable, node_idle, node_releasing,
             take_a = jnp.where(gang_ok, take_a, 0.0)
             take_b = jnp.where(gang_ok, take_b, 0.0)
 
-        n_now = jnp.zeros(N).at[order].set(take_a)
-        n_pipe = jnp.zeros(N).at[order].set(take_b)
-        idle = idle - n_now[:, None] * req[None, :]
-        rel = rel - n_pipe[:, None] * req[None, :]
-        room = room - n_now - n_pipe
+        idle = idle - take_a[:, None] * req[None, :]
+        rel = rel - take_b[:, None] * req[None, :]
+        room = room - take_a - take_b
 
-        nodes_a, counts_a = _compact(take_a, order, K)
-        nodes_b, counts_b = _compact(take_b, order, K)
+        nodes_a, counts_a = _compact(take_a, key, K)
+        nodes_b, counts_b = _compact(take_b, key, K)
         # Merge phases: A segments first, then B (pipelined) in the slots
         # after A's.
         a_used = (counts_a > 0).sum()

@@ -121,6 +121,16 @@ class PodAffinityPlugin(Plugin):
         """Can the term be satisfied/violated by the chunk itself?"""
         return any(term.matches(x.labels, x.namespace) for x in tasks)
 
+    def _selected_in_gang_affinity(self, tasks):
+        """The ONE in-gang required-affinity term the kernel enforces
+        dynamically (affinity_domains); deterministic first-by-task-order
+        so hard_masks and affinity_domains agree on which term that is."""
+        for task in tasks:
+            for t2 in getattr(task, "affinity_terms", []) or []:
+                if self._in_gang(t2, tasks):
+                    return t2
+        return None
+
     # -- hard masks (required terms vs EXISTING pods) ----------------------
     def hard_masks(self, tasks):
         has_own_terms = any(
@@ -138,11 +148,23 @@ class PodAffinityPlugin(Plugin):
         sym_repellers = [
             (labels, ns, idx, term)
             for labels, ns, idx, anti, _j in pods for term in anti]
+        selected = self._selected_in_gang_affinity(tasks)
         for i, task in enumerate(tasks):
             row = out[i]
             for term in getattr(task, "affinity_terms", []) or []:
-                if self._in_gang(term, tasks):
+                if selected is not None and _same_term(term, selected):
                     continue  # enforced in-kernel via affinity_domains
+                if self._in_gang(term, tasks):
+                    # A second distinct in-gang term: the kernel carries
+                    # only one, so enforce it statically against existing
+                    # pods with the first-pod bootstrap escape.
+                    mask = self._term_mask(term, pods)
+                    if not mask.any() and term.matches(task.labels,
+                                                       task.namespace):
+                        continue
+                    row &= mask
+                    touched = True
+                    continue
                 row &= self._term_mask(term, pods)
                 touched = True
             for term in getattr(task, "anti_affinity_terms", []) or []:
@@ -194,14 +216,7 @@ class PodAffinityPlugin(Plugin):
         must share a domain with a matching pod — pre-existing
         (static_ok), placed by this gang (kernel union), or themselves
         under the upstream first-pod bootstrap rule."""
-        term = None
-        for task in tasks:
-            for t2 in getattr(task, "affinity_terms", []) or []:
-                if self._in_gang(t2, tasks):
-                    term = t2
-                    break
-            if term is not None:
-                break
+        term = self._selected_in_gang_affinity(tasks)
         if term is None:
             return None
         dom, n_dom = self._domains(term.topology_key)

@@ -96,14 +96,15 @@ class UpstreamPredicatesPlugin(Plugin):
             return None
         n = self.ssn.node_idle.shape[0]
         out = np.ones((len(tasks), n), bool)
-        ports_by_node = None
+        port_masks = None
         for i, task in enumerate(tasks):
             if task.host_ports:
-                if ports_by_node is None:
-                    ports_by_node = self._ports_by_node()
-                for j in range(n):
-                    if ports_by_node[j] & task.host_ports:
-                        out[i, j] = False
+                if port_masks is None:
+                    port_masks = self._ports_by_node()
+                for port in task.host_ports:
+                    occupied = port_masks.get(port)
+                    if occupied is not None:
+                        out[i] &= ~occupied
             for pvc_name in task.pvc_names:
                 pvc = self.ssn.cluster.pvcs.get(
                     (task.namespace, pvc_name))
@@ -118,15 +119,16 @@ class UpstreamPredicatesPlugin(Plugin):
                     out[i] &= keep
         return out
 
-    def _ports_by_node(self) -> list[set]:
-        """Occupied (protocol, hostPort) pairs per node (nodeports.go:
-        Fits against NodeInfo.UsedPorts); memoized per session mutation
-        tick."""
+    def _ports_by_node(self) -> dict:
+        """(protocol, hostPort) -> [N] bool occupied-node mask
+        (nodeports.go: Fits against NodeInfo.UsedPorts), memoized per
+        session mutation tick.  Boolean rows keep the per-task mask a few
+        numpy ops instead of an O(N) Python scan."""
         tick = self.ssn.mutation_count
         if self._ports_cache[0] == tick:
             return self._ports_cache[1]
         n = self.ssn.node_idle.shape[0]
-        out = [set() for _ in range(n)]
+        out: dict = {}
         for pg in self.ssn.cluster.podgroups.values():
             for t in pg.pods.values():
                 if not t.host_ports or not t.node_name:
@@ -134,7 +136,12 @@ class UpstreamPredicatesPlugin(Plugin):
                 if not t.is_active_allocated():
                     continue
                 idx = self.ssn.node_index(t.node_name)
-                if idx >= 0:
-                    out[idx] |= t.host_ports
+                if idx < 0:
+                    continue
+                for port in t.host_ports:
+                    mask = out.get(port)
+                    if mask is None:
+                        mask = out[port] = np.zeros(n, bool)
+                    mask[idx] = True
         self._ports_cache = (tick, out)
         return out

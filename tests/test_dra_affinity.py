@@ -456,3 +456,43 @@ class TestAffinityNamespaceScoping:
             {"app": "db"}, "zone", namespaces=["default"])]
         run_action(ssn)
         assert placements(ssn)["mine-0"][0] == "n1"
+
+
+class TestSecondInGangAffinityTerm:
+    def test_second_distinct_term_still_enforced_statically(self):
+        """Only one in-gang affinity term runs in the kernel; any other
+        must still be enforced against existing pods."""
+        ssn = build_session({
+            "nodes": {"n1": {"gpu": 8, "labels": {"zone": "a"}},
+                      "n2": {"gpu": 8, "labels": {"zone": "b"}}},
+            "queues": {"q": {}},
+            "jobs": {
+                "banchor": {"queue": "q",
+                            "tasks": [{"gpu": 1, "status": "RUNNING",
+                                       "node": "n2",
+                                       "labels": {"app": "b"}}]},
+                "mix": {"queue": "q", "min_available": 2, "tasks": [
+                    # term 1 (selected): self-affine on app=grp.
+                    {"gpu": 1, "labels": {"app": "grp"},
+                     "affinity_terms": [{"selector": {"app": "grp"},
+                                         "topology_key": "zone"}]},
+                    # term 2: requires co-location with app=b (exists on
+                    # n2 only) AND matches a sibling (app=grp in-gang is
+                    # term 1's selector; this term's selector app=b also
+                    # matches banchor only — make it in-gang by labeling).
+                    {"gpu": 1, "labels": {"app": "grp", "tier": "b"},
+                     "affinity_terms": [
+                         {"selector": {"app": "grp"},
+                          "topology_key": "zone"},
+                         {"selector": {"app": "b"},
+                          "topology_key": "zone"}]},
+                ]}},
+        })
+        run_action(ssn)
+        p = placements(ssn)
+        # Both must land in zone b: mix-1's second term pins it to the
+        # banchor zone, and the selected self-affinity term drags mix-0
+        # along.
+        assert len(p) >= 2
+        assert p["mix-1"][0] == "n2"
+        assert p["mix-0"][0] == "n2"

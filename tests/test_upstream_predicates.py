@@ -202,3 +202,29 @@ class TestHostPathMaskEnforcement:
         assert p1["spec"].get("nodeName") == "mig1"
         assert not p1["metadata"].get("deletionTimestamp")
         assert not p2["spec"].get("nodeName")
+
+
+class TestInGangHostPorts:
+    def test_gang_members_with_same_port_spread_across_nodes(self):
+        task = {"cpu": "1", "host_ports": [8080]}
+        ssn = build_session({
+            "nodes": {"n1": {"gpu": 8}, "n2": {"gpu": 8}},
+            "queues": {"q": {}},
+            "jobs": {"svc": {"queue": "q", "min_available": 2,
+                             "tasks": [dict(task), dict(task)]}},
+        })
+        run_action(ssn)
+        p = placements(ssn)
+        assert len(p) == 2
+        assert p["svc-0"][0] != p["svc-1"][0]
+
+    def test_gang_fails_when_ports_exhaust_nodes(self):
+        task = {"cpu": "1", "host_ports": [8080]}
+        ssn = build_session({
+            "nodes": {"n1": {"gpu": 8}},
+            "queues": {"q": {}},
+            "jobs": {"svc": {"queue": "q", "min_available": 2,
+                             "tasks": [dict(task), dict(task)]}},
+        })
+        run_action(ssn)
+        assert placements(ssn) == {}
