@@ -225,13 +225,10 @@ def _execute_bulk(ssn, jobs):
             n = len(tasks)
             if success[j]:
                 stmt = ssn.statement()
-                for i, task in enumerate(tasks):
-                    node_name = ssn.snapshot.node_names[
-                        int(placements[ti + i])]
-                    if pipelined[ti + i]:
-                        stmt.pipeline(task, node_name)
-                    else:
-                        stmt.allocate(task, node_name)
+                stmt.apply_bulk(
+                    (task, ssn.snapshot.node_names[int(placements[ti + i])],
+                     bool(pipelined[ti + i]))
+                    for i, task in enumerate(tasks))
                 if ordered[j].should_pipeline():
                     stmt.convert_all_allocated_to_pipelined(ordered[j].uid)
                 stmt.commit()
@@ -366,11 +363,9 @@ def _allocate_tasks_on_subset(ssn, stmt, job, tasks, node_subset,
         if not proposal.success:
             _record_chunk_failure(ssn, job, tasks)
             return False
-        for task, node_name, pipelined in proposal.placements:
-            if pipelined or pipeline_only:
-                stmt.pipeline(task, node_name)
-            else:
-                stmt.allocate(task, node_name)
+        stmt.apply_bulk(
+            (task, node_name, bool(pipelined or pipeline_only))
+            for task, node_name, pipelined in proposal.placements)
         ok = True
     if not ok:
         return False

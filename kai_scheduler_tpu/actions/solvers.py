@@ -124,13 +124,25 @@ def solve_job(ssn, pending_job: PodGroupInfo,
     ssn.on_job_solution_start()
 
     builder = ScenarioBuilder(pending_job, tasks, ordered_victims)
+    # Batched pre-screen: one device call scores every victim prefix's
+    # pipeline feasibility for the pending job; prefixes that cannot host
+    # it are skipped without paying a per-scenario simulation round trip
+    # (SURVEY §7.6 — worst-case reclaim latency was scenario-count-bound).
+    prescreen = _prefix_prescreen(ssn, tasks, builder)
     tried = 0
+    step_idx = 0
     # One statement across scenarios: evictions accumulate incrementally
     # (by_pod_solver keeps recorded victims evicted and rolls back only
     # the allocation attempt); the attempt itself is checkpointed.
     stmt = ssn.statement()
     while builder.has_next() and tried < ssn.config.max_scenarios_per_job:
         scenario = builder.next_scenario()
+        step_idx += 1
+        if (prescreen is not None and step_idx <= len(prescreen)
+                and not prescreen[step_idx - 1]):
+            # The pending job cannot place even with this whole prefix
+            # released; simulating would fail identically.
+            continue
         # Validators depend only on the scenario's composition (victim
         # resources vs queue shares, min-runtimes) — check them BEFORE
         # paying for placement simulation.  Cheap validation rejections do
@@ -155,6 +167,65 @@ def solve_job(ssn, pending_job: PodGroupInfo,
         stmt.rollback(cp)
     stmt.discard()
     return SolverResult(False, scenarios_tried=tried)
+
+
+def _prefix_prescreen(ssn, tasks, builder: "ScenarioBuilder"):
+    """[S] bool per victim-prefix step, from ONE batched kernel call —
+    or None when the pending job needs state the batch cannot model.
+
+    Soundness: a False must mean the sequential simulation would also
+    fail.  That holds only when the pending job's feasibility depends
+    solely on capacity (evictions can then only ADD releasing capacity):
+    host-state tasks (fractional/MIG/DRA) and any hard-mask / in-gang
+    domain contribution disqualify, because eviction order could change
+    those (conservatively: masks only relax after evictions, but a
+    current-state mask may be stricter than a post-eviction one — we must
+    not over-prune).
+    """
+    steps = builder._steps
+    cap = ssn.config.scenario_prescreen_max
+    if cap <= 0 or len(steps) < 3:
+        return None
+    if any(t.is_fractional or t.resource_claims or t.res_req.mig_resources
+           for t in tasks):
+        return None
+    if ssn.compute_hard_mask(tasks) is not None:
+        return None
+    for fn in ssn.anti_domain_fns + ssn.affinity_domain_fns:
+        if fn(tasks) is not None:
+            return None
+
+    import jax.numpy as jnp
+
+    from ..ops.scenario_batch import batch_prefix_feasibility
+
+    snap = ssn.snapshot
+    n = ssn.node_idle.shape[0]
+    steps = steps[:cap]
+    deltas = np.zeros((len(steps), n, snap.node_releasing.shape[1]))
+    for k, (_victim, vtasks) in enumerate(steps):
+        for t in vtasks:
+            idx = ssn.node_index(t.node_name)
+            if idx >= 0:
+                deltas[k, idx] += t.res_req.to_vec(mig_as_gpu=False)
+    prefix_rel = ssn.node_releasing[None, :, :] + np.cumsum(deltas, axis=0)
+
+    rows = [ssn._task_row(t) for t in tasks]
+    if any(r[0] is None for r in rows):
+        return None
+    task_req = np.stack([r[0] for r in rows])
+    task_sel = np.stack([r[1] for r in rows])
+    task_tol = np.stack([r[2] for r in rows])
+    task_job = np.zeros(len(tasks), np.int32)
+
+    alloc, idle, _rel, labels, taints, room = ssn._device_arrays()
+    feasible = batch_prefix_feasibility(
+        alloc, idle, labels, taints,
+        jnp.asarray(prefix_rel), room,
+        jnp.asarray(task_req), jnp.asarray(task_job),
+        jnp.asarray(task_sel), jnp.asarray(task_tol),
+        gpu_strategy=ssn.gpu_strategy, cpu_strategy=ssn.cpu_strategy)
+    return np.asarray(feasible)
 
 
 def _unevicted_tasks(scenario: Scenario, stmt) -> list:

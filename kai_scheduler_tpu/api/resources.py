@@ -180,6 +180,16 @@ class ResourceRequirements:
         inventory on the node (resource_info.go:153-165 scalarResources),
         not draws from its whole-GPU pool.
         """
+        # Memoized: requirements are de-facto immutable after parse, and
+        # the host pipeline evaluates this vector ~5x per task per cycle
+        # (statement accounting, queue roll-ups, pre-predicates).  The
+        # cached array is read-only: arithmetic copies, in-place writes
+        # (which would corrupt every consumer) raise.
+        cache_key = (float(node_gpu_memory), mig_as_gpu)
+        cache = self.__dict__.setdefault("_vec_cache", {})
+        cached = cache.get(cache_key)
+        if cached is not None:
+            return cached
         v = self.base.copy()
         if self.gpu_fraction > 0.0:
             v[RES_GPU] = self.gpu_fraction * self.num_fraction_devices
@@ -193,6 +203,8 @@ class ResourceRequirements:
             for profile, count in self.mig_resources.items():
                 slices, _mem = parse_mig_profile(profile)
                 v[RES_GPU] += slices * count
+        v.setflags(write=False)
+        cache[cache_key] = v
         return v
 
     @classmethod

@@ -50,6 +50,10 @@ class SchedulerConfig:
     # metric scenarios_simulation_by_action tracks actual usage).
     max_scenarios_per_job: int = 16
     max_victims_considered: int = 32
+    # Batched scenario pre-screen: score up to this many victim prefixes
+    # in ONE device call before simulating (ops/scenario_batch.py); 0
+    # disables.
+    scenario_prescreen_max: int = 64
     # Scheduling-signature dedup of provably unschedulable jobs.
     use_scheduling_signatures: bool = True
     # Node-axis padding bucket to stabilize kernel shapes across cycles.
@@ -104,7 +108,8 @@ class SchedulerConfig:
                     "default_staleness_grace_seconds",
                     "saturation_multiplier", "use_scheduling_signatures",
                     "node_pad_bucket", "bulk_allocation_threshold",
-                    "max_scenarios_per_job", "max_victims_considered"):
+                    "max_scenarios_per_job", "max_victims_considered",
+                    "scenario_prescreen_max"):
             if key in d:
                 setattr(config, key, d[key])
         if "queue_depth_per_action" in d:

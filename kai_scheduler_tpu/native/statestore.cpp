@@ -135,9 +135,14 @@ StateStore* ss_clone(StateStore* s) {
 }
 
 void ss_restore(StateStore* s, const StateStore* checkpoint) {
-  s->used = checkpoint->used;
-  s->releasing = checkpoint->releasing;
-  s->room = checkpoint->room;
+  // memcpy into the existing storage: Python holds zero-copy numpy views
+  // over these buffers, so their addresses must never change.
+  std::memcpy(s->used.data(), checkpoint->used.data(),
+              s->used.size() * sizeof(double));
+  std::memcpy(s->releasing.data(), checkpoint->releasing.data(),
+              s->releasing.size() * sizeof(double));
+  std::memcpy(s->room.data(), checkpoint->room.data(),
+              s->room.size() * sizeof(double));
 }
 
 }  // extern "C"

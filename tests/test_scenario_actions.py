@@ -225,3 +225,57 @@ class TestStaleGangEviction:
         })
         run_action(ssn, "stalegangeviction")
         assert ssn.cache.evicted == []
+
+
+class TestBatchedPrescreen:
+    def test_prescreen_skips_infeasible_prefixes(self):
+        """With many small victims, the batched pre-screen must skip the
+        prefixes that cannot host the reclaimer — visible as fewer
+        simulated scenarios than victim steps."""
+        from kai_scheduler_tpu.utils.metrics import METRICS
+        # 8 single-GPU victims in over-quota queue b; reclaimer needs 4
+        # GPUs, so prefixes 1..3 are infeasible and must not simulate.
+        jobs = {
+            f"v{i}": {"queue": "b", "tasks": [
+                {"gpu": 1, "status": "RUNNING", "node": "n1"}]}
+            for i in range(8)}
+        jobs["claimer"] = {"queue": "a", "tasks": [{"gpu": 4}]}
+        ssn = build_session({
+            "nodes": {"n1": {"gpu": 8}},
+            "queues": {"a": {"deserved": {"gpu": 4}},
+                       "b": {"deserved": {"gpu": 4}}},
+            "jobs": jobs,
+        })
+        key = 'scenarios_simulation_by_action{action="reclaim"}'
+        before = METRICS.counters.get(key, 0)
+        run_action(ssn, "reclaim")
+        after = METRICS.counters.get(key, 0)
+        p = placements(ssn)
+        assert p["claimer-0"][0] == "n1"
+        evicted = [uid for uid, (node, status) in p.items()
+                   if status == "RELEASING"]
+        assert len(evicted) == 4
+        # Exactly one simulated scenario: the first feasible prefix (4
+        # victims); the three short prefixes were pre-screened away.
+        assert after - before == 1
+
+    def test_prescreen_disabled_matches(self):
+        """Soundness guard: results identical with prescreen off."""
+        from kai_scheduler_tpu.framework import SchedulerConfig
+        spec = {
+            "nodes": {"n1": {"gpu": 8}},
+            "queues": {"a": {"deserved": {"gpu": 4}},
+                       "b": {"deserved": {"gpu": 4}}},
+            "jobs": {
+                **{f"v{i}": {"queue": "b", "tasks": [
+                    {"gpu": 1, "status": "RUNNING", "node": "n1"}]}
+                   for i in range(6)},
+                "claimer": {"queue": "a", "tasks": [{"gpu": 3}]},
+            },
+        }
+        on = build_session(spec)
+        run_action(on, "reclaim")
+        cfg = SchedulerConfig(scenario_prescreen_max=0)
+        off = build_session(spec, cfg)
+        run_action(off, "reclaim")
+        assert placements(on) == placements(off)
