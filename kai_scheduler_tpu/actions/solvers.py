@@ -23,6 +23,7 @@ import numpy as np
 
 from ..api.podgroup_info import PodGroupInfo
 from ..utils.metrics import METRICS
+from ..ops.allocate_grouped import _next_pow2
 from .allocate import attempt_to_allocate_job
 
 
@@ -189,6 +190,12 @@ def _prefix_prescreen(ssn, tasks, builder: "ScenarioBuilder"):
     if any(t.is_fractional or t.resource_claims or t.res_req.mig_resources
            for t in tasks):
         return None
+    # Fractional VICTIMS release whole devices when their sharing group
+    # empties (node_info._sync_group_releasing) — more than their
+    # request vector — so the vector model would undercount and
+    # unsoundly skip feasible prefixes.
+    if any(t.is_fractional for _v, vtasks in steps for t in vtasks):
+        return None
     if ssn.compute_hard_mask(tasks) is not None:
         return None
     for fn in ssn.anti_domain_fns + ssn.affinity_domain_fns:
@@ -199,33 +206,55 @@ def _prefix_prescreen(ssn, tasks, builder: "ScenarioBuilder"):
 
     from ..ops.scenario_batch import batch_prefix_feasibility
 
-    snap = ssn.snapshot
-    n = ssn.node_idle.shape[0]
     steps = steps[:cap]
-    deltas = np.zeros((len(steps), n, snap.node_releasing.shape[1]))
+    # Sparse victim-release rows; padding (step index == num_prefixes)
+    # drops in the device-side scatter.  Pow2 buckets keep the jit cache
+    # small across (prefixes, rows, tasks) shapes.
+    rows_step, rows_node, rows_vec = [], [], []
     for k, (_victim, vtasks) in enumerate(steps):
         for t in vtasks:
             idx = ssn.node_index(t.node_name)
             if idx >= 0:
-                deltas[k, idx] += t.res_req.to_vec(mig_as_gpu=False)
-    prefix_rel = ssn.node_releasing[None, :, :] + np.cumsum(deltas, axis=0)
+                rows_step.append(k)
+                rows_node.append(idx)
+                rows_vec.append(t.res_req.to_vec(mig_as_gpu=False))
+    if not rows_vec:
+        return None
+    num_prefixes = _next_pow2(len(steps))
+    m_pad = _next_pow2(len(rows_vec))
+    n_res = ssn.node_releasing.shape[1]
+    release_step = np.full(m_pad, num_prefixes, np.int32)
+    release_step[:len(rows_step)] = rows_step
+    release_node = np.zeros(m_pad, np.int32)
+    release_node[:len(rows_node)] = rows_node
+    release_vec = np.zeros((m_pad, n_res))
+    release_vec[:len(rows_vec)] = rows_vec
 
     rows = [ssn._task_row(t) for t in tasks]
     if any(r[0] is None for r in rows):
         return None
-    task_req = np.stack([r[0] for r in rows])
-    task_sel = np.stack([r[1] for r in rows])
-    task_tol = np.stack([r[2] for r in rows])
-    task_job = np.zeros(len(tasks), np.int32)
+    t_pad = _next_pow2(len(tasks))
+    task_req = np.zeros((t_pad, n_res))
+    task_req[:len(rows)] = [r[0] for r in rows]
+    task_sel = np.full((t_pad, rows[0][1].shape[0]), -1, np.int32)
+    task_sel[:len(rows)] = [r[1] for r in rows]
+    task_tol = np.full((t_pad, rows[0][2].shape[0]), -1, np.int32)
+    task_tol[:len(rows)] = [r[2] for r in rows]
+    # Padding rows form their own job 1 so they can never fail job 0's
+    # gang (a zero-req row could still miss on pod room).
+    task_job = np.zeros(t_pad, np.int32)
+    task_job[len(rows):] = 1
 
-    alloc, idle, _rel, labels, taints, room = ssn._device_arrays()
+    alloc, idle, rel, labels, taints, room = ssn._device_arrays()
     feasible = batch_prefix_feasibility(
-        alloc, idle, labels, taints,
-        jnp.asarray(prefix_rel), room,
+        alloc, idle, rel, labels, taints, room,
+        jnp.asarray(release_step), jnp.asarray(release_node),
+        jnp.asarray(release_vec),
         jnp.asarray(task_req), jnp.asarray(task_job),
         jnp.asarray(task_sel), jnp.asarray(task_tol),
+        num_prefixes=num_prefixes,
         gpu_strategy=ssn.gpu_strategy, cpu_strategy=ssn.cpu_strategy)
-    return np.asarray(feasible)
+    return np.asarray(feasible)[:len(steps)]
 
 
 def _unevicted_tasks(scenario: Scenario, stmt) -> list:

@@ -1,0 +1,216 @@
+"""Operator operands: render the fleet's deployment manifests.
+
+Mirrors pkg/operator/operands/ (deployable/interface.go — each service
+contributes the Kubernetes objects that run it) for the TPU-native fleet:
+given a ``SystemConfig``-shaped values dict, produce Deployments,
+Services, ServiceAccounts, RBAC, the admission webhook configuration, and
+a default SchedulingShard — the in-cluster half of ``operator.py``'s
+System assembly.  The Helm chart (deployments/kai-scheduler-tpu) installs
+only the operator + CRDs; the operator renders these operands at
+reconcile time, exactly like the reference.
+
+Webhook TLS follows pkg/operator's cert management: a self-signed CA +
+serving certificate minted locally (openssl when present) and published
+as a Secret with the CA bundle patched into the webhook configuration.
+"""
+
+from __future__ import annotations
+
+import base64
+import subprocess
+import tempfile
+from pathlib import Path
+
+NAMESPACE = "kai-scheduler"
+SERVICES = ("apiserver", "scheduler", "controllers", "admission")
+
+
+def _meta(name: str, labels: dict | None = None) -> dict:
+    return {"name": name, "namespace": NAMESPACE,
+            "labels": {"app.kubernetes.io/part-of": "kai-scheduler-tpu",
+                       "app": name, **(labels or {})}}
+
+
+def _deployment(name: str, image: str, args: list, replicas: int = 1,
+                ports: list | None = None) -> dict:
+    container = {"name": name, "image": image,
+                 "command": ["python", "-m", f"kai_scheduler_tpu.{name}"]
+                 if name != "apiserver"
+                 else ["python", "-m",
+                       "kai_scheduler_tpu.controllers.apiserver"],
+                 "args": args}
+    if ports:
+        container["ports"] = [{"containerPort": p} for p in ports]
+    return {"apiVersion": "apps/v1", "kind": "Deployment",
+            "metadata": _meta(f"kai-{name}"),
+            "spec": {"replicas": replicas,
+                     "selector": {"matchLabels": {"app": f"kai-{name}"}},
+                     "template": {
+                         "metadata": {"labels": {"app": f"kai-{name}"}},
+                         "spec": {"serviceAccountName": f"kai-{name}",
+                                  "containers": [container]}}}}
+
+
+def _service(name: str, port: int) -> dict:
+    return {"apiVersion": "v1", "kind": "Service",
+            "metadata": _meta(f"kai-{name}"),
+            "spec": {"selector": {"app": f"kai-{name}"},
+                     "ports": [{"port": port, "targetPort": port}]}}
+
+
+def render_operands(values: dict | None = None) -> list[dict]:
+    """The full operand set for one installation.
+
+    values: {"image": ..., "replicas": {...}, "leaderElection": bool,
+    "shards": [{"name", "nodePoolLabelKey", "nodePoolLabelValue"}]}.
+    """
+    v = dict(values or {})
+    image = v.get("image", "kai-scheduler-tpu:latest")
+    replicas = v.get("replicas", {})
+    leader = bool(v.get("leaderElection", False))
+    api_url = f"http://kai-apiserver.{NAMESPACE}.svc:8443"
+
+    out: list[dict] = [{"apiVersion": "v1", "kind": "Namespace",
+                        "metadata": {"name": NAMESPACE}}]
+    for svc in SERVICES:
+        out.append({"apiVersion": "v1", "kind": "ServiceAccount",
+                    "metadata": _meta(f"kai-{svc}")})
+
+    out.append(_deployment("apiserver", image,
+                           ["--port", "8443", "--host", "0.0.0.0"],
+                           ports=[8443]))
+    out.append(_service("apiserver", 8443))
+
+    sched_args = ["--api-server", api_url, "--http-port", "8080"]
+    if leader:
+        sched_args.append("--leader-elect")
+    out.append(_deployment(
+        "scheduler", image, sched_args,
+        replicas=int(replicas.get("scheduler", 2 if leader else 1)),
+        ports=[8080]))
+    out.append(_service("scheduler", 8080))
+
+    out.append(_deployment(
+        "controllers", image,
+        ["--api-server", api_url, "--controllers-only"],
+        replicas=int(replicas.get("controllers", 1))))
+
+    out.append(_deployment("admission", image,
+                           ["--api-server", api_url, "--webhook-port",
+                            "9443"], ports=[9443]))
+    out.append(_service("admission", 9443))
+    out.append({
+        "apiVersion": "admissionregistration.k8s.io/v1",
+        "kind": "MutatingWebhookConfiguration",
+        "metadata": {"name": "kai-admission"},
+        "webhooks": [{
+            "name": "pods.kai.scheduler",
+            "admissionReviewVersions": ["v1"],
+            "sideEffects": "None",
+            "clientConfig": {
+                "service": {"name": "kai-admission",
+                            "namespace": NAMESPACE, "path": "/mutate",
+                            "port": 9443},
+                "caBundle": ""},  # patched by reconcile_webhook_cert
+            "rules": [{"apiGroups": [""], "apiVersions": ["v1"],
+                       "operations": ["CREATE"], "resources": ["pods"]}],
+        }]})
+
+    # RBAC: the scheduler/controllers read+write the scheduling objects.
+    out.append({
+        "apiVersion": "rbac.authorization.k8s.io/v1",
+        "kind": "ClusterRole", "metadata": {"name": "kai-scheduler-tpu"},
+        "rules": [
+            {"apiGroups": ["", "kai.scheduler", "scheduling.kai",
+                           "coordination.k8s.io"],
+             "resources": ["pods", "nodes", "queues", "podgroups",
+                           "bindrequests", "schedulingshards",
+                           "topologies", "configmaps",
+                           "persistentvolumeclaims", "leases", "events"],
+             "verbs": ["get", "list", "watch", "create", "update",
+                       "patch", "delete"]}]})
+    out.append({
+        "apiVersion": "rbac.authorization.k8s.io/v1",
+        "kind": "ClusterRoleBinding",
+        "metadata": {"name": "kai-scheduler-tpu"},
+        "roleRef": {"apiGroup": "rbac.authorization.k8s.io",
+                    "kind": "ClusterRole", "name": "kai-scheduler-tpu"},
+        "subjects": [{"kind": "ServiceAccount", "name": f"kai-{svc}",
+                      "namespace": NAMESPACE} for svc in SERVICES]})
+
+    # Default shard: the operator's SchedulingShard seed
+    # (deployments/.../default-shard.yaml analog).
+    shards = v.get("shards") or [{"name": "default"}]
+    for shard in shards:
+        out.append({"apiVersion": "kai.scheduler/v1",
+                    "kind": "SchedulingShard",
+                    "metadata": {"name": shard.get("name", "default")},
+                    "spec": {
+                        "nodePoolLabelKey": shard.get("nodePoolLabelKey"),
+                        "nodePoolLabelValue": shard.get(
+                            "nodePoolLabelValue"),
+                        "args": shard.get("args", {})}})
+    return out
+
+
+def generate_webhook_cert(service: str = "kai-admission",
+                          namespace: str = NAMESPACE) -> dict | None:
+    """Self-signed CA + serving cert for the admission webhook
+    (pkg/operator cert management analog).  Returns
+    {"ca.crt", "tls.crt", "tls.key"} base64-encoded, or None when no
+    openssl toolchain is available (callers fall back to an external
+    cert-manager)."""
+    cn = f"{service}.{namespace}.svc"
+    try:
+        with tempfile.TemporaryDirectory() as tmp:
+            tmp = Path(tmp)
+            subprocess.run(
+                ["openssl", "req", "-x509", "-newkey", "rsa:2048",
+                 "-nodes", "-days", "3650", "-subj", f"/CN={cn}",
+                 "-addext", f"subjectAltName=DNS:{cn}",
+                 "-keyout", str(tmp / "tls.key"),
+                 "-out", str(tmp / "tls.crt")],
+                check=True, capture_output=True, timeout=60)
+            key = (tmp / "tls.key").read_bytes()
+            crt = (tmp / "tls.crt").read_bytes()
+    except (OSError, subprocess.SubprocessError):
+        return None
+    b64 = lambda b: base64.b64encode(b).decode()
+    return {"ca.crt": b64(crt), "tls.crt": b64(crt), "tls.key": b64(key)}
+
+
+def reconcile_webhook_cert(api, operands: list[dict]) -> None:
+    """Mint (or reuse) the webhook Secret and patch the CA bundle into the
+    MutatingWebhookConfiguration — the reconcile-time half of cert
+    management."""
+    existing = api.get_opt("Secret", "kai-admission-tls", NAMESPACE)
+    if existing is not None:
+        cert = existing["data"]
+    else:
+        cert = generate_webhook_cert()
+        if cert is None:
+            return
+        api.create({"kind": "Secret",
+                    "metadata": {"name": "kai-admission-tls",
+                                 "namespace": NAMESPACE},
+                    "type": "kubernetes.io/tls", "data": cert})
+    for obj in operands:
+        if obj["kind"] == "MutatingWebhookConfiguration":
+            for hook in obj["webhooks"]:
+                hook["clientConfig"]["caBundle"] = cert["ca.crt"]
+
+
+def apply_operands(api, values: dict | None = None) -> list[dict]:
+    """Create-or-update every operand through a kube API (in-memory or
+    HTTP) — what the in-cluster operator runs each reconcile."""
+    operands = render_operands(values)
+    reconcile_webhook_cert(api, operands)
+    for obj in operands:
+        ns = obj["metadata"].get("namespace", "default")
+        existing = api.get_opt(obj["kind"], obj["metadata"]["name"], ns)
+        if existing is None:
+            api.create(obj)
+        elif existing.get("spec") != obj.get("spec"):
+            api.patch(obj["kind"], obj["metadata"]["name"],
+                      {"spec": obj.get("spec")}, ns)
+    return operands

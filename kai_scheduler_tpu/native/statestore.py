@@ -36,6 +36,7 @@ class NativeNodeTable:
         self.n_res = n_res
         self._handle = ctypes.c_void_p(self._lib.ss_create(n_nodes, n_res))
         self._checkpoints: list = []
+        self._views: dict = {}
 
     def __del__(self):
         lib = getattr(self, "_lib", None)
@@ -79,10 +80,8 @@ class NativeNodeTable:
         return buf.reshape(shape)
 
     def _cached_view(self, name: str, fn_name: str, shape):
-        view = self._views.get(name) if hasattr(self, "_views") else None
+        view = self._views.get(name)
         if view is None:
-            if not hasattr(self, "_views"):
-                self._views = {}
             ptr = getattr(self._lib, fn_name)(self._handle)
             view = self._views[name] = self._view(ptr, shape)
         return view

@@ -29,27 +29,39 @@ from .scoring import BINPACK
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("gpu_strategy", "cpu_strategy"))
-def batch_prefix_feasibility(node_allocatable, node_idle, node_labels,
-                             node_taints, prefix_releasing, node_room,
+                   static_argnames=("num_prefixes", "gpu_strategy",
+                                    "cpu_strategy"))
+def batch_prefix_feasibility(node_allocatable, node_idle, node_releasing,
+                             node_labels, node_taints, node_room,
+                             release_step, release_node, release_vec,
                              task_req, task_job, task_selector,
-                             task_tolerations, task_node_mask=None,
+                             task_tolerations, num_prefixes: int,
+                             task_node_mask=None,
                              gpu_strategy: int = BINPACK,
                              cpu_strategy: int = BINPACK) -> jnp.ndarray:
-    """[K] bool: can the pending job pipeline onto each prefix's released
-    resources?
+    """[num_prefixes] bool: can the pending job pipeline onto each
+    prefix's released resources?
 
-    prefix_releasing: [K,N,R] releasing pool per prefix (live releasing +
-    cumulative victim releases).  node_room: [N] — prefix-invariant, since
-    evicted pods stay on their node as Releasing; broadcast, not tiled.
-    Static node tables (allocatable/labels/taints) and the pending job's
-    task rows are shared across the batch.
+    Victim releases arrive SPARSE — (release_step [M], release_node [M],
+    release_vec [M,R]) rows, padded with step >= num_prefixes — and the
+    dense per-prefix releasing pools materialize on device (scatter-add +
+    cumulative sum over the prefix axis), so the host->device transfer is
+    O(victim tasks), never O(prefixes x nodes).  node_room is
+    prefix-invariant (evicted pods stay on their node as Releasing).
     """
-    job_allowed = jnp.ones(1, bool)
+    n = node_allocatable.shape[0]
+    r = node_releasing.shape[1]
+    delta = jnp.zeros((num_prefixes, n, r), node_releasing.dtype)
+    delta = delta.at[release_step, release_node].add(release_vec,
+                                                     mode="drop")
+    prefix_rel = node_releasing[None, :, :] + jnp.cumsum(delta, axis=0)
+    # Job 1 holds the caller's padding task rows (their success is never
+    # read); job 0 is the pending job.
+    job_allowed = jnp.ones(2, bool)
 
-    def one(prefix_rel):
+    def one(prefix):
         result = allocate_jobs_kernel(
-            node_allocatable, node_idle, prefix_rel, node_labels,
+            node_allocatable, node_idle, prefix, node_labels,
             node_taints, node_room, task_req, task_job, task_selector,
             task_tolerations, job_allowed,
             task_node_mask=task_node_mask,
@@ -57,4 +69,4 @@ def batch_prefix_feasibility(node_allocatable, node_idle, node_labels,
             pipeline_only=True)
         return result.job_success[0]
 
-    return jax.vmap(one)(prefix_releasing)
+    return jax.vmap(one)(prefix_rel)

@@ -6,17 +6,18 @@ Combines the two scaling ideas of this framework:
 - parallel/sharded.py: the node axis sharded across chips with ICI
   collectives replacing global reductions.
 
-Per group, each shard computes its local candidate top-K; an all_gather
-merges the (score, global index, capacities) triples and a second top-K —
-stable, so ties keep global-index order — yields the global candidate set,
-on which the two-phase fill plan computes REPLICATED take amounts; each
-shard then scatters the takes it owns into its local node state.  The
-gathered working set is [devices x K], independent of cluster size: the
-per-group cost stays flat as nodes scale out across chips.
+Per group, the fill threshold comes from the same sort-free radix
+select as the single-chip kernel, with per-shard capacity histograms
+psum-merged over ICI — every shard derives the identical replicated
+threshold and computes its own local takes directly; threshold-equal
+marginal nodes resolve in ascending GLOBAL index order through a
+cross-shard exclusive prefix.  Only the compacted fill segments (at most
+max_group per phase, gathered as [devices x K]) ever cross shards, so
+the per-group communication cost is flat in cluster size.
 
 Exactness matches allocate_grouped (and therefore the per-task kernel):
-every feasible node carries >= 1 task of capacity, so K = max_group
-candidates suffice.
+takes are integral and bounded by the gang size, so K = max_group
+segment slots suffice per shard and globally.
 """
 
 from __future__ import annotations
@@ -141,7 +142,6 @@ def sharded_allocate_groups_kernel(mesh, node_allocatable, node_idle,
         n_local = alloc.shape[0]
         my_dev = jax.lax.axis_index(NODE_AXIS)
         offset = my_dev * n_local
-        k_local = min(K, n_local)
 
         class Carry(NamedTuple):
             idle: jnp.ndarray

@@ -1,0 +1,88 @@
+"""Automated scale ring: the KWOK-suite analog as a recorded test suite.
+
+Mirrors the reference's scale tests (test/e2e/scale/kwok_test.go:128-520,
+docs/scale-tests/README.md:27-34): each scenario from tools/scale_gen runs
+against a synthetic cluster, asserts a placement-correctness floor AND a
+duration ceiling, and appends its measured numbers to
+``docs/scale-tests/results.jsonl`` so per-commit history accumulates.
+
+Sizes are chosen to keep the whole ring under ~a minute on CPU CI; the
+standalone harness (``python -m kai_scheduler_tpu.tools.scale_gen``)
+runs the same scenarios at arbitrary scale.
+"""
+
+import json
+import pathlib
+import subprocess
+import time
+
+import pytest
+
+from kai_scheduler_tpu.tools import scale_gen
+
+RESULTS = pathlib.Path(__file__).resolve().parent.parent / \
+    "docs" / "scale-tests" / "results.jsonl"
+
+N_NODES = 400
+# Generous CPU ceilings (the TPU path is benchmarked separately); the
+# point is catching order-of-magnitude regressions per commit.
+CEILINGS_S = {"fill": 60.0, "whole-gpu": 30.0, "distributed": 30.0,
+              "burst": 90.0, "reclaim": 60.0, "system-fill": 60.0}
+
+
+def _record(result: dict) -> None:
+    RESULTS.parent.mkdir(parents=True, exist_ok=True)
+    commit = ""
+    try:
+        commit = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], capture_output=True,
+            text=True, timeout=10).stdout.strip()
+    except Exception:
+        pass
+    entry = {"commit": commit, "recorded_at": time.time(), **result}
+    with RESULTS.open("a") as f:
+        f.write(json.dumps(entry) + "\n")
+
+
+@pytest.mark.scale
+class TestScaleRing:
+    def test_fill(self):
+        r = scale_gen.run_scenario("fill", N_NODES)
+        _record(r)
+        # Every whole-GPU slot fillable: 400 nodes x 8 GPUs.
+        assert r["pods_bound"] == N_NODES * 8
+        assert r["first_cycle_s"] < CEILINGS_S["fill"]
+
+    def test_whole_gpu(self):
+        r = scale_gen.run_scenario("whole-gpu", N_NODES)
+        _record(r)
+        assert r["pods_bound"] == N_NODES
+        assert r["first_cycle_s"] < CEILINGS_S["whole-gpu"]
+
+    def test_distributed_gangs(self):
+        r = scale_gen.run_scenario("distributed", N_NODES)
+        _record(r)
+        # n/4 gangs x 4 members, each member 8 GPUs = full cluster.
+        assert r["pods_bound"] == N_NODES
+        assert r["first_cycle_s"] < CEILINGS_S["distributed"]
+
+    def test_burst_over_capacity(self):
+        r = scale_gen.run_scenario("burst", N_NODES)
+        _record(r)
+        # 2x demand: exactly capacity binds, the rest stays pending.
+        assert r["pods_bound"] == N_NODES * 8
+        assert r["first_cycle_s"] < CEILINGS_S["burst"]
+
+    def test_reclaim_latency(self):
+        r = scale_gen.run_scenario("reclaim", N_NODES)
+        _record(r)
+        assert r["pods_bound"] == N_NODES * 8
+        # The starved queue must actually reclaim.
+        assert r["evictions"] > 0
+        assert r["reclaim_cycle_s"] < CEILINGS_S["reclaim"]
+
+    def test_system_fill_fleet(self):
+        r = scale_gen.run_system_scenario(200, 400)
+        _record(r)
+        assert r["pods_bound"] == 400
+        assert r["cycle_s"] < CEILINGS_S["system-fill"]
