@@ -90,15 +90,36 @@ class ClusterInfo:
         gpu-memory tasks charge device fractions against their node's
         per-GPU memory — the same normalization queue_requested uses, so
         the two aggregates stay comparable."""
-        out = {qid: rs.zeros() for qid in self.queues}
+        return self.queue_aggregates()[0]
+
+    def queue_aggregates(self) -> tuple[dict, dict]:
+        """(allocated, requested) in ONE pod walk — at 100k-node scale the
+        walk itself dominates, so callers needing both (snapshot.pack)
+        must not pay it twice.  Memoized until the next snapshot build
+        (ClusterInfo is immutable between Statement transactions, which
+        operate on the packed mirrors, not these aggregates)."""
+        cached = getattr(self, "_queue_aggregates", None)
+        if cached is not None:
+            return cached
+        min_gpu_mem = self.min_node_gpu_memory()
+        allocated = {qid: rs.zeros() for qid in self.queues}
+        requested = {qid: rs.zeros() for qid in self.queues}
         for pg in self.podgroups.values():
-            if pg.queue_id not in out:
+            qid = pg.queue_id
+            if qid not in allocated:
                 continue
             for t in pg.pods.values():
                 if t.is_active_allocated():
-                    out[pg.queue_id] += t.req_vec(
+                    allocated[qid] += t.req_vec(
                         self.task_gpu_memory_context(t))
-        return out
+                    # Request keeps the min-node normalization for every
+                    # alive task (proportion.go's Request roll-up), so the
+                    # refactor is behavior-preserving.
+                    requested[qid] += t.req_vec(min_gpu_mem)
+                elif t.status == PodStatus.PENDING:
+                    requested[qid] += t.req_vec(min_gpu_mem)
+        self._queue_aggregates = (allocated, requested)
+        return self._queue_aggregates
 
     def min_node_gpu_memory(self) -> float:
         """Smallest per-GPU memory across nodes that report one — the
@@ -115,15 +136,7 @@ class ClusterInfo:
     def queue_requested(self) -> dict[str, np.ndarray]:
         """Per-leaf-queue total demand (allocated + Pending tasks; Gated
         pods are excluded, matching proportion.go's Request roll-up)."""
-        min_gpu_mem = self.min_node_gpu_memory()
-        out = {qid: rs.zeros() for qid in self.queues}
-        for pg in self.podgroups.values():
-            if pg.queue_id not in out:
-                continue
-            for t in pg.pods.values():
-                if t.status == PodStatus.PENDING or t.is_active_allocated():
-                    out[pg.queue_id] += t.req_vec(min_gpu_mem)
-        return out
+        return self.queue_aggregates()[1]
 
     def pending_jobs(self) -> list[PodGroupInfo]:
         return [pg for pg in self.podgroups.values()

@@ -221,8 +221,7 @@ def pack(cluster: ClusterInfo,
     q_alloc = np.zeros((q, rs.NUM_RES))
     q_req = np.zeros((q, rs.NUM_RES))
     q_usage = np.zeros((q, rs.NUM_RES))
-    allocated = cluster.queue_allocated()
-    requested = cluster.queue_requested()
+    allocated, requested = cluster.queue_aggregates()
     for qid, i in q_index.items():
         info = cluster.queues[qid]
         q_deserved[i] = info.quota.deserved

@@ -1,0 +1,122 @@
+"""Admission webhook server: AdmissionReview over HTTP(S).
+
+The in-cluster face of the Admission controller (pkg/webhookmanager +
+binder's webhook endpoints in the reference): the apiserver POSTs
+AdmissionReview objects to /mutate and /validate; responses carry a JSON
+patch (mutation: gpu-fraction normalization, scheduler name) or an
+allow/deny verdict.  TLS uses the operator-minted secret
+(controllers/operands.generate_webhook_cert) via --tls-cert/--tls-key.
+
+Run: ``python -m kai_scheduler_tpu.controllers.admission_server
+--webhook-port 9443 [--tls-cert tls.crt --tls-key tls.key]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import copy
+import json
+import ssl
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .admission import Admission, AdmissionError
+
+
+def _json_patch(before: dict, after: dict, path: str = "") -> list:
+    """Minimal RFC-6902 patch between two manifests (replace/add only —
+    admission mutations never remove keys)."""
+    ops = []
+    for key, value in after.items():
+        sub = f"{path}/{key.replace('~', '~0').replace('/', '~1')}"
+        if key not in before:
+            ops.append({"op": "add", "path": sub, "value": value})
+        elif isinstance(value, dict) and isinstance(before[key], dict):
+            ops.extend(_json_patch(before[key], value, sub))
+        elif before[key] != value:
+            ops.append({"op": "replace", "path": sub, "value": value})
+    return ops
+
+
+def review_response(admission: Admission, review: dict,
+                    mutate: bool) -> dict:
+    request = review.get("request", {})
+    pod = request.get("object", {})
+    uid = request.get("uid", "")
+    response: dict = {"uid": uid, "allowed": True}
+    try:
+        if mutate:
+            mutated = copy.deepcopy(pod)
+            admission.mutate(mutated)
+            patch = _json_patch(pod, mutated)
+            if patch:
+                response["patchType"] = "JSONPatch"
+                response["patch"] = base64.b64encode(
+                    json.dumps(patch).encode()).decode()
+        else:
+            admission.validate(pod)
+    except AdmissionError as exc:
+        response["allowed"] = False
+        response["status"] = {"message": str(exc)}
+    return {"apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+            "response": response}
+
+
+def make_server(admission: Admission, host: str = "0.0.0.0",
+                port: int = 9443, tls_cert: str | None = None,
+                tls_key: str | None = None) -> ThreadingHTTPServer:
+    class Handler(BaseHTTPRequestHandler):
+        def do_POST(self):
+            length = int(self.headers.get("Content-Length") or 0)
+            try:
+                review = json.loads(self.rfile.read(length))
+                mutate = self.path.startswith("/mutate")
+                body = json.dumps(
+                    review_response(admission, review, mutate)).encode()
+            except (ValueError, KeyError) as exc:
+                self.send_error(400, str(exc))
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self.send_response(200)
+                self.send_header("Content-Length", "2")
+                self.end_headers()
+                self.wfile.write(b"ok")
+            else:
+                self.send_error(404)
+
+        def log_message(self, *args):
+            pass
+
+    httpd = ThreadingHTTPServer((host, port), Handler)
+    if tls_cert and tls_key:
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(tls_cert, tls_key)
+        httpd.socket = ctx.wrap_socket(httpd.socket, server_side=True)
+    return httpd
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser("kai-admission")
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--webhook-port", type=int, default=9443)
+    ap.add_argument("--tls-cert", default=None)
+    ap.add_argument("--tls-key", default=None)
+    ap.add_argument("--require-queue-label", action="store_true")
+    args = ap.parse_args(argv)
+    admission = Admission(
+        require_queue_label=args.require_queue_label)
+    httpd = make_server(admission, args.host, args.webhook_port,
+                        args.tls_cert, args.tls_key)
+    print(f"kai-admission webhook on :{args.webhook_port}", flush=True)
+    httpd.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -286,9 +286,11 @@ class ClusterCache:
 
     # -- side-effect executor (framework Session cache interface) ------------
     def bind(self, task, node_name: str, bind_request) -> None:
-        """Create the BindRequest object the binder consumes
-        (cache/cache.go:267-290)."""
-        self.api.create({
+        """Create (or supersede) the BindRequest object the binder
+        consumes (cache/cache.go:267-290).  A leftover request from a
+        previous failed attempt is replaced: the fresh scheduling decision
+        resets the phase and retry budget."""
+        obj = {
             "kind": "BindRequest",
             "metadata": {"name": f"bind-{task.uid}",
                          "namespace": task.namespace},
@@ -298,7 +300,13 @@ class ClusterCache:
                      "gpuFraction": task.res_req.gpu_fraction or None,
                      "backoffLimit": bind_request.backoff_limit},
             "status": {"phase": "Pending"},
-        })
+        }
+        existing = self.api.get_opt("BindRequest", obj["metadata"]["name"],
+                                    task.namespace)
+        if existing is not None:
+            self.api.delete("BindRequest", obj["metadata"]["name"],
+                            task.namespace)
+        self.api.create(obj)
 
     def task_pipelined(self, task, node_name: str,
                        gpu_group: str = "") -> None:

@@ -31,13 +31,20 @@ def _meta(name: str, labels: dict | None = None) -> dict:
                        "app": name, **(labels or {})}}
 
 
+# Service -> runnable module (every one has a __main__/CLI; operand
+# manifests must never reference entrypoints that don't exist).
+ENTRYPOINTS = {
+    "apiserver": "kai_scheduler_tpu.controllers.apiserver",
+    "scheduler": "kai_scheduler_tpu.server",
+    "controllers": "kai_scheduler_tpu.server",   # with --controllers-only
+    "admission": "kai_scheduler_tpu.controllers.admission_server",
+}
+
+
 def _deployment(name: str, image: str, args: list, replicas: int = 1,
                 ports: list | None = None) -> dict:
     container = {"name": name, "image": image,
-                 "command": ["python", "-m", f"kai_scheduler_tpu.{name}"]
-                 if name != "apiserver"
-                 else ["python", "-m",
-                       "kai_scheduler_tpu.controllers.apiserver"],
+                 "command": ["python", "-m", ENTRYPOINTS[name]],
                  "args": args}
     if ports:
         container["ports"] = [{"containerPort": p} for p in ports]
@@ -96,8 +103,10 @@ def render_operands(values: dict | None = None) -> list[dict]:
         replicas=int(replicas.get("controllers", 1))))
 
     out.append(_deployment("admission", image,
-                           ["--api-server", api_url, "--webhook-port",
-                            "9443"], ports=[9443]))
+                           ["--webhook-port", "9443",
+                            "--tls-cert", "/etc/kai/tls/tls.crt",
+                            "--tls-key", "/etc/kai/tls/tls.key"],
+                           ports=[9443]))
     out.append(_service("admission", 9443))
     out.append({
         "apiVersion": "admissionregistration.k8s.io/v1",
@@ -210,7 +219,13 @@ def apply_operands(api, values: dict | None = None) -> list[dict]:
         existing = api.get_opt(obj["kind"], obj["metadata"]["name"], ns)
         if existing is None:
             api.create(obj)
-        elif existing.get("spec") != obj.get("spec"):
-            api.patch(obj["kind"], obj["metadata"]["name"],
-                      {"spec": obj.get("spec")}, ns)
+            continue
+        # Reconcile every payload field, not just spec: webhook
+        # configurations (webhooks + caBundle), ClusterRole rules, and
+        # binding subjects all live at the top level.
+        payload = {k: v for k, v in obj.items()
+                   if k not in ("kind", "apiVersion", "metadata", "status")}
+        current = {k: existing.get(k) for k in payload}
+        if current != payload:
+            api.patch(obj["kind"], obj["metadata"]["name"], payload, ns)
     return operands

@@ -55,9 +55,10 @@ def batch_prefix_feasibility(node_allocatable, node_idle, node_releasing,
     delta = delta.at[release_step, release_node].add(release_vec,
                                                      mode="drop")
     prefix_rel = node_releasing[None, :, :] + jnp.cumsum(delta, axis=0)
-    # Job 1 holds the caller's padding task rows (their success is never
-    # read); job 0 is the pending job.
-    job_allowed = jnp.ones(2, bool)
+    # Job 1 holds the caller's padding task rows; gate it off so the
+    # kernel skips their placement work entirely (same convention as
+    # session.propose_placements padding).
+    job_allowed = jnp.array([True, False])
 
     def one(prefix):
         result = allocate_jobs_kernel(

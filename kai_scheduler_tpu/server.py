@@ -132,6 +132,9 @@ def run_app(argv=None) -> None:
                          "--leader-elect uses a distributed Lease")
     ap.add_argument("--lease-name", default="kai-scheduler")
     ap.add_argument("--lease-duration", type=float, default=15.0)
+    ap.add_argument("--controllers-only", action="store_true",
+                    help="run the companion-controller fleet without a "
+                         "scheduler (the controllers Deployment's mode)")
     ap.add_argument("--node-pool-label", default=None)
     ap.add_argument("--node-pool", default=None)
     ap.add_argument("--k-value", type=float, default=1.0)
@@ -175,10 +178,10 @@ def run_app(argv=None) -> None:
             elector.acquire()
         LOG.info("became leader")
 
+    shards = [] if args.controllers_only else [
+        ShardSpec("default", args.node_pool_label, args.node_pool, config)]
     system = System(SystemConfig(
-        shards=[ShardSpec("default", args.node_pool_label, args.node_pool,
-                          config)],
-        usage_db=args.usage_db), api=api)
+        shards=shards, usage_db=args.usage_db), api=api)
 
     state: dict = {}
     handler = _make_handler(state)

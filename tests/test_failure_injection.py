@@ -1,0 +1,163 @@
+"""Failure-injection ring: kill components mid-flight and assert recovery
+(VERDICT weak#8 — binder death mid-bind, dropped watches under churn,
+shard failover with pending work)."""
+
+import time
+
+import pytest
+
+from kai_scheduler_tpu.controllers import (HTTPKubeAPI, InMemoryKubeAPI,
+                                           KubeAPIServer, System,
+                                           SystemConfig, make_pod)
+from kai_scheduler_tpu.utils.leaderelect import LeaseElector
+
+
+def make_node(api, name, gpu=8):
+    api.create({"kind": "Node", "metadata": {"name": name}, "spec": {},
+                "status": {"allocatable": {"cpu": "32", "memory": "256Gi",
+                                           "nvidia.com/gpu": gpu,
+                                           "pods": 110}}})
+
+
+def make_queue(api, name="q"):
+    api.create({"kind": "Queue", "metadata": {"name": name},
+                "spec": {"deserved": {"cpu": "32", "memory": "256Gi",
+                                      "gpu": 16}}})
+
+
+class TestBinderDeathMidBind:
+    def test_binder_crash_leaves_requests_for_successor(self):
+        """The binder dies after binding some of a gang's pods; a fresh
+        fleet over the surviving API objects completes the rest — the
+        BindRequest is the durable handoff (bindrequest_controller.go)."""
+        system = System(SystemConfig())
+        api = system.api
+        make_node(api, "n1")
+        make_queue(api)
+        for i in range(3):
+            api.create(make_pod(f"p{i}", queue="q", gpu=2))
+
+        # Crash injection: the binder's _bind explodes after the first
+        # success.
+        binder = system.binder
+        real_bind = binder._bind
+        calls = {"n": 0}
+
+        def flaky_bind(br):
+            calls["n"] += 1
+            if calls["n"] > 1:
+                raise RuntimeError("binder crashed")
+            real_bind(br)
+
+        binder._bind = flaky_bind
+        system.run_cycle()
+        bound = [p for p in api.list("Pod") if p["spec"].get("nodeName")]
+        assert len(bound) == 1
+        # Failed requests persist with retry budget left.
+        brs = api.list("BindRequest")
+        assert brs and all(br["status"]["phase"] != "Succeeded"
+                           or br["spec"]["podName"] == "p0"
+                           for br in brs)
+
+        # "Restart": a brand-new fleet over the same objects finishes.
+        reborn = System(SystemConfig(), api=api)
+        for _ in range(3):
+            reborn.run_cycle()
+        bound = [p for p in api.list("Pod") if p["spec"].get("nodeName")]
+        assert len(bound) == 3
+
+    def test_exhausted_backoff_rolls_back(self):
+        """A permanently failing bind hits its backoff limit, the request
+        goes Failed, and the pod stays unbound for a future cycle."""
+        system = System(SystemConfig())
+        api = system.api
+        make_node(api, "n1")
+        make_queue(api)
+        api.create(make_pod("doomed", queue="q", gpu=2))
+        binder = system.binder
+
+        def always_fail(br):
+            raise RuntimeError("node gone")
+
+        binder._bind = always_fail
+        for _ in range(4):
+            system.run_cycle()
+        brs = [br for br in api.list("BindRequest")]
+        assert all(br["status"]["phase"] == "Failed" for br in brs)
+        assert not api.get("Pod", "doomed")["spec"].get("nodeName")
+
+
+class TestWatchDropUnderChurn:
+    def test_client_reconnect_converges_under_churn(self):
+        """A controller's watch stream drops while objects churn; after
+        reconnect (seq resume or TOO_OLD replay) its view converges."""
+        srv = KubeAPIServer().start()
+        try:
+            writer = HTTPKubeAPI(srv.url)
+            observer = HTTPKubeAPI(srv.url)
+            seen: dict = {}
+
+            def on_pod(et, obj):
+                name = obj["metadata"]["name"]
+                if et == "DELETED":
+                    seen.pop(name, None)
+                else:
+                    seen[name] = obj["status"].get("phase")
+
+            observer.watch("Pod", on_pod)
+            writer.create(make_pod("a"))
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and "a" not in seen:
+                observer.drain()
+                time.sleep(0.02)
+            assert "a" in seen
+
+            # Drop the stream; churn while disconnected.
+            observer._stop.set()
+            time.sleep(0.05)
+            writer.delete("Pod", "a")
+            writer.create(make_pod("b", phase="Running"))
+            for i in range(4):
+                writer.create(make_pod(f"noise{i}"))
+            observer._stop.clear()
+            observer._ensure_watch_thread()
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and (
+                    "a" in seen or "b" not in seen):
+                observer.drain()
+                time.sleep(0.02)
+            assert "a" not in seen
+            assert seen.get("b") == "Running"
+            observer.close()
+            writer.close()
+        finally:
+            srv.stop()
+
+
+class TestShardFailoverWithPendingWork:
+    def test_follower_takes_over_and_schedules(self):
+        """Leader dies with pods still pending; the follower acquires the
+        Lease and its scheduler binds the remaining work."""
+        api = InMemoryKubeAPI()
+        make_node(api, "n1")
+        make_queue(api)
+        api.create(make_pod("before", queue="q", gpu=2))
+
+        leader = LeaseElector(api, "shard-0", "leader",
+                              lease_duration=0.6, retry_period=0.1)
+        follower = LeaseElector(api, "shard-0", "follower",
+                                lease_duration=0.6, retry_period=0.1)
+        assert leader.acquire(timeout=2)
+        system_a = System(SystemConfig(), api=api)
+        system_a.run_cycle()
+        assert api.get("Pod", "before")["spec"].get("nodeName")
+
+        # Leader "dies": renewals stop, new work arrives while no one
+        # holds the lease.
+        leader._stop.set()
+        api.create(make_pod("after", queue="q", gpu=2))
+        assert follower.acquire(timeout=5), "failover did not happen"
+        system_b = System(SystemConfig(), api=api)
+        system_b.run_cycle()
+        assert api.get("Pod", "after")["spec"].get("nodeName")
+        follower.release()
