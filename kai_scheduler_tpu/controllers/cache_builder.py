@@ -17,7 +17,7 @@ from ..api import (ClusterInfo, NodeInfo, PodGroupInfo, PodInfo, PodSet,
 from ..api.resources import ResourceRequirements
 from .admission import GPU_FRACTION_ANNOTATION, GPU_MEMORY_ANNOTATION
 from .binder import GPU_GROUP_ANNOTATION
-from .kubeapi import InMemoryKubeAPI
+from .kubeapi import Conflict, InMemoryKubeAPI
 from .podgrouper import POD_GROUP_LABEL, SUBGROUP_LABEL
 
 PHASE_TO_STATUS = {
@@ -301,12 +301,16 @@ class ClusterCache:
                      "backoffLimit": bind_request.backoff_limit},
             "status": {"phase": "Pending"},
         }
-        existing = self.api.get_opt("BindRequest", obj["metadata"]["name"],
-                                    task.namespace)
-        if existing is not None:
+        try:
+            self.api.create(obj)
+        except Conflict:
+            # Leftover from a failed earlier attempt: supersede it.  The
+            # common case stays a single API call.
             self.api.delete("BindRequest", obj["metadata"]["name"],
                             task.namespace)
-        self.api.create(obj)
+            obj["metadata"].pop("resourceVersion", None)
+            obj["metadata"].pop("uid", None)
+            self.api.create(obj)
 
     def task_pipelined(self, task, node_name: str,
                        gpu_group: str = "") -> None:

@@ -102,11 +102,19 @@ def render_operands(values: dict | None = None) -> list[dict]:
         ["--api-server", api_url, "--controllers-only"],
         replicas=int(replicas.get("controllers", 1))))
 
-    out.append(_deployment("admission", image,
-                           ["--webhook-port", "9443",
-                            "--tls-cert", "/etc/kai/tls/tls.crt",
-                            "--tls-key", "/etc/kai/tls/tls.key"],
-                           ports=[9443]))
+    admission = _deployment("admission", image,
+                            ["--webhook-port", "9443",
+                             "--tls-cert", "/etc/kai/tls/tls.crt",
+                             "--tls-key", "/etc/kai/tls/tls.key"],
+                            ports=[9443])
+    # The serving cert the operator mints (kai-admission-tls) must be
+    # mounted where the args point.
+    pod_spec = admission["spec"]["template"]["spec"]
+    pod_spec["volumes"] = [{"name": "tls", "secret": {
+        "secretName": "kai-admission-tls"}}]
+    pod_spec["containers"][0]["volumeMounts"] = [
+        {"name": "tls", "mountPath": "/etc/kai/tls", "readOnly": True}]
+    out.append(admission)
     out.append(_service("admission", 9443))
     out.append({
         "apiVersion": "admissionregistration.k8s.io/v1",
@@ -222,10 +230,27 @@ def apply_operands(api, values: dict | None = None) -> list[dict]:
             continue
         # Reconcile every payload field, not just spec: webhook
         # configurations (webhooks + caBundle), ClusterRole rules, and
-        # binding subjects all live at the top level.
+        # binding subjects all live at the top level.  Subset comparison:
+        # a real apiserver DEFAULTS extra fields (failurePolicy,
+        # timeoutSeconds, ...) — equality would re-patch forever.
         payload = {k: v for k, v in obj.items()
                    if k not in ("kind", "apiVersion", "metadata", "status")}
-        current = {k: existing.get(k) for k in payload}
-        if current != payload:
+        if not _is_subset(payload, existing):
             api.patch(obj["kind"], obj["metadata"]["name"], payload, ns)
     return operands
+
+
+def _is_subset(rendered, current) -> bool:
+    """Every rendered field equals current's value; fields the apiserver
+    added (defaults) are ignored.  Lists compare element-wise with the
+    same subset rule."""
+    if isinstance(rendered, dict):
+        if not isinstance(current, dict):
+            return False
+        return all(_is_subset(v, current.get(k))
+                   for k, v in rendered.items())
+    if isinstance(rendered, list):
+        if not isinstance(current, list) or len(rendered) != len(current):
+            return False
+        return all(_is_subset(a, b) for a, b in zip(rendered, current))
+    return rendered == current

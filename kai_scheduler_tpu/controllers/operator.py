@@ -34,6 +34,9 @@ class ShardSpec:
 @dataclass
 class SystemConfig:
     shards: list = field(default_factory=lambda: [ShardSpec()])
+    # False = companion-controller mode: never build schedulers, even when
+    # SchedulingShard objects appear (the scheduler deployment owns them).
+    scheduling_enabled: bool = True
     require_queue_label: bool = False
     now_fn: object = None
     # Time-based fairness: usage-db client spec ("memory://", None = off)
@@ -76,6 +79,8 @@ class System:
             (lambda: self.usage_db.queue_usage(now_fn()))
             if self.usage_db else None)
         self.schedulers = []
+        if not self.config.scheduling_enabled:
+            self.config.shards = []
         for shard in self.config.shards:
             cache = ClusterCache(self.api, now_fn,
                                  status_updater=self.status_updater)
@@ -111,6 +116,8 @@ class System:
         drive the scheduler fleet (schedulingshard_types.go:66-95 — one
         scheduler per shard with per-shard args and node-pool label).
         Returns True when the fleet changed."""
+        if not self.config.scheduling_enabled:
+            return False
         shard_objs = self.api.list("SchedulingShard")
         if not shard_objs:
             return False

@@ -181,7 +181,8 @@ def run_app(argv=None) -> None:
     shards = [] if args.controllers_only else [
         ShardSpec("default", args.node_pool_label, args.node_pool, config)]
     system = System(SystemConfig(
-        shards=shards, usage_db=args.usage_db), api=api)
+        shards=shards, usage_db=args.usage_db,
+        scheduling_enabled=not args.controllers_only), api=api)
 
     state: dict = {}
     handler = _make_handler(state)

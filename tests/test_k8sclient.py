@@ -1,0 +1,400 @@
+"""KubernetesKubeAPI against a stub speaking the REAL k8s REST dialect —
+core/CRD paths, namespacing, merge-patch content type, list+watch with
+resourceVersion resumption, 410 Gone re-list (the client-go informer
+contract)."""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+import pytest
+
+from kai_scheduler_tpu.controllers.k8sclient import (KIND_ROUTES,
+                                                     KubernetesKubeAPI,
+                                                     load_kubeconfig)
+from kai_scheduler_tpu.controllers.kubeapi import Conflict, NotFound
+
+
+class StubK8s:
+    """Tiny apiserver honoring the k8s REST conventions we rely on."""
+
+    def __init__(self):
+        self.objects: dict = {}   # path -> obj
+        self.rv = 0
+        self.requests: list = []  # (method, path, content_type)
+        self.watch_sends: dict = {}  # plural -> canned event dicts
+        # Live event log: (rv, plural, event dict); watch streams replay
+        # events newer than the requested resourceVersion, then follow.
+        self.events: list = []
+        self.cond = threading.Condition()
+
+        stub = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def _body(self):
+                length = int(self.headers.get("Content-Length") or 0)
+                return json.loads(self.rfile.read(length)) if length \
+                    else None
+
+            def _send(self, code, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _record(self):
+                stub.requests.append(
+                    (self.command, self.path,
+                     self.headers.get("Content-Type", "")))
+
+            def do_GET(self):
+                self._record()
+                parsed = urlparse(self.path)
+                q = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+                if q.get("watch"):
+                    plural = parsed.path.rstrip("/").split("/")[-1]
+                    self.send_response(200)
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self.end_headers()
+
+                    def send(ev):
+                        line = (json.dumps(ev) + "\n").encode()
+                        self.wfile.write(f"{len(line):x}\r\n".encode())
+                        self.wfile.write(line + b"\r\n")
+                        self.wfile.flush()
+
+                    try:
+                        for ev in stub.watch_sends.get(plural, []):
+                            send(ev)
+                        since = int(q.get("resourceVersion", 0) or 0)
+                        deadline = time.monotonic() + 30
+                        while time.monotonic() < deadline:
+                            with stub.cond:
+                                fresh = [(rv, ev) for rv, pl, ev
+                                         in stub.events
+                                         if pl == plural and rv > since]
+                                if not fresh:
+                                    stub.cond.wait(timeout=0.2)
+                                    continue
+                            for rv, ev in fresh:
+                                send(ev)
+                                since = max(since, rv)
+                    except (BrokenPipeError, ConnectionResetError,
+                            OSError):
+                        pass
+                    return
+                if parsed.path in stub.objects:
+                    self._send(200, stub.objects[parsed.path])
+                    return
+                plurals = {route[1] for route in KIND_ROUTES.values()}
+                last = parsed.path.rstrip("/").split("/")[-1]
+                if last not in plurals:
+                    # Named object that doesn't exist: a real apiserver
+                    # 404s instead of returning an empty list.
+                    self._send(404, {"message": "NotFound"})
+                    return
+                # Collection list; the all-namespaces form
+                # (/api/v1/pods) matches any namespace's objects.
+                items = [o for p, o in stub.objects.items()
+                         if p.startswith(parsed.path + "/")
+                         or f"/{last}/" in p]
+                if q.get("labelSelector"):
+                    want = dict(kv.split("=") for kv in
+                                q["labelSelector"].split(","))
+                    items = [o for o in items
+                             if all(o.get("metadata", {}).get(
+                                 "labels", {}).get(k) == v
+                                 for k, v in want.items())]
+                self._send(200, {"kind": "List",
+                                 "metadata": {"resourceVersion":
+                                              str(stub.rv)},
+                                 "items": items})
+
+            def do_POST(self):
+                self._record()
+                obj = self._body()
+                stub.rv += 1
+                obj.setdefault("metadata", {})["resourceVersion"] = \
+                    str(stub.rv)
+                path = self.path.split("?")[0].rstrip("/") + "/" + \
+                    obj["metadata"]["name"]
+                if path in stub.objects:
+                    self._send(409, {"message": "AlreadyExists"})
+                    return
+                stub.objects[path] = obj
+                stub.emit(path, "ADDED", obj)
+                self._send(201, obj)
+
+            def do_PUT(self):
+                self._record()
+                if self.path not in stub.objects:
+                    self._send(404, {"message": "NotFound"})
+                    return
+                obj = self._body()
+                stub.rv += 1
+                obj["metadata"]["resourceVersion"] = str(stub.rv)
+                stub.objects[self.path] = obj
+                stub.emit(self.path, "MODIFIED", obj)
+                self._send(200, obj)
+
+            def do_PATCH(self):
+                self._record()
+                if self.path not in stub.objects:
+                    self._send(404, {"message": "NotFound"})
+                    return
+                cur = stub.objects[self.path]
+
+                def merge(dst, src):
+                    for k, v in src.items():
+                        if isinstance(v, dict) and isinstance(
+                                dst.get(k), dict):
+                            merge(dst[k], v)
+                        elif v is None:
+                            dst.pop(k, None)
+                        else:
+                            dst[k] = v
+
+                merge(cur, self._body())
+                stub.rv += 1
+                cur["metadata"]["resourceVersion"] = str(stub.rv)
+                stub.emit(self.path, "MODIFIED", cur)
+                self._send(200, cur)
+
+            def do_DELETE(self):
+                self._record()
+                gone = stub.objects.pop(self.path, None)
+                if gone is None:
+                    self._send(404, {"message": "NotFound"})
+                else:
+                    stub.emit(self.path, "DELETED", gone)
+                    self._send(200, {})
+
+            def log_message(self, *a):
+                pass
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    def emit(self, path: str, etype: str, obj: dict) -> None:
+        plural = path.rstrip("/").split("/")[-2]
+        with self.cond:
+            self.events.append((self.rv, plural, {"type": etype,
+                                                  "object": obj}))
+            self.cond.notify_all()
+
+    @property
+    def url(self):
+        return f"http://127.0.0.1:{self.httpd.server_port}"
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+@pytest.fixture()
+def stub():
+    s = StubK8s()
+    yield s
+    s.stop()
+
+
+@pytest.fixture()
+def client(stub):
+    c = KubernetesKubeAPI(stub.url, token="test-token")
+    yield c
+    c.close()
+
+
+class TestPaths:
+    def test_core_group_namespaced(self, stub, client):
+        client.create({"kind": "Pod",
+                       "metadata": {"name": "p", "namespace": "team-a"},
+                       "spec": {}})
+        assert ("POST", "/api/v1/namespaces/team-a/pods",
+                "application/json") in stub.requests
+        got = client.get("Pod", "p", "team-a")
+        assert got["metadata"]["name"] == "p"
+
+    def test_cluster_scoped_crd(self, stub, client):
+        client.create({"kind": "Queue", "metadata": {"name": "q"},
+                       "spec": {}})
+        assert any(p == "/apis/kai.scheduler/v1/queues"
+                   for _m, p, _c in stub.requests)
+
+    def test_namespaced_crd_and_lease(self, stub, client):
+        client.create({"kind": "BindRequest",
+                       "metadata": {"name": "b", "namespace": "ns1"},
+                       "spec": {}})
+        assert any(
+            p == "/apis/scheduling.kai/v1/namespaces/ns1/bindrequests"
+            for _m, p, _c in stub.requests)
+        client.create({"kind": "Lease",
+                       "metadata": {"name": "l",
+                                    "namespace": "kai-system"},
+                       "spec": {}})
+        assert any(
+            p == "/apis/coordination.k8s.io/v1/namespaces/kai-system/leases"
+            for _m, p, _c in stub.requests)
+
+    def test_patch_uses_merge_patch_content_type(self, stub, client):
+        client.create({"kind": "Pod",
+                       "metadata": {"name": "p", "namespace": "default"},
+                       "spec": {}})
+        client.patch("Pod", "p", {"status": {"phase": "Running"}})
+        assert ("PATCH", "/api/v1/namespaces/default/pods/p",
+                "application/merge-patch+json") in stub.requests
+        assert client.get("Pod", "p")["status"]["phase"] == "Running"
+
+    def test_errors_and_label_selector(self, stub, client):
+        with pytest.raises(NotFound):
+            client.get("Pod", "nope")
+        client.create({"kind": "Node", "metadata": {
+            "name": "n1", "labels": {"pool": "a"}}, "spec": {}})
+        client.create({"kind": "Node", "metadata": {
+            "name": "n2", "labels": {"pool": "b"}}, "spec": {}})
+        with pytest.raises(Conflict):
+            client.create({"kind": "Node", "metadata": {"name": "n1"},
+                           "spec": {}})
+        assert len(client.list("Node",
+                               label_selector={"pool": "a"})) == 1
+
+    def test_bearer_token_sent(self, stub, client):
+        # The stub doesn't authenticate, but every kind route must be
+        # resolvable so the fleet's kinds all map to real URLs.
+        for kind in ("Pod", "PodGroup", "Queue", "BindRequest", "Lease",
+                     "SchedulingShard", "Topology", "ConfigMap",
+                     "PersistentVolumeClaim", "Secret"):
+            assert kind in KIND_ROUTES
+
+
+class TestWatch:
+    def test_list_seeds_then_watch_streams(self, stub, client):
+        stub.objects["/api/v1/namespaces/default/pods/seed"] = {
+            "kind": "Pod", "metadata": {"name": "seed",
+                                        "namespace": "default",
+                                        "resourceVersion": "1"}}
+        stub.watch_sends["pods"] = [
+            {"type": "MODIFIED", "object": {
+                "kind": "Pod",
+                "metadata": {"name": "seed", "namespace": "default",
+                             "resourceVersion": "2"},
+                "status": {"phase": "Running"}}},
+            {"type": "BOOKMARK", "object": {
+                "kind": "Pod", "metadata": {"resourceVersion": "5"}}},
+        ]
+        seen = []
+        client.watch("Pod", lambda et, obj: seen.append(
+            (et, obj["metadata"]["name"])))
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and len(seen) < 2:
+            client.drain()
+            time.sleep(0.02)
+        assert ("ADDED", "seed") in seen      # list seeding
+        assert ("MODIFIED", "seed") in seen   # stream event
+        # BOOKMARK advanced the cursor without reaching handlers.
+        assert all(et != "BOOKMARK" for et, _ in seen)
+
+    def test_410_gone_triggers_relist(self, stub, client):
+        stub.objects["/api/v1/nodes/n1"] = {
+            "kind": "Node", "metadata": {"name": "n1",
+                                         "resourceVersion": "1"}}
+        stub.watch_sends["nodes"] = [
+            {"type": "ERROR", "object": {"kind": "Status", "code": 410}}]
+        seen = []
+        client.watch("Node", lambda et, obj: seen.append(
+            obj["metadata"]["name"]))
+        deadline = time.monotonic() + 5
+        # After 410 the loop re-lists: n1 arrives again as ADDED.
+        while time.monotonic() < deadline and seen.count("n1") < 2:
+            client.drain()
+            time.sleep(0.02)
+        assert seen.count("n1") >= 2
+
+
+class TestKubeconfig:
+    def test_minimal_kubeconfig_loads(self, tmp_path):
+        cfg = tmp_path / "kubeconfig"
+        cfg.write_text(json.dumps({
+            "current-context": "dev",
+            "contexts": [{"name": "dev",
+                          "context": {"cluster": "c", "user": "u"}}],
+            "clusters": [{"name": "c", "cluster": {
+                "server": "https://1.2.3.4:6443",
+                "insecure-skip-tls-verify": True}}],
+            "users": [{"name": "u", "user": {"token": "abc"}}],
+        }))
+        loaded = load_kubeconfig(str(cfg))
+        assert loaded["server"] == "https://1.2.3.4:6443"
+        assert loaded["token"] == "abc"
+        assert loaded["insecure"]
+        client = KubernetesKubeAPI.from_kubeconfig(str(cfg))
+        assert client.server == "https://1.2.3.4:6443"
+        client.close()
+
+
+class TestFleetOverK8sDialect:
+    def test_pod_binds_through_k8s_rest(self, stub, client):
+        """The full controller fleet over the REAL Kubernetes REST
+        dialect: pod -> podgrouper -> scheduler -> BindRequest -> binder,
+        with informer-style list+watch per kind (missing#1 closure: the
+        same code runs against a live apiserver via kubeconfig)."""
+        from kai_scheduler_tpu.controllers import System, SystemConfig
+        from kai_scheduler_tpu.controllers.kubeapi import make_pod
+
+        system = System(SystemConfig(), api=client)
+        client.create({"kind": "Node", "metadata": {"name": "n1"},
+                       "spec": {},
+                       "status": {"allocatable": {
+                           "cpu": "32", "memory": "256Gi",
+                           "nvidia.com/gpu": 8, "pods": 110}}})
+        client.create({"kind": "Queue", "metadata": {"name": "q"},
+                       "spec": {"deserved": {"cpu": "32",
+                                             "memory": "256Gi",
+                                             "gpu": 8}}})
+        client.create(make_pod("w1", queue="q", gpu=2))
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            system.run_cycle()
+            pod = client.get("Pod", "w1")
+            if pod["spec"].get("nodeName"):
+                break
+            time.sleep(0.1)
+        assert client.get("Pod", "w1")["spec"].get("nodeName") == "n1"
+        assert client.get("Pod", "w1")["status"]["phase"] == "Running"
+
+
+class TestRelistDeletes:
+    def test_410_relist_synthesizes_deleted(self, stub, client):
+        """Objects that vanish while the watch is behind arrive as
+        synthesized DELETED events after the re-list (informer Replace)."""
+        stub.objects["/api/v1/nodes/gone"] = {
+            "kind": "Node", "metadata": {"name": "gone",
+                                         "resourceVersion": "1"}}
+        seen = []
+        client.watch("Node", lambda et, obj: seen.append(
+            (et, obj["metadata"]["name"])))
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and ("ADDED", "gone") not in seen:
+            client.drain()
+            time.sleep(0.02)
+        # Remove the object without a watch event, then force a re-list.
+        del stub.objects["/api/v1/nodes/gone"]
+        with stub.cond:
+            stub.events.append((stub.rv + 1, "nodes", {
+                "type": "ERROR",
+                "object": {"kind": "Status", "code": 410}}))
+            stub.rv += 1
+            stub.cond.notify_all()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and \
+                ("DELETED", "gone") not in seen:
+            client.drain()
+            time.sleep(0.02)
+        assert ("DELETED", "gone") in seen
