@@ -31,6 +31,10 @@ class BindRequest:
     gpu_groups: list = field(default_factory=list)
     backoff_limit: int = 3
     phase: str = "Pending"  # Pending | Succeeded | Failed
+    # DRA: claim names + structured ResourceClaimAllocations
+    # ({"name", "node", "devices"}) the binder publishes at bind time.
+    resource_claims: list = field(default_factory=list)
+    claim_allocations: list = field(default_factory=list)
 
 
 class ClusterInfo:
@@ -41,13 +45,19 @@ class ClusterInfo:
                  now: float = 0.0,
                  resource_claims: dict | None = None,
                  config_maps: set | None = None,
-                 pvcs: dict | None = None):
+                 pvcs: dict | None = None,
+                 resource_slices: dict | None = None):
         self.nodes: dict[str, NodeInfo] = nodes or {}
         self.podgroups: dict[str, PodGroupInfo] = podgroups or {}
         self.queues: dict[str, QueueInfo] = queues or {}
         self.topologies: dict = topologies or {}
-        # DRA claims: name -> {"device_class", "allocated", "node"}.
+        # DRA claims: name -> {"device_class", "count",
+        # "allocation": {"node", "devices"} | None} (legacy keys
+        # "allocated"/"node" still honored by the plugin).
         self.resource_claims: dict = resource_claims or {}
+        # DRA device inventory (ResourceSlice objects):
+        # node -> device_class -> [device names].
+        self.resource_slices: dict = resource_slices or {}
         # ConfigMap predicate inventory: {(namespace, name)}.
         self.config_maps: set = set(config_maps or ())
         # PVC inventory for the schedule-time VolumeBinding filter:
@@ -157,4 +167,6 @@ class ClusterInfo:
             dict(self.queues), dict(self.topologies), self.now,
             {k: dict(v) for k, v in self.resource_claims.items()},
             set(self.config_maps),
-            {k: dict(v) for k, v in self.pvcs.items()})
+            {k: dict(v) for k, v in self.pvcs.items()},
+            {n: {c: list(d) for c, d in by_class.items()}
+             for n, by_class in self.resource_slices.items()})

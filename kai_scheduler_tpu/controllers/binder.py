@@ -51,19 +51,28 @@ class VolumeBindingPlugin(BindPlugin):
 
 
 class ResourceClaimPlugin(BindPlugin):
-    """Writes DRA-style resource-claim allocations at bind time
-    (k8s-plugins/dynamicresources analog)."""
+    """Publishes the scheduler's structured claim allocations at bind time
+    (dynamicresources.go:252 allocateResourceClaim -> status.allocation)."""
 
     def pre_bind(self, api, pod, node_name, bind_request) -> None:
-        for claim_name in bind_request.get("spec", {}).get(
-                "resourceClaims", []) or []:
-            claim = api.get_opt("ResourceClaim", claim_name,
-                                pod["metadata"].get("namespace", "default"))
-            if claim is not None:
-                api.patch(
-                    "ResourceClaim", claim_name,
-                    {"status": {"allocated": True, "nodeName": node_name}},
-                    pod["metadata"].get("namespace", "default"))
+        spec = bind_request.get("spec", {})
+        allocations = {a.get("name"): a for a in
+                       spec.get("resourceClaimAllocations") or []}
+        for claim_name in spec.get("resourceClaims", []) or []:
+            ns = pod["metadata"].get("namespace", "default")
+            claim = api.get_opt("ResourceClaim", claim_name, ns)
+            if claim is None:
+                continue
+            alloc = allocations.get(claim_name) or {"node": node_name,
+                                                    "devices": []}
+            api.patch(
+                "ResourceClaim", claim_name,
+                {"status": {"allocated": True,
+                            "nodeName": alloc.get("node", node_name),
+                            "allocation": {
+                                "node": alloc.get("node", node_name),
+                                "devices": alloc.get("devices", [])}}},
+                ns)
 
 
 class Binder:

@@ -118,6 +118,10 @@ def _parse_pod_predicates(task: PodInfo, pod: dict) -> None:
         claim = (vol.get("persistentVolumeClaim") or {}).get("claimName")
         if claim:
             task.pvc_names.append(claim)
+    for ref in spec.get("resourceClaims") or []:
+        name = ref.get("resourceClaimName") or ref.get("name")
+        if name:
+            task.resource_claims.append(name)
 
 
 def _quota_vec(spec: dict | None):
@@ -269,6 +273,38 @@ class ClusterCache:
                 "levels": [lvl["nodeLabel"] for lvl in
                            topo.get("spec", {}).get("levels", [])]}
 
+        # DRA objects: structured claims + per-node device inventory
+        # (the upstream DRA manager's ResourceClaim/ResourceSlice views).
+        resource_claims = {}
+        for rc in self.api.list("ResourceClaim"):
+            spec = rc.get("spec", {})
+            device_reqs = (spec.get("devices") or {}).get("requests") \
+                or [{}]
+            alloc = rc.get("status", {}).get("allocation")
+            resource_claims[rc["metadata"]["name"]] = {
+                # Every device request (multi-class claims supported).
+                "requests": [
+                    {"device_class": r.get("deviceClassName", ""),
+                     "count": int(r.get("count", 1))}
+                    for r in device_reqs],
+                # Legacy single-request view kept for older callers.
+                "device_class": device_reqs[0].get("deviceClassName", ""),
+                "count": int(device_reqs[0].get("count", 1)),
+                "allocation": alloc,
+                "allocated": bool(alloc),
+                "node": (alloc or {}).get("node"),
+            }
+        resource_slices: dict = {}
+        for sl in self.api.list("ResourceSlice"):
+            spec = sl.get("spec", {})
+            node = spec.get("nodeName")
+            if not node:
+                continue
+            per_node = resource_slices.setdefault(node, {})
+            for dev in spec.get("devices") or []:
+                cls = dev.get("deviceClassName", "")
+                per_node.setdefault(cls, []).append(dev.get("name", ""))
+
         config_maps = {
             (cm["metadata"].get("namespace", "default"),
              cm["metadata"]["name"])
@@ -282,7 +318,9 @@ class ClusterCache:
 
         return ClusterInfo(nodes, podgroups, queues, topologies,
                            now=self.now_fn(),
-                           config_maps=config_maps, pvcs=pvcs)
+                           resource_claims=resource_claims,
+                           config_maps=config_maps, pvcs=pvcs,
+                           resource_slices=resource_slices)
 
     # -- side-effect executor (framework Session cache interface) ------------
     def bind(self, task, node_name: str, bind_request) -> None:
@@ -298,7 +336,11 @@ class ClusterCache:
                      "selectedNode": node_name,
                      "selectedGPUGroups": bind_request.gpu_groups,
                      "gpuFraction": task.res_req.gpu_fraction or None,
-                     "backoffLimit": bind_request.backoff_limit},
+                     "backoffLimit": bind_request.backoff_limit,
+                     "resourceClaims": list(
+                         getattr(bind_request, "resource_claims", [])),
+                     "resourceClaimAllocations": list(
+                         getattr(bind_request, "claim_allocations", []))},
             "status": {"phase": "Pending"},
         }
         try:

@@ -40,6 +40,8 @@ KIND_ROUTES = {
     "ServiceAccount": ("api/v1", "serviceaccounts", True),
     "Service": ("api/v1", "services", True),
     "PersistentVolumeClaim": ("api/v1", "persistentvolumeclaims", True),
+    "ResourceClaim": ("apis/resource.k8s.io/v1", "resourceclaims", True),
+    "ResourceSlice": ("apis/resource.k8s.io/v1", "resourceslices", False),
     "Deployment": ("apis/apps/v1", "deployments", True),
     "Lease": ("apis/coordination.k8s.io/v1", "leases", True),
     "Queue": ("apis/kai.scheduler/v1", "queues", False),
@@ -257,6 +259,11 @@ class KubernetesKubeAPI:
                             code = obj.get("code")
                             if code == 410:  # Gone: re-list
                                 rv = ""
+                            else:
+                                # Unknown server error: back off before
+                                # reconnecting, or a persistent ERROR
+                                # becomes a hot loop at RTT rate.
+                                time.sleep(0.5)
                             break
                         if etype == "BOOKMARK":
                             rv = obj.get("metadata", {}).get(

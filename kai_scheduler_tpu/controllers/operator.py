@@ -79,9 +79,9 @@ class System:
             (lambda: self.usage_db.queue_usage(now_fn()))
             if self.usage_db else None)
         self.schedulers = []
-        if not self.config.scheduling_enabled:
-            self.config.shards = []
-        for shard in self.config.shards:
+        shards = (self.config.shards
+                  if self.config.scheduling_enabled else [])
+        for shard in shards:
             cache = ClusterCache(self.api, now_fn,
                                  status_updater=self.status_updater)
             provider = self._shard_provider(cache, shard)

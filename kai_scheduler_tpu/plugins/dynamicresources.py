@@ -1,15 +1,21 @@
-"""Dynamic Resource Allocation (DRA) plugin.
+"""Dynamic Resource Allocation (DRA) plugin — structured device claims.
 
-Mirrors pkg/scheduler/plugins/dynamicresources/dynamicresources.go:59-87:
-tasks may reference ResourceClaims; a claim must be allocatable (or already
-allocated to a compatible node) for the task to schedule, claims are
-assumed/unassumed in-session as statements allocate/rollback, and the
-claim names ride the BindRequest so the binder can write the allocation
-status at bind time (allocateResourceClaim :252).
+Mirrors pkg/scheduler/plugins/dynamicresources/dynamicresources.go:59-87
+plus the upstream DRA manager's structured allocation: tasks reference
+ResourceClaims (deviceClassName + count); device inventory comes from
+per-node ResourceSlices (``cluster.resource_slices``); the scheduler picks
+concrete free devices, assumes them in-session (rolled back with the
+statement), and writes ResourceClaimAllocations onto the BindRequest
+(dynamicresources.go:252 allocateResourceClaim) so the binder can publish
+``claim.status.allocation``.
 
-Claims live in the info model as ``task.resource_claims``: a list of claim
-names resolved against ``cluster.resource_claims`` ({name: {"device_class",
-"allocated", "node"}}).
+Claim states the schedulability check honors:
+- already allocated (status.allocation / legacy "node"): the task must
+  follow the allocation's node;
+- unallocated: the candidate node must hold >= count FREE devices of the
+  claim's class (free = slice inventory minus devices assumed or
+  allocated to other claims);
+- unknown claim name: unschedulable.
 """
 
 from __future__ import annotations
@@ -22,41 +28,128 @@ class DynamicResourcesPlugin(Plugin):
     def on_session_open(self, ssn) -> None:
         self.ssn = ssn
         self.claims = getattr(ssn.cluster, "resource_claims", {})
+        self.slices = getattr(ssn.cluster, "resource_slices", {})
         if not self.claims:
             return
-        # In-session assumed allocations: claim -> node (rolled back with
-        # the statement via the deallocate handler).
-        self.assumed: dict[str, str] = {}
+        # In-session assumed allocations: claim -> {"node", "devices"}
+        # (rolled back with the statement via the deallocate handler).
+        self.assumed: dict[str, dict] = {}
+        # Devices already promised on each node: node -> {device names}.
+        self.devices_taken: dict[str, set] = {}
+        for name, claim in self.claims.items():
+            alloc = self._allocation(claim)
+            if alloc and alloc.get("node"):
+                self.devices_taken.setdefault(
+                    alloc["node"], set()).update(alloc.get("devices", ()))
         ssn.allocate_handlers.append(self.on_allocate)
         ssn.deallocate_handlers.append(self.on_deallocate)
         ssn.bind_request_mutators = getattr(ssn, "bind_request_mutators",
                                             [])
         ssn.bind_request_mutators.append(self.mutate_bind_request)
 
+    @staticmethod
+    def _allocation(claim: dict) -> dict | None:
+        alloc = claim.get("allocation")
+        if alloc:
+            return alloc
+        if claim.get("node"):  # legacy shape
+            return {"node": claim["node"], "devices": []}
+        return None
+
+    @staticmethod
+    def _requests(claim: dict) -> list:
+        """[(device_class, count)] — multi-request claims supported;
+        the legacy single device_class/count shape maps to one entry."""
+        reqs = claim.get("requests")
+        if reqs:
+            return [(r.get("device_class", r.get("deviceClassName", "")),
+                     int(r.get("count", 1))) for r in reqs]
+        return [(claim.get("device_class", ""),
+                 int(claim.get("count", 1)))]
+
     def task_claims(self, task) -> list:
         return getattr(task, "resource_claims", []) or []
 
+    def _free_devices(self, node_name: str, device_class: str) -> list:
+        inventory = self.slices.get(node_name, {}).get(device_class, [])
+        taken = self.devices_taken.get(node_name, set())
+        return [d for d in inventory if d not in taken]
+
     def claims_schedulable(self, task, node_name: str) -> bool:
-        """PrePredicate analog: every referenced claim must be free, already
-        assumed on this node, or bound to this node."""
+        """PreFilter: every referenced claim must be satisfiable on the
+        node — already there, assumed there, or coverable by free slice
+        devices.  Demand accumulates PER device class across the task's
+        unallocated claims."""
+        needed: dict[str, int] = {}
         for name in self.task_claims(task):
             claim = self.claims.get(name)
             if claim is None:
                 return False
-            node = claim.get("node") or self.assumed.get(name)
-            if node and node != node_name:
-                return False
+            alloc = self.assumed.get(name) or self._allocation(claim)
+            if alloc is not None:
+                if alloc.get("node") != node_name:
+                    return False
+                continue
+            # No slice inventory published (legacy/simplified clusters):
+            # any node can host an unallocated claim.
+            if self.slices:
+                for cls, count in self._requests(claim):
+                    needed[cls] = needed.get(cls, 0) + count
+                    if needed[cls] > len(self._free_devices(node_name,
+                                                            cls)):
+                        return False
         return True
 
     def on_allocate(self, task) -> None:
         for name in self.task_claims(task):
-            self.assumed[name] = task.node_name
+            claim = self.claims.get(name)
+            if claim is None:
+                continue
+            assumed = self.assumed.get(name)
+            if assumed is not None:
+                # Shareable claim: another task already holds the
+                # assumption; this task becomes a co-user.
+                assumed["users"].add(task.uid)
+                continue
+            if self._allocation(claim) is not None:
+                continue
+            devices: list = []
+            for cls, count in self._requests(claim):
+                devices += self._free_devices(task.node_name, cls)[:count]
+            self.assumed[name] = {"node": task.node_name,
+                                  "devices": devices,
+                                  "users": {task.uid}}
+            self.devices_taken.setdefault(task.node_name,
+                                          set()).update(devices)
 
     def on_deallocate(self, task, prev_status) -> None:
         for name in self.task_claims(task):
-            self.assumed.pop(name, None)
+            assumed = self.assumed.get(name)
+            if assumed is None:
+                continue
+            assumed["users"].discard(task.uid)
+            # The assumption (and its devices) release only once NO
+            # placed task still rides the claim.
+            if not assumed["users"]:
+                del self.assumed[name]
+                self.devices_taken.get(assumed["node"],
+                                       set()).difference_update(
+                    assumed["devices"])
 
     def mutate_bind_request(self, task, bind_request) -> None:
         claims = self.task_claims(task)
-        if claims:
-            bind_request.resource_claims = list(claims)
+        if not claims:
+            return
+        bind_request.resource_claims = list(claims)
+        # Structured allocations ride the BindRequest
+        # (ResourceClaimAllocations, bindrequest_types.go).
+        def alloc_of(name):
+            assumed = self.assumed.get(name)
+            if assumed is not None:
+                return {"node": assumed["node"],
+                        "devices": list(assumed["devices"])}
+            return (self._allocation(self.claims.get(name, {}))
+                    or {"node": task.node_name, "devices": []})
+
+        bind_request.claim_allocations = [
+            {"name": name, **alloc_of(name)} for name in claims]

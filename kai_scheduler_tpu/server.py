@@ -178,10 +178,10 @@ def run_app(argv=None) -> None:
             elector.acquire()
         LOG.info("became leader")
 
-    shards = [] if args.controllers_only else [
-        ShardSpec("default", args.node_pool_label, args.node_pool, config)]
     system = System(SystemConfig(
-        shards=shards, usage_db=args.usage_db,
+        shards=[ShardSpec("default", args.node_pool_label, args.node_pool,
+                          config)],
+        usage_db=args.usage_db,
         scheduling_enabled=not args.controllers_only), api=api)
 
     state: dict = {}

@@ -122,7 +122,8 @@ def build_cluster(spec: dict) -> ClusterInfo:
                       else ("default", ns_name))
                      for ns_name in spec.get("config_maps", ())},
         pvcs={(k if isinstance(k, tuple) else ("default", k)): dict(v)
-              for k, v in spec.get("pvcs", {}).items()})
+              for k, v in spec.get("pvcs", {}).items()},
+        resource_slices=spec.get("resource_slices", {}))
 
 
 def build_session(spec: dict, config: SchedulerConfig | None = None

@@ -496,3 +496,135 @@ class TestSecondInGangAffinityTerm:
         assert len(p) >= 2
         assert p["mix-1"][0] == "n2"
         assert p["mix-0"][0] == "n2"
+
+
+class TestStructuredDRA:
+    def test_device_count_gates_node_choice(self):
+        """A 2-device claim must land where 2 FREE devices of its class
+        exist; n1's inventory is exhausted by an allocated claim."""
+        ssn = build_session({
+            "nodes": {"n1": {"gpu": 8}, "n2": {"gpu": 8}},
+            "queues": {"q": {}},
+            "resource_slices": {
+                "n1": {"net.example/nic": ["n1-nic0"]},
+                "n2": {"net.example/nic": ["n2-nic0", "n2-nic1"]}},
+            "resource_claims": {
+                "fast-net": {"device_class": "net.example/nic",
+                             "count": 2}},
+            "jobs": {"j": {"queue": "q", "tasks": [
+                {"gpu": 1, "resource_claims": ["fast-net"]}]}},
+        })
+        run_action(ssn)
+        assert placements(ssn)["j-0"][0] == "n2"
+
+    def test_device_exhaustion_blocks_second_claimant(self):
+        ssn = build_session({
+            "nodes": {"n1": {"gpu": 8}},
+            "queues": {"q": {}},
+            "resource_slices": {"n1": {"acc.example/fpga": ["f0"]}},
+            "resource_claims": {
+                "c1": {"device_class": "acc.example/fpga", "count": 1},
+                "c2": {"device_class": "acc.example/fpga", "count": 1}},
+            "jobs": {
+                "a": {"queue": "q", "tasks": [
+                    {"cpu": "1", "resource_claims": ["c1"]}]},
+                "b": {"queue": "q", "tasks": [
+                    {"cpu": "1", "resource_claims": ["c2"]}]},
+            },
+        })
+        run_action(ssn)
+        p = placements(ssn)
+        # Only one FPGA device exists: exactly one claimant places.
+        assert len(p) == 1
+
+    def test_fleet_publishes_structured_allocation(self):
+        """Manifest-driven DRA: ResourceClaim + ResourceSlice objects in,
+        claim.status.allocation with concrete devices out."""
+        from kai_scheduler_tpu.controllers import (System, SystemConfig,
+                                                   make_pod)
+        system = System(SystemConfig())
+        api = system.api
+        api.create({"kind": "Node", "metadata": {"name": "n1"},
+                    "spec": {},
+                    "status": {"allocatable": {"cpu": "32",
+                                               "memory": "256Gi",
+                                               "nvidia.com/gpu": 8,
+                                               "pods": 110}}})
+        api.create({"kind": "Queue", "metadata": {"name": "q"},
+                    "spec": {"deserved": {"cpu": "32", "memory": "256Gi",
+                                          "gpu": 8}}})
+        api.create({"kind": "ResourceClaim",
+                    "metadata": {"name": "nic-claim"},
+                    "spec": {"devices": {"requests": [
+                        {"deviceClassName": "net.example/nic",
+                         "count": 2}]}},
+                    "status": {}})
+        api.create({"kind": "ResourceSlice",
+                    "metadata": {"name": "n1-slice"},
+                    "spec": {"nodeName": "n1", "devices": [
+                        {"name": "nic0",
+                         "deviceClassName": "net.example/nic"},
+                        {"name": "nic1",
+                         "deviceClassName": "net.example/nic"}]}})
+        pod = make_pod("dra-pod", queue="q", gpu=1)
+        pod["spec"]["resourceClaims"] = [
+            {"name": "net", "resourceClaimName": "nic-claim"}]
+        api.create(pod)
+        system.run_cycle()
+        assert api.get("Pod", "dra-pod")["spec"].get("nodeName") == "n1"
+        claim = api.get("ResourceClaim", "nic-claim")
+        alloc = claim["status"]["allocation"]
+        assert alloc["node"] == "n1"
+        assert sorted(alloc["devices"]) == ["nic0", "nic1"]
+
+
+class TestStructuredDRARegressions:
+    def test_multi_class_claims_on_one_node(self):
+        """Per-class demand accounting: one nic + one fpga on the same
+        node must schedule (global accumulation over-rejected this)."""
+        ssn = build_session({
+            "nodes": {"n1": {"gpu": 8}},
+            "queues": {"q": {}},
+            "resource_slices": {"n1": {
+                "net.example/nic": ["nic0"],
+                "acc.example/fpga": ["f0"]}},
+            "resource_claims": {
+                "nic": {"device_class": "net.example/nic", "count": 1},
+                "fpga": {"device_class": "acc.example/fpga", "count": 1}},
+            "jobs": {"j": {"queue": "q", "tasks": [
+                {"cpu": "1", "resource_claims": ["nic", "fpga"]}]}},
+        })
+        run_action(ssn)
+        assert "j-0" in placements(ssn)
+
+    def test_shared_claim_survives_sibling_rollback(self):
+        """A failed gang sharing a claim must not free the devices the
+        surviving pod rides on (refcounted assumption release)."""
+        ssn = build_session({
+            "nodes": {"n1": {"gpu": 2}},
+            "queues": {"q": {}},
+            "resource_slices": {"n1": {"net.example/nic": ["nic0"]}},
+            "resource_claims": {
+                "shared": {"device_class": "net.example/nic",
+                           "count": 1}},
+            "jobs": {
+                # Places first and holds the claim.
+                "a": {"queue": "q", "creation_ts": 0.0, "tasks": [
+                    {"cpu": "1", "gpu": 1,
+                     "resource_claims": ["shared"]}]},
+                # Gang of 3 x 1 GPU > 1 remaining: fails and rolls back;
+                # its members also reference the shared claim.
+                "b": {"queue": "q", "creation_ts": 1.0,
+                      "min_available": 3, "tasks": [
+                          {"cpu": "1", "gpu": 1,
+                           "resource_claims": ["shared"]}] * 3},
+            },
+        })
+        run_action(ssn)
+        p = placements(ssn)
+        assert "a-0" in p
+        dra = next(pl for pl in ssn.plugins
+                   if pl.name == "dynamicresources")
+        # The assumption survives with a's devices intact.
+        assert dra.assumed["shared"]["devices"] == ["nic0"]
+        assert "nic0" in dra.devices_taken["n1"]
