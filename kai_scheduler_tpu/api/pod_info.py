@@ -125,6 +125,39 @@ class PodInfo:
     def req_vec(self, node_gpu_memory: float = 0.0) -> np.ndarray:
         return self.res_req.to_vec(node_gpu_memory)
 
+    def instantiate(self) -> "PodInfo":
+        """Fresh per-cycle instance from a parsed template: immutable
+        pieces (ResourceRequirements with its memoized vectors, the
+        AffinityTerm lists) are SHARED, small mutable containers are
+        copied.  The single definition of which fields a manifest parse
+        carries — cache_builder's parse cache relies on it staying in
+        step with the dataclass."""
+        return PodInfo(
+            uid=self.uid, name=self.name, namespace=self.namespace,
+            job_id=self.job_id, subgroup=self.subgroup,
+            res_req=self.res_req, status=self.status,
+            node_name=self.node_name, priority=self.priority,
+            node_selector=dict(self.node_selector),
+            tolerations=set(self.tolerations),
+            accepted_resource_types=(set(self.accepted_resource_types)
+                                     if self.accepted_resource_types
+                                     else None),
+            gpu_group=self.gpu_group,
+            nominated_node=self.nominated_node,
+            resource_claims=list(self.resource_claims),
+            pod_affinity_peers=list(self.pod_affinity_peers),
+            pod_anti_affinity_peers=list(self.pod_anti_affinity_peers),
+            labels=dict(self.labels),
+            host_ports=set(self.host_ports),
+            required_configmaps=list(self.required_configmaps),
+            pvc_names=list(self.pvc_names),
+            affinity_terms=self.affinity_terms,
+            anti_affinity_terms=self.anti_affinity_terms,
+            preferred_affinity_terms=self.preferred_affinity_terms,
+            preferred_anti_affinity_terms=(
+                self.preferred_anti_affinity_terms),
+        )
+
     def clone(self) -> "PodInfo":
         return PodInfo(
             uid=self.uid, name=self.name, namespace=self.namespace,

@@ -144,6 +144,48 @@ class ClusterCache:
         # In-memory pipelined assignments surviving between cycles
         # (Cache.TaskPipelined): pod uid -> (node, gpu_group).
         self._pipelined: dict = {}
+        # Manifest-parse cache: pod uid -> (resourceVersion, template
+        # PodInfo).  A pod whose resourceVersion hasn't moved re-parses
+        # nothing; instances share the template's immutable pieces
+        # (ResourceRequirements with its memoized vectors, affinity
+        # terms), which dominates snapshot cost at fleet scale.
+        self._pod_cache: dict = {}
+
+    def _parse_pod(self, pod: dict) -> PodInfo:
+        md = pod["metadata"]
+        uid = md.get("uid", md["name"])
+        rv = md.get("resourceVersion")
+        cached = self._pod_cache.get(uid)
+        if cached is not None and rv is not None and cached[0] == rv:
+            return cached[1].instantiate()
+        phase = pod.get("status", {}).get("phase", "Pending")
+        status = PHASE_TO_STATUS.get(phase, PodStatus.UNKNOWN)
+        if md.get("deletionTimestamp"):
+            status = PodStatus.RELEASING
+        task = PodInfo(
+            uid=uid,
+            name=md["name"],
+            namespace=md.get("namespace", "default"),
+            subgroup=md.get("labels", {}).get(SUBGROUP_LABEL, "default"),
+            res_req=_requests_to_reqreq(pod),
+            status=status,
+            node_name=pod.get("spec", {}).get("nodeName", ""),
+            node_selector=pod.get("spec", {}).get("nodeSelector", {}),
+            tolerations={t["key"] for t in pod.get("spec", {}).get(
+                "tolerations", [])},
+            labels=dict(md.get("labels", {})))
+        _parse_pod_affinity(task, pod.get("spec", {}).get("affinity", {}))
+        _parse_pod_predicates(task, pod)
+        gpu_group = md.get("annotations", {}).get(GPU_GROUP_ANNOTATION)
+        if gpu_group:
+            task.gpu_group = gpu_group
+        if rv is not None:
+            # Template is a dedicated instance: the returned task mutates
+            # during the cycle (statements), the template never does.
+            # instantiate() shares the immutable pieces, so the memoized
+            # request vectors survive across cycles.
+            self._pod_cache[uid] = (rv, task.instantiate())
+        return task
 
     # -- snapshot ------------------------------------------------------------
     def snapshot(self) -> ClusterInfo:
@@ -222,34 +264,13 @@ class ClusterCache:
             podgroups[name] = pg
 
         seen_uids = set()
+        cache_seen = set()
         for pod in self.api.list("Pod"):
             group = pod["metadata"].get("labels", {}).get(POD_GROUP_LABEL)
             if not group or group not in podgroups:
                 continue
-            phase = pod.get("status", {}).get("phase", "Pending")
-            status = PHASE_TO_STATUS.get(phase, PodStatus.UNKNOWN)
-            if pod["metadata"].get("deletionTimestamp"):
-                status = PodStatus.RELEASING
-            task = PodInfo(
-                uid=pod["metadata"].get("uid", pod["metadata"]["name"]),
-                name=pod["metadata"]["name"],
-                namespace=pod["metadata"].get("namespace", "default"),
-                subgroup=pod["metadata"].get("labels", {}).get(
-                    SUBGROUP_LABEL, "default"),
-                res_req=_requests_to_reqreq(pod),
-                status=status,
-                node_name=pod.get("spec", {}).get("nodeName", ""),
-                node_selector=pod.get("spec", {}).get("nodeSelector", {}),
-                tolerations={t["key"] for t in pod.get("spec", {}).get(
-                    "tolerations", [])},
-                labels=dict(pod["metadata"].get("labels", {})))
-            _parse_pod_affinity(task, pod.get("spec", {}).get(
-                "affinity", {}))
-            _parse_pod_predicates(task, pod)
-            gpu_group = pod["metadata"].get("annotations", {}).get(
-                GPU_GROUP_ANNOTATION)
-            if gpu_group:
-                task.gpu_group = gpu_group
+            task = self._parse_pod(pod)
+            cache_seen.add(task.uid)
             if task.status == PodStatus.PENDING:
                 seen_uids.add(task.uid)
             # A remembered pipelined assignment becomes a nomination: the
@@ -266,6 +287,9 @@ class ClusterCache:
         self._pipelined = {
             uid: v for uid, v in self._pipelined.items()
             if uid in seen_uids}  # seen = still pending this snapshot
+        # Drop parse-cache entries for vanished pods.
+        self._pod_cache = {uid: v for uid, v in self._pod_cache.items()
+                           if uid in cache_seen}
 
         topologies = {}
         for topo in self.api.list("Topology"):
