@@ -7,6 +7,7 @@ status tracking, subgroup membership, and preemptibility.
 
 from __future__ import annotations
 
+import copy as _copy
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -128,35 +129,24 @@ class PodInfo:
     def instantiate(self) -> "PodInfo":
         """Fresh per-cycle instance from a parsed template: immutable
         pieces (ResourceRequirements with its memoized vectors, the
-        AffinityTerm lists) are SHARED, small mutable containers are
-        copied.  The single definition of which fields a manifest parse
-        carries — cache_builder's parse cache relies on it staying in
-        step with the dataclass."""
-        return PodInfo(
-            uid=self.uid, name=self.name, namespace=self.namespace,
-            job_id=self.job_id, subgroup=self.subgroup,
-            res_req=self.res_req, status=self.status,
-            node_name=self.node_name, priority=self.priority,
-            node_selector=dict(self.node_selector),
-            tolerations=set(self.tolerations),
-            accepted_resource_types=(set(self.accepted_resource_types)
-                                     if self.accepted_resource_types
-                                     else None),
-            gpu_group=self.gpu_group,
-            nominated_node=self.nominated_node,
-            resource_claims=list(self.resource_claims),
-            pod_affinity_peers=list(self.pod_affinity_peers),
-            pod_anti_affinity_peers=list(self.pod_anti_affinity_peers),
-            labels=dict(self.labels),
-            host_ports=set(self.host_ports),
-            required_configmaps=list(self.required_configmaps),
-            pvc_names=list(self.pvc_names),
-            affinity_terms=self.affinity_terms,
-            anti_affinity_terms=self.anti_affinity_terms,
-            preferred_affinity_terms=self.preferred_affinity_terms,
-            preferred_anti_affinity_terms=(
-                self.preferred_anti_affinity_terms),
-        )
+        AffinityTerm lists) are SHARED, mutable containers are copied.
+        Built on a shallow copy so fields added to the dataclass later
+        are picked up automatically (cache_hit pods must never lag
+        freshly-parsed ones); only re-copy containers a cycle mutates."""
+        inst = _copy.copy(self)
+        inst.node_selector = dict(self.node_selector)
+        inst.tolerations = set(self.tolerations)
+        if self.accepted_resource_types is not None:
+            inst.accepted_resource_types = set(
+                self.accepted_resource_types)
+        inst.resource_claims = list(self.resource_claims)
+        inst.pod_affinity_peers = list(self.pod_affinity_peers)
+        inst.pod_anti_affinity_peers = list(self.pod_anti_affinity_peers)
+        inst.labels = dict(self.labels)
+        inst.host_ports = set(self.host_ports)
+        inst.required_configmaps = list(self.required_configmaps)
+        inst.pvc_names = list(self.pvc_names)
+        return inst
 
     def clone(self) -> "PodInfo":
         return PodInfo(

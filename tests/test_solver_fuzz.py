@@ -169,3 +169,74 @@ def test_preempt_soundness(seed):
             if t.status == PodStatus.RELEASING:
                 assert pg.priority < urgent_prio
                 assert pg.is_preemptible()
+
+
+def random_elastic_spec(seed):
+    """Contended cluster whose victims are ELASTIC gangs (more tasks than
+    min_available): the solver must shrink surplus before killing cores."""
+    rng = np.random.default_rng(seed)
+    n_nodes = int(rng.integers(2, 4))
+    nodes = {f"n{i}": {"gpu": 8, "cpu": "32", "mem": "256Gi"}
+             for i in range(n_nodes)}
+    queues = {
+        "q_a": {"deserved": dict(cpu="32", memory="256Gi", gpu=4)},
+        "q_b": {"deserved": dict(cpu="32", memory="256Gi", gpu=4)},
+    }
+    jobs = {}
+    node_free = {f"n{i}": 8 for i in range(n_nodes)}
+    names = list(node_free)
+    v = 0
+    while any(f > 0 for f in node_free.values()) and v < 6:
+        size = int(rng.integers(2, 5))
+        min_avail = int(rng.integers(1, size))
+        tasks = []
+        for _ in range(size):
+            candidates = [n for n in names if node_free[n] > 0]
+            if not candidates:
+                break
+            node = candidates[int(rng.integers(len(candidates)))]
+            tasks.append({"gpu": 1, "status": "RUNNING", "node": node})
+            node_free[node] -= 1
+        if not tasks:
+            break
+        jobs[f"victim{v}"] = {
+            "queue": "q_a", "min_available": min(min_avail, len(tasks)),
+            "last_start_ts": float(rng.choice([0.0, 990.0])),
+            "tasks": tasks,
+        }
+        v += 1
+    jobs["starved"] = {"queue": "q_b",
+                       "tasks": [{"gpu": int(rng.integers(1, 5))}]}
+    spec = {"now": 1000.0, "nodes": nodes, "queues": queues, "jobs": jobs}
+    if rng.random() < 0.5:
+        spec["queues"]["q_a"]["reclaim_min_runtime"] = 100.0
+    return spec
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_reclaim_elastic_discipline(seed):
+    """With elastic victims: gang integrity (a job is never left with
+    0 < active < min_available), min-runtime protection honored, and the
+    standard cycle invariants hold."""
+    spec = random_elastic_spec(seed)
+    ssn = build_session(spec)
+    run_action(ssn, "reclaim")
+    check_invariants(ssn)
+    min_runtime = spec["queues"]["q_a"].get("reclaim_min_runtime")
+    for uid, pg in ssn.cluster.podgroups.items():
+        if not uid.startswith("victim"):
+            continue
+        active = pg.num_active_allocated()
+        evicted = sum(1 for t in pg.pods.values()
+                      if t.status == PodStatus.RELEASING)
+        min_avail = sum(ps.min_available for ps in pg.pod_sets.values())
+        # Elastic shrink keeps the core gang intact; a full kill takes
+        # everything.
+        assert active == 0 or active >= min_avail, \
+            f"{uid}: gang left split (active={active}, min={min_avail})"
+        # Min-runtime protection: victims inside their window are
+        # untouchable.
+        if evicted and min_runtime is not None \
+                and pg.last_start_ts is not None:
+            assert (ssn.cluster.now - pg.last_start_ts) >= min_runtime, \
+                f"{uid}: evicted inside its reclaim_min_runtime window"
