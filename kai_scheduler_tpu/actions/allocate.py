@@ -201,6 +201,7 @@ def _execute_bulk(ssn, jobs):
             break
 
         import functools as _functools
+        kw = {}
         if ssn.mesh is not None:
             # Multi-chip: node axis sharded over the configured mesh
             # (parallel/sharded_grouped.py; bit-identical to single-chip).
@@ -209,12 +210,18 @@ def _execute_bulk(ssn, jobs):
         else:
             from ..ops.allocate_grouped import allocate_grouped
             kernel = allocate_grouped
+            # Single-task chunks place independently: identical adjacent
+            # ones merge into one scan step (burst waves of one-pod jobs
+            # collapse from thousands of steps to a handful).
+            kw["independent_jobs"] = np.array(
+                [len(tasks) == 1 for tasks in chunks])
         result = kernel(
             ssn._device_arrays(),
             np.stack(rows_req), np.array(task_jobs, np.int32),
             np.stack(rows_sel), np.stack(rows_tol),
             np.array(job_allowed),
-            gpu_strategy=ssn.gpu_strategy, cpu_strategy=ssn.cpu_strategy)
+            gpu_strategy=ssn.gpu_strategy, cpu_strategy=ssn.cpu_strategy,
+            **kw)
 
         success = np.asarray(result.job_success)
         placements = np.asarray(result.placements)

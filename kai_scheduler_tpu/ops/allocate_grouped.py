@@ -41,22 +41,32 @@ from .scoring import BINPACK, score_row
 
 
 def group_tasks(task_req: np.ndarray, task_job: np.ndarray,
-                task_selector: np.ndarray, task_tolerations: np.ndarray):
+                task_selector: np.ndarray, task_tolerations: np.ndarray,
+                task_mergeable: np.ndarray | None = None):
     """Host-side prep: run-length groups over identical adjacent tasks.
 
+    ``task_mergeable`` ([T] bool): tasks whose jobs place INDEPENDENTLY
+    (single-task chunks with trivial gang semantics) — identical adjacent
+    mergeable tasks group together ACROSS job boundaries, collapsing e.g.
+    a burst of 20k identical one-pod jobs into one scan step.
+
     Returns (group_of_task [T], group_req [G,R], group_sel [G,L],
-    group_tol [G,Tl], group_count [G], group_job [G]).
+    group_tol [G,Tl], group_count [G], group_job [G], group_indep [G]).
     """
     t = task_req.shape[0]
     if t == 0:
         return (np.zeros(0, np.int32), np.zeros((0, task_req.shape[1])),
                 np.zeros((0, task_selector.shape[1]), np.int32),
                 np.zeros((0, task_tolerations.shape[1]), np.int32),
-                np.zeros(0), np.zeros(0, np.int32))
+                np.zeros(0), np.zeros(0, np.int32), np.zeros(0, bool))
+    if task_mergeable is None:
+        task_mergeable = np.zeros(t, bool)
     change = np.zeros(t, bool)
     change[0] = True
+    job_break = task_job[1:] != task_job[:-1]
+    job_break &= ~(task_mergeable[1:] & task_mergeable[:-1])
     change[1:] = (
-        (task_job[1:] != task_job[:-1])
+        job_break
         | (task_req[1:] != task_req[:-1]).any(axis=1)
         | (task_selector[1:] != task_selector[:-1]).any(axis=1)
         | (task_tolerations[1:] != task_tolerations[:-1]).any(axis=1))
@@ -65,7 +75,7 @@ def group_tasks(task_req: np.ndarray, task_job: np.ndarray,
     counts = np.diff(np.append(starts, t)).astype(np.float64)
     return (group_of_task, task_req[starts], task_selector[starts],
             task_tolerations[starts], counts,
-            task_job[starts].astype(np.int32))
+            task_job[starts].astype(np.int32), task_mergeable[starts])
 
 
 def _compact(take, key, max_group: int):
@@ -165,6 +175,7 @@ def allocate_groups_kernel(node_allocatable, node_idle, node_releasing,
                            node_labels, node_taints, node_pod_room,
                            group_req, group_sel, group_tol, group_count,
                            group_job, job_allowed, max_group: int,
+                           group_indep=None,
                            gpu_strategy: int = BINPACK,
                            cpu_strategy: int = BINPACK,
                            allow_pipeline: bool = True,
@@ -184,6 +195,8 @@ def allocate_groups_kernel(node_allocatable, node_idle, node_releasing,
     G = group_req.shape[0]
     N = node_allocatable.shape[0]
     K = max_group
+    if group_indep is None:
+        group_indep = jnp.zeros(G, bool)
 
     class Carry(NamedTuple):
         idle: jnp.ndarray
@@ -260,8 +273,10 @@ def allocate_groups_kernel(node_allocatable, node_idle, node_releasing,
 
         if single_group_jobs:
             # A failed gang must leave no trace: zero its takes in-step
-            # (there is no later boundary to roll back at).
-            gang_ok = placed >= count
+            # (there is no later boundary to roll back at).  Independent
+            # groups (merged single-task jobs) keep partial placements:
+            # each member job succeeds or fails on its own.
+            gang_ok = group_indep[g] | (placed >= count)
             take_a = jnp.where(gang_ok, take_a, 0.0)
             take_b = jnp.where(gang_ok, take_b, 0.0)
 
@@ -335,27 +350,51 @@ def allocate_grouped(node_arrays, task_req, task_job, task_selector,
                      gpu_strategy: int = BINPACK,
                      cpu_strategy: int = BINPACK,
                      allow_pipeline: bool = True,
-                     pipeline_only: bool = False) -> AllocationResult:
+                     pipeline_only: bool = False,
+                     independent_jobs=None) -> AllocationResult:
     """Host wrapper: group prep -> group-scan kernel -> per-task expansion.
 
     Drop-in equivalent of ops.allocate.allocate_jobs_kernel for bin-pack
-    strategies.
+    strategies.  ``independent_jobs`` ([J] bool): single-task jobs whose
+    placement is independent — identical adjacent ones merge into one
+    group (one scan step for a whole burst wave), each member succeeding
+    or failing on its own.
     """
     np_req = np.asarray(task_req)
     np_job = np.asarray(task_job)
     np_sel = np.asarray(task_selector)
     np_tol = np.asarray(task_tolerations)
+    allowed_np = np.asarray(job_allowed)
+    mergeable = None
+    if independent_jobs is not None:
+        indep_np = np.asarray(independent_jobs)
+        # Independence only holds for single-task jobs: partial placement
+        # of a gang would silently break its atomicity.
+        task_counts = np.bincount(np_job, minlength=len(indep_np))
+        assert not (indep_np & (task_counts != 1)).any(), \
+            "independent_jobs may only flag single-task jobs"
+        # Merging may not cross an allowed/gated boundary: the kernel
+        # gates a whole group by its first job's flag.
+        mergeable = indep_np[np_job] & allowed_np[np_job]
     (group_of_task, g_req, g_sel, g_tol, g_count,
-     g_job) = group_tasks(np_req, np_job, np_sel, np_tol)
-    max_group = _next_pow2(int(g_count.max()) if len(g_count) else 1)
+     g_job, g_indep) = group_tasks(np_req, np_job, np_sel, np_tol,
+                                   mergeable)
     # Homogeneous gangs: one group per job lets the kernel drop its
-    # checkpoint carries entirely.
+    # checkpoint carries entirely.  Merged groups alias several jobs to
+    # one group_job; that is only sound in this no-checkpoint mode, so
+    # fall back to unmerged grouping otherwise.
     single = len(g_job) == len(set(g_job.tolist()))
+    if not single and mergeable is not None and mergeable.any():
+        (group_of_task, g_req, g_sel, g_tol, g_count,
+         g_job, g_indep) = group_tasks(np_req, np_job, np_sel, np_tol)
+        single = len(g_job) == len(set(g_job.tolist()))
+    max_group = _next_pow2(int(g_count.max()) if len(g_count) else 1)
 
     packed, idle, rel = _allocate_groups_packed(
         *node_arrays, jnp.asarray(g_req), jnp.asarray(g_sel),
         jnp.asarray(g_tol), jnp.asarray(g_count), jnp.asarray(g_job),
         jnp.asarray(job_allowed), max_group=max_group,
+        group_indep=jnp.asarray(g_indep),
         gpu_strategy=gpu_strategy, cpu_strategy=cpu_strategy,
         allow_pipeline=allow_pipeline, pipeline_only=pipeline_only,
         single_group_jobs=single)
@@ -371,12 +410,22 @@ def allocate_grouped(node_arrays, task_req, task_job, task_selector,
     t = 0
     for g in range(len(g_count)):
         k = int(g_count[g])
-        if success[g_job[g]]:
+        # Merged independent runs expand partial placements in task order
+        # (first jobs of the run win, like the sequential greedy); gangs
+        # expand only on success.  g_indep is all-False unless the
+        # single-group mode is active (fallback regrouping above).
+        if g_indep[g] or success[g_job[g]]:
             nodes = np.repeat(seg_nodes[g], seg_counts[g])
             pipes = np.repeat(seg_pipe[g], seg_counts[g])
             n = min(len(nodes), k)
             placements[t:t + n] = nodes[:n]
             pipelined[t:t + n] = pipes[:n]
         t += k
+    # Per-job success for merged independent jobs comes from their own
+    # task's placement (the kernel's segment accounting aliases them to
+    # the run's first job).  Mergeable jobs are single-task, so their
+    # np_job values are unique: one vectorized assignment.
+    if mergeable is not None and mergeable.any():
+        success[np_job[mergeable]] = placements[mergeable] >= 0
     return AllocationResult(placements, pipelined,
                             jnp.asarray(success), idle, rel)

@@ -272,7 +272,7 @@ def sharded_allocate_grouped(mesh, node_arrays, task_req, task_job,
     np_sel = np.asarray(task_selector)
     np_tol = np.asarray(task_tolerations)
     (group_of_task, g_req, g_sel, g_tol, g_count,
-     g_job) = group_tasks(np_req, np_job, np_sel, np_tol)
+     g_job, _g_indep) = group_tasks(np_req, np_job, np_sel, np_tol)
     max_group = _next_pow2(int(g_count.max()) if len(g_count) else 1)
 
     packed, group_placed, job_success, idle, rel = \

@@ -101,3 +101,85 @@ def test_pipeline_phase_marks_tasks():
     piped = np.asarray(out.pipelined)
     assert (p[:2] == 0).all() and not piped[:2].any()  # idle capacity first
     assert (p[2:] == 1).all() and piped[2:].all()      # overflow pipelines
+
+
+class TestMergedIndependentSingles:
+    def _instance(self, n_jobs, n_nodes=16, gpu=1):
+        import numpy as np
+        alloc = np.tile([8000.0, 64e9, 8.0], (n_nodes, 1))
+        idle = alloc.copy()
+        rel = np.zeros((n_nodes, 3))
+        labels = np.full((n_nodes, 1), -1, np.int32)
+        taints = np.full((n_nodes, 1), -1, np.int32)
+        room = np.full(n_nodes, 110.0)
+        req = np.tile([1000.0, 1e9, float(gpu)], (n_jobs, 1))
+        job = np.arange(n_jobs, dtype=np.int32)
+        sel = np.full((n_jobs, 1), -1, np.int32)
+        tol = np.full((n_jobs, 1), -1, np.int32)
+        nodes = tuple(map(jnp.asarray,
+                          (alloc, idle, rel, labels, taints, room)))
+        return nodes, req, job, sel, tol
+
+    def test_merged_matches_unmerged(self):
+        """A burst of identical single-task jobs must place identically
+        whether merged into one scan step or not."""
+        import numpy as np
+        nodes, req, job, sel, tol = self._instance(40)
+        allowed = np.ones(40, bool)
+        allowed[7] = False  # one gated job mid-run splits the merge
+        merged = allocate_grouped(nodes, req, job, sel, tol, allowed,
+                                  independent_jobs=np.ones(40, bool))
+        plain = allocate_grouped(nodes, req, job, sel, tol, allowed)
+        np.testing.assert_array_equal(np.asarray(merged.placements),
+                                      np.asarray(plain.placements))
+        np.testing.assert_array_equal(np.asarray(merged.job_success),
+                                      np.asarray(plain.job_success))
+        np.testing.assert_allclose(np.asarray(merged.node_idle),
+                                   np.asarray(plain.node_idle))
+
+    def test_merged_partial_placement(self):
+        """Demand beyond capacity: the first jobs of the merged run place,
+        the tail fails individually (no all-or-nothing across the run)."""
+        import numpy as np
+        # 16 nodes x 8 GPUs = 128 slots; 200 one-GPU jobs.
+        nodes, req, job, sel, tol = self._instance(200)
+        allowed = np.ones(200, bool)
+        out = allocate_grouped(nodes, req, job, sel, tol, allowed,
+                               independent_jobs=np.ones(200, bool))
+        placed = np.asarray(out.placements)
+        success = np.asarray(out.job_success)
+        assert (placed >= 0).sum() == 128
+        # Sequential semantics: the first 128 jobs succeed.
+        np.testing.assert_array_equal(success[:128], True)
+        np.testing.assert_array_equal(success[128:], False)
+
+    def test_mixed_gangs_and_singles(self):
+        """Real gangs interleaved with mergeable singles keep their
+        all-or-nothing semantics."""
+        import numpy as np
+        n_nodes = 4  # 32 GPU slots
+        alloc = np.tile([8000.0, 64e9, 8.0], (n_nodes, 1))
+        nodes = tuple(map(jnp.asarray, (
+            alloc, alloc.copy(), np.zeros((n_nodes, 3)),
+            np.full((n_nodes, 1), -1, np.int32),
+            np.full((n_nodes, 1), -1, np.int32),
+            np.full(n_nodes, 110.0))))
+        # jobs: 10 singles (1 GPU), one too-big gang (40 GPUs), 5 singles.
+        req_rows = [[1000.0, 1e9, 1.0]] * 10 \
+            + [[1000.0, 1e9, 1.0]] * 40 + [[1000.0, 1e9, 1.0]] * 5
+        job_ids = list(range(10)) + [10] * 40 + list(range(11, 16))
+        req = np.array(req_rows)
+        job = np.array(job_ids, np.int32)
+        sel = np.full((len(job), 1), -1, np.int32)
+        tol = np.full((len(job), 1), -1, np.int32)
+        allowed = np.ones(16, bool)
+        indep = np.array([True] * 10 + [False] + [True] * 5)
+        out = allocate_grouped(nodes, req, job, sel, tol, allowed,
+                               independent_jobs=indep)
+        success = np.asarray(out.job_success)
+        placed = np.asarray(out.placements)
+        # Gang of 40 cannot fit 32 slots: fails atomically.
+        assert not success[10]
+        assert (placed[10:50] >= 0).sum() == 0
+        # All 15 singles fit.
+        assert success[:10].all() and success[11:].all()
