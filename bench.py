@@ -22,6 +22,9 @@ number includes one round trip; co-located deployments would subtract it).
 """
 
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
@@ -212,5 +215,141 @@ def main():
     }))
 
 
+def _probe_backend(env, timeout=240):
+    """Try to initialize the JAX backend in a subprocess.
+
+    Backend-init failures (e.g. a TPU tunnel flake: "Unable to initialize
+    backend 'axon': UNAVAILABLE") poison the whole process, so the probe —
+    and the bench itself — run in child processes.  Returns (ok, detail).
+    """
+    code = "import jax; jax.devices(); print('PROBE_OK', jax.default_backend())"
+    try:
+        p = subprocess.run([sys.executable, "-c", code], env=env,
+                           capture_output=True, text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return False, f"probe timed out after {timeout}s"
+    if p.returncode == 0 and "PROBE_OK" in p.stdout:
+        return True, next(line for line in p.stdout.splitlines()
+                          if "PROBE_OK" in line)
+    tail = (p.stderr or p.stdout or "").strip().splitlines()[-3:]
+    return False, " | ".join(tail)
+
+
+def _run_bench(env, timeout=2700):
+    """Run the measurement pass (`bench.py --run`) in a subprocess.
+
+    Returns (parsed_json_or_None, diagnostic_str).
+    """
+    try:
+        p = subprocess.run([sys.executable, os.path.abspath(__file__),
+                            "--run"], env=env, capture_output=True,
+                           text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return None, f"bench run timed out after {timeout}s"
+    for line in reversed((p.stdout or "").strip().splitlines()):
+        try:
+            parsed = json.loads(line)
+        except (ValueError, TypeError):
+            continue
+        if isinstance(parsed, dict) and "metric" in parsed:
+            return parsed, ""
+    tail = (p.stderr or p.stdout or "").strip().splitlines()[-4:]
+    return None, f"rc={p.returncode}: " + " | ".join(tail)
+
+
+def _cpu_env(base_env):
+    """Environment that genuinely lands on the CPU backend.
+
+    Setting JAX_PLATFORMS=cpu alone is not enough here: the TPU relay shim
+    is injected via a PYTHONPATH sitecustomize that re-registers the TPU
+    backend regardless, so the fallback also strips that path entry."""
+    env = dict(base_env)
+    env["JAX_PLATFORMS"] = "cpu"
+    # Same trigger the test conftest and __graft_entry__ neutralize: with
+    # the pool var set the shim grabs the device tunnel and overrides
+    # jax_platforms even when the sitecustomize path strip misses.
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    path = env.get("PYTHONPATH", "")
+    kept = [p for p in path.split(os.pathsep)
+            if p and ".axon_site" not in p]
+    env["PYTHONPATH"] = os.pathsep.join(kept)
+    return env
+
+
+def orchestrate():
+    """Resilient driver: try TPU, wait out flakes, fall back to CPU.
+
+    Round 2's entire perf story was erased by a single backend-init flake
+    (BENCH_r02.json rc=1).  This wrapper guarantees one JSON line on stdout:
+    either a TPU-backed measurement, a CPU-labeled fallback measurement with
+    the TPU failure attached as a diagnostic, or (only if even CPU fails) a
+    structured failure record — so a flake is distinguishable from a
+    regression.  The happy path runs the bench directly (no extra backend
+    bring-up); probing happens only after a failed run, to classify it and
+    wait out a transient.
+    """
+    attempts = []
+    base_env = dict(os.environ)
+    try:
+        backoff = float(os.environ.get("BENCH_BACKOFF_S", "30"))
+        if not (0.0 <= backoff < 3600.0):  # also rejects nan/inf
+            backoff = 30.0
+    except ValueError:
+        backoff = 30.0
+
+    result, diag = _run_bench(base_env)
+    attempts.append({"phase": "run-tpu-1", "ok": result is not None,
+                     "detail": diag})
+    tpu_err = diag if result is None else None
+    if result is None:
+        for i in range(3):
+            time.sleep(backoff)
+            ok, detail = _probe_backend(base_env)
+            attempts.append({"phase": f"tpu-probe-{i + 1}", "ok": ok,
+                             "detail": detail})
+            if ok:
+                # Backend is reachable again: the failure was (or has
+                # resolved like) a transient — one more full attempt.
+                tpu_err = None
+                result, diag = _run_bench(base_env)
+                attempts.append({"phase": "run-tpu-2",
+                                 "ok": result is not None, "detail": diag})
+                if result is None:
+                    tpu_err = diag
+                break
+            tpu_err = detail
+
+    fallback = False
+    if result is None:
+        result, diag = _run_bench(_cpu_env(base_env))
+        attempts.append({"phase": "run-cpu-fallback",
+                         "ok": result is not None, "detail": diag})
+        fallback = result is not None
+
+    if result is not None:
+        if fallback:
+            # Make a fallback unmistakable at the top level: a CPU number
+            # must never be read as a TPU regression (or vice versa).
+            result["metric"] += "@cpu-fallback"
+            result["vs_baseline"] = None
+            result["detail"]["backend_note"] = "cpu-fallback"
+            if tpu_err:
+                result["detail"]["tpu_error"] = tpu_err
+        if any(not a["ok"] for a in attempts):
+            result["detail"]["attempts"] = attempts
+        print(json.dumps(result))
+        return 0
+
+    print(json.dumps({
+        "metric": "scheduling_cycle_latency_ms",
+        "value": None, "unit": "ms", "vs_baseline": None,
+        "detail": {"error": "all backends failed", "attempts": attempts},
+    }))
+    return 1
+
+
 if __name__ == "__main__":
-    main()
+    if "--run" in sys.argv:
+        main()
+    else:
+        sys.exit(orchestrate())
