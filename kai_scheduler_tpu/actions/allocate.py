@@ -128,7 +128,8 @@ def _execute_bulk(ssn, jobs):
         # (queue key, job key) tuples when plugins provide key functions
         # (pairwise comparators cost milliseconds each at scale);
         # comparator heaps remain the strict path.
-        if ssn.queue_key_fn is not None and ssn.job_key_fns:
+        if ssn.queue_key_fn is not None and ssn.job_key_fns \
+                and ssn.job_keys_complete:
             by_queue: dict = {}
             for pg in pending:
                 by_queue.setdefault(pg.queue_id, []).append(pg)

@@ -20,10 +20,19 @@ INFINITE = -1
 
 
 class PriorityQueue:
-    """Heap over a less(a, b) comparator with optional max size."""
+    """Heap over a less(a, b) comparator with optional max size.
 
-    def __init__(self, less: Callable, max_size: int = INFINITE):
+    ``key``: optional item -> sort-key function; when given, each push
+    computes the key ONCE and heap maintenance compares tuples instead of
+    invoking the comparator per comparison — pairwise DRF comparators cost
+    tens of microseconds each, which dominated steady-state cycles with
+    thousands of pending jobs (the burst scale scenario).
+    """
+
+    def __init__(self, less: Callable, max_size: int = INFINITE,
+                 key: Callable | None = None):
         self.less = less
+        self.key = key
         self.max_size = max_size
         self._items: list = []
         self._counter = itertools.count()
@@ -41,8 +50,23 @@ class PriorityQueue:
                 return False
             return self.seq < other.seq
 
+    class _KeyedEntry:
+        __slots__ = ("item", "k", "seq")
+
+        def __init__(self, item, k, seq):
+            self.item, self.k, self.seq = item, k, seq
+
+        def __lt__(self, other):
+            if self.k != other.k:
+                return self.k < other.k
+            return self.seq < other.seq
+
     def push(self, item) -> None:
-        entry = self._Entry(item, self.less, next(self._counter))
+        if self.key is not None:
+            entry = self._KeyedEntry(item, self.key(item),
+                                     next(self._counter))
+        else:
+            entry = self._Entry(item, self.less, next(self._counter))
         if self.max_size != INFINITE and len(self._items) >= self.max_size:
             # Keep the best max_size items: replace the worst if the new
             # item beats it (priority_queue.go bounded behavior).
@@ -81,16 +105,30 @@ class JobsOrderByQueues:
                  victims_by_queue: dict | None = None):
         self.ssn = ssn
         self.victims_by_queue = victims_by_queue or {}
+        # Key mode: when every registered comparator has a matching
+        # precomputed-key form, heap maintenance compares cached tuples
+        # (one key computation per push) instead of running the pairwise
+        # DRF comparators per heap comparison.  An unpaired registration
+        # (order fn without key fn) disables it, preserving exact
+        # comparator semantics.
+        job_key = ssn.job_sort_key if (
+            getattr(ssn, "job_keys_complete", False)
+            and len(ssn.job_key_fns) == len(ssn.job_order_fns)) else None
+        queue_key = None
+        if (not self.victims_by_queue and ssn.queue_key_fn is not None
+                and len(ssn.queue_order_fns) == 1):
+            def queue_key(qid):
+                return ssn.queue_key_fn(qid, self._peek_job(qid))
         self._job_heaps: dict[str, PriorityQueue] = {}
         for job in jobs:
             heap = self._job_heaps.get(job.queue_id)
             if heap is None:
                 heap = PriorityQueue(
                     lambda a, b: ssn.compare_jobs(a, b) < 0,
-                    max_jobs_per_queue)
+                    max_jobs_per_queue, key=job_key)
                 self._job_heaps[job.queue_id] = heap
             heap.push(job)
-        self._queue_heap = PriorityQueue(self._queue_less)
+        self._queue_heap = PriorityQueue(self._queue_less, key=queue_key)
         for qid, heap in self._job_heaps.items():
             if not heap.empty():
                 self._queue_heap.push(qid)

@@ -82,9 +82,13 @@ class Session:
         self.queue_order_fns: list[Callable] = []
         self.job_order_fns: list[Callable] = []
         # Key-function mirrors of the comparators: plugins that can express
-        # their ordering as a sort key register here too, letting bulk
-        # paths sort by precomputed tuples instead of pairwise callbacks.
+        # their ordering as a sort key register here too, letting bulk and
+        # heap paths sort by precomputed tuples instead of pairwise
+        # callbacks.  Register PAIRS via add_job_order_fn — an order fn
+        # without a matching key disables key mode for the whole session
+        # (job_keys_complete), never silently mis-orders.
         self.job_key_fns: list[Callable] = []
+        self.job_keys_complete: bool = True
         self.queue_key_fn: Callable | None = None
         self.task_order_fns: list[Callable] = []
         self.pod_set_order_fns: list[Callable] = []
@@ -254,6 +258,17 @@ class Session:
             if res != 0:
                 return res
         return 0
+
+    def add_job_order_fn(self, order_fn: Callable,
+                         key_fn: Callable | None = None) -> None:
+        """Register a job comparator with (optionally) its sort-key
+        mirror.  Key-based ordering stays enabled only while every
+        registered comparator has a paired key."""
+        self.job_order_fns.append(order_fn)
+        if key_fn is None:
+            self.job_keys_complete = False
+        else:
+            self.job_key_fns.append(key_fn)
 
     def job_sort_key(self, job: PodGroupInfo):
         return tuple(fn(job) for fn in self.job_key_fns) + (

@@ -79,32 +79,58 @@ def group_tasks(task_req: np.ndarray, task_job: np.ndarray,
 
 
 def _compact(take, key, max_group: int):
-    """Gather the nonzero fill segments into [max_group] slots, ordered
-    by descending score (ascending node index among ties) so per-task
-    expansion matches the exact kernel's placement sequence.  Only the
-    <= max_group compacted slots are sorted — the full node axis never
-    is."""
-    n = take.shape[0]
+    """Gather the nonzero fill segments into [max_group] slots in
+    ascending node-index order (score ordering is applied AFTER the scan,
+    as one batched sort over all groups — see _order_segments).
+
+    Slot s holds the s-th node with a nonzero take, found by binary
+    search over the running nonzero count.  This is gather-only: the
+    scatter formulation (.at[slot].set over the full node axis) lowered
+    to per-element stores and dominated large-cluster cycle latency
+    (~1.2ms per call at 98k nodes), and a per-step argsort would sit on
+    the sequential scan's critical path."""
     flag = take > 0
-    slot = jnp.cumsum(flag) - 1
-    slot = jnp.where(flag, slot, max_group)  # dropped when out of range
-    nodes = jnp.full(max_group, -1, jnp.int32).at[slot].set(
-        jnp.arange(n, dtype=jnp.int32), mode="drop")
-    counts = jnp.zeros(max_group, take.dtype).at[slot].set(
-        take, mode="drop")
-    # Slots are in ascending node index; complementing the unsigned key
-    # makes a stable ascending argsort yield descending score with the
-    # ascending-index tie-break.
-    seg_key = jnp.where(nodes >= 0, key[jnp.clip(nodes, 0)],
+    csum = jnp.cumsum(flag.astype(jnp.int32))
+    total = csum[-1]
+    nodes = jnp.searchsorted(
+        csum, jnp.arange(1, max_group + 1, dtype=jnp.int32)).astype(
+        jnp.int32)
+    valid = jnp.arange(max_group) < jnp.minimum(total, max_group)
+    nodes = jnp.where(valid, nodes, -1)
+    counts = jnp.where(valid, take[jnp.clip(nodes, 0)],
+                       jnp.zeros((), take.dtype))
+    seg_key = jnp.where(valid, key[jnp.clip(nodes, 0)],
                         jnp.zeros((), key.dtype))
-    order = jnp.argsort(~seg_key, stable=True)
-    return nodes[order], counts[order]
+    return nodes, counts, seg_key
+
+
+def _order_segments(seg_nodes, seg_counts, seg_pipe, seg_keys):
+    """One batched sort over [G, K]: within each group, phase-A segments
+    first then phase-B (pipelined), each descending by score key with the
+    ascending-node-index tie-break (the input order within a phase is
+    ascending node index and the sort is stable), empty slots last —
+    reproducing the exact kernel's placement sequence.  Batched across
+    groups, this runs once per kernel call instead of once per scan step.
+    """
+    phase = jnp.where(seg_counts > 0,
+                      seg_pipe.astype(jnp.uint32), jnp.uint32(2))
+    _, _, seg_nodes, seg_counts, seg_pipe = jax.lax.sort(
+        (phase, ~seg_keys, seg_nodes, seg_counts,
+         seg_pipe.astype(jnp.uint32)),
+        dimension=-1, num_keys=2, is_stable=True)
+    return seg_nodes, seg_counts, seg_pipe > 0
 
 
 def _score_keys(score):
     """Order-preserving unsigned-integer keys for float scores: key(a) >
-    key(b) iff a > b.  (levels, utype) size the radix select below."""
-    if score.dtype == jnp.float64:
+    key(b) iff a > b.  (levels, utype) size the radix select below.
+
+    On TPU the float64 path downcasts to float32 first: XLA's x64-rewrite
+    pass cannot lower a u64 bitcast-convert on TPU (crashes at compile),
+    and score ORDER at f32 precision is what the hardware natively
+    supports — CPU runs (the x64 parity suite) keep the exact u64 path.
+    """
+    if score.dtype == jnp.float64 and jax.default_backend() != "tpu":
         bits = jax.lax.bitcast_convert_type(score, jnp.uint64)
         key = jnp.where(bits >> jnp.uint64(63) == 1, ~bits,
                         bits | jnp.uint64(1 << 63))
@@ -284,24 +310,38 @@ def allocate_groups_kernel(node_allocatable, node_idle, node_releasing,
         rel = rel - take_b[:, None] * req[None, :]
         room = room - take_a - take_b
 
-        nodes_a, counts_a = _compact(take_a, key, K)
-        nodes_b, counts_b = _compact(take_b, key, K)
+        nodes_a, counts_a, keys_a = _compact(take_a, key, K)
+        nodes_b, counts_b, keys_b = _compact(take_b, key, K)
         # Merge phases: A segments first, then B (pipelined) in the slots
-        # after A's.
-        a_used = (counts_a > 0).sum()
-        slot_b = jnp.arange(K) + a_used
-        seg_nodes = nodes_a.at[slot_b].set(
-            jnp.where(counts_b > 0, nodes_b, -1), mode="drop")
-        seg_counts = counts_a.at[slot_b].set(counts_b, mode="drop")
-        seg_pipe = (jnp.arange(K) >= a_used) & (seg_counts > 0)
+        # after A's — a dynamic-slice shift, not a scatter (dynamic-index
+        # scatters serialize on TPU).  A's nonzero segments are a
+        # contiguous prefix by construction.
+        a_used = (counts_a > 0).sum().astype(jnp.int32)
+        start = (K - a_used).astype(jnp.int32)
+        shift_n = jax.lax.dynamic_slice(
+            jnp.concatenate([jnp.full(K, -1, jnp.int32), nodes_b]),
+            (start,), (K,))
+        shift_c = jax.lax.dynamic_slice(
+            jnp.concatenate([jnp.zeros(K, counts_b.dtype), counts_b]),
+            (start,), (K,))
+        shift_k = jax.lax.dynamic_slice(
+            jnp.concatenate([jnp.zeros(K, keys_b.dtype), keys_b]),
+            (start,), (K,))
+        in_a = jnp.arange(K) < a_used
+        seg_nodes = jnp.where(in_a, nodes_a, shift_n)
+        seg_counts = jnp.where(in_a, counts_a, shift_c)
+        seg_keys = jnp.where(in_a, keys_a, shift_k)
+        seg_pipe = ~in_a & (seg_counts > 0)
 
         ok = ok & (placed >= count)
         return (Carry(idle, rel, room, ck_idle, ck_rel, ck_room,
                       j.astype(jnp.int32), ok),
-                (seg_nodes, seg_counts, seg_pipe, placed))
+                (seg_nodes, seg_counts, seg_pipe, seg_keys, placed))
 
-    carry, (seg_nodes, seg_counts, seg_pipe, group_placed) = jax.lax.scan(
-        step, init, jnp.arange(G))
+    carry, (seg_nodes, seg_counts, seg_pipe, seg_keys,
+            group_placed) = jax.lax.scan(step, init, jnp.arange(G))
+    seg_nodes, seg_counts, seg_pipe = _order_segments(
+        seg_nodes, seg_counts, seg_pipe, seg_keys)
     if single_group_jobs:
         idle, rel = carry.idle, carry.rel
     else:
@@ -390,10 +430,33 @@ def allocate_grouped(node_arrays, task_req, task_job, task_selector,
         single = len(g_job) == len(set(g_job.tolist()))
     max_group = _next_pow2(int(g_count.max()) if len(g_count) else 1)
 
+    # Pad the ragged group/job axes to power-of-two buckets: a steady
+    # backlog whose pending count drifts by a few jobs per cycle must not
+    # recompile the kernel every cycle (each distinct (G, J) is a fresh
+    # XLA compilation — seconds per cycle at burst scale).  Padded groups
+    # carry count 0 and point at padded jobs gated to False; padded jobs
+    # keep group_job values distinct so single-group mode is preserved.
+    n_real_groups = len(g_count)
+    n_real_jobs = len(allowed_np)
+    g_pad = _next_pow2(max(n_real_groups, 1)) - n_real_groups
+    n_jobs_padded = _next_pow2(max(n_real_jobs + g_pad, 1))
+    job_allowed_padded = np.zeros(n_jobs_padded, bool)
+    job_allowed_padded[:n_real_jobs] = allowed_np
+    if g_pad:
+        g_req = np.concatenate([g_req, np.zeros((g_pad, g_req.shape[1]))])
+        g_sel = np.concatenate(
+            [g_sel, np.full((g_pad, g_sel.shape[1]), -1, g_sel.dtype)])
+        g_tol = np.concatenate(
+            [g_tol, np.full((g_pad, g_tol.shape[1]), -1, g_tol.dtype)])
+        g_count = np.concatenate([g_count, np.zeros(g_pad)])
+        g_job = np.concatenate([
+            g_job, (n_real_jobs + np.arange(g_pad)).astype(np.int32)])
+        g_indep = np.concatenate([g_indep, np.zeros(g_pad, bool)])
+
     packed, idle, rel = _allocate_groups_packed(
         *node_arrays, jnp.asarray(g_req), jnp.asarray(g_sel),
         jnp.asarray(g_tol), jnp.asarray(g_count), jnp.asarray(g_job),
-        jnp.asarray(job_allowed), max_group=max_group,
+        jnp.asarray(job_allowed_padded), max_group=max_group,
         group_indep=jnp.asarray(g_indep),
         gpu_strategy=gpu_strategy, cpu_strategy=cpu_strategy,
         allow_pipeline=allow_pipeline, pipeline_only=pipeline_only,
@@ -402,13 +465,13 @@ def allocate_grouped(node_arrays, task_req, task_job, task_selector,
     g, k = len(g_count), max_group
     seg_nodes = packed[:g * k].reshape(g, k).astype(np.int32)
     seg_counts = packed[g * k:2 * g * k].reshape(g, k).astype(np.int64)
-    seg_pipe = packed[2 * g * k:3 * g * k].reshape(g, k) > 0.5
-    success = packed[3 * g * k:3 * g * k + len(job_allowed)] > 0.5
+    seg_pipe = packed[2 * g * k:3 * g * k] .reshape(g, k) > 0.5
+    success = packed[3 * g * k:3 * g * k + n_real_jobs] > 0.5
     T = np_req.shape[0]
     placements = np.full(T, -1, np.int32)
     pipelined = np.zeros(T, bool)
     t = 0
-    for g in range(len(g_count)):
+    for g in range(n_real_groups):
         k = int(g_count[g])
         # Merged independent runs expand partial placements in task order
         # (first jobs of the run win, like the sequential greedy); gangs

@@ -18,8 +18,7 @@ class PriorityPlugin(Plugin):
     """Jobs with higher PriorityClass value first (priority/priority.go)."""
 
     def on_session_open(self, ssn) -> None:
-        ssn.job_order_fns.append(self.job_order)
-        ssn.job_key_fns.append(lambda job: -job.priority)
+        ssn.add_job_order_fn(self.job_order, lambda job: -job.priority)
 
     @staticmethod
     def job_order(l, r) -> int:
@@ -39,8 +38,7 @@ class ElasticPlugin(Plugin):
     (elastic/elastic.go:21-25) — grow starved gangs first."""
 
     def on_session_open(self, ssn) -> None:
-        ssn.job_order_fns.append(self.job_order)
-        ssn.job_key_fns.append(_below_min)
+        ssn.add_job_order_fn(self.job_order, _below_min)
 
     @staticmethod
     def job_order(l, r) -> int:

@@ -45,6 +45,11 @@ class QueueAttributes:
     request: np.ndarray = field(default_factory=rs.zeros)
     usage: np.ndarray = field(default_factory=rs.zeros)
     fair_share: np.ndarray = field(default_factory=rs.zeros)
+    # Mutation stamp + sort-key memo: with a large backlog of identical
+    # pending jobs, the DRF queue key is recomputed per requeue although
+    # nothing changed — version bumps on every _walk touch.
+    version: int = 0
+    sort_key_cache: tuple | None = None
 
     def clone(self) -> "QueueAttributes":
         return QueueAttributes(
@@ -165,6 +170,7 @@ class ProportionPlugin(Plugin):
         q = self.queues.get(qid)
         while q is not None:
             setattr(q, attr, getattr(q, attr) + req)
+            q.version += 1
             q = self.queues.get(q.parent) if q.parent else None
 
     def _set_fair_share(self, ssn) -> None:
@@ -231,12 +237,15 @@ class ProportionPlugin(Plugin):
         collapses to a sum — a total-order approximation of the partial
         order the comparator uses."""
         q = self.queues[qid]
+        req = _job_req(peek_job)
+        stamp = (q.version, req.tobytes())
+        if q.sort_key_cache is not None and q.sort_key_cache[0] == stamp:
+            return q.sort_key_cache[1]
         over = _less(q.fair_share, q.allocated)
-        with_job = q.allocated + _job_req(peek_job)
+        with_job = q.allocated + req
         starved = _less_equal(with_job, q.deserved)
         viol = _zero_share_violation(q, with_job)
-        share_with_job = q.dominant_share(self.total,
-                                          _job_req(peek_job))
+        share_with_job = q.dominant_share(self.total, req)
         share0 = q.dominant_share(self.total)
         alloc_sum = float(np.where(q.allocatable_share() == UNLIMITED,
                                    self.total,
@@ -244,8 +253,10 @@ class ProportionPlugin(Plugin):
         # +alloc_sum: the smaller allocatable share wins the tie-break,
         # matching queue_order_fn and prioritizeBasedOnAllocatableShare
         # (queue_order.go).
-        return (over, not starved, -q.priority, viol, share_with_job,
-                share0, alloc_sum, q.creation_ts)
+        key = (over, not starved, -q.priority, viol, share_with_job,
+               share0, alloc_sum, q.creation_ts)
+        q.sort_key_cache = (stamp, key)
+        return key
 
     # -- queue ordering (queue_order/queue_order.go:19-242) ----------------
     def queue_order_fn(self, l: str, r: str, l_job, r_job,

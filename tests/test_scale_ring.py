@@ -24,10 +24,13 @@ RESULTS = pathlib.Path(__file__).resolve().parent.parent / \
     "docs" / "scale-tests" / "results.jsonl"
 
 N_NODES = 400
-# Generous CPU ceilings (the TPU path is benchmarked separately); the
-# point is catching order-of-magnitude regressions per commit.
-CEILINGS_S = {"fill": 60.0, "whole-gpu": 30.0, "distributed": 30.0,
-              "burst": 90.0, "reclaim": 60.0, "system-fill": 60.0}
+# CPU ceilings at ~2-3x the recorded medians (docs/scale-tests/
+# results.jsonl) — tight enough that a real regression fails, loose
+# enough for CI jit-compile variance.  The TPU path is benchmarked
+# separately (bench.py).
+CEILINGS_S = {"fill": 20.0, "whole-gpu": 12.0, "distributed": 15.0,
+              "burst": 35.0, "burst-steady": 2.0, "reclaim": 5.0,
+              "system-fill": 15.0}
 
 
 def _record(result: dict) -> None:
@@ -72,6 +75,10 @@ class TestScaleRing:
         # 2x demand: exactly capacity binds, the rest stays pending.
         assert r["pods_bound"] == N_NODES * 8
         assert r["first_cycle_s"] < CEILINGS_S["burst"]
+        # The backlog of identical unschedulable jobs must be near-free
+        # to re-attempt (signature skip + keyed ordering + memoized DRF
+        # keys + padded kernel shapes — no per-cycle recompiles).
+        assert r["steady_cycle_s"] < CEILINGS_S["burst-steady"]
 
     def test_reclaim_latency(self):
         r = scale_gen.run_scenario("reclaim", N_NODES)
