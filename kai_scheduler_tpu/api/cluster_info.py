@@ -102,12 +102,17 @@ class ClusterInfo:
         the two aggregates stay comparable."""
         return self.queue_aggregates()[0]
 
+    def invalidate_aggregates(self) -> None:
+        """Drop the memoized queue aggregates.  Statement mutations call
+        this so a mid-cycle reader never sees snapshot-open values after
+        task statuses have moved."""
+        self._queue_aggregates = None
+
     def queue_aggregates(self) -> tuple[dict, dict]:
         """(allocated, requested) in ONE pod walk — at 100k-node scale the
         walk itself dominates, so callers needing both (snapshot.pack)
-        must not pay it twice.  Memoized until the next snapshot build
-        (ClusterInfo is immutable between Statement transactions, which
-        operate on the packed mirrors, not these aggregates)."""
+        must not pay it twice.  Memoized until the next snapshot build or
+        the next Statement mutation (which calls invalidate_aggregates)."""
         cached = getattr(self, "_queue_aggregates", None)
         if cached is not None:
             return cached

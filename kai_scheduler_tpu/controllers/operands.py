@@ -21,6 +21,8 @@ import subprocess
 import tempfile
 from pathlib import Path
 
+from ..utils import parse_bool
+
 NAMESPACE = "kai-scheduler"
 SERVICES = ("apiserver", "scheduler", "controllers", "admission")
 
@@ -134,18 +136,24 @@ def render_operands(values: dict | None = None) -> list[dict]:
         }]})
 
     # RBAC: the scheduler/controllers read+write the scheduling objects.
+    # Rules are per-apiGroup (no cross-product): RBAC escalation checks
+    # compare literal (group, resource, verb) coverage, so a cross-product
+    # rule would force the granting operator to hold nonsense tuples.
+    verbs = ["get", "list", "watch", "create", "update", "patch", "delete"]
     out.append({
         "apiVersion": "rbac.authorization.k8s.io/v1",
         "kind": "ClusterRole", "metadata": {"name": "kai-scheduler-tpu"},
         "rules": [
-            {"apiGroups": ["", "kai.scheduler", "scheduling.kai",
-                           "coordination.k8s.io"],
-             "resources": ["pods", "nodes", "queues", "podgroups",
-                           "bindrequests", "schedulingshards",
-                           "topologies", "configmaps",
-                           "persistentvolumeclaims", "leases", "events"],
-             "verbs": ["get", "list", "watch", "create", "update",
-                       "patch", "delete"]}]})
+            {"apiGroups": [""],
+             "resources": ["pods", "nodes", "configmaps",
+                           "persistentvolumeclaims", "events"],
+             "verbs": verbs},
+            {"apiGroups": ["kai.scheduler", "scheduling.kai"],
+             "resources": ["queues", "podgroups", "bindrequests",
+                           "schedulingshards", "topologies"],
+             "verbs": verbs},
+            {"apiGroups": ["coordination.k8s.io"],
+             "resources": ["leases"], "verbs": verbs}]})
     out.append({
         "apiVersion": "rbac.authorization.k8s.io/v1",
         "kind": "ClusterRoleBinding",
@@ -170,28 +178,72 @@ def render_operands(values: dict | None = None) -> list[dict]:
     return out
 
 
+def _mint_cert_inprocess(cn: str) -> tuple[bytes, bytes]:
+    """Self-signed serving cert via the cryptography library — no external
+    binary needed at reconcile time (pkg/operator mints in-process too)."""
+    import datetime
+
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, cn)])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (x509.CertificateBuilder()
+            .subject_name(name).issuer_name(name)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(minutes=5))
+            .not_valid_after(now + datetime.timedelta(days=3650))
+            .add_extension(x509.SubjectAlternativeName([x509.DNSName(cn)]),
+                           critical=False)
+            .add_extension(x509.BasicConstraints(ca=True, path_length=None),
+                           critical=True)
+            .sign(key, hashes.SHA256()))
+    key_pem = key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.TraditionalOpenSSL,
+        serialization.NoEncryption())
+    return cert.public_bytes(serialization.Encoding.PEM), key_pem
+
+
+def _mint_cert_openssl(cn: str) -> tuple[bytes, bytes]:
+    with tempfile.TemporaryDirectory() as tmp:
+        tmp = Path(tmp)
+        subprocess.run(
+            ["openssl", "req", "-x509", "-newkey", "rsa:2048",
+             "-nodes", "-days", "3650", "-subj", f"/CN={cn}",
+             "-addext", f"subjectAltName=DNS:{cn}",
+             "-keyout", str(tmp / "tls.key"),
+             "-out", str(tmp / "tls.crt")],
+            check=True, capture_output=True, timeout=60)
+        return (tmp / "tls.crt").read_bytes(), (tmp / "tls.key").read_bytes()
+
+
 def generate_webhook_cert(service: str = "kai-admission",
-                          namespace: str = NAMESPACE) -> dict | None:
+                          namespace: str = NAMESPACE) -> dict:
     """Self-signed CA + serving cert for the admission webhook
     (pkg/operator cert management analog).  Returns
-    {"ca.crt", "tls.crt", "tls.key"} base64-encoded, or None when no
-    openssl toolchain is available (callers fall back to an external
-    cert-manager)."""
+    {"ca.crt", "tls.crt", "tls.key"} base64-encoded.  Minted in-process
+    via the cryptography library; an openssl subprocess is only a
+    fallback, and when neither works the failure is LOUD (RuntimeError) —
+    a webhook silently running without certs is undiagnosable."""
     cn = f"{service}.{namespace}.svc"
-    try:
-        with tempfile.TemporaryDirectory() as tmp:
-            tmp = Path(tmp)
-            subprocess.run(
-                ["openssl", "req", "-x509", "-newkey", "rsa:2048",
-                 "-nodes", "-days", "3650", "-subj", f"/CN={cn}",
-                 "-addext", f"subjectAltName=DNS:{cn}",
-                 "-keyout", str(tmp / "tls.key"),
-                 "-out", str(tmp / "tls.crt")],
-                check=True, capture_output=True, timeout=60)
-            key = (tmp / "tls.key").read_bytes()
-            crt = (tmp / "tls.crt").read_bytes()
-    except (OSError, subprocess.SubprocessError):
-        return None
+    errors = []
+    for minter in (_mint_cert_inprocess, _mint_cert_openssl):
+        try:
+            crt, key = minter(cn)
+            break
+        except Exception as exc:  # noqa: BLE001 — collected and re-raised
+            errors.append(f"{minter.__name__}: {exc!r}")
+    else:
+        raise RuntimeError(
+            "cannot mint webhook serving certificate; install the "
+            "'cryptography' package or an openssl binary, or provision "
+            "the kai-admission-tls Secret externally (cert-manager). "
+            + "; ".join(errors))
     b64 = lambda b: base64.b64encode(b).decode()
     return {"ca.crt": b64(crt), "tls.crt": b64(crt), "tls.key": b64(key)}
 
@@ -205,8 +257,6 @@ def reconcile_webhook_cert(api, operands: list[dict]) -> None:
         cert = existing["data"]
     else:
         cert = generate_webhook_cert()
-        if cert is None:
-            return
         api.create({"kind": "Secret",
                     "metadata": {"name": "kai-admission-tls",
                                  "namespace": NAMESPACE},
@@ -240,6 +290,85 @@ def apply_operands(api, values: dict | None = None) -> list[dict]:
     return operands
 
 
+def _load_values(args) -> dict:
+    """Merge static operator values: file < CLI flags.  A live Config
+    object (the reference operator's Config CRD, config_types.go:136)
+    is applied on top in main() — deliberately highest precedence, since
+    the Config object is the admin's in-cluster source of truth and must
+    win over whatever static flags the Deployment was rolled out with."""
+    import json
+
+    values: dict = {}
+    if args.values_file:
+        values.update(json.loads(Path(args.values_file).read_text()))
+    if args.image:
+        values["image"] = args.image
+    if args.leader_elect is not None:
+        values["leaderElection"] = args.leader_elect
+    return values
+
+
+def main(argv=None) -> None:
+    """In-cluster operator: connect to the API and reconcile the operand
+    set on a loop (the reference operator's controller-runtime reconcile,
+    pkg/operator/).  This is the entrypoint the Helm chart's operator
+    Deployment runs."""
+    import argparse
+    import json
+    import time
+
+    ap = argparse.ArgumentParser("kai-operator")
+    ap.add_argument("--in-cluster", action="store_true",
+                    help="connect via the pod's service account "
+                         "(KubernetesKubeAPI.in_cluster)")
+    ap.add_argument("--kubeconfig", default=None,
+                    help="connect to a real Kubernetes apiserver via "
+                         "kubeconfig")
+    ap.add_argument("--api-server", default=None,
+                    help="connect to a kai HTTP apiserver (embedded "
+                         "substrate) instead of Kubernetes")
+    ap.add_argument("--values-file", default=None,
+                    help="JSON values for render_operands")
+    ap.add_argument("--image", default=None)
+    ap.add_argument("--leader-elect", dest="leader_elect", nargs="?",
+                    const=True, default=None, type=parse_bool)
+    ap.add_argument("--interval", type=float, default=30.0)
+    ap.add_argument("--once", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.api_server:
+        from .httpclient import HTTPKubeAPI
+        api = HTTPKubeAPI(args.api_server)
+    elif args.kubeconfig:
+        from .k8sclient import KubernetesKubeAPI
+        api = KubernetesKubeAPI.from_kubeconfig(args.kubeconfig)
+    else:
+        from .k8sclient import KubernetesKubeAPI
+        api = KubernetesKubeAPI.in_cluster()
+
+    while True:
+        # One failed reconcile must not kill the operator: transient API
+        # errors retry next interval (controller-runtime requeue analog).
+        # --once propagates failures so CI/scripts see them.
+        try:
+            values = _load_values(args)
+            # Live Config object (named "kai-config") overrides static
+            # values — the admin edits it to retune the fleet without
+            # redeploying (highest precedence, see _load_values).
+            config = api.get_opt("Config", "kai-config", NAMESPACE)
+            if config is not None:
+                values.update(config.get("spec") or {})
+            applied = apply_operands(api, values)
+            print(json.dumps({"reconciled": len(applied)}), flush=True)
+        except Exception as exc:  # noqa: BLE001 — reconcile must survive
+            if args.once:
+                raise
+            print(json.dumps({"reconcile_error": repr(exc)}), flush=True)
+        if args.once:
+            break
+        time.sleep(args.interval)
+
+
 def _is_subset(rendered, current) -> bool:
     """Every rendered field equals current's value; fields the apiserver
     added (defaults) are ignored.  Lists compare element-wise with the
@@ -254,3 +383,7 @@ def _is_subset(rendered, current) -> bool:
             return False
         return all(_is_subset(a, b) for a, b in zip(rendered, current))
     return rendered == current
+
+
+if __name__ == "__main__":
+    main()

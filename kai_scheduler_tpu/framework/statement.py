@@ -94,6 +94,7 @@ class Statement:
             job.update_task_status(task, status)
         else:
             task.status = status
+        self.session.cluster.invalidate_aggregates()
         node.add_task(task)
         self._sync(node)
         self.session.fire_allocate_handlers(task)
@@ -111,6 +112,7 @@ class Statement:
             job.update_task_status(task, PodStatus.RELEASING)
         else:
             task.status = PodStatus.RELEASING
+        self.session.cluster.invalidate_aggregates()
         if node is not None:
             node.add_task(task)
             self._sync(node)
@@ -129,6 +131,7 @@ class Statement:
         task = op.task
         node = self.session.cluster.nodes.get(op.node_name)
         job = self.session.cluster.podgroups.get(task.job_id)
+        self.session.cluster.invalidate_aggregates()
         if op.kind in ("allocate", "pipeline"):
             if node is not None:
                 node.remove_task(task)

@@ -34,6 +34,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from .controllers import ShardSpec, System, SystemConfig
 from .framework.conf import SchedulerConfig
 from .plugins.snapshot_plugin import dump_cluster
+from .utils import parse_bool as _parse_bool
 from .utils.logging import LOG, init_loggers
 from .utils.metrics import METRICS
 
@@ -124,7 +125,10 @@ def run_app(argv=None) -> None:
     ap.add_argument("--schedule-period", type=float, default=1.0)
     ap.add_argument("--http-port", type=int, default=8080)
     ap.add_argument("--verbosity", "-v", type=int, default=0)
-    ap.add_argument("--leader-elect", action="store_true")
+    # Both `--leader-elect` and `--leader-elect=false` are valid: chart
+    # values templating renders the explicit form.
+    ap.add_argument("--leader-elect", nargs="?", const=True, default=False,
+                    type=_parse_bool)
     ap.add_argument("--lock-file", default="/tmp/kai-scheduler-tpu.lock")
     ap.add_argument("--api-server", default=None,
                     help="URL of a kai-apiserver; the fleet then runs over "

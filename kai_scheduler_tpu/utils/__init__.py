@@ -1,1 +1,15 @@
 """Shared utilities: metrics, logging."""
+
+
+import argparse
+
+
+def parse_bool(value: str) -> bool:
+    """Strict CLI boolean: chart templating renders --flag=true/false, and
+    a typo must fail loudly, not silently pick a default."""
+    lowered = value.strip().lower()
+    if lowered in ("true", "1", "yes", "on"):
+        return True
+    if lowered in ("false", "0", "no", "off"):
+        return False
+    raise argparse.ArgumentTypeError(f"not a boolean: {value!r}")

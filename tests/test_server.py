@@ -24,3 +24,20 @@ def test_leader_election_excludes_second_instance(tmp_path):
     a.release()
     assert got_b.wait(timeout=5.0)  # leadership transfers on release
     b.release()
+
+
+def test_leader_elect_flag_accepts_explicit_value():
+    """The chart renders --leader-elect={{ value }}; argparse must accept
+    both the bare flag and an explicit true/false (ADVICE r2: store_true
+    rejected the explicit form and crash-looped the pod)."""
+    import argparse
+
+    from kai_scheduler_tpu.server import _parse_bool
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--leader-elect", nargs="?", const=True, default=False,
+                    type=_parse_bool)
+    assert ap.parse_args([]).leader_elect is False
+    assert ap.parse_args(["--leader-elect"]).leader_elect is True
+    assert ap.parse_args(["--leader-elect=true"]).leader_elect is True
+    assert ap.parse_args(["--leader-elect=false"]).leader_elect is False
