@@ -46,7 +46,10 @@ class ClusterInfo:
                  resource_claims: dict | None = None,
                  config_maps: set | None = None,
                  pvcs: dict | None = None,
-                 resource_slices: dict | None = None):
+                 resource_slices: dict | None = None,
+                 storage_classes: dict | None = None,
+                 storage_claims: dict | None = None,
+                 storage_capacities: dict | None = None):
         self.nodes: dict[str, NodeInfo] = nodes or {}
         self.podgroups: dict[str, PodGroupInfo] = podgroups or {}
         self.queues: dict[str, QueueInfo] = queues or {}
@@ -63,6 +66,11 @@ class ClusterInfo:
         # PVC inventory for the schedule-time VolumeBinding filter:
         # (namespace, name) -> {"bound_node": str | None}.
         self.pvcs: dict = dict(pvcs or {})
+        # Schedule-time CSI storage infos (api/storage_info.py; mirrors
+        # cluster_info.go Snapshot storage fields).
+        self.storage_classes: dict = storage_classes or {}
+        self.storage_claims: dict = storage_claims or {}
+        self.storage_capacities: dict = storage_capacities or {}
         self.bind_requests: list[BindRequest] = []
         self.now = now
         # Stable orderings for tensor packing.
@@ -70,6 +78,11 @@ class ClusterInfo:
         for i, name in enumerate(self.node_order):
             self.nodes[name].idx = i
         self._wire_tasks_to_nodes()
+        if self.storage_capacities or self.storage_claims:
+            from .storage_info import link_storage_objects
+            link_storage_objects(self.storage_claims,
+                                 self.storage_capacities,
+                                 self.podgroups, self.nodes)
 
     def _wire_tasks_to_nodes(self) -> None:
         """Account every already-placed task on its node (snapshot build)."""
@@ -166,12 +179,27 @@ class ClusterInfo:
                            node.gpu_memory_per_device, node.max_pods,
                            node.idx, dict(node.mig_capacity))
             for name, node in self.nodes.items()}
+        # Storage infos are mutable (provisioned claims move with the
+        # statement), so the clone gets fresh objects; cloned tasks drop
+        # their claim dicts and re-link against the fresh infos.
+        cloned_claims = {k: c.clone()
+                         for k, c in self.storage_claims.items()}
+        cloned_caps = {}
+        for uid, cap in self.storage_capacities.items():
+            cc = cap.clone()
+            cc.provisioned_pvcs = {}  # re-derived by linking + add_task
+            cloned_caps[uid] = cc
+        cloned_pgs = {uid: pg.clone() for uid, pg in self.podgroups.items()}
+        for pg in cloned_pgs.values():
+            for task in pg.pods.values():
+                task.storage_claims = {}
+                task.owned_storage_claims = {}
         return ClusterInfo(
-            bare_nodes,
-            {uid: pg.clone() for uid, pg in self.podgroups.items()},
+            bare_nodes, cloned_pgs,
             dict(self.queues), dict(self.topologies), self.now,
             {k: dict(v) for k, v in self.resource_claims.items()},
             set(self.config_maps),
             {k: dict(v) for k, v in self.pvcs.items()},
             {n: {c: list(d) for c, d in by_class.items()}
-             for n, by_class in self.resource_slices.items()})
+             for n, by_class in self.resource_slices.items()},
+            dict(self.storage_classes), cloned_claims, cloned_caps)

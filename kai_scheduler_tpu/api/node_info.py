@@ -71,6 +71,11 @@ class NodeInfo:
         self.mig_capacity: dict[str, float] = dict(mig_capacity or {})
         self.mig_used: dict[str, float] = {}
         self.mig_releasing: dict[str, float] = {}
+        # Schedule-time CSI storage: storage_class ->
+        # [StorageCapacityInfo] this node can provision from
+        # (node_info.go:91 AccessibleStorageCapacities, populated by
+        # api/storage_info.link_storage_objects).
+        self.accessible_capacities: dict[str, list] = {}
 
     # -- derived quantities ------------------------------------------------
     @property
@@ -119,6 +124,7 @@ class NodeInfo:
             self.used += req
             self._mig_account(task, used=+1)
         self.pod_infos[task.uid] = task
+        self._add_task_storage(task)
         if task.is_fractional and task.gpu_group:
             self._add_to_gpu_group(task)
 
@@ -135,8 +141,56 @@ class NodeInfo:
             self.used -= req
             self._mig_account(task, used=-1)
         self.pod_infos.pop(task.uid, None)
+        self._remove_task_storage(task)
         if task.is_fractional and task.gpu_group:
             self._remove_from_gpu_group(task)
+
+    # -- schedule-time CSI storage (node_info.go:200-268,438-463,553-570) --
+    def _add_task_storage(self, task: PodInfo) -> None:
+        """addTaskStorage: charge the task's pending claims into every
+        accessible capacity of their class (idempotent dict insert)."""
+        if not self.accessible_capacities or not task.storage_claims:
+            return
+        for cls, claims in task.pending_claims_by_class().items():
+            for cap in self.accessible_capacities.get(cls, []):
+                for claim in claims:
+                    cap.provisioned_pvcs[claim.key] = claim
+
+    def _remove_task_storage(self, task: PodInfo) -> None:
+        """removeTaskStorage: the inverse."""
+        if not self.accessible_capacities or not task.storage_claims:
+            return
+        for cls, claims in task.pending_claims_by_class().items():
+            for cap in self.accessible_capacities.get(cls, []):
+                for claim in claims:
+                    cap.provisioned_pvcs.pop(claim.key, None)
+
+    def is_task_storage_allocatable(self, task: PodInfo,
+                                    allow_releasing: bool = False,
+                                    pod_infos: dict | None = None) -> bool:
+        """isTaskStorageAllocatable(-OnReleasingOrIdle): every pending
+        claim's class must have an accessible capacity here that fits the
+        class's total pending demand.  Deleted-owner claims are a hard
+        no (the PVC is being garbage-collected with its pod)."""
+        if not task.storage_claims:
+            return True
+        if task.deleted_storage_claim_names():
+            return False
+        for cls, claims in task.pending_claims_by_class().items():
+            caps = self.accessible_capacities.get(cls)
+            if not caps:
+                return False
+            if allow_releasing:
+                ok = all(cap.are_pvcs_allocatable_on_releasing_or_idle(
+                    claims, pod_infos if pod_infos is not None
+                    else self.pod_infos) for cap in caps)
+            else:
+                # Demand could land on any one capacity: feasible if ANY
+                # fits (isTaskStorageAllocatableOnCapacities).
+                ok = any(cap.are_pvcs_allocatable(claims) for cap in caps)
+            if not ok:
+                return False
+        return True
 
     def _mig_account(self, task: PodInfo, used: int = 0,
                      releasing: int = 0) -> None:
@@ -169,6 +223,8 @@ class NodeInfo:
         """
         if len(self.pod_infos) >= self.max_pods:
             return False
+        if not self.is_task_storage_allocatable(task):
+            return False
         if task.is_fractional:
             return self._fits_fraction(task, allow_releasing=False)
         if not self.has_mig_room(task, allow_releasing=False):
@@ -181,6 +237,8 @@ class NodeInfo:
         Mirrors IsTaskAllocatableOnReleasingOrIdle (node_info.go:190).
         """
         if len(self.pod_infos) >= self.max_pods:
+            return False
+        if not self.is_task_storage_allocatable(task, allow_releasing=True):
             return False
         if task.is_fractional:
             return self._fits_fraction(task, allow_releasing=True)

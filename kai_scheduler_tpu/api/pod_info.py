@@ -13,7 +13,8 @@ from typing import Optional
 
 import numpy as np
 
-from .pod_status import PodStatus, is_active_allocated, is_active_used
+from .pod_status import (PodStatus, is_active_allocated, is_active_used,
+                         is_alive)
 from .resources import ResourceRequirements
 
 DEFAULT_SUBGROUP = "default"
@@ -110,6 +111,11 @@ class PodInfo:
     anti_affinity_terms: list = field(default_factory=list)   # required
     preferred_affinity_terms: list = field(default_factory=list)
     preferred_anti_affinity_terms: list = field(default_factory=list)
+    # Schedule-time CSI storage (api/storage_info.py): all claims this
+    # pod references, and the subset it exclusively owns (deleted with
+    # the pod).  Mirrors pod_info.go storageClaims/ownedStorageClaims.
+    storage_claims: dict = field(default_factory=dict)
+    owned_storage_claims: dict = field(default_factory=dict)
     # Index into the packed task tensor for the current snapshot.
     tensor_idx: int = -1
 
@@ -118,6 +124,54 @@ class PodInfo:
 
     def is_active_allocated(self) -> bool:
         return is_active_allocated(self.status)
+
+    def is_alive(self) -> bool:
+        return is_alive(self.status)
+
+    # -- schedule-time CSI storage (pod_info.go:114-168) -------------------
+    def upsert_storage_claim(self, claim) -> None:
+        """UpsertStorageClaim: track the claim; a claim owned by THIS pod
+        is also 'owned' (it dies with the pod), and seeing the live pod
+        clears the deleted-owner flag."""
+        owner = claim.pod_owner
+        if owner is not None and owner.pod_uid == self.uid:
+            self.owned_storage_claims[claim.key] = claim
+            claim.deleted_owner = False
+        self.storage_claims[claim.key] = claim
+
+    def needs_storage_scheduling(self) -> bool:
+        """True when placement must track CSI capacity host-side: the
+        task has claims that will consume new capacity (or are being
+        garbage-collected).  Routes the task down the sequential host
+        path, like fractional/MIG/DRA."""
+        return bool(self.storage_claims) and (
+            bool(self.deleted_storage_claim_names())
+            or bool(self.pending_claims_by_class()))
+
+    def deleted_storage_claim_names(self) -> list:
+        """Claims whose owning pod is gone: the PVC is being garbage
+        collected, the task can never start (GetDeletedStorageClaimsNames
+        -> isTaskStorageAllocatable hard failure)."""
+        return [f"{ns}/{name}" for (ns, name), c
+                in self.storage_claims.items() if c.deleted_owner]
+
+    def pending_claims_by_class(self) -> dict:
+        """GetUnboundOrReleasingStorageClaimsByStorageClass: claims that
+        will consume new capacity if this pod is placed — Pending ones,
+        plus owned claims of a pod that was (virtually) evicted and is
+        being re-placed (its PVCs get deleted and re-provisioned)."""
+        out: dict = {}
+        for claim in self.storage_claims.values():
+            if claim.phase == "Pending":
+                out.setdefault(claim.storage_class, []).append(claim)
+        if not self.is_active_allocated():
+            for claim in self.owned_storage_claims.values():
+                if claim.phase != "Pending":
+                    # The evicted owner's Bound claim will be deleted and
+                    # re-provisioned: it consumes capacity again.
+                    claim.reprovision = True
+                    out.setdefault(claim.storage_class, []).append(claim)
+        return out
 
     @property
     def is_fractional(self) -> bool:
@@ -146,6 +200,10 @@ class PodInfo:
         inst.host_ports = set(self.host_ports)
         inst.required_configmaps = list(self.required_configmaps)
         inst.pvc_names = list(self.pvc_names)
+        # Claims re-link each snapshot (link_storage_objects) — never
+        # share the template's dicts across cycles.
+        inst.storage_claims = {}
+        inst.owned_storage_claims = {}
         return inst
 
     def clone(self) -> "PodInfo":
@@ -173,5 +231,7 @@ class PodInfo:
                 t.clone() for t in self.preferred_affinity_terms],
             preferred_anti_affinity_terms=[
                 t.clone() for t in self.preferred_anti_affinity_terms],
+            storage_claims=dict(self.storage_claims),
+            owned_storage_claims=dict(self.owned_storage_claims),
             tensor_idx=self.tensor_idx,
         )

@@ -1,0 +1,304 @@
+"""Schedule-time CSI storage model.
+
+Mirrors the reference's storage snapshot + capacity algebra
+(pkg/scheduler/cache/cluster_info/storage.go:1-241,
+pkg/scheduler/api/storagecapacity_info/storagecapacity_info.go,
+pkg/scheduler/api/storageclaim_info/storageclaim_info.go,
+pkg/scheduler/api/storageclass_info, pkg/scheduler/api/csidriver_info):
+
+- only **WaitForFirstConsumer** StorageClasses whose provisioner is a
+  CSI driver with ``storageCapacity: true`` participate in advanced
+  scheduling (storage.go snapshotStorageClasses + filterStorageClasses);
+- each ``CSIStorageCapacity`` object advertises a byte capacity for one
+  storage class over a node-topology label selector; nodes gain
+  ``accessible_capacities`` per class (storage.go:135-145), and a node
+  seeing >1 capacity for one class opts out of advanced scheduling
+  entirely (handleMultiCapacityNodes:148-158 — the reference does not
+  know how to split demand between them);
+- pending claims charge capacity while bound claims are already counted
+  in the CSI driver's reported number, so
+  ``allocatable = capacity - sum(pending provisioned claims)``
+  (storagecapacity_info.go Allocatable:131-146);
+- claims owned by a dying pod count as *releasing* capacity for the
+  pipelining path (Releasing:148-168).
+
+This state is sparse and transactional (it mutates as the statement
+places/evicts tasks), so it stays host-side — like fractional-GPU groups
+and DRA claims — while whole-node resource math rides the packed tensors.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+WAIT_FOR_FIRST_CONSUMER = "WaitForFirstConsumer"
+
+
+@dataclass
+class CSIDriverInfo:
+    """csidriver_info.CSIDriverInfo: name + whether the driver publishes
+    CSIStorageCapacity objects (spec.storageCapacity)."""
+    name: str
+    capacity_enabled: bool = False
+
+
+@dataclass
+class StorageClassInfo:
+    """storageclass_info.StorageClassInfo (only WaitForFirstConsumer
+    classes survive the snapshot filter)."""
+    name: str
+    provisioner: str = ""
+
+
+@dataclass
+class PodOwnerRef:
+    pod_uid: str
+    pod_name: str
+    pod_namespace: str
+
+
+@dataclass
+class StorageClaimInfo:
+    """storageclaim_info.StorageClaimInfo: one PVC.
+
+    ``pod_owner`` is set only when the PVC has exactly one owner
+    reference and it is a Pod (GetPodOwner, storageclaim_info.go:96-111);
+    ``deleted_owner`` starts True for owned claims and is cleared when
+    the owning pod is seen alive (MarkOwnerAlive)."""
+    namespace: str
+    name: str
+    size: float = 0.0                   # bytes
+    phase: str = "Pending"              # Pending | Bound | Lost
+    storage_class: str = ""
+    pod_owner: PodOwnerRef | None = None
+    deleted_owner: bool = False
+    # Set when a Bound owned claim re-enters the pending demand pool
+    # because its owner pod was (virtually) evicted: the PVC will be
+    # deleted and re-provisioned, so it must charge capacity again even
+    # though its phase still reads Bound.  Without this, two re-placed
+    # evictees with Bound claims could overcommit a capacity.
+    reprovision: bool = False
+
+    @property
+    def key(self) -> tuple[str, str]:
+        return (self.namespace, self.name)
+
+    def consumes_capacity(self) -> bool:
+        """Does this claim subtract from a capacity's allocatable bytes?
+        Bound claims are already inside the driver-reported number
+        (Allocatable, storagecapacity_info.go:131-146) — unless they are
+        being re-provisioned with a re-placed evictee."""
+        return self.phase != "Bound" or self.reprovision
+
+    def clone(self) -> "StorageClaimInfo":
+        return StorageClaimInfo(self.namespace, self.name, self.size,
+                                self.phase, self.storage_class,
+                                self.pod_owner, self.deleted_owner,
+                                self.reprovision)
+
+
+def _match_expressions(selector: dict, labels: dict) -> bool:
+    for expr in selector.get("matchExpressions") or []:
+        key, op = expr.get("key"), expr.get("operator")
+        values = expr.get("values") or []
+        val = labels.get(key)
+        if op == "In":
+            if val not in values:
+                return False
+        elif op == "NotIn":
+            if val in values:
+                return False
+        elif op == "Exists":
+            if key not in labels:
+                return False
+        elif op == "DoesNotExist":
+            if key in labels:
+                return False
+    return True
+
+
+@dataclass
+class StorageCapacityInfo:
+    """storagecapacity_info.StorageCapacityInfo: one CSIStorageCapacity.
+
+    ``provisioned_pvcs`` holds every claim charged against this capacity:
+    bound claims of placed pods (linked at snapshot) plus pending claims
+    of tasks the statement has (possibly virtually) placed here."""
+    uid: str
+    name: str
+    storage_class: str
+    capacity: float = 0.0               # bytes, as reported by the driver
+    maximum_volume_size: float = 0.0    # 0 = unlimited
+    node_topology: dict = field(default_factory=dict)  # LabelSelector
+    provisioned_pvcs: dict = field(default_factory=dict)
+
+    def clone(self) -> "StorageCapacityInfo":
+        return StorageCapacityInfo(
+            self.uid, self.name, self.storage_class, self.capacity,
+            self.maximum_volume_size, self.node_topology,
+            dict(self.provisioned_pvcs))
+
+    def is_node_valid(self, node_labels: dict) -> bool:
+        """nodeTopology label-selector match (IsNodeValid)."""
+        sel = self.node_topology
+        if not sel:
+            return True
+        for k, v in (sel.get("matchLabels") or {}).items():
+            if node_labels.get(k) != v:
+                return False
+        return _match_expressions(sel, node_labels)
+
+    def allocatable(self) -> float:
+        """capacity minus claims consuming new provisioning — pending
+        ones plus Bound claims marked for re-provisioning
+        (Allocatable, storagecapacity_info.go:131-146)."""
+        pending = sum(c.size for c in self.provisioned_pvcs.values()
+                      if c.consumes_capacity())
+        return self.capacity - pending
+
+    def releasing(self, pod_infos: dict) -> float:
+        """Capacity of claims owned by pods that are no longer alive
+        (Releasing:148-168): it frees once those pods go away."""
+        total = 0.0
+        for claim in self.provisioned_pvcs.values():
+            owner = claim.pod_owner
+            if owner is None:
+                continue
+            pod = pod_infos.get(owner.pod_uid)
+            if pod is None or not pod.is_alive():
+                total += claim.size
+        return total
+
+    def are_pvcs_allocatable(self, pvcs: list) -> bool:
+        """sum(requested) <= allocatable (ArePVCsAllocatable:96-109)."""
+        return sum(p.size for p in pvcs) <= self.allocatable() + 1e-6
+
+    def are_pvcs_allocatable_on_releasing_or_idle(
+            self, pvcs: list, pod_infos: dict) -> bool:
+        """Pipelining variant: releasing capacity counts too
+        (ArePVCsAllocatableOnReleasingOrIdle:113-128)."""
+        total = sum(p.size for p in pvcs)
+        return total <= self.allocatable() + self.releasing(pod_infos) + 1e-6
+
+
+def parse_quantity(q) -> float:
+    """Kubernetes quantity -> bytes/count float ('10Gi', '500m', 3)."""
+    if q is None:
+        return 0.0
+    if isinstance(q, (int, float)):
+        return float(q)
+    s = str(q).strip()
+    suffixes = {"Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40,
+                "Pi": 2**50, "Ei": 2**60, "k": 1e3, "M": 1e6, "G": 1e9,
+                "T": 1e12, "P": 1e15, "E": 1e18}
+    for suf in sorted(suffixes, key=len, reverse=True):
+        if s.endswith(suf):
+            return float(s[: -len(suf)]) * suffixes[suf]
+    if s.endswith("m"):
+        return float(s[:-1]) / 1000.0
+    return float(s)
+
+
+def build_storage_snapshot(drivers: list, classes: list, claims: list,
+                           capacities: list) -> tuple[dict, dict, dict]:
+    """The snapshot filter chain (storage.go snapshot* + filter*):
+    returns (storage_classes, storage_claims, storage_capacities) with
+    only the objects that participate in advanced CSI scheduling.
+
+    Inputs are raw manifest dicts straight off the API."""
+    driver_infos = {}
+    for d in drivers:
+        name = d["metadata"]["name"]
+        driver_infos[name] = CSIDriverInfo(
+            name, bool((d.get("spec") or {}).get("storageCapacity")))
+
+    class_infos = {}
+    for sc in classes:
+        mode = sc.get("volumeBindingMode")
+        if mode != WAIT_FOR_FIRST_CONSUMER:
+            continue  # Immediate classes bind before scheduling; skip.
+        provisioner = sc.get("provisioner", "")
+        driver = driver_infos.get(provisioner)
+        if driver is None or not driver.capacity_enabled:
+            # filterStorageClasses: non-CSI (or capacity-less) provisioner
+            # -> no advanced scheduling for this class.
+            continue
+        name = sc["metadata"]["name"]
+        class_infos[name] = StorageClassInfo(name, provisioner)
+
+    claim_infos = {}
+    for pvc in claims:
+        md = pvc["metadata"]
+        spec = pvc.get("spec") or {}
+        sc_name = spec.get("storageClassName") or ""
+        if sc_name not in class_infos:
+            continue  # filterStorageClaims
+        owners = md.get("ownerReferences") or []
+        pod_owner = None
+        if len(owners) == 1 and owners[0].get("kind", "").lower() == "pod":
+            pod_owner = PodOwnerRef(owners[0].get("uid", ""),
+                                    owners[0].get("name", ""),
+                                    md.get("namespace", "default"))
+        info = StorageClaimInfo(
+            md.get("namespace", "default"), md["name"],
+            parse_quantity(((spec.get("resources") or {})
+                            .get("requests") or {}).get("storage")),
+            (pvc.get("status") or {}).get("phase", "Pending"),
+            sc_name, pod_owner,
+            deleted_owner=pod_owner is not None)
+        claim_infos[info.key] = info
+
+    capacity_infos = {}
+    for cap in capacities:
+        md = cap["metadata"]
+        sc_name = cap.get("storageClassName", "")
+        if sc_name not in class_infos:
+            continue
+        uid = md.get("uid") or f"{md.get('namespace', 'default')}/" \
+                               f"{md['name']}"
+        capacity_infos[uid] = StorageCapacityInfo(
+            uid, md["name"], sc_name,
+            parse_quantity(cap.get("capacity")),
+            parse_quantity(cap.get("maximumVolumeSize")),
+            cap.get("nodeTopology") or {})
+    return class_infos, claim_infos, capacity_infos
+
+
+def link_storage_objects(storage_claims: dict, storage_capacities: dict,
+                         podgroups: dict, nodes: dict) -> None:
+    """linkStorageObjects (storage.go:120-216): attach capacities to
+    nodes by topology, claims to tasks by volume reference, and charge
+    placed tasks' claims into their node's capacities."""
+    for cap in storage_capacities.values():
+        for node in nodes.values():
+            if cap.is_node_valid(node.labels):
+                node.accessible_capacities.setdefault(
+                    cap.storage_class, []).append(cap)
+    # handleMultiCapacityNodes: ambiguity -> opt the node out entirely.
+    for node in nodes.values():
+        if any(len(caps) > 1
+               for caps in node.accessible_capacities.values()):
+            node.accessible_capacities = {}
+
+    tasks_by_uid = {}
+    for pg in podgroups.values():
+        for task in pg.pods.values():
+            tasks_by_uid[task.uid] = task
+            for pvc_name in task.pvc_names:
+                claim = storage_claims.get((task.namespace, pvc_name))
+                if claim is None:
+                    continue
+                task.upsert_storage_claim(claim)
+
+    # linkStorageClaimsToStorageCapacities: bound pods' claims occupy
+    # their node's capacities.
+    for task in tasks_by_uid.values():
+        if not task.node_name:
+            continue
+        node = nodes.get(task.node_name)
+        if node is None or not task.is_active_allocated():
+            continue
+        for claim in task.storage_claims.values():
+            for cap in node.accessible_capacities.get(
+                    claim.storage_class, []):
+                cap.provisioned_pvcs[claim.key] = claim

@@ -35,6 +35,10 @@ class VolumeBindingPlugin(BindPlugin):
     def pre_bind(self, api, pod, node_name, bind_request) -> None:
         for vol in pod.get("spec", {}).get("volumes", []) or []:
             claim = vol.get("persistentVolumeClaim", {}).get("claimName")
+            if not claim and vol.get("ephemeral") is not None \
+                    and vol.get("name"):
+                # Generic ephemeral volume: PVC named <pod>-<volume>.
+                claim = f"{pod['metadata']['name']}-{vol['name']}"
             if not claim:
                 continue
             pvc = api.get_opt("PersistentVolumeClaim", claim,

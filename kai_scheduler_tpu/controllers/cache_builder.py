@@ -118,6 +118,12 @@ def _parse_pod_predicates(task: PodInfo, pod: dict) -> None:
         claim = (vol.get("persistentVolumeClaim") or {}).get("claimName")
         if claim:
             task.pvc_names.append(claim)
+        elif vol.get("ephemeral") is not None and vol.get("name"):
+            # Generic ephemeral inline volume: its PVC is named
+            # <pod>-<volume> (storage.go:173-176, upstream
+            # ephemeral.VolumeClaimName).
+            task.pvc_names.append(
+                f"{pod['metadata']['name']}-{vol['name']}")
     for ref in spec.get("resourceClaims") or []:
         name = ref.get("resourceClaimName") or ref.get("name")
         if name:
@@ -333,18 +339,29 @@ class ClusterCache:
             (cm["metadata"].get("namespace", "default"),
              cm["metadata"]["name"])
             for cm in self.api.list("ConfigMap")}
+        pvc_objs = self.api.list("PersistentVolumeClaim")
         pvcs = {}
-        for pvc in self.api.list("PersistentVolumeClaim"):
+        for pvc in pvc_objs:
             md = pvc["metadata"]
             pvcs[(md.get("namespace", "default"), md["name"])] = {
                 "bound_node": md.get("annotations", {}).get(
                     "volume.kubernetes.io/selected-node")}
 
+        # Schedule-time CSI storage (storage.go snapshot* chain).
+        from ..api.storage_info import build_storage_snapshot
+        storage_classes, storage_claims, storage_capacities = \
+            build_storage_snapshot(
+                self.api.list("CSIDriver"), self.api.list("StorageClass"),
+                pvc_objs, self.api.list("CSIStorageCapacity"))
+
         return ClusterInfo(nodes, podgroups, queues, topologies,
                            now=self.now_fn(),
                            resource_claims=resource_claims,
                            config_maps=config_maps, pvcs=pvcs,
-                           resource_slices=resource_slices)
+                           resource_slices=resource_slices,
+                           storage_classes=storage_classes,
+                           storage_claims=storage_claims,
+                           storage_capacities=storage_capacities)
 
     # -- side-effect executor (framework Session cache interface) ------------
     def bind(self, task, node_name: str, bind_request) -> None:

@@ -42,6 +42,10 @@ KIND_ROUTES = {
     "PersistentVolumeClaim": ("api/v1", "persistentvolumeclaims", True),
     "ResourceClaim": ("apis/resource.k8s.io/v1", "resourceclaims", True),
     "ResourceSlice": ("apis/resource.k8s.io/v1", "resourceslices", False),
+    "CSIDriver": ("apis/storage.k8s.io/v1", "csidrivers", False),
+    "StorageClass": ("apis/storage.k8s.io/v1", "storageclasses", False),
+    "CSIStorageCapacity": ("apis/storage.k8s.io/v1",
+                           "csistoragecapacities", True),
     "Deployment": ("apis/apps/v1", "deployments", True),
     "Lease": ("apis/coordination.k8s.io/v1", "leases", True),
     "Queue": ("apis/kai.scheduler/v1", "queues", False),

@@ -79,7 +79,10 @@ class UpstreamPredicatesPlugin(Plugin):
 
     def _pvcs_exist(self, task) -> SchedulableResult:
         """volume_binding.go filter, cluster-level half: referenced PVCs
-        must exist (unbound WaitForFirstConsumer ones bind later)."""
+        must exist (unbound WaitForFirstConsumer ones bind later), and
+        none may be mid-garbage-collection with its dead owner pod
+        (isTaskStorageAllocatable's deleted-claims hard failure,
+        node_info.go:212-215)."""
         missing = [name for name in task.pvc_names
                    if (task.namespace, name) not in self.ssn.cluster.pvcs]
         if missing:
@@ -87,6 +90,11 @@ class UpstreamPredicatesPlugin(Plugin):
                 False, "VolumeBinding",
                 f"pod {task.namespace}/{task.name} references missing "
                 f"PersistentVolumeClaims: {missing}")
+        deleted = task.deleted_storage_claim_names()
+        if deleted:
+            return SchedulableResult(
+                False, "VolumeBinding",
+                f"task has deleted storage claims: {deleted}")
         return SchedulableResult()
 
     # -- node-level filters as hard masks ----------------------------------
@@ -117,6 +125,49 @@ class UpstreamPredicatesPlugin(Plugin):
                     if idx >= 0:
                         keep[idx] = True
                     out[i] &= keep
+            if task.needs_storage_scheduling():
+                out[i] &= self._storage_mask(task, n)
+        return out
+
+    def _storage_mask(self, task, n: int) -> np.ndarray:
+        """[N] bool: nodes whose accessible CSI capacities can host the
+        task's pending claims (releasing-permissive ceiling — the exact
+        idle-vs-releasing split is enforced by NodeInfo checks on the
+        sequential host path).  Feasibility is computed once per
+        *capacity* (few), then mapped onto nodes (many); the pod-infos
+        dict is memoized per mutation tick (it is O(total pods))."""
+        cluster = self.ssn.cluster
+        pending = task.pending_claims_by_class()
+        feasible_caps: dict[str, set] = {}
+        for cls, claims in pending.items():
+            feasible_caps[cls] = {
+                cap.uid for cap in cluster.storage_capacities.values()
+                if cap.storage_class == cls
+                and cap.are_pvcs_allocatable_on_releasing_or_idle(
+                    claims, self._all_pod_infos())}
+        keep = np.zeros(n, bool)
+        for name in cluster.node_order:
+            node = cluster.nodes[name]
+            ok = True
+            for cls in pending:
+                caps = node.accessible_capacities.get(cls)
+                if not caps or not any(c.uid in feasible_caps[cls]
+                                       for c in caps):
+                    ok = False
+                    break
+            if ok and 0 <= node.idx < n:
+                keep[node.idx] = True
+        return keep
+
+    def _all_pod_infos(self) -> dict:
+        tick = self.ssn.mutation_count
+        cached = getattr(self, "_pods_cache", None)
+        if cached is not None and cached[0] == tick:
+            return cached[1]
+        out = {}
+        for pg in self.ssn.cluster.podgroups.values():
+            out.update(pg.pods)
+        self._pods_cache = (tick, out)
         return out
 
     def _ports_by_node(self) -> dict:

@@ -113,6 +113,27 @@ def build_cluster(spec: dict) -> ClusterInfo:
             pg.add_task(task)
         podgroups[name] = pg
 
+    # Schedule-time CSI storage: raw manifest lists, run through the same
+    # snapshot filter chain as the live cache (api/storage_info.py).
+    storage = spec.get("storage") or {}
+    storage_classes = storage_claims = storage_capacities = None
+    pvcs = {(k if isinstance(k, tuple) else ("default", k)): dict(v)
+            for k, v in spec.get("pvcs", {}).items()}
+    if storage:
+        from ..api.storage_info import build_storage_snapshot
+        storage_classes, storage_claims, storage_capacities = \
+            build_storage_snapshot(
+                storage.get("csi_drivers", []), storage.get("classes", []),
+                storage.get("claims", []), storage.get("capacities", []))
+        # Every claim manifest is also a PVC for the existence prefilter
+        # (the live cache derives both from the same list).
+        for pvc in storage.get("claims", []):
+            md = pvc["metadata"]
+            pvcs.setdefault(
+                (md.get("namespace", "default"), md["name"]),
+                {"bound_node": (md.get("annotations") or {}).get(
+                    "volume.kubernetes.io/selected-node")})
+
     return ClusterInfo(
         nodes, podgroups, queues,
         topologies=spec.get("topologies", {}),
@@ -121,9 +142,11 @@ def build_cluster(spec: dict) -> ClusterInfo:
         config_maps={(ns_name if isinstance(ns_name, tuple)
                       else ("default", ns_name))
                      for ns_name in spec.get("config_maps", ())},
-        pvcs={(k if isinstance(k, tuple) else ("default", k)): dict(v)
-              for k, v in spec.get("pvcs", {}).items()},
-        resource_slices=spec.get("resource_slices", {}))
+        pvcs=pvcs,
+        resource_slices=spec.get("resource_slices", {}),
+        storage_classes=storage_classes,
+        storage_claims=storage_claims,
+        storage_capacities=storage_capacities)
 
 
 def build_session(spec: dict, config: SchedulerConfig | None = None
