@@ -133,12 +133,34 @@ def _execute_bulk(ssn, jobs):
             by_queue: dict = {}
             for pg in pending:
                 by_queue.setdefault(pg.queue_id, []).append(pg)
-            queue_keys = {}
-            for qid, qjobs in by_queue.items():
+            for qjobs in by_queue.values():
                 qjobs.sort(key=ssn.job_sort_key)
-                queue_keys[qid] = ssn.queue_key_fn(qid, qjobs[0])
+            # Hierarchical ordering, key form: a leaf sorts by the chain
+            # of ancestor queue keys root->leaf (each ancestor keyed with
+            # its subtree's best job), matching the strict
+            # JobsOrderByQueues tree order — a department's standing
+            # decides before its leaves do.
+            best_in_subtree: dict = {}
+            queues = ssn.cluster.queues
+            for qid, qjobs in by_queue.items():
+                node, job = qid, qjobs[0]
+                while node:
+                    cur = best_in_subtree.get(node)
+                    if cur is None or ssn.job_sort_key(job) \
+                            < ssn.job_sort_key(cur):
+                        best_in_subtree[node] = job
+                    node = getattr(queues.get(node), "parent", None)
+            path_keys = {}
+            for qid in by_queue:
+                chain, node = [], qid
+                while node:
+                    chain.append(node)
+                    node = getattr(queues.get(node), "parent", None)
+                path_keys[qid] = tuple(
+                    ssn.queue_key_fn(anc, best_in_subtree[anc])
+                    for anc in reversed(chain))
             ordered = sorted(
-                pending, key=lambda pg: (queue_keys[pg.queue_id],
+                pending, key=lambda pg: (path_keys[pg.queue_id],
                                          ssn.job_sort_key(pg)))
         else:
             order = JobsOrderByQueues(ssn, pending)
@@ -361,6 +383,7 @@ def _allocate_tasks_on_subset(ssn, stmt, job, tasks, node_subset,
     # placement (mutation tick) and does.
     host_path = any(t.is_fractional or t.resource_claims
                     or t.res_req.mig_resources or t.host_ports
+                    or t.needs_storage_scheduling()
                     for t in tasks)
     if host_path:
         ok = _allocate_task_by_task(ssn, stmt, job, tasks, node_subset,
@@ -394,7 +417,10 @@ def _allocate_task_by_task(ssn, stmt, job, tasks, node_subset,
         elif task.resource_claims:
             placed = _allocate_with_claims(ssn, stmt, task, node_subset,
                                            pipeline_only)
-        elif task.res_req.mig_resources:
+        elif task.res_req.mig_resources or task.needs_storage_scheduling():
+            # MIG inventory and CSI storage capacity are both sparse
+            # host-side state: scan nodes best-score-first with the full
+            # NodeInfo checks (which cover both).
             placed = _allocate_mig(ssn, stmt, task, node_subset,
                                    pipeline_only)
         else:
@@ -443,10 +469,11 @@ def _allocate_fractional(ssn, stmt, task, node_subset,
 
 def _allocate_mig(ssn, stmt, task, node_subset,
                   pipeline_only: bool) -> bool:
-    """MIG path: best-scoring node whose per-profile inventory fits
-    (node_info.has_mig_room over the nvidia.com/mig-* scalar resources;
-    reference: resource_info.go:153-165 scalar accounting — MIG devices
-    are pre-partitioned inventory, never draws on the whole-GPU pool)."""
+    """MIG / CSI-storage path: best-scoring node whose sparse host-side
+    inventory fits — per-profile MIG room (node_info.has_mig_room;
+    reference resource_info.go:153-165 scalar accounting) and CSI storage
+    capacity (node_info.is_task_storage_allocatable; reference
+    node_info.go:200-268), both folded into is_task_allocatable."""
     scores = ssn.score_nodes_for_task(task)[:len(ssn.snapshot.node_names)]
     order = np.argsort(-scores, kind="stable")
     hard_mask = ssn.compute_hard_mask([task])

@@ -74,10 +74,10 @@ class ReclaimAction:
 
 def survey_reclaim_victims(ssn) -> list[PodGroupInfo]:
     """All queues' running preemptible jobs (reclaim.go:123-143), ordered
-    so the weakest claims are tried first: queues with the highest dominant
-    share first, then reverse job order (newest / lowest priority first).
-    Per-reclaimer filtering (own queue) happens at use site; dominant
-    shares are computed once per queue here."""
+    by the REVERSED hierarchical queue order with reversed job order
+    inside each queue — the least deserving queue's weakest claim first
+    (getOrderedVictimsQueue -> JobsOrderByQueues VictimQueue mode).
+    Per-reclaimer filtering (own queue) happens at use site."""
     victims = []
     for pg in ssn.cluster.podgroups.values():
         if pg.queue_id not in ssn.cluster.queues:
@@ -87,17 +87,15 @@ def survey_reclaim_victims(ssn) -> list[PodGroupInfo]:
         if pg.num_active_allocated() == 0:
             continue
         victims.append(pg)
-    prop = getattr(ssn, "proportion", None)
-    queue_share = {}
-    if prop is not None:
-        for qid in {pg.queue_id for pg in victims}:
-            if qid in prop.queues:
-                queue_share[qid] = prop.queues[qid].dominant_share(
-                    prop.total)
-
-    victims.sort(key=lambda pg: (-queue_share.get(pg.queue_id, 0.0),
-                                 ssn_job_rank(ssn, pg)))
-    return victims
+    order = JobsOrderByQueues(ssn, victims, victim_mode=True)
+    out = []
+    while not order.empty():
+        job = order.pop_next_job()
+        if job is None:
+            break
+        out.append(job)
+        order.requeue_queue(job.queue_id)
+    return out
 
 
 def collect_reclaim_victims(ssn, reclaimer: PodGroupInfo

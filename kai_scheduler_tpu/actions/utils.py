@@ -91,78 +91,267 @@ class PriorityQueue:
         return len(self._items)
 
 
-class JobsOrderByQueues:
-    """The allocate/reclaim job iterator (job_order_by_queue.go).
+class _QueueNode:
+    """One queue in the ordering tree (job_order_by_queue.go queueNode).
 
-    Queues are ordered by ssn.compare_queues with each queue's *next job*
-    as context (DRF with the job's demand); jobs within a queue by
-    ssn.compare_jobs.  After a job is processed the queue is re-pushed so
-    ordering reflects updated shares.
+    Leaves hold a job heap; inner nodes hold a child-node heap.  Nodes
+    carry a ``token`` for lazy heap deletion: re-pushing a node bumps the
+    token, so stale heap entries (older token, or detached node) are
+    skipped on pop — the cheap stand-in for the reference's
+    needsReorder + heap Fix."""
+
+    __slots__ = ("qid", "parent", "jobs", "children", "is_leaf",
+                 "token", "attached")
+
+    def __init__(self, qid: str, is_leaf: bool):
+        self.qid = qid
+        self.parent: "_QueueNode | None" = None
+        self.jobs: PriorityQueue | None = None
+        self.children: list = []   # heap of (_NodeEntry)
+        self.is_leaf = is_leaf
+        self.token = 0
+        self.attached = False
+
+    def live(self) -> bool:
+        if self.is_leaf:
+            return self.jobs is not None and not self.jobs.empty()
+        return any(e.node.attached and e.token == e.node.token
+                   for e in self.children)
+
+
+class _Rev:
+    """Reverses the sort order of a key tuple (victim-mode key form:
+    pairwise-comparator reversal would abandon the O(1)-comparison key
+    fast path that keeps 1000s-of-jobs ordering cheap)."""
+
+    __slots__ = ("k",)
+
+    def __init__(self, k):
+        self.k = k
+
+    def __lt__(self, other):
+        return other.k < self.k
+
+    def __eq__(self, other):
+        return self.k == other.k
+
+
+class _NodeEntry:
+    __slots__ = ("node", "token", "k", "less", "seq")
+
+    def __init__(self, node, k, less, seq):
+        self.node, self.token = node, node.token
+        self.k, self.less, self.seq = k, less, seq
+
+    def __lt__(self, other):
+        if self.k is not None or other.k is not None:
+            if self.k != other.k:
+                return self.k < other.k
+            return self.seq < other.seq
+        if self.less(self.node, other.node):
+            return True
+        if self.less(other.node, self.node):
+            return False
+        return self.seq < other.seq
+
+
+class JobsOrderByQueues:
+    """The allocate/reclaim job iterator over the n-level queue hierarchy
+    (job_order_by_queue.go).
+
+    Queues form a tree mirroring parentQueue links; at every level sibling
+    nodes are ordered by ssn.compare_queues with each subtree's *best
+    descendant job* as context (buildNodeOrderFn/getBestJobFromNode), so a
+    department's standing — not just a leaf's — decides who allocates
+    next.  Jobs within a leaf are ordered by ssn.compare_jobs.  After a
+    job is processed the caller re-queues its leaf; ancestors re-enter
+    their heaps with fresh keys (the needsReorder analog).
     """
 
     def __init__(self, ssn, jobs: Iterable[PodGroupInfo],
                  max_jobs_per_queue: int = INFINITE,
-                 victims_by_queue: dict | None = None):
+                 victims_by_queue: dict | None = None,
+                 victim_mode: bool = False):
         self.ssn = ssn
         self.victims_by_queue = victims_by_queue or {}
+        self.victim_mode = victim_mode
+        self._max_jobs = max_jobs_per_queue
+        self._counter = itertools.count()
         # Key mode: when every registered comparator has a matching
         # precomputed-key form, heap maintenance compares cached tuples
         # (one key computation per push) instead of running the pairwise
         # DRF comparators per heap comparison.  An unpaired registration
         # (order fn without key fn) disables it, preserving exact
-        # comparator semantics.
-        job_key = ssn.job_sort_key if (
-            getattr(ssn, "job_keys_complete", False)
-            and len(ssn.job_key_fns) == len(ssn.job_order_fns)) else None
-        queue_key = None
-        if (not self.victims_by_queue and ssn.queue_key_fn is not None
-                and len(ssn.queue_order_fns) == 1):
-            def queue_key(qid):
-                return ssn.queue_key_fn(qid, self._peek_job(qid))
-        self._job_heaps: dict[str, PriorityQueue] = {}
+        # comparator semantics.  Victim mode reverses the keys via _Rev
+        # (the reference's VictimQueue "!order" with the fast path kept —
+        # a 3200-victim survey must not pay pairwise DRF comparisons).
+        self._job_key = None
+        if (getattr(ssn, "job_keys_complete", False)
+                and len(ssn.job_key_fns) == len(ssn.job_order_fns)):
+            if victim_mode:
+                self._job_key = lambda j: _Rev(ssn.job_sort_key(j))
+            else:
+                self._job_key = ssn.job_sort_key
+        self._queue_key = None
+        if (ssn.queue_key_fn is not None
+                and len(ssn.queue_order_fns) == 1
+                and (victim_mode or not self.victims_by_queue)):
+            if victim_mode:
+                self._queue_key = lambda qid, job: _Rev(
+                    ssn.queue_key_fn(qid, job))
+            else:
+                self._queue_key = ssn.queue_key_fn
+        self._nodes: dict[str, _QueueNode] = {}
+        self._roots: list = []      # heap of _NodeEntry
+        # Bulk build: fill job heaps first, then attach each node ONCE
+        # (bottom-up by construction order: leaves insert before the
+        # parents they create), instead of re-keying ancestors per job.
         for job in jobs:
-            heap = self._job_heaps.get(job.queue_id)
-            if heap is None:
-                heap = PriorityQueue(
-                    lambda a, b: ssn.compare_jobs(a, b) < 0,
-                    max_jobs_per_queue, key=job_key)
-                self._job_heaps[job.queue_id] = heap
-            heap.push(job)
-        self._queue_heap = PriorityQueue(self._queue_less, key=queue_key)
-        for qid, heap in self._job_heaps.items():
-            if not heap.empty():
-                self._queue_heap.push(qid)
+            self._leaf(job.queue_id).jobs.push(job)
+        for node in list(self._nodes.values()):
+            if node.live():
+                self._attach(node)
 
-    def _queue_less(self, l: str, r: str) -> bool:
-        l_job = self._peek_job(l)
-        r_job = self._peek_job(r)
+    # -- tree construction -------------------------------------------------
+    def _leaf(self, qid: str) -> _QueueNode:
+        node = self._nodes.get(qid)
+        if node is None:
+            node = _QueueNode(qid, is_leaf=True)
+            if self.victim_mode:
+                # createLeafNode: victims pop in REVERSE job order (the
+                # weakest claim — newest / lowest priority — first).
+                job_less = lambda a, b: self.ssn.compare_jobs(a, b) > 0
+            else:
+                job_less = lambda a, b: self.ssn.compare_jobs(a, b) < 0
+            node.jobs = PriorityQueue(job_less, self._max_jobs,
+                                      key=self._job_key)
+            self._nodes[qid] = node
+            self._link_parent(node)
+        return node
+
+    def _link_parent(self, node: _QueueNode) -> None:
+        queue = self.ssn.cluster.queues.get(node.qid)
+        parent_id = queue.parent if queue is not None else None
+        if parent_id and parent_id in self.ssn.cluster.queues:
+            parent = self._nodes.get(parent_id)
+            if parent is None:
+                parent = _QueueNode(parent_id, is_leaf=False)
+                self._nodes[parent_id] = parent
+                self._link_parent(parent)
+            node.parent = parent
+
+    # -- node ordering (buildNodeOrderFn) ----------------------------------
+    def _best_job(self, node: _QueueNode):
+        """Best descendant job of the subtree (getBestJobFromNode)."""
+        while not node.is_leaf:
+            child = self._peek_node(node.children)
+            if child is None:
+                return None, None
+            node = child
+        jobs = node.jobs
+        job = jobs.peek() if jobs is not None and not jobs.empty() else None
+        return job, node.qid
+
+    def _node_less(self, l: _QueueNode, r: _QueueNode) -> bool:
+        l_job, l_qid = self._best_job(l)
+        r_job, r_qid = self._best_job(r)
+        if self.victim_mode:
+            # getVictimsForQueue: the comparison context is the popped
+            # victims plus the next candidate, with no pending job; the
+            # queue order is REVERSED (buildNodeOrderFn reverseOrder) so
+            # the least deserving queue yields victims first.
+            l_victims = list(self.victims_by_queue.get(l_qid) or ())
+            r_victims = list(self.victims_by_queue.get(r_qid) or ())
+            if l_job is not None:
+                l_victims.append(l_job)
+            if r_job is not None:
+                r_victims.append(r_job)
+            return self.ssn.compare_queues(
+                l.qid, r.qid, None, None, l_victims, r_victims) > 0
         return self.ssn.compare_queues(
-            l, r, l_job, r_job,
-            self.victims_by_queue.get(l), self.victims_by_queue.get(r)) < 0
+            l.qid, r.qid, l_job, r_job,
+            self.victims_by_queue.get(l_qid),
+            self.victims_by_queue.get(r_qid)) < 0
 
-    def _peek_job(self, qid: str):
-        heap = self._job_heaps.get(qid)
-        return heap.peek() if heap and not heap.empty() else None
+    def _entry(self, node: _QueueNode) -> _NodeEntry:
+        key = None
+        if self._queue_key is not None:
+            best, _ = self._best_job(node)
+            key = self._queue_key(node.qid, best)
+        return _NodeEntry(node, key, self._node_less,
+                          next(self._counter))
 
-    def empty(self) -> bool:
-        return self._queue_heap.empty()
+    def _attach(self, node: _QueueNode) -> None:
+        """(Re-)insert the node into its parent's heap with a fresh key;
+        any older heap entry goes stale via the token bump."""
+        node.token += 1
+        node.attached = True
+        heap = self._roots if node.parent is None else node.parent.children
+        heapq.heappush(heap, self._entry(node))
 
-    def pop_next_job(self) -> PodGroupInfo | None:
-        """Pop the best job of the best queue; the queue leaves the heap
-        until push_job/done re-inserts it."""
-        while not self._queue_heap.empty():
-            qid = self._queue_heap.pop()
-            heap = self._job_heaps[qid]
-            if heap.empty():
-                continue
-            return heap.pop()
+    def _detach(self, node: _QueueNode) -> None:
+        node.attached = False
+        node.token += 1
+
+    def _peek_node(self, heap: list) -> "_QueueNode | None":
+        while heap:
+            entry = heap[0]
+            if entry.node.attached and entry.token == entry.node.token \
+                    and entry.node.live():
+                return entry.node
+            heapq.heappop(heap)   # stale or empty: lazy delete
         return None
 
+    # -- public API --------------------------------------------------------
+    def empty(self) -> bool:
+        return self._peek_node(self._roots) is None
+
+    def pop_next_job(self) -> PodGroupInfo | None:
+        """Pop the best job of the best root-to-leaf path; the leaf
+        leaves the tree until push_job/requeue_queue re-inserts it, and
+        its ancestors re-enter their heaps with fresh ordering keys."""
+        node = self._peek_node(self._roots)
+        if node is None:
+            return None
+        while not node.is_leaf:
+            child = self._peek_node(node.children)
+            if child is None:
+                self._detach(node)
+                return self.pop_next_job()
+            node = child
+        job = node.jobs.pop()
+        if self.victim_mode:
+            # Popped victims join the comparator context
+            # (poppedJobsByQueue, getVictimsForQueue).
+            self.victims_by_queue.setdefault(node.qid, []).append(job)
+        self._detach(node)
+        self._refresh_ancestors(node)
+        return job
+
+    def _refresh_ancestors(self, node: _QueueNode) -> None:
+        """Re-key every ancestor (markAncestorsForReorder analog): its
+        best-descendant context changed, so its heap position must too."""
+        anc = node.parent
+        while anc is not None:
+            if anc.live():
+                self._attach(anc)
+            else:
+                self._detach(anc)
+            anc = anc.parent
+
     def push_job(self, job: PodGroupInfo) -> None:
-        """Re-enqueue a job (e.g. elastic next chunk) and its queue."""
-        self._job_heaps[job.queue_id].push(job)
-        self._queue_heap.push(job.queue_id)
+        """Enqueue a job (initial build, or elastic next chunk) and
+        attach its leaf's ancestor chain."""
+        node = self._leaf(job.queue_id)
+        node.jobs.push(job)
+        self._attach(node)
+        self._refresh_ancestors(node)
 
     def requeue_queue(self, qid: str) -> None:
-        if not self._job_heaps[qid].empty():
-            self._queue_heap.push(qid)
+        node = self._nodes.get(qid)
+        if node is None:
+            return
+        if node.is_leaf and node.jobs is not None \
+                and not node.jobs.empty():
+            self._attach(node)
+        self._refresh_ancestors(node)

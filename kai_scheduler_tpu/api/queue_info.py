@@ -24,16 +24,34 @@ class QueueQuota:
 
     @classmethod
     def from_spec(cls, deserved=None, limit=None, over_quota_weight=1.0):
-        def _v(spec, default):
+        def _v(spec):
             if spec is None:
-                return default()
+                return rs.unlimited()
             if isinstance(spec, np.ndarray):
                 return spec.astype(np.float64)
-            return rs.vec_from_spec(**spec)
+            # Per-resource dict: entries NOT specified stay UNLIMITED —
+            # a queue that declares only a GPU quota has no CPU/memory
+            # quota (reference: NoMaxAllowedResource defaults,
+            # test_utils_builder.go:120-131 / queue CRD semantics).
+            # Explicit values (including 0) are honored; unknown keys
+            # fail loudly (a typoed key must not silently disable the
+            # quota by leaving it unlimited).
+            unknown = set(spec) - {"cpu", "memory", "gpu"}
+            if unknown:
+                raise ValueError(f"unknown quota resource keys: "
+                                 f"{sorted(unknown)}")
+            out = rs.unlimited()
+            if spec.get("cpu") is not None:
+                out[rs.RES_CPU] = rs.parse_cpu(spec["cpu"])
+            if spec.get("memory") is not None:
+                out[rs.RES_MEM] = rs.parse_memory(spec["memory"])
+            if spec.get("gpu") is not None:
+                out[rs.RES_GPU] = float(spec["gpu"])
+            return out
         w = over_quota_weight
         if not isinstance(w, np.ndarray):
             w = np.full(rs.NUM_RES, float(w))
-        return cls(_v(deserved, rs.unlimited), _v(limit, rs.unlimited), w)
+        return cls(_v(deserved), _v(limit), w)
 
 
 @dataclass

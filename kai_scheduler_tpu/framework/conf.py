@@ -51,9 +51,12 @@ class SchedulerConfig:
     max_scenarios_per_job: int = 16
     max_victims_considered: int = 32
     # Batched scenario pre-screen: score up to this many victim prefixes
-    # in ONE device call before simulating (ops/scenario_batch.py); 0
-    # disables.
-    scenario_prescreen_max: int = 64
+    # in ONE device call (ops/scenario_batch.py); 0 disables.  Engages
+    # lazily, only after ``scenario_prescreen_after`` simulated scenarios
+    # failed — on the happy path (first scenario fits) it would be pure
+    # overhead.
+    scenario_prescreen_max: int = 256
+    scenario_prescreen_after: int = 2
     # Scheduling-signature dedup of provably unschedulable jobs.
     use_scheduling_signatures: bool = True
     # Node-axis padding bucket to stabilize kernel shapes across cycles.
@@ -109,7 +112,7 @@ class SchedulerConfig:
                     "saturation_multiplier", "use_scheduling_signatures",
                     "node_pad_bucket", "bulk_allocation_threshold",
                     "max_scenarios_per_job", "max_victims_considered",
-                    "scenario_prescreen_max"):
+                    "scenario_prescreen_max", "scenario_prescreen_after"):
             if key in d:
                 setattr(config, key, d[key])
         if "queue_depth_per_action" in d:

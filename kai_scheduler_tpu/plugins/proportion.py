@@ -81,11 +81,13 @@ class QueueAttributes:
 
 
 def _less(a: np.ndarray, b: np.ndarray) -> bool:
-    """ResourceQuantities.Less: strictly less in at least one dim, not
-    greater anywhere (treating UNLIMITED in b as +inf)."""
+    """ResourceQuantities.Less: strictly less in EVERY dimension
+    (resource_quantities.go:50-57) — one equal dimension (e.g. cpu fair
+    share == cpu allocated) already defeats it.  The over-utilized queue
+    check rides on this exact semantic."""
     b_eff = np.where(b == UNLIMITED, np.inf, b)
     a_eff = np.where(a == UNLIMITED, np.inf, a)
-    return bool(np.all(a_eff <= b_eff + 1e-9) and np.any(a_eff < b_eff - 1e-9))
+    return bool(np.all(a_eff < b_eff - 1e-9))
 
 
 def _less_equal(a: np.ndarray, b: np.ndarray) -> bool:

@@ -69,6 +69,17 @@ def run_scenario(scenario: str, n_nodes: int, seed: int = 0) -> dict:
         add_job_wave(spec, gpu_capacity, gpus=1, prefix="hog", seed=seed)
         for j in spec["jobs"].values():
             j["queue"] = "q0"
+    elif scenario == "reclaim-contention":
+        # Deep-victim-queue contention (BASELINE config #3 / VERDICT r2
+        # task #6): ~1k queues, half hogging the whole cluster, half
+        # starved with pending work — every reclaimer faces a long
+        # ordered victim queue, the worst case for sequential scenario
+        # simulation.  Measured twice: prescreen batched vs disabled.
+        n_queues = min(1024, max(8, gpu_capacity // 4))
+        spec = gen_spec(n_nodes, n_queues=n_queues, seed=seed)
+        add_job_wave(spec, gpu_capacity, gpus=1, prefix="hog", seed=seed)
+        for i, j in enumerate(spec["jobs"].values()):
+            j["queue"] = f"q{i % (n_queues // 2)}"   # hog half the queues
     else:
         raise SystemExit(f"unknown scenario {scenario!r}")
 
@@ -100,6 +111,58 @@ def run_scenario(scenario: str, n_nodes: int, seed: int = 0) -> dict:
         ssn2 = sched.run_once()
         result["reclaim_cycle_s"] = round(time.perf_counter() - t1, 3)
         result["evictions"] = len(ssn2.cache.evicted)
+    elif scenario == "reclaim-contention":
+        # Inject pending 2-GPU jobs from the starved queue half, then
+        # measure the reclaim cycle twice on clones of the same packed
+        # cluster: batched prefix prescreen vs fully sequential
+        # simulation (scenario_prescreen_max=0).
+        from ..api.podgroup_info import PodGroupInfo
+        from ..api.pod_info import PodInfo
+        from ..api.resources import ResourceRequirements
+        # Deep-prefix reclaimers: each starved queue (deserved raised to
+        # 32) asks for a 32-GPU wave against 1-GPU victims, so the
+        # sequential solver simulates (and fails) ~31 growing prefixes
+        # per job — the shape the batched prescreen collapses into one
+        # device call.  Two timed runs per variant, min taken, to cancel
+        # jit-compile warmup (first run pays compiles).
+        n_queues = len(spec["queues"])
+        deep = 32
+        for i in range(8):
+            qid = f"q{n_queues // 2 + i}"
+            spec["queues"][qid]["deserved"]["gpu"] = deep
+            cluster.queues[qid].quota.deserved[-1] = float(deep)
+            pg = PodGroupInfo(f"starved-{i}", f"starved-{i}", queue_id=qid,
+                              min_available=deep)
+            for k in range(deep):
+                pg.add_task(PodInfo(
+                    uid=f"starved-{i}-{k}", name=f"starved-{i}-{k}",
+                    res_req=ResourceRequirements.from_spec("1", "1Gi", 1)))
+            cluster.podgroups[pg.uid] = pg
+        timings = {}
+        for label, prescreen_after in (("prescreen", 2),
+                                       ("sequential", 10 ** 9)):
+            elapsed = None
+            # Run 1 is an untimed warmup (jit compiles for this state's
+            # shapes); run 2 is the measurement.
+            for timed in (False, True):
+                trial = cluster.clone()
+                sched_t = Scheduler(
+                    lambda c=trial: c,
+                    SchedulerConfig(
+                        scenario_prescreen_after=prescreen_after,
+                        max_scenarios_per_job=64,
+                        max_victims_considered=64))
+                t1 = time.perf_counter()
+                ssn_t = sched_t.run_once()
+                if timed:
+                    elapsed = time.perf_counter() - t1
+                    result[f"evictions_{label}"] = len(ssn_t.cache.evicted)
+            timings[label] = elapsed
+        result["reclaim_cycle_s"] = round(timings["prescreen"], 3)
+        result["reclaim_sequential_s"] = round(timings["sequential"], 3)
+        result["prescreen_speedup"] = round(
+            timings["sequential"] / max(timings["prescreen"], 1e-9), 2)
+        result["queues"] = n_queues
     else:
         # Two cycles, report the best: the first steady cycle can still
         # pay a one-off kernel compile for the post-placement backlog
@@ -148,7 +211,8 @@ def main(argv=None):
     ap.add_argument("--nodes", type=int, default=500)
     ap.add_argument("--scenario", default="fill",
                     choices=("fill", "whole-gpu", "distributed", "burst",
-                             "reclaim", "system-fill"))
+                             "reclaim", "reclaim-contention",
+                             "system-fill"))
     ap.add_argument("--pods", type=int, default=0,
                     help="pod count for system-fill (default 2x nodes)")
     ap.add_argument("--seed", type=int, default=0)

@@ -1,0 +1,258 @@
+"""Declarative action-integration corpus harness.
+
+Python analog of the reference's integration-test runner
+(/root/reference/pkg/scheduler/actions/integration_tests/
+integration_tests_utils/integration_tests_utils.go): each case declares a
+cluster (nodes/queues/departments/jobs), the runner executes the full
+action sequence for ``rounds_until_match`` rounds — **rebuilding the
+session between rounds with scheduling results fed back** exactly like
+runSchedulerOneRound:104-135 (Binding->Running on the node, Pipelined->
+Pending unbound, Releasing->Pending unbound unless the case deletes the
+job) — then asserts the expected per-job placement/status, then runs
+``rounds_after_match`` more rounds asserting the state is stable (no
+allocate/evict loops).
+
+Case shape (mirrors TestTopologyBasic):
+
+    {"name": str,
+     "nodes": {name: {"gpus": 4, "cpu_millis": 4000, "memory_mb": ...}},
+     "queues": [{"name", "deserved_gpus", "max_gpus", "oqw", "parent",
+                 "deserved_cpu_millis", "max_cpu_millis"}],
+     "departments": [{"name", "deserved_gpus", "max_gpus"}],
+     "jobs": [{"name", "queue", "gpus_per_task", "cpu_millis_per_task",
+               "memory_mb_per_task", "priority", "min_available",
+               "delete_in_test",
+               "tasks": [{"state": "Pending|Running|Releasing",
+                          "node": str}]}],
+     "expected": {job: {"status": "Running|Pending|Releasing",
+                        "node": str | None, "nodes": [str, ...],
+                        "dont_validate_node": bool}},
+     "rounds_until_match": 2, "rounds_after_match": 5,
+     "actions": [...]}  # default: full reference order
+
+Priorities follow the reference's constants (priorities.go): train=50,
+interactive-preemptible=75, build=100, inference=125; preemptibility
+derives from priority < 100 (pkg/common/podgroup/preemptible.go:14-26)
+unless the job sets "preemptible" explicitly.
+"""
+
+from __future__ import annotations
+
+from kai_scheduler_tpu.api.pod_status import PodStatus
+from kai_scheduler_tpu.framework import SchedulerConfig
+
+from tests.fixtures import build_session, run_action
+
+PRIORITY_TRAIN = 50
+PRIORITY_INTERACTIVE = 75
+PRIORITY_BUILD = 100
+PRIORITY_INFERENCE = 125
+
+DEFAULT_ACTIONS = ["allocate", "consolidation", "reclaim", "preempt",
+                   "stalegangeviction"]
+DEFAULT_ROUNDS_UNTIL = 2
+DEFAULT_ROUNDS_AFTER = 5
+
+# Reference test nodes default to plentiful CPU/memory so GPU contention
+# drives the scenario (nodes_fake defaults).
+DEFAULT_CPU_MILLIS = 32000
+DEFAULT_MEMORY_MB = 256 * 1024
+
+_STATE_MAP = {
+    "Pending": "PENDING", "Running": "RUNNING", "Releasing": "RELEASING",
+    "Bound": "BOUND", "Binding": "BINDING", "Allocated": "ALLOCATED",
+    "Pipelined": "PIPELINED", "Gated": "GATED",
+}
+
+# Statuses that count as "actively placed" when matching an expected
+# Running (our allocate marks ALLOCATED in-session; the reference's
+# Binding feeds back to Running between rounds — we do the same, so by
+# match time placed tasks are RUNNING).
+_ACTIVE = {"RUNNING", "BOUND", "BINDING", "ALLOCATED"}
+
+
+def _queue_quota(q: dict) -> dict:
+    quota: dict = {}
+    deserved = {}
+    if "deserved_gpus" in q:
+        deserved["gpu"] = q["deserved_gpus"]
+    if "deserved_cpu_millis" in q:
+        deserved["cpu"] = f"{q['deserved_cpu_millis']}m"
+    if "deserved_memory_mb" in q:
+        deserved["memory"] = f"{q['deserved_memory_mb']}Mi"
+    if deserved:
+        quota["deserved"] = deserved
+    limit = {}
+    if "max_gpus" in q:
+        limit["gpu"] = q["max_gpus"]
+    if "max_cpu_millis" in q:
+        limit["cpu"] = f"{q['max_cpu_millis']}m"
+    if "max_memory_mb" in q:
+        limit["memory"] = f"{q['max_memory_mb']}Mi"
+    if limit:
+        quota["limit"] = limit
+    if "oqw" in q:
+        quota["oqw"] = q["oqw"]
+    return quota
+
+
+def _to_spec(case: dict, feedback: dict) -> dict:
+    """Translate a corpus case (+ per-task feedback state) into the
+    cluster_spec dict build_session consumes."""
+    nodes = {}
+    for name, n in (case.get("nodes") or {}).items():
+        nodes[name] = {
+            "gpu": n.get("gpus", 0),
+            "cpu": f"{n.get('cpu_millis', DEFAULT_CPU_MILLIS)}m",
+            "mem": f"{n.get('memory_mb', DEFAULT_MEMORY_MB)}Mi",
+        }
+        if "gpu_memory_mb" in n:
+            nodes[name]["gpu_memory"] = f"{n['gpu_memory_mb']}Mi"
+        if "mig_capacity" in n:
+            nodes[name]["mig_capacity"] = n["mig_capacity"]
+        if "max_pods" in n:
+            nodes[name]["max_pods"] = n["max_pods"]
+
+    queues = {}
+    for dept in case.get("departments") or []:
+        queues[dept["name"]] = _queue_quota(dept)
+    for q in case.get("queues") or []:
+        spec = _queue_quota(q)
+        spec["parent"] = q.get("parent")
+        if "priority" in q:
+            spec["priority"] = q["priority"]
+        if "creation_ts" in q:
+            spec["creation_ts"] = q["creation_ts"]
+        queues[q["name"]] = spec
+    # Departments referenced but not declared (reference defaults them).
+    for q in case.get("queues") or []:
+        parent = q.get("parent")
+        if parent and parent not in queues:
+            queues[parent] = {}
+
+    jobs = {}
+    for job_index, j in enumerate(case.get("jobs") or []):
+        name = j["name"]
+        priority = j.get("priority", PRIORITY_TRAIN)
+        tasks = []
+        for i, t in enumerate(j.get("tasks") or []):
+            fb = feedback.get((name, i))
+            state = fb["state"] if fb else t.get("state", "Pending")
+            node = fb["node"] if fb else t.get("node", "")
+            task = {"status": _STATE_MAP.get(state, state),
+                    "node": node or "",
+                    "gpu": j.get("gpus_per_task", 0),
+                    "cpu": f"{j.get('cpu_millis_per_task', 100)}m",
+                    "mem": f"{j.get('memory_mb_per_task', 200)}Mi"}
+            if j.get("gpu_fraction"):
+                task["gpu_fraction"] = j["gpu_fraction"]
+                task["gpu"] = 0
+            if fb and fb.get("gpu_group"):
+                task["gpu_group"] = fb["gpu_group"]
+            elif not fb and t.get("gpu_group"):
+                # Reference GPUGroups: initial shared-GPU placement.
+                task["gpu_group"] = t["gpu_group"]
+            if j.get("mig"):
+                task["mig"] = dict(j["mig"])
+            tasks.append(task)
+        jobs[name] = {
+            "queue": j.get("queue", "default"),
+            "priority": priority,
+            "preemptible": j.get("preemptible",
+                                 priority < PRIORITY_BUILD),
+            "min_available": j.get("min_available", len(tasks) or 1),
+            # Reference fake jobs get creation times increasing with
+            # list order (jobs_fake.go:83) — ordering ties break on it.
+            "creation_ts": float(j.get("creation_ts", job_index)),
+            "tasks": tasks,
+        }
+        if j.get("last_start_ts") is not None:
+            jobs[name]["last_start_ts"] = j["last_start_ts"]
+
+    spec = {"nodes": nodes, "queues": queues, "jobs": jobs,
+            "now": case.get("now", 1000.0)}
+    for key in ("storage", "resource_claims", "resource_slices",
+                "topologies", "config_maps", "pvcs"):
+        if key in case:
+            spec[key] = case[key]
+    return spec
+
+
+def _run_round(case: dict, feedback: dict, config=None):
+    """One scheduler round + result feedback (runSchedulerOneRound)."""
+    ssn = build_session(_to_spec(case, feedback),
+                        config or SchedulerConfig())
+    for action in case.get("actions", DEFAULT_ACTIONS):
+        run_action(ssn, action)
+    for j in case.get("jobs") or []:
+        pg = ssn.cluster.podgroups.get(j["name"])
+        if pg is None:
+            continue
+        for i in range(len(j.get("tasks") or [])):
+            task = pg.pods.get(f"{j['name']}-{i}")
+            if task is None:
+                continue
+            if task.status == PodStatus.RELEASING:
+                if j.get("delete_in_test"):
+                    feedback[(j["name"], i)] = {
+                        "state": "Releasing", "node": task.node_name,
+                        "gpu_group": task.gpu_group}
+                else:
+                    feedback[(j["name"], i)] = {"state": "Pending",
+                                                "node": ""}
+            elif task.status == PodStatus.PIPELINED:
+                feedback[(j["name"], i)] = {"state": "Pending", "node": ""}
+            elif task.status in (PodStatus.ALLOCATED, PodStatus.BINDING,
+                                 PodStatus.BOUND):
+                feedback[(j["name"], i)] = {
+                    "state": "Running", "node": task.node_name,
+                    "gpu_group": task.gpu_group}
+            else:
+                feedback[(j["name"], i)] = {
+                    "state": task.status.name.capitalize(),
+                    "node": task.node_name, "gpu_group": task.gpu_group}
+    return ssn
+
+
+def _match(case: dict, ssn) -> None:
+    """MatchExpectedAndRealTasks (test_utils.go:121): every task of the
+    job must carry the expected status; node asserted when given."""
+    for job_name, want in (case.get("expected") or {}).items():
+        pg = ssn.cluster.podgroups.get(job_name)
+        assert pg is not None, \
+            f"[{case['name']}] job {job_name} missing from snapshot"
+        want_status = want.get("status", "Running")
+        allowed_nodes = None
+        if want.get("node"):
+            allowed_nodes = {want["node"]}
+        elif want.get("nodes"):
+            allowed_nodes = set(want["nodes"])
+        for task in pg.pods.values():
+            got = task.status.name
+            if want_status == "Running":
+                ok = got in _ACTIVE
+            elif want_status == "Pending":
+                ok = got in ("PENDING", "PIPELINED", "GATED")
+            else:
+                ok = got == _STATE_MAP.get(want_status, want_status)
+            assert ok, (f"[{case['name']}] task {task.uid}: status {got}, "
+                        f"expected {want_status}")
+            if (allowed_nodes is not None
+                    and not want.get("dont_validate_node")
+                    and got in _ACTIVE):
+                assert task.node_name in allowed_nodes, (
+                    f"[{case['name']}] task {task.uid}: on "
+                    f"{task.node_name}, expected {sorted(allowed_nodes)}")
+
+
+def run_case(case: dict) -> None:
+    """RunTest: rounds-until-match -> assert -> rounds-after (stability)."""
+    feedback: dict = {}
+    config = SchedulerConfig(**case.get("config", {}))
+    ssn = None
+    for _ in range(case.get("rounds_until_match", DEFAULT_ROUNDS_UNTIL)):
+        ssn = _run_round(case, feedback, config)
+    _match(case, ssn)
+    for _ in range(case.get("rounds_after_match", DEFAULT_ROUNDS_AFTER)):
+        ssn = _run_round(case, feedback, config)
+        _match(case, ssn)

@@ -1,0 +1,390 @@
+"""Allocate-action behavior corpus, ported case-for-case from
+/root/reference/pkg/scheduler/actions/integration_tests/allocate/
+allocate_test.go (18 declarative cluster cases: quota/limit gates at
+queue and department level, over-quota for preemptible train vs
+non-preemptible build, creation-time and queue-priority ordering, DRF
+share updates mid-round, department ratios, CPU limits, and N-level
+queue hierarchies)."""
+
+import pytest
+
+from tests.corpus import (PRIORITY_BUILD, PRIORITY_TRAIN, run_case)
+
+CASES = [
+    {
+        # allocate_test.go:30 — queue MaxAllowedGPUs caps the queue even
+        # with idle GPUs left.
+        "name": "no-over-queue-allowance",
+        "nodes": {"node0": {"gpus": 4}},
+        "queues": [{"name": "queue0", "parent": "department-a",
+                    "deserved_gpus": 2, "oqw": 2, "max_gpus": 2}],
+        "departments": [{"name": "department-a", "deserved_gpus": 2}],
+        "jobs": [
+            {"name": "pending_job0", "queue": "queue0", "gpus_per_task": 2,
+             "priority": PRIORITY_TRAIN, "tasks": [{}]},
+            {"name": "pending_job1", "queue": "queue0", "gpus_per_task": 2,
+             "priority": PRIORITY_TRAIN, "tasks": [{}]},
+        ],
+        "expected": {
+            "pending_job0": {"status": "Running", "node": "node0"},
+            "pending_job1": {"status": "Pending"},
+        },
+        "rounds_until_match": 1,
+    },
+    {
+        # allocate_test.go:96 — department limit caps its child queue.
+        "name": "no-over-department-allowance",
+        "nodes": {"node0": {"gpus": 4}},
+        "queues": [{"name": "queue0", "parent": "department-a",
+                    "deserved_gpus": 2}],
+        "departments": [{"name": "department-a", "deserved_gpus": 2,
+                         "max_gpus": 2}],
+        "jobs": [
+            {"name": "pending_job0", "queue": "queue0", "gpus_per_task": 2,
+             "priority": PRIORITY_TRAIN, "tasks": [{}]},
+            {"name": "pending_job1", "queue": "queue0", "gpus_per_task": 2,
+             "priority": PRIORITY_TRAIN, "tasks": [{}]},
+        ],
+        "expected": {
+            "pending_job0": {"status": "Running", "node": "node0"},
+            "pending_job1": {"status": "Pending"},
+        },
+        "rounds_until_match": 1,
+    },
+    {
+        # allocate_test.go:161 — train jobs may exceed deserved (over
+        # quota); build jobs in the same queue allocate within quota.
+        "name": "over-quota-for-train",
+        "nodes": {"node0": {"gpus": 2}},
+        "queues": [{"name": "queue0", "deserved_gpus": 1},
+                   {"name": "queue1", "deserved_gpus": 1}],
+        "jobs": [
+            {"name": "pending_job0", "queue": "queue0", "gpus_per_task": 1,
+             "priority": PRIORITY_BUILD, "tasks": [{}]},
+            {"name": "pending_job1", "queue": "queue0", "gpus_per_task": 1,
+             "priority": PRIORITY_TRAIN, "tasks": [{}]},
+        ],
+        "expected": {
+            "pending_job0": {"status": "Running", "node": "node0"},
+            "pending_job1": {"status": "Running", "node": "node0"},
+        },
+        "rounds_until_match": 1,
+    },
+    {
+        # allocate_test.go:222 — a build (non-preemptible) job must not
+        # allocate beyond the queue's deserved quota.
+        "name": "no-over-quota-build",
+        "nodes": {"node0": {"gpus": 4}},
+        "queues": [{"name": "queue0", "deserved_gpus": 1},
+                   {"name": "queue1", "deserved_gpus": 1}],
+        "jobs": [
+            {"name": "pending_job0", "queue": "queue0", "gpus_per_task": 2,
+             "priority": PRIORITY_BUILD, "tasks": [{}]},
+        ],
+        "expected": {"pending_job0": {"status": "Pending"}},
+        "rounds_until_match": 1,
+    },
+    {
+        # allocate_test.go:262 — equal shares: earlier-created job wins.
+        "name": "creation-time-tiebreak",
+        "nodes": {"node0": {"gpus": 1}},
+        "queues": [{"name": "queue0", "deserved_gpus": 1},
+                   {"name": "queue1", "deserved_gpus": 1}],
+        "jobs": [
+            {"name": "pending_job0", "queue": "queue0", "gpus_per_task": 1,
+             "priority": PRIORITY_BUILD, "creation_ts": 1,
+             "tasks": [{}]},
+            {"name": "pending_job1", "queue": "queue1", "gpus_per_task": 1,
+             "priority": PRIORITY_BUILD, "creation_ts": 2,
+             "tasks": [{}]},
+        ],
+        "expected": {
+            "pending_job0": {"status": "Running", "node": "node0"},
+            "pending_job1": {"status": "Pending"},
+        },
+        "rounds_until_match": 1,
+    },
+    {
+        # allocate_test.go:322 — higher-priority QUEUE goes first.
+        "name": "queue-priority-order",
+        "nodes": {"node0": {"gpus": 1}},
+        "queues": [{"name": "queue0", "deserved_gpus": 1},
+                   {"name": "queue1", "deserved_gpus": 1, "priority": 101}],
+        "jobs": [
+            {"name": "pending_job0", "queue": "queue0", "gpus_per_task": 1,
+             "priority": PRIORITY_BUILD, "creation_ts": 1, "tasks": [{}]},
+            {"name": "pending_job1", "queue": "queue1", "gpus_per_task": 1,
+             "priority": PRIORITY_BUILD, "creation_ts": 2, "tasks": [{}]},
+        ],
+        "expected": {
+            "pending_job0": {"status": "Pending"},
+            "pending_job1": {"status": "Running", "node": "node0"},
+        },
+        "rounds_until_match": 1,
+    },
+    {
+        # allocate_test.go:383 — larger deserved share wins the one GPU.
+        "name": "larger-share-wins",
+        "nodes": {"node0": {"gpus": 1}},
+        "queues": [{"name": "queue0", "deserved_gpus": 1},
+                   {"name": "queue1", "deserved_gpus": 2}],
+        "jobs": [
+            {"name": "pending_job0", "queue": "queue0", "gpus_per_task": 1,
+             "priority": PRIORITY_BUILD, "tasks": [{}]},
+            {"name": "pending_job1", "queue": "queue1", "gpus_per_task": 1,
+             "priority": PRIORITY_BUILD, "tasks": [{}]},
+        ],
+        "expected": {
+            "pending_job0": {"status": "Pending"},
+            "pending_job1": {"status": "Running", "node": "node0"},
+        },
+        "rounds_until_match": 1,
+    },
+    {
+        # allocate_test.go:443 — 6 train jobs, 2 queues, 4 GPUs: first 2
+        # of each queue allocate; shares update during the round.
+        "name": "share-updates-mid-round",
+        "nodes": {"node0": {"gpus": 4}},
+        "queues": [{"name": "queue0", "deserved_gpus": 1},
+                   {"name": "queue1", "deserved_gpus": 1}],
+        "jobs": [
+            {"name": f"pending_job{i}", "queue": f"queue{i // 3}",
+             "gpus_per_task": 1, "priority": PRIORITY_TRAIN,
+             "creation_ts": i % 3, "tasks": [{}]}
+            for i in range(6)
+        ],
+        "expected": {
+            "pending_job0": {"status": "Running", "node": "node0"},
+            "pending_job1": {"status": "Running", "node": "node0"},
+            "pending_job2": {"status": "Pending"},
+            "pending_job3": {"status": "Running", "node": "node0"},
+            "pending_job4": {"status": "Running", "node": "node0"},
+            "pending_job5": {"status": "Pending"},
+        },
+        "rounds_until_match": 1,
+    },
+    {
+        # allocate_test.go:562 — 4 queues, 2 GPUs: only the first job of
+        # the two least-allocated queues runs (share updates in-round).
+        "name": "overprovision-share-update",
+        "nodes": {"node0": {"gpus": 2}},
+        "queues": [{"name": f"queue{i}", "deserved_gpus": 1}
+                   for i in range(4)],
+        "jobs": [
+            {"name": "pending_job0", "queue": "queue0", "gpus_per_task": 1,
+             "priority": PRIORITY_TRAIN, "creation_ts": 0, "tasks": [{}]},
+            {"name": "pending_job1", "queue": "queue1", "gpus_per_task": 1,
+             "priority": PRIORITY_TRAIN, "creation_ts": 1, "tasks": [{}]},
+            {"name": "pending_job2", "queue": "queue2", "gpus_per_task": 1,
+             "priority": PRIORITY_TRAIN, "creation_ts": 2, "tasks": [{}]},
+            {"name": "pending_job3", "queue": "queue3", "gpus_per_task": 1,
+             "priority": PRIORITY_TRAIN, "creation_ts": 3, "tasks": [{}]},
+            {"name": "pending_job4", "queue": "queue0", "gpus_per_task": 1,
+             "priority": PRIORITY_TRAIN, "creation_ts": 4, "tasks": [{}]},
+            {"name": "pending_job5", "queue": "queue1", "gpus_per_task": 1,
+             "priority": PRIORITY_TRAIN, "creation_ts": 5, "tasks": [{}]},
+        ],
+        "expected": {
+            "pending_job0": {"status": "Running", "node": "node0"},
+            "pending_job1": {"status": "Running", "node": "node0"},
+            "pending_job2": {"status": "Pending"},
+            "pending_job3": {"status": "Pending"},
+            "pending_job4": {"status": "Pending"},
+            "pending_job5": {"status": "Pending"},
+        },
+        "rounds_until_match": 1,
+    },
+    {
+        # allocate_test.go:682 — department with the smaller
+        # allocated/deserved ratio allocates first.
+        "name": "department-ratio-first",
+        "nodes": {"node0": {"gpus": 1}},
+        "queues": [
+            {"name": "queue0", "parent": "d1", "deserved_gpus": 3},
+            {"name": "queue1", "parent": "d1", "deserved_gpus": 2},
+            {"name": "queue2", "parent": "d2", "deserved_gpus": 1},
+        ],
+        "departments": [{"name": "d1", "deserved_gpus": 1},
+                        {"name": "d2", "deserved_gpus": 2}],
+        "jobs": [
+            {"name": "pending_job0", "queue": "queue0", "gpus_per_task": 1,
+             "priority": PRIORITY_TRAIN, "tasks": [{}]},
+            {"name": "pending_job1", "queue": "queue1", "gpus_per_task": 1,
+             "priority": PRIORITY_TRAIN, "tasks": [{}]},
+            {"name": "pending_job2", "queue": "queue2", "gpus_per_task": 1,
+             "priority": PRIORITY_TRAIN, "tasks": [{}]},
+        ],
+        "expected": {
+            "pending_job0": {"status": "Pending"},
+            "pending_job1": {"status": "Pending"},
+            "pending_job2": {"status": "Running", "node": "node0"},
+        },
+    },
+    {
+        # allocate_test.go:772 — interactive (build) jobs cannot exceed
+        # the DEPARTMENT's deserved GPUs even if the queue's allow it.
+        "name": "build-capped-by-department-deserved",
+        "nodes": {"node0": {"gpus": 4}},
+        "queues": [{"name": "queue0", "parent": "d1", "deserved_gpus": 2},
+                   {"name": "queue1", "parent": "d2", "deserved_gpus": 2}],
+        "departments": [{"name": "d1", "deserved_gpus": 1},
+                        {"name": "d2", "deserved_gpus": 1}],
+        "jobs": [
+            {"name": "pending_job0", "queue": "queue0", "gpus_per_task": 2,
+             "priority": PRIORITY_BUILD, "tasks": [{}]},
+        ],
+        "expected": {"pending_job0": {"status": "Pending"}},
+    },
+    {
+        # allocate_test.go:823 — over-quota queue (max 1 GPU): pending
+        # interactive displaces the running train via in-queue preempt.
+        "name": "interactive-preempts-train-at-quota",
+        "nodes": {"node0": {"gpus": 2}},
+        "queues": [{"name": "queue0", "deserved_gpus": 1, "oqw": 1,
+                    "max_gpus": 1}],
+        "jobs": [
+            {"name": "running_job_train", "queue": "queue0",
+             "gpus_per_task": 1, "priority": PRIORITY_TRAIN,
+             "tasks": [{"state": "Running", "node": "node0"}]},
+            {"name": "pending_job_interactive", "queue": "queue0",
+             "gpus_per_task": 1, "priority": PRIORITY_BUILD,
+             "tasks": [{}]},
+        ],
+        "expected": {
+            "running_job_train": {"status": "Pending"},
+            "pending_job_interactive": {"status": "Running",
+                                        "node": "node0"},
+        },
+        "rounds_until_match": 2,
+    },
+    {
+        # allocate_test.go:885 — the mirror image: train pending behind a
+        # running interactive at quota stays pending (no preemption of
+        # higher priority).
+        "name": "train-waits-behind-interactive-at-quota",
+        "nodes": {"node0": {"gpus": 2}},
+        "queues": [{"name": "queue0", "deserved_gpus": 1, "oqw": 1,
+                    "max_gpus": 1}],
+        "jobs": [
+            {"name": "pending_job_interactive0", "queue": "queue0",
+             "gpus_per_task": 1, "priority": PRIORITY_BUILD,
+             "tasks": [{"state": "Running", "node": "node0"}]},
+            {"name": "pending_job_train1", "queue": "queue0",
+             "gpus_per_task": 1, "priority": PRIORITY_TRAIN,
+             "tasks": [{}]},
+        ],
+        "expected": {
+            "pending_job_interactive0": {"status": "Running",
+                                         "node": "node0"},
+            "pending_job_train1": {"status": "Pending"},
+        },
+        "rounds_until_match": 1,
+    },
+    {
+        # allocate_test.go:945 — queue CPU limit gates the second job.
+        "name": "queue-cpu-limit",
+        "nodes": {"node0": {"gpus": 4, "cpu_millis": 5000}},
+        "queues": [{"name": "queue0", "parent": "department-a",
+                    "deserved_gpus": 2, "oqw": 2, "max_gpus": 2,
+                    "max_cpu_millis": 2500}],
+        "departments": [{"name": "department-a", "deserved_gpus": 2}],
+        "jobs": [
+            {"name": "pending_job0", "queue": "queue0", "gpus_per_task": 1,
+             "cpu_millis_per_task": 2000, "priority": PRIORITY_TRAIN,
+             "creation_ts": 0, "tasks": [{}]},
+            {"name": "pending_job1", "queue": "queue0", "gpus_per_task": 1,
+             "cpu_millis_per_task": 2000, "priority": PRIORITY_TRAIN,
+             "creation_ts": 1, "tasks": [{}]},
+        ],
+        "expected": {
+            "pending_job0": {"status": "Running", "node": "node0"},
+            "pending_job1": {"status": "Pending"},
+        },
+        "rounds_until_match": 1,
+    },
+    {
+        # allocate_test.go:1015 — department CPU limit gates the child.
+        "name": "department-cpu-limit",
+        "nodes": {"node0": {"gpus": 4, "cpu_millis": 5000}},
+        "queues": [{"name": "queue0", "parent": "department-a",
+                    "deserved_gpus": 2, "oqw": 2, "max_gpus": 2}],
+        "departments": [{"name": "department-a", "deserved_gpus": 2,
+                         "max_cpu_millis": 2500}],
+        "jobs": [
+            {"name": "pending_job0", "queue": "queue0", "gpus_per_task": 1,
+             "cpu_millis_per_task": 2000, "priority": PRIORITY_TRAIN,
+             "creation_ts": 0, "tasks": [{}]},
+            {"name": "pending_job1", "queue": "queue0", "gpus_per_task": 1,
+             "cpu_millis_per_task": 2000, "priority": PRIORITY_TRAIN,
+             "creation_ts": 1, "tasks": [{}]},
+        ],
+        "expected": {
+            "pending_job0": {"status": "Running", "node": "node0"},
+            "pending_job1": {"status": "Pending"},
+        },
+        "rounds_until_match": 1,
+    },
+    {
+        # allocate_test.go:1086 — single-level hierarchy (a root queue
+        # with no department) still allocates.
+        "name": "hierarchy-single-level",
+        "nodes": {"node0": {"gpus": 2}},
+        "queues": [{"name": "root-queue", "deserved_gpus": 2}],
+        "jobs": [
+            {"name": "pending_job0", "queue": "root-queue",
+             "gpus_per_task": 1, "priority": PRIORITY_TRAIN,
+             "tasks": [{}]},
+        ],
+        "expected": {"pending_job0": {"status": "Running",
+                                      "node": "node0"}},
+        "rounds_until_match": 1,
+    },
+    {
+        # allocate_test.go:1129 — three-level hierarchy: both teams
+        # under one department allocate.
+        "name": "hierarchy-three-level",
+        "nodes": {"node0": {"gpus": 4}},
+        "queues": [
+            {"name": "org", "deserved_gpus": 4},
+            {"name": "dept1", "parent": "org", "deserved_gpus": 2},
+            {"name": "team1", "parent": "dept1", "deserved_gpus": 1},
+            {"name": "team2", "parent": "dept1", "deserved_gpus": 1},
+        ],
+        "jobs": [
+            {"name": "job_team1", "queue": "team1", "gpus_per_task": 1,
+             "priority": PRIORITY_TRAIN, "tasks": [{}]},
+            {"name": "job_team2", "queue": "team2", "gpus_per_task": 1,
+             "priority": PRIORITY_TRAIN, "tasks": [{}]},
+        ],
+        "expected": {
+            "job_team1": {"status": "Running", "node": "node0"},
+            "job_team2": {"status": "Running", "node": "node0"},
+        },
+        "rounds_until_match": 1,
+    },
+    {
+        # allocate_test.go:1203 — four-level hierarchy, job at the
+        # deepest queue.
+        "name": "hierarchy-four-level",
+        "nodes": {"node0": {"gpus": 4}},
+        "queues": [
+            {"name": "company", "deserved_gpus": 10},
+            {"name": "division", "parent": "company", "deserved_gpus": 5},
+            {"name": "department", "parent": "division",
+             "deserved_gpus": 3},
+            {"name": "project", "parent": "department",
+             "deserved_gpus": 2},
+        ],
+        "jobs": [
+            {"name": "deep_job", "queue": "project", "gpus_per_task": 2,
+             "priority": PRIORITY_TRAIN, "tasks": [{}]},
+        ],
+        "expected": {"deep_job": {"status": "Running", "node": "node0"}},
+        "rounds_until_match": 1,
+    },
+]
+
+
+@pytest.mark.parametrize("case", CASES, ids=[c["name"] for c in CASES])
+def test_allocate_corpus(case):
+    run_case(case)

@@ -1,0 +1,231 @@
+"""Preempt-action behavior corpus, ported case-for-case from
+/root/reference/pkg/scheduler/actions/integration_tests/preempt/
+preempt_test.go and preemptGang_test.go: in-queue priority preemption,
+minimal-victim selection, no-preempt when nothing helps, and gang
+semantics (whole gang waits / whole gang evicts)."""
+
+import pytest
+
+from tests.corpus import (PRIORITY_BUILD, PRIORITY_TRAIN, run_case)
+
+CASES = [
+    {
+        # preempt_test.go:26 — two fractional jobs share GPU 0; the
+        # whole-GPU train job is the single victim for the build job
+        # (don't evict two when one is enough).
+        "name": "preempt-minimal-victim-fractional",
+        "nodes": {"node0": {"gpus": 2}},
+        "queues": [{"name": "queue0", "deserved_gpus": 2}],
+        "jobs": [
+            {"name": "running_job0", "queue": "queue0",
+             "gpu_fraction": 0.5, "priority": PRIORITY_TRAIN,
+             "tasks": [{"state": "Running", "node": "node0",
+                        "gpu_group": "0"}]},
+            {"name": "running_job1", "queue": "queue0", "gpus_per_task": 1,
+             "priority": PRIORITY_TRAIN,
+             "tasks": [{"state": "Running", "node": "node0"}]},
+            {"name": "running_job2", "queue": "queue0",
+             "gpu_fraction": 0.5, "priority": PRIORITY_TRAIN,
+             "tasks": [{"state": "Running", "node": "node0",
+                        "gpu_group": "0"}]},
+            {"name": "pending_job0", "queue": "queue0", "gpus_per_task": 1,
+             "priority": PRIORITY_BUILD, "tasks": [{}]},
+        ],
+        "expected": {
+            "running_job0": {"status": "Running"},
+            "running_job1": {"status": "Pending"},
+            "running_job2": {"status": "Running"},
+            "pending_job0": {"status": "Running", "node": "node0"},
+        },
+    },
+    {
+        # preempt_test.go:120 — higher-priority build preempts the train
+        # job even within deserved quota.
+        "name": "preempt-basic-priority",
+        "nodes": {"node0": {"gpus": 2}},
+        "queues": [{"name": "queue0", "deserved_gpus": 1}],
+        "jobs": [
+            {"name": "running_job0", "queue": "queue0", "gpus_per_task": 2,
+             "priority": PRIORITY_TRAIN,
+             "tasks": [{"state": "Running", "node": "node0"}]},
+            {"name": "pending_job0", "queue": "queue0", "gpus_per_task": 1,
+             "priority": PRIORITY_BUILD, "tasks": [{}]},
+        ],
+        "expected": {
+            "running_job0": {"status": "Pending"},
+            "pending_job0": {"status": "Running", "node": "node0"},
+        },
+    },
+    {
+        # preempt_test.go:178 — build job needs the whole node: all three
+        # train jobs are evicted.
+        "name": "preempt-whole-node",
+        "nodes": {"node0": {"gpus": 4}},
+        "queues": [{"name": "queue0", "deserved_gpus": 4}],
+        "jobs": [
+            {"name": "running_job0", "queue": "queue0", "gpus_per_task": 1,
+             "priority": PRIORITY_TRAIN,
+             "tasks": [{"state": "Running", "node": "node0"}]},
+            {"name": "running_job1", "queue": "queue0", "gpus_per_task": 2,
+             "priority": PRIORITY_TRAIN,
+             "tasks": [{"state": "Running", "node": "node0"}]},
+            {"name": "running_job2", "queue": "queue0", "gpus_per_task": 1,
+             "priority": PRIORITY_TRAIN,
+             "tasks": [{"state": "Running", "node": "node0"}]},
+            {"name": "pending_job0", "queue": "queue0", "gpus_per_task": 4,
+             "priority": PRIORITY_BUILD, "tasks": [{}]},
+        ],
+        "expected": {
+            "running_job0": {"status": "Pending"},
+            "running_job1": {"status": "Pending"},
+            "running_job2": {"status": "Pending"},
+            "pending_job0": {"status": "Running", "node": "node0"},
+        },
+    },
+    {
+        # preempt_test.go:266 — 4-GPU build job but GPUs are split 2+2
+        # across nodes: preempting cannot help, leave everything running.
+        "name": "no-preempt-when-fragmented",
+        "nodes": {"node0": {"gpus": 2}, "node1": {"gpus": 2}},
+        "queues": [{"name": "queue0", "deserved_gpus": 4}],
+        "jobs": [
+            {"name": "running_job0", "queue": "queue0", "gpus_per_task": 1,
+             "priority": PRIORITY_TRAIN,
+             "tasks": [{"state": "Running", "node": "node0"}]},
+            {"name": "running_job1", "queue": "queue0", "gpus_per_task": 2,
+             "priority": PRIORITY_TRAIN,
+             "tasks": [{"state": "Running", "node": "node1"}]},
+            {"name": "running_job2", "queue": "queue0", "gpus_per_task": 1,
+             "priority": PRIORITY_TRAIN,
+             "tasks": [{"state": "Running", "node": "node0"}]},
+            {"name": "pending_job0", "queue": "queue0", "gpus_per_task": 4,
+             "priority": PRIORITY_BUILD, "tasks": [{}]},
+        ],
+        "expected": {
+            "running_job0": {"status": "Running", "node": "node0"},
+            "running_job1": {"status": "Running", "node": "node1"},
+            "running_job2": {"status": "Running", "node": "node0"},
+            "pending_job0": {"status": "Pending"},
+        },
+    },
+    {
+        # preempt_test.go:351 — build job would exceed the queue's
+        # deserved 3: preemption must not happen.
+        "name": "no-preempt-over-quota-build",
+        "nodes": {"node0": {"gpus": 4}},
+        "queues": [{"name": "queue0", "deserved_gpus": 3}],
+        "jobs": [
+            {"name": "running_job0", "queue": "queue0", "gpus_per_task": 1,
+             "priority": PRIORITY_TRAIN,
+             "tasks": [{"state": "Running", "node": "node0"}]},
+            {"name": "running_job1", "queue": "queue0", "gpus_per_task": 2,
+             "priority": PRIORITY_TRAIN,
+             "tasks": [{"state": "Running", "node": "node0"}]},
+            {"name": "running_job2", "queue": "queue0", "gpus_per_task": 1,
+             "priority": PRIORITY_TRAIN,
+             "tasks": [{"state": "Running", "node": "node0"}]},
+            {"name": "pending_job0", "queue": "queue0", "gpus_per_task": 4,
+             "priority": PRIORITY_BUILD, "tasks": [{}]},
+        ],
+        "expected": {
+            "running_job0": {"status": "Running", "node": "node0"},
+            "running_job1": {"status": "Running", "node": "node0"},
+            "running_job2": {"status": "Running", "node": "node0"},
+            "pending_job0": {"status": "Pending"},
+        },
+    },
+    {
+        # preempt_test.go:434 — nothing pending: nothing moves.
+        "name": "no-preempt-without-pending",
+        "nodes": {"node0": {"gpus": 4}},
+        "queues": [{"name": "queue0", "deserved_gpus": 3}],
+        "jobs": [
+            {"name": "running_job0", "queue": "queue0", "gpus_per_task": 1,
+             "priority": PRIORITY_TRAIN,
+             "tasks": [{"state": "Running", "node": "node0"}]},
+            {"name": "running_job1", "queue": "queue0", "gpus_per_task": 2,
+             "priority": PRIORITY_BUILD,
+             "tasks": [{"state": "Running", "node": "node0"}]},
+            {"name": "running_job2", "queue": "queue0", "gpus_per_task": 1,
+             "priority": PRIORITY_TRAIN,
+             "tasks": [{"state": "Running", "node": "node0"}]},
+        ],
+        "expected": {
+            "running_job0": {"status": "Running", "node": "node0"},
+            "running_job1": {"status": "Running", "node": "node0"},
+            "running_job2": {"status": "Running", "node": "node0"},
+        },
+    },
+    {
+        # preemptGang_test.go:26 — a 2-member build gang preempts the
+        # 2-GPU train job (both members must fit).
+        "name": "gang-preempts-train",
+        "nodes": {"node0": {"gpus": 2}},
+        "queues": [{"name": "queue0", "deserved_gpus": 2}],
+        "jobs": [
+            {"name": "running_job0", "queue": "queue0", "gpus_per_task": 2,
+             "priority": PRIORITY_TRAIN,
+             "tasks": [{"state": "Running", "node": "node0"}]},
+            {"name": "pending_job0", "queue": "queue0", "gpus_per_task": 1,
+             "priority": PRIORITY_BUILD, "min_available": 2,
+             "tasks": [{}, {}]},
+        ],
+        "expected": {
+            "running_job0": {"status": "Pending"},
+            "pending_job0": {"status": "Running", "node": "node0"},
+        },
+    },
+    {
+        # preemptGang_test.go:87 — gang with one member already running:
+        # preempt just enough to place the second member.
+        "name": "gang-partial-preempt",
+        "nodes": {"node0": {"gpus": 3}},
+        "queues": [{"name": "queue0", "deserved_gpus": 2}],
+        "jobs": [
+            {"name": "running_job0", "queue": "queue0", "gpus_per_task": 1,
+             "priority": PRIORITY_TRAIN,
+             "tasks": [{"state": "Running", "node": "node0"}]},
+            {"name": "running_job1", "queue": "queue0", "gpus_per_task": 1,
+             "priority": PRIORITY_TRAIN,
+             "tasks": [{"state": "Running", "node": "node0"}]},
+            {"name": "pending_job0", "queue": "queue0", "gpus_per_task": 1,
+             "priority": PRIORITY_BUILD, "min_available": 2,
+             "tasks": [{"state": "Running", "node": "node0"}, {}]},
+        ],
+        "expected": {
+            "running_job0": {"status": "Running"},
+            "running_job1": {"status": "Pending"},
+            "pending_job0": {"status": "Running", "node": "node0"},
+        },
+    },
+    {
+        # preemptGang_test.go:165 — the victim is itself a gang: evicting
+        # one member evicts the whole gang.
+        "name": "gang-victim-evicts-whole-gang",
+        "nodes": {"node0": {"gpus": 2}},
+        "queues": [{"name": "queue0", "deserved_gpus": 1}],
+        "jobs": [
+            {"name": "running_gang_job0", "queue": "queue0",
+             "gpus_per_task": 1, "priority": PRIORITY_TRAIN,
+             "min_available": 2,
+             "tasks": [{"state": "Running", "node": "node0"},
+                       {"state": "Running", "node": "node0"}]},
+            {"name": "pending_job0", "queue": "queue0", "gpus_per_task": 1,
+             "priority": PRIORITY_BUILD, "tasks": [{}]},
+        ],
+        "expected": {
+            "running_gang_job0": {"status": "Pending"},
+            "pending_job0": {"status": "Running"},
+        },
+    },
+]
+
+
+@pytest.mark.parametrize(
+    "case",
+    [pytest.param(c, marks=pytest.mark.xfail(reason=c["xfail"],
+                                             strict=True))
+     if "xfail" in c else c for c in CASES],
+    ids=[c["name"] for c in CASES])
+def test_preempt_corpus(case):
+    run_case(case)

@@ -31,8 +31,8 @@ N_NODES = 400
 # variance.  Re-tighten whenever the medians move down.  The TPU path is
 # benchmarked separately (bench.py).
 CEILINGS_S = {"fill": 10.0, "whole-gpu": 8.0, "distributed": 9.0,
-              "burst": 18.0, "burst-steady": 1.0, "reclaim": 2.5,
-              "system-fill": 8.0}
+              "burst": 18.0, "burst-steady": 1.0, "reclaim": 4.0,
+              "reclaim-contention": 15.0, "system-fill": 8.0}
 
 
 def _record(result: dict) -> None:
@@ -89,6 +89,18 @@ class TestScaleRing:
         # The starved queue must actually reclaim.
         assert r["evictions"] > 0
         assert r["reclaim_cycle_s"] < CEILINGS_S["reclaim"]
+
+    def test_reclaim_contention(self):
+        """Deep-victim-prefix contention at ~400 queues (BASELINE config
+        #3): gang reclaimers against 1-GPU victims, measured with the
+        batched prefix prescreen vs fully sequential simulation."""
+        r = scale_gen.run_scenario("reclaim-contention", 200)
+        _record(r)
+        assert r["evictions_prescreen"] == r["evictions_sequential"] > 0
+        # The prescreen must never lose to sequential by more than jit
+        # noise, and the cycle must stay bounded.
+        assert r["prescreen_speedup"] > 0.8
+        assert r["reclaim_cycle_s"] < CEILINGS_S["reclaim-contention"]
 
     def test_system_fill_fleet(self):
         r = scale_gen.run_system_scenario(200, 400)

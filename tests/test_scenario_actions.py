@@ -255,9 +255,11 @@ class TestBatchedPrescreen:
         evicted = [uid for uid, (node, status) in p.items()
                    if status == "RELEASING"]
         assert len(evicted) == 4
-        # Exactly one simulated scenario: the first feasible prefix (4
-        # victims); the three short prefixes were pre-screened away.
-        assert after - before == 1
+        # The prescreen engages lazily after scenario_prescreen_after
+        # (=2) failed simulations, then skips the remaining infeasible
+        # prefix (3 victims) in one batched call: 2 warmup failures + 1
+        # successful simulation, instead of 4 sequential scenarios.
+        assert after - before == 3
 
     def test_prescreen_disabled_matches(self):
         """Soundness guard: results identical with prescreen off."""
