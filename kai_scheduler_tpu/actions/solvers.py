@@ -220,6 +220,8 @@ def _prefix_prescreen(ssn, tasks, builder: "ScenarioBuilder"):
 
     from ..ops.scenario_batch import batch_prefix_feasibility
 
+    METRICS.inc("device_kernel_calls")
+
     steps = steps[:cap]
     # Sparse victim-release rows; padding (step index == num_prefixes)
     # drops in the device-side scatter.  Pow2 buckets keep the jit cache
@@ -284,6 +286,16 @@ def _simulate_attempt(ssn, stmt, scenario: Scenario,
                       try_replace_victims: bool) -> bool:
     """Try to place the pending job (and re-place victims) on top of the
     statement's accumulated evictions."""
+    batched = (_batched_confirm(ssn, stmt, scenario, try_replace_victims)
+               if ssn.config.batched_scenario_confirm else None)
+    if batched is not None:
+        ok, all_replaced = batched
+        if not ok:
+            return False
+        if require_all_victims_replaced and not all_replaced:
+            return False
+        return True
+
     placed = attempt_to_allocate_job(ssn, scenario.pending_job,
                                      pipeline_only=True, stmt=stmt,
                                      commit=False)
@@ -302,3 +314,88 @@ def _simulate_attempt(ssn, stmt, scenario: Scenario,
     if require_all_victims_replaced and not all_replaced:
         return False
     return True
+
+
+def _plain_chunk(ssn, job):
+    """tasks_to_allocate when the job is expressible in one concatenated
+    kernel call; None routes the scenario to the sequential path (the
+    same state classes attempt_to_allocate_job handles host-side)."""
+    if (job.required_topology_level or job.preferred_topology_level
+            or any(ps.has_own_topology_constraint()
+                   for ps in job.pod_sets.values())):
+        return None
+    tasks = job.tasks_to_allocate(
+        subgroup_order_fn=ssn.pod_set_order_key,
+        task_order_fn=ssn.task_order_key, real_allocation=False)
+    for t in tasks:
+        if (t.is_fractional or t.resource_claims or t.res_req.mig_resources
+                or t.host_ports or t.needs_storage_scheduling()):
+            return None
+    return tasks
+
+
+def _batched_confirm(ssn, stmt, scenario: Scenario,
+                     try_replace_victims: bool):
+    """Exact-confirm pass in ONE device call: pending job first, then
+    victim re-placements, all through the multi-job kernel
+    (solvers/by_pod_solver.go runs these as N sequential AllocateJob
+    calls — the dominant per-scenario cost at contention).
+
+    Returns (ok, all_replaced), or None to fall back to the sequential
+    path when any involved job needs host-side state."""
+    pending_tasks = _plain_chunk(ssn, scenario.pending_job)
+    if pending_tasks is None or not pending_tasks:
+        return None
+    # Same admission gates attempt_to_allocate_job applies.
+    if not ssn.is_job_over_queue_capacity(
+            scenario.pending_job, pending_tasks).schedulable:
+        return (False, False)
+    if not ssn.check_pre_predicates(pending_tasks).schedulable:
+        return (False, False)
+
+    chunks = [(scenario.pending_job, pending_tasks)]
+    skipped_victim = False
+    if try_replace_victims:
+        for vjob, _vtasks in scenario.victims:
+            vtasks = _plain_chunk(ssn, vjob)
+            if vtasks is None:
+                return None  # host-state victim: sequential path
+            if not vtasks:
+                skipped_victim = True
+                continue
+            if not ssn.is_job_over_queue_capacity(
+                    vjob, vtasks).schedulable \
+                    or not ssn.check_pre_predicates(vtasks).schedulable:
+                skipped_victim = True
+                continue
+            chunks.append((vjob, vtasks))
+
+    for job, _tasks in chunks:
+        ssn.pre_job_allocation(job)
+    proposals = ssn.propose_placements_multi(chunks, pipeline_only=True)
+    if proposals is None:
+        return None
+    pending_prop = proposals[scenario.pending_job.uid]
+    if not pending_prop.success:
+        return (False, False)
+    # Apply job by job, re-checking the queue-capacity gate against the
+    # statement state accumulated so far — the kernel models NODE
+    # capacity only, and two jobs that each fit a queue's quota alone
+    # can exceed it together (sequential semantics: a victim whose gate
+    # fails after earlier placements simply stays evicted).  Dropping a
+    # gated-out job only frees node capacity the kernel had charged, so
+    # the retained placements remain feasible.
+    stmt.apply_bulk((task, node, True)
+                    for task, node, _p in pending_prop.placements)
+    all_replaced = try_replace_victims and not skipped_victim
+    for job, tasks in chunks[1:]:
+        prop = proposals[job.uid]
+        if not prop.success:
+            all_replaced = False
+            continue
+        if not ssn.is_job_over_queue_capacity(job, tasks).schedulable:
+            all_replaced = False
+            continue
+        stmt.apply_bulk((task, node, True)
+                        for task, node, _p in prop.placements)
+    return (True, all_replaced)

@@ -116,8 +116,10 @@ class PodInfo:
     # the pod).  Mirrors pod_info.go storageClaims/ownedStorageClaims.
     storage_claims: dict = field(default_factory=dict)
     owned_storage_claims: dict = field(default_factory=dict)
-    # Index into the packed task tensor for the current snapshot.
+    # Index into the packed task tensor, valid only when tensor_epoch
+    # matches the snapshot's pack_epoch (SnapshotTensors.row_of).
     tensor_idx: int = -1
+    tensor_epoch: int = -1
 
     def is_active_used(self) -> bool:
         return is_active_used(self.status)
@@ -234,4 +236,5 @@ class PodInfo:
             storage_claims=dict(self.storage_claims),
             owned_storage_claims=dict(self.owned_storage_claims),
             tensor_idx=self.tensor_idx,
+            tensor_epoch=self.tensor_epoch,
         )

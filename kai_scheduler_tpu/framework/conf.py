@@ -56,7 +56,10 @@ class SchedulerConfig:
     # failed — on the happy path (first scenario fits) it would be pure
     # overhead.
     scenario_prescreen_max: int = 256
-    scenario_prescreen_after: int = 2
+    scenario_prescreen_after: int = 1
+    # Confirm scenario solutions (pending job + victim re-placements) in
+    # ONE multi-job kernel call instead of one device call per job.
+    batched_scenario_confirm: bool = True
     # Scheduling-signature dedup of provably unschedulable jobs.
     use_scheduling_signatures: bool = True
     # Node-axis padding bucket to stabilize kernel shapes across cycles.
@@ -112,7 +115,8 @@ class SchedulerConfig:
                     "saturation_multiplier", "use_scheduling_signatures",
                     "node_pad_bucket", "bulk_allocation_threshold",
                     "max_scenarios_per_job", "max_victims_considered",
-                    "scenario_prescreen_max", "scenario_prescreen_after"):
+                    "scenario_prescreen_max", "scenario_prescreen_after",
+                    "batched_scenario_confirm"):
             if key in d:
                 setattr(config, key, d[key])
         if "queue_depth_per_action" in d:

@@ -376,6 +376,84 @@ class Session:
         return [None]
 
     # -- device-kernel placement proposals ---------------------------------
+    def propose_placements_multi(self, job_chunks,
+                                 pipeline_only: bool = True):
+        """Place SEVERAL jobs' chunks in ONE kernel call (the scenario
+        confirm pass: pending job + victim re-placements together instead
+        of one device round trip per job).
+
+        ``job_chunks``: [(job, tasks)].  Returns {job_uid: Proposal} with
+        per-job gang atomicity (the kernel's per-job success gating), or
+        None when any chunk needs per-job machinery the concatenated call
+        cannot express (domain rows from anti/affinity plugins)."""
+        from ..utils.metrics import METRICS
+        METRICS.inc("device_kernel_calls")
+        snap = self.snapshot
+        all_tasks = [t for _job, tasks in job_chunks for t in tasks]
+        t = len(all_tasks)
+        if t == 0:
+            return {}
+        for fn in self.anti_domain_fns + self.affinity_domain_fns:
+            if fn(all_tasks) is not None:
+                return None
+
+        t_pad = _next_pow2(t)
+        task_req = np.zeros((t_pad, snap.task_req.shape[1]))
+        task_sel = np.full((t_pad, snap.task_selector.shape[1]), -1,
+                           np.int32)
+        task_tol = np.full((t_pad, snap.task_tolerations.shape[1]), -1,
+                           np.int32)
+        task_job = np.full(t_pad, len(job_chunks), np.int32)  # padding job
+        row = 0
+        for j, (_job, tasks) in enumerate(job_chunks):
+            for task in tasks:
+                req, sel, tol = self._task_row(task)
+                if req is None:
+                    return None
+                task_req[row], task_sel[row, :len(sel)] = req, sel
+                task_tol[row, :len(tol)] = tol
+                task_job[row] = j
+                row += 1
+        job_allowed = np.ones(len(job_chunks) + 1, bool)
+        job_allowed[-1] = False
+
+        n_nodes = self.node_idle.shape[0]
+        extra = np.zeros((t_pad, n_nodes))
+        for fn in self.extra_score_fns:
+            contrib = fn(all_tasks)
+            if contrib is not None:
+                extra[:t] += contrib
+        mask = self.compute_hard_mask(all_tasks)
+        mask_pad = None
+        if mask is not None:
+            mask_pad = np.ones((t_pad, n_nodes), bool)
+            mask_pad[:t] = mask
+
+        result = allocate_jobs_kernel(
+            *self._device_arrays(),
+            jnp.asarray(task_req), jnp.asarray(task_job),
+            jnp.asarray(task_sel), jnp.asarray(task_tol),
+            jnp.asarray(job_allowed), jnp.asarray(extra),
+            task_node_mask=(None if mask_pad is None
+                            else jnp.asarray(mask_pad)),
+            gpu_strategy=self.gpu_strategy, cpu_strategy=self.cpu_strategy,
+            allow_pipeline=True, pipeline_only=pipeline_only)
+        success = np.asarray(result.job_success)
+        placed = np.asarray(result.placements[:t])
+        piped = np.asarray(result.pipelined[:t])
+        out = {}
+        row = 0
+        for j, (job, tasks) in enumerate(job_chunks):
+            rows = range(row, row + len(tasks))
+            row += len(tasks)
+            if not bool(success[j]) or any(placed[r] < 0 for r in rows):
+                out[job.uid] = Proposal(False, [])
+                continue
+            out[job.uid] = Proposal(True, [
+                (task, snap.node_names[int(placed[r])], bool(piped[r]))
+                for task, r in zip(tasks, rows)])
+        return out
+
     def propose_placements(self, tasks: list[PodInfo],
                            pipeline_only: bool = False,
                            allow_pipeline: bool = True,
@@ -383,6 +461,8 @@ class Session:
                            ) -> Proposal:
         """Run the gang-allocation kernel for one job's task chunk against
         the current (statement-mutated) node state."""
+        from ..utils.metrics import METRICS
+        METRICS.inc("device_kernel_calls")
         snap = self.snapshot
         t = len(tasks)
         t_pad = _next_pow2(max(t, 1))
@@ -522,8 +602,8 @@ class Session:
         rows for this cycle's candidates, codec re-encoding for others
         (evicted victims in scenario simulation)."""
         snap = self.snapshot
-        if task.tensor_idx >= 0:
-            i = task.tensor_idx
+        i = snap.row_of(task)
+        if i >= 0:
             return (snap.task_req[i], snap.task_selector[i],
                     snap.task_tolerations[i])
         codec = snap.codec

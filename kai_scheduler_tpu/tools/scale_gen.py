@@ -139,8 +139,14 @@ def run_scenario(scenario: str, n_nodes: int, seed: int = 0) -> dict:
                     res_req=ResourceRequirements.from_spec("1", "1Gi", 1)))
             cluster.podgroups[pg.uid] = pg
         timings = {}
-        for label, prescreen_after in (("prescreen", 2),
-                                       ("sequential", 10 ** 9)):
+        variants = (
+            # (label, prescreen_after, batched_confirm)
+            ("batched", 2, True),        # prescreen + one-call confirm
+            ("prescreen-only", 2, False),
+            ("sequential", 10 ** 9, False),  # round-1 baseline
+        )
+        from ..utils.metrics import METRICS
+        for label, prescreen_after, batched in variants:
             elapsed = None
             # Run 1 is an untimed warmup (jit compiles for this state's
             # shapes); run 2 is the measurement.
@@ -150,18 +156,28 @@ def run_scenario(scenario: str, n_nodes: int, seed: int = 0) -> dict:
                     lambda c=trial: c,
                     SchedulerConfig(
                         scenario_prescreen_after=prescreen_after,
+                        batched_scenario_confirm=batched,
                         max_scenarios_per_job=64,
                         max_victims_considered=64))
+                calls0 = METRICS.counters.get("device_kernel_calls", 0)
                 t1 = time.perf_counter()
                 ssn_t = sched_t.run_once()
                 if timed:
                     elapsed = time.perf_counter() - t1
                     result[f"evictions_{label}"] = len(ssn_t.cache.evicted)
+                    # Device round trips: the hardware-independent cost —
+                    # on the tunneled TPU each is a ~70ms RTT, so call
+                    # count is what the batching actually buys.
+                    result[f"device_calls_{label}"] = int(
+                        METRICS.counters.get("device_kernel_calls", 0)
+                        - calls0)
             timings[label] = elapsed
-        result["reclaim_cycle_s"] = round(timings["prescreen"], 3)
+        result["reclaim_cycle_s"] = round(timings["batched"], 3)
+        result["reclaim_prescreen_only_s"] = round(
+            timings["prescreen-only"], 3)
         result["reclaim_sequential_s"] = round(timings["sequential"], 3)
         result["prescreen_speedup"] = round(
-            timings["sequential"] / max(timings["prescreen"], 1e-9), 2)
+            timings["sequential"] / max(timings["batched"], 1e-9), 2)
         result["queues"] = n_queues
     else:
         # Two cycles, report the best: the first steady cycle can still

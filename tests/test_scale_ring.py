@@ -96,7 +96,7 @@ class TestScaleRing:
         batched prefix prescreen vs fully sequential simulation."""
         r = scale_gen.run_scenario("reclaim-contention", 200)
         _record(r)
-        assert r["evictions_prescreen"] == r["evictions_sequential"] > 0
+        assert r["evictions_batched"] == r["evictions_sequential"] > 0
         # The prescreen must never lose to sequential by more than jit
         # noise, and the cycle must stay bounded.
         assert r["prescreen_speedup"] > 0.8

@@ -256,10 +256,10 @@ class TestBatchedPrescreen:
                    if status == "RELEASING"]
         assert len(evicted) == 4
         # The prescreen engages lazily after scenario_prescreen_after
-        # (=2) failed simulations, then skips the remaining infeasible
-        # prefix (3 victims) in one batched call: 2 warmup failures + 1
+        # (=1) failed simulations, then skips the remaining infeasible
+        # prefix (3 victims) in one batched call: 1 warmup failure + 1
         # successful simulation, instead of 4 sequential scenarios.
-        assert after - before == 3
+        assert after - before == 2
 
     def test_prescreen_disabled_matches(self):
         """Soundness guard: results identical with prescreen off."""
