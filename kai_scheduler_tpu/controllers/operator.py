@@ -29,6 +29,10 @@ class ShardSpec:
     node_pool_label: str | None = None    # label key
     node_pool_value: str | None = None    # label value selecting the pool
     config: SchedulerConfig = field(default_factory=SchedulerConfig)
+    # Raw per-shard args (schedulingshard_types.go:67-77 override map):
+    # re-merged over the operator Config's global scheduler args whenever
+    # either object changes (shard args win).
+    args: dict = field(default_factory=dict)
 
 
 @dataclass
@@ -43,11 +47,14 @@ class SystemConfig:
     # and its window/decay parameters (cache/usagedb params analog).
     usage_db: str | None = None
     usage_params: object = None
-    # Feature gates (pkg/common/feature_gates analog).
+    # Feature gates (pkg/common/feature_gates analog): overrides applied
+    # on top of KNOWN_GATES defaults, shared with every shard's
+    # SchedulerConfig by _build_schedulers.
     feature_gates: dict = field(default_factory=dict)
 
     def gate(self, name: str, default: bool = True) -> bool:
-        return bool(self.feature_gates.get(name, default))
+        from ..utils.feature_gates import FeatureGates
+        return FeatureGates(self.feature_gates).enabled(name, default)
 
 
 class System:
@@ -75,19 +82,15 @@ class System:
         from ..utils.usagedb import resolve_usage_client
         self.usage_db = resolve_usage_client(self.config.usage_db,
                                              self.config.usage_params)
-        usage_provider = (
-            (lambda: self.usage_db.queue_usage(now_fn()))
-            if self.usage_db else None)
         self.schedulers = []
-        shards = (self.config.shards
-                  if self.config.scheduling_enabled else [])
-        for shard in shards:
-            cache = ClusterCache(self.api, now_fn,
-                                 status_updater=self.status_updater)
-            provider = self._shard_provider(cache, shard)
-            self.schedulers.append(
-                Scheduler(provider, shard.config, cache=cache,
-                          usage_provider=usage_provider))
+        self._config_rv = None     # last reconciled Config resourceVersion
+        self._global_sched_args = {}  # Config CRD spec.scheduler.args
+        self._global_gates = {}       # Config CRD featureGates
+        # Programmatic admission policy: the revert target when the admin
+        # removes spec.admission.requireQueueLabel from the Config CRD.
+        self._base_require_queue_label = self.config.require_queue_label
+        if self.config.scheduling_enabled:
+            self._build_schedulers(self.config.shards)
 
     def _shard_provider(self, cache: ClusterCache, shard: ShardSpec):
         def provider():
@@ -111,6 +114,118 @@ class System:
             return cluster
         return provider
 
+    def _compose_shard_config(self, shard: ShardSpec,
+                              dra_detected: bool) -> SchedulerConfig:
+        """Effective config for one shard, recomposed from pristine layers
+        on every reconcile (so REMOVING a Config field reverts it):
+
+          shard base config (programmatic; never mutated)
+          < Config CRD spec.scheduler.args + featureGates (cluster-wide)
+          < SchedulingShard spec.args (per-shard override map,
+            schedulingshard_types.go:67-77)
+
+        with API auto-detection (DRA discovery) as a separate layer under
+        every explicit override."""
+        import copy
+        cfg = copy.deepcopy(shard.config)
+        base_gates = dict(cfg.feature_gates)
+        cfg.feature_gates = dict(self.config.feature_gates)
+        cfg.feature_gates.update(base_gates)
+        if self._global_sched_args:
+            cfg.apply_dict(self._global_sched_args)
+        cfg.feature_gates.update(self._global_gates)
+        if shard.args:
+            cfg.apply_dict(shard.args)
+        from ..utils.feature_gates import DYNAMIC_RESOURCE_ALLOCATION
+        cfg.detected_gates = dict(cfg.detected_gates)
+        cfg.detected_gates[DYNAMIC_RESOURCE_ALLOCATION] = dra_detected
+        return cfg
+
+    def _build_schedulers(self, shards: list, dra: bool | None = None
+                          ) -> None:
+        """(Re)build the scheduler fleet for ``shards`` from freshly
+        composed per-shard configs (a gate the admin flips in the Config
+        CRD must reach plugin registration).  ``dra``: pass a
+        just-detected value to avoid re-running API discovery (and to
+        guarantee the built configs match ones compared against it)."""
+        from ..utils.feature_gates import detect_dra
+        usage_provider = (
+            (lambda: self.usage_db.queue_usage(self._now_fn()))
+            if self.usage_db else None)
+        # DRA auto-detection against the live API server
+        # (feature_gates.go:30-80); explicit overrides win.
+        if dra is None:
+            dra = detect_dra(self.api)
+        self.schedulers = []
+        for shard in shards:
+            cfg = self._compose_shard_config(shard, dra)
+            cache = ClusterCache(self.api, self._now_fn,
+                                 status_updater=self.status_updater)
+            provider = self._shard_provider(cache, shard)
+            self.schedulers.append(
+                Scheduler(provider, cfg, cache=cache,
+                          usage_provider=usage_provider))
+
+    def reconcile_config(self) -> bool:
+        """Operator reconciliation of the cluster-scoped Config CRD
+        (pkg/apis/kai/v1/config_types.go:136): the admin's in-cluster
+        source of truth for system-wide settings.  Applies feature gates,
+        admission policy, and scheduler args to the running fleet.
+        Returns True when anything changed."""
+        obj = self.api.get_opt("Config", "kai-config")
+        if obj is None:
+            if self._config_rv is None:
+                return False
+            # Deleting the Config reverts everything it applied.
+            self._config_rv = None
+            spec = {}
+        else:
+            rv = obj.get("metadata", {}).get("resourceVersion")
+            if rv is not None and rv == self._config_rv:
+                return False
+            self._config_rv = rv
+            spec = obj.get("spec") or {}
+        glob = spec.get("global") or {}
+        new_gates = {
+            k: bool(v) for k, v in (spec.get("featureGates")
+                                    or glob.get("featureGates")
+                                    or {}).items()}
+        new_args = dict((spec.get("scheduler") or {}).get("args") or {})
+        # Validate BEFORE committing to state: a malformed args document
+        # (the CRD preserves unknown fields) must not poison every later
+        # fleet rebuild or crash run_cycle.
+        try:
+            SchedulerConfig().apply_dict(new_args)
+        except Exception as exc:
+            from ..utils.logging import LOG
+            LOG.warning("ignoring invalid Config spec.scheduler.args: %r",
+                        exc)
+            new_args = {}
+        self._global_gates = new_gates
+        self._global_sched_args = new_args
+        changed = False
+        admission = spec.get("admission") or {}
+        # Removal reverts: the fallback is the PROGRAMMATIC base value,
+        # not the last applied one (no ratchet).
+        rql = bool(admission.get("requireQueueLabel",
+                                 self._base_require_queue_label))
+        if rql != self.config.require_queue_label:
+            self.config.require_queue_label = rql
+            self.admission.require_queue_label = rql
+            changed = True
+        if self.config.scheduling_enabled:
+            # Rebuild only when the composed configs actually differ — a
+            # no-op resourceVersion bump must not discard shard caches.
+            from ..utils.feature_gates import detect_dra
+            dra = detect_dra(self.api)
+            desired = [self._compose_shard_config(s, dra)
+                       for s in self.config.shards]
+            current = [s.config for s in self.schedulers]
+            if desired != current:
+                self._build_schedulers(self.config.shards, dra=dra)
+                changed = True
+        return changed
+
     def reconcile_shards(self) -> bool:
         """Operator reconciliation: SchedulingShard objects in the API
         drive the scheduler fleet (schedulingshard_types.go:66-95 — one
@@ -124,36 +239,38 @@ class System:
         shards = []
         for obj in shard_objs:
             spec = obj.get("spec", {})
-            config = SchedulerConfig.from_dict(spec.get("args", {}))
+            args = dict(spec.get("args", {}))
+            try:
+                SchedulerConfig().apply_dict(args)
+            except Exception as exc:
+                from ..utils.logging import LOG
+                LOG.warning("ignoring invalid SchedulingShard %s args: %r",
+                            obj["metadata"]["name"], exc)
+                args = {}
+            # The raw args are the source of truth; composition applies
+            # them over the (default) base in _compose_shard_config.
             shards.append(ShardSpec(
                 obj["metadata"]["name"],
                 spec.get("nodePoolLabelKey"),
                 spec.get("nodePoolLabelValue"),
-                config))
-        current = [(s.name, s.node_pool_label, s.node_pool_value)
+                args=args))
+        # args participate in the change check: editing a shard's
+        # spec.args in place must re-merge its config.
+        current = [(s.name, s.node_pool_label, s.node_pool_value, s.args)
                    for s in self.config.shards]
-        desired = [(s.name, s.node_pool_label, s.node_pool_value)
+        desired = [(s.name, s.node_pool_label, s.node_pool_value, s.args)
                    for s in shards]
         if current == desired:
             return False
         self.config.shards = shards
-        usage_provider = (
-            (lambda: self.usage_db.queue_usage(self._now_fn()))
-            if self.usage_db else None)
-        self.schedulers = []
-        for shard in shards:
-            cache = ClusterCache(self.api, self._now_fn,
-                                 status_updater=self.status_updater)
-            provider = self._shard_provider(cache, shard)
-            self.schedulers.append(
-                Scheduler(provider, shard.config, cache=cache,
-                          usage_provider=usage_provider))
+        self._build_schedulers(shards)
         return True
 
     def run_cycle(self) -> None:
         """One end-to-end tick: drain controller events, run every shard's
         scheduling cycle, drain the binder's work."""
         self.api.drain()
+        self.reconcile_config()
         self.reconcile_shards()
         for scheduler in self.schedulers:
             ssn = scheduler.run_once()

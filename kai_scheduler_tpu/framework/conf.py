@@ -77,6 +77,19 @@ class SchedulerConfig:
     # 0 disables bulk mode.
     bulk_allocation_threshold: int = 32
     bulk_allocation_max_rounds: int = 8
+    # Feature-gate overrides (pkg/common/feature_gates analog): gate name
+    # -> bool.  Consulted at plugin registration (plugins/base.py) via
+    # utils.feature_gates.FeatureGates; unset gates use KNOWN_GATES
+    # defaults or API auto-detection (DRA discovery).
+    feature_gates: dict = field(default_factory=dict)
+    # Auto-detected gate values (e.g. DRA discovery against the live API
+    # server): a separate layer under the explicit overrides above, so
+    # re-detection on a fleet rebuild can still change the answer.
+    detected_gates: dict = field(default_factory=dict)
+
+    def gates(self, api=None):
+        from ..utils.feature_gates import gates_for
+        return gates_for(self, api)
 
     def plugin_args(self, name: str) -> dict:
         for p in self.plugins:
@@ -92,7 +105,14 @@ class SchedulerConfig:
         """Build from the scheduler-config document shape the reference
         embeds (conf_util/scheduler_conf_util.go:36-61): an ``actions``
         string plus plugin tiers with optional argument maps."""
-        config = cls()
+        return cls().apply_dict(d)
+
+    def apply_dict(self, d: dict) -> "SchedulerConfig":
+        """Apply a (partial) config document on top of this config: only
+        keys present in ``d`` change; ``feature_gates`` merges.  The
+        operator uses this to layer Config-CRD global args and per-shard
+        SchedulingShard args over a shard's base config."""
+        config = self
         if "actions" in d:
             actions = d["actions"]
             if isinstance(actions, str):
@@ -121,6 +141,14 @@ class SchedulerConfig:
                 setattr(config, key, d[key])
         if "queue_depth_per_action" in d:
             config.queue_depth_per_action = dict(d["queue_depth_per_action"])
+        gates = d.get("feature_gates", d.get("featureGates"))
+        if gates:
+            if isinstance(gates, str):
+                from ..utils.feature_gates import parse_gate_string
+                gates = parse_gate_string(gates)
+            config.feature_gates = dict(config.feature_gates)
+            config.feature_gates.update(
+                {k: bool(v) for k, v in gates.items()})
         return config
 
     @classmethod

@@ -34,11 +34,21 @@ def register_plugin(name: str):
     return deco
 
 
-def build_plugins(config) -> list[Plugin]:
+def build_plugins(config, api=None) -> list[Plugin]:
+    """Instantiate the configured plugins, honoring feature gates: a
+    plugin whose gate is off is not registered at all (the reference's
+    DRA gate decides whether the upstream DRA machinery participates —
+    pkg/common/feature_gates/feature_gates.go:22)."""
+    gates = None
+    gates_fn = getattr(config, "gates", None)
+    if gates_fn is not None:
+        gates = gates_fn(api)
     plugins = []
     for pc in config.plugins:
         builder = _REGISTRY.get(pc.name)
         if builder is None:
+            continue
+        if gates is not None and not gates.plugin_enabled(pc.name):
             continue
         plugins.append(builder(pc.args))
     return plugins
