@@ -487,8 +487,6 @@ class Session:
             contrib = fn(tasks)
             if contrib is not None:
                 extra[:t] += contrib
-        if node_subset is not None:
-            extra[:, ~node_subset] = -1e17  # mask out-of-subset nodes
 
         # Hard per-task node masks (inter-pod affinity terms, upstream
         # predicate verdicts): False = infeasible, enforced in-kernel.
@@ -508,16 +506,36 @@ class Session:
                 aff_dom = contrib
                 break
 
-        # Homogeneous chunks with no extra score terms take the grouped
-        # fill-plan kernel: one scan step instead of one per task.
+        # Homogeneous chunks take the grouped fill-plan kernel: one scan
+        # step instead of one per task.  Extra score terms and hard masks
+        # ride along when per-job uniform (one [N] row for the whole
+        # chunk) — extras must be tier constants (multiples of 10) for
+        # the fill plan's ordering invariance (allocate_groups_kernel);
+        # a node subset becomes a hard mask row.
         homogeneous = (
-            t > 1 and node_subset is None and not extra.any()
-            and mask is None and anti_dom is None and aff_dom is None
+            t > 1 and anti_dom is None and aff_dom is None
             and self.gpu_strategy == BINPACK
             and self.cpu_strategy == BINPACK
             and (task_req[1:t] == task_req[0]).all()
             and (task_sel[1:t] == task_sel[0]).all()
             and (task_tol[1:t] == task_tol[0]).all())
+        row_extra = row_mask = None
+        if homogeneous and extra.any():
+            row = extra[0]
+            if (extra[1:t] == row).all() and bool(
+                    np.all(np.remainder(row, 10.0) == 0.0)):
+                row_extra = row[None, :]
+            else:
+                homogeneous = False
+        if homogeneous and mask is not None:
+            if (mask[1:t] == mask[0]).all():
+                row_mask = mask[0][None, :]
+            else:
+                homogeneous = False
+        if homogeneous and node_subset is not None:
+            subset_row = np.asarray(node_subset, bool)[None, :]
+            row_mask = (subset_row if row_mask is None
+                        else row_mask & subset_row)
         if homogeneous:
             from ..ops.allocate_grouped import allocate_grouped
             node_arrays = self._device_arrays()
@@ -527,7 +545,9 @@ class Session:
                 gpu_strategy=self.gpu_strategy,
                 cpu_strategy=self.cpu_strategy,
                 allow_pipeline=allow_pipeline,
-                pipeline_only=pipeline_only)
+                pipeline_only=pipeline_only,
+                extra_scores=row_extra,
+                node_mask=row_mask)
             if not bool(result.job_success[0]):
                 return Proposal(False, [])
             placements = []
@@ -540,6 +560,8 @@ class Session:
                 placements.append((task, snap.node_names[node_idx],
                                    bool(piped[i])))
             return Proposal(True, placements)
+        if node_subset is not None:
+            extra[:, ~np.asarray(node_subset, bool)] = -1e17
 
         mask_pad = None
         if mask is not None:

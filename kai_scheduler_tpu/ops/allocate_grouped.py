@@ -14,11 +14,21 @@ by the mean gang size.
 Equivalence to the sequential greedy (tested against the exact kernel):
 - under bin-pack, greedy fills the best-scoring node to capacity before
   moving on, and filling one node never reorders the rest (their free
-  amounts are untouched; the min/max scale shifts monotonically), so the
-  greedy sequence equals "sort nodes by initial score, fill in order";
-- the availability tier is preserved by two fill phases: idle capacity on
-  fit-now nodes first, then (if pipelining) leftover idle+releasing
-  capacity in the same order;
+  amounts are untouched; relative bin-pack order between two untouched
+  nodes depends only on their free amounts, whatever the min/max span
+  does), so the greedy sequence equals "sort by initial score, fill in
+  order";
+- each node contributes TWO fill items — an idle-capacity item keyed by
+  its full score (availability included) and a releasing-capacity item
+  keyed by score minus the availability boost — and ONE fill runs over
+  the interleaved 2N items.  This reproduces the exact kernel's
+  interleaving of tiers: a topology/nominated-boosted pipeline candidate
+  (extra >= 10000 > availability 100) correctly beats an unboosted
+  fit-now node, while within one extra level every fit-now item still
+  beats every pipeline item.  A node's releasing item can only be taken
+  after its idle item (strictly smaller key, same node), so the static
+  capacity split (floor over idle vs floor over idle+releasing minus the
+  former) is exact;
 - per-node capacity = floor(min_r free_r / req_r) bounded by pod room;
 - gang failure (demand exceeds total capacity) rolls the job back at the
   next job boundary, exactly like the per-task kernel.
@@ -37,7 +47,7 @@ import numpy as np
 
 from .allocate import NEG, AllocationResult
 from .predicates import feasibility_row
-from .scoring import BINPACK, score_row
+from .scoring import AVAILABILITY, BINPACK, score_row
 
 
 def group_tasks(task_req: np.ndarray, task_job: np.ndarray,
@@ -105,17 +115,17 @@ def _compact(take, key, max_group: int):
 
 
 def _order_segments(seg_nodes, seg_counts, seg_pipe, seg_keys):
-    """One batched sort over [G, K]: within each group, phase-A segments
-    first then phase-B (pipelined), each descending by score key with the
-    ascending-node-index tie-break (the input order within a phase is
-    ascending node index and the sort is stable), empty slots last —
-    reproducing the exact kernel's placement sequence.  Batched across
-    groups, this runs once per kernel call instead of once per scan step.
-    """
-    phase = jnp.where(seg_counts > 0,
-                      seg_pipe.astype(jnp.uint32), jnp.uint32(2))
+    """One batched sort over [G, K]: within each group, segments order
+    descending by score key (availability folded in, so fit-now items of
+    a tier precede its pipeline items and boosted pipeline items precede
+    unboosted fit-now ones) with the ascending-item-index tie-break (the
+    input order is ascending interleaved item index and the sort is
+    stable), empty slots last — reproducing the exact kernel's placement
+    sequence.  Batched across groups, this runs once per kernel call
+    instead of once per scan step."""
+    empty = jnp.where(seg_counts > 0, jnp.uint32(0), jnp.uint32(1))
     _, _, seg_nodes, seg_counts, seg_pipe = jax.lax.sort(
-        (phase, ~seg_keys, seg_nodes, seg_counts,
+        (empty, ~seg_keys, seg_nodes, seg_counts,
          seg_pipe.astype(jnp.uint32)),
         dimension=-1, num_keys=2, is_stable=True)
     return seg_nodes, seg_counts, seg_pipe > 0
@@ -142,6 +152,20 @@ def _score_keys(score):
     return key, 4, jnp.uint32
 
 
+def _histogram(capw, digit, bins):
+    """Capacity histogram over radix digits WITHOUT materializing a
+    one-hot: the broadcast-compare feeds straight into the axis-0 sum, so
+    XLA's reduce fusion reads ``capw``/``digit`` once per lane tile
+    instead of writing+reading an [N, bins] f32 one-hot through HBM (the
+    previous matmul formulation's dominant per-step cost at 98k nodes).
+    Accumulation stays in ``capw.dtype``; capacities are whole counts, so
+    f32 sums are exact below 2^24."""
+    ar = jnp.arange(bins)
+    return jnp.sum(jnp.where(digit[:, None] == ar[None, :],
+                             capw[:, None], jnp.zeros((), capw.dtype)),
+                   axis=0)
+
+
 def _fill_by_score(key, levels, utype, cap, count):
     """Exact greedy fill WITHOUT sorting: distribute ``count`` units over
     nodes in descending-score order (ascending index among ties), each
@@ -150,14 +174,13 @@ def _fill_by_score(key, levels, utype, cap, count):
     The fill is monotone in score, so it is fully described by a threshold
     key: nodes strictly above it take their whole capacity, nodes at it
     split the remainder in index order.  The threshold is found by
-    radix-select — per 8-bit digit, a capacity histogram via a one-hot
-    matmul (MXU-friendly; no sort, no top_k, no scatter) and a 256-wide
-    scan.  Replaces the per-step ``lax.top_k`` over the full node axis,
-    which lowers to a full sort per scan step and dominated large-cluster
-    cycle latency.
+    radix-select — per 8-bit digit, a fused capacity histogram (no sort,
+    no top_k, no scatter, no materialized one-hot) and a 256-wide scan.
+    Replaces the per-step ``lax.top_k`` over the full node axis, which
+    lowers to a full sort per scan step and dominated large-cluster cycle
+    latency.
     """
     n_bits = levels * 8
-    ar = jnp.arange(256)
     prefix = jnp.zeros((), utype)
     above = jnp.zeros((), cap.dtype)
     for level in range(levels):
@@ -168,11 +191,7 @@ def _fill_by_score(key, levels, utype, cap, count):
         else:
             in_prefix = (key >> utype(n_bits - 8 * level)) == prefix
             capw = jnp.where(in_prefix, cap, 0.0)
-        onehot = (digit[:, None] == ar[None, :]).astype(cap.dtype)
-        # HIGHEST precision: the MXU's default bf16 rounding would corrupt
-        # capacity sums above 256 and break threshold exactness.
-        hist = jnp.matmul(capw, onehot,
-                          precision=jax.lax.Precision.HIGHEST)
+        hist = _histogram(capw, digit, 256)
         ge = jnp.cumsum(hist[::-1])[::-1]          # capacity(digit >= d)
         gt = ge - hist                             # capacity(digit >  d)
         need = count - above                       # invariant: need > 0
@@ -201,7 +220,8 @@ def allocate_groups_kernel(node_allocatable, node_idle, node_releasing,
                            node_labels, node_taints, node_pod_room,
                            group_req, group_sel, group_tol, group_count,
                            group_job, job_allowed, max_group: int,
-                           group_indep=None,
+                           group_indep=None, group_extra=None,
+                           group_mask=None,
                            gpu_strategy: int = BINPACK,
                            cpu_strategy: int = BINPACK,
                            allow_pipeline: bool = True,
@@ -217,7 +237,18 @@ def allocate_groups_kernel(node_allocatable, node_idle, node_releasing,
     failed gang never has prior groups to roll back — the checkpoint
     carries are dropped entirely (a failing group's own take is zeroed by
     its capacity gate).  The host wrapper enables this automatically.
-    """
+
+    ``group_extra`` ([J,N] additive score row per JOB — topology and
+    nominated-node boosts; groups gather their job's row on device) and
+    ``group_mask`` ([J,N] bool hard feasibility — inter-pod-affinity/
+    upstream-predicate verdicts, node subsets) extend the fill plan to
+    heterogeneous-constraint gangs.
+    PRECONDITION for exact parity with the per-task kernel: extra values
+    are tier constants (multiples of 10, scoring.py) — the binpack term
+    spans < 10, so a group's fill can never reorder nodes ACROSS extra
+    levels mid-fill, and WITHIN a level the pure-binpack invariance
+    argument above applies unchanged.  The session fast path checks this
+    before routing (framework/session.py)."""
     G = group_req.shape[0]
     N = node_allocatable.shape[0]
     K = max_group
@@ -264,14 +295,24 @@ def allocate_groups_kernel(node_allocatable, node_idle, node_releasing,
         fit_now, fit_future = feasibility_row(
             idle, rel, node_labels, node_taints, room, req,
             group_sel[g], group_tol[g])
+        if group_mask is not None:
+            mask_row = group_mask[j]
+            fit_now = fit_now & mask_row
+            fit_future = fit_future & mask_row
         if pipeline_only:
             fit_now = jnp.zeros_like(fit_now)
         feasible = fit_now | (fit_future if (allow_pipeline or pipeline_only)
                               else jnp.zeros_like(fit_future))
         score = score_row(node_allocatable, idle, req, feasible, fit_now,
                           gpu_strategy, cpu_strategy)
+        if group_extra is not None:
+            score = score + group_extra[j]
         score = jnp.where(feasible, score, NEG)
-        key, levels, utype = _score_keys(score)
+        # Pipeline items score without the availability boost (the exact
+        # kernel's fit_now term vanishes once a node's idle is spent).
+        score_pipe = score - jnp.where(fit_now, AVAILABILITY, 0.0)
+        key_now, levels, utype = _score_keys(score)
+        key_pipe, _, _ = _score_keys(score_pipe)
 
         safe_req = jnp.where(req > 0, req, 1.0)
         cap_now_f = jnp.min(jnp.where(req[None, :] > 0,
@@ -283,19 +324,27 @@ def allocate_groups_kernel(node_allocatable, node_idle, node_releasing,
         cap_now = jnp.where(fit_now, jnp.minimum(cap_now_f, room), 0.0)
         cap_tot = jnp.where(feasible, jnp.minimum(cap_tot_f, room), 0.0)
         cap_now = jnp.clip(cap_now, 0.0, count)
-        cap_tot = jnp.clip(cap_tot, 0.0, count)
-
-        # Exact greedy fill, sort-free: phase A on idle capacity in score
-        # order, then phase B (pipelining) on the leftover releasing
-        # capacity in the same order.
-        take_a = _fill_by_score(key, levels, utype, cap_now, count)
-        total_now = take_a.sum()
-        cap_b = cap_tot - take_a
-        remaining = jnp.maximum(count - total_now, 0.0)
-        take_b = _fill_by_score(key, levels, utype, cap_b, remaining)
+        cap_rel = jnp.clip(cap_tot - cap_now, 0.0, count)
         if not (allow_pipeline or pipeline_only):
-            take_b = jnp.zeros_like(take_b)
-        placed = total_now + take_b.sum()
+            cap_rel = jnp.zeros_like(cap_rel)
+
+        # ONE exact greedy fill, sort-free, over the interleaved 2N
+        # (node, phase) items — item 2n is node n's idle capacity at its
+        # full score, item 2n+1 its releasing capacity without the
+        # availability boost.  Interleaving keeps equal-key ties resolved
+        # by ascending node index, matching the exact kernel's argmax.
+        # The lax.cond skips the radix select entirely for satisfied
+        # demands (padded/gated groups) — most of a backlog cycle's
+        # step cost.
+        key2 = jnp.stack([key_now, key_pipe], axis=1).reshape(-1)
+        cap2 = jnp.stack([cap_now, cap_rel], axis=1).reshape(-1)
+        take2 = jax.lax.cond(
+            count > 0,
+            lambda: _fill_by_score(key2, levels, utype, cap2, count),
+            lambda: jnp.zeros_like(cap2))
+        take_a = take2[0::2]
+        take_b = take2[1::2]
+        placed = take2.sum()
 
         if single_group_jobs:
             # A failed gang must leave no trace: zero its takes in-step
@@ -305,33 +354,17 @@ def allocate_groups_kernel(node_allocatable, node_idle, node_releasing,
             gang_ok = group_indep[g] | (placed >= count)
             take_a = jnp.where(gang_ok, take_a, 0.0)
             take_b = jnp.where(gang_ok, take_b, 0.0)
+            take2 = jnp.where(gang_ok, take2, 0.0)
 
         idle = idle - take_a[:, None] * req[None, :]
         rel = rel - take_b[:, None] * req[None, :]
         room = room - take_a - take_b
 
-        nodes_a, counts_a, keys_a = _compact(take_a, key, K)
-        nodes_b, counts_b, keys_b = _compact(take_b, key, K)
-        # Merge phases: A segments first, then B (pipelined) in the slots
-        # after A's — a dynamic-slice shift, not a scatter (dynamic-index
-        # scatters serialize on TPU).  A's nonzero segments are a
-        # contiguous prefix by construction.
-        a_used = (counts_a > 0).sum().astype(jnp.int32)
-        start = (K - a_used).astype(jnp.int32)
-        shift_n = jax.lax.dynamic_slice(
-            jnp.concatenate([jnp.full(K, -1, jnp.int32), nodes_b]),
-            (start,), (K,))
-        shift_c = jax.lax.dynamic_slice(
-            jnp.concatenate([jnp.zeros(K, counts_b.dtype), counts_b]),
-            (start,), (K,))
-        shift_k = jax.lax.dynamic_slice(
-            jnp.concatenate([jnp.zeros(K, keys_b.dtype), keys_b]),
-            (start,), (K,))
-        in_a = jnp.arange(K) < a_used
-        seg_nodes = jnp.where(in_a, nodes_a, shift_n)
-        seg_counts = jnp.where(in_a, counts_a, shift_c)
-        seg_keys = jnp.where(in_a, keys_a, shift_k)
-        seg_pipe = ~in_a & (seg_counts > 0)
+        # Compact the interleaved items once: item index -> (node, phase).
+        items, counts2, seg_keys = _compact(take2, key2, K)
+        seg_nodes = jnp.where(items >= 0, items >> 1, -1)
+        seg_pipe = (items >= 0) & (items & 1 == 1) & (counts2 > 0)
+        seg_counts = counts2
 
         ok = ok & (placed >= count)
         return (Carry(idle, rel, room, ck_idle, ck_rel, ck_room,
@@ -367,21 +400,55 @@ def _next_pow2(n: int) -> int:
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("max_group", "gpu_strategy",
+                   static_argnames=("max_group", "t_pad", "gpu_strategy",
                                     "cpu_strategy", "allow_pipeline",
                                     "pipeline_only", "single_group_jobs"))
-def _allocate_groups_packed(*args, **kw):
-    """Kernel + single-buffer packing: a remote device pays a full RTT per
-    fetched buffer, so everything the host needs returns as ONE array."""
-    (seg_nodes, seg_counts, seg_pipe, group_placed, job_success,
-     idle, rel) = allocate_groups_kernel(*args, **kw)
-    g, k = seg_nodes.shape
-    packed = jnp.concatenate([
-        seg_nodes.astype(jnp.float32).ravel(),
-        seg_counts.astype(jnp.float32).ravel(),
-        seg_pipe.astype(jnp.float32).ravel(),
-        job_success.astype(jnp.float32).ravel(),
-    ])
+def _allocate_groups_packed(node_allocatable, node_idle, node_releasing,
+                            node_labels, node_taints, node_pod_room,
+                            group_req, group_sel, group_tol, group_count,
+                            group_job, job_allowed, max_group: int,
+                            t_pad: int, group_indep=None, **kw):
+    """Kernel + DEVICE-SIDE per-task expansion + single-buffer packing.
+
+    A remote device pays a full RTT per fetched buffer, so everything the
+    host needs returns as ONE int32 array of length t_pad + J:
+      [0:t_pad]   per-task encoding: -1 unplaced, node for allocated,
+                  -(node+2) for pipelined;
+      [t_pad:]    per-job success flags.
+    Expanding segments to tasks on device replaces both the [G,K]x3
+    segment fetch (12.6MB at the north-star shape) and the host-side
+    Python per-group expansion loop with one [T] fetch.
+    """
+    G = group_req.shape[0]
+    if group_indep is None:
+        group_indep = jnp.zeros(G, bool)
+    (seg_nodes, seg_counts, seg_pipe, _group_placed, job_success,
+     idle, rel) = allocate_groups_kernel(
+        node_allocatable, node_idle, node_releasing, node_labels,
+        node_taints, node_pod_room, group_req, group_sel, group_tol,
+        group_count, group_job, job_allowed, max_group,
+        group_indep=group_indep, **kw)
+    # A group expands only if it is independent (partial placements keep
+    # task order: first jobs of a merged run win) or its gang succeeded.
+    gate = group_indep | job_success[group_job]
+    counts = jnp.where(gate[:, None], seg_counts, 0).astype(jnp.int32)
+    enc = jnp.where(seg_pipe, -(seg_nodes + 2), seg_nodes)
+    # Sentinel column per group: the unplaced tail of each group maps to
+    # -1, keeping every group's tasks aligned at their original offsets;
+    # one trailing sentinel absorbs the pad to t_pad.
+    sentinel = (group_count.astype(jnp.int32)
+                - counts.sum(axis=1))[:, None]
+    flat_enc = jnp.concatenate([
+        jnp.concatenate([enc, jnp.full((G, 1), -1, enc.dtype)],
+                        axis=1).ravel(),
+        jnp.array([-1], enc.dtype)])
+    flat_counts = jnp.concatenate([
+        jnp.concatenate([counts, sentinel], axis=1).ravel(),
+        (t_pad - group_count.sum().astype(jnp.int32))[None]])
+    per_task = jnp.repeat(flat_enc, flat_counts,
+                          total_repeat_length=t_pad)
+    packed = jnp.concatenate([per_task.astype(jnp.int32),
+                              job_success.astype(jnp.int32)])
     return packed, idle, rel
 
 
@@ -391,14 +458,24 @@ def allocate_grouped(node_arrays, task_req, task_job, task_selector,
                      cpu_strategy: int = BINPACK,
                      allow_pipeline: bool = True,
                      pipeline_only: bool = False,
-                     independent_jobs=None) -> AllocationResult:
-    """Host wrapper: group prep -> group-scan kernel -> per-task expansion.
+                     independent_jobs=None,
+                     extra_scores=None,
+                     node_mask=None) -> AllocationResult:
+    """Host wrapper: group prep -> group-scan kernel (with on-device
+    per-task expansion).
 
     Drop-in equivalent of ops.allocate.allocate_jobs_kernel for bin-pack
     strategies.  ``independent_jobs`` ([J] bool): single-task jobs whose
     placement is independent — identical adjacent ones merge into one
     group (one scan step for a whole burst wave), each member succeeding
     or failing on its own.
+
+    ``extra_scores``: [J,N] additive per-JOB score rows (every task of a
+    job shares one row — the common shape of topology/nominated boosts);
+    values must be tier constants (multiples of 10) for exact parity —
+    see allocate_groups_kernel.  ``node_mask``: [J,N] bool per-job hard
+    feasibility rows.  Jobs with either disable group merging across job
+    boundaries (rows differ) but still fill in one step per group.
     """
     np_req = np.asarray(task_req)
     np_job = np.asarray(task_job)
@@ -406,7 +483,10 @@ def allocate_grouped(node_arrays, task_req, task_job, task_selector,
     np_tol = np.asarray(task_tolerations)
     allowed_np = np.asarray(job_allowed)
     mergeable = None
-    if independent_jobs is not None:
+    if independent_jobs is not None and extra_scores is None \
+            and node_mask is None:
+        # (Per-job extra/mask rows disable cross-job merging: a merged
+        # group can only carry one row.)
         indep_np = np.asarray(independent_jobs)
         # Independence only holds for single-task jobs: partial placement
         # of a gang would silently break its atomicity.
@@ -430,14 +510,16 @@ def allocate_grouped(node_arrays, task_req, task_job, task_selector,
         single = len(g_job) == len(set(g_job.tolist()))
     max_group = _next_pow2(int(g_count.max()) if len(g_count) else 1)
 
-    # Pad the ragged group/job axes to power-of-two buckets: a steady
+    # Pad the ragged group/job/task axes to power-of-two buckets: a steady
     # backlog whose pending count drifts by a few jobs per cycle must not
-    # recompile the kernel every cycle (each distinct (G, J) is a fresh
+    # recompile the kernel every cycle (each distinct (G, J, T) is a fresh
     # XLA compilation — seconds per cycle at burst scale).  Padded groups
     # carry count 0 and point at padded jobs gated to False; padded jobs
     # keep group_job values distinct so single-group mode is preserved.
     n_real_groups = len(g_count)
     n_real_jobs = len(allowed_np)
+    T = np_req.shape[0]
+    t_pad = _next_pow2(max(T, 1))
     g_pad = _next_pow2(max(n_real_groups, 1)) - n_real_groups
     n_jobs_padded = _next_pow2(max(n_real_jobs + g_pad, 1))
     job_allowed_padded = np.zeros(n_jobs_padded, bool)
@@ -452,38 +534,34 @@ def allocate_grouped(node_arrays, task_req, task_job, task_selector,
         g_job = np.concatenate([
             g_job, (n_real_jobs + np.arange(g_pad)).astype(np.int32)])
         g_indep = np.concatenate([g_indep, np.zeros(g_pad, bool)])
+    kw = {}
+    if extra_scores is not None or node_mask is not None:
+        # Per-JOB rows, padded to the job axis; groups gather their job's
+        # row on device (no [G,N] host expansion).  f32 is exact for tier
+        # constants (multiples of 10 below 2^24).
+        n_nodes = int(np.asarray(node_arrays[0]).shape[0])
+        if extra_scores is not None:
+            j_extra = np.zeros((n_jobs_padded, n_nodes), np.float32)
+            j_extra[:n_real_jobs] = np.asarray(extra_scores)
+            kw["group_extra"] = jnp.asarray(j_extra)
+        if node_mask is not None:
+            j_mask = np.ones((n_jobs_padded, n_nodes), bool)
+            j_mask[:n_real_jobs] = np.asarray(node_mask)
+            kw["group_mask"] = jnp.asarray(j_mask)
 
     packed, idle, rel = _allocate_groups_packed(
         *node_arrays, jnp.asarray(g_req), jnp.asarray(g_sel),
         jnp.asarray(g_tol), jnp.asarray(g_count), jnp.asarray(g_job),
         jnp.asarray(job_allowed_padded), max_group=max_group,
-        group_indep=jnp.asarray(g_indep),
+        t_pad=t_pad, group_indep=jnp.asarray(g_indep),
         gpu_strategy=gpu_strategy, cpu_strategy=cpu_strategy,
         allow_pipeline=allow_pipeline, pipeline_only=pipeline_only,
-        single_group_jobs=single)
+        single_group_jobs=single, **kw)
     packed = np.asarray(packed)  # ONE device->host fetch
-    g, k = len(g_count), max_group
-    seg_nodes = packed[:g * k].reshape(g, k).astype(np.int32)
-    seg_counts = packed[g * k:2 * g * k].reshape(g, k).astype(np.int64)
-    seg_pipe = packed[2 * g * k:3 * g * k] .reshape(g, k) > 0.5
-    success = packed[3 * g * k:3 * g * k + n_real_jobs] > 0.5
-    T = np_req.shape[0]
-    placements = np.full(T, -1, np.int32)
-    pipelined = np.zeros(T, bool)
-    t = 0
-    for g in range(n_real_groups):
-        k = int(g_count[g])
-        # Merged independent runs expand partial placements in task order
-        # (first jobs of the run win, like the sequential greedy); gangs
-        # expand only on success.  g_indep is all-False unless the
-        # single-group mode is active (fallback regrouping above).
-        if g_indep[g] or success[g_job[g]]:
-            nodes = np.repeat(seg_nodes[g], seg_counts[g])
-            pipes = np.repeat(seg_pipe[g], seg_counts[g])
-            n = min(len(nodes), k)
-            placements[t:t + n] = nodes[:n]
-            pipelined[t:t + n] = pipes[:n]
-        t += k
+    enc = packed[:T]
+    placements = np.where(enc >= -1, enc, -enc - 2).astype(np.int32)
+    pipelined = enc < -1
+    success = packed[t_pad:t_pad + n_real_jobs] > 0
     # Per-job success for merged independent jobs comes from their own
     # task's placement (the kernel's segment accounting aliases them to
     # the run's first job).  Mergeable jobs are single-task, so their

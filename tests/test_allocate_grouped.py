@@ -183,3 +183,183 @@ class TestMergedIndependentSingles:
         assert (placed[10:50] >= 0).sum() == 0
         # All 15 singles fit.
         assert success[:10].all() and success[11:].all()
+
+
+class TestExtraScoresAndMasks:
+    """Per-job extra score rows (tier constants) and hard masks through
+    the grouped fill plan: parity with the exact kernel, which receives
+    the same terms as [T,N] arrays."""
+
+    def _expand(self, rows, task_job):
+        return np.asarray(rows)[np.asarray(task_job)]
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_extra_parity_with_exact_kernel(self, seed):
+        nodes, tasks, job_allowed = make_instance(seed)
+        n_jobs = len(np.asarray(job_allowed))
+        n_nodes = np.asarray(nodes[0]).shape[0]
+        rng = np.random.default_rng(seed + 100)
+        # Tier-constant boosts (multiples of 10, like topology=10000 and
+        # nominated=1e6): a random subset of nodes boosted per job.
+        extra = np.where(rng.random((n_jobs, n_nodes)) < 0.3,
+                         10000.0, 0.0)
+        exact = allocate_jobs_kernel(
+            *nodes, *tasks, job_allowed,
+            jnp.asarray(self._expand(extra, tasks[1])))
+        grouped = allocate_grouped(nodes, *tasks, job_allowed,
+                                   extra_scores=extra)
+        np.testing.assert_array_equal(np.asarray(exact.job_success),
+                                      np.asarray(grouped.job_success))
+        np.testing.assert_array_equal(np.asarray(exact.placements),
+                                      np.asarray(grouped.placements))
+        np.testing.assert_array_equal(np.asarray(exact.pipelined),
+                                      np.asarray(grouped.pipelined))
+        np.testing.assert_allclose(np.asarray(exact.node_idle),
+                                   np.asarray(grouped.node_idle))
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_mask_parity_with_exact_kernel(self, seed):
+        nodes, tasks, job_allowed = make_instance(seed)
+        n_jobs = len(np.asarray(job_allowed))
+        n_nodes = np.asarray(nodes[0]).shape[0]
+        rng = np.random.default_rng(seed + 200)
+        mask = rng.random((n_jobs, n_nodes)) < 0.7
+        exact = allocate_jobs_kernel(
+            *nodes, *tasks, job_allowed,
+            task_node_mask=jnp.asarray(self._expand(mask, tasks[1])))
+        grouped = allocate_grouped(nodes, *tasks, job_allowed,
+                                   node_mask=mask)
+        np.testing.assert_array_equal(np.asarray(exact.job_success),
+                                      np.asarray(grouped.job_success))
+        np.testing.assert_array_equal(np.asarray(exact.placements),
+                                      np.asarray(grouped.placements))
+        np.testing.assert_allclose(np.asarray(exact.node_idle),
+                                   np.asarray(grouped.node_idle))
+
+    def test_extra_and_mask_together(self):
+        nodes, tasks, job_allowed = make_instance(3)
+        n_jobs = len(np.asarray(job_allowed))
+        n_nodes = np.asarray(nodes[0]).shape[0]
+        rng = np.random.default_rng(42)
+        extra = np.where(rng.random((n_jobs, n_nodes)) < 0.3, 100.0, 0.0)
+        mask = rng.random((n_jobs, n_nodes)) < 0.8
+        exact = allocate_jobs_kernel(
+            *nodes, *tasks, job_allowed,
+            jnp.asarray(self._expand(extra, tasks[1])),
+            task_node_mask=jnp.asarray(self._expand(mask, tasks[1])))
+        grouped = allocate_grouped(nodes, *tasks, job_allowed,
+                                   extra_scores=extra, node_mask=mask)
+        np.testing.assert_array_equal(np.asarray(exact.placements),
+                                      np.asarray(grouped.placements))
+        np.testing.assert_array_equal(np.asarray(exact.job_success),
+                                      np.asarray(grouped.job_success))
+
+
+class TestSessionFastPathRouting:
+    """propose_placements routing: which chunks take the grouped
+    fill-plan kernel vs the exact per-task scan (framework/session.py).
+    A regression that routes non-uniform or non-tier terms through the
+    fill plan would silently change placements."""
+
+    def _session(self):
+        from kai_scheduler_tpu.utils.cluster_spec import build_session
+        spec = {"nodes": {f"n{i}": {"gpu": 8} for i in range(6)},
+                "queues": {"q": {}},
+                "jobs": {"j1": {"queue": "q", "min_available": 4,
+                                "tasks": [{"cpu": "1", "mem": "1Gi",
+                                           "gpu": 2}] * 4}}}
+        ssn = build_session(spec)
+        tasks = list(ssn.cluster.podgroups["j1"].pods.values())
+        return ssn, tasks
+
+    def _spy(self, monkeypatch):
+        import kai_scheduler_tpu.ops.allocate_grouped as ag
+        calls = []
+        orig = ag.allocate_grouped
+
+        def spy(*a, **k):
+            calls.append(k)
+            return orig(*a, **k)
+
+        # The session imports inside the function body, so patch the
+        # module attribute it resolves at call time.
+        monkeypatch.setattr(
+            "kai_scheduler_tpu.ops.allocate_grouped.allocate_grouped",
+            spy, raising=True)
+        return calls
+
+    def test_plain_homogeneous_routes_grouped(self, monkeypatch):
+        ssn, tasks = self._session()
+        calls = self._spy(monkeypatch)
+        prop = ssn.propose_placements(tasks)
+        assert prop.success and len(prop.placements) == 4
+        assert len(calls) == 1
+
+    def test_uniform_tier_extra_routes_grouped(self, monkeypatch):
+        ssn, tasks = self._session()
+        n = ssn.node_idle.shape[0]
+        boost = np.zeros(n)
+        boost[3] = 10000.0
+        ssn.extra_score_fns.append(
+            lambda ts: np.tile(boost, (len(ts), 1)))
+        calls = self._spy(monkeypatch)
+        prop = ssn.propose_placements(tasks)
+        assert prop.success
+        assert len(calls) == 1
+        assert calls[0].get("extra_scores") is not None
+        # The boost decides the placement: everything lands on n3.
+        assert {p[1] for p in prop.placements} == {"n3"}
+
+    def test_non_tier_extra_falls_back_to_exact(self, monkeypatch):
+        ssn, tasks = self._session()
+        n = ssn.node_idle.shape[0]
+        boost = np.zeros(n)
+        boost[3] = 5.0  # not a multiple of 10: fill-plan parity unsafe
+        ssn.extra_score_fns.append(
+            lambda ts: np.tile(boost, (len(ts), 1)))
+        calls = self._spy(monkeypatch)
+        prop = ssn.propose_placements(tasks)
+        assert prop.success
+        assert calls == []
+
+    def test_per_task_varying_extra_falls_back(self, monkeypatch):
+        ssn, tasks = self._session()
+        n = ssn.node_idle.shape[0]
+
+        def varying(ts):
+            extra = np.zeros((len(ts), n))
+            extra[0, 2] = 10000.0  # only the first task boosted
+            return extra
+
+        ssn.extra_score_fns.append(varying)
+        calls = self._spy(monkeypatch)
+        prop = ssn.propose_placements(tasks)
+        assert prop.success
+        assert calls == []
+
+    def test_node_subset_becomes_mask_row(self, monkeypatch):
+        ssn, tasks = self._session()
+        n = ssn.node_idle.shape[0]
+        subset = np.zeros(n, bool)
+        subset[4:] = True
+        calls = self._spy(monkeypatch)
+        prop = ssn.propose_placements(tasks, node_subset=subset)
+        assert prop.success
+        assert len(calls) == 1
+        assert calls[0].get("node_mask") is not None
+        assert {p[1] for p in prop.placements} <= {"n4", "n5"}
+
+    def test_per_task_varying_mask_falls_back(self, monkeypatch):
+        ssn, tasks = self._session()
+        n = ssn.node_idle.shape[0]
+
+        def varying_mask(ts):
+            mask = np.ones((len(ts), n), bool)
+            mask[0, :3] = False
+            return mask
+
+        ssn.hard_node_mask_fns.append(varying_mask)
+        calls = self._spy(monkeypatch)
+        prop = ssn.propose_placements(tasks)
+        assert prop.success
+        assert calls == []
