@@ -154,6 +154,23 @@ class Session:
                         snap.node_allocatable - snap.node_idle,
                         snap.node_releasing, snap.node_pod_room)
                     self._native = table
+                    # Single source of truth: rebind each NodeInfo's
+                    # used/releasing to zero-copy VIEWS of its table row.
+                    # Statement accounting then updates the object graph
+                    # and the packed kernel inputs in one native write —
+                    # no per-task copy-back (the dominant host cost at
+                    # 100k-node scale).  All in-tree mutations are
+                    # in-place (+=/-=); clone() detaches via .copy().
+                    used_rows = table.used
+                    rel_rows = table.releasing
+                    for name, node in cluster.nodes.items():
+                        i = node.idx
+                        if 0 <= i < table.n_nodes and \
+                                node.used.shape[0] == table.n_res:
+                            used_rows[i] = node.used
+                            rel_rows[i] = node.releasing
+                            node.used = used_rows[i]
+                            node.releasing = rel_rows[i]
             except Exception:
                 self._native = None
         if self._native is None:

@@ -33,6 +33,10 @@ class _Op:
     prev_node: str = ""
     prev_gpu_group: str = ""
     gpu_group: str = ""
+    # Fast-path ops: accounting went through the native table directly
+    # (one batched call), so undo must route there too.
+    native_req: object = None      # np.ndarray when native-applied
+    node_idx: int = -1
 
 
 class Statement:
@@ -55,7 +59,16 @@ class Statement:
         """Apply [(task, node_name, pipelined)] with one mirror sync per
         touched node.  Semantically identical to per-task allocate()/
         pipeline() — the op log and handlers still fire per task, so
-        checkpoint/rollback and queue accounting are unchanged."""
+        checkpoint/rollback and queue accounting are unchanged.
+
+        Plain tasks (no fractional GPU, no MIG, no storage claims) take
+        the NATIVE batch path: per-task Python does only the object-graph
+        bookkeeping (op log, job status, handlers, pod_infos) while the
+        resource accounting for the whole batch lands in ONE
+        statestore.cpp call, with NodeInfo.used/releasing views updated
+        for free (framework/session.py row binding)."""
+        if self._apply_bulk_native(placements):
+            return
         self._defer = set()
         try:
             for task, node_name, pipelined in placements:
@@ -67,6 +80,59 @@ class Statement:
             touched, self._defer = self._defer, None
             for name in touched:
                 self.session.sync_node(self.session.cluster.nodes[name])
+
+    def _apply_bulk_native(self, placements) -> bool:
+        """Try the batched native path; False -> caller uses the generic
+        per-task path (non-plain task, no native table, unbound views)."""
+        import numpy as np
+        ssn = self.session
+        table = getattr(ssn, "_native", None)
+        if table is None or not placements:
+            return False
+        nodes = ssn.cluster.nodes
+        rows = []
+        for task, node_name, pipelined in placements:
+            node = nodes[node_name]
+            if (task.is_fractional or task.res_req.mig_resources
+                    or task.storage_claims or node.idx < 0
+                    or node.idx >= table.n_nodes
+                    or node.used.base is None):  # view not bound
+                return False
+            rows.append((task, node, pipelined))
+        n = len(rows)
+        idx = np.empty(n, np.int64)
+        reqs = np.empty((n, table.n_res), np.float64)
+        statuses = np.empty(n, np.int32)
+        ops = []
+        for i, (task, node, pipelined) in enumerate(rows):
+            status = (PodStatus.PIPELINED if pipelined
+                      else PodStatus.ALLOCATED)
+            req = task.res_req.to_vec(node.gpu_memory_per_device,
+                                      mig_as_gpu=False)
+            op = _Op("pipeline" if pipelined else "allocate", task,
+                     node.name, prev_status=task.status,
+                     prev_node=task.node_name,
+                     prev_gpu_group=task.gpu_group,
+                     native_req=req, node_idx=node.idx)
+            task.node_name = node.name
+            task.gpu_group = ""
+            job = ssn.cluster.podgroups.get(task.job_id)
+            if job is not None:
+                job.update_task_status(task, status)
+            else:
+                task.status = status
+            node.pod_infos[task.uid] = task
+            ssn.fire_allocate_handlers(task)
+            ops.append(op)
+            idx[i] = node.idx
+            reqs[i] = req
+            statuses[i] = 2 if pipelined else 0
+        table.add_tasks(idx, reqs, statuses)
+        ssn.cluster.invalidate_aggregates()
+        ssn.mutation_count += 1
+        ssn._state_dirty = True
+        self.ops.extend(ops)
+        return True
 
     # -- mutations ---------------------------------------------------------
     def allocate(self, task: PodInfo, node_name: str,
@@ -127,11 +193,33 @@ class Statement:
         while len(self.ops) > checkpoint:
             self._undo(self.ops.pop())
 
+    _STATUS_CODE = {PodStatus.ALLOCATED: 0, PodStatus.RELEASING: 1,
+                    PodStatus.PIPELINED: 2}
+
     def _undo(self, op: _Op) -> None:
         task = op.task
         node = self.session.cluster.nodes.get(op.node_name)
         job = self.session.cluster.podgroups.get(task.job_id)
         self.session.cluster.invalidate_aggregates()
+        if op.native_req is not None and op.kind in ("allocate",
+                                                     "pipeline"):
+            # Native-applied op: reverse through the table (views keep
+            # the NodeInfo graph consistent).
+            if node is not None:
+                node.pod_infos.pop(task.uid, None)
+                self.session._native.remove_task(
+                    op.node_idx, op.native_req,
+                    self._STATUS_CODE.get(task.status, 0))
+            self.session.fire_deallocate_handlers(task, task.status)
+            if job is not None:
+                job.update_task_status(task, op.prev_status)
+            else:
+                task.status = op.prev_status
+            task.node_name = op.prev_node
+            task.gpu_group = op.prev_gpu_group
+            self.session.mutation_count += 1
+            self.session._state_dirty = True
+            return
         if op.kind in ("allocate", "pipeline"):
             if node is not None:
                 node.remove_task(task)
@@ -167,6 +255,20 @@ class Statement:
                     and op.task.status == PodStatus.ALLOCATED):
                 node = self.session.cluster.nodes[op.task.node_name]
                 job = self.session.cluster.podgroups.get(job_id)
+                if op.native_req is not None:
+                    self.session._native.remove_task(
+                        op.node_idx, op.native_req, 0)
+                    if job is not None:
+                        job.update_task_status(op.task,
+                                               PodStatus.PIPELINED)
+                    else:
+                        op.task.status = PodStatus.PIPELINED
+                    self.session._native.add_task(
+                        op.node_idx, op.native_req, 2)
+                    self.session.mutation_count += 1
+                    self.session._state_dirty = True
+                    op.kind = "pipeline"
+                    continue
                 node.remove_task(op.task)
                 if job is not None:
                     job.update_task_status(op.task, PodStatus.PIPELINED)

@@ -48,6 +48,12 @@ def load_statestore_lib():
                                 ctypes.c_int]
     lib.ss_remove_task.argtypes = [ctypes.c_void_p, ctypes.c_int64, d,
                                    ctypes.c_int]
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    lib.ss_add_tasks.argtypes = [ctypes.c_void_p, ctypes.c_int64, i64p, d,
+                                 i32p]
+    lib.ss_remove_tasks.argtypes = [ctypes.c_void_p, ctypes.c_int64, i64p,
+                                    d, i32p]
     for name in ("ss_idle", "ss_allocatable", "ss_used", "ss_releasing",
                  "ss_room"):
         fn = getattr(lib, name)

@@ -100,6 +100,23 @@ void ss_remove_task(StateStore* s, int64_t i, const double* req,
   s->room[i] += 1.0;
 }
 
+// Batched accounting: the whole gang's placements in ONE call from
+// Python (the per-task ctypes round trip dominated bulk Statement
+// application at 100k-node scale).  reqs is [n * n_res] row-major.
+void ss_add_tasks(StateStore* s, int64_t n, const int64_t* idx,
+                  const double* reqs, const int32_t* status) {
+  for (int64_t i = 0; i < n; ++i) {
+    ss_add_task(s, idx[i], reqs + i * s->n_res, status[i]);
+  }
+}
+
+void ss_remove_tasks(StateStore* s, int64_t n, const int64_t* idx,
+                     const double* reqs, const int32_t* status) {
+  for (int64_t i = 0; i < n; ++i) {
+    ss_remove_task(s, idx[i], reqs + i * s->n_res, status[i]);
+  }
+}
+
 // Refresh the derived idle table (allocatable - used) and return pointers.
 double* ss_idle(StateStore* s) {
   const int64_t n = s->n_nodes * s->n_res;

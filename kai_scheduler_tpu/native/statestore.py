@@ -70,6 +70,28 @@ class NativeNodeTable:
         self._lib.ss_remove_task(self._handle, node_idx, _as_dptr(r),
                                  status)
 
+    # Batched forms: one ctypes round trip for a whole gang's placements
+    # (the per-call overhead dominated bulk Statement application).
+    def add_tasks(self, idx: np.ndarray, reqs: np.ndarray,
+                  statuses: np.ndarray) -> None:
+        i = np.ascontiguousarray(idx, np.int64)
+        r = np.ascontiguousarray(reqs, np.float64)
+        s = np.ascontiguousarray(statuses, np.int32)
+        self._lib.ss_add_tasks(
+            self._handle, len(i),
+            i.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), _as_dptr(r),
+            s.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+
+    def remove_tasks(self, idx: np.ndarray, reqs: np.ndarray,
+                     statuses: np.ndarray) -> None:
+        i = np.ascontiguousarray(idx, np.int64)
+        r = np.ascontiguousarray(reqs, np.float64)
+        s = np.ascontiguousarray(statuses, np.int32)
+        self._lib.ss_remove_tasks(
+            self._handle, len(i),
+            i.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), _as_dptr(r),
+            s.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+
     # -- views (zero-copy over the C buffers) ------------------------------
     # The C buffers live at fixed addresses for the table's lifetime, so
     # each view is built once and cached — view construction showed up as
