@@ -49,9 +49,16 @@ class AllocateAction:
                     "skipped: identical job already failed this cycle")
                 order.requeue_queue(job.queue_id)
                 continue
+            active_before = job.num_active_used()
             succeeded = attempt_to_allocate_job(ssn, job)
             if succeeded:
-                if job.has_tasks_to_allocate():
+                # Progress guard: a "successful" attempt that placed
+                # nothing (num_active_used unchanged) must not re-enter the
+                # queue — re-pushing it would retry the identical attempt
+                # forever.  Only elastic jobs that genuinely advanced get
+                # another chunk this cycle.
+                if (job.has_tasks_to_allocate()
+                        and job.num_active_used() > active_before):
                     order.push_job(job)  # elastic: next chunk later
                 else:
                     order.requeue_queue(job.queue_id)

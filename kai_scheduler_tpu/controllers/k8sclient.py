@@ -48,6 +48,7 @@ KIND_ROUTES = {
                            "csistoragecapacities", True),
     "Deployment": ("apis/apps/v1", "deployments", True),
     "Lease": ("apis/coordination.k8s.io/v1", "leases", True),
+    "Config": ("apis/kai.scheduler/v1", "configs", False),
     "Queue": ("apis/kai.scheduler/v1", "queues", False),
     "SchedulingShard": ("apis/kai.scheduler/v1", "schedulingshards", False),
     "Topology": ("apis/kai.scheduler/v1", "topologies", False),

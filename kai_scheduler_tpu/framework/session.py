@@ -508,6 +508,15 @@ class Session:
         # Hard per-task node masks (inter-pod affinity terms, upstream
         # predicate verdicts): False = infeasible, enforced in-kernel.
         mask = self.compute_hard_mask(tasks)
+        if node_subset is not None:
+            # The topology node subset is a hard mask (matching the
+            # fractional/MIG handlers, which skip out-of-subset nodes
+            # unconditionally): an out-of-subset node is infeasible, not a
+            # soft last resort.  Folded in here once so the homogeneous
+            # fast path and the per-task path share identical semantics.
+            subset = np.asarray(node_subset, bool)
+            mask = (np.broadcast_to(subset, (t, n_nodes)).copy()
+                    if mask is None else mask & subset[None, :])
         # Self-anti-affinity domain rows (spread-one-per-domain gangs).
         anti_dom = None
         for fn in self.anti_domain_fns:
@@ -549,10 +558,6 @@ class Session:
                 row_mask = mask[0][None, :]
             else:
                 homogeneous = False
-        if homogeneous and node_subset is not None:
-            subset_row = np.asarray(node_subset, bool)[None, :]
-            row_mask = (subset_row if row_mask is None
-                        else row_mask & subset_row)
         if homogeneous:
             from ..ops.allocate_grouped import allocate_grouped
             node_arrays = self._device_arrays()
@@ -577,9 +582,6 @@ class Session:
                 placements.append((task, snap.node_names[node_idx],
                                    bool(piped[i])))
             return Proposal(True, placements)
-        if node_subset is not None:
-            extra[:, ~np.asarray(node_subset, bool)] = -1e17
-
         mask_pad = None
         if mask is not None:
             mask_pad = np.ones((t_pad, n_nodes), bool)

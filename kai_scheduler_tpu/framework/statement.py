@@ -67,6 +67,11 @@ class Statement:
         resource accounting for the whole batch lands in ONE
         statestore.cpp call, with NodeInfo.used/releasing views updated
         for free (framework/session.py row binding)."""
+        # Callers pass generators; materialize once so the native attempt
+        # and the generic fallback iterate the same complete list (a
+        # partially-consumed generator would silently drop placements and
+        # break gang atomicity).
+        placements = list(placements)
         if self._apply_bulk_native(placements):
             return
         self._defer = set()
@@ -94,7 +99,7 @@ class Statement:
         for task, node_name, pipelined in placements:
             node = nodes[node_name]
             if (task.is_fractional or task.res_req.mig_resources
-                    or task.storage_claims or node.idx < 0
+                    or task.needs_storage_scheduling() or node.idx < 0
                     or node.idx >= table.n_nodes
                     or node.used.base is None):  # view not bound
                 return False

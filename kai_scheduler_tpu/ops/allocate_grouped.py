@@ -158,8 +158,14 @@ def _histogram(capw, digit, bins):
     XLA's reduce fusion reads ``capw``/``digit`` once per lane tile
     instead of writing+reading an [N, bins] f32 one-hot through HBM (the
     previous matmul formulation's dominant per-step cost at 98k nodes).
-    Accumulation stays in ``capw.dtype``; capacities are whole counts, so
-    f32 sums are exact below 2^24."""
+    Accumulation stays in ``capw.dtype``.  In f32 a bin's capacity sum
+    (and the cumsum over bins) can exceed 2^24 at large shapes — e.g.
+    98k nodes with per-node caps clipped to the gang count — so the sums
+    themselves are not guaranteed exact there.  The threshold decision
+    stays correct because ``need <= count`` keeps the compared region
+    (cumulative capacity up to the threshold digit vs the remaining
+    need) within the exactly-representable range: the select only reads
+    the histogram where the running total is still below ``need``."""
     ar = jnp.arange(bins)
     return jnp.sum(jnp.where(digit[:, None] == ar[None, :],
                              capw[:, None], jnp.zeros((), capw.dtype)),
