@@ -136,8 +136,15 @@ class Session:
                     "mesh_devices=%d requested but only %d JAX device(s) "
                     "available; running single-chip",
                     self.config.mesh_devices, len(jax.devices()))
+        # Per-phase cycle timing (the e2e_scheduling_latency breakdown the
+        # reference gets from per-plugin/action histograms,
+        # metrics/metrics.go:65): filled here and by open()/run_once.
+        import time as _time
+        self.phase_timings: dict[str, float] = {}
+        _t = _time.perf_counter()
         self.snapshot: SnapshotTensors = pack(
             cluster, queue_usage=queue_usage, pad_nodes_to=pad)
+        self.phase_timings["snapshot_pack"] = _time.perf_counter() - _t
         # Dense mutable mirrors: backed by the native C++ state store when
         # available (contiguous C-owned tables, zero-copy views), else
         # plain numpy.
@@ -191,10 +198,19 @@ class Session:
 
     # -- lifecycle ---------------------------------------------------------
     def open(self) -> "Session":
+        import time as _time
+
         from ..plugins import build_plugins
+        t0 = _time.perf_counter()
         self.plugins = build_plugins(self.config)
         for plugin in self.plugins:
+            t = _time.perf_counter()
             plugin.on_session_open(self)
+            dt = _time.perf_counter() - t
+            if dt >= 0.005:  # only phases that matter in the breakdown
+                self.phase_timings[f"plugin_{plugin.name}"] = \
+                    self.phase_timings.get(f"plugin_{plugin.name}", 0.0) + dt
+        self.phase_timings["plugins_open"] = _time.perf_counter() - t0
         return self
 
     def close(self) -> None:
@@ -515,7 +531,9 @@ class Session:
             # soft last resort.  Folded in here once so the homogeneous
             # fast path and the per-task path share identical semantics.
             subset = np.asarray(node_subset, bool)
-            mask = (np.broadcast_to(subset, (t, n_nodes)).copy()
+            # Read-only broadcast view: downstream only reads mask
+            # (mask_pad[:t] = mask copies; row_mask takes a row view).
+            mask = (np.broadcast_to(subset, (t, n_nodes))
                     if mask is None else mask & subset[None, :])
         # Self-anti-affinity domain rows (spread-one-per-domain gangs).
         anti_dom = None

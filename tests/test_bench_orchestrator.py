@@ -116,13 +116,15 @@ def test_stream_child_annotate_applied_per_line(tmp_path, monkeypatch,
 def test_happy_path_single_tpu_child(monkeypatch, capsys):
     calls = []
 
-    def fake_stream(env, budget, annotate=None):
+    def fake_stream(env, budget, annotate=None, first_result_s=None):
         calls.append(("tpu" if "JAX_PLATFORMS" not in env else
                       env["JAX_PLATFORMS"], budget))
         print(json.dumps(_result()), flush=True)
         return _result(), ""
 
     monkeypatch.setattr(bench, "_stream_child", fake_stream)
+    monkeypatch.setattr(bench, "_run_parity",
+                        lambda env, budget, result: None)
     assert bench.orchestrate() == 0
     assert len(calls) == 1  # no fallback, no probe ladder
     parsed = _json_lines(capsys)[-1]
@@ -133,21 +135,26 @@ def test_happy_path_single_tpu_child(monkeypatch, capsys):
 def test_tpu_budget_leaves_room_for_fallback(monkeypatch):
     budgets = []
 
-    def fake_stream(env, budget, annotate=None):
-        budgets.append(budget)
+    def fake_stream(env, budget, annotate=None, first_result_s=None):
+        budgets.append((budget, first_result_s))
         return _result(), ""
 
     monkeypatch.setattr(bench, "_stream_child", fake_stream)
+    monkeypatch.setattr(bench, "_run_parity",
+                        lambda env, budget, result: None)
     monkeypatch.setenv("BENCH_DEADLINE_S", "600")
     assert bench.orchestrate() == 0
-    assert budgets[0] <= 600 - bench.MIN_FALLBACK_S
+    assert budgets[0][0] <= 600 - bench.MIN_FALLBACK_S
+    # The first-result deadline must leave room for the fallback child
+    # even when the aggregate deadline is tight.
+    assert budgets[0][1] <= 600 - bench.MIN_FALLBACK_S - 60
 
 
 def test_tpu_failure_falls_back_to_cpu_annotated(monkeypatch, capsys):
     monkeypatch.setattr(bench, "_cpu_env", lambda base: {"IS_CPU": "1"})
     calls = []
 
-    def fake_stream(env, budget, annotate=None):
+    def fake_stream(env, budget, annotate=None, first_result_s=None):
         if env.get("IS_CPU"):
             calls.append("cpu")
             out = _result("cpu")
@@ -170,8 +177,8 @@ def test_tpu_failure_falls_back_to_cpu_annotated(monkeypatch, capsys):
 
 def test_everything_fails_structured_diagnostic(monkeypatch, capsys):
     monkeypatch.setattr(bench, "_stream_child",
-                        lambda env, budget, annotate=None:
-                        (None, "rc=1: broken"))
+                        lambda env, budget, annotate=None,
+                        first_result_s=None: (None, "rc=1: broken"))
     assert bench.orchestrate() == 1
     parsed = _json_lines(capsys)[-1]
     assert parsed["value"] is None
@@ -183,8 +190,70 @@ def test_everything_fails_structured_diagnostic(monkeypatch, capsys):
 def test_bad_deadline_env_does_not_crash(monkeypatch):
     monkeypatch.setenv("BENCH_DEADLINE_S", "not-a-number")
     monkeypatch.setattr(bench, "_stream_child",
-                        lambda env, budget, annotate=None: (_result(), ""))
+                        lambda env, budget, annotate=None,
+                        first_result_s=None: (_result(), ""))
+    monkeypatch.setattr(bench, "_run_parity",
+                        lambda env, budget, result: None)
     assert bench.orchestrate() == 0
+
+
+def test_first_result_deadline_kills_silent_child(tmp_path, monkeypatch):
+    """A child that streams NOTHING is killed at the first-result deadline
+    (well before its full budget) — the round-4 failure mode: a C-level
+    tunnel stall that in-child alarms cannot interrupt."""
+    script = _fake_script(tmp_path, ["time.sleep(60)"])
+    monkeypatch.setattr(bench, "__file__", str(script))
+    t0 = time.monotonic()
+    parsed, diag = bench._stream_child({"PATH": "/usr/bin:/bin"}, 50.0,
+                                       first_result_s=1.5)
+    assert time.monotonic() - t0 < 30
+    assert parsed is None and "first-result" in diag
+
+
+def test_first_result_deadline_spares_streaming_child(tmp_path, monkeypatch,
+                                                      capsys):
+    """Once ANY result line streamed, the first-result deadline must not
+    kill the child — only the full budget applies."""
+    first, second = _result(), _result(phase=2)
+    script = _fake_script(tmp_path, [
+        f"print(json.dumps({first!r}), flush=True)",
+        "time.sleep(3)",
+        f"print(json.dumps({second!r}), flush=True)",
+    ])
+    monkeypatch.setattr(bench, "__file__", str(script))
+    parsed, diag = bench._stream_child({"PATH": "/usr/bin:/bin"}, 30.0,
+                                       first_result_s=1.5)
+    assert parsed == second and diag == ""
+
+
+def test_parity_merges_verdict_into_result(tmp_path, monkeypatch, capsys):
+    """_run_parity folds the CPU child's verdict into the result and
+    re-emits the enriched line."""
+    parity_file = tmp_path / "parity.npz"
+    parity_file.write_bytes(b"x")  # exists -> parity runs
+    monkeypatch.setattr(bench, "PARITY_FILE", str(parity_file))
+
+    class FakeProc:
+        returncode = 0
+        stdout = json.dumps({"parity": {"ok": True, "tasks": 4,
+                                        "placement_mismatches": 0}}) + "\n"
+        stderr = ""
+
+    monkeypatch.setattr(bench.subprocess, "run",
+                        lambda *a, **kw: FakeProc())
+    result = _result()
+    bench._run_parity({}, 30.0, result)
+    assert result["detail"]["parity"]["ok"] is True
+    assert _json_lines(capsys)[-1]["detail"]["parity"]["tasks"] == 4
+
+
+def test_parity_skipped_without_artifact(tmp_path, monkeypatch, capsys):
+    monkeypatch.setattr(bench, "PARITY_FILE",
+                        str(tmp_path / "missing.npz"))
+    result = _result()
+    bench._run_parity({}, 30.0, result)
+    assert "parity" not in result["detail"]
+    assert _json_lines(capsys) == []
 
 
 def test_cpu_env_strips_relay_shim(monkeypatch):
