@@ -122,6 +122,7 @@ def _execute_bulk(ssn, jobs):
             or any(t.affinity_terms or t.anti_affinity_terms
                    or t.preferred_affinity_terms
                    or t.preferred_anti_affinity_terms
+                   or t.node_affinity_required or t.node_affinity_preferred
                    or t.host_ports or t.pvc_names
                    or any(term.matches(t.labels, t.namespace)
                           for term in repeller_terms) for t in tasks))

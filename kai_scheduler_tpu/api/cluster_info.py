@@ -49,7 +49,8 @@ class ClusterInfo:
                  resource_slices: dict | None = None,
                  storage_classes: dict | None = None,
                  storage_claims: dict | None = None,
-                 storage_capacities: dict | None = None):
+                 storage_capacities: dict | None = None,
+                 device_classes: dict | None = None):
         self.nodes: dict[str, NodeInfo] = nodes or {}
         self.podgroups: dict[str, PodGroupInfo] = podgroups or {}
         self.queues: dict[str, QueueInfo] = queues or {}
@@ -59,8 +60,14 @@ class ClusterInfo:
         # "allocated"/"node" still honored by the plugin).
         self.resource_claims: dict = resource_claims or {}
         # DRA device inventory (ResourceSlice objects):
-        # node -> device_class -> [device names].
+        # node -> pool/class key -> [device name | {"name", "attributes",
+        # "capacity"}].  Plain strings are attribute-less devices.
         self.resource_slices: dict = resource_slices or {}
+        # DRA DeviceClasses: name -> {"selectors": [...]} — structured
+        # attribute/capacity requirements (upstream selects via CEL,
+        # consumed by dynamicresources.go:59-87; here the structured
+        # subset: attribute equality + capacity minimums).
+        self.device_classes: dict = device_classes or {}
         # ConfigMap predicate inventory: {(namespace, name)}.
         self.config_maps: set = set(config_maps or ())
         # PVC inventory for the schedule-time VolumeBinding filter:
@@ -202,4 +209,5 @@ class ClusterInfo:
             {k: dict(v) for k, v in self.pvcs.items()},
             {n: {c: list(d) for c, d in by_class.items()}
              for n, by_class in self.resource_slices.items()},
-            dict(self.storage_classes), cloned_claims, cloned_caps)
+            dict(self.storage_classes), cloned_claims, cloned_caps,
+            device_classes=dict(self.device_classes))

@@ -77,6 +77,54 @@ class AffinityTerm:
                             list(self.namespaces))
 
 
+def _node_expr_matches(expr: dict, labels: dict) -> bool:
+    """One nodeSelectorRequirement against a node's labels — the upstream
+    v1helper.MatchNodeSelectorTerms operator set (NodeAffinity plugin,
+    consumed via k8s_internal/predicates/predicates.go:70-167)."""
+    key = expr.get("key")
+    op = expr.get("operator")
+    values = expr.get("values") or []
+    if op == "In":
+        return labels.get(key) in values
+    if op == "NotIn":
+        return key not in labels or labels[key] not in values
+    if op == "Exists":
+        return key in labels
+    if op == "DoesNotExist":
+        return key not in labels
+    if op in ("Gt", "Lt"):
+        if key not in labels or len(values) != 1:
+            return False
+        try:
+            node_val = int(labels[key])
+            want = int(values[0])
+        except (TypeError, ValueError):
+            return False
+        return node_val > want if op == "Gt" else node_val < want
+    return False  # unknown operator: match nothing (loud, never too-wide)
+
+
+def node_affinity_matches(terms: list, labels: dict,
+                          node_name: str = "") -> bool:
+    """requiredDuringSchedulingIgnoredDuringExecution semantics: OR
+    across nodeSelectorTerms, AND across a term's matchExpressions and
+    matchFields (only metadata.name is a valid field, as upstream)."""
+    if not terms:
+        return True
+    for term in terms:
+        exprs = term.get("expressions") or []
+        fields = term.get("fields") or []
+        if not exprs and not fields:
+            # An empty term matches no objects (upstream
+            # nodeaffinity.NewNodeSelector).
+            continue
+        if all(_node_expr_matches(e, labels) for e in exprs) and all(
+                _node_expr_matches(f, {"metadata.name": node_name})
+                for f in fields):
+            return True
+    return False
+
+
 @dataclass
 class PodInfo:
     uid: str
@@ -111,6 +159,15 @@ class PodInfo:
     anti_affinity_terms: list = field(default_factory=list)   # required
     preferred_affinity_terms: list = field(default_factory=list)
     preferred_anti_affinity_terms: list = field(default_factory=list)
+    # Node affinity (spec.affinity.nodeAffinity — the upstream
+    # NodeAffinity plugin the reference embeds,
+    # k8s_internal/predicates/predicates.go:70-167):
+    # required: list of nodeSelectorTerms (OR across terms; a term is
+    # {"expressions": [...], "fields": [...]}, AND within), operators
+    # In/NotIn/Exists/DoesNotExist/Gt/Lt;
+    # preferred: list of {"weight", "expressions", "fields"} scored terms.
+    node_affinity_required: list = field(default_factory=list)
+    node_affinity_preferred: list = field(default_factory=list)
     # Schedule-time CSI storage (api/storage_info.py): all claims this
     # pod references, and the subset it exclusively owns (deleted with
     # the pod).  Mirrors pod_info.go storageClaims/ownedStorageClaims.
@@ -233,6 +290,9 @@ class PodInfo:
                 t.clone() for t in self.preferred_affinity_terms],
             preferred_anti_affinity_terms=[
                 t.clone() for t in self.preferred_anti_affinity_terms],
+            # Term dicts are immutable at runtime: share, don't deep-copy.
+            node_affinity_required=list(self.node_affinity_required),
+            node_affinity_preferred=list(self.node_affinity_preferred),
             storage_claims=dict(self.storage_claims),
             owned_storage_claims=dict(self.owned_storage_claims),
             tensor_idx=self.tensor_idx,

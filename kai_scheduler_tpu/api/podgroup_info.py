@@ -294,6 +294,7 @@ class PodGroupInfo:
                  tuple(sorted(t.pvc_names)),
                  tuple(sorted(t.resource_claims)),
                  repr(t.affinity_terms), repr(t.anti_affinity_terms),
+                 repr(t.node_affinity_required),
                  tuple(sorted(t.labels.items())))
                 for t in ps.pods.values() if t.status == PodStatus.PENDING)
             h.update(repr(reqs).encode())

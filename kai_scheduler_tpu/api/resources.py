@@ -72,6 +72,20 @@ def parse_memory(value: "str | int | float") -> float:
     return num * _MEM_SUFFIX[suffix]
 
 
+def parse_quantity(value) -> "float | None":
+    """Lenient quantity -> float: plain numbers pass through, memory
+    suffixes are honored ("40Gi" -> bytes), unparseable -> None.  The
+    shared helper for DRA device capacities and selector minimums
+    (cache_builder parse time + dynamicresources match time must agree)."""
+    try:
+        return float(value)
+    except (TypeError, ValueError):
+        try:
+            return float(parse_memory(str(value)))
+        except (TypeError, ValueError):
+            return None
+
+
 def vec(cpu_milli: float = 0.0, memory: float = 0.0, gpu: float = 0.0) -> np.ndarray:
     """Build a resource vector from raw units (milli-CPU, bytes, GPUs)."""
     v = np.zeros(NUM_RES, dtype=np.float64)

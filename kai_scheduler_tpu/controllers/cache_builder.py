@@ -51,6 +51,58 @@ def _requests_to_reqreq(pod: dict) -> ResourceRequirements:
         gpu=gpu, gpu_fraction=fraction, gpu_memory=gpu_memory, mig=mig)
 
 
+def _parse_device_selectors(raw) -> list:
+    """DeviceClass/request selectors -> structured entries.
+
+    The structured dialect ({"attribute": k, "value": v} equality,
+    {"capacity": k, "min": quantity} minimums) is matched exactly; CEL
+    expressions (upstream DeviceClass spec.selectors[].cel,
+    dynamicresources.go:59-87) are kept opaque and match NOTHING — loud,
+    never too-wide."""
+    out = []
+    for sel in raw or []:
+        if "attribute" in sel:
+            out.append({"attribute": sel["attribute"],
+                        "value": sel.get("value")})
+        elif "capacity" in sel:
+            out.append({"capacity": sel["capacity"],
+                        "min": rs.parse_quantity(sel.get("min"))})
+        else:  # CEL or unknown shape
+            out.append({"unsupported": True})
+    return out
+
+
+def _parse_device_attributes(dev: dict) -> dict:
+    """Flatten upstream device attributes ({k: {"string"|"int"|"bool"|
+    "version": v}}) or our flat dialect ({k: v}) to {k: python value}."""
+    raw = (dev.get("basic") or {}).get("attributes") \
+        or dev.get("attributes") or {}
+    out = {}
+    for k, v in raw.items():
+        if isinstance(v, dict):
+            for typed in ("string", "int", "bool", "version"):
+                if typed in v:
+                    out[k] = v[typed]
+                    break
+        else:
+            out[k] = v
+    return out
+
+
+def _parse_device_capacity(dev: dict) -> dict:
+    """Flatten device capacity ({k: {"value": q}} or {k: q}) to
+    {k: float}."""
+    raw = (dev.get("basic") or {}).get("capacity") \
+        or dev.get("capacity") or {}
+    out = {}
+    for k, v in raw.items():
+        q = rs.parse_quantity(v.get("value") if isinstance(v, dict)
+                              else v)
+        if q is not None:
+            out[k] = q
+    return out
+
+
 def _parse_pod_affinity(task: PodInfo, affinity: dict) -> None:
     """Parse pod (anti-)affinity terms from the manifest's
     spec.affinity.podAffinity/podAntiAffinity into AffinityTerms
@@ -89,6 +141,24 @@ def _parse_pod_affinity(task: PodInfo, affinity: dict) -> None:
         terms(aff, required, preferred)
     task.anti_affinity_terms, task.preferred_anti_affinity_terms = \
         terms(anti, required, preferred)
+
+    # Node affinity (the upstream NodeAffinity plugin's inputs,
+    # k8s_internal/predicates/predicates.go:70-167): required terms are a
+    # hard per-node filter (In/NotIn/Exists/DoesNotExist/Gt/Lt, OR across
+    # nodeSelectorTerms); preferred terms contribute weighted scores.
+    node_aff = affinity.get("nodeAffinity") or {}
+    node_req = (node_aff.get(required) or {}).get("nodeSelectorTerms") or []
+    task.node_affinity_required = [
+        {"expressions": [dict(e) for e in t.get("matchExpressions") or []],
+         "fields": [dict(f) for f in t.get("matchFields") or []]}
+        for t in node_req]
+    task.node_affinity_preferred = [
+        {"weight": float(entry.get("weight", 1)),
+         "expressions": [dict(e) for e in (entry.get("preference") or {})
+                         .get("matchExpressions") or []],
+         "fields": [dict(f) for f in (entry.get("preference") or {})
+                    .get("matchFields") or []]}
+        for entry in node_aff.get(preferred) or []]
 
 
 def _parse_pod_predicates(task: PodInfo, pod: dict) -> None:
@@ -315,7 +385,9 @@ class ClusterCache:
                 # Every device request (multi-class claims supported).
                 "requests": [
                     {"device_class": r.get("deviceClassName", ""),
-                     "count": int(r.get("count", 1))}
+                     "count": int(r.get("count", 1)),
+                     "selectors": _parse_device_selectors(
+                         r.get("selectors"))}
                     for r in device_reqs],
                 # Legacy single-request view kept for older callers.
                 "device_class": device_reqs[0].get("deviceClassName", ""),
@@ -333,7 +405,17 @@ class ClusterCache:
             per_node = resource_slices.setdefault(node, {})
             for dev in spec.get("devices") or []:
                 cls = dev.get("deviceClassName", "")
-                per_node.setdefault(cls, []).append(dev.get("name", ""))
+                attrs = _parse_device_attributes(dev)
+                caps = _parse_device_capacity(dev)
+                entry = ({"name": dev.get("name", ""),
+                          "attributes": attrs, "capacity": caps}
+                         if attrs or caps else dev.get("name", ""))
+                per_node.setdefault(cls, []).append(entry)
+        device_classes = {
+            dc["metadata"]["name"]: {
+                "selectors": _parse_device_selectors(
+                    dc.get("spec", {}).get("selectors"))}
+            for dc in self.api.list("DeviceClass")}
 
         config_maps = {
             (cm["metadata"].get("namespace", "default"),
@@ -361,7 +443,8 @@ class ClusterCache:
                            resource_slices=resource_slices,
                            storage_classes=storage_classes,
                            storage_claims=storage_claims,
-                           storage_capacities=storage_capacities)
+                           storage_capacities=storage_capacities,
+                           device_classes=device_classes)
 
     # -- side-effect executor (framework Session cache interface) ------------
     def bind(self, task, node_name: str, bind_request) -> None:

@@ -42,6 +42,7 @@ KIND_ROUTES = {
     "PersistentVolumeClaim": ("api/v1", "persistentvolumeclaims", True),
     "ResourceClaim": ("apis/resource.k8s.io/v1", "resourceclaims", True),
     "ResourceSlice": ("apis/resource.k8s.io/v1", "resourceslices", False),
+    "DeviceClass": ("apis/resource.k8s.io/v1", "deviceclasses", False),
     "CSIDriver": ("apis/storage.k8s.io/v1", "csidrivers", False),
     "StorageClass": ("apis/storage.k8s.io/v1", "storageclasses", False),
     "CSIStorageCapacity": ("apis/storage.k8s.io/v1",

@@ -629,17 +629,37 @@ class Session:
             b[:t] = boot
             aff_pad = (jnp.asarray(d), jnp.asarray(m), jnp.asarray(a),
                        jnp.asarray(st), jnp.asarray(b))
-        result = allocate_jobs_kernel(
-            *self._device_arrays(),
-            jnp.asarray(task_req), jnp.asarray(task_job),
-            jnp.asarray(task_sel), jnp.asarray(task_tol),
-            jnp.asarray(job_allowed), jnp.asarray(extra),
-            task_node_mask=(None if mask_pad is None
-                            else jnp.asarray(mask_pad)),
-            task_anti_domain=dom_pad,
-            task_aff_domain=aff_pad,
-            gpu_strategy=self.gpu_strategy, cpu_strategy=self.cpu_strategy,
-            allow_pipeline=allow_pipeline, pipeline_only=pipeline_only)
+        if (self.mesh is not None and dom_pad is None and aff_pad is None
+                and not pipeline_only and not np.any(extra)):
+            # Multi-chip exact kernel (parallel/sharded.py): node axis
+            # sharded over the mesh, bit-identical tie-breaks.  Domain
+            # rows, extra score terms, and pipeline-only proposals stay
+            # on the single-chip kernel (unsupported under shard_map).
+            from ..parallel.sharded import sharded_allocate_jobs
+            result = sharded_allocate_jobs(
+                self.mesh, *self._device_arrays(),
+                jnp.asarray(task_req), jnp.asarray(task_job),
+                jnp.asarray(task_sel), jnp.asarray(task_tol),
+                jnp.asarray(job_allowed),
+                task_node_mask=(None if mask_pad is None
+                                else jnp.asarray(mask_pad)),
+                gpu_strategy=self.gpu_strategy,
+                cpu_strategy=self.cpu_strategy,
+                allow_pipeline=allow_pipeline)
+        else:
+            result = allocate_jobs_kernel(
+                *self._device_arrays(),
+                jnp.asarray(task_req), jnp.asarray(task_job),
+                jnp.asarray(task_sel), jnp.asarray(task_tol),
+                jnp.asarray(job_allowed), jnp.asarray(extra),
+                task_node_mask=(None if mask_pad is None
+                                else jnp.asarray(mask_pad)),
+                task_anti_domain=dom_pad,
+                task_aff_domain=aff_pad,
+                gpu_strategy=self.gpu_strategy,
+                cpu_strategy=self.cpu_strategy,
+                allow_pipeline=allow_pipeline,
+                pipeline_only=pipeline_only)
 
         if not bool(result.job_success[0]):
             return Proposal(False, [])

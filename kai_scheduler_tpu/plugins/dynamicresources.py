@@ -23,12 +23,46 @@ from __future__ import annotations
 from .base import Plugin, register_plugin
 
 
+def _device_name(dev) -> str:
+    return dev["name"] if isinstance(dev, dict) else dev
+
+
+def _qty(value) -> float | None:
+    """Quantity -> float via the shared helper (cache_builder parse time
+    and match time must agree on suffix handling)."""
+    from ..api import resources as rs
+    return rs.parse_quantity(value)
+
+
+def _device_matches(dev, selectors: list) -> bool:
+    """Structured selector match: attribute equality + capacity minimums
+    (the non-CEL subset of upstream DeviceClass/request selectors).
+    Unsupported (CEL/unknown) entries match nothing."""
+    if not selectors:
+        return True
+    attrs = dev.get("attributes", {}) if isinstance(dev, dict) else {}
+    caps = dev.get("capacity", {}) if isinstance(dev, dict) else {}
+    for sel in selectors:
+        if "attribute" in sel:
+            if attrs.get(sel["attribute"]) != sel.get("value"):
+                return False
+        elif "capacity" in sel:
+            have = _qty(caps.get(sel["capacity"]))
+            want = _qty(sel.get("min"))
+            if have is None or want is None or have < want:
+                return False
+        else:
+            return False
+    return True
+
+
 @register_plugin("dynamicresources")
 class DynamicResourcesPlugin(Plugin):
     def on_session_open(self, ssn) -> None:
         self.ssn = ssn
         self.claims = getattr(ssn.cluster, "resource_claims", {})
         self.slices = getattr(ssn.cluster, "resource_slices", {})
+        self.device_classes = getattr(ssn.cluster, "device_classes", {})
         if not self.claims:
             return
         # In-session assumed allocations: claim -> {"node", "devices"}
@@ -58,29 +92,73 @@ class DynamicResourcesPlugin(Plugin):
 
     @staticmethod
     def _requests(claim: dict) -> list:
-        """[(device_class, count)] — multi-request claims supported;
-        the legacy single device_class/count shape maps to one entry."""
+        """[(device_class, count, selectors)] — multi-request claims
+        supported; the legacy single device_class/count shape maps to one
+        entry."""
         reqs = claim.get("requests")
         if reqs:
             return [(r.get("device_class", r.get("deviceClassName", "")),
-                     int(r.get("count", 1))) for r in reqs]
+                     int(r.get("count", 1)),
+                     r.get("selectors") or []) for r in reqs]
         return [(claim.get("device_class", ""),
-                 int(claim.get("count", 1)))]
+                 int(claim.get("count", 1)),
+                 claim.get("selectors") or [])]
 
     def task_claims(self, task) -> list:
         return getattr(task, "resource_claims", []) or []
 
-    def _free_devices(self, node_name: str, device_class: str) -> list:
-        inventory = self.slices.get(node_name, {}).get(device_class, [])
+    def _free_devices(self, node_name: str, device_class: str,
+                      selectors: list = ()) -> list:
+        """Names of free node devices satisfying the class's structured
+        selectors plus the request's own.  A class with selectors draws
+        from every pool on the node (upstream classes select devices,
+        they don't name pools); a selector-less class keeps the legacy
+        pool-keyed-by-class inventory."""
+        per_node = self.slices.get(node_name, {})
+        cls_sel = (self.device_classes.get(device_class) or {}) \
+            .get("selectors") or []
+        if cls_sel:
+            inventory = [d for pool in per_node.values() for d in pool]
+        else:
+            inventory = per_node.get(device_class, [])
+        sels = list(cls_sel) + list(selectors)
         taken = self.devices_taken.get(node_name, set())
-        return [d for d in inventory if d not in taken]
+        return [_device_name(d) for d in inventory
+                if _device_name(d) not in taken
+                and _device_matches(d, sels)]
+
+    def _pick_devices(self, node_name: str, claim: dict,
+                      extra_taken: set = frozenset()) -> list | None:
+        """Concrete-device choice for one unallocated claim on a node,
+        never reusing a device across the claim's requests (nor any in
+        ``extra_taken``).  Requests assign scarcest-first — the request
+        with the fewest matching free devices picks before looser ones —
+        so a selector-less request cannot starve a selective one of its
+        only match (upstream's structured allocator backtracks; the
+        scarcest-first order is exact for nested/disjoint selector sets,
+        the shapes DeviceClasses produce).  None = doesn't fit."""
+        candidates = []
+        for cls, count, selectors in self._requests(claim):
+            free = [d for d in self._free_devices(node_name, cls,
+                                                  selectors)
+                    if d not in extra_taken]
+            if len(free) < count:
+                return None
+            candidates.append((len(free), count, free))
+        chosen: list = []
+        for _, count, free in sorted(candidates, key=lambda c: c[0]):
+            usable = [d for d in free if d not in chosen]
+            if len(usable) < count:
+                return None
+            chosen += usable[:count]
+        return chosen
 
     def claims_schedulable(self, task, node_name: str) -> bool:
         """PreFilter: every referenced claim must be satisfiable on the
         node — already there, assumed there, or coverable by free slice
-        devices.  Demand accumulates PER device class across the task's
-        unallocated claims."""
-        needed: dict[str, int] = {}
+        devices.  Uses the SAME picker as allocation, so the check and
+        the later assumption can never diverge."""
+        local_taken: set = set()
         for name in self.task_claims(task):
             claim = self.claims.get(name)
             if claim is None:
@@ -93,11 +171,11 @@ class DynamicResourcesPlugin(Plugin):
             # No slice inventory published (legacy/simplified clusters):
             # any node can host an unallocated claim.
             if self.slices:
-                for cls, count in self._requests(claim):
-                    needed[cls] = needed.get(cls, 0) + count
-                    if needed[cls] > len(self._free_devices(node_name,
-                                                            cls)):
-                        return False
+                devices = self._pick_devices(node_name, claim,
+                                             extra_taken=local_taken)
+                if devices is None:
+                    return False
+                local_taken.update(devices)
         return True
 
     def on_allocate(self, task) -> None:
@@ -113,9 +191,19 @@ class DynamicResourcesPlugin(Plugin):
                 continue
             if self._allocation(claim) is not None:
                 continue
-            devices: list = []
-            for cls, count in self._requests(claim):
-                devices += self._free_devices(task.node_name, cls)[:count]
+            devices = self._pick_devices(task.node_name, claim)
+            if devices is None:
+                if self.slices:
+                    # The prefilter and this picker share one code path,
+                    # so this is unreachable unless a caller placed a DRA
+                    # task without consulting claims_schedulable —
+                    # publishing an empty allocation would start the
+                    # workload without its devices, so fail loudly.
+                    raise RuntimeError(
+                        f"claim {name!r} does not fit node "
+                        f"{task.node_name!r} at allocation time; "
+                        f"claims_schedulable was not consulted")
+                devices = []  # no inventory published: node-only assume
             self.assumed[name] = {"node": task.node_name,
                                   "devices": devices,
                                   "users": {task.uid}}

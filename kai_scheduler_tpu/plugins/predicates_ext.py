@@ -35,8 +35,12 @@ class UpstreamPredicatesPlugin(Plugin):
                 self.max_mig[profile] = max(
                     self.max_mig.get(profile, 0.0), count)
         self._ports_cache = (-1, None)  # (mutation_count, ports)
+        # Node-affinity mask/score caches: node labels are immutable for
+        # the session, so each distinct term spec evaluates once.
+        self._node_aff_cache: dict = {}
         ssn.pre_predicate_fns.append(self.pre_predicate)
         ssn.hard_node_mask_fns.append(self.node_masks)
+        ssn.extra_score_fns.append(self.preferred_node_affinity_scores)
 
     # -- PreFilters (cluster-level, once per task) -------------------------
     def pre_predicate(self, task) -> SchedulableResult:
@@ -97,15 +101,59 @@ class UpstreamPredicatesPlugin(Plugin):
                 f"task has deleted storage claims: {deleted}")
         return SchedulableResult()
 
+    # -- node affinity (upstream NodeAffinity, predicates.go:70-167) -------
+    def _node_affinity_mask(self, terms: list) -> np.ndarray:
+        """[N] bool: nodes whose labels satisfy the required
+        nodeSelectorTerms.  Node labels are session-immutable, so each
+        distinct spec evaluates once; padding rows stay False."""
+        key = repr(terms)
+        cached = self._node_aff_cache.get(key)
+        if cached is not None:
+            return cached
+        from ..api.pod_info import node_affinity_matches
+        names = self.ssn.snapshot.node_names
+        nodes = self.ssn.cluster.nodes
+        mask = np.zeros(self.ssn.node_idle.shape[0], bool)
+        for i, name in enumerate(names):
+            node = nodes.get(name)
+            if node is not None and node_affinity_matches(
+                    terms, node.labels or {}, name):
+                mask[i] = True
+        self._node_aff_cache[key] = mask
+        return mask
+
+    def preferred_node_affinity_scores(self, tasks):
+        """Weighted preferred-term boosts (the NodeAffinity score plugin).
+        Scale 10 per weight unit: the smallest step the grouped kernel's
+        uniform-extras contract allows (extras must be multiples of 10,
+        framework/session.py homogeneous gate)."""
+        out = None
+        for i, task in enumerate(tasks):
+            prefs = getattr(task, "node_affinity_preferred", None) or []
+            if not prefs:
+                continue
+            if out is None:
+                out = np.zeros((len(tasks), self.ssn.node_idle.shape[0]))
+            for term in prefs:
+                spec = [{"expressions": term.get("expressions") or [],
+                         "fields": term.get("fields") or []}]
+                out[i] += (float(term.get("weight", 1)) * 10.0
+                           * self._node_affinity_mask(spec))
+        return out
+
     # -- node-level filters as hard masks ----------------------------------
     def node_masks(self, tasks):
-        needs = any(t.host_ports or t.pvc_names for t in tasks)
+        needs = any(t.host_ports or t.pvc_names
+                    or t.node_affinity_required for t in tasks)
         if not needs:
             return None
         n = self.ssn.node_idle.shape[0]
         out = np.ones((len(tasks), n), bool)
         port_masks = None
         for i, task in enumerate(tasks):
+            if task.node_affinity_required:
+                out[i] &= self._node_affinity_mask(
+                    task.node_affinity_required)
             if task.host_ports:
                 if port_masks is None:
                     port_masks = self._ports_by_node()

@@ -64,6 +64,22 @@ def run_scenario(scenario: str, n_nodes: int, seed: int = 0) -> dict:
     elif scenario == "burst":
         add_job_wave(spec, gpu_capacity * 2, gpus=1, prefix="burst",
                      seed=seed)
+    elif scenario in ("topology-required", "topology-preferred"):
+        # The reference's TAS scale scenarios (kwok_test.go:128-520):
+        # rack-sized gangs with a required or preferred rack-level
+        # constraint over the dc topology (levels zone > rack).  Demand is
+        # ~half the cluster so every gang CAN land in some rack; required
+        # must pin each gang to one rack, preferred must still bind all.
+        gang = 16
+        count = max(1, gpu_capacity // (2 * gang))
+        add_job_wave(spec, count, gpus=1, gang=gang, prefix="topo",
+                     seed=seed)
+        level_key = ("required_topology_level"
+                     if scenario == "topology-required"
+                     else "preferred_topology_level")
+        for j in spec["jobs"].values():
+            j["topology"] = "dc"
+            j[level_key] = "rack"
     elif scenario == "reclaim":
         # Fill from one queue, then measure a starved queue reclaiming.
         add_job_wave(spec, gpu_capacity, gpus=1, prefix="hog", seed=seed)
@@ -93,6 +109,23 @@ def run_scenario(scenario: str, n_nodes: int, seed: int = 0) -> dict:
               "jobs": len(spec["jobs"]),
               "first_cycle_s": round(first_cycle, 3),
               "pods_bound": len(ssn.cache.bound)}
+
+    if scenario.startswith("topology-"):
+        # Constraint audit: how many gangs landed entirely inside one
+        # rack (for required this must be ALL placed gangs).
+        node_rack = {name: n["labels"]["rack"]
+                     for name, n in spec["nodes"].items()}
+        single_rack = placed = 0
+        for pg in cluster.podgroups.values():
+            nodes_used = {t.node_name for t in pg.pods.values()
+                          if t.node_name}
+            if not nodes_used:
+                continue
+            placed += 1
+            if len({node_rack[n] for n in nodes_used}) == 1:
+                single_rack += 1
+        result["gangs_placed"] = placed
+        result["gangs_single_rack"] = single_rack
 
     if scenario == "reclaim":
         # The fill wave (all in q0) is now allocated; inject a starved
@@ -228,6 +261,7 @@ def main(argv=None):
     ap.add_argument("--scenario", default="fill",
                     choices=("fill", "whole-gpu", "distributed", "burst",
                              "reclaim", "reclaim-contention",
+                             "topology-required", "topology-preferred",
                              "system-fill"))
     ap.add_argument("--pods", type=int, default=0,
                     help="pod count for system-fill (default 2x nodes)")

@@ -104,6 +104,15 @@ def build_cluster(spec: dict) -> ClusterInfo:
                                for pp in t.get("host_ports", ())}
             task.required_configmaps = list(t.get("configmaps", ()))
             task.pvc_names = list(t.get("pvcs", ()))
+            task.node_affinity_required = [
+                {"expressions": list(term.get("expressions", ())),
+                 "fields": list(term.get("fields", ()))}
+                for term in t.get("node_affinity", ())]
+            task.node_affinity_preferred = [
+                {"weight": float(term.get("weight", 1)),
+                 "expressions": list(term.get("expressions", ())),
+                 "fields": list(term.get("fields", ()))}
+                for term in t.get("node_affinity_preferred", ())]
             task.affinity_terms = _terms(t.get("affinity_terms"))
             task.anti_affinity_terms = _terms(t.get("anti_affinity_terms"))
             task.preferred_affinity_terms = _terms(
@@ -144,6 +153,7 @@ def build_cluster(spec: dict) -> ClusterInfo:
                      for ns_name in spec.get("config_maps", ())},
         pvcs=pvcs,
         resource_slices=spec.get("resource_slices", {}),
+        device_classes=spec.get("device_classes", {}),
         storage_classes=storage_classes,
         storage_claims=storage_claims,
         storage_capacities=storage_capacities)

@@ -546,6 +546,11 @@ class TestAdmissionRuntimeAndMetrics:
         text = METRICS.to_prometheus_text()
         assert 'queue_fair_share_gpu{queue="q"}' in text
         assert "e2e_scheduling_latency_milliseconds" in text
+        # Per-phase cycle breakdown (the host-pipeline profiling surface):
+        # snapshot pack, plugin opens, each action.
+        assert "cycle_phase_latency_snapshot_pack" in text
+        assert "cycle_phase_latency_plugins_open" in text
+        assert "cycle_phase_latency_action_allocate" in text
 
 
 class TestMixedWorkloadScenario:

@@ -1,0 +1,176 @@
+"""DRA structured-parameter depth: device attributes and selectors on
+DeviceClass/claims (the non-CEL subset of upstream structured allocation,
+/root/reference/pkg/scheduler/plugins/dynamicresources/dynamicresources.go:59-87).
+"""
+
+from tests.fixtures import build_session, placements, run_action
+
+
+def dev(name, **attrs):
+    cap = attrs.pop("capacity", None)
+    d = {"name": name, "attributes": attrs, "capacity": cap or {}}
+    return d
+
+
+class TestSelectors:
+    def _session(self, claims, classes, slices, tasks=None):
+        return build_session({
+            "nodes": {"n1": {"gpu": 8}, "n2": {"gpu": 8}},
+            "queues": {"q": {}},
+            "resource_claims": claims,
+            "device_classes": classes,
+            "resource_slices": slices,
+            "jobs": {"j": {"queue": "q", "tasks": tasks or [
+                {"cpu": "1", "resource_claims": list(claims)}]}},
+        })
+
+    def test_two_classes_disambiguate_by_attribute(self):
+        """One shared pool on one node; class a40/a80 select by memory
+        attribute — each claim gets the matching device, not just any."""
+        ssn = self._session(
+            claims={"want-80": {"device_class": "a80", "count": 1}},
+            classes={
+                "a40": {"selectors": [{"attribute": "mem", "value": "40"}]},
+                "a80": {"selectors": [{"attribute": "mem", "value": "80"}]},
+            },
+            slices={"n1": {"gpu-pool": [dev("d40", mem="40"),
+                                        dev("d80", mem="80")]}})
+        run_action(ssn)
+        p = placements(ssn)
+        assert p["j-0"][0] == "n1"
+        plugin = next(pl for pl in ssn.plugins
+                      if pl.name == "dynamicresources")
+        assert plugin.assumed["want-80"]["devices"] == ["d80"]
+
+    def test_attribute_mismatch_blocks(self):
+        ssn = self._session(
+            claims={"c": {"device_class": "a80", "count": 1}},
+            classes={
+                "a80": {"selectors": [{"attribute": "mem", "value": "80"}]}},
+            slices={"n1": {"pool": [dev("d40", mem="40")]}})
+        run_action(ssn)
+        assert placements(ssn) == {}
+
+    def test_capacity_minimum(self):
+        ssn = self._session(
+            claims={"big": {"device_class": "big-mem", "count": 1}},
+            classes={"big-mem": {"selectors": [
+                {"capacity": "memory", "min": "64Gi"}]}},
+            slices={"n1": {"pool": [
+                dev("small", capacity={"memory": "40Gi"}),
+                dev("large", capacity={"memory": "80Gi"})]}})
+        run_action(ssn)
+        assert placements(ssn)["j-0"][0] == "n1"
+        plugin = next(pl for pl in ssn.plugins
+                      if pl.name == "dynamicresources")
+        assert plugin.assumed["big"]["devices"] == ["large"]
+
+    def test_request_selectors_on_legacy_pool(self):
+        """Request-level selectors filter the legacy class-keyed pool."""
+        ssn = self._session(
+            claims={"c": {"requests": [
+                {"device_class": "gpu", "count": 1,
+                 "selectors": [{"attribute": "nvlink", "value": True}]}]}},
+            classes={},
+            slices={"n1": {"gpu": [dev("plain"),
+                                   dev("linked", nvlink=True)]}})
+        run_action(ssn)
+        plugin = next(pl for pl in ssn.plugins
+                      if pl.name == "dynamicresources")
+        assert plugin.assumed["c"]["devices"] == ["linked"]
+
+    def test_cel_selector_matches_nothing(self):
+        """Opaque (CEL/unknown) selectors must block, never over-match."""
+        ssn = self._session(
+            claims={"c": {"device_class": "celled", "count": 1}},
+            classes={"celled": {"selectors": [{"unsupported": True}]}},
+            slices={"n1": {"pool": [dev("d1", mem="80")]}})
+        run_action(ssn)
+        assert placements(ssn) == {}
+
+    def test_cross_request_no_double_booking(self):
+        """A claim whose two requests select overlapping devices cannot
+        count one device twice."""
+        ssn = self._session(
+            claims={"c": {"requests": [
+                {"device_class": "fast", "count": 1},
+                {"device_class": "any", "count": 1}]}},
+            classes={
+                "fast": {"selectors": [{"attribute": "tier",
+                                        "value": "fast"}]},
+                "any": {"selectors": [{"attribute": "tier",
+                                       "value": "fast"}]},
+            },
+            # Only ONE matching device: the two requests need two.
+            slices={"n1": {"pool": [dev("only", tier="fast")]}})
+        run_action(ssn)
+        assert placements(ssn) == {}
+
+    def test_loose_request_cannot_starve_selective_one(self):
+        """A selector-less request must not greedily grab the only device
+        a selective sibling request can match: scarcest-first assignment
+        gives the selective request devA and the loose one devB."""
+        ssn = self._session(
+            claims={"c": {"requests": [
+                {"device_class": "gpu", "count": 1},
+                {"device_class": "fast", "count": 1}]}},
+            classes={"fast": {"selectors": [
+                {"attribute": "tier", "value": "fast"}]}},
+            slices={"n1": {"gpu": [dev("devA", tier="fast"),
+                                   dev("devB")]}})
+        run_action(ssn)
+        plugin = next(pl for pl in ssn.plugins
+                      if pl.name == "dynamicresources")
+        assert sorted(plugin.assumed["c"]["devices"]) == ["devA", "devB"]
+
+    def test_selector_allocation_rides_bind_request(self):
+        ssn = self._session(
+            claims={"c": {"device_class": "a80", "count": 1}},
+            classes={"a80": {"selectors": [
+                {"attribute": "mem", "value": "80"}]}},
+            slices={"n1": {"pool": [dev("d40", mem="40"),
+                                    dev("d80", mem="80")]}})
+        run_action(ssn)
+        brs = ssn.cluster.bind_requests
+        assert len(brs) == 1
+        assert brs[0].claim_allocations == [
+            {"name": "c", "node": "n1", "devices": ["d80"]}]
+
+
+class TestManifestParsing:
+    def test_device_class_and_slice_attributes(self):
+        from kai_scheduler_tpu.controllers.cache_builder import ClusterCache
+        from kai_scheduler_tpu.controllers.kubeapi import InMemoryKubeAPI
+
+        api = InMemoryKubeAPI()
+        api.create({"kind": "DeviceClass",
+                    "metadata": {"name": "a80"},
+                    "spec": {"selectors": [
+                        {"attribute": "mem", "value": "80"},
+                        {"cel": {"expression": "device.attributes..."}}]}})
+        api.create({"kind": "ResourceSlice",
+                    "metadata": {"name": "s1"},
+                    "spec": {"nodeName": "n1", "devices": [
+                        {"name": "d1", "basic": {
+                            "attributes": {"mem": {"string": "80"}},
+                            "capacity": {"memory": {"value": "80Gi"}}}},
+                        {"name": "d2", "deviceClassName": "gpu"}]}})
+        api.create({"kind": "ResourceClaim",
+                    "metadata": {"name": "c1", "namespace": "default"},
+                    "spec": {"devices": {"requests": [
+                        {"deviceClassName": "a80", "count": 2,
+                         "selectors": [
+                             {"capacity": "memory", "min": "64Gi"}]}]}}})
+        cache = ClusterCache(api)
+        ci = cache.snapshot()
+        assert ci.device_classes["a80"]["selectors"] == [
+            {"attribute": "mem", "value": "80"},
+            {"unsupported": True}]
+        devices = ci.resource_slices["n1"][""]
+        assert devices[0]["attributes"] == {"mem": "80"}
+        assert devices[0]["capacity"] == {"memory": float(80 * 2 ** 30)}
+        assert ci.resource_slices["n1"]["gpu"] == ["d2"]
+        req = ci.resource_claims["c1"]["requests"][0]
+        assert req["count"] == 2
+        assert req["selectors"] == [
+            {"capacity": "memory", "min": float(64 * 2 ** 30)}]

@@ -1,0 +1,232 @@
+"""Node affinity: required matchExpressions/matchFields as hard in-kernel
+masks, preferred weighted terms as score boosts.
+
+Mirrors the upstream NodeAffinity plugin the reference embeds
+(/root/reference/pkg/scheduler/k8s_internal/predicates/predicates.go:70-167).
+"""
+
+import numpy as np
+
+from kai_scheduler_tpu.api.pod_info import node_affinity_matches
+from tests.fixtures import build_session, placements, run_action
+
+
+def term(*exprs, fields=()):
+    return {"expressions": list(exprs), "fields": list(fields)}
+
+
+def e(key, op, *values):
+    return {"key": key, "operator": op, "values": list(values)}
+
+
+class TestMatcher:
+    LABELS = {"zone": "a", "tier": "gold", "gen": "7"}
+
+    def test_in(self):
+        assert node_affinity_matches([term(e("zone", "In", "a", "b"))],
+                                     self.LABELS)
+        assert not node_affinity_matches([term(e("zone", "In", "b"))],
+                                         self.LABELS)
+        # Missing key never matches In.
+        assert not node_affinity_matches([term(e("nope", "In", "a"))],
+                                         self.LABELS)
+
+    def test_not_in(self):
+        assert node_affinity_matches([term(e("zone", "NotIn", "b"))],
+                                     self.LABELS)
+        assert not node_affinity_matches([term(e("zone", "NotIn", "a"))],
+                                         self.LABELS)
+        # Missing key matches NotIn (upstream semantics).
+        assert node_affinity_matches([term(e("nope", "NotIn", "a"))],
+                                     self.LABELS)
+
+    def test_exists_doesnotexist(self):
+        assert node_affinity_matches([term(e("tier", "Exists"))],
+                                     self.LABELS)
+        assert not node_affinity_matches([term(e("nope", "Exists"))],
+                                         self.LABELS)
+        assert node_affinity_matches([term(e("nope", "DoesNotExist"))],
+                                     self.LABELS)
+        assert not node_affinity_matches([term(e("tier", "DoesNotExist"))],
+                                         self.LABELS)
+
+    def test_gt_lt(self):
+        assert node_affinity_matches([term(e("gen", "Gt", "5"))],
+                                     self.LABELS)
+        assert not node_affinity_matches([term(e("gen", "Gt", "7"))],
+                                         self.LABELS)
+        assert node_affinity_matches([term(e("gen", "Lt", "9"))],
+                                     self.LABELS)
+        # Non-numeric label value never matches Gt/Lt.
+        assert not node_affinity_matches([term(e("zone", "Gt", "1"))],
+                                         self.LABELS)
+
+    def test_or_across_terms_and_within(self):
+        terms = [term(e("zone", "In", "b"), e("tier", "Exists")),
+                 term(e("gen", "Gt", "6"))]
+        assert node_affinity_matches(terms, self.LABELS)  # 2nd term
+        terms = [term(e("zone", "In", "b")), term(e("gen", "Gt", "9"))]
+        assert not node_affinity_matches(terms, self.LABELS)
+
+    def test_match_fields_node_name(self):
+        t = term(fields=[e("metadata.name", "In", "node-7")])
+        assert node_affinity_matches([t], {}, node_name="node-7")
+        assert not node_affinity_matches([t], {}, node_name="node-8")
+
+    def test_empty_terms_match_everything(self):
+        assert node_affinity_matches([], self.LABELS)
+
+    def test_empty_term_matches_nothing(self):
+        assert not node_affinity_matches([term()], self.LABELS)
+
+    def test_unknown_operator_matches_nothing(self):
+        assert not node_affinity_matches([term(e("zone", "Fancy", "a"))],
+                                         self.LABELS)
+
+
+class TestPlacement:
+    def _spec(self, task, nodes=None):
+        return {
+            "nodes": nodes or {
+                "n-a": {"gpu": 8, "labels": {"zone": "a", "gen": "5"}},
+                "n-b": {"gpu": 8, "labels": {"zone": "b", "gen": "7",
+                                             "fast": "true"}},
+            },
+            "queues": {"q": {}},
+            "jobs": {"j": {"queue": "q", "tasks": [task]}},
+        }
+
+    def test_not_in_steers_away(self):
+        ssn = build_session(self._spec(
+            {"gpu": 1, "node_affinity": [term(e("zone", "NotIn", "a"))]}))
+        run_action(ssn)
+        assert placements(ssn)["j-0"][0] == "n-b"
+
+    def test_exists_requires_label(self):
+        ssn = build_session(self._spec(
+            {"gpu": 1, "node_affinity": [term(e("fast", "Exists"))]}))
+        run_action(ssn)
+        assert placements(ssn)["j-0"][0] == "n-b"
+
+    def test_gt_numeric(self):
+        ssn = build_session(self._spec(
+            {"gpu": 1, "node_affinity": [term(e("gen", "Gt", "6"))]}))
+        run_action(ssn)
+        assert placements(ssn)["j-0"][0] == "n-b"
+
+    def test_unsatisfiable_blocks_with_fit_error(self):
+        ssn = build_session(self._spec(
+            {"gpu": 1, "node_affinity": [term(e("zone", "In", "zz"))]}))
+        run_action(ssn)
+        assert placements(ssn) == {}
+        job = ssn.cluster.podgroups["j"]
+        assert job.fit_errors
+
+    def test_mixed_gang_in_kernel(self):
+        """A gang where only SOME members carry affinity places as one
+        chunk: constrained members land on matching nodes, free members
+        fill wherever fits."""
+        ssn = build_session({
+            "nodes": {
+                "n-a": {"gpu": 2, "labels": {"zone": "a"}},
+                "n-b": {"gpu": 2, "labels": {"zone": "b"}},
+            },
+            "queues": {"q": {}},
+            "jobs": {"g": {"queue": "q", "min_available": 3, "tasks": [
+                {"gpu": 2,
+                 "node_affinity": [term(e("zone", "In", "b"))]},
+                {"gpu": 1},
+                {"gpu": 1},
+            ]}},
+        })
+        run_action(ssn)
+        p = placements(ssn)
+        assert len(p) == 3
+        assert p["g-0"][0] == "n-b"
+        # The remaining 2 single-GPU tasks can only fit on n-a.
+        assert {p["g-1"][0], p["g-2"][0]} == {"n-a"}
+
+    def test_preferred_tips_equal_nodes(self):
+        ssn = build_session(self._spec(
+            {"gpu": 1, "node_affinity_preferred": [
+                {"weight": 10, "expressions": [e("zone", "In", "a")]}]},
+            nodes={
+                "n-a": {"gpu": 8, "labels": {"zone": "a"}},
+                "n-b": {"gpu": 8, "labels": {"zone": "b"}},
+            }))
+        run_action(ssn)
+        assert placements(ssn)["j-0"][0] == "n-a"
+
+    def test_preferred_does_not_block(self):
+        """A preferred term matching NO node must not prevent placement."""
+        ssn = build_session(self._spec(
+            {"gpu": 1, "node_affinity_preferred": [
+                {"weight": 5, "expressions": [e("zone", "In", "zz")]}]}))
+        run_action(ssn)
+        assert len(placements(ssn)) == 1
+
+    def test_signature_disambiguates(self):
+        """Jobs differing only in node affinity must not share a
+        scheduling signature (the failed-job skip would fence the
+        schedulable one out)."""
+        ssn = build_session({
+            "nodes": {"n-a": {"gpu": 8, "labels": {"zone": "a"}}},
+            "queues": {"q": {}},
+            "jobs": {
+                "ok": {"queue": "q", "tasks": [{"gpu": 1}]},
+                "blocked": {"queue": "q", "tasks": [
+                    {"gpu": 1,
+                     "node_affinity": [term(e("zone", "In", "zz"))]}]},
+            },
+        })
+        jobs = ssn.cluster.podgroups
+        assert (jobs["ok"].scheduling_signature()
+                != jobs["blocked"].scheduling_signature())
+        run_action(ssn)
+        p = placements(ssn)
+        assert "ok-0" in p and "blocked-0" not in p
+
+
+class TestManifestParsing:
+    def test_cache_builder_parses_node_affinity(self):
+        from kai_scheduler_tpu.controllers.cache_builder import ClusterCache
+
+        class FakeAPI:
+            def watch(self, kind, handler):
+                pass
+
+        cache = ClusterCache.__new__(ClusterCache)
+        cache._pod_cache = {}
+        cache._pipelined = {}
+        pod = {
+            "metadata": {"name": "p", "uid": "u1", "namespace": "ns"},
+            "spec": {
+                "containers": [{"resources": {"requests": {"cpu": "1"}}}],
+                "affinity": {"nodeAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": {
+                        "nodeSelectorTerms": [
+                            {"matchExpressions": [
+                                {"key": "zone", "operator": "NotIn",
+                                 "values": ["a"]}],
+                             "matchFields": [
+                                {"key": "metadata.name", "operator": "In",
+                                 "values": ["n9"]}]}]},
+                    "preferredDuringSchedulingIgnoredDuringExecution": [
+                        {"weight": 30, "preference": {"matchExpressions": [
+                            {"key": "fast", "operator": "Exists"}]}}],
+                }},
+            },
+        }
+        task = cache._parse_pod(pod)
+        assert task.node_affinity_required == [
+            {"expressions": [{"key": "zone", "operator": "NotIn",
+                              "values": ["a"]}],
+             "fields": [{"key": "metadata.name", "operator": "In",
+                         "values": ["n9"]}]}]
+        assert task.node_affinity_preferred == [
+            {"weight": 30.0,
+             "expressions": [{"key": "fast", "operator": "Exists"}],
+             "fields": []}]
+        # The parse cache template shares terms with instances.
+        again = cache._parse_pod(pod)
+        assert again.node_affinity_required == task.node_affinity_required

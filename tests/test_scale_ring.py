@@ -32,7 +32,8 @@ N_NODES = 400
 # benchmarked separately (bench.py).
 CEILINGS_S = {"fill": 10.0, "whole-gpu": 8.0, "distributed": 9.0,
               "burst": 18.0, "burst-steady": 1.0, "reclaim": 4.0,
-              "reclaim-contention": 15.0, "system-fill": 8.0}
+              "reclaim-contention": 15.0, "system-fill": 8.0,
+              "topology": 15.0}
 
 
 def _record(result: dict) -> None:
@@ -101,6 +102,27 @@ class TestScaleRing:
         # noise, and the cycle must stay bounded.
         assert r["prescreen_speedup"] > 0.8
         assert r["reclaim_cycle_s"] < CEILINGS_S["reclaim-contention"]
+
+    def test_topology_required(self):
+        """TAS with a required rack level (kwok_test.go topology
+        scenarios): every placed gang sits entirely inside one rack."""
+        r = scale_gen.run_scenario("topology-required", N_NODES)
+        _record(r)
+        # Demand is half the cluster; every gang fits SOME rack.
+        assert r["pods_bound"] == r["jobs"] * 16
+        assert r["gangs_placed"] == r["jobs"]
+        assert r["gangs_single_rack"] == r["gangs_placed"]
+        assert r["first_cycle_s"] < CEILINGS_S["topology"]
+
+    def test_topology_preferred(self):
+        """Preferred rack level: all gangs still bind, and the boost
+        keeps most of them rack-local."""
+        r = scale_gen.run_scenario("topology-preferred", N_NODES)
+        _record(r)
+        assert r["pods_bound"] == r["jobs"] * 16
+        # Preferred is advisory: most gangs should still pack one rack.
+        assert r["gangs_single_rack"] >= r["gangs_placed"] * 0.5
+        assert r["first_cycle_s"] < CEILINGS_S["topology"]
 
     def test_system_fill_fleet(self):
         r = scale_gen.run_system_scenario(200, 400)

@@ -167,3 +167,77 @@ class TestMeshConfiguredSession:
         assert meshy.snapshot.node_allocatable.shape[0] % 8 == 0
         run_action(meshy)
         assert placements(single) == placements(meshy)
+
+    def test_heterogeneous_gangs_use_sharded_exact_kernel(self,
+                                                          monkeypatch):
+        """Mixed-request gangs miss the grouped fast path; under a mesh
+        they must route through the sharded EXACT kernel (not silently
+        fall back to single-chip) and still match single-chip placements."""
+        from kai_scheduler_tpu.framework import SchedulerConfig
+        from kai_scheduler_tpu.parallel import sharded as sharded_mod
+        from tests.fixtures import build_session, placements, run_action
+
+        spec = {
+            "nodes": {f"n{i:02d}": {"gpu": 8} for i in range(12)},
+            "queues": {"q": {}},
+            # Heterogeneous gangs: trainer (2 GPU) + sidecar (CPU-only).
+            "jobs": {f"j{i:02d}": {"queue": "q", "min_available": 2,
+                                   "tasks": [{"gpu": 2},
+                                             {"cpu": "2", "gpu": 0}]}
+                     for i in range(6)},
+        }
+        single = build_session(spec, config=SchedulerConfig())
+        run_action(single)
+
+        calls = []
+        real = sharded_mod.sharded_allocate_jobs
+
+        def spy(*args, **kw):
+            calls.append(1)
+            return real(*args, **kw)
+
+        monkeypatch.setattr(sharded_mod, "sharded_allocate_jobs", spy)
+        meshy = build_session(spec, config=SchedulerConfig(mesh_devices=8))
+        assert meshy.mesh is not None
+        run_action(meshy)
+        assert calls, "sharded exact kernel was never invoked"
+        assert placements(single) == placements(meshy)
+
+    def test_full_action_sequence_over_mesh(self):
+        """allocate + reclaim run end-to-end under the 8-way virtual mesh
+        and reach the same placements and evictions as single-chip."""
+        from kai_scheduler_tpu.framework import SchedulerConfig
+        from kai_scheduler_tpu.scheduler import Scheduler
+        from kai_scheduler_tpu.utils.cluster_spec import build_cluster
+
+        def spec():
+            s = {
+                "nodes": {f"n{i:02d}": {"gpu": 8} for i in range(16)},
+                "queues": {"hog": {"deserved": {"gpu": 64}},
+                           "starved": {"deserved": {"gpu": 64}}},
+                "jobs": {f"hog{i:02d}": {"queue": "hog",
+                                         "tasks": [{"gpu": 1}]}
+                         for i in range(128)},
+            }
+            # Pending gangs in the starved queue force a reclaim.
+            for i in range(4):
+                s["jobs"][f"starved{i}"] = {
+                    "queue": "starved", "min_available": 2,
+                    "tasks": [{"gpu": 2}, {"cpu": "2", "gpu": 0}]}
+            return s
+
+        results = {}
+        for label, cfg in (("single", SchedulerConfig()),
+                           ("mesh", SchedulerConfig(mesh_devices=8))):
+            cluster = build_cluster(spec())
+            cfg.actions = ["allocate", "reclaim"]
+            sched = Scheduler(lambda c=cluster: c, cfg)
+            ssn = sched.run_once()
+            placed = {t.uid: t.node_name
+                      for pg in cluster.podgroups.values()
+                      for t in pg.pods.values() if t.node_name}
+            results[label] = (placed, sorted(ssn.cache.evicted))
+        assert results["single"] == results["mesh"]
+        # The starved queue actually got capacity back.
+        placed, evicted = results["mesh"]
+        assert any(uid.startswith("starved") for uid in placed)
