@@ -61,9 +61,9 @@ def _parse_device_selectors(raw) -> list:
     never too-wide."""
     out = []
     for sel in raw or []:
-        if "attribute" in sel:
+        if "attribute" in sel and sel.get("value") is not None:
             out.append({"attribute": sel["attribute"],
-                        "value": sel.get("value")})
+                        "value": sel["value"]})
         elif "capacity" in sel:
             out.append({"capacity": sel["capacity"],
                         "min": rs.parse_quantity(sel.get("min"))})

@@ -55,6 +55,8 @@ KIND_ROUTES = {
     "Topology": ("apis/kai.scheduler/v1", "topologies", False),
     "PodGroup": ("apis/scheduling.kai/v1", "podgroups", True),
     "BindRequest": ("apis/scheduling.kai/v1", "bindrequests", True),
+    "CustomResourceDefinition": ("apis/apiextensions.k8s.io/v1",
+                                 "customresourcedefinitions", False),
     "ClusterRole": ("apis/rbac.authorization.k8s.io/v1", "clusterroles",
                     False),
     "ClusterRoleBinding": ("apis/rbac.authorization.k8s.io/v1",

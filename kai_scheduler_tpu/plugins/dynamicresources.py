@@ -44,7 +44,11 @@ def _device_matches(dev, selectors: list) -> bool:
     caps = dev.get("capacity", {}) if isinstance(dev, dict) else {}
     for sel in selectors:
         if "attribute" in sel:
-            if attrs.get(sel["attribute"]) != sel.get("value"):
+            want = sel.get("value")
+            # A value-less selector is malformed: match nothing (a None
+            # "want" would otherwise equal the None of attribute-less
+            # devices and over-match).
+            if want is None or attrs.get(sel["attribute"]) != want:
                 return False
         elif "capacity" in sel:
             have = _qty(caps.get(sel["capacity"]))

@@ -79,6 +79,16 @@ class TestSelectors:
                       if pl.name == "dynamicresources")
         assert plugin.assumed["c"]["devices"] == ["linked"]
 
+    def test_valueless_attribute_selector_matches_nothing(self):
+        """{"attribute": k} with the value forgotten must not over-match
+        attribute-less devices (None == None)."""
+        ssn = self._session(
+            claims={"c": {"device_class": "broken", "count": 1}},
+            classes={"broken": {"selectors": [{"attribute": "vendor"}]}},
+            slices={"n1": {"pool": [dev("plain")]}})
+        run_action(ssn)
+        assert placements(ssn) == {}
+
     def test_cel_selector_matches_nothing(self):
         """Opaque (CEL/unknown) selectors must block, never over-match."""
         ssn = self._session(
