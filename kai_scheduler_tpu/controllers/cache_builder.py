@@ -236,6 +236,14 @@ class ClusterCache:
             return cached[1].instantiate()
         phase = pod.get("status", {}).get("phase", "Pending")
         status = PHASE_TO_STATUS.get(phase, PodStatus.UNKNOWN)
+        if (status == PodStatus.PENDING
+                and pod.get("spec", {}).get("nodeName")):
+            # Bound but not yet started: on a real cluster the phase
+            # stays Pending until the kubelet runs the pod (and in
+            # envtest forever) — the scheduler must treat it as placed,
+            # never re-place it (cluster_info.go snapshotPods does the
+            # same via the scheduled-pod check).
+            status = PodStatus.BOUND
         if md.get("deletionTimestamp"):
             status = PodStatus.RELEASING
         task = PodInfo(

@@ -219,6 +219,17 @@ class KubernetesKubeAPI:
         except NotFound:
             pass
 
+    def bind_pod(self, name: str, node_name: str,
+                 namespace: str = "default") -> None:
+        """POST pods/binding — the only way a real apiserver lets
+        spec.nodeName be set (clientset Bind; update/patch rejects it)."""
+        url = self._path("Pod", namespace, name) + "/binding"
+        self._json("POST", url, {
+            "apiVersion": "v1", "kind": "Binding",
+            "metadata": {"name": name, "namespace": namespace},
+            "target": {"apiVersion": "v1", "kind": "Node",
+                       "name": node_name}})
+
     # -- watch (one informer stream per kind, like client-go) --------------
     def watch(self, kind: str, handler: Callable) -> None:
         self._watchers[kind].append(handler)

@@ -38,6 +38,8 @@ unless the job sets "preemptible" explicitly.
 
 from __future__ import annotations
 
+import copy as _copy
+
 from kai_scheduler_tpu.api.pod_status import PodStatus
 from kai_scheduler_tpu.framework import SchedulerConfig
 
@@ -112,6 +114,10 @@ def _to_spec(case: dict, feedback: dict) -> dict:
             nodes[name]["mig_capacity"] = n["mig_capacity"]
         if "max_pods" in n:
             nodes[name]["max_pods"] = n["max_pods"]
+        if "labels" in n:
+            nodes[name]["labels"] = dict(n["labels"])
+        if "taints" in n:
+            nodes[name]["taints"] = list(n["taints"])
 
     queues = {}
     for dept in case.get("departments") or []:
@@ -154,6 +160,18 @@ def _to_spec(case: dict, feedback: dict) -> dict:
                 task["gpu_group"] = t["gpu_group"]
             if j.get("mig"):
                 task["mig"] = dict(j["mig"])
+            # Per-job scheduling constraints replicated onto every task
+            # (the reference's tasks_fake applies the job template);
+            # per-task values override.  Deep-copied so no two task
+            # dicts alias one mutable constraint object across rounds.
+            for key in ("selector", "tolerations", "node_affinity",
+                        "node_affinity_preferred", "labels",
+                        "affinity_terms", "anti_affinity_terms",
+                        "preferred_affinity_terms", "resource_claims"):
+                if key in t:
+                    task[key] = _copy.deepcopy(t[key])
+                elif key in j:
+                    task[key] = _copy.deepcopy(j[key])
             tasks.append(task)
         jobs[name] = {
             "queue": j.get("queue", "default"),
@@ -168,6 +186,10 @@ def _to_spec(case: dict, feedback: dict) -> dict:
         }
         if j.get("last_start_ts") is not None:
             jobs[name]["last_start_ts"] = j["last_start_ts"]
+        for key in ("topology", "required_topology_level",
+                    "preferred_topology_level", "pod_sets"):
+            if key in j:
+                jobs[name][key] = j[key]
 
     spec = {"nodes": nodes, "queues": queues, "jobs": jobs,
             "now": case.get("now", 1000.0)}

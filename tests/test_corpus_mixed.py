@@ -1,0 +1,300 @@
+"""Mixed-constraint action-integration corpus: topology, node affinity,
+selectors, and taints interacting with preempt/reclaim across feedback
+rounds — the cross-feature cases the reference spreads over
+actions/integration_tests/{allocate,preempt,reclaim}/... with
+node_order/predicates subsuites."""
+
+import pytest
+
+from tests.corpus import (PRIORITY_BUILD, PRIORITY_TRAIN, run_case)
+
+
+def e(key, op, *values):
+    return {"key": key, "operator": op, "values": list(values)}
+
+
+def na(*exprs):
+    return [{"expressions": list(exprs)}]
+
+
+TOPO = {"dc": {"levels": ["zone", "rack"]}}
+
+
+def rack_nodes(racks=2, per_rack=2, gpus=4):
+    nodes = {}
+    for r in range(racks):
+        for i in range(per_rack):
+            nodes[f"n{r}{i}"] = {
+                "gpus": gpus,
+                "labels": {"zone": "z0", "rack": f"r{r}"}}
+    return nodes
+
+
+CASES = [
+    {
+        # A gang sized exactly to one rack with a REQUIRED rack level
+        # must land entirely inside a single rack.
+        "name": "topology-required-single-rack",
+        "nodes": rack_nodes(racks=2, per_rack=2, gpus=4),
+        "queues": [{"name": "q0", "deserved_gpus": 16}],
+        "topologies": TOPO,
+        "jobs": [
+            {"name": "gang", "queue": "q0", "gpus_per_task": 2,
+             "priority": PRIORITY_TRAIN, "min_available": 4,
+             "topology": "dc", "required_topology_level": "rack",
+             "tasks": [{}] * 4},
+        ],
+        "expected": {"gang": {"status": "Running",
+                              "nodes": ["n00", "n01"]}},
+        "rounds_until_match": 1,
+    },
+    {
+        # Required rack level with one rack partially occupied: the gang
+        # only fits the empty rack.
+        "name": "topology-required-avoids-busy-rack",
+        "nodes": rack_nodes(racks=2, per_rack=2, gpus=4),
+        "queues": [{"name": "q0", "deserved_gpus": 16}],
+        "topologies": TOPO,
+        "jobs": [
+            {"name": "occupant", "queue": "q0", "gpus_per_task": 2,
+             "priority": PRIORITY_TRAIN,
+             "tasks": [{"state": "Running", "node": "n00"}]},
+            {"name": "gang", "queue": "q0", "gpus_per_task": 2,
+             "priority": PRIORITY_TRAIN, "min_available": 4,
+             "topology": "dc", "required_topology_level": "rack",
+             "tasks": [{}] * 4},
+        ],
+        "expected": {"gang": {"status": "Running",
+                              "nodes": ["n10", "n11"]}},
+        "rounds_until_match": 1,
+    },
+    {
+        # Preferred rack level is advisory: an over-rack-sized gang still
+        # binds (spilling racks), where required would starve it.
+        "name": "topology-preferred-spills",
+        "nodes": rack_nodes(racks=2, per_rack=2, gpus=4),
+        "queues": [{"name": "q0", "deserved_gpus": 16}],
+        "topologies": TOPO,
+        "jobs": [
+            {"name": "big", "queue": "q0", "gpus_per_task": 2,
+             "priority": PRIORITY_TRAIN, "min_available": 6,
+             "topology": "dc", "preferred_topology_level": "rack",
+             "tasks": [{}] * 6},
+        ],
+        "expected": {"big": {"status": "Running"}},
+        "rounds_until_match": 1,
+    },
+    {
+        # Same gang with REQUIRED rack cannot place (no rack holds 12
+        # GPUs) and stays pending without thrash.
+        "name": "topology-required-over-rack-starves",
+        "nodes": rack_nodes(racks=2, per_rack=2, gpus=4),
+        "queues": [{"name": "q0", "deserved_gpus": 16}],
+        "topologies": TOPO,
+        "jobs": [
+            {"name": "big", "queue": "q0", "gpus_per_task": 2,
+             "priority": PRIORITY_TRAIN, "min_available": 6,
+             "topology": "dc", "required_topology_level": "rack",
+             "tasks": [{}] * 6},
+        ],
+        "expected": {"big": {"status": "Pending"}},
+        "rounds_until_match": 1,
+    },
+    {
+        # NotIn steers to the matching node even when bin-pack would
+        # prefer the busier one.
+        "name": "affinity-notin-overrides-binpack",
+        "nodes": {"na": {"gpus": 4, "labels": {"zone": "a"}},
+                  "nb": {"gpus": 4, "labels": {"zone": "b"}}},
+        "queues": [{"name": "q0", "deserved_gpus": 8}],
+        "jobs": [
+            {"name": "warm", "queue": "q0", "gpus_per_task": 2,
+             "priority": PRIORITY_TRAIN,
+             "tasks": [{"state": "Running", "node": "na"}]},
+            {"name": "picky", "queue": "q0", "gpus_per_task": 1,
+             "priority": PRIORITY_TRAIN,
+             "node_affinity": na(e("zone", "NotIn", "a")),
+             "tasks": [{}]},
+        ],
+        "expected": {"picky": {"status": "Running", "node": "nb"}},
+        "rounds_until_match": 1,
+    },
+    {
+        # Gt over a numeric generation label.
+        "name": "affinity-gt-numeric-generation",
+        "nodes": {"old": {"gpus": 4, "labels": {"gen": "5"}},
+                  "new": {"gpus": 4, "labels": {"gen": "7"}}},
+        "queues": [{"name": "q0", "deserved_gpus": 8}],
+        "jobs": [
+            {"name": "modern", "queue": "q0", "gpus_per_task": 1,
+             "priority": PRIORITY_TRAIN,
+             "node_affinity": na(e("gen", "Gt", "6")),
+             "tasks": [{}]},
+        ],
+        "expected": {"modern": {"status": "Running", "node": "new"}},
+        "rounds_until_match": 1,
+    },
+    {
+        # OR across nodeSelectorTerms: either zone works, so it binds.
+        "name": "affinity-or-terms",
+        "nodes": {"na": {"gpus": 1, "labels": {"zone": "a"}},
+                  "nc": {"gpus": 4, "labels": {"zone": "c"}}},
+        "queues": [{"name": "q0", "deserved_gpus": 8}],
+        "jobs": [
+            {"name": "either", "queue": "q0", "gpus_per_task": 2,
+             "priority": PRIORITY_TRAIN,
+             "node_affinity": [{"expressions": [e("zone", "In", "a")]},
+                               {"expressions": [e("zone", "In", "c")]}],
+             "tasks": [{}]},
+        ],
+        "expected": {"either": {"status": "Running", "node": "nc"}},
+        "rounds_until_match": 1,
+    },
+    {
+        # An unsatisfiable required term keeps the job pending and must
+        # not block the rest of the queue.
+        "name": "affinity-unsatisfiable-isolated",
+        "nodes": {"na": {"gpus": 4, "labels": {"zone": "a"}}},
+        "queues": [{"name": "q0", "deserved_gpus": 4}],
+        "jobs": [
+            {"name": "stuck", "queue": "q0", "gpus_per_task": 1,
+             "priority": PRIORITY_TRAIN,
+             "node_affinity": na(e("zone", "In", "nowhere")),
+             "tasks": [{}]},
+            {"name": "fine", "queue": "q0", "gpus_per_task": 1,
+             "priority": PRIORITY_TRAIN, "tasks": [{}]},
+        ],
+        "expected": {"stuck": {"status": "Pending"},
+                     "fine": {"status": "Running", "node": "na"}},
+        "rounds_until_match": 1,
+    },
+    {
+        # In-queue preemption honors the preemptor's node affinity: the
+        # only affinity-eligible node is occupied by a lower-priority
+        # train job, which is evicted AND re-placed on the unconstrained
+        # node (the scenario solver re-places victims when possible).
+        "name": "preempt-follows-affinity",
+        "nodes": {"na": {"gpus": 2, "labels": {"zone": "a"}},
+                  "nb": {"gpus": 2, "labels": {"zone": "b"}}},
+        "queues": [{"name": "q0", "deserved_gpus": 2}],
+        "jobs": [
+            {"name": "victim", "queue": "q0", "gpus_per_task": 2,
+             "priority": PRIORITY_TRAIN,
+             "tasks": [{"state": "Running", "node": "nb"}]},
+            {"name": "vip", "queue": "q0", "gpus_per_task": 2,
+             "priority": PRIORITY_BUILD, "preemptible": False,
+             "node_affinity": na(e("zone", "NotIn", "a")),
+             "tasks": [{}]},
+        ],
+        "expected": {"vip": {"status": "Running", "node": "nb"},
+                     "victim": {"status": "Running", "node": "na"}},
+        "rounds_until_match": 3,
+    },
+    {
+        # Cross-queue reclaim honors the reclaimer's node affinity.
+        "name": "reclaim-follows-affinity",
+        "nodes": {"na": {"gpus": 2, "labels": {"zone": "a"}},
+                  "nb": {"gpus": 2, "labels": {"zone": "b"}}},
+        "queues": [{"name": "hog", "deserved_gpus": 2},
+                   {"name": "starved", "deserved_gpus": 2}],
+        "jobs": [
+            {"name": "hog-a", "queue": "hog", "gpus_per_task": 2,
+             "priority": PRIORITY_TRAIN,
+             "tasks": [{"state": "Running", "node": "na"}]},
+            {"name": "hog-b", "queue": "hog", "gpus_per_task": 2,
+             "priority": PRIORITY_TRAIN,
+             "tasks": [{"state": "Running", "node": "nb"}]},
+            {"name": "claimer", "queue": "starved", "gpus_per_task": 2,
+             "priority": PRIORITY_TRAIN,
+             "node_affinity": na(e("zone", "In", "b")),
+             "tasks": [{}]},
+        ],
+        "expected": {"claimer": {"status": "Running", "node": "nb"},
+                     "hog-a": {"status": "Running", "node": "na"}},
+        "rounds_until_match": 3,
+    },
+    {
+        # Preferred node affinity tips placement between equal nodes but
+        # never blocks when unmatched (second job).
+        "name": "preferred-affinity-tips-not-blocks",
+        "nodes": {"na": {"gpus": 4, "labels": {"zone": "a"}},
+                  "nb": {"gpus": 4, "labels": {"zone": "b"}}},
+        "queues": [{"name": "q0", "deserved_gpus": 8}],
+        "jobs": [
+            {"name": "tipped", "queue": "q0", "gpus_per_task": 1,
+             "priority": PRIORITY_TRAIN,
+             "node_affinity_preferred": [
+                 {"weight": 10, "expressions": [e("zone", "In", "b")]}],
+             "tasks": [{}]},
+            {"name": "unmatched", "queue": "q0", "gpus_per_task": 1,
+             "priority": PRIORITY_TRAIN,
+             "node_affinity_preferred": [
+                 {"weight": 10,
+                  "expressions": [e("zone", "In", "nowhere")]}],
+             "tasks": [{}]},
+        ],
+        "expected": {"tipped": {"status": "Running", "node": "nb"},
+                     "unmatched": {"status": "Running"}},
+        "rounds_until_match": 1,
+    },
+    {
+        # Mixed gang: one member pinned by affinity, the other free —
+        # placed atomically in one chunk; the pinned member MUST get the
+        # matching node, forcing the free one to the other.
+        "name": "mixed-gang-one-pinned-member",
+        "nodes": {"na": {"gpus": 2, "labels": {"zone": "a"}},
+                  "nb": {"gpus": 2, "labels": {"zone": "b"}}},
+        "queues": [{"name": "q0", "deserved_gpus": 4}],
+        "jobs": [
+            {"name": "gang", "queue": "q0", "gpus_per_task": 2,
+             "priority": PRIORITY_TRAIN, "min_available": 2,
+             "tasks": [{"node_affinity": na(e("zone", "In", "b"))}, {}]},
+        ],
+        "expected": {"gang": {"status": "Running",
+                              "nodes": ["na", "nb"]}},
+        "rounds_until_match": 1,
+    },
+    {
+        # Taints: an untolerated taint excludes the node; the tolerating
+        # job may use it.
+        "name": "taint-toleration-split",
+        "nodes": {"tainted": {"gpus": 4, "taints": ["dedicated"]},
+                  "open": {"gpus": 1}},
+        "queues": [{"name": "q0", "deserved_gpus": 8}],
+        "jobs": [
+            {"name": "plain", "queue": "q0", "gpus_per_task": 1,
+             "priority": PRIORITY_TRAIN, "tasks": [{}]},
+            {"name": "tolerant", "queue": "q0", "gpus_per_task": 2,
+             "priority": PRIORITY_TRAIN, "tolerations": ["dedicated"],
+             "tasks": [{}]},
+        ],
+        "expected": {"plain": {"status": "Running", "node": "open"},
+                     "tolerant": {"status": "Running",
+                                  "node": "tainted"}},
+        "rounds_until_match": 1,
+    },
+    {
+        # Selector and required affinity compose (AND): only the node
+        # satisfying BOTH hosts the job.
+        "name": "selector-and-affinity-compose",
+        "nodes": {
+            "n1": {"gpus": 4, "labels": {"pool": "p1", "zone": "a"}},
+            "n2": {"gpus": 4, "labels": {"pool": "p1", "zone": "b"}},
+            "n3": {"gpus": 4, "labels": {"pool": "p2", "zone": "b"}}},
+        "queues": [{"name": "q0", "deserved_gpus": 12}],
+        "jobs": [
+            {"name": "both", "queue": "q0", "gpus_per_task": 1,
+             "priority": PRIORITY_TRAIN,
+             "selector": {"pool": "p1"},
+             "node_affinity": na(e("zone", "NotIn", "a")),
+             "tasks": [{}]},
+        ],
+        "expected": {"both": {"status": "Running", "node": "n2"}},
+        "rounds_until_match": 1,
+    },
+]
+
+
+@pytest.mark.parametrize("case", CASES, ids=lambda c: c["name"])
+def test_mixed_corpus(case):
+    run_case(case)

@@ -104,6 +104,9 @@ def real_apiserver():
              "--service-account-issuer", "https://envtest",
              "--authorization-mode", "AlwaysAllow",
              "--anonymous-auth=true",
+             # Serve every API group/version the client routes (e.g.
+             # resource.k8s.io/v1 is off by default before k8s 1.34).
+             "--runtime-config", "api/all=true",
              "--disable-admission-plugins",
              "ServiceAccount,TaintNodesByCondition",
              "--allow-privileged=true",
@@ -136,17 +139,23 @@ def real_apiserver():
         for crd_file in sorted(CRD_DIR.glob("*.yaml")):
             crd = yaml.safe_load(crd_file.read_text())
             client.create(crd)
-        # CRDs must reach Established before serving their routes.
+        # CRDs must reach Established before serving their routes; a
+        # silent fall-through here would surface later as misleading
+        # NotFound route failures.
+        want = len(list(CRD_DIR.glob("*.yaml")))
         deadline = time.monotonic() + 30
-        while time.monotonic() < deadline:
+        while True:
             crds = client.list("CustomResourceDefinition")
             est = sum(1 for c in crds
                       if any(cond.get("type") == "Established"
                              and cond.get("status") == "True"
                              for cond in c.get("status", {})
                              .get("conditions", [])))
-            if est >= len(list(CRD_DIR.glob("*.yaml"))):
+            if est >= want:
                 break
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"only {est}/{want} CRDs became Established")
             time.sleep(0.5)
         yield url
     finally:

@@ -119,11 +119,36 @@ class StubK8s:
             def do_POST(self):
                 self._record()
                 obj = self._body()
+                clean = self.path.split("?")[0].rstrip("/")
+                if clean.endswith("/binding"):
+                    # pods/binding subresource: the ONLY way the real
+                    # dialect sets spec.nodeName.  The stub also flips
+                    # the phase (standing in for the kubelet, as KWOK
+                    # does) so the fleet's status feedback proceeds.
+                    pod_path = clean[: -len("/binding")]
+                    if pod_path not in stub.objects:
+                        self._send(404, {"message": "NotFound"})
+                        return
+                    pod = stub.objects[pod_path]
+                    if pod.get("spec", {}).get("nodeName"):
+                        # Real apiserver: re-binding an assigned pod is
+                        # a conflict, not an overwrite.
+                        self._send(409, {"message":
+                                         "pod is already assigned"})
+                        return
+                    pod.setdefault("spec", {})["nodeName"] = \
+                        obj.get("target", {}).get("name", "")
+                    pod.setdefault("status", {})["phase"] = "Running"
+                    stub.rv += 1
+                    pod["metadata"]["resourceVersion"] = str(stub.rv)
+                    stub.emit(pod_path, "MODIFIED", pod)
+                    self._send(201, {"kind": "Status", "status":
+                                     "Success"})
+                    return
                 stub.rv += 1
                 obj.setdefault("metadata", {})["resourceVersion"] = \
                     str(stub.rv)
-                path = self.path.split("?")[0].rstrip("/") + "/" + \
-                    obj["metadata"]["name"]
+                path = clean + "/" + obj["metadata"]["name"]
                 if path in stub.objects:
                     self._send(409, {"message": "AlreadyExists"})
                     return
@@ -368,6 +393,41 @@ class TestFleetOverK8sDialect:
             time.sleep(0.1)
         assert client.get("Pod", "w1")["spec"].get("nodeName") == "n1"
         assert client.get("Pod", "w1")["status"]["phase"] == "Running"
+        # The bind must go through the pods/binding subresource — a
+        # genuine apiserver rejects spec.nodeName via update/patch.
+        assert any(m == "POST" and p.rstrip("/").endswith("/binding")
+                   for m, p, _ in stub.requests)
+
+    def test_rebind_retry_is_idempotent(self, stub, client):
+        """A re-reconcile of an already-bound pod (binder died between
+        binding and the status patch) gets 409 from the apiserver and
+        must be treated as success for the same target node — the
+        BindRequest must end Succeeded, not Failed."""
+        from kai_scheduler_tpu.controllers.binder import Binder
+
+        client.create({"kind": "Node", "metadata": {"name": "n1"},
+                       "spec": {}, "status": {"allocatable": {
+                           "cpu": "32", "memory": "256Gi", "pods": 110}}})
+        pod = {"kind": "Pod",
+               "metadata": {"name": "w1", "namespace": "default"},
+               "spec": {}, "status": {"phase": "Pending"}}
+        client.create(pod)
+        br = {"kind": "BindRequest",
+              "metadata": {"name": "w1-bind", "namespace": "default"},
+              "spec": {"podName": "w1", "selectedNode": "n1"},
+              "status": {}}
+        client.create(br)
+        binder = Binder(client)
+        binder._on_bind_request("ADDED", client.get(
+            "BindRequest", "w1-bind"))
+        assert client.get("Pod", "w1")["spec"]["nodeName"] == "n1"
+        # Simulate the partial-bind retry: reconcile the same request
+        # again with its status cleared.
+        client.patch("BindRequest", "w1-bind", {"status": {}})
+        binder._on_bind_request("MODIFIED", client.get(
+            "BindRequest", "w1-bind"))
+        status = client.get("BindRequest", "w1-bind")["status"]
+        assert status.get("phase") == "Succeeded", status
 
 
 class TestRelistDeletes:
