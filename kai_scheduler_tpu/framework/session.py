@@ -70,6 +70,23 @@ def _next_pow2(n: int) -> int:
     return p
 
 
+def _unpack_allocation(result, t: int):
+    """(placed [t], piped [t], success [J]) from an AllocationResult.
+
+    When the kernel fused its outputs (result.packed: placements ++
+    pipelined ++ job_success, ops/allocate.py), ONE device->host fetch
+    serves all three — three separate fetches are three tunnel round
+    trips.  The layout is sliced here and nowhere else."""
+    if result.packed is not None:
+        flat = np.asarray(result.packed)
+        tp = result.placements.shape[0]
+        return (flat[:t], flat[tp:tp + t].astype(bool),
+                flat[2 * tp:].astype(bool))
+    return (np.asarray(result.placements[:t]),
+            np.asarray(result.pipelined[:t]),
+            np.asarray(result.job_success))
+
+
 class Session:
     def __init__(self, cluster: ClusterInfo, config=None, cache=None,
                  queue_usage: dict | None = None):
@@ -471,9 +488,7 @@ class Session:
                             else jnp.asarray(mask_pad)),
             gpu_strategy=self.gpu_strategy, cpu_strategy=self.cpu_strategy,
             allow_pipeline=True, pipeline_only=pipeline_only)
-        success = np.asarray(result.job_success)
-        placed = np.asarray(result.placements[:t])
-        piped = np.asarray(result.pipelined[:t])
+        placed, piped, success = _unpack_allocation(result, t)
         out = {}
         row = 0
         for j, (job, tasks) in enumerate(job_chunks):
@@ -661,11 +676,15 @@ class Session:
                 allow_pipeline=allow_pipeline,
                 pipeline_only=pipeline_only)
 
-        if not bool(result.job_success[0]):
+        if result.packed is None:
+            # Cheap early exit first: a failed proposal needs only the
+            # success bit, not the placement arrays.
+            if not bool(result.job_success[0]):
+                return Proposal(False, [])
+        placed, piped, success = _unpack_allocation(result, t)
+        if not bool(success[0]):
             return Proposal(False, [])
         placements = []
-        placed = np.asarray(result.placements[:t])
-        piped = np.asarray(result.pipelined[:t])
         for i, task in enumerate(tasks):
             node_idx = int(placed[i])
             if node_idx < 0:

@@ -45,6 +45,11 @@ class AllocationResult(NamedTuple):
     job_success: jnp.ndarray   # [J] bool — gang fully placed
     node_idle: jnp.ndarray     # [N,R] post-allocation idle
     node_releasing: jnp.ndarray  # [N,R] post-allocation releasing pool
+    # [T + T + J] int32: placements ++ pipelined ++ job_success fused on
+    # device, so a caller needing all three pays ONE device->host fetch
+    # (~70-100ms RTT each on the tunneled TPU) instead of three.  None
+    # when the producing kernel doesn't fuse it.
+    packed: "jnp.ndarray | None" = None
 
 
 @functools.partial(jax.jit,
@@ -223,4 +228,8 @@ def allocate_jobs_kernel(node_allocatable, node_idle, node_releasing,
     valid = job_success[task_job]
     placements = jnp.where(valid, placements, -1)
     pipelined = pipelined & valid
-    return AllocationResult(placements, pipelined, job_success, idle, rel)
+    packed = jnp.concatenate([placements,
+                              pipelined.astype(jnp.int32),
+                              job_success.astype(jnp.int32)])
+    return AllocationResult(placements, pipelined, job_success, idle, rel,
+                            packed)

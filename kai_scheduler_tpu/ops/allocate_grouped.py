@@ -574,5 +574,7 @@ def allocate_grouped(node_arrays, task_req, task_job, task_selector,
     # np_job values are unique: one vectorized assignment.
     if mergeable is not None and mergeable.any():
         success[np_job[mergeable]] = placements[mergeable] >= 0
-    return AllocationResult(placements, pipelined,
-                            jnp.asarray(success), idle, rel)
+    # All three outputs are host arrays derived from the ONE packed
+    # fetch above — returning success as numpy keeps consumers from
+    # paying an upload+fetch round trip to read it back.
+    return AllocationResult(placements, pipelined, success, idle, rel)

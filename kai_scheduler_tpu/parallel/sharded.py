@@ -173,8 +173,11 @@ def sharded_allocate_jobs(mesh, node_allocatable, node_idle, node_releasing,
     valid = job_success[task_job]
     placements = jnp.where(valid, placements, -1)
     pipelined = pipelined & valid
+    packed = jnp.concatenate([placements,
+                              pipelined.astype(jnp.int32),
+                              job_success.astype(jnp.int32)])
     return AllocationResult(placements, pipelined, job_success, idle_out,
-                            rel_out)
+                            rel_out, packed)
 
 
 def sharded_cycle_step(mesh, snapshot_arrays: dict, k_value: float = 1.0,

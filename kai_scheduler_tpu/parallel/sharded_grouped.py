@@ -308,5 +308,6 @@ def sharded_allocate_grouped(mesh, node_arrays, task_req, task_job,
             placements[t:t + m] = nodes[:m]
             pipelined[t:t + m] = pipes[:m]
         t += count
-    return AllocationResult(placements, pipelined, jnp.asarray(success),
-                            idle, rel)
+    # Host arrays throughout: consumers read them for free instead of
+    # round-tripping a re-uploaded device array.
+    return AllocationResult(placements, pipelined, success, idle, rel)

@@ -128,3 +128,104 @@ class TestCommit:
         stmt.discard()
         assert t.status == PodStatus.PENDING
         assert ssn.cache.bound == []
+
+
+class TestApplyBulk:
+    def test_native_bulk_matches_per_task_accounting(self):
+        """The native batched path and the per-task path must leave
+        identical node/queue/mirror state."""
+        a, b = session(), session()
+        ta = [task(a, "j1", 0), task(a, "j1", 1)]
+        tb = [task(b, "j1", 0), task(b, "j1", 1)]
+        sa, sb = a.statement(), b.statement()
+        sa.apply_bulk((t, "n1", False) for t in ta)  # native (plain)
+        for t in tb:
+            sb.allocate(t, "n1")
+        na, nb = a.cluster.nodes["n1"], b.cluster.nodes["n1"]
+        assert np.allclose(na.used, nb.used)
+        assert np.allclose(a.node_idle[a.node_index("n1")],
+                           b.node_idle[b.node_index("n1")])
+        assert (a.proportion.queues["q"].allocated
+                == b.proportion.queues["q"].allocated).all()
+        # And the native ops roll back identically.
+        sa.rollback(0), sb.rollback(0)
+        assert np.allclose(na.used, nb.used)
+        assert all(t.status == PodStatus.PENDING for t in ta + tb)
+
+    def test_generator_input_survives_native_bail(self):
+        """The round-4 regression shape: a generator argument whose
+        items trip the native bail must still apply EVERY placement via
+        the generic path (a partially-consumed generator would silently
+        drop the already-consumed ones)."""
+        ssn = build_session({
+            "nodes": {"n1": {"gpu": 8}},
+            "queues": {"q": {}},
+            "jobs": {"j": {"queue": "q", "min_available": 2,
+                           "tasks": [{"gpu": 1},
+                                     {"gpu_fraction": 0.5}]}},
+        })
+        tasks = [ssn.cluster.podgroups["j"].pods[f"j-{i}"]
+                 for i in range(2)]
+        tasks[1].gpu_group = "g0"
+        stmt = ssn.statement()
+        # Fractional second task bails the native scan AFTER consuming
+        # the first item.
+        stmt.apply_bulk((t, "n1", False) for t in tasks)
+        assert all(t.status == PodStatus.ALLOCATED for t in tasks)
+        assert len(stmt.ops) == 2
+
+    def test_convert_handles_native_ops(self):
+        ssn = session()
+        t0, t1 = task(ssn, "j1", 0), task(ssn, "j1", 1)
+        stmt = ssn.statement()
+        stmt.apply_bulk([(t0, "n1", False), (t1, "n1", True)])
+        stmt.convert_all_allocated_to_pipelined("j1")
+        assert t0.status == PodStatus.PIPELINED
+        node = ssn.cluster.nodes["n1"]
+        assert node.used[rs.RES_GPU] == 0
+        # Both claim future capacity now.
+        idle = ssn.node_idle[ssn.node_index("n1")][rs.RES_GPU]
+        rel = ssn.node_releasing[ssn.node_index("n1")][rs.RES_GPU]
+        assert idle == 8 and rel == -4
+        # Undo restores a clean slate through the native table too.
+        stmt.rollback(0)
+        assert ssn.node_releasing[ssn.node_index("n1")][rs.RES_GPU] == 0
+        assert t0.status == PodStatus.PENDING
+
+    def test_lifo_undo_with_interleaved_evicts(self):
+        ssn = session()
+        victim = task(ssn, "running", 0)
+        t0, t1 = task(ssn, "j1", 0), task(ssn, "j1", 1)
+        stmt = ssn.statement()
+        stmt.allocate(t0, "n2")
+        stmt.evict(victim)
+        stmt.pipeline(t1, "n2")
+        stmt.rollback(0)
+        assert victim.status == PodStatus.RUNNING
+        assert t0.status == PodStatus.PENDING
+        assert t1.status == PodStatus.PENDING
+        n2 = ssn.cluster.nodes["n2"]
+        assert n2.used[rs.RES_GPU] == 4 and n2.releasing[rs.RES_GPU] == 0
+
+    def test_commit_reports_pipelined_to_cache(self):
+        ssn = session()
+        t = task(ssn, "j1", 0)
+        recorded = []
+        ssn.cache.task_pipelined = (
+            lambda task_, node, group: recorded.append(
+                (task_.uid, node, group)))
+        stmt = ssn.statement()
+        stmt.pipeline(t, "n1")
+        binds = stmt.commit()
+        assert binds == []  # pipelined tasks emit no BindRequest yet
+        assert recorded == [("j1-0", "n1", "")]
+
+    def test_bind_request_mutators_fire_on_commit(self):
+        ssn = session()
+        t = task(ssn, "j1", 0)
+        ssn.bind_request_mutators = [
+            lambda task_, br: setattr(br, "resource_claims", ["c1"])]
+        stmt = ssn.statement()
+        stmt.allocate(t, "n1")
+        binds = stmt.commit()
+        assert binds[0].resource_claims == ["c1"]
