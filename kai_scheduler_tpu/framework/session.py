@@ -76,7 +76,9 @@ def _unpack_allocation(result, t: int):
     When the kernel fused its outputs (result.packed: placements ++
     pipelined ++ job_success, ops/allocate.py), ONE device->host fetch
     serves all three — three separate fetches are three tunnel round
-    trips.  The layout is sliced here and nowhere else."""
+    trips.  The layout is sliced here and nowhere else.  The fallback
+    exists for results whose arrays are already host-side (the grouped
+    kernels return numpy) or hand-built results in tests."""
     if result.packed is not None:
         flat = np.asarray(result.packed)
         tp = result.placements.shape[0]
@@ -676,11 +678,6 @@ class Session:
                 allow_pipeline=allow_pipeline,
                 pipeline_only=pipeline_only)
 
-        if result.packed is None:
-            # Cheap early exit first: a failed proposal needs only the
-            # success bit, not the placement arrays.
-            if not bool(result.job_success[0]):
-                return Proposal(False, [])
         placed, piped, success = _unpack_allocation(result, t)
         if not bool(success[0]):
             return Proposal(False, [])

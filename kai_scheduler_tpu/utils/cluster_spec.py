@@ -93,6 +93,8 @@ def build_cluster(spec: dict) -> ClusterInfo:
                     mig=t.get("mig")))
             if t.get("gpu_group"):
                 task.gpu_group = t["gpu_group"]
+            if t.get("nominated"):
+                task.nominated_node = t["nominated"]
             task.resource_claims = list(t.get("resource_claims", ()))
             task.pod_affinity_peers = list(t.get("affinity", ()))
             task.pod_anti_affinity_peers = list(t.get("anti_affinity", ()))

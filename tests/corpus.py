@@ -150,6 +150,8 @@ def _to_spec(case: dict, feedback: dict) -> dict:
                     "gpu": j.get("gpus_per_task", 0),
                     "cpu": f"{j.get('cpu_millis_per_task', 100)}m",
                     "mem": f"{j.get('memory_mb_per_task', 200)}Mi"}
+            if fb and fb.get("nominated"):
+                task["nominated"] = fb["nominated"]
             if j.get("gpu_fraction"):
                 task["gpu_fraction"] = j["gpu_fraction"]
                 task["gpu"] = 0
@@ -223,7 +225,12 @@ def _run_round(case: dict, feedback: dict, config=None):
                     feedback[(j["name"], i)] = {"state": "Pending",
                                                 "node": ""}
             elif task.status == PodStatus.PIPELINED:
-                feedback[(j["name"], i)] = {"state": "Pending", "node": ""}
+                # The live cache persists pipelined assignments across
+                # cycles (Cache.TaskPipelined -> next snapshot nominates
+                # the node); the harness carries the same nomination so
+                # consolidation/preemption solutions can converge.
+                feedback[(j["name"], i)] = {"state": "Pending", "node": "",
+                                            "nominated": task.node_name}
             elif task.status in (PodStatus.ALLOCATED, PodStatus.BINDING,
                                  PodStatus.BOUND):
                 feedback[(j["name"], i)] = {

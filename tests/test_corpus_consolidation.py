@@ -1,0 +1,146 @@
+"""Consolidation action-integration corpus across feedback rounds.
+
+Behavior parity with the reference's consolidation integration ring
+(/root/reference/pkg/scheduler/actions/integration_tests/consolidation/
+consolidation_test.go, consolidationGang_test.go): defragment by moving
+running preemptible pods so a pending job fits, never move
+non-preemptible pods, honor topology constraints, and only commit when
+every displaced pod is re-placed."""
+
+import pytest
+
+from tests.corpus import (PRIORITY_BUILD, PRIORITY_TRAIN, run_case)
+
+TOPO = {"dc": {"levels": ["rack"]}}
+
+CASES = [
+    {
+        # Two 1-GPU train pods on different nodes block a 2-GPU job on
+        # 2-GPU nodes: one must relocate so the pending job fits
+        # (consolidation_test.go "...- consolidate").
+        "name": "defragment-for-pending",
+        "nodes": {"node0": {"gpus": 2}, "node1": {"gpus": 2}},
+        "queues": [{"name": "queue0", "deserved_gpus": 4}],
+        "jobs": [
+            {"name": "frag0", "queue": "queue0", "gpus_per_task": 1,
+             "priority": PRIORITY_TRAIN,
+             "tasks": [{"state": "Running", "node": "node0"}]},
+            {"name": "frag1", "queue": "queue0", "gpus_per_task": 1,
+             "priority": PRIORITY_TRAIN,
+             "tasks": [{"state": "Running", "node": "node1"}]},
+            {"name": "wide", "queue": "queue0", "gpus_per_task": 2,
+             "priority": PRIORITY_TRAIN, "tasks": [{}]},
+        ],
+        "expected": {
+            "frag0": {"status": "Running", "dont_validate_node": True},
+            "frag1": {"status": "Running", "dont_validate_node": True},
+            "wide": {"status": "Running", "dont_validate_node": True},
+        },
+        "rounds_until_match": 3,
+    },
+    {
+        # The same fragmentation with BUILD (non-preemptible) runners:
+        # nothing may move, the wide job stays pending
+        # (consolidation_test.go "...- don't consolidate").
+        "name": "build-pods-never-move",
+        "nodes": {"node0": {"gpus": 2}, "node1": {"gpus": 2}},
+        "queues": [{"name": "queue0", "deserved_gpus": 4}],
+        "jobs": [
+            {"name": "pinned0", "queue": "queue0", "gpus_per_task": 1,
+             "priority": PRIORITY_BUILD, "preemptible": False,
+             "tasks": [{"state": "Running", "node": "node0"}]},
+            {"name": "pinned1", "queue": "queue0", "gpus_per_task": 1,
+             "priority": PRIORITY_BUILD, "preemptible": False,
+             "tasks": [{"state": "Running", "node": "node1"}]},
+            {"name": "wide", "queue": "queue0", "gpus_per_task": 2,
+             "priority": PRIORITY_TRAIN, "tasks": [{}]},
+        ],
+        "expected": {
+            "pinned0": {"status": "Running", "node": "node0"},
+            "pinned1": {"status": "Running", "node": "node1"},
+            "wide": {"status": "Pending"},
+        },
+        "rounds_until_match": 2,
+    },
+    {
+        # Gang consolidation: a 2x2-GPU gang fits only if both fragments
+        # land on one node, freeing the other entirely
+        # (consolidationGang_test.go).
+        "name": "gang-needs-whole-node",
+        "nodes": {"node0": {"gpus": 2}, "node1": {"gpus": 2}},
+        "queues": [{"name": "queue0", "deserved_gpus": 4}],
+        "jobs": [
+            {"name": "frag0", "queue": "queue0", "gpus_per_task": 1,
+             "priority": PRIORITY_TRAIN,
+             "tasks": [{"state": "Running", "node": "node0"}]},
+            {"name": "frag1", "queue": "queue0", "gpus_per_task": 1,
+             "priority": PRIORITY_TRAIN,
+             "tasks": [{"state": "Running", "node": "node1"}]},
+            {"name": "gang", "queue": "queue0", "gpus_per_task": 2,
+             "priority": PRIORITY_TRAIN, "min_available": 1,
+             "tasks": [{}]},
+        ],
+        "expected": {
+            "gang": {"status": "Running"},
+            "frag0": {"status": "Running", "dont_validate_node": True},
+            "frag1": {"status": "Running", "dont_validate_node": True},
+        },
+        "rounds_until_match": 3,
+    },
+    {
+        # No-full-replacement rule: the cluster simply cannot host the
+        # displaced pod AND the pending job, so nothing moves at all
+        # (allPodsReallocated, consolidation.go:121-128).
+        "name": "no-partial-consolidation",
+        "nodes": {"node0": {"gpus": 2}},
+        "queues": [{"name": "queue0", "deserved_gpus": 2}],
+        "jobs": [
+            {"name": "resident", "queue": "queue0", "gpus_per_task": 1,
+             "priority": PRIORITY_TRAIN,
+             "tasks": [{"state": "Running", "node": "node0"}]},
+            {"name": "wide", "queue": "queue0", "gpus_per_task": 2,
+             "priority": PRIORITY_TRAIN, "tasks": [{}]},
+        ],
+        "expected": {
+            "resident": {"status": "Running", "node": "node0"},
+            "wide": {"status": "Pending"},
+        },
+        "rounds_until_match": 2,
+    },
+    {
+        # Topology-required consolidation: the gang must land inside one
+        # rack; the only rack with capacity is partially occupied by a
+        # movable train pod (consolidation_test.go "topology
+        # consolidation with required - simple").
+        "name": "topology-required-consolidation",
+        "nodes": {
+            "r0n0": {"gpus": 2, "labels": {"rack": "r0"}},
+            "r0n1": {"gpus": 2, "labels": {"rack": "r0"}},
+            "r1n0": {"gpus": 2, "labels": {"rack": "r1"}},
+        },
+        "queues": [{"name": "queue0", "deserved_gpus": 6}],
+        "topologies": TOPO,
+        "jobs": [
+            {"name": "squatter", "queue": "queue0", "gpus_per_task": 1,
+             "priority": PRIORITY_TRAIN,
+             "tasks": [{"state": "Running", "node": "r0n0"}]},
+            {"name": "gang", "queue": "queue0", "gpus_per_task": 2,
+             "priority": PRIORITY_TRAIN, "min_available": 2,
+             "topology": "dc", "required_topology_level": "rack",
+             "tasks": [{}, {}]},
+        ],
+        "expected": {
+            "gang": {"status": "Running", "nodes": ["r0n0", "r0n1"]},
+            "squatter": {"status": "Running",
+                         "dont_validate_node": True},
+        },
+        # Consolidation pipelines the gang onto the squatter's releasing
+        # capacity; the next round's feedback re-allocates both for real.
+        "rounds_until_match": 4,
+    },
+]
+
+
+@pytest.mark.parametrize("case", CASES, ids=lambda c: c["name"])
+def test_consolidation_corpus(case):
+    run_case(case)
