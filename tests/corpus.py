@@ -139,13 +139,25 @@ def _to_spec(case: dict, feedback: dict) -> dict:
     jobs = {}
     for job_index, j in enumerate(case.get("jobs") or []):
         name = j["name"]
+        # delete_in_test deletion completes between rounds: once any of
+        # the job's tasks was seen Releasing, the whole job object is
+        # gone from the next snapshot (the reference harness deletes the
+        # job from the fake cluster — no phantom empty podgroup remains).
+        if j.get("delete_in_test") and any(
+                feedback.get((name, i), {}).get("state") == "Releasing"
+                for i in range(len(j.get("tasks") or []))):
+            continue
         priority = j.get("priority", PRIORITY_TRAIN)
         tasks = []
         for i, t in enumerate(j.get("tasks") or []):
             fb = feedback.get((name, i))
             state = fb["state"] if fb else t.get("state", "Pending")
             node = fb["node"] if fb else t.get("node", "")
-            task = {"status": _STATE_MAP.get(state, state),
+            # Explicit uid pinned to the ORIGINAL index: deleted earlier
+            # siblings must not shift the survivors' identities (feedback
+            # keys and expected-placement names are positional).
+            task = {"uid": f"{name}-{i}", "name": f"{name}-{i}",
+                    "status": _STATE_MAP.get(state, state),
                     "node": node or "",
                     "gpu": j.get("gpus_per_task", 0),
                     "cpu": f"{j.get('cpu_millis_per_task', 100)}m",
@@ -237,9 +249,17 @@ def _run_round(case: dict, feedback: dict, config=None):
                     "state": "Running", "node": task.node_name,
                     "gpu_group": task.gpu_group}
             else:
-                feedback[(j["name"], i)] = {
+                entry = {
                     "state": task.status.name.capitalize(),
                     "node": task.node_name, "gpu_group": task.gpu_group}
+                # Sticky nomination: the live cache keeps a pipelined
+                # assignment for as long as the pod stays pending
+                # (cache_builder._pipelined re-nominates every snapshot),
+                # even across a round where nothing re-pipelined it.
+                if task.status == PodStatus.PENDING \
+                        and task.nominated_node:
+                    entry["nominated"] = task.nominated_node
+                feedback[(j["name"], i)] = entry
     return ssn
 
 

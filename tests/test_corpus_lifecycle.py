@@ -1,0 +1,128 @@
+"""Lifecycle action-integration corpus: deletions freeing capacity,
+stale-gang eviction, and consolidation+reclaim interplay across rounds.
+
+Behavior parity with the reference's deletion_tests, stalegangeviction,
+and consolidation_and_reclaim integration rings
+(/root/reference/pkg/scheduler/actions/integration_tests/)."""
+
+import pytest
+
+from tests.corpus import (PRIORITY_BUILD, PRIORITY_TRAIN, run_case)
+
+CASES = [
+    {
+        # A releasing (being-deleted) job holds the whole node: the
+        # pending job pipelines onto it, the deletion completes between
+        # rounds, and the pipelined nomination converts to a real
+        # allocation (deletion_test.go:27 behavior over rounds).
+        "name": "deleted-job-frees-node",
+        "nodes": {"node0": {"gpus": 2}},
+        "queues": [{"name": "queue0", "deserved_gpus": 2}],
+        "jobs": [
+            {"name": "dying", "queue": "queue0", "gpus_per_task": 2,
+             "priority": PRIORITY_TRAIN, "delete_in_test": True,
+             "tasks": [{"state": "Releasing", "node": "node0"}]},
+            {"name": "next", "queue": "queue0", "gpus_per_task": 2,
+             "priority": PRIORITY_TRAIN, "tasks": [{}]},
+        ],
+        "expected": {"next": {"status": "Running", "node": "node0"}},
+        "rounds_until_match": 3,
+    },
+    {
+        # Two dying fractional pods shared one GPU; a whole-GPU job
+        # needs the device clean (deletion_test.go:78 "delete 2
+        # fractional jobs from same GPU").
+        "name": "deleted-fractions-free-whole-gpu",
+        "nodes": {"node0": {"gpus": 1}},
+        "queues": [{"name": "queue0", "deserved_gpus": 1}],
+        "jobs": [
+            {"name": "dying0", "queue": "queue0", "gpu_fraction": 0.5,
+             "priority": PRIORITY_TRAIN, "delete_in_test": True,
+             "tasks": [{"state": "Releasing", "node": "node0",
+                        "gpu_group": "g0"}]},
+            {"name": "dying1", "queue": "queue0", "gpu_fraction": 0.5,
+             "priority": PRIORITY_TRAIN, "delete_in_test": True,
+             "tasks": [{"state": "Releasing", "node": "node0",
+                        "gpu_group": "g0"}]},
+            {"name": "whole", "queue": "queue0", "gpus_per_task": 1,
+             "priority": PRIORITY_TRAIN, "tasks": [{}]},
+        ],
+        "expected": {"whole": {"status": "Running", "node": "node0"}},
+        "rounds_until_match": 3,
+    },
+    {
+        # A gang stuck below minAvailable past the staleness grace is
+        # evicted whole and stays pending when it can never fit
+        # (stalegangeviction_test.go "Evict stale gang job of train").
+        "name": "stale-gang-evicted",
+        "nodes": {"node0": {"gpus": 2}},
+        "queues": [{"name": "queue0", "deserved_gpus": 2}],
+        "jobs": [
+            # 3x1GPU gang on a 2-GPU cluster: permanently partial.
+            {"name": "stale", "queue": "queue0", "gpus_per_task": 1,
+             "priority": PRIORITY_TRAIN, "min_available": 3,
+             "last_start_ts": 0.0,
+             "tasks": [{"state": "Running", "node": "node0"},
+                       {"state": "Running", "node": "node0"}, {}]},
+        ],
+        "now": 10000.0,  # far past the staleness grace
+        "expected": {"stale": {"status": "Pending"}},
+        "rounds_until_match": 2,
+    },
+    {
+        # The freed capacity from the stale eviction goes to a waiting
+        # whole-node job next rounds.
+        "name": "stale-eviction-frees-capacity",
+        "nodes": {"node0": {"gpus": 2}},
+        "queues": [{"name": "queue0", "deserved_gpus": 2}],
+        "jobs": [
+            {"name": "stale", "queue": "queue0", "gpus_per_task": 1,
+             "priority": PRIORITY_TRAIN, "min_available": 3,
+             "last_start_ts": 0.0,
+             "tasks": [{"state": "Running", "node": "node0"},
+                       {"state": "Running", "node": "node0"}, {}]},
+            {"name": "whole", "queue": "queue0", "gpus_per_task": 2,
+             "priority": PRIORITY_BUILD, "preemptible": False,
+             "tasks": [{}]},
+        ],
+        "now": 10000.0,
+        "expected": {"whole": {"status": "Running", "node": "node0"},
+                     "stale": {"status": "Pending"}},
+        "rounds_until_match": 3,
+        # The 3-member gang keeps retrying against 0 free GPUs and
+        # stays pending; the bound whole-node job must stay put.
+        "rounds_after_match": 3,
+    },
+    {
+        # Consolidation and reclaim compose: queue1 deserves half the
+        # cluster but queue0's fragments cover both nodes; the cheapest
+        # path is reclaiming one fragment and keeping the other running
+        # (consolidation_and_reclaim_test.go).
+        "name": "reclaim-one-fragment-keep-other",
+        "nodes": {"node0": {"gpus": 2}, "node1": {"gpus": 2}},
+        "queues": [{"name": "queue0", "deserved_gpus": 2},
+                   {"name": "queue1", "deserved_gpus": 2}],
+        "jobs": [
+            {"name": "hog-old", "queue": "queue0", "gpus_per_task": 2,
+             "priority": PRIORITY_TRAIN, "creation_ts": 0.0,
+             "tasks": [{"state": "Running", "node": "node0"}]},
+            {"name": "hog-young", "queue": "queue0", "gpus_per_task": 2,
+             "priority": PRIORITY_TRAIN, "creation_ts": 1.0,
+             "tasks": [{"state": "Running", "node": "node1"}]},
+            {"name": "claimer", "queue": "queue1", "gpus_per_task": 2,
+             "priority": PRIORITY_TRAIN, "tasks": [{}]},
+        ],
+        "expected": {
+            "claimer": {"status": "Running",
+                        "dont_validate_node": True},
+            "hog-old": {"status": "Running",
+                        "dont_validate_node": True},
+        },
+        "rounds_until_match": 3,
+    },
+]
+
+
+@pytest.mark.parametrize("case", CASES, ids=lambda c: c["name"])
+def test_lifecycle_corpus(case):
+    run_case(case)
