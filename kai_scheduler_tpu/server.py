@@ -86,6 +86,23 @@ def _make_handler(server_state):
                 body = json.dumps(
                     server_state.get("job_order", {})).encode()
                 ctype = "application/json"
+            elif self.path.split("?", 1)[0] == "/debug/profile":
+                from urllib.parse import parse_qs, urlparse
+                prof = server_state.get("profiler")
+                if prof is None:
+                    self.send_error(
+                        404, "profiler disabled (--enable-profiler)")
+                    return
+                q = {k: v[0] for k, v in
+                     parse_qs(urlparse(self.path).query).items()}
+                if q.get("summary") in ("1", "true"):
+                    body = json.dumps(prof.summary()).encode()
+                    ctype = "application/json"
+                else:
+                    # pprof collapsed-stack format (flamegraph-ready).
+                    top = int(q.get("top", 5000))
+                    body = prof.folded(top=top).encode()
+                    ctype = "text/plain"
             else:
                 self.send_error(404)
                 return
@@ -146,6 +163,11 @@ def run_app(argv=None) -> None:
                     help="comma-separated action order override")
     ap.add_argument("--cycles", type=int, default=0,
                     help="stop after N cycles (0 = forever)")
+    ap.add_argument("--enable-profiler", action="store_true",
+                    help="continuous sampling profiler (pprof/Pyroscope "
+                         "analog, cmd/scheduler/profiling/): collapsed "
+                         "stacks at GET /debug/profile, summary at "
+                         "/debug/profile?summary=1")
     ap.add_argument("--profile-dir", default=None,
                     help="write a JAX profiler trace of the run here "
                          "(the pprof/Pyroscope analog)")
@@ -189,6 +211,9 @@ def run_app(argv=None) -> None:
         scheduling_enabled=not args.controllers_only), api=api)
 
     state: dict = {}
+    if args.enable_profiler:
+        from .utils.profiling import SamplingProfiler
+        state["profiler"] = SamplingProfiler().start()
     handler = _make_handler(state)
     httpd = ThreadingHTTPServer(("127.0.0.1", args.http_port), handler)
     threading.Thread(target=httpd.serve_forever, daemon=True).start()

@@ -153,11 +153,7 @@ def _to_spec(case: dict, feedback: dict) -> dict:
             fb = feedback.get((name, i))
             state = fb["state"] if fb else t.get("state", "Pending")
             node = fb["node"] if fb else t.get("node", "")
-            # Explicit uid pinned to the ORIGINAL index: deleted earlier
-            # siblings must not shift the survivors' identities (feedback
-            # keys and expected-placement names are positional).
-            task = {"uid": f"{name}-{i}", "name": f"{name}-{i}",
-                    "status": _STATE_MAP.get(state, state),
+            task = {"status": _STATE_MAP.get(state, state),
                     "node": node or "",
                     "gpu": j.get("gpus_per_task", 0),
                     "cpu": f"{j.get('cpu_millis_per_task', 100)}m",

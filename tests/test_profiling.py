@@ -1,0 +1,107 @@
+"""Continuous sampling profiler (the pprof/Pyroscope analog,
+cmd/scheduler/profiling/) and its /debug/profile surface."""
+
+import json
+import threading
+import time
+import urllib.request
+
+from kai_scheduler_tpu.utils.profiling import SamplingProfiler
+
+
+def busy(stop):
+    x = 0.0
+    while not stop.is_set():
+        for i in range(2000):
+            x += i * 1.000001
+    return x
+
+
+class TestSamplingProfiler:
+    def test_captures_busy_stacks(self):
+        prof = SamplingProfiler(interval_seconds=0.002).start()
+        stop = threading.Event()
+        t = threading.Thread(target=busy, args=(stop,))
+        t.start()
+        time.sleep(0.3)
+        stop.set()
+        t.join()
+        prof.stop()
+        assert prof.total_samples > 10
+        folded = prof.folded()
+        # The busy loop's frame appears in some collapsed stack.
+        assert "test_profiling.py:busy" in folded
+        # Folded lines are "stack count".
+        line = folded.splitlines()[0]
+        stack, count = line.rsplit(" ", 1)
+        assert int(count) >= 1
+        assert ":" in stack  # file:func:lineno frames
+        summary = prof.summary()
+        assert summary["total_samples"] == prof.total_samples
+        assert summary["top_leaves"]
+        assert abs(sum(e["share"] for e in summary["top_leaves"]) - 1.0) \
+            < 0.05 or len(summary["top_leaves"]) == 30
+
+    def test_reset_clears(self):
+        prof = SamplingProfiler(interval_seconds=0.002).start()
+        stop = threading.Event()
+        t = threading.Thread(target=busy, args=(stop,))
+        t.start()
+        time.sleep(0.1)
+        stop.set()
+        t.join()
+        prof.stop()
+        prof.reset()
+        assert prof.total_samples == 0
+        assert prof.folded() == ""
+
+
+class TestDebugEndpoint:
+    def test_profile_endpoint_serves_folded_and_summary(self):
+        from http.server import ThreadingHTTPServer
+
+        from kai_scheduler_tpu.server import _make_handler
+
+        prof = SamplingProfiler(interval_seconds=0.002).start()
+        stop = threading.Event()
+        t = threading.Thread(target=busy, args=(stop,))
+        t.start()
+        time.sleep(0.2)
+        state = {"profiler": prof}
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0),
+                                    _make_handler(state))
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        try:
+            port = httpd.server_port
+            folded = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/profile",
+                timeout=5).read().decode()
+            assert "busy" in folded
+            summary = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/profile?summary=1",
+                timeout=5).read())
+            assert summary["total_samples"] > 0
+        finally:
+            stop.set()
+            t.join()
+            prof.stop()
+            httpd.shutdown()
+
+    def test_disabled_returns_404(self):
+        from http.server import ThreadingHTTPServer
+
+        from kai_scheduler_tpu.server import _make_handler
+
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0), _make_handler({}))
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        try:
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{httpd.server_port}/debug/profile",
+                    timeout=5)
+                raised = False
+            except urllib.error.HTTPError as e:
+                raised = e.code == 404
+            assert raised
+        finally:
+            httpd.shutdown()
