@@ -115,6 +115,11 @@ class PodGroupInfo:
         self._tasks_to_allocate: Optional[list[PodInfo]] = None
         self._signature: Optional[str] = None
         self._init_resource: Optional[np.ndarray] = None
+        # Incremental status counters: has_tasks_to_allocate is called
+        # for every job every cycle (action admission + re-push checks),
+        # so it must not rescan the pod dict each time at 1M-pod scale.
+        self._pending_count = 0
+        self._releasing_count = 0
 
     # -- structure ---------------------------------------------------------
     def set_pod_sets(self, pod_sets: Iterable[PodSet],
@@ -137,11 +142,20 @@ class PodGroupInfo:
         task.job_id = self.uid
         self.pods[task.uid] = task
         self._index_task(task)
+        self._count_status(task.status, +1)
         self.invalidate_caches()
 
     def update_task_status(self, task: PodInfo, status: PodStatus) -> None:
+        self._count_status(task.status, -1)
         task.status = status
+        self._count_status(status, +1)
         self.invalidate_caches()
+
+    def _count_status(self, status: PodStatus, delta: int) -> None:
+        if status == PodStatus.PENDING:
+            self._pending_count += delta
+        elif status == PodStatus.RELEASING:
+            self._releasing_count += delta
 
     def invalidate_caches(self) -> None:
         self._tasks_to_allocate = None
@@ -252,8 +266,9 @@ class PodGroupInfo:
         return out
 
     def has_tasks_to_allocate(self, real_allocation: bool = True) -> bool:
-        return any(self._should_allocate(t, real_allocation)
-                   for t in self.pods.values())
+        if real_allocation:
+            return self._pending_count > 0
+        return self._pending_count > 0 or self._releasing_count > 0
 
     def tasks_to_allocate_init_resource(self, **kw) -> np.ndarray:
         """Total request of the next chunk; cached like the reference's

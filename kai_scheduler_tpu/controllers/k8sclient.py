@@ -11,8 +11,9 @@ apiserver (controllers/apiserver.py speaks a simplified dialect of the
 same protocol).
 
 Auth: bearer token (in-cluster serviceaccount file or explicit), TLS CA
-(or insecure skip for dev clusters).  A minimal kubeconfig loader covers
-token and insecure client configs; exec-plugin auth is out of scope.
+(or insecure skip for dev clusters).  The kubeconfig loader covers
+static-token users and client-go exec credential plugins (token-minting
+commands); cert-based exec credentials are unsupported and fail loudly.
 """
 
 from __future__ import annotations
@@ -72,7 +73,13 @@ SA_CA = "/var/run/secrets/kubernetes.io/serviceaccount/ca.crt"
 
 def load_kubeconfig(path: str) -> dict:
     """Minimal kubeconfig: current-context -> {server, token,
-    insecure_skip_tls_verify, ca_file}."""
+    insecure_skip_tls_verify, ca_file}.
+
+    Supports static ``token`` users and client-go credential ("exec")
+    plugins: the configured command runs once and its ExecCredential
+    JSON supplies ``status.token`` (client-go's
+    client-go/plugin/pkg/client/auth/exec contract; token refresh on
+    expiry is the caller's concern — re-invoke from_kubeconfig)."""
     import yaml
 
     cfg = yaml.safe_load(open(path))
@@ -83,10 +90,54 @@ def load_kubeconfig(path: str) -> dict:
                    if c["name"] == ctx["cluster"])
     user = next(u["user"] for u in cfg.get("users", [])
                 if u["name"] == ctx["user"])
+    token = user.get("token")
+    exec_spec = user.get("exec")
+    if token is None and exec_spec:
+        token = _exec_credential_token(exec_spec)
     return {"server": cluster["server"],
             "insecure": bool(cluster.get("insecure-skip-tls-verify")),
             "ca_file": cluster.get("certificate-authority"),
-            "token": user.get("token")}
+            "token": token}
+
+
+def _exec_credential_token(exec_spec: dict) -> str | None:
+    """Run a client-go credential plugin and extract the bearer token."""
+    import os
+    import subprocess
+
+    cmd = [exec_spec["command"], *(exec_spec.get("args") or [])]
+    env = dict(os.environ)
+    for entry in exec_spec.get("env") or []:
+        env[entry["name"]] = entry.get("value", "")
+    # The plugin may inspect the request's cluster/interactivity.
+    env["KUBERNETES_EXEC_INFO"] = json.dumps({
+        "kind": "ExecCredential",
+        "apiVersion": exec_spec.get(
+            "apiVersion", "client.authentication.k8s.io/v1"),
+        "spec": {"interactive": False},
+    })
+    try:
+        out = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                             timeout=60, check=True).stdout
+        cred = json.loads(out)
+        token = (cred.get("status") or {}).get("token")
+        if not token:
+            # Cert-based ExecCredentials (clientCertificateData) are not
+            # supported; proceeding token-less would just produce
+            # unexplained 401s on every request.
+            raise RuntimeError(
+                f"exec credential plugin {cmd[0]!r} returned no "
+                f"status.token (cert-based credentials unsupported)")
+        return token
+    except (OSError, subprocess.SubprocessError,
+            json.JSONDecodeError) as exc:
+        detail = str(exc)
+        stderr = getattr(exc, "stderr", None)
+        if stderr:
+            detail += f" | stderr: {stderr.strip()[:500]}"
+        raise RuntimeError(
+            f"exec credential plugin {cmd[0]!r} failed: "
+            f"{detail}") from exc
 
 
 class KubernetesKubeAPI:

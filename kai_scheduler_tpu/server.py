@@ -100,7 +100,11 @@ def _make_handler(server_state):
                     ctype = "application/json"
                 else:
                     # pprof collapsed-stack format (flamegraph-ready).
-                    top = int(q.get("top", 5000))
+                    try:
+                        top = int(q.get("top", 5000))
+                    except ValueError:
+                        self.send_error(400, "top must be an integer")
+                        return
                     body = prof.folded(top=top).encode()
                     ctype = "text/plain"
             else:

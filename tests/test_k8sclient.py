@@ -363,6 +363,51 @@ class TestKubeconfig:
         assert client.server == "https://1.2.3.4:6443"
         client.close()
 
+    def test_exec_credential_plugin(self, tmp_path):
+        """client-go exec-plugin auth: the configured command's
+        ExecCredential JSON supplies the bearer token."""
+        plugin = tmp_path / "get-token.py"
+        plugin.write_text(
+            "#!/usr/bin/env python3\n"
+            "import json, os\n"
+            "info = json.loads(os.environ['KUBERNETES_EXEC_INFO'])\n"
+            "assert info['kind'] == 'ExecCredential'\n"
+            "print(json.dumps({'kind': 'ExecCredential',\n"
+            "                  'apiVersion': info['apiVersion'],\n"
+            "                  'status': {'token': 'exec-token-'\n"
+            "                             + os.environ['CLUSTER']}}))\n")
+        plugin.chmod(0o755)
+        cfg = tmp_path / "kubeconfig"
+        cfg.write_text(json.dumps({
+            "current-context": "dev",
+            "contexts": [{"name": "dev",
+                          "context": {"cluster": "c", "user": "u"}}],
+            "clusters": [{"name": "c", "cluster": {
+                "server": "https://1.2.3.4:6443",
+                "insecure-skip-tls-verify": True}}],
+            "users": [{"name": "u", "user": {"exec": {
+                "apiVersion": "client.authentication.k8s.io/v1",
+                "command": str(plugin),
+                "env": [{"name": "CLUSTER", "value": "prod"}],
+            }}}],
+        }))
+        loaded = load_kubeconfig(str(cfg))
+        assert loaded["token"] == "exec-token-prod"
+
+    def test_exec_plugin_failure_is_loud(self, tmp_path):
+        cfg = tmp_path / "kubeconfig"
+        cfg.write_text(json.dumps({
+            "current-context": "dev",
+            "contexts": [{"name": "dev",
+                          "context": {"cluster": "c", "user": "u"}}],
+            "clusters": [{"name": "c", "cluster": {
+                "server": "https://x:6443"}}],
+            "users": [{"name": "u", "user": {"exec": {
+                "command": "/nonexistent-credential-plugin"}}}],
+        }))
+        with pytest.raises(RuntimeError, match="exec credential plugin"):
+            load_kubeconfig(str(cfg))
+
 
 class TestFleetOverK8sDialect:
     def test_pod_binds_through_k8s_rest(self, stub, client):
