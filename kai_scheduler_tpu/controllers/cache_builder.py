@@ -11,6 +11,7 @@ cache.Bind/Evictor for the embedded deployment.
 from __future__ import annotations
 
 import itertools
+import re
 
 from ..api import (ClusterInfo, NodeInfo, PodGroupInfo, PodInfo, PodSet,
                    PodStatus, QueueInfo, QueueQuota, resources as rs)
@@ -51,23 +52,114 @@ def _requests_to_reqreq(pod: dict) -> ResourceRequirements:
         gpu=gpu, gpu_fraction=fraction, gpu_memory=gpu_memory, mig=mig)
 
 
+# Conservative CEL subset for DeviceClass/request selectors (upstream
+# classes select devices ONLY via CEL, dynamicresources.go:59-87 /
+# k8s.io/dynamic-resource-allocation/cel).  Supported shapes:
+#   device.attributes["<domain>"].<name> == <literal>
+#   device.attributes["<domain>"].<name> in [<literals>]
+#   device.capacity["<domain>"].<name> >= quantity("<q>")
+#   device.capacity["<domain>"].<name>.compareTo(quantity("<q>")) >= 0
+#   device.driver == "<driver>"
+# AND-conjunctions (&&) of the above split into separate entries.
+# Anything else stays opaque and matches NOTHING — never too-wide.
+_CEL_ATTR_EQ = re.compile(
+    r'^device\.attributes\["(?P<domain>[^"]+)"\]\.(?P<name>\w+)\s*==\s*'
+    r'(?P<value>"[^"]*"|\d+(?:\.\d+)?|true|false)$')
+_CEL_ATTR_IN = re.compile(
+    r'^device\.attributes\["(?P<domain>[^"]+)"\]\.(?P<name>\w+)\s+in\s+'
+    r'\[(?P<values>[^\]]*)\]$')
+_CEL_CAP_GE = re.compile(
+    r'^device\.capacity\["(?P<domain>[^"]+)"\]\.(?P<name>\w+)'
+    r'(?:\.compareTo\(quantity\("(?P<q1>[^"]+)"\)\)\s*>=\s*0'
+    r'|\s*>=\s*quantity\("(?P<q2>[^"]+)"\))$')
+_CEL_DRIVER_EQ = re.compile(r'^device\.driver\s*==\s*"(?P<value>[^"]+)"$')
+
+
+def _cel_literal(text: str):
+    """Parse a CEL literal; raises ValueError on anything that is not a
+    plain string/bool/number literal (callers translate that into a
+    match-nothing selector — a non-literal must never crash the
+    snapshot)."""
+    text = text.strip()
+    if text.startswith('"') and text.endswith('"') and len(text) >= 2:
+        return text[1:-1]
+    if text in ("true", "false"):
+        return text == "true"
+    try:
+        return int(text)
+    except ValueError:
+        return float(text)  # ValueError propagates to the caller's guard
+
+
+def _parse_cel_expression(expr: str) -> list:
+    """One CEL expression -> structured entries, or a single opaque
+    match-nothing entry when any conjunct falls outside the subset."""
+    out = []
+    for part in expr.split("&&"):
+        part = part.strip()
+        # One level of surrounding parens (blind strip would eat
+        # quantity(...)'s closing paren).
+        if part.startswith("(") and part.endswith(")"):
+            part = part[1:-1].strip()
+        m = _CEL_ATTR_EQ.match(part)
+        if m:
+            out.append({"attribute": f"{m['domain']}/{m['name']}",
+                        "fallback_attribute": m["name"],
+                        "value": _cel_literal(m["value"])})
+            continue
+        m = _CEL_ATTR_IN.match(part)
+        if m:
+            try:
+                values = [_cel_literal(v)
+                          for v in m["values"].split(",") if v.strip()]
+            except ValueError:
+                # Non-literal list members (or quoted commas the naive
+                # split breaks): outside the subset, match nothing.
+                return [{"unsupported": True, "cel": expr}]
+            out.append({"attribute": f"{m['domain']}/{m['name']}",
+                        "fallback_attribute": m["name"],
+                        "any_of": values})
+            continue
+        m = _CEL_CAP_GE.match(part)
+        if m:
+            out.append({"capacity": f"{m['domain']}/{m['name']}",
+                        "fallback_capacity": m["name"],
+                        "min": rs.parse_quantity(m["q1"] or m["q2"])})
+            continue
+        m = _CEL_DRIVER_EQ.match(part)
+        if m:
+            out.append({"attribute": "driver",
+                        "value": m["value"]})
+            continue
+        return [{"unsupported": True, "cel": expr}]
+    return out
+
+
 def _parse_device_selectors(raw) -> list:
     """DeviceClass/request selectors -> structured entries.
 
     The structured dialect ({"attribute": k, "value": v} equality,
-    {"capacity": k, "min": quantity} minimums) is matched exactly; CEL
-    expressions (upstream DeviceClass spec.selectors[].cel,
-    dynamicresources.go:59-87) are kept opaque and match NOTHING — loud,
-    never too-wide."""
+    {"attribute": k, "any_of": [...]}, {"capacity": k, "min": quantity})
+    is matched exactly; CEL expressions translate through the
+    conservative subset above, and anything unparsed matches NOTHING —
+    loud, never too-wide."""
     out = []
     for sel in raw or []:
-        if "attribute" in sel and sel.get("value") is not None:
-            out.append({"attribute": sel["attribute"],
-                        "value": sel["value"]})
+        if "attribute" in sel and (sel.get("value") is not None
+                                   or sel.get("any_of")):
+            entry = {"attribute": sel["attribute"]}
+            if sel.get("any_of"):
+                entry["any_of"] = list(sel["any_of"])
+            else:
+                entry["value"] = sel["value"]
+            out.append(entry)
         elif "capacity" in sel:
             out.append({"capacity": sel["capacity"],
                         "min": rs.parse_quantity(sel.get("min"))})
-        else:  # CEL or unknown shape
+        elif "cel" in sel and isinstance(sel["cel"], dict) \
+                and sel["cel"].get("expression"):
+            out.extend(_parse_cel_expression(sel["cel"]["expression"]))
+        else:  # unknown shape
             out.append({"unsupported": True})
     return out
 
@@ -411,10 +503,15 @@ class ClusterCache:
             if not node:
                 continue
             per_node = resource_slices.setdefault(node, {})
+            driver = spec.get("driver")
             for dev in spec.get("devices") or []:
                 cls = dev.get("deviceClassName", "")
                 attrs = _parse_device_attributes(dev)
                 caps = _parse_device_capacity(dev)
+                if driver:
+                    # The slice's driver is addressable from CEL
+                    # (device.driver == "...").
+                    attrs.setdefault("driver", driver)
                 entry = ({"name": dev.get("name", ""),
                           "attributes": attrs, "capacity": caps}
                          if attrs or caps else dev.get("name", ""))

@@ -34,24 +34,50 @@ def _qty(value) -> float | None:
     return rs.parse_quantity(value)
 
 
+def _lookup(mapping: dict, key: str, fallback_key, driver=None) -> object:
+    """Qualified-key lookup with a domain-scoped bare-name fallback: CEL
+    addresses attributes as domain/name; flat inventories may key by
+    name alone, but the fallback only applies when the device's driver
+    matches the selector's domain (or records no driver at all) — a
+    bare "family" on an NVIDIA device must not satisfy an
+    attributes["gpu.amd.com"].family selector."""
+    if key in mapping:
+        return mapping[key]
+    if fallback_key is None:
+        return None
+    domain = key.split("/", 1)[0] if "/" in key else None
+    if driver is None or domain is None or driver == domain:
+        return mapping.get(fallback_key)
+    return None
+
+
 def _device_matches(dev, selectors: list) -> bool:
-    """Structured selector match: attribute equality + capacity minimums
-    (the non-CEL subset of upstream DeviceClass/request selectors).
-    Unsupported (CEL/unknown) entries match nothing."""
+    """Structured selector match: attribute equality/membership +
+    capacity minimums (incl. the translated CEL subset of upstream
+    DeviceClass/request selectors).  Unsupported entries match
+    nothing."""
     if not selectors:
         return True
     attrs = dev.get("attributes", {}) if isinstance(dev, dict) else {}
     caps = dev.get("capacity", {}) if isinstance(dev, dict) else {}
+    driver = attrs.get("driver")
     for sel in selectors:
         if "attribute" in sel:
+            have = _lookup(attrs, sel["attribute"],
+                           sel.get("fallback_attribute"), driver)
+            if "any_of" in sel:
+                if have is None or have not in sel["any_of"]:
+                    return False
+                continue
             want = sel.get("value")
             # A value-less selector is malformed: match nothing (a None
             # "want" would otherwise equal the None of attribute-less
             # devices and over-match).
-            if want is None or attrs.get(sel["attribute"]) != want:
+            if want is None or have != want:
                 return False
         elif "capacity" in sel:
-            have = _qty(caps.get(sel["capacity"]))
+            have = _qty(_lookup(caps, sel["capacity"],
+                                sel.get("fallback_capacity"), driver))
             want = _qty(sel.get("min"))
             if have is None or want is None or have < want:
                 return False

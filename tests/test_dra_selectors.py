@@ -147,6 +147,123 @@ class TestSelectors:
             {"name": "c", "node": "n1", "devices": ["d80"]}]
 
 
+class TestCELSubset:
+    """Upstream DeviceClasses select ONLY via CEL; the conservative
+    subset translates the stereotyped shapes and leaves the rest
+    match-nothing."""
+
+    def _parse(self, expr):
+        from kai_scheduler_tpu.controllers.cache_builder import \
+            _parse_device_selectors
+        return _parse_device_selectors([{"cel": {"expression": expr}}])
+
+    def test_attribute_equality(self):
+        sels = self._parse(
+            'device.attributes["gpu.nvidia.com"].family == "ampere"')
+        assert sels == [{"attribute": "gpu.nvidia.com/family",
+                         "fallback_attribute": "family",
+                         "value": "ampere"}]
+
+    def test_attribute_membership(self):
+        sels = self._parse(
+            'device.attributes["gpu.nvidia.com"].family in '
+            '["ampere", "hopper"]')
+        assert sels[0]["any_of"] == ["ampere", "hopper"]
+
+    def test_capacity_quantity_both_forms(self):
+        a = self._parse('device.capacity["gpu.nvidia.com"].memory '
+                        '>= quantity("40Gi")')
+        b = self._parse('device.capacity["gpu.nvidia.com"].memory'
+                        '.compareTo(quantity("40Gi")) >= 0')
+        assert a[0]["min"] == b[0]["min"] == float(40 * 2 ** 30)
+
+    def test_driver_equality_and_conjunction(self):
+        sels = self._parse(
+            'device.driver == "nvidia" && '
+            'device.attributes["gpu.nvidia.com"].mem == "80"')
+        assert sels[0] == {"attribute": "driver", "value": "nvidia"}
+        assert sels[1]["value"] == "80"
+
+    def test_unparsed_cel_matches_nothing(self):
+        sels = self._parse('device.attributes["x"].y.matches("^a.*")')
+        assert sels == [{"unsupported": True,
+                         "cel": 'device.attributes["x"].y'
+                                '.matches("^a.*")'}]
+        # One bad conjunct poisons the whole expression.
+        sels = self._parse('device.driver == "ok" && size(device.x) > 0')
+        assert sels[0].get("unsupported") is True
+
+    def test_cel_class_places_end_to_end(self):
+        """A CEL-only DeviceClass (the real-world shape) selects the
+        right device through claim fit and allocation."""
+        ssn = build_session({
+            "nodes": {"n1": {"gpu": 8}},
+            "queues": {"q": {}},
+            "resource_claims": {"c": {"device_class": "a80", "count": 1}},
+            "device_classes": {"a80": {"selectors": [
+                {"attribute": "gpu.nvidia.com/mem", "value": "80",
+                 "fallback_attribute": "mem"}]}},
+            "resource_slices": {"n1": {"pool": [
+                {"name": "d40", "attributes": {"gpu.nvidia.com/mem":
+                                               "40"}, "capacity": {}},
+                {"name": "d80", "attributes": {"gpu.nvidia.com/mem":
+                                               "80"}, "capacity": {}}]}},
+            "jobs": {"j": {"queue": "q", "tasks": [
+                {"cpu": "1", "resource_claims": ["c"]}]}},
+        })
+        run_action(ssn)
+        plugin = next(pl for pl in ssn.plugins
+                      if pl.name == "dynamicresources")
+        assert plugin.assumed["c"]["devices"] == ["d80"]
+
+    def test_non_literal_in_list_matches_nothing_not_crash(self):
+        """A non-literal 'in' member must fold to match-nothing, never
+        crash the snapshot build."""
+        sels = self._parse(
+            'device.attributes["x"].y in [device.z, "a"]')
+        assert sels[0].get("unsupported") is True
+
+    def test_bare_fallback_is_domain_scoped(self):
+        """A bare-name attribute on one vendor's device must not satisfy
+        another vendor's qualified selector."""
+        from kai_scheduler_tpu.plugins.dynamicresources import \
+            _device_matches
+
+        amd_sel = [{"attribute": "gpu.amd.com/family",
+                    "fallback_attribute": "family", "value": "x100"}]
+        nvidia_dev = {"name": "d", "capacity": {},
+                      "attributes": {"family": "x100",
+                                     "driver": "gpu.nvidia.com"}}
+        assert not _device_matches(nvidia_dev, amd_sel)
+        # Same device, matching domain: fallback applies.
+        nv_sel = [{"attribute": "gpu.nvidia.com/family",
+                   "fallback_attribute": "family", "value": "x100"}]
+        assert _device_matches(nvidia_dev, nv_sel)
+        # Driver-less flat dialect keeps the permissive fallback.
+        flat_dev = {"name": "d", "capacity": {},
+                    "attributes": {"family": "x100"}}
+        assert _device_matches(flat_dev, amd_sel)
+
+    def test_slice_driver_addressable(self):
+        from kai_scheduler_tpu.controllers.cache_builder import \
+            ClusterCache
+        from kai_scheduler_tpu.controllers.kubeapi import InMemoryKubeAPI
+
+        api = InMemoryKubeAPI()
+        api.create({"kind": "DeviceClass", "metadata": {"name": "nv"},
+                    "spec": {"selectors": [
+                        {"cel": {"expression":
+                                 'device.driver == "nvidia"'}}]}})
+        api.create({"kind": "ResourceSlice", "metadata": {"name": "s"},
+                    "spec": {"nodeName": "n1", "driver": "nvidia",
+                             "devices": [{"name": "d0"}]}})
+        ci = ClusterCache(api).snapshot()
+        dev = ci.resource_slices["n1"][""][0]
+        assert dev["attributes"]["driver"] == "nvidia"
+        assert ci.device_classes["nv"]["selectors"] == [
+            {"attribute": "driver", "value": "nvidia"}]
+
+
 class TestManifestParsing:
     def test_device_class_and_slice_attributes(self):
         from kai_scheduler_tpu.controllers.cache_builder import ClusterCache
@@ -173,9 +290,9 @@ class TestManifestParsing:
                              {"capacity": "memory", "min": "64Gi"}]}]}}})
         cache = ClusterCache(api)
         ci = cache.snapshot()
-        assert ci.device_classes["a80"]["selectors"] == [
-            {"attribute": "mem", "value": "80"},
-            {"unsupported": True}]
+        sels = ci.device_classes["a80"]["selectors"]
+        assert sels[0] == {"attribute": "mem", "value": "80"}
+        assert sels[1]["unsupported"] is True
         devices = ci.resource_slices["n1"][""]
         assert devices[0]["attributes"] == {"mem": "80"}
         assert devices[0]["capacity"] == {"memory": float(80 * 2 ** 30)}
