@@ -57,13 +57,37 @@ def build_cluster(spec: dict) -> ClusterInfo:
             queues[q.parent].children.append(name)
 
     podgroups = {}
+    _JOB_KEYS = {"queue", "min_available", "priority", "preemptible",
+                 "creation_ts", "topology", "required_topology_level",
+                 "preferred_topology_level", "pod_sets", "tasks",
+                 "last_start_ts", "staleness_grace_seconds"}
+    _TASK_KEYS = {"uid", "name", "subgroup", "status", "node", "selector",
+                  "tolerations", "cpu", "mem", "gpu", "gpu_fraction",
+                  "gpu_memory", "mig", "gpu_group", "nominated",
+                  "resource_claims", "affinity", "anti_affinity",
+                  "labels", "host_ports", "configmaps", "pvcs",
+                  "affinity_terms", "anti_affinity_terms",
+                  "preferred_affinity_terms",
+                  "preferred_anti_affinity_terms", "node_affinity",
+                  "node_affinity_preferred"}
     for name, j in spec.get("jobs", {}).items():
+        unknown = set(j) - _JOB_KEYS
+        if unknown:
+            # Loud, not silent: a constraint typo'd or placed at job
+            # level (e.g. node_affinity belongs on each task) would
+            # otherwise vanish and the test/simulation would assert
+            # against an unconstrained schedule.
+            raise ValueError(
+                f"job {name!r}: unknown spec keys {sorted(unknown)} "
+                f"(per-task constraints go inside 'tasks' entries)")
         pg = PodGroupInfo(
             name, name, queue_id=j.get("queue", "default"),
             priority=j.get("priority", 0),
             min_available=j.get("min_available", 1),
             preemptible=j.get("preemptible", True),
             creation_ts=j.get("creation_ts", 0.0),
+            staleness_grace_seconds=j.get("staleness_grace_seconds",
+                                          60.0),
             topology_name=j.get("topology"),
             required_topology_level=j.get("required_topology_level"),
             preferred_topology_level=j.get("preferred_topology_level"))
@@ -78,6 +102,11 @@ def build_cluster(spec: dict) -> ClusterInfo:
                            "preferred_topology_level"))
                 for ps in j["pod_sets"]])
         for i, t in enumerate(j.get("tasks", [])):
+            unknown = set(t) - _TASK_KEYS
+            if unknown:
+                raise ValueError(
+                    f"job {name!r} task {i}: unknown spec keys "
+                    f"{sorted(unknown)}")
             task = PodInfo(
                 uid=t.get("uid", f"{name}-{i}"),
                 name=t.get("name", f"{name}-{i}"),
