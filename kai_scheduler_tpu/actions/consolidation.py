@@ -11,8 +11,9 @@ from __future__ import annotations
 
 import numpy as np
 
+from ..api import resources as rs
 from ..api.podgroup_info import PodGroupInfo
-from .solvers import solve_job
+from .solvers import fractional_headroom, solve_job
 from .utils import INFINITE, JobsOrderByQueues
 
 
@@ -42,7 +43,12 @@ class ConsolidationAction:
                 continue
             # Relocation conserves total free resources: if the gang does
             # not fit the cluster's aggregate idle+releasing space, no
-            # amount of defragmentation can host it.
+            # amount of defragmentation can host it.  The dense mirrors
+            # count a partially-shared device as fully used, but
+            # relocating fractions CAN empty whole devices — so each
+            # sharing group's unused remainder is added back before the
+            # bound is applied (otherwise fractional defragmentation,
+            # consolidationFractional_test.go, is unreachable).
             tasks = job.tasks_to_allocate(
                 subgroup_order_fn=ssn.pod_set_order_key,
                 task_order_fn=ssn.task_order_key, real_allocation=False)
@@ -52,6 +58,7 @@ class ConsolidationAction:
                                 for t in tasks], axis=0) if tasks else None
             total_free = ssn.node_idle.sum(axis=0) \
                 + ssn.node_releasing.sum(axis=0)
+            total_free[rs.RES_GPU] += fractional_headroom(ssn)
             if total_req is None or np.any(total_req > total_free + 1e-9):
                 if ssn.config.use_scheduling_signatures:
                     failed_signatures.add(sig)

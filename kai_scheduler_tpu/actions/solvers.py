@@ -21,6 +21,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from ..api import resources as rs
 from ..api.podgroup_info import PodGroupInfo
 from ..utils.metrics import METRICS
 from ..ops.allocate_grouped import _next_pow2
@@ -94,6 +95,21 @@ class SolverResult:
     scenarios_tried: int = 0
 
 
+def fractional_headroom(ssn) -> float:
+    """Whole-GPU-axis capacity recoverable by repacking live sharing
+    groups: each group charges one whole backing device, so the sum of
+    unused fractions bounds how many devices perfect defragmentation
+    could empty.  Fully-releasing groups are skipped — their device
+    already counts in node_releasing (adding it again would double-count
+    one physical device)."""
+    headroom = 0.0
+    for node in ssn.cluster.nodes.values():
+        for g in node.gpu_sharing_groups.values():
+            if g.pods and not g.releasing:
+                headroom += max(0.0, 1.0 - g.used_fraction)
+    return headroom
+
+
 def solve_job(ssn, pending_job: PodGroupInfo,
               ordered_victims: list[PodGroupInfo],
               validate, action_name: str,
@@ -108,12 +124,17 @@ def solve_job(ssn, pending_job: PodGroupInfo,
         return SolverResult(False)
 
     # Cheap infeasibility precheck: even evicting every candidate victim
-    # cannot create more than (idle + releasing + victim resources); a
-    # pending job larger than that can never be solved — skip simulating.
+    # cannot create more than (idle + releasing + victim resources +
+    # repackable fraction headroom); a pending job larger than that can
+    # never be solved — skip simulating.  The headroom term matters
+    # because a fractional victim's request vector (0.4 GPU) understates
+    # what its relocation can free (the WHOLE backing device empties once
+    # the sharing group drains).
     ordered_victims = ordered_victims[:ssn.config.max_victims_considered]
     total_req = np.sum([t.res_req.to_vec(mig_as_gpu=False)
                         for t in tasks], axis=0)
     budget = ssn.node_idle.sum(axis=0) + ssn.node_releasing.sum(axis=0)
+    budget[rs.RES_GPU] += fractional_headroom(ssn)
     for vjob in ordered_victims:
         for t in vjob.pods.values():
             if t.is_active_allocated():

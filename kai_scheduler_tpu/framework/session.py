@@ -736,7 +736,16 @@ class Session:
         score = score_matrix(
             alloc, idle, jnp.asarray(req), fit_now, fit_future,
             gpu_strategy=self.gpu_strategy, cpu_strategy=self.cpu_strategy)
-        return np.asarray(score[0])
+        out = np.asarray(score[0]).copy()
+        # Plugin score terms apply to host-side paths too: without them a
+        # nominated (pipelined-last-cycle) fractional task loses its
+        # sticky node and flaps between devices across cycles; preferred
+        # node affinity would likewise be ignored.
+        for fn in self.extra_score_fns:
+            contrib = fn([task])
+            if contrib is not None:
+                out += np.asarray(contrib)[0]
+        return out
 
     def node_index(self, name: str) -> int:
         return self._node_index.get(name, -1)

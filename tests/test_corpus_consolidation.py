@@ -141,6 +141,86 @@ CASES = [
 ]
 
 
-@pytest.mark.parametrize("case", CASES, ids=lambda c: c["name"])
+FRACTIONAL_CASES = [
+    {
+        # Two half-GPU pods on separate devices of a 1-GPU-per-node pair
+        # block a whole-GPU job; consolidating them onto ONE shared
+        # device frees the other (consolidationFractional_test.go).
+        "name": "fractions-consolidate-onto-shared-device",
+        "nodes": {"node0": {"gpus": 1}, "node1": {"gpus": 1}},
+        "queues": [{"name": "queue0", "deserved_gpus": 2}],
+        "jobs": [
+            {"name": "half0", "queue": "queue0", "gpu_fraction": 0.5,
+             "priority": PRIORITY_TRAIN,
+             "tasks": [{"state": "Running", "node": "node0",
+                        "gpu_group": "g0"}]},
+            {"name": "half1", "queue": "queue0", "gpu_fraction": 0.5,
+             "priority": PRIORITY_TRAIN,
+             "tasks": [{"state": "Running", "node": "node1",
+                        "gpu_group": "g1"}]},
+            {"name": "whole", "queue": "queue0", "gpus_per_task": 1,
+             "priority": PRIORITY_TRAIN, "tasks": [{}]},
+        ],
+        "expected": {
+            "half0": {"status": "Running", "dont_validate_node": True},
+            "half1": {"status": "Running", "dont_validate_node": True},
+            "whole": {"status": "Running", "dont_validate_node": True},
+        },
+        "rounds_until_match": 4,
+    },
+    {
+        # Unequal fractions (0.5 + 0.4) whose request vectors sum BELOW
+        # the whole-GPU request: the solver's budget must count the
+        # repackable device headroom, not just the victims' vectors, or
+        # this never even simulates.
+        "name": "unequal-fractions-still-consolidate",
+        "nodes": {"node0": {"gpus": 1}, "node1": {"gpus": 1}},
+        "queues": [{"name": "queue0", "deserved_gpus": 2}],
+        "jobs": [
+            {"name": "half", "queue": "queue0", "gpu_fraction": 0.5,
+             "priority": PRIORITY_TRAIN,
+             "tasks": [{"state": "Running", "node": "node0",
+                        "gpu_group": "g0"}]},
+            {"name": "smaller", "queue": "queue0", "gpu_fraction": 0.4,
+             "priority": PRIORITY_TRAIN,
+             "tasks": [{"state": "Running", "node": "node1",
+                        "gpu_group": "g1"}]},
+            {"name": "whole", "queue": "queue0", "gpus_per_task": 1,
+             "priority": PRIORITY_TRAIN, "tasks": [{}]},
+        ],
+        "expected": {
+            "half": {"status": "Running", "dont_validate_node": True},
+            "smaller": {"status": "Running", "dont_validate_node": True},
+            "whole": {"status": "Running", "dont_validate_node": True},
+        },
+        "rounds_until_match": 4,
+    },
+    {
+        # A fraction joins an existing shared device instead of opening
+        # a new one when the whole-GPU job needs the clean device.
+        "name": "fraction-joins-existing-group",
+        "nodes": {"node0": {"gpus": 2}},
+        "queues": [{"name": "queue0", "deserved_gpus": 2}],
+        "jobs": [
+            {"name": "resident", "queue": "queue0", "gpu_fraction": 0.4,
+             "priority": PRIORITY_TRAIN,
+             "tasks": [{"state": "Running", "node": "node0",
+                        "gpu_group": "g0"}]},
+            {"name": "incoming", "queue": "queue0", "gpu_fraction": 0.4,
+             "priority": PRIORITY_TRAIN, "tasks": [{}]},
+            {"name": "whole", "queue": "queue0", "gpus_per_task": 1,
+             "priority": PRIORITY_TRAIN, "tasks": [{}]},
+        ],
+        "expected": {
+            "incoming": {"status": "Running", "node": "node0"},
+            "whole": {"status": "Running", "node": "node0"},
+        },
+        "rounds_until_match": 2,
+    },
+]
+
+
+@pytest.mark.parametrize("case", CASES + FRACTIONAL_CASES,
+                         ids=lambda c: c["name"])
 def test_consolidation_corpus(case):
     run_case(case)
