@@ -97,16 +97,23 @@ class SolverResult:
 
 def fractional_headroom(ssn) -> float:
     """Whole-GPU-axis capacity recoverable by repacking live sharing
-    groups: each group charges one whole backing device, so the sum of
-    unused fractions bounds how many devices perfect defragmentation
-    could empty.  Fully-releasing groups are skipped — their device
-    already counts in node_releasing (adding it again would double-count
-    one physical device)."""
+    groups: each group charges one whole backing device, so the device
+    capacity not pinned by ACTIVE members bounds how many devices
+    perfect defragmentation could empty.  active_fraction() (not
+    used_fraction) so a mixed group's releasing members — whose space
+    frees on its own — still count toward the bound; fully-releasing
+    groups are skipped since their device already counts in
+    node_releasing.  Memoized on the session mutation tick: the bound
+    feeds prechecks that run per pending job per cycle."""
+    cached = getattr(ssn, "_frac_headroom_cache", None)
+    if cached is not None and cached[0] == ssn.mutation_count:
+        return cached[1]
     headroom = 0.0
     for node in ssn.cluster.nodes.values():
         for g in node.gpu_sharing_groups.values():
             if g.pods and not g.releasing:
-                headroom += max(0.0, 1.0 - g.used_fraction)
+                headroom += max(0.0, 1.0 - g.active_fraction())
+    ssn._frac_headroom_cache = (ssn.mutation_count, headroom)
     return headroom
 
 

@@ -163,6 +163,11 @@ def _to_spec(case: dict, feedback: dict) -> dict:
             if j.get("gpu_fraction"):
                 task["gpu_fraction"] = j["gpu_fraction"]
                 task["gpu"] = 0
+            if j.get("gpu_memory"):
+                # Memory-based fraction (resolved against the node's
+                # per-device memory at schedule time).
+                task["gpu_memory"] = j["gpu_memory"]
+                task["gpu"] = 0
             if fb and fb.get("gpu_group"):
                 task["gpu_group"] = fb["gpu_group"]
             elif not fb and t.get("gpu_group"):

@@ -196,6 +196,34 @@ FRACTIONAL_CASES = [
         "rounds_until_match": 4,
     },
     {
+        # GPU-MEMORY-based fractions (8Gi each on 16Gi devices = 0.5)
+        # consolidate exactly like ratio fractions
+        # (consolidationFractional_test.go "consolidate job that
+        # requested memory and insert another job that required memory").
+        "name": "memory-fractions-consolidate",
+        "nodes": {"node0": {"gpus": 1, "gpu_memory_mb": 16384},
+                  "node1": {"gpus": 1, "gpu_memory_mb": 16384}},
+        "queues": [{"name": "queue0", "deserved_gpus": 2}],
+        "jobs": [
+            {"name": "mem0", "queue": "queue0", "gpu_memory": "8Gi",
+             "priority": PRIORITY_TRAIN,
+             "tasks": [{"state": "Running", "node": "node0",
+                        "gpu_group": "g0"}]},
+            {"name": "mem1", "queue": "queue0", "gpu_memory": "8Gi",
+             "priority": PRIORITY_TRAIN,
+             "tasks": [{"state": "Running", "node": "node1",
+                        "gpu_group": "g1"}]},
+            {"name": "whole", "queue": "queue0", "gpus_per_task": 1,
+             "priority": PRIORITY_TRAIN, "tasks": [{}]},
+        ],
+        "expected": {
+            "mem0": {"status": "Running", "dont_validate_node": True},
+            "mem1": {"status": "Running", "dont_validate_node": True},
+            "whole": {"status": "Running", "dont_validate_node": True},
+        },
+        "rounds_until_match": 4,
+    },
+    {
         # A fraction joins an existing shared device instead of opening
         # a new one when the whole-GPU job needs the clean device.
         "name": "fraction-joins-existing-group",
