@@ -182,7 +182,8 @@ def _to_spec(case: dict, feedback: dict) -> dict:
             for key in ("selector", "tolerations", "node_affinity",
                         "node_affinity_preferred", "labels",
                         "affinity_terms", "anti_affinity_terms",
-                        "preferred_affinity_terms", "resource_claims"):
+                        "preferred_affinity_terms", "resource_claims",
+                        "subgroup"):
                 if key in t:
                     task[key] = _copy.deepcopy(t[key])
                 elif key in j:

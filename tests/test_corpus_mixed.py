@@ -295,6 +295,79 @@ CASES = [
 ]
 
 
-@pytest.mark.parametrize("case", CASES, ids=lambda c: c["name"])
+HIERARCHICAL_CASES = [
+    {
+        # Grove-style hierarchical gang: two podsets with their OWN
+        # required rack constraints place independently (prefill fills
+        # one rack, decode fits the other), all-or-nothing as one gang
+        # (allocateSubGroupSet recursion, actions/common/allocate.go:38).
+        "name": "podsets-own-topology-split-racks",
+        "nodes": rack_nodes(racks=2, per_rack=2, gpus=2),
+        "queues": [{"name": "q0", "deserved_gpus": 8}],
+        "topologies": TOPO,
+        "jobs": [
+            {"name": "serve", "queue": "q0", "gpus_per_task": 2,
+             "priority": PRIORITY_TRAIN, "min_available": 3,
+             "pod_sets": [
+                 {"name": "prefill", "min_available": 2,
+                  "topology": "dc", "required_topology_level": "rack"},
+                 {"name": "decode", "min_available": 1,
+                  "topology": "dc", "required_topology_level": "rack"},
+             ],
+             "tasks": [{"subgroup": "prefill"}, {"subgroup": "prefill"},
+                       {"subgroup": "decode"}]},
+        ],
+        "expected": {"serve": {"status": "Running"}},
+        "rounds_until_match": 1,
+    },
+    {
+        # One podset's constraint is unsatisfiable (rack too small for
+        # it): the WHOLE hierarchical gang stays pending — no partial
+        # podset placement survives.
+        "name": "podsets-atomic-failure",
+        "nodes": rack_nodes(racks=2, per_rack=2, gpus=2),
+        "queues": [{"name": "q0", "deserved_gpus": 8}],
+        "topologies": TOPO,
+        "jobs": [
+            {"name": "serve", "queue": "q0", "gpus_per_task": 2,
+             "priority": PRIORITY_TRAIN, "min_available": 4,
+             "pod_sets": [
+                 {"name": "prefill", "min_available": 3,  # 6 GPU > rack
+                  "topology": "dc", "required_topology_level": "rack"},
+                 {"name": "decode", "min_available": 1,
+                  "topology": "dc", "required_topology_level": "rack"},
+             ],
+             "tasks": [{"subgroup": "prefill"}, {"subgroup": "prefill"},
+                       {"subgroup": "prefill"},
+                       {"subgroup": "decode"}]},
+        ],
+        "expected": {"serve": {"status": "Pending"}},
+        "rounds_until_match": 1,
+    },
+]
+
+
+def _rack_of(case, ssn, uid):
+    node = next(t.node_name for pg in ssn.cluster.podgroups.values()
+                for t in pg.pods.values() if t.uid == uid)
+    return case["nodes"][node]["labels"]["rack"]
+
+
+@pytest.mark.parametrize("case", CASES + HIERARCHICAL_CASES,
+                         ids=lambda c: c["name"])
 def test_mixed_corpus(case):
     run_case(case)
+
+
+def test_podsets_rack_locality_detail():
+    """Beyond job-level Running: each podset of the split-rack case sits
+    entirely inside ONE rack."""
+    from tests.corpus import _run_round
+
+    case = HIERARCHICAL_CASES[0]
+    ssn = _run_round(case, {})
+    prefill_racks = {_rack_of(case, ssn, f"serve-{i}") for i in (0, 1)}
+    decode_rack = _rack_of(case, ssn, "serve-2")
+    assert len(prefill_racks) == 1
+    # Prefill consumed its whole rack (4 GPUs): decode must be elsewhere.
+    assert decode_rack not in prefill_racks
