@@ -348,9 +348,10 @@ HIERARCHICAL_CASES = [
 
 
 def _rack_of(case, ssn, uid):
-    node = next(t.node_name for pg in ssn.cluster.podgroups.values()
-                for t in pg.pods.values() if t.uid == uid)
-    return case["nodes"][node]["labels"]["rack"]
+    job = uid.rsplit("-", 1)[0]
+    task = ssn.cluster.podgroups[job].pods[uid]
+    assert task.node_name, f"{uid} not placed"
+    return case["nodes"][task.node_name]["labels"]["rack"]
 
 
 @pytest.mark.parametrize("case", CASES + HIERARCHICAL_CASES,
