@@ -10,6 +10,55 @@ from tests.corpus import (PRIORITY_BUILD, PRIORITY_TRAIN, run_case)
 
 CASES = [
     {
+        # Elastic shrink instead of kill: the preemptor needs 2 GPUs;
+        # the elastic train victim (min 1, three 1-GPU pods) gives up
+        # two pods and keeps running at its gang minimum
+        # (docs/elastic/ semantics; ScenarioBuilder splits elastic
+        # surplus from the gang core).
+        "name": "preempt-shrinks-elastic-victim",
+        "nodes": {"node0": {"gpus": 3}},
+        "queues": [{"name": "queue0", "deserved_gpus": 3}],
+        "jobs": [
+            {"name": "elastic", "queue": "queue0", "gpus_per_task": 1,
+             "priority": PRIORITY_TRAIN, "min_available": 1,
+             "tasks": [{"state": "Running", "node": "node0"},
+                       {"state": "Running", "node": "node0"},
+                       {"state": "Running", "node": "node0"}]},
+            {"name": "vip", "queue": "queue0", "gpus_per_task": 2,
+             "priority": PRIORITY_BUILD, "preemptible": False,
+             "tasks": [{}]},
+        ],
+        # The shrunk victim is part-Running part-Pending — outside the
+        # all-tasks matcher's vocabulary; the precise shrink is asserted
+        # by test_elastic_shrink_detail below.
+        "expected": {
+            "vip": {"status": "Running", "node": "node0"},
+        },
+        "rounds_until_match": 3,
+    },
+    {
+        # The non-elastic twin: a rigid 3-pod gang (min 3) cannot
+        # shrink, so satisfying the preemptor kills the whole gang.
+        "name": "preempt-rigid-gang-evicted-whole",
+        "nodes": {"node0": {"gpus": 3}},
+        "queues": [{"name": "queue0", "deserved_gpus": 3}],
+        "jobs": [
+            {"name": "rigid", "queue": "queue0", "gpus_per_task": 1,
+             "priority": PRIORITY_TRAIN, "min_available": 3,
+             "tasks": [{"state": "Running", "node": "node0"},
+                       {"state": "Running", "node": "node0"},
+                       {"state": "Running", "node": "node0"}]},
+            {"name": "vip", "queue": "queue0", "gpus_per_task": 2,
+             "priority": PRIORITY_BUILD, "preemptible": False,
+             "tasks": [{}]},
+        ],
+        "expected": {
+            "vip": {"status": "Running", "node": "node0"},
+            "rigid": {"status": "Pending"},
+        },
+        "rounds_until_match": 3,
+    },
+    {
         # preempt_test.go:26 — two fractional jobs share GPU 0; the
         # whole-GPU train job is the single victim for the build job
         # (don't evict two when one is enough).
@@ -229,3 +278,28 @@ CASES = [
     ids=[c["name"] for c in CASES])
 def test_preempt_corpus(case):
     run_case(case)
+
+
+def test_elastic_shrink_detail():
+    """The elastic victim loses EXACTLY its surplus — one pod keeps
+    running (the gang minimum), two go pending — and HOLDS that shape
+    across stability rounds (no post-convergence thrash).  Round counts
+    and config come from the case dict so this never drifts from the
+    corpus run of the same name."""
+    from kai_scheduler_tpu.framework import SchedulerConfig
+    from tests.corpus import _run_round
+
+    case = next(c for c in CASES
+                if c["name"] == "preempt-shrinks-elastic-victim")
+    config = SchedulerConfig(**case.get("config", {}))
+    feedback = {}
+    for _ in range(case["rounds_until_match"]):
+        ssn = _run_round(case, feedback, config)
+    for _ in range(1 + case.get("rounds_after_match", 5)):
+        statuses = sorted(
+            t.status.name
+            for t in ssn.cluster.podgroups["elastic"].pods.values())
+        assert statuses == ["PENDING", "PENDING", "RUNNING"], statuses
+        vip = ssn.cluster.podgroups["vip"].pods["vip-0"]
+        assert vip.status.name == "RUNNING" and vip.node_name == "node0"
+        ssn = _run_round(case, feedback, config)
