@@ -1,10 +1,19 @@
 """Test fixtures: re-export the package's declarative cluster builder
 (kai_scheduler_tpu.utils.cluster_spec) for the test suite."""
 
+import socket
+
 from kai_scheduler_tpu.utils.cluster_spec import (assert_placements,
                                                   build_cluster,
                                                   build_session, placements,
                                                   run_action)
 
 __all__ = ["assert_placements", "build_cluster", "build_session",
-           "placements", "run_action"]
+           "free_port", "placements", "run_action"]
+
+
+def free_port() -> int:
+    """Ephemeral local port for test servers."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]

@@ -59,10 +59,7 @@ pytestmark = pytest.mark.skipif(
            "install envtest assets via setup-envtest)")
 
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+from tests.fixtures import free_port as _free_port  # noqa: E402
 
 
 @pytest.fixture(scope="module")

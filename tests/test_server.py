@@ -12,49 +12,51 @@ from kai_scheduler_tpu.server import LeaderElector
 
 def test_daemon_cli_smoke(tmp_path):
     """The daemon binary end-to-end: bounded cycles over the embedded
-    API with the profiler on, every HTTP surface answering (the
-    cmd/scheduler/app/server.go RunApp smoke)."""
-    import socket
+    API with the profiler on, every HTTP surface serving REAL content
+    (the cmd/scheduler/app/server.go RunApp smoke)."""
+    from tests.fixtures import free_port
 
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
+    port = free_port()
     proc = subprocess.Popen(
         [sys.executable, "-m", "kai_scheduler_tpu.server",
          "--http-port", str(port), "--cycles", "400",
          "--schedule-period", "0.05", "--enable-profiler",
          "--lock-file", str(tmp_path / "lease.lock")],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+
+    def get(path, timeout=5):
+        return urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=timeout).read()
+
     try:
+        # The HTTP server comes up before the first cycle completes and
+        # the latency histogram registers lazily at cycle end: poll for
+        # the histogram, which also guarantees >=1 full cycle ran before
+        # the content assertions below.
         deadline = time.monotonic() + 60
-        up = False
+        cycled = False
         while time.monotonic() < deadline:
             if proc.poll() is not None:
                 raise AssertionError(
                     f"daemon died rc={proc.returncode}: "
                     f"{proc.stdout.read()[-2000:]}")
             try:
-                with urllib.request.urlopen(
-                        f"http://127.0.0.1:{port}/healthz",
-                        timeout=2) as r:
-                    up = r.status == 200
+                metrics = get("/metrics").decode()
+                if "e2e_scheduling_latency_milliseconds" in metrics:
+                    cycled = True
                     break
             except OSError:
-                time.sleep(0.2)
-        assert up, "daemon never served /healthz"
-        metrics = urllib.request.urlopen(
-            f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
-        assert "e2e_scheduling_latency_milliseconds" in metrics
-        snap = json.loads(urllib.request.urlopen(
-            f"http://127.0.0.1:{port}/get-snapshot", timeout=5).read())
-        assert isinstance(snap, dict)
-        order = json.loads(urllib.request.urlopen(
-            f"http://127.0.0.1:{port}/job-order", timeout=5).read())
-        assert isinstance(order, dict)
-        prof = json.loads(urllib.request.urlopen(
-            f"http://127.0.0.1:{port}/debug/profile?summary=1",
-            timeout=5).read())
-        assert prof["total_samples"] >= 0
+                pass
+            time.sleep(0.2)
+        assert cycled, "daemon never completed a scheduling cycle"
+        assert get("/healthz") == b"ok"
+        snap = json.loads(get("/get-snapshot"))
+        assert snap.get("config", {}).get("actions"), snap.keys()
+        assert "nodes" in snap
+        order = json.loads(get("/job-order"))
+        assert "order" in order
+        prof = json.loads(get("/debug/profile?summary=1"))
+        assert prof["total_samples"] > 0
     finally:
         proc.terminate()
         try:
