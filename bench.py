@@ -42,10 +42,11 @@ import os
 import signal
 import subprocess
 import sys
-import threading
 import time
 
 import numpy as np
+
+from kai_scheduler_tpu.utils.deviceguard import Watchdog
 
 N_NODES = 1024
 N_JOBS = 512
@@ -186,9 +187,23 @@ def _emit(result):
 
 def main():
     """Measurement child.  Emits after EVERY phase; each phase runs under
-    its own alarm slice so one hung phase cannot erase the others."""
+    its own alarm slice so one hung phase cannot erase the others.
+
+    Every device dispatch routes through the device guard
+    (utils/deviceguard.py): a hung/erroring device trips the breaker and
+    the phase degrades to the guard's CPU fallback instead of burning the
+    child's whole budget — under ``KAI_FAULT_INJECT=hang`` the primary
+    number still lands, annotated ``@guard-degraded``.
+
+    ``BENCH_SMOKE=1`` shrinks the primary config and skips later phases:
+    the chaos ring's fault-injection smoke needs the degradation path,
+    not the full measurement."""
+    global N_NODES, N_JOBS
     budget = _env_float("BENCH_RUN_BUDGET_S", TPU_CHILD_BUDGET_S,
                         10.0, 86400.0)
+    smoke = os.environ.get("BENCH_SMOKE") == "1"
+    if smoke:
+        N_NODES, N_JOBS = 64, 16
 
     def remaining():
         return budget - (time.monotonic() - _T0)
@@ -221,11 +236,18 @@ def main():
     on_tpu = backend == "tpu"
     _log(f"backend={backend} init={init_s:.1f}s")
 
+    from kai_scheduler_tpu.utils.deviceguard import device_guard
+    guard = device_guard()
+    if guard.injector.active:
+        _log(f"fault injection active: {guard.injector.spec}")
+
     # --- phase 1: primary config (always first, always emitted) -----------
-    rtt_ms = measure_rtt()
+    rtt_ms = guard.call(measure_rtt, label="bench_rtt")
     _log(f"rtt={rtt_ms:.1f}ms; compiling primary")
 
-    args = build_arrays()
+    # Explicit sizes: smoke mode mutates the globals, which the def-time
+    # defaults of build_arrays would ignore.
+    args = build_arrays(N_NODES, N_JOBS)
     q_des = jnp.full((N_QUEUES, 3), -1.0)
     q_lim = jnp.full((N_QUEUES, 3), -1.0)
     q_w = jnp.ones((N_QUEUES, 3))
@@ -242,19 +264,41 @@ def main():
             q_des, q_lim, q_w, q_req, q_use, q_tie, 1.0)
         return allocate_jobs_kernel(*args)
 
+    n_tasks = N_JOBS * TASKS_PER_JOB
+
+    def _shape_ok(r):
+        # badshape-class corruption must read as a device failure.
+        return getattr(r.placements, "shape", (0,))[0] >= n_tasks
+
+    # The FIRST dispatch legitimately pays the primary XLA compile —
+    # minutes on the tunneled TPU (PHASE1_BUDGET_S exists for exactly
+    # that), which the guard's 30s default deadline must not read as a
+    # hang.  Widen it to the phase scale unless the operator pinned a
+    # deadline explicitly or injection is active (a chaos run has no
+    # real compile to protect and wants fast degradation).
+    first_deadline = guard.deadline_s
+    if not guard.injector.active \
+            and "KAI_DEVICE_DEADLINE_S" not in os.environ:
+        first_deadline = max(guard.deadline_s,
+                             min(PHASE1_BUDGET_S, remaining()))
     t_c = time.perf_counter()
-    first = cycle()
+    first = guard.call(cycle, label="bench_primary", validate=_shape_ok,
+                       deadline_s=first_deadline)
     placements_np = np.asarray(first.placements)  # warm fetch
     compile_s = time.perf_counter() - t_c
     placed = int((placements_np >= 0).sum())
     _log(f"primary compiled+ran in {compile_s:.1f}s; measuring")
+    fb_before = guard.fallback_calls
     times = []
     for _ in range(10):
         t_it = time.perf_counter()
-        np.asarray(cycle().placements)  # one real device->host fetch
+        # Guarded like the daemon's dispatches: with the breaker open the
+        # iteration runs the CPU fallback directly instead of re-paying
+        # the watchdog deadline on a dead device.
+        np.asarray(guard.call(cycle, label="bench_primary",
+                              validate=_shape_ok).placements)
         times.append((time.perf_counter() - t_it) * 1000.0)
     median = float(np.median(times))
-    n_tasks = N_JOBS * TASKS_PER_JOB
     signal.alarm(0)
 
     result = {
@@ -276,7 +320,23 @@ def main():
             "backend_init_s": round(init_s, 1),
         },
     }
+    if guard.injector.active or guard.degraded or guard.fallback_calls:
+        result["detail"]["device_guard"] = guard.status()
+    # Annotate on ANY fallback iteration, not just a breaker left open at
+    # emit time: intermittent failures mix CPU-fallback latencies into
+    # the median even when trailing successes re-close the breaker.
+    if guard.degraded or guard.fallback_calls > fb_before:
+        # A number measured behind an open breaker is a CPU-fallback
+        # number; it must never be read as a device regression (same
+        # contract as the orchestrator's @cpu-fallback annotation).
+        result["metric"] += "@guard-degraded"
+        result["vs_baseline"] = None
+        result["detail"]["backend_note"] = \
+            "device-guard degraded to CPU fallback"
     _emit(result)
+    if smoke:
+        _log("smoke mode: stopping after primary phase")
+        return
 
     # Parity artifact: the orchestrator recomputes these placements on a
     # CPU x64 child (u64 score keys) and asserts agreement — the TPU
@@ -569,19 +629,21 @@ def _stream_child(env, budget_s, annotate=None, first_result_s=None):
         except OSError:
             pass
 
-    timer = threading.Timer(max(1.0, budget_s), expire, ("budget",))
-    timer.daemon = True
-    timer.start()
+    # Both deadlines ride the device-guard's Watchdog primitive — the
+    # same one that bounds every in-cycle kernel dispatch
+    # (utils/deviceguard.py), so the bench and the scheduler share one
+    # deadline mechanism instead of ad-hoc timers.
+    timer = Watchdog(max(1.0, budget_s), lambda: expire("budget"),
+                     reason="bench-child-budget").start()
     first_timer = None
     if first_result_s is not None:
         def expire_if_no_result():
             if last is None:
                 expire("first-result")
 
-        first_timer = threading.Timer(max(1.0, first_result_s),
-                                      expire_if_no_result)
-        first_timer.daemon = True
-        first_timer.start()
+        first_timer = Watchdog(max(1.0, first_result_s),
+                               expire_if_no_result,
+                               reason="bench-first-result").start()
     noise = []
     try:
         for line in p.stdout:
@@ -733,6 +795,20 @@ def orchestrate():
 
 
 if __name__ == "__main__":
+    # --fault-inject=SPEC: deterministic chaos for the delivery path
+    # itself (tests/test_device_guard.py smoke).  Exported as
+    # KAI_FAULT_INJECT so both this process's guard and any spawned
+    # measurement children inherit it.
+    for _i, _arg in enumerate(sys.argv[1:], start=1):
+        if _arg == "--fault-inject":
+            # Space-separated form ("--fault-inject slow:100"): the spec
+            # is the next argv element, not a default of hang.
+            _next = sys.argv[_i + 1] if _i + 1 < len(sys.argv) else ""
+            os.environ["KAI_FAULT_INJECT"] = \
+                _next if _next and not _next.startswith("--") else "hang"
+        elif _arg.startswith("--fault-inject="):
+            os.environ["KAI_FAULT_INJECT"] = \
+                _arg.partition("=")[2] or "hang"
     if "--run" in sys.argv:
         main()
     elif "--parity" in sys.argv:

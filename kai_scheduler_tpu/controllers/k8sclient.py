@@ -14,6 +14,9 @@ Auth: bearer token (in-cluster serviceaccount file or explicit), TLS CA
 (or insecure skip for dev clusters).  The kubeconfig loader covers
 static-token users and client-go exec credential plugins (token-minting
 commands); cert-based exec credentials are unsupported and fail loudly.
+Exec-plugin tokens refresh on expiry: a 401 re-runs the credential
+plugin once and retries the request with the fresh token (client-go's
+exec auth provider does the same on Unauthorized).
 """
 
 from __future__ import annotations
@@ -78,8 +81,9 @@ def load_kubeconfig(path: str) -> dict:
     Supports static ``token`` users and client-go credential ("exec")
     plugins: the configured command runs once and its ExecCredential
     JSON supplies ``status.token`` (client-go's
-    client-go/plugin/pkg/client/auth/exec contract; token refresh on
-    expiry is the caller's concern — re-invoke from_kubeconfig)."""
+    client-go/plugin/pkg/client/auth/exec contract).  The exec spec is
+    returned too so the client can re-run the plugin when the token
+    expires (401)."""
     import yaml
 
     cfg = yaml.safe_load(open(path))
@@ -97,7 +101,8 @@ def load_kubeconfig(path: str) -> dict:
     return {"server": cluster["server"],
             "insecure": bool(cluster.get("insecure-skip-tls-verify")),
             "ca_file": cluster.get("certificate-authority"),
-            "token": token}
+            "token": token,
+            "exec": exec_spec}
 
 
 def _exec_credential_token(exec_spec: dict) -> str | None:
@@ -145,9 +150,11 @@ class KubernetesKubeAPI:
 
     def __init__(self, server: str, token: str | None = None,
                  ca_file: str | None = None, insecure: bool = False,
-                 timeout: float = 15.0):
+                 timeout: float = 15.0, exec_spec: dict | None = None):
         self.server = server.rstrip("/")
         self.token = token
+        self.exec_spec = exec_spec  # re-run on 401 to refresh the token
+        self._refresh_lock = threading.Lock()
         self.timeout = timeout
         if insecure:
             self._ssl = ssl._create_unverified_context()
@@ -172,7 +179,8 @@ class KubernetesKubeAPI:
         cfg = load_kubeconfig(path)
         return cls(cfg["server"], token=cfg.get("token"),
                    ca_file=cfg.get("ca_file"),
-                   insecure=cfg.get("insecure", False))
+                   insecure=cfg.get("insecure", False),
+                   exec_spec=cfg.get("exec"))
 
     # -- plumbing ----------------------------------------------------------
     def _path(self, kind: str, namespace: str | None = None,
@@ -186,14 +194,32 @@ class KubernetesKubeAPI:
             parts.append(name)
         return "/".join(parts)
 
+    def _refresh_exec_token(self, stale: str | None) -> bool:
+        """Re-run the exec credential plugin after a 401 (expired token).
+        Returns True when a DIFFERENT token is now installed — either by
+        this call or by a concurrent one that won the lock first (watch
+        threads and the cycle can 401 together; one plugin run serves
+        all)."""
+        if self.exec_spec is None:
+            return False
+        with self._refresh_lock:
+            if self.token != stale:  # another caller already refreshed
+                return True
+            fresh = _exec_credential_token(self.exec_spec)
+            if not fresh or fresh == stale:
+                return False
+            self.token = fresh
+            return True
+
     def _request(self, method: str, url: str, body: dict | None = None,
                  content_type: str = "application/json",
-                 timeout: float | None = None):
+                 timeout: float | None = None, _retry_auth: bool = True):
         data = json.dumps(body).encode() if body is not None else None
         headers = {"Content-Type": content_type,
                    "Accept": "application/json"}
-        if self.token:
-            headers["Authorization"] = f"Bearer {self.token}"
+        token = self.token
+        if token:
+            headers["Authorization"] = f"Bearer {token}"
         req = urllib.request.Request(url, data=data, method=method,
                                      headers=headers)
         try:
@@ -209,6 +235,12 @@ class KubernetesKubeAPI:
                 raise NotFound(detail or url) from None
             if e.code == 409:
                 raise Conflict(detail or url) from None
+            if e.code == 401 and _retry_auth \
+                    and self._refresh_exec_token(token):
+                # Expired exec-plugin token: one refresh, one retry.  A
+                # second 401 propagates — the credential itself is bad.
+                return self._request(method, url, body, content_type,
+                                     timeout, _retry_auth=False)
             raise
 
     def _json(self, method: str, url: str, body: dict | None = None,

@@ -77,6 +77,12 @@ class SchedulerConfig:
     # 0 disables bulk mode.
     bulk_allocation_threshold: int = 32
     bulk_allocation_max_rounds: int = 8
+    # Whole-cycle deadline in seconds (0 disables).  Enforced by the
+    # cycle driver between actions AND inside them at kernel-dispatch
+    # granularity (Session.dispatch_kernel): past the deadline the cycle
+    # aborts, uncommitted statements roll back, and the daemon moves on
+    # to the next cycle — a mid-cycle device death degrades, never wedges.
+    cycle_deadline_s: float = 0.0
     # Feature-gate overrides (pkg/common/feature_gates analog): gate name
     # -> bool.  Consulted at plugin registration (plugins/base.py) via
     # utils.feature_gates.FeatureGates; unset gates use KNOWN_GATES
@@ -136,7 +142,7 @@ class SchedulerConfig:
                     "node_pad_bucket", "bulk_allocation_threshold",
                     "max_scenarios_per_job", "max_victims_considered",
                     "scenario_prescreen_max", "scenario_prescreen_after",
-                    "batched_scenario_confirm"):
+                    "batched_scenario_confirm", "cycle_deadline_s"):
             if key in d:
                 setattr(config, key, d[key])
         if "queue_depth_per_action" in d:

@@ -70,6 +70,28 @@ def _next_pow2(n: int) -> int:
     return p
 
 
+def _allocation_shape_check(t_pad: int):
+    """Device-guard validator for allocation results: the task axis must
+    match what was dispatched (a truncated/garbled device answer — the
+    ``badshape`` fault class — must read as a device failure, never be
+    silently unpacked)."""
+    def ok(result) -> bool:
+        try:
+            if result.placements.shape[0] < t_pad:
+                return False
+            packed = getattr(result, "packed", None)
+            if packed is not None and \
+                    packed.shape[0] != 2 * result.placements.shape[0] \
+                    + result.job_success.shape[0]:
+                # packed is placements ++ pipelined ++ job_success
+                # ([T + T + J], ops/allocate.py AllocationResult).
+                return False
+            return True
+        except Exception:
+            return False
+    return ok
+
+
 def _unpack_allocation(result, t: int):
     """(placed [t], piped [t], success [J]) from an AllocationResult.
 
@@ -209,6 +231,10 @@ class Session:
         self.cpu_strategy = BINPACK
         self.mutation_count = 0
         self.statements: list[Statement] = []
+        # Whole-cycle deadline (absolute clock value, set by the
+        # scheduler's run_once): past it, every kernel dispatch aborts
+        # with CycleDeadlineExceeded instead of starting new device work.
+        self.cycle_deadline_at: float | None = None
         # Device-array cache: static snapshot arrays upload once; mutable
         # state arrays re-upload only after a statement touched them.
         self._static_dev: dict = {}
@@ -240,6 +266,31 @@ class Session:
         st = Statement(self)
         self.statements.append(st)
         return st
+
+    def abort_uncommitted(self) -> int:
+        """Roll back every statement that never committed — the cycle
+        driver's consistency hook when a device death (or the cycle
+        deadline) aborts an action mid-flight: the dense mirrors, object
+        graph, and cache must show no phantom allocations."""
+        n = 0
+        for st in self.statements:
+            if not st.committed and st.ops:
+                st.discard()
+                n += 1
+        return n
+
+    # -- guarded device dispatch ------------------------------------------
+    def dispatch_kernel(self, thunk, label: str, validate=None):
+        """Route one device-kernel dispatch through the device guard:
+        watchdog deadline, retry, circuit breaker, CPU degradation
+        (utils/deviceguard.py).  All session/solver kernel call sites go
+        through here so fault handling is uniform and the whole-cycle
+        deadline is enforced at dispatch granularity."""
+        from ..utils.deviceguard import device_guard
+        return device_guard().call(
+            thunk, label=label, validate=validate,
+            record_event=getattr(self.cache, "record_event", None),
+            cycle_deadline_at=self.cycle_deadline_at)
 
     # -- dense mirrors (single writer: the Statement via sync_node) --------
     @property
@@ -481,15 +532,19 @@ class Session:
             mask_pad = np.ones((t_pad, n_nodes), bool)
             mask_pad[:t] = mask
 
-        result = allocate_jobs_kernel(
-            *self._device_arrays(),
-            jnp.asarray(task_req), jnp.asarray(task_job),
-            jnp.asarray(task_sel), jnp.asarray(task_tol),
-            jnp.asarray(job_allowed), jnp.asarray(extra),
-            task_node_mask=(None if mask_pad is None
-                            else jnp.asarray(mask_pad)),
-            gpu_strategy=self.gpu_strategy, cpu_strategy=self.cpu_strategy,
-            allow_pipeline=True, pipeline_only=pipeline_only)
+        result = self.dispatch_kernel(
+            lambda: allocate_jobs_kernel(
+                *self._device_arrays(),
+                jnp.asarray(task_req), jnp.asarray(task_job),
+                jnp.asarray(task_sel), jnp.asarray(task_tol),
+                jnp.asarray(job_allowed), jnp.asarray(extra),
+                task_node_mask=(None if mask_pad is None
+                                else jnp.asarray(mask_pad)),
+                gpu_strategy=self.gpu_strategy,
+                cpu_strategy=self.cpu_strategy,
+                allow_pipeline=True, pipeline_only=pipeline_only),
+            label="allocate_jobs_multi",
+            validate=_allocation_shape_check(t_pad))
         placed, piped, success = _unpack_allocation(result, t)
         out = {}
         row = 0
@@ -596,15 +651,18 @@ class Session:
         if homogeneous:
             from ..ops.allocate_grouped import allocate_grouped
             node_arrays = self._device_arrays()
-            result = allocate_grouped(
-                node_arrays, task_req[:t], np.zeros(t, np.int32),
-                task_sel[:t], task_tol[:t], np.ones(1, bool),
-                gpu_strategy=self.gpu_strategy,
-                cpu_strategy=self.cpu_strategy,
-                allow_pipeline=allow_pipeline,
-                pipeline_only=pipeline_only,
-                extra_scores=row_extra,
-                node_mask=row_mask)
+            result = self.dispatch_kernel(
+                lambda: allocate_grouped(
+                    node_arrays, task_req[:t], np.zeros(t, np.int32),
+                    task_sel[:t], task_tol[:t], np.ones(1, bool),
+                    gpu_strategy=self.gpu_strategy,
+                    cpu_strategy=self.cpu_strategy,
+                    allow_pipeline=allow_pipeline,
+                    pipeline_only=pipeline_only,
+                    extra_scores=row_extra,
+                    node_mask=row_mask),
+                label="allocate_grouped",
+                validate=_allocation_shape_check(t))
             if not bool(result.job_success[0]):
                 return Proposal(False, [])
             placements = []
@@ -653,30 +711,36 @@ class Session:
             # rows, extra score terms, and pipeline-only proposals stay
             # on the single-chip kernel (unsupported under shard_map).
             from ..parallel.sharded import sharded_allocate_jobs
-            result = sharded_allocate_jobs(
-                self.mesh, *self._device_arrays(),
-                jnp.asarray(task_req), jnp.asarray(task_job),
-                jnp.asarray(task_sel), jnp.asarray(task_tol),
-                jnp.asarray(job_allowed),
-                task_node_mask=(None if mask_pad is None
-                                else jnp.asarray(mask_pad)),
-                gpu_strategy=self.gpu_strategy,
-                cpu_strategy=self.cpu_strategy,
-                allow_pipeline=allow_pipeline)
+            result = self.dispatch_kernel(
+                lambda: sharded_allocate_jobs(
+                    self.mesh, *self._device_arrays(),
+                    jnp.asarray(task_req), jnp.asarray(task_job),
+                    jnp.asarray(task_sel), jnp.asarray(task_tol),
+                    jnp.asarray(job_allowed),
+                    task_node_mask=(None if mask_pad is None
+                                    else jnp.asarray(mask_pad)),
+                    gpu_strategy=self.gpu_strategy,
+                    cpu_strategy=self.cpu_strategy,
+                    allow_pipeline=allow_pipeline),
+                label="allocate_jobs_sharded",
+                validate=_allocation_shape_check(t_pad))
         else:
-            result = allocate_jobs_kernel(
-                *self._device_arrays(),
-                jnp.asarray(task_req), jnp.asarray(task_job),
-                jnp.asarray(task_sel), jnp.asarray(task_tol),
-                jnp.asarray(job_allowed), jnp.asarray(extra),
-                task_node_mask=(None if mask_pad is None
-                                else jnp.asarray(mask_pad)),
-                task_anti_domain=dom_pad,
-                task_aff_domain=aff_pad,
-                gpu_strategy=self.gpu_strategy,
-                cpu_strategy=self.cpu_strategy,
-                allow_pipeline=allow_pipeline,
-                pipeline_only=pipeline_only)
+            result = self.dispatch_kernel(
+                lambda: allocate_jobs_kernel(
+                    *self._device_arrays(),
+                    jnp.asarray(task_req), jnp.asarray(task_job),
+                    jnp.asarray(task_sel), jnp.asarray(task_tol),
+                    jnp.asarray(job_allowed), jnp.asarray(extra),
+                    task_node_mask=(None if mask_pad is None
+                                    else jnp.asarray(mask_pad)),
+                    task_anti_domain=dom_pad,
+                    task_aff_domain=aff_pad,
+                    gpu_strategy=self.gpu_strategy,
+                    cpu_strategy=self.cpu_strategy,
+                    allow_pipeline=allow_pipeline,
+                    pipeline_only=pipeline_only),
+                label="allocate_jobs",
+                validate=_allocation_shape_check(t_pad))
 
         placed, piped, success = _unpack_allocation(result, t)
         if not bool(success[0]):
@@ -728,15 +792,24 @@ class Session:
             return np.zeros(self.node_idle.shape[0])
         req = req_row[None, :]
         alloc, idle, rel, labels, taints, room = self._device_arrays()
-        # Fractional tasks: capacity-check the cpu/mem axes; GPU device fit
-        # is decided host-side by the sharing-group logic.
-        fit_now, fit_future = feasibility_masks(
-            idle, rel, labels, taints, room, jnp.asarray(req),
-            jnp.asarray(sel_row[None, :]), jnp.asarray(tol_row[None, :]))
-        score = score_matrix(
-            alloc, idle, jnp.asarray(req), fit_now, fit_future,
-            gpu_strategy=self.gpu_strategy, cpu_strategy=self.cpu_strategy)
-        out = np.asarray(score[0]).copy()
+        n_nodes = self.node_idle.shape[0]
+
+        def score_thunk():
+            # Fractional tasks: capacity-check the cpu/mem axes; GPU
+            # device fit is decided host-side by the sharing-group logic.
+            fit_now, fit_future = feasibility_masks(
+                idle, rel, labels, taints, room, jnp.asarray(req),
+                jnp.asarray(sel_row[None, :]),
+                jnp.asarray(tol_row[None, :]))
+            score = score_matrix(
+                alloc, idle, jnp.asarray(req), fit_now, fit_future,
+                gpu_strategy=self.gpu_strategy,
+                cpu_strategy=self.cpu_strategy)
+            return np.asarray(score[0]).copy()
+
+        out = self.dispatch_kernel(
+            score_thunk, label="score_nodes",
+            validate=lambda r: getattr(r, "shape", (0,))[0] == n_nodes)
         # Plugin score terms apply to host-side paths too: without them a
         # nominated (pipelined-last-cycle) fractional task loses its
         # sticky node and flaps between devices across cycles; preferred

@@ -190,10 +190,17 @@ class ProportionPlugin(Plugin):
         hier = fsops.QueueHierarchy.build(parent, priority, creation, qids)
         stack = lambda attr: np.stack(
             [getattr(self.queues[q], attr) for q in qids])
-        fair = fsops.fair_share_levels(
-            self.total, ssn.config.k_value, hier,
-            stack("deserved"), stack("limit"), stack("over_quota_weight"),
-            stack("request"), stack("usage"))
+        # Guarded like every other device dispatch: session open must
+        # degrade to the CPU fallback on a dead device, not wedge the
+        # cycle before its first action.
+        fair = ssn.dispatch_kernel(
+            lambda: fsops.fair_share_levels(
+                self.total, ssn.config.k_value, hier,
+                stack("deserved"), stack("limit"),
+                stack("over_quota_weight"),
+                stack("request"), stack("usage")),
+            label="fair_share",
+            validate=lambda r: getattr(r, "shape", (0,))[0] >= n)
         from ..utils.metrics import METRICS
         for qid, i in index.items():
             self.queues[qid].fair_share = fair[i]

@@ -13,6 +13,9 @@ import time
 from .actions import build_actions
 from .framework.conf import SchedulerConfig
 from .framework.session import InMemoryCache, Session
+from .utils.deviceguard import (CycleDeadlineExceeded, DeviceGuardError,
+                                device_guard)
+from .utils.logging import LOG
 from .utils.metrics import METRICS
 
 
@@ -30,21 +33,76 @@ class Scheduler:
         self.last_session = None  # kept for introspection endpoints
 
     def run_once(self) -> Session:
-        """One scheduling cycle (scheduler.go:113-138)."""
+        """One scheduling cycle (scheduler.go:113-138).
+
+        The cycle runs under an optional whole-cycle deadline
+        (config.cycle_deadline_s): checked between actions here, and
+        inside actions at every kernel dispatch (Session.dispatch_kernel).
+        A device death or deadline expiry mid-action rolls back that
+        action's uncommitted statements — committed work stands, phantom
+        allocations never reach the cache — and the cycle ends degraded
+        instead of wedging the daemon (docs/DEGRADATION.md)."""
         self.session_id += 1
         t0 = time.perf_counter()
+        deadline = self.config.cycle_deadline_s
+        # The dispatch-level deadline shares t0's origin: taking it after
+        # the snapshot build would let kernel dispatches overrun the
+        # whole-cycle budget by the full snapshot cost at fleet scale.
+        clock0 = device_guard().clock()
         cluster = self.cluster_provider()
         usage = self.usage_provider() if self.usage_provider else None
         ssn = Session(cluster, self.config, self.cache, queue_usage=usage)
-        ssn.open()
+        if deadline:
+            ssn.cycle_deadline_at = clock0 + deadline
+        ssn.aborted = None
+
+        def _abort(where: str, exc: Exception) -> None:
+            # Device path dead AND no fallback (or the cycle deadline
+            # fired mid-dispatch): abandon the phase, leave the cache
+            # consistent, keep the daemon alive.
+            rolled = ssn.abort_uncommitted()
+            ssn.aborted = f"{where}: {exc}"
+            METRICS.inc("scheduler_cycle_aborts")
+            if isinstance(exc, CycleDeadlineExceeded):
+                # Deadline-driven aborts count in both families: they are
+                # aborts AND deadline expiries, wherever the budget ran
+                # out (a dispatch inside open/an action, not only the
+                # action-boundary check below).
+                METRICS.inc("scheduler_cycle_deadline_exceeded")
+            LOG.warning(
+                "cycle %d aborted in %s (%d statements rolled back): %s",
+                self.session_id, where, rolled, exc)
+            record = getattr(ssn.cache, "record_event", None)
+            if record is not None:
+                record("CycleAborted", ssn.aborted)
+
         try:
-            for action in build_actions(self.config.actions):
-                ta = time.perf_counter()
-                action.execute(ssn)
-                dt = time.perf_counter() - ta
-                ssn.phase_timings[f"action_{action.name}"] = dt
-                METRICS.observe(f"action_scheduling_latency_{action.name}",
-                                dt * 1000.0)
+            try:
+                # Plugin open runs device kernels too (proportion's
+                # fair-share division) — it degrades, not wedges, like
+                # any action.
+                ssn.open()
+            except DeviceGuardError as exc:
+                _abort("session open", exc)
+            if ssn.aborted is None:
+                for action in build_actions(self.config.actions):
+                    if deadline and time.perf_counter() - t0 > deadline:
+                        ssn.aborted = (f"cycle deadline {deadline:g}s "
+                                       f"reached before action "
+                                       f"{action.name}")
+                        METRICS.inc("scheduler_cycle_deadline_exceeded")
+                        break
+                    ta = time.perf_counter()
+                    try:
+                        action.execute(ssn)
+                    except DeviceGuardError as exc:
+                        _abort(f"action {action.name}", exc)
+                        break
+                    dt = time.perf_counter() - ta
+                    ssn.phase_timings[f"action_{action.name}"] = dt
+                    METRICS.observe(
+                        f"action_scheduling_latency_{action.name}",
+                        dt * 1000.0)
         finally:
             ssn.close()
         # Per-phase breakdown on /metrics: where the cycle budget goes

@@ -9,7 +9,10 @@ endpoints:
   GET /metrics        Prometheus text (utils/metrics.py)
   GET /get-snapshot   full cluster+config dump (snapshot plugin)
   GET /job-order      current job ordering per queue (reflectjoborder)
-  GET /healthz
+  GET /healthz        liveness + device-guard breaker state: a tripped
+                      breaker reports {"status": "degraded", ...} with
+                      HTTP 200 — the daemon is alive and scheduling on
+                      the CPU fallback path, not dead (docs/DEGRADATION.md)
 
 Leader election comes in two flavors:
 
@@ -35,8 +38,18 @@ from .controllers import ShardSpec, System, SystemConfig
 from .framework.conf import SchedulerConfig
 from .plugins.snapshot_plugin import dump_cluster
 from .utils import parse_bool as _parse_bool
+from .utils.deviceguard import configure_device_guard, device_guard
 from .utils.logging import LOG, init_loggers
 from .utils.metrics import METRICS
+
+
+def healthz_payload() -> dict:
+    """Liveness + degraded-mode report: alive is HTTP 200 regardless;
+    ``status`` flips to "degraded" while the device-guard breaker is not
+    closed (scheduling continues on the CPU fallback path)."""
+    guard = device_guard()
+    return {"status": "degraded" if guard.degraded else "ok",
+            "device_guard": guard.status()}
 
 
 class LeaderElector:
@@ -75,8 +88,8 @@ def _make_handler(server_state):
                 body = METRICS.to_prometheus_text().encode()
                 ctype = "text/plain"
             elif self.path == "/healthz":
-                body = b"ok"
-                ctype = "text/plain"
+                body = json.dumps(healthz_payload()).encode()
+                ctype = "application/json"
             elif self.path == "/get-snapshot":
                 ssn = server_state.get("last_session")
                 body = json.dumps(
@@ -178,10 +191,25 @@ def run_app(argv=None) -> None:
     ap.add_argument("--usage-db", default=None,
                     help="usage client spec for time-based fairness, "
                          "e.g. memory://")
+    ap.add_argument("--cycle-deadline", type=float, default=0.0,
+                    help="whole-cycle deadline in seconds (0 disables): "
+                         "past it the cycle aborts with statement "
+                         "rollback and the daemon moves on degraded")
+    ap.add_argument("--device-deadline", type=float, default=None,
+                    help="per-dispatch watchdog deadline in seconds "
+                         "(default KAI_DEVICE_DEADLINE_S or 30)")
+    ap.add_argument("--fault-inject", default=None,
+                    help="deterministic device-fault injection for the "
+                         "chaos ring: hang | slow:<ms> | error | "
+                         "flaky:<p> | badshape (KAI_FAULT_INJECT analog)")
     args = ap.parse_args(argv)
 
     init_loggers(args.verbosity)
-    config = SchedulerConfig(k_value=args.k_value)
+    if args.fault_inject or args.device_deadline is not None:
+        configure_device_guard(fault=args.fault_inject,
+                               deadline_s=args.device_deadline)
+    config = SchedulerConfig(k_value=args.k_value,
+                             cycle_deadline_s=args.cycle_deadline)
     if args.actions:
         config.actions = [a.strip() for a in args.actions.split(",")]
     api = None

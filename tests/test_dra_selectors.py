@@ -301,3 +301,54 @@ class TestManifestParsing:
         assert req["count"] == 2
         assert req["selectors"] == [
             {"capacity": "memory", "min": float(64 * 2 ** 30)}]
+
+    def test_unsupported_selector_is_loud(self):
+        """An out-of-subset CEL selector translates to match-nothing —
+        but the user must see "selector unsupported", not a silent fit
+        error (VERDICT Weak #7): one DeviceSelectorUnsupported event and
+        one device_selector_unsupported count per (owner, expression),
+        deduped across snapshots."""
+        from kai_scheduler_tpu.controllers.cache_builder import ClusterCache
+        from kai_scheduler_tpu.controllers.kubeapi import InMemoryKubeAPI
+        from kai_scheduler_tpu.utils.metrics import METRICS
+
+        class EventSink:
+            def __init__(self):
+                self.events = []
+
+            def record_event(self, kind, message):
+                self.events.append((kind, message))
+
+        expr = 'device.attributes["weird"].exists(a, a > 3)'
+        api = InMemoryKubeAPI()
+        api.create({"kind": "DeviceClass", "metadata": {"name": "celled"},
+                    "spec": {"selectors": [
+                        {"cel": {"expression": expr}}]}})
+        api.create({"kind": "ResourceClaim",
+                    "metadata": {"name": "c1", "namespace": "default"},
+                    "spec": {"devices": {"requests": [
+                        {"deviceClassName": "celled", "count": 1,
+                         "selectors": [{"cel": {"expression": expr}}]}]}}})
+        sink = EventSink()
+        count0 = METRICS.counters.get("device_selector_unsupported", 0)
+        cache = ClusterCache(api, status_updater=sink)
+        cache.snapshot()
+        warned = [(k, m) for k, m in sink.events
+                  if k == "DeviceSelectorUnsupported"]
+        # One per owner (the class AND the claim request), expression
+        # named in the message.
+        assert len(warned) == 2
+        owners = {m.split(":")[0] for _, m in warned}
+        # Claim owners are namespace-qualified: same-named claims in two
+        # namespaces are distinct users and must each get their warning.
+        assert owners == {"DeviceClass/celled",
+                          "ResourceClaim/default/c1"}
+        assert all(expr in m for _, m in warned)
+        assert METRICS.counters["device_selector_unsupported"] \
+            == count0 + 2
+        # Re-snapshot: same expressions, no new spam.
+        cache.snapshot()
+        assert len([1 for k, _ in sink.events
+                    if k == "DeviceSelectorUnsupported"]) == 2
+        assert METRICS.counters["device_selector_unsupported"] \
+            == count0 + 2

@@ -394,6 +394,104 @@ class TestKubeconfig:
         loaded = load_kubeconfig(str(cfg))
         assert loaded["token"] == "exec-token-prod"
 
+    def test_exec_token_refresh_on_401(self, tmp_path):
+        """Expired exec-plugin token: the first 401 re-runs the plugin
+        and retries with the fresh token — the fleet survives token
+        rotation instead of failing permanently (docs/PARITY.md gap)."""
+        counter = tmp_path / "mint-count"
+        counter.write_text("0")
+        plugin = tmp_path / "expiring-token.py"
+        plugin.write_text(
+            "#!/usr/bin/env python3\n"
+            "import json\n"
+            f"path = {str(counter)!r}\n"
+            "n = int(open(path).read()) + 1\n"
+            "open(path, 'w').write(str(n))\n"
+            "print(json.dumps({'kind': 'ExecCredential',\n"
+            "                  'status': {'token': f'tok-{n}'}}))\n")
+        plugin.chmod(0o755)
+
+        class AuthHandler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                # tok-1 has "expired" by the time the request lands;
+                # only the re-minted tok-2 is accepted.
+                if self.headers.get("Authorization") == "Bearer tok-2":
+                    body = json.dumps({"kind": "List", "items": [
+                        {"kind": "Node",
+                         "metadata": {"name": "n1"}}]}).encode()
+                    self.send_response(200)
+                else:
+                    body = json.dumps({"message": "Unauthorized"}).encode()
+                    self.send_response(401)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0), AuthHandler)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        cfg = tmp_path / "kubeconfig"
+        cfg.write_text(json.dumps({
+            "current-context": "dev",
+            "contexts": [{"name": "dev",
+                          "context": {"cluster": "c", "user": "u"}}],
+            "clusters": [{"name": "c", "cluster": {
+                "server": f"http://127.0.0.1:{httpd.server_port}"}}],
+            "users": [{"name": "u", "user": {"exec": {
+                "command": str(plugin)}}}],
+        }))
+        client = KubernetesKubeAPI.from_kubeconfig(str(cfg))
+        try:
+            assert client.token == "tok-1"
+            nodes = client.list("Node")
+            assert [n["metadata"]["name"] for n in nodes] == ["n1"]
+            assert client.token == "tok-2"
+            assert counter.read_text() == "2"  # exactly one re-mint
+        finally:
+            client.close()
+            httpd.shutdown()
+            httpd.server_close()
+
+    def test_exec_refresh_same_token_propagates_401(self, tmp_path):
+        """A plugin that keeps minting the SAME (rejected) token must not
+        retry-loop: the 401 propagates after one refresh attempt."""
+        import urllib.error
+
+        plugin = tmp_path / "static-token.py"
+        plugin.write_text(
+            "#!/usr/bin/env python3\n"
+            "import json\n"
+            "print(json.dumps({'kind': 'ExecCredential',\n"
+            "                  'status': {'token': 'rejected'}}))\n")
+        plugin.chmod(0o755)
+
+        class DenyHandler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                body = b'{"message": "Unauthorized"}'
+                self.send_response(401)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0), DenyHandler)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        client = KubernetesKubeAPI(
+            f"http://127.0.0.1:{httpd.server_port}", token="rejected",
+            exec_spec={"command": str(plugin)})
+        try:
+            with pytest.raises(urllib.error.HTTPError):
+                client.list("Node")
+        finally:
+            client.close()
+            httpd.shutdown()
+            httpd.server_close()
+
     def test_exec_plugin_failure_is_loud(self, tmp_path):
         cfg = tmp_path / "kubeconfig"
         cfg.write_text(json.dumps({

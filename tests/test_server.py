@@ -49,7 +49,9 @@ def test_daemon_cli_smoke(tmp_path):
                 pass
             time.sleep(0.2)
         assert cycled, "daemon never completed a scheduling cycle"
-        assert get("/healthz") == b"ok"
+        health = json.loads(get("/healthz"))
+        assert health["status"] == "ok"  # no faults -> breaker closed
+        assert health["device_guard"]["state"] == "closed"
         snap = json.loads(get("/get-snapshot"))
         assert snap.get("config", {}).get("actions"), snap.keys()
         assert "nodes" in snap
