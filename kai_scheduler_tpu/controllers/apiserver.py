@@ -26,9 +26,20 @@ a server-side monotonic event sequence (the resourceVersion analog for
 watch resumption): a client reconnecting with ``since=N`` replays every
 event after N from the ring buffer, exactly like an informer re-list.
 
-Errors map to status codes: 404 NotFound, 409 Conflict — the HTTP client
-(httpclient.py) converts them back into the same exceptions
-``InMemoryKubeAPI`` raises, so callers cannot tell the substrates apart.
+Watch-gap contract: a ``since`` outside the ring's retained window —
+older than the horizon (events evicted) or NEWER than the head (the
+server restarted and its sequence reset) — gets one explicit
+``{"type": "GONE", "code": 410, "seq": <head>}`` line and the stream
+closes.  The server never silently replays a truncated history; the
+client must re-list (``GET /relist`` returns an atomic
+``{"seq", "items"}`` snapshot), diff its store, and resume from the
+returned head — exactly K8s' 410 Gone + informer re-list protocol.
+
+Errors map to status codes: 404 NotFound, 409 Conflict, 412 Fenced (a
+deposed leader's write; epoch travels in the ``X-Kai-Epoch`` /
+``X-Kai-Fence`` request headers) — the HTTP client (httpclient.py)
+converts them back into the same exceptions ``InMemoryKubeAPI`` raises,
+so callers cannot tell the substrates apart.
 """
 
 from __future__ import annotations
@@ -36,11 +47,13 @@ from __future__ import annotations
 import copy
 import json
 import threading
+import uuid
 from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
-from .kubeapi import Conflict, InMemoryKubeAPI, NotFound
+from ..utils.deviceguard import control_fault
+from .kubeapi import Conflict, Fenced, InMemoryKubeAPI, NotFound
 
 EVENT_LOG_CAPACITY = 100_000
 HEARTBEAT_SECONDS = 1.0
@@ -91,11 +104,24 @@ class KubeAPIServer:
     """
 
     def __init__(self, api: InMemoryKubeAPI | None = None,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 event_log_capacity: int = EVENT_LOG_CAPACITY):
         self.api = api or InMemoryKubeAPI()
-        self.log = EventLog()
+        self.log = EventLog(capacity=event_log_capacity)
         self.lock = threading.RLock()
-        self.api.watch_any(lambda et, obj: self.log.append(et, obj))
+        # Per-boot identity: seq numbers are only comparable within ONE
+        # server lifetime.  Clients echo the boot id on resume; a
+        # mismatch is a restart and forces GONE+relist even when the new
+        # log's head seq happens to have caught up past the client's old
+        # cursor (ordering alone cannot detect that case).
+        self.boot_id = uuid.uuid4().hex[:12]
+        self._log_appender = lambda et, obj: self.log.append(et, obj)
+        self.api.watch_any(self._log_appender)
+        # Set on stop(): active watch-stream handler threads (which
+        # outlive httpd.shutdown()) must terminate their connections, or
+        # an in-process "restart" leaves clients reading heartbeats from
+        # a zombie handler forever instead of reconnecting.
+        self._closing = threading.Event()
         handler = _make_handler(self)
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self._thread: threading.Thread | None = None
@@ -116,17 +142,27 @@ class KubeAPIServer:
         return self
 
     def stop(self) -> None:
+        self._closing.set()
+        # Stop feeding (and deep-copying into) a log nobody will read —
+        # an in-process restart otherwise leaks one zombie subscriber
+        # per server generation.
+        unwatch = getattr(self.api, "unwatch_any", None)
+        if unwatch is not None:
+            unwatch(self._log_appender)
+        with self.log.cond:
+            self.log.cond.notify_all()  # wake streams so they exit now
         self.httpd.shutdown()
         self.httpd.server_close()
 
     # -- handlers (called under self.lock) ---------------------------------
     def handle(self, method: str, kind: str, namespace: str | None,
-               name: str | None, query: dict, body: dict | None):
+               name: str | None, query: dict, body: dict | None,
+               epoch: int | None = None, fence: str | None = None):
         api = self.api
         with self.lock:
             try:
                 if method == "POST":
-                    out = api.create(body)
+                    out = api.create(body, epoch=epoch, fence=fence)
                 elif method == "GET" and name is None:
                     sel = _parse_selector(query.get("labelSelector"))
                     out = {"items": api.list(kind,
@@ -135,11 +171,13 @@ class KubeAPIServer:
                 elif method == "GET":
                     out = api.get(kind, name, namespace)
                 elif method == "PUT":
-                    out = api.update(body)
+                    out = api.update(body, epoch=epoch, fence=fence)
                 elif method == "PATCH":
-                    out = api.patch(kind, name, body, namespace)
+                    out = api.patch(kind, name, body, namespace,
+                                    epoch=epoch, fence=fence)
                 elif method == "DELETE":
-                    api.delete(kind, name, namespace)
+                    api.delete(kind, name, namespace,
+                               epoch=epoch, fence=fence)
                     out = {}
                 else:
                     return 405, {"error": f"bad method {method}"}
@@ -147,10 +185,22 @@ class KubeAPIServer:
                 return 404, {"error": str(e)}
             except Conflict as e:
                 return 409, {"error": str(e)}
+            except Fenced as e:
+                return 412, {"error": str(e), "fenced": True}
             # Push events to the log right away so watch streams are live
             # even when no in-process controller calls drain().
             api.drain()
         return 200, out
+
+    def relist_snapshot(self) -> dict:
+        """Atomic full-store snapshot + the event seq it corresponds to —
+        the client's 410-GONE recovery re-list.  Taken under the server
+        lock so no event can land between the copy and the seq read: a
+        client resuming its watch from the returned seq misses nothing."""
+        with self.lock:
+            items = [copy.deepcopy(o) for o in self.api.objects.values()]
+            return {"seq": self.log.seq, "boot": self.boot_id,
+                    "items": items}
 
 
 def _parse_selector(raw: str | None) -> dict | None:
@@ -190,7 +240,11 @@ def _make_handler(server: "KubeAPIServer"):
                 self._send_json(200, {"ok": True})
                 return
             if parsed.path.startswith("/watch"):
-                self._stream_watch(int(query.get("since", 0)))
+                self._stream_watch(int(query.get("since", 0)),
+                                   query.get("boot"))
+                return
+            if parsed.path == "/relist":
+                self._send_json(200, server.relist_snapshot())
                 return
             if not parts or parts[0] != "apis" or len(parts) < 2:
                 self._send_json(404, {"error": "unknown route"})
@@ -198,12 +252,15 @@ def _make_handler(server: "KubeAPIServer"):
             kind = parts[1]
             namespace = parts[2] if len(parts) > 2 else None
             name = parts[3] if len(parts) > 3 else None
+            epoch = self.headers.get("X-Kai-Epoch")
             code, payload = server.handle(
                 method, kind, namespace or "default",
-                name, query, self._read_body())
+                name, query, self._read_body(),
+                epoch=int(epoch) if epoch is not None else None,
+                fence=self.headers.get("X-Kai-Fence"))
             self._send_json(code, payload)
 
-        def _stream_watch(self, since: int) -> None:
+        def _stream_watch(self, since: int, boot: str | None) -> None:
             self.send_response(200)
             self.send_header("Content-Type", "application/x-ndjson")
             self.send_header("Transfer-Encoding", "chunked")
@@ -215,34 +272,46 @@ def _make_handler(server: "KubeAPIServer"):
                 self.wfile.write(line + b"\r\n")
                 self.wfile.flush()
 
+            # Chaos: drop the stream after N lines (watchdrop fault) —
+            # the client must reconnect with its seq and lose nothing.
+            drop_spec = control_fault("watchdrop")
+            drop_after = (int(drop_spec) if drop_spec else 5) \
+                if drop_spec is not None else None
+            sent = 0
             seq = since
             try:
-                # Resumption from before the ring buffer's horizon: the
-                # missed events are gone (K8s answers 410 Gone and the
-                # informer re-lists).  Signal TOO_OLD, then replay the
-                # entire current store as SYNC events so the client's
-                # handlers converge on current state.
-                if seq < server.log.oldest():
-                    with server.lock:
-                        snapshot = [copy.deepcopy(o) for o in
-                                    server.api.objects.values()]
-                        seq = server.log.seq
-                    send_line({"type": "TOO_OLD", "seq": seq})
-                    for obj in snapshot:
-                        send_line({"type": "SYNC", "object": obj,
-                                   "seq": seq})
-                    # The client diffs the replay against the keys it has
-                    # seen to synthesize DELETED for vanished objects.
-                    send_line({"type": "SYNC_END", "seq": seq})
-                while True:
+                # Resumption from outside the ring's retained window: the
+                # history is gone — the requested events were evicted
+                # (since < oldest), or this server restarted (boot-id
+                # mismatch; seq numbers from the previous life mean
+                # nothing here, INCLUDING when the new log's head has
+                # already caught up past the client's cursor).  K8s
+                # answers 410 Gone and the informer re-lists; we send
+                # one explicit GONE line and close.  Never silently
+                # replay a truncated history.
+                restarted = boot is not None and boot != server.boot_id
+                if restarted or seq < server.log.oldest() \
+                        or seq > server.log.seq:
+                    send_line({"type": "GONE", "code": 410,
+                               "seq": server.log.seq,
+                               "boot": server.boot_id,
+                               "oldest": server.log.oldest()})
+                    return
+                send_line({"type": "BOOT", "boot": server.boot_id,
+                           "seq": seq})
+                while not server._closing.is_set():
                     events = server.log.since(seq)
                     for eseq, etype, obj in events:
                         send_line({"seq": eseq, "type": etype, "object": obj})
                         seq = eseq
+                        sent += 1
+                        if drop_after is not None and sent >= drop_after:
+                            return  # injected mid-stream connection drop
                     with server.log.cond:
-                        if server.log.seq == seq:
+                        if server.log.seq == seq \
+                                and not server._closing.is_set():
                             server.log.cond.wait(timeout=HEARTBEAT_SECONDS)
-                    if not events:
+                    if not events and not server._closing.is_set():
                         send_line({"type": "HEARTBEAT", "seq": seq})
             except (BrokenPipeError, ConnectionResetError, OSError):
                 return

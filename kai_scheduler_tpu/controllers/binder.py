@@ -10,6 +10,12 @@ reservations on failure.
 
 from __future__ import annotations
 
+import random
+import time
+import uuid
+
+from ..utils import backoff_delay
+from ..utils.metrics import METRICS
 from .kubeapi import Conflict, InMemoryKubeAPI
 
 RESERVATION_NAMESPACE = "kai-resource-reservation"
@@ -80,13 +86,37 @@ class ResourceClaimPlugin(BindPlugin):
 
 
 class Binder:
+    """BindRequest reconciler with *bounded* retries.
+
+    A persistently failing bind (node gone, PVC wedged) used to hot-loop:
+    every failure re-emitted the request, which failed again in the same
+    drain pass until the backoff limit burned out in microseconds.
+    Failures now schedule the next attempt at
+    ``backoff_base_s * 2^(attempts-1)`` (+ deterministic jitter, capped),
+    recorded in ``status.backoffUntil``; ``tick()`` — called once per
+    operator cycle — re-reconciles requests whose backoff elapsed.
+    Exhausting the limit emits a ``bind_backoff_exceeded`` event (and
+    counter) and rolls back any reservations the attempts took."""
+
+    # now_fn is WALL clock by default: status.backoffUntil persists in
+    # the API object and must stay meaningful to a successor binder in
+    # another process (monotonic origins differ per process).
     def __init__(self, api: InMemoryKubeAPI, plugins=None,
-                 backoff_limit: int = 3):
+                 backoff_limit: int = 3, now_fn=time.time,
+                 backoff_base_s: float = 0.5, backoff_cap_s: float = 60.0):
         self.api = api
         self.plugins = plugins if plugins is not None else [
             VolumeBindingPlugin(), ResourceClaimPlugin()]
         self.backoff_limit = backoff_limit
+        self.now_fn = now_fn
+        self.backoff_base_s = backoff_base_s
+        self.backoff_cap_s = backoff_cap_s
+        self._jitter_rng = random.Random(0xB17D)
         api.watch("BindRequest", self._on_bind_request)
+
+    def _backoff_delay(self, attempts: int) -> float:
+        return backoff_delay(self.backoff_base_s, self.backoff_cap_s,
+                             attempts, self._jitter_rng, spread=0.25)
 
     def _on_bind_request(self, event_type: str, br: dict) -> None:
         if event_type == "DELETED":
@@ -94,9 +124,13 @@ class Binder:
         status = br.setdefault("status", {})
         if status.get("phase") in ("Succeeded", "Failed"):
             return
+        if status.get("attempts", 0) and \
+                self.now_fn() < status.get("backoffUntil", 0.0):
+            return  # backing off; tick() retries once the delay elapses
         try:
             self._bind(br)
             status["phase"] = "Succeeded"
+            status.pop("backoffUntil", None)
         except Exception as exc:  # retry with backoff limit
             attempts = status.get("attempts", 0) + 1
             status["attempts"] = attempts
@@ -105,21 +139,47 @@ class Binder:
                 status["phase"] = "Failed"
                 status["reason"] = str(exc)
                 self._rollback(br)
+                METRICS.inc("bind_backoff_exceeded")
+                self._record_event(
+                    "bind_backoff_exceeded",
+                    f"BindRequest {br['metadata']['name']}: "
+                    f"{attempts} attempts exhausted: {exc}")
             else:
                 status["phase"] = "Pending"
-                self._requeue(br)
+                status["backoffUntil"] = \
+                    self.now_fn() + self._backoff_delay(attempts)
         ns = br["metadata"].get("namespace", "default")
         self.api.patch("BindRequest", br["metadata"]["name"],
                        {"status": status}, ns)
 
-    def _requeue(self, br: dict) -> None:
-        """Re-enqueue a failed request for the next reconcile pass
-        (controller-runtime Requeue analog).  The in-memory API exposes a
-        direct event re-emit; over HTTP the status PATCH below already
-        produces a MODIFIED event that re-triggers this watcher."""
-        emit = getattr(self.api, "_emit", None)
-        if emit is not None:
-            emit("MODIFIED", br)
+    def tick(self) -> int:
+        """Re-reconcile Pending BindRequests whose backoff has elapsed
+        (the controller-runtime RequeueAfter analog — works identically
+        over the in-memory and HTTP substrates because it re-enters the
+        reconciler directly).  Returns how many were retried."""
+        retried = 0
+        now = self.now_fn()
+        for br in self.api.list("BindRequest"):
+            status = br.get("status", {})
+            if status.get("phase") != "Pending":
+                continue
+            if status.get("attempts", 0) and \
+                    now >= status.get("backoffUntil", 0.0):
+                self._on_bind_request("MODIFIED", br)
+                retried += 1
+        return retried
+
+    def _record_event(self, reason: str, message: str) -> None:
+        # uuid, not a process-local counter: a restarted binder's
+        # counter resets, and a name collision with a persisted Event
+        # would silently drop the announcement via the except below.
+        try:
+            self.api.create({
+                "kind": "Event",
+                "metadata": {"name": f"bind-evt-{uuid.uuid4().hex[:12]}"},
+                "spec": {"reason": reason, "message": message}})
+        except Exception:
+            pass  # events are best-effort, never fail the reconcile
 
     def _bind(self, br: dict) -> None:
         spec = br["spec"]

@@ -310,6 +310,37 @@ class ClusterCache:
         # Optional async worker pool for status/event writes
         # (controllers/status_updater.py); synchronous when absent.
         self.status_updater = status_updater
+        # Fenced leadership: when set (set_fence), every mutating write
+        # the scheduler makes through this cache — BindRequest create,
+        # evict, GC delete — carries the leader's epoch; the store
+        # rejects stale epochs with kubeapi.Fenced, so a deposed leader
+        # can never commit.
+        self.fence: str | None = None
+        self.epoch_provider = None
+        # Crash-safe bind journal (utils/commitlog.py), attached by the
+        # operator; Statement.commit journals intents through it and
+        # startup_reconcile replays it after a restart.
+        self.commitlog = None
+        # Watch-gap recovery: after the HTTP client re-lists past a 410
+        # GONE, derived caches keyed on resourceVersions it may have
+        # missed must be rebuilt.  Registered through a weakref: shard
+        # rebuilds (operator reconciles) replace caches, and the client's
+        # callback list must not pin every dead cache's parse cache —
+        # returning False deregisters a dead wrapper.
+        self._resync_pending = False
+        on_resync = getattr(api, "on_resync", None)
+        if on_resync is not None:
+            import weakref
+            ref = weakref.ref(self)
+
+            def _resync_cb():
+                cache = ref()
+                if cache is None:
+                    return False  # cache replaced: deregister me
+                cache._on_watch_resync()
+                return True
+
+            on_resync(_resync_cb)
         # In-memory pipelined assignments surviving between cycles
         # (Cache.TaskPipelined): pod uid -> (node, gpu_group).
         self._pipelined: dict = {}
@@ -323,6 +354,26 @@ class ClusterCache:
         # CEL selector is re-parsed every snapshot, but the user should
         # see ONE loud event per expression, not one per cycle.
         self._warned_selectors: set = set()
+
+    def set_fence(self, fence: str | None, epoch_provider) -> None:
+        """Arm fencing: ``epoch_provider()`` is read at each write (the
+        elector's current epoch — reading late keeps a long-running
+        commit from carrying a pre-renewal epoch)."""
+        self.fence = fence
+        self.epoch_provider = epoch_provider
+
+    def _fence_kwargs(self) -> dict:
+        if self.fence is None or self.epoch_provider is None:
+            return {}
+        return {"epoch": self.epoch_provider(), "fence": self.fence}
+
+    def _on_watch_resync(self) -> None:
+        """A watch gap forced a re-list: the pod parse cache may hold
+        entries whose MODIFIED events we never saw.  This runs on the
+        WATCH thread while snapshot() may be iterating the cache on the
+        scheduler thread, so only flip a flag here; the next snapshot
+        drops the cache on its own thread."""
+        self._resync_pending = True
 
     def _audit_device_selectors(self, owner: str, selectors: list) -> list:
         """Loud failure for selectors outside the supported CEL subset: a
@@ -398,6 +449,12 @@ class ClusterCache:
 
     # -- snapshot ------------------------------------------------------------
     def snapshot(self) -> ClusterInfo:
+        if self._resync_pending:
+            # Deferred watch-gap invalidation (see _on_watch_resync):
+            # rebind, don't clear() — the watch thread may set the flag
+            # again concurrently, which the NEXT snapshot then honors.
+            self._resync_pending = False
+            self._pod_cache = {}
         nodes = {}
         for n in self.api.list("Node"):
             spec = n.get("status", {}).get("allocatable", {})
@@ -595,6 +652,7 @@ class ClusterCache:
         consumes (cache/cache.go:267-290).  A leftover request from a
         previous failed attempt is replaced: the fresh scheduling decision
         resets the phase and retry budget."""
+        fk = self._fence_kwargs()
         obj = {
             "kind": "BindRequest",
             "metadata": {"name": f"bind-{task.uid}",
@@ -604,6 +662,9 @@ class ClusterCache:
                      "selectedGPUGroups": bind_request.gpu_groups,
                      "gpuFraction": task.res_req.gpu_fraction or None,
                      "backoffLimit": bind_request.backoff_limit,
+                     # Leadership epoch of the deciding scheduler —
+                     # auditable fencing trail on the object itself.
+                     "schedulerEpoch": fk.get("epoch"),
                      "resourceClaims": list(
                          getattr(bind_request, "resource_claims", [])),
                      "resourceClaimAllocations": list(
@@ -611,15 +672,15 @@ class ClusterCache:
             "status": {"phase": "Pending"},
         }
         try:
-            self.api.create(obj)
+            self.api.create(obj, **fk)
         except Conflict:
             # Leftover from a failed earlier attempt: supersede it.  The
             # common case stays a single API call.
             self.api.delete("BindRequest", obj["metadata"]["name"],
-                            task.namespace)
+                            task.namespace, **fk)
             obj["metadata"].pop("resourceVersion", None)
             obj["metadata"].pop("uid", None)
-            self.api.create(obj)
+            self.api.create(obj, **fk)
 
     def task_pipelined(self, task, node_name: str,
                        gpu_group: str = "") -> None:
@@ -640,7 +701,7 @@ class ClusterCache:
                 "Pod", task.name,
                 {"status": {"conditions": conditions},
                  "metadata": {"deletionTimestamp": str(self.now_fn())}},
-                task.namespace)
+                task.namespace, **self._fence_kwargs())
 
     def record_event(self, kind: str, message: str) -> None:
         if self.status_updater is not None:
@@ -683,14 +744,122 @@ class ClusterCache:
         """Stale BindRequest GC (cache/cache.go:371): drop requests whose
         pod vanished or already bound."""
         removed = 0
+        fk = self._fence_kwargs()
         for br in self.api.list("BindRequest"):
             ns = br["metadata"].get("namespace", "default")
             pod = self.api.get_opt("Pod", br["spec"]["podName"], ns)
             done = br.get("status", {}).get("phase") == "Succeeded"
             if pod is None or (done and pod.get("spec", {}).get("nodeName")):
-                self.api.delete("BindRequest", br["metadata"]["name"], ns)
+                self.api.delete("BindRequest", br["metadata"]["name"], ns,
+                                **fk)
                 removed += 1
         return removed
+
+    # -- restart reconcile (the crash-consistency pass) ----------------------
+    def startup_reconcile(self, commitlog=None) -> dict:
+        """Replay the commit journal against live API state and scrub the
+        cluster of everything a crashed scheduler/binder can leave behind.
+        Runs once at daemon startup, BEFORE the first scheduling cycle:
+
+        1. every journal intent without a ``done`` marker is resolved
+           against the store — a BindRequest that exists (or a pod that
+           bound) means the write survived; otherwise the decision died
+           with the old process and is dropped (the next cycle
+           re-schedules the pod from scratch);
+        2. orphaned reservation pods in ``kai-resource-reservation`` —
+           gpu-groups no live pod annotation and no live BindRequest
+           references — are deleted (a phantom reservation holds real
+           GPU capacity hostage forever);
+        3. BindRequests stuck past their backoff limit (phase Failed, or
+           attempts exhausted) are reaped so the pod re-enters
+           scheduling instead of wedging behind a dead request.
+
+        Returns a summary dict (counts) for logging/healthz."""
+        from .binder import GPU_GROUP_ANNOTATION, RESERVATION_NAMESPACE
+        log = commitlog if commitlog is not None else self.commitlog
+        summary = {"lost_commits": 0, "recovered_commits": 0,
+                   "orphaned_reservations": 0, "reaped_bind_requests": 0}
+
+        if log is not None:
+            for intent in log.pending_intents():
+                if intent.get("kind") == "bind":
+                    ns = intent.get("namespace", "default")
+                    br = self.api.get_opt("BindRequest",
+                                          f"bind-{intent['pod_uid']}", ns)
+                    pod = self.api.get_opt("Pod", intent.get("pod_name"),
+                                           ns)
+                    bound = pod is not None and \
+                        pod.get("spec", {}).get("nodeName")
+                    if br is not None or bound:
+                        summary["recovered_commits"] += 1
+                    else:
+                        # Crash between journal append and API commit:
+                        # the decision is lost, the pod re-schedules.
+                        summary["lost_commits"] += 1
+                        METRICS.inc("commitlog_lost_commits")
+                        self.record_event(
+                            "CommitLost",
+                            f"bind intent for pod "
+                            f"{ns}/{intent.get('pod_name')} died before "
+                            f"the API commit; pod will re-schedule")
+                else:  # evict intents are idempotent: nothing to undo
+                    summary["recovered_commits"] += 1
+            log.compact()
+
+        # Reap BindRequests past their backoff budget FIRST: Failed
+        # phase, or a Pending request whose attempts already exhausted
+        # the limit (binder died before marking it Failed).  Order
+        # matters — a dead-but-Pending request must not count its
+        # gpu-groups as "live" in the orphan scan below, or the
+        # reservations it took survive as phantoms until a SECOND
+        # restart.
+        for br in self.api.list("BindRequest"):
+            status = br.get("status", {})
+            limit = br.get("spec", {}).get("backoffLimit", 3)
+            exhausted = status.get("attempts", 0) >= limit
+            if status.get("phase") == "Failed" or \
+                    (status.get("phase") == "Pending" and exhausted):
+                ns = br["metadata"].get("namespace", "default")
+                self.api.delete("BindRequest", br["metadata"]["name"], ns)
+                summary["reaped_bind_requests"] += 1
+                METRICS.inc("bind_requests_reaped_total")
+
+        # Orphaned reservation-pod GC: collect every gpu-group still
+        # referenced by a live pod annotation or a live BindRequest;
+        # reservation pods holding any OTHER group are phantoms.
+        live_groups: set = set()
+        for pod in self.api.list("Pod"):
+            if pod["metadata"].get("namespace") == RESERVATION_NAMESPACE:
+                continue
+            ann = pod["metadata"].get("annotations", {})
+            for g in ann.get(GPU_GROUP_ANNOTATION, "").split(","):
+                if g:
+                    live_groups.add(g)
+        for br in self.api.list("BindRequest"):
+            for g in br.get("spec", {}).get("selectedGPUGroups") or []:
+                live_groups.add(g)
+        for pod in self.api.list("Pod", namespace=RESERVATION_NAMESPACE):
+            group = pod["metadata"].get("labels", {}).get(
+                GPU_GROUP_ANNOTATION)
+            if group and group not in live_groups:
+                self.api.delete("Pod", pod["metadata"]["name"],
+                                RESERVATION_NAMESPACE)
+                summary["orphaned_reservations"] += 1
+                METRICS.inc("reservation_orphans_gc_total")
+                self.record_event(
+                    "OrphanedReservationReclaimed",
+                    f"reservation pod for gpu-group {group} had no "
+                    f"owning pod or BindRequest after restart")
+
+        if any(summary.values()):
+            LOGGER_MSG = ("startup reconcile: %(lost_commits)d lost "
+                          "commits, %(recovered_commits)d recovered, "
+                          "%(orphaned_reservations)d orphaned "
+                          "reservations GC'd, %(reaped_bind_requests)d "
+                          "stale BindRequests reaped")
+            from ..utils.logging import LOG
+            LOG.warning(LOGGER_MSG, summary)
+        return summary
 
 
 _EVENT_SEQ = itertools.count()

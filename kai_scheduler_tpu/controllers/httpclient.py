@@ -15,11 +15,21 @@ land in a thread-safe pending queue; ``drain()`` delivers them to the
 registered per-kind handlers on the caller's thread — the same
 "reconcile on your own goroutine, not the watch goroutine" discipline as
 controller-runtime.
+
+Watch-gap recovery: when the server answers a resume with ``GONE``
+(events evicted from the ring, or a server restart reset the sequence),
+the watcher re-lists the whole store atomically (``GET /relist``), diffs
+it against everything it has delivered (synthesizing DELETED for
+vanished objects — informer re-list semantics), fires the registered
+resync callbacks, bumps ``watch_gap_total``, and resumes the stream from
+the re-list's seq.  Reconnects back off exponentially with jitter so a
+flapping apiserver is not hammered by its whole fleet in lockstep.
 """
 
 from __future__ import annotations
 
 import json
+import random
 import threading
 import time
 import urllib.error
@@ -27,7 +37,13 @@ import urllib.request
 from collections import defaultdict
 from typing import Callable
 
-from .kubeapi import Conflict, NotFound, obj_key
+from ..utils import backoff_delay
+from ..utils.deviceguard import control_fault
+from ..utils.metrics import METRICS
+from .kubeapi import Conflict, Fenced, NotFound, obj_key
+
+RECONNECT_BASE_S = 0.2
+RECONNECT_CAP_S = 5.0
 
 
 class HTTPKubeAPI:
@@ -37,22 +53,75 @@ class HTTPKubeAPI:
         self._watchers: dict[str, list[Callable]] = defaultdict(list)
         self._pending: list[tuple] = []
         self._pending_lock = threading.Lock()
-        # Keys observed via watch events; used to synthesize DELETED after
-        # a TOO_OLD re-list (an informer diffs its store the same way).
+        # Keys observed via watch events; used to synthesize DELETED when
+        # a GONE re-list shows an object vanished while we were away (an
+        # informer diffs its store the same way).
         self._known: dict[tuple, dict] = {}
-        self._syncing: set | None = None
         self._watch_thread: threading.Thread | None = None
+        # Serializes the watch thread's exit decision against
+        # _ensure_watch_thread's liveness check: without it, a
+        # stop/clear/restart sequence can observe a thread that is alive
+        # but already committed to exiting, and strand the watch with no
+        # thread at all.
+        self._watch_lock = threading.Lock()
         self._watch_seq = 0
+        # Server boot id last observed: seq numbers are only comparable
+        # within one server lifetime, so the cursor is really the pair
+        # (boot, seq) — the server forces GONE on a boot mismatch.
+        self._server_boot: str | None = None
         self._stop = threading.Event()
         self._synced = threading.Event()
+        # Called (no args) after a watch-gap re-list rebuilt the local
+        # view: consumers with derived caches (cache_builder) re-derive.
+        self._resync_callbacks: list[Callable] = []
+        self._reconnect_rng = random.Random(0xC0FFEE)
+        self._partition_started: float | None = None
+        # Default fence for mutating writes (set_fence); per-call epoch=
+        # kwargs override.
+        self._fence: str | None = None
+        self._epoch_provider: Callable | None = None
+
+    # -- fencing -----------------------------------------------------------
+    def set_fence(self, fence: str | None,
+                  epoch_provider: Callable | None) -> None:
+        """Stamp every mutating request from this client with the
+        leadership epoch (X-Kai-Epoch/X-Kai-Fence headers); the apiserver
+        rejects stale epochs with 412 -> Fenced."""
+        self._fence = fence
+        self._epoch_provider = epoch_provider
 
     # -- plumbing ----------------------------------------------------------
+    def _maybe_partition(self) -> None:
+        """``partition:<ms>`` chaos: fail every request for a window
+        starting at the first request after the fault is armed."""
+        spec = control_fault("partition")
+        if spec is None:
+            self._partition_started = None
+            return
+        window_s = float(spec or 100) / 1000.0
+        now = time.monotonic()
+        if self._partition_started is None:
+            self._partition_started = now
+        if now - self._partition_started < window_s:
+            raise urllib.error.URLError("injected network partition")
+
     def _request(self, method: str, path: str,
-                 body: dict | None = None) -> dict:
+                 body: dict | None = None,
+                 epoch: int | None = None,
+                 fence: str | None = None) -> dict:
+        self._maybe_partition()
         data = json.dumps(body).encode() if body is not None else None
+        headers = {"Content-Type": "application/json"}
+        if fence is None and method in ("POST", "PUT", "PATCH", "DELETE") \
+                and self._fence is not None \
+                and self._epoch_provider is not None:
+            fence, epoch = self._fence, self._epoch_provider()
+        if fence is not None and epoch is not None:
+            headers["X-Kai-Fence"] = fence
+            headers["X-Kai-Epoch"] = str(int(epoch))
         req = urllib.request.Request(
             self.base_url + path, data=data, method=method,
-            headers={"Content-Type": "application/json"})
+            headers=headers)
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
                 return json.loads(resp.read() or b"{}")
@@ -67,11 +136,15 @@ class HTTPKubeAPI:
                 raise NotFound(msg) from None
             if e.code == 409:
                 raise Conflict(msg) from None
+            if e.code == 412:
+                raise Fenced(msg) from None
             raise
 
     # -- CRUD (InMemoryKubeAPI surface) ------------------------------------
-    def create(self, obj: dict) -> dict:
-        out = self._request("POST", f"/apis/{obj['kind']}", obj)
+    def create(self, obj: dict, epoch: int | None = None,
+               fence: str | None = None) -> dict:
+        out = self._request("POST", f"/apis/{obj['kind']}", obj,
+                            epoch=epoch, fence=fence)
         obj.setdefault("metadata", {}).update(out.get("metadata", {}))
         return out
 
@@ -96,22 +169,27 @@ class HTTPKubeAPI:
         qs = ("?" + "&".join(query)) if query else ""
         return self._request("GET", f"/apis/{kind}{qs}")["items"]
 
-    def update(self, obj: dict) -> dict:
+    def update(self, obj: dict, epoch: int | None = None,
+               fence: str | None = None) -> dict:
         kind, ns, name = obj_key(obj)
-        out = self._request("PUT", f"/apis/{kind}/{ns}/{name}", obj)
+        out = self._request("PUT", f"/apis/{kind}/{ns}/{name}", obj,
+                            epoch=epoch, fence=fence)
         obj["metadata"]["resourceVersion"] = \
             out["metadata"]["resourceVersion"]
         return out
 
     def patch(self, kind: str, name: str, patch: dict,
-              namespace: str = "default") -> dict:
+              namespace: str = "default", epoch: int | None = None,
+              fence: str | None = None) -> dict:
         return self._request("PATCH", f"/apis/{kind}/{namespace}/{name}",
-                             patch)
+                             patch, epoch=epoch, fence=fence)
 
     def delete(self, kind: str, name: str,
-               namespace: str = "default") -> None:
+               namespace: str = "default", epoch: int | None = None,
+               fence: str | None = None) -> None:
         try:
-            self._request("DELETE", f"/apis/{kind}/{namespace}/{name}")
+            self._request("DELETE", f"/apis/{kind}/{namespace}/{name}",
+                          epoch=epoch, fence=fence)
         except NotFound:
             pass
 
@@ -124,52 +202,81 @@ class HTTPKubeAPI:
         self._watchers["*"].append(handler)
         self._ensure_watch_thread()
 
+    def on_resync(self, callback: Callable) -> None:
+        """Register a no-arg callback fired after a watch-gap re-list
+        rebuilt the local view (consumers invalidate derived caches).
+        Locked against _relist's concurrent prune on the watch thread —
+        an unsynchronized append could land on the replaced list and be
+        silently lost."""
+        with self._pending_lock:
+            self._resync_callbacks.append(callback)
+
     def _ensure_watch_thread(self) -> None:
-        if self._watch_thread is not None and self._watch_thread.is_alive():
-            return
-        self._stop.clear()
-        self._watch_thread = threading.Thread(target=self._watch_loop,
-                                              daemon=True)
-        self._watch_thread.start()
+        with self._watch_lock:
+            if self._watch_thread is not None \
+                    and self._watch_thread.is_alive():
+                # Alive thread: either it never saw the stop, or its
+                # locked loop-top check will observe the cleared flag
+                # and keep serving — never a stranded watch.
+                return
+            self._stop.clear()
+            self._watch_thread = threading.Thread(target=self._watch_loop,
+                                                  daemon=True)
+            self._watch_thread.start()
+
+    def _reconnect_sleep(self, failures: int) -> None:
+        """Exponential backoff with jitter between watch reconnects: a
+        fleet of watchers must not hammer a flapping apiserver in
+        lockstep."""
+        self._stop.wait(backoff_delay(RECONNECT_BASE_S, RECONNECT_CAP_S,
+                                      failures + 1, self._reconnect_rng))
 
     def _watch_loop(self) -> None:
-        while not self._stop.is_set():
+        failures = 0
+        while True:
+            # The ONLY exit point, atomic with _ensure_watch_thread: we
+            # either die here (clearing _watch_thread so ensure starts a
+            # fresh generation) or we observed a cleared _stop and keep
+            # serving.  Mid-read stop observations just break back to
+            # this check.
+            with self._watch_lock:
+                if self._stop.is_set():
+                    if self._watch_thread is threading.current_thread():
+                        self._watch_thread = None
+                    return
+            got_line = False
             try:
-                req = urllib.request.Request(
-                    f"{self.base_url}/watch?since={self._watch_seq}")
+                self._maybe_partition()
+                url = f"{self.base_url}/watch?since={self._watch_seq}"
+                if self._server_boot is not None:
+                    url += f"&boot={self._server_boot}"
+                req = urllib.request.Request(url)
                 with urllib.request.urlopen(req, timeout=30.0) as resp:
                     for raw in resp:
                         if self._stop.is_set():
-                            return
+                            break  # decide at the locked loop top
+                        got_line = True
+                        failures = 0
                         event = json.loads(raw)
                         etype = event.get("type")
-                        # The cursor advances past a TOO_OLD replay only
-                        # once SYNC_END lands: a disconnect mid-replay
-                        # then resumes from the OLD seq, triggering a
-                        # fresh complete replay instead of silently
-                        # skipping the unreplayed remainder.
-                        if etype not in ("TOO_OLD", "SYNC", "SYNC_END"):
-                            self._watch_seq = max(self._watch_seq,
-                                                  int(event.get("seq", 0)))
+                        if etype == "BOOT":
+                            self._server_boot = event.get("boot")
+                            continue
+                        if etype == "GONE":
+                            # Watch gap: our resume point fell outside
+                            # the ring (evicted history or a server
+                            # restart reset the sequence).  Re-list,
+                            # diff, resume from the re-list's seq.
+                            METRICS.inc("watch_gap_total")
+                            self._relist()
+                            break  # reconnect at the new seq
+                        self._watch_seq = max(self._watch_seq,
+                                              int(event.get("seq", 0)))
                         if etype == "HEARTBEAT":
                             self._synced.set()
                             continue
-                        if etype == "TOO_OLD":
-                            self._syncing = set()
-                            continue
-                        if etype == "SYNC_END":
-                            self._finish_sync()
-                            self._watch_seq = max(self._watch_seq,
-                                                  int(event.get("seq", 0)))
-                            continue
                         obj = event["object"]
                         key = obj_key(obj)
-                        if etype == "SYNC":
-                            # Re-list replay after ring-buffer eviction;
-                            # handlers see a MODIFIED convergence event.
-                            if self._syncing is not None:
-                                self._syncing.add(key)
-                            etype = "MODIFIED"
                         if etype == "DELETED":
                             self._known.pop(key, None)
                         else:
@@ -179,20 +286,41 @@ class HTTPKubeAPI:
             except (urllib.error.URLError, OSError,
                     json.JSONDecodeError):
                 if self._stop.is_set():
-                    return
-                time.sleep(0.2)  # reconnect; seq resumes the stream
+                    continue  # exit via the locked loop-top check
+                failures = 0 if got_line else failures + 1
+                METRICS.inc("watch_reconnect_total")
+                self._reconnect_sleep(failures)
 
-    def _finish_sync(self) -> None:
-        """After a TOO_OLD re-list: objects we knew about that did NOT
-        appear in the SYNC replay were deleted while the DELETED events
-        fell off the ring — synthesize them (informer re-list diffing)."""
-        if self._syncing is None:
-            return
-        vanished = [key for key in self._known if key not in self._syncing]
+    def _relist(self) -> None:
+        """410-GONE recovery: fetch the atomic store snapshot, deliver
+        every current object as a MODIFIED convergence event, synthesize
+        DELETED for objects that vanished while the events fell off the
+        ring (informer re-list diffing), and resume from the snapshot's
+        seq."""
+        snap = self._request("GET", "/relist")
+        current: dict[tuple, dict] = {}
+        for obj in snap["items"]:
+            current[obj_key(obj)] = obj
+        vanished = [key for key in self._known if key not in current]
         with self._pending_lock:
             for key in vanished:
                 self._pending.append(("DELETED", self._known.pop(key)))
-        self._syncing = None
+            for key, obj in current.items():
+                self._known[key] = obj
+                self._pending.append(("MODIFIED", obj))
+        self._watch_seq = int(snap["seq"])
+        self._server_boot = snap.get("boot")
+        # A callback returning False asks to be deregistered (the
+        # weakref-dead caches of rebuilt shards prune themselves here).
+        # Invoke outside the lock (callbacks may be arbitrary), mutate
+        # under it (on_resync appends race this prune).
+        with self._pending_lock:
+            callbacks = list(self._resync_callbacks)
+        dead = [cb for cb in callbacks if cb() is False]
+        if dead:
+            with self._pending_lock:
+                self._resync_callbacks = [
+                    cb for cb in self._resync_callbacks if cb not in dead]
 
     def drain(self, max_rounds: int = 100) -> int:
         """Deliver queued watch events to handlers on this thread."""

@@ -28,6 +28,19 @@ class NotFound(Exception):
     pass
 
 
+class Fenced(Exception):
+    """A mutating write carried a leadership epoch older than the one in
+    the coordination Lease: the writer was deposed.  Rejecting the write
+    here (the consistency point) is what makes a split-brain harmless —
+    a deposed leader can *decide* all it wants, it can never *commit*."""
+
+
+# Namespace the coordination Lease lives in (utils/leaderelect.py uses
+# the same); the fence check reads the Lease object straight from the
+# store, so the Lease IS the fence registry — no second source of truth.
+FENCE_NAMESPACE = "kai-system"
+
+
 def obj_key(obj: dict) -> tuple:
     md = obj.get("metadata", {})
     return (obj["kind"], md.get("namespace", "default"), md["name"])
@@ -40,8 +53,28 @@ class InMemoryKubeAPI:
         self._watchers: dict[str, list[Callable]] = defaultdict(list)
         self._pending: list[tuple] = []  # (event_type, obj) queue
 
+    # -- fencing -----------------------------------------------------------
+    def check_fence(self, epoch: int | None, fence: str | None) -> None:
+        """Reject a write whose leadership epoch is older than the one
+        recorded in the coordination Lease named ``fence``.  No Lease or
+        no epoch on the call means fencing is not in play (controllers
+        that never lead write unfenced)."""
+        if fence is None or epoch is None:
+            return
+        lease = self.objects.get(("Lease", FENCE_NAMESPACE, fence))
+        if lease is None:
+            return
+        current = int(lease.get("spec", {}).get("epoch", 0) or 0)
+        if epoch < current:
+            from ..utils.metrics import METRICS
+            METRICS.inc("fenced_writes_total")
+            raise Fenced(f"write with epoch {epoch} rejected: Lease "
+                         f"{fence!r} is at epoch {current} (deposed leader)")
+
     # -- CRUD --------------------------------------------------------------
-    def create(self, obj: dict) -> dict:
+    def create(self, obj: dict, epoch: int | None = None,
+               fence: str | None = None) -> dict:
+        self.check_fence(epoch, fence)
         md = obj.setdefault("metadata", {})
         md.setdefault("namespace", "default")
         md.setdefault("uid", uuid.uuid4().hex[:12])
@@ -79,7 +112,9 @@ class InMemoryKubeAPI:
             out.append(obj)
         return sorted(out, key=lambda o: o["metadata"]["name"])
 
-    def update(self, obj: dict) -> dict:
+    def update(self, obj: dict, epoch: int | None = None,
+               fence: str | None = None) -> dict:
+        self.check_fence(epoch, fence)
         key = obj_key(obj)
         if key not in self.objects:
             raise NotFound(str(key))
@@ -96,13 +131,17 @@ class InMemoryKubeAPI:
         return obj
 
     def patch(self, kind: str, name: str, patch: dict,
-              namespace: str = "default") -> dict:
+              namespace: str = "default", epoch: int | None = None,
+              fence: str | None = None) -> dict:
+        self.check_fence(epoch, fence)
         obj = self.get(kind, name, namespace)
         _deep_merge(obj, patch)
         return self.update(obj)
 
     def delete(self, kind: str, name: str,
-               namespace: str = "default") -> None:
+               namespace: str = "default", epoch: int | None = None,
+               fence: str | None = None) -> None:
+        self.check_fence(epoch, fence)
         key = (kind, namespace, name)
         obj = self.objects.pop(key, None)
         if obj is not None:
@@ -117,6 +156,14 @@ class InMemoryKubeAPI:
         """handler(event_type, obj) for EVERY kind; delivered on drain().
         Used by the HTTP apiserver to fan events out to remote watchers."""
         self._watchers["*"].append(handler)
+
+    def unwatch_any(self, handler: Callable) -> None:
+        """Unregister a watch_any handler (a stopped apiserver must not
+        keep deep-copying every future event into a log nobody reads)."""
+        try:
+            self._watchers["*"].remove(handler)
+        except ValueError:
+            pass
 
     def _emit(self, event_type: str, obj: dict) -> None:
         self._pending.append((event_type, obj))

@@ -51,6 +51,10 @@ class SystemConfig:
     # on top of KNOWN_GATES defaults, shared with every shard's
     # SchedulerConfig by _build_schedulers.
     feature_gates: dict = field(default_factory=dict)
+    # Crash-safe bind journal (utils/commitlog.py): statement commits
+    # journal intents here and the startup reconcile pass replays it.
+    # None = journaling off (embedded/test deployments).
+    commitlog_path: str | None = None
 
     def gate(self, name: str, default: bool = True) -> bool:
         from ..utils.feature_gates import FeatureGates
@@ -82,6 +86,14 @@ class System:
         from ..utils.usagedb import resolve_usage_client
         self.usage_db = resolve_usage_client(self.config.usage_db,
                                              self.config.usage_params)
+        self.commitlog = None
+        if self.config.commitlog_path:
+            from ..utils.commitlog import CommitLog
+            self.commitlog = CommitLog(self.config.commitlog_path)
+            self.cache.commitlog = self.commitlog
+        # Fencing state, armed by set_fence() once a Lease is held.
+        self._fence_name: str | None = None
+        self._epoch_provider = None
         self.schedulers = []
         self._config_rv = None     # last reconciled Config resourceVersion
         self._global_sched_args = {}  # Config CRD spec.scheduler.args
@@ -161,6 +173,9 @@ class System:
             cfg = self._compose_shard_config(shard, dra)
             cache = ClusterCache(self.api, self._now_fn,
                                  status_updater=self.status_updater)
+            cache.commitlog = self.commitlog
+            if self._fence_name is not None:
+                cache.set_fence(self._fence_name, self._epoch_provider)
             provider = self._shard_provider(cache, shard)
             self.schedulers.append(
                 Scheduler(provider, cfg, cache=cache,
@@ -266,9 +281,28 @@ class System:
         self._build_schedulers(shards)
         return True
 
+    def set_fence(self, fence_name: str, epoch_provider) -> None:
+        """Arm fenced leadership: every scheduler-side mutating write
+        (BindRequest create, evict, GC delete) carries
+        ``epoch_provider()``; the store rejects stale epochs with
+        ``kubeapi.Fenced`` (utils/leaderelect.py owns the epoch)."""
+        self._fence_name = fence_name
+        self._epoch_provider = epoch_provider
+        self.cache.set_fence(fence_name, epoch_provider)
+        for scheduler in self.schedulers:
+            scheduler.cache.set_fence(fence_name, epoch_provider)
+
+    def startup_reconcile(self) -> dict:
+        """The restart crash-consistency pass
+        (``ClusterCache.startup_reconcile``): replay the bind journal,
+        GC orphaned reservations, reap exhausted BindRequests.  Run once
+        BEFORE the first scheduling cycle."""
+        return self.cache.startup_reconcile(self.commitlog)
+
     def run_cycle(self) -> None:
         """One end-to-end tick: drain controller events, run every shard's
         scheduling cycle, drain the binder's work."""
+        from .kubeapi import Fenced
         self.api.drain()
         self.reconcile_config()
         self.reconcile_shards()
@@ -281,7 +315,13 @@ class System:
                     self.usage_db.record(self._now_fn(), qid,
                                          attrs.allocated)
         self.api.drain()
+        self.binder.tick()
         self.status_updater.flush()
         self.queue_controller.reconcile_if_dirty()
-        self.cache.gc_stale_bind_requests()
+        try:
+            self.cache.gc_stale_bind_requests()
+        except Fenced:
+            # Deposed between cycles: GC writes are the new leader's job
+            # now; the daemon's election loop will stand this one down.
+            pass
         self.api.drain()

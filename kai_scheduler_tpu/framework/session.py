@@ -44,6 +44,12 @@ class InMemoryCache:
     """Side-effect executor for tests and offline replay — the analog of
     cache.Bind/Evict (pkg/scheduler/cache/cache.go:267, evictor)."""
 
+    # Optional control-plane hooks (same surface as ClusterCache): a
+    # crash-safe bind journal and a fencing-epoch provider; statements
+    # consult both at commit time.
+    commitlog = None
+    epoch_provider = None
+
     def __init__(self):
         self.bound = []     # (task_uid, node_name)
         self.evicted = []   # task_uid

@@ -287,22 +287,64 @@ class Statement:
     def commit(self) -> list[BindRequest]:
         """Apply durable side effects: BindRequests for allocations,
         evictions via the cache/evictor.  Pipelined tasks stay in-memory —
-        they bind in a later cycle once resources actually free."""
+        they bind in a later cycle once resources actually free.
+
+        When the cache carries a commit journal (utils/commitlog.py), the
+        commit follows WAL discipline: every durable side effect's intent
+        is journaled and fsync'd as ONE batch before the first API write
+        (a gang's intents are all-or-nothing durable), then each
+        completed write appends a buffered ``done`` marker.  A crash
+        anywhere in between leaves a journal the restart reconcile pass
+        (``ClusterCache.startup_reconcile``) resolves against live API
+        state — no phantom reservations, no half-trusted history."""
+        from ..utils import commitlog as cl
+        from ..utils.deviceguard import control_fault
+
+        log = getattr(self.session.cache, "commitlog", None)
+        epoch_provider = getattr(self.session.cache, "epoch_provider", None)
+        epoch = epoch_provider() if epoch_provider is not None else None
+
+        # Pre-pass: build every BindRequest (running the plugin mutators,
+        # dynamicresources.go:252) and collect the intent records in op
+        # order, so the whole gang's intents hit the journal in one
+        # fsync'd batch before any API write.
         binds: list[BindRequest] = []
-        for op in self.ops:
+        by_op: dict[int, BindRequest] = {}
+        intents: list[dict] = []
+        for i, op in enumerate(self.ops):
             if op.kind == "allocate":
                 br = BindRequest(
                     pod_uid=op.task.uid, pod_name=op.task.name,
                     namespace=op.task.namespace, node_name=op.node_name,
                     gpu_groups=(op.gpu_group.split(",") if op.gpu_group
                                 else []))
-                # Plugin mutation hook (DRA claim lists etc. —
-                # BindRequestMutate, dynamicresources.go:252).
                 for mutator in getattr(self.session,
                                        "bind_request_mutators", []):
                     mutator(op.task, br)
                 binds.append(br)
-                self.session.cache.bind(op.task, op.node_name, br)
+                by_op[i] = br
+                if log is not None:
+                    intents.append(cl.bind_intent(
+                        op.task.uid, op.task.name, op.task.namespace,
+                        op.node_name, br.gpu_groups, epoch))
+            elif op.kind == "evict" and log is not None:
+                intents.append(cl.evict_intent(
+                    op.task.uid, op.task.name, op.task.namespace, epoch))
+        txids = iter(log.append_intents(intents) if log is not None
+                     and intents else ())
+        if log is not None and intents \
+                and control_fault("crash-after-journal") is not None:
+            # Chaos: die at the worst instant — intents durable, nothing
+            # committed.  The restart reconcile pass must make this
+            # indistinguishable from "never decided".
+            raise cl.SimulatedCrash(
+                "crash-after-journal: intents journaled, API commit "
+                "not started")
+        for i, op in enumerate(self.ops):
+            if op.kind == "allocate":
+                self.session.cache.bind(op.task, op.node_name, by_op[i])
+                if log is not None:
+                    log.mark_done(next(txids))
             elif op.kind == "pipeline":
                 # Pipelined assignments persist in the cache across cycles
                 # (Cache.TaskPipelined, cache/interface.go:36-50) so the
@@ -313,6 +355,10 @@ class Statement:
                     task_pipelined(op.task, op.node_name, op.gpu_group)
             elif op.kind == "evict":
                 self.session.cache.evict(op.task)
+                if log is not None:
+                    log.mark_done(next(txids))
+        if log is not None and intents:
+            log.flush_buffered()
         self.committed = True
         self.session.cluster.bind_requests.extend(binds)
         return binds

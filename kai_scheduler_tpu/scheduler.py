@@ -42,6 +42,9 @@ class Scheduler:
         action's uncommitted statements — committed work stands, phantom
         allocations never reach the cache — and the cycle ends degraded
         instead of wedging the daemon (docs/DEGRADATION.md)."""
+        # Deferred: controllers/__init__ imports this module (operator
+        # builds Schedulers), so a top-level import would be circular.
+        from .controllers.kubeapi import Fenced
         self.session_id += 1
         t0 = time.perf_counter()
         deadline = self.config.cycle_deadline_s
@@ -69,6 +72,12 @@ class Scheduler:
                 # out (a dispatch inside open/an action, not only the
                 # action-boundary check below).
                 METRICS.inc("scheduler_cycle_deadline_exceeded")
+            if isinstance(exc, Fenced):
+                # Deposed mid-commit: the store rejected our writes (a
+                # newer leader's epoch is in the Lease).  Everything
+                # uncommitted rolls back; this daemon must stop leading
+                # (server.py's loop exits on the elector flag).
+                METRICS.inc("scheduler_fenced_aborts")
             LOG.warning(
                 "cycle %d aborted in %s (%d statements rolled back): %s",
                 self.session_id, where, rolled, exc)
@@ -95,7 +104,7 @@ class Scheduler:
                     ta = time.perf_counter()
                     try:
                         action.execute(ssn)
-                    except DeviceGuardError as exc:
+                    except (DeviceGuardError, Fenced) as exc:
                         _abort(f"action {action.name}", exc)
                         break
                     dt = time.perf_counter() - ta

@@ -43,13 +43,30 @@ from .utils.logging import LOG, init_loggers
 from .utils.metrics import METRICS
 
 
-def healthz_payload() -> dict:
+def healthz_payload(state: dict | None = None) -> dict:
     """Liveness + degraded-mode report: alive is HTTP 200 regardless;
     ``status`` flips to "degraded" while the device-guard breaker is not
-    closed (scheduling continues on the CPU fallback path)."""
+    closed (scheduling continues on the CPU fallback path).  When the
+    daemon runs leader-elected/journaled, a ``control_plane`` section
+    reports the leadership epoch, watch-gap count, and the last startup
+    reconcile summary (docs/DEGRADATION.md failure matrix)."""
     guard = device_guard()
-    return {"status": "degraded" if guard.degraded else "ok",
-            "device_guard": guard.status()}
+    payload = {"status": "degraded" if guard.degraded else "ok",
+               "device_guard": guard.status()}
+    state = state or {}
+    elector = state.get("lease_elector")
+    control: dict = {}
+    if elector is not None:
+        control["leader"] = bool(elector.is_leader)
+        control["epoch"] = elector.epoch
+    if state.get("reconcile_summary") is not None:
+        control["startup_reconcile"] = state["reconcile_summary"]
+    gaps = METRICS.counters.get("watch_gap_total")
+    if gaps:
+        control["watch_gaps"] = gaps
+    if control:
+        payload["control_plane"] = control
+    return payload
 
 
 class LeaderElector:
@@ -88,7 +105,7 @@ def _make_handler(server_state):
                 body = METRICS.to_prometheus_text().encode()
                 ctype = "text/plain"
             elif self.path == "/healthz":
-                body = json.dumps(healthz_payload()).encode()
+                body = json.dumps(healthz_payload(server_state)).encode()
                 ctype = "application/json"
             elif self.path == "/get-snapshot":
                 ssn = server_state.get("last_session")
@@ -202,6 +219,11 @@ def run_app(argv=None) -> None:
                     help="deterministic device-fault injection for the "
                          "chaos ring: hang | slow:<ms> | error | "
                          "flaky:<p> | badshape (KAI_FAULT_INJECT analog)")
+    ap.add_argument("--commit-log", default=None,
+                    help="path to the crash-safe bind journal "
+                         "(utils/commitlog.py); statement commits "
+                         "journal intents and a restart replays them — "
+                         "unset disables journaling")
     args = ap.parse_args(argv)
 
     init_loggers(args.verbosity)
@@ -240,9 +262,19 @@ def run_app(argv=None) -> None:
         shards=[ShardSpec("default", args.node_pool_label, args.node_pool,
                           config)],
         usage_db=args.usage_db,
+        commitlog_path=args.commit_log,
         scheduling_enabled=not args.controllers_only), api=api)
 
     state: dict = {}
+    if lease_elector is not None:
+        # Fenced leadership: scheduler writes carry the Lease epoch; a
+        # deposed incarnation's writes are rejected at the store.
+        system.set_fence(args.lease_name,
+                         lambda: lease_elector.epoch)
+        state["lease_elector"] = lease_elector
+    # Restart crash-consistency pass BEFORE the first cycle: replay the
+    # bind journal, GC orphaned reservations, reap dead BindRequests.
+    state["reconcile_summary"] = system.startup_reconcile()
     if args.enable_profiler:
         from .utils.profiling import SamplingProfiler
         state["profiler"] = SamplingProfiler().start()
@@ -250,6 +282,8 @@ def run_app(argv=None) -> None:
     httpd = ThreadingHTTPServer(("127.0.0.1", args.http_port), handler)
     threading.Thread(target=httpd.serve_forever, daemon=True).start()
     LOG.info("serving http on :%d", httpd.server_port)
+
+    import urllib.error
 
     cycle = 0
     try:
@@ -260,13 +294,25 @@ def run_app(argv=None) -> None:
                 # the supervisor restarts us as a candidate.
                 LOG.warning("lost leadership; stopping scheduling loop")
                 break
-            system.run_cycle()
-            if system.schedulers:
-                # Keep the last session around for introspection endpoints.
-                ssn = system.schedulers[0].last_session
-                if ssn is not None:
-                    state["last_session"] = ssn
-                    state["job_order"] = _job_order_dump(ssn)
+            try:
+                system.run_cycle()
+                if system.schedulers:
+                    # Keep the last session for introspection endpoints.
+                    ssn = system.schedulers[0].last_session
+                    if ssn is not None:
+                        state["last_session"] = ssn
+                        state["job_order"] = _job_order_dump(ssn)
+            except (urllib.error.URLError, ConnectionError,
+                    TimeoutError, OSError) as exc:
+                # Apiserver unreachable mid-cycle: ride out the outage
+                # degraded instead of dying.  The watch thread is already
+                # backing off+reconnecting; the Lease renewal loop keeps
+                # retrying until the lease itself would have expired
+                # (utils/leaderelect.py) — so a short outage costs
+                # skipped cycles, never the daemon.
+                METRICS.inc("control_plane_outage_cycles")
+                LOG.warning("cycle %d skipped: apiserver unreachable "
+                            "(%s); retrying", cycle, exc)
             cycle += 1
             if args.cycles and cycle >= args.cycles:
                 break

@@ -1,0 +1,110 @@
+"""Chaos matrix: re-run the chaos suite under a sweep of fault seeds.
+
+A chaos test that passes once under one seed proves little — the whole
+point of deterministic fault injection (``KAI_FAULT_INJECT`` +
+``KAI_FAULT_SEED``) is that the SAME scenarios replay under different
+interleavings by just changing the seed.  This harness runs the chaos
+marker N times, each iteration with a different ``KAI_FAULT_SEED``, and
+fails on ANY flake — one red iteration out of twenty is a real
+control-plane bug with a reproducing seed, not noise to rerun away.
+
+Usage:
+
+    python -m kai_scheduler_tpu.tools.chaos_matrix --iterations 20
+    python -m kai_scheduler_tpu.tools.chaos_matrix --seeds 7,11,13 \
+        --tests tests/test_reconciler.py -k commitlog
+
+The tier-1 suite wires a 3-iteration smoke of this harness
+(tests/test_reconciler.py::test_chaos_matrix_smoke); the full sweep is
+the ``stress`` pytest marker's job (slow-gated).  Exit code 0 = every
+iteration green; 1 = at least one flake (the failing seeds are printed
+for replay).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+DEFAULT_TESTS = ["tests/test_reconciler.py", "tests/test_device_guard.py"]
+
+
+def run_iteration(seed: int, tests: list[str], marker: str,
+                  keyword: str | None, repo_root: str,
+                  timeout_s: float) -> tuple[bool, float, str]:
+    """One pytest run under one fault seed; (passed, seconds, tail)."""
+    cmd = [sys.executable, "-m", "pytest", "-q", "-p", "no:cacheprovider",
+           "-p", "no:randomly", "-m", marker, *tests]
+    # Never select the matrix-harness tests themselves: an iteration
+    # that re-runs the smoke/sweep would spawn pytest recursively.
+    cmd += ["-k", f"({keyword}) and not chaos_matrix" if keyword
+            else "not chaos_matrix"]
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               KAI_FAULT_SEED=str(seed))
+    # The matrix must control the fault spec per test, not inherit an
+    # outer one armed for a different experiment.
+    env.pop("KAI_FAULT_INJECT", None)
+    t0 = time.monotonic()
+    try:
+        proc = subprocess.run(cmd, cwd=repo_root, env=env,
+                              capture_output=True, text=True,
+                              timeout=timeout_s)
+        out = (proc.stdout or "") + (proc.stderr or "")
+        return proc.returncode == 0, time.monotonic() - t0, out[-2000:]
+    except subprocess.TimeoutExpired as exc:
+        out = ((exc.stdout or b"").decode(errors="replace")
+               if isinstance(exc.stdout, bytes) else (exc.stdout or ""))
+        return False, time.monotonic() - t0, \
+            f"TIMEOUT after {timeout_s:g}s\n{out[-1000:]}"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser("kai-chaos-matrix")
+    ap.add_argument("--iterations", type=int, default=5,
+                    help="number of runs (seeds default to 1..N)")
+    ap.add_argument("--seeds", default=None,
+                    help="comma-separated explicit KAI_FAULT_SEED sweep "
+                         "(overrides --iterations)")
+    ap.add_argument("--tests", nargs="*", default=None,
+                    help=f"test paths (default: {DEFAULT_TESTS})")
+    ap.add_argument("-k", "--keyword", default=None,
+                    help="pytest -k filter (narrow the smoke subset)")
+    ap.add_argument("--marker", default="chaos",
+                    help="pytest marker to select (default: chaos)")
+    ap.add_argument("--timeout", type=float, default=300.0,
+                    help="per-iteration timeout in seconds")
+    args = ap.parse_args(argv)
+
+    seeds = ([int(s) for s in args.seeds.split(",") if s.strip()]
+             if args.seeds else list(range(1, args.iterations + 1)))
+    tests = args.tests if args.tests else DEFAULT_TESTS
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+    rows, failed = [], []
+    for seed in seeds:
+        ok, secs, tail = run_iteration(seed, tests, args.marker,
+                                       args.keyword, repo_root,
+                                       args.timeout)
+        rows.append((seed, ok, secs))
+        status = "ok" if ok else "FLAKE"
+        print(f"seed {seed:>6}  {status:<5}  {secs:6.1f}s", flush=True)
+        if not ok:
+            failed.append(seed)
+            print(tail, flush=True)
+
+    print(f"\nchaos matrix: {len(rows) - len(failed)}/{len(rows)} green",
+          flush=True)
+    if failed:
+        print("replay a flake with: "
+              f"KAI_FAULT_SEED={failed[0]} python -m pytest -m "
+              f"{args.marker} {' '.join(tests)}", flush=True)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -13,3 +13,13 @@ def parse_bool(value: str) -> bool:
     if lowered in ("false", "0", "no", "off"):
         return False
     raise argparse.ArgumentTypeError(f"not a boolean: {value!r}")
+
+
+def backoff_delay(base_s: float, cap_s: float, attempt: int, rng,
+                  spread: float = 0.5) -> float:
+    """Jittered exponential backoff: ``min(cap, base * 2^(attempt-1))``
+    stretched by ``[1, 1+spread)`` from the caller's seeded RNG.  The one
+    implementation behind every retry loop in the fleet (binder retries,
+    watch reconnects) so thundering-herd tuning happens in one place."""
+    exp = min(max(0, attempt - 1), 16)  # bound the power, min() caps anyway
+    return min(cap_s, base_s * (2 ** exp)) * (1.0 + spread * rng.random())

@@ -1,0 +1,215 @@
+"""Crash-safe bind journal: a write-ahead intent log for statement commits.
+
+The scheduler's durable output is the statement commit — BindRequest
+creates and evictions pushed through the cache executor.  A scheduler
+that dies *between* deciding and writing (or mid-way through a gang's
+BindRequest fan-out) leaves the cluster in a state no component can
+distinguish from "never decided": phantom fractional-GPU reservations
+keep real capacity hostage, half-committed gangs deadlock (arxiv
+2603.22691 — any partial commit of a gang is a full-job loss).
+
+This module gives commits the classic WAL discipline:
+
+  1. append one ``intent`` record per durable side effect (fsync'd as a
+     batch before the first API write);
+  2. perform the API writes;
+  3. append a ``done`` record per completed write (buffered — losing a
+     ``done`` only costs an idempotent re-check on restart, never
+     correctness).
+
+On startup the reconcile pass (``ClusterCache.startup_reconcile``)
+replays the journal against live API state: intents without a matching
+``done`` are checked against the store, orphaned reservation pods are
+garbage-collected, and the journal is compacted.
+
+Record wire format — one record per line, torn-write safe:
+
+    <crc32 hex, 8 chars> <canonical JSON>\n
+
+``replay()`` verifies each line's CRC and STOPS at the first corrupt or
+truncated line (a torn tail from a crash mid-append); everything before
+it is trusted.  Records carry a monotonically increasing ``txid`` that
+survives restarts (max replayed + 1).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import zlib
+
+from .logging import LOG
+from .metrics import METRICS
+
+
+class SimulatedCrash(RuntimeError):
+    """Raised by the ``crash-after-journal`` fault between the journal
+    append and the API commit — the in-process stand-in for ``kill -9``
+    at the worst possible instant (the chaos suite's acceptance case)."""
+
+
+def _encode(record: dict) -> bytes:
+    payload = json.dumps(record, sort_keys=True,
+                         separators=(",", ":")).encode()
+    crc = zlib.crc32(payload) & 0xFFFFFFFF
+    return b"%08x " % crc + payload + b"\n"
+
+
+def _decode(line: bytes) -> dict | None:
+    """Parse one journal line; None on any corruption (bad CRC, torn
+    JSON, short line)."""
+    if len(line) < 10 or line[8:9] != b" ":
+        return None
+    try:
+        crc = int(line[:8], 16)
+    except ValueError:
+        return None
+    payload = line[9:].rstrip(b"\n")
+    if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+        return None
+    try:
+        rec = json.loads(payload)
+    except ValueError:
+        return None
+    return rec if isinstance(rec, dict) else None
+
+
+class CommitLog:
+    """File-backed append-only intent journal (one writer per file)."""
+
+    def __init__(self, path: str, fsync: bool = True):
+        self.path = path
+        self.fsync = fsync
+        self._lock = threading.Lock()
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self._records = self._replay_file()
+        self._txid = 1 + max((r.get("txid", 0) for r in self._records),
+                             default=0)
+        self._fh = open(self.path, "ab")
+
+    # -- durability --------------------------------------------------------
+    def _replay_file(self) -> list[dict]:
+        records: list[dict] = []
+        if not os.path.exists(self.path):
+            return records
+        valid_bytes = 0
+        with open(self.path, "rb") as fh:
+            for lineno, line in enumerate(fh, 1):
+                rec = _decode(line)
+                if rec is None:
+                    # Torn tail (crash mid-append) or bit rot: everything
+                    # after the first bad line is untrusted — stop, never
+                    # skip-and-continue past corruption, and TRUNCATE the
+                    # file to the valid prefix so the next append starts
+                    # a clean line instead of gluing onto the torn one.
+                    LOG.warning("commitlog %s: corrupt record at line %d; "
+                                "truncating to the valid prefix",
+                                self.path, lineno)
+                    METRICS.inc("commitlog_corrupt_records")
+                    with open(self.path, "r+b") as trunc:
+                        trunc.truncate(valid_bytes)
+                    break
+                valid_bytes += len(line)
+                records.append(rec)
+        return records
+
+    def _flush(self) -> None:
+        self._fh.flush()
+        if self.fsync:
+            os.fsync(self._fh.fileno())
+
+    # -- append API --------------------------------------------------------
+    def append(self, record: dict, flush: bool = True) -> int:
+        """Append one record; returns its txid.  ``flush=False`` buffers
+        (used for ``done`` markers, where loss is harmless)."""
+        with self._lock:
+            record = dict(record)
+            record["txid"] = self._txid
+            self._txid += 1
+            self._fh.write(_encode(record))
+            if flush:
+                self._flush()
+            self._records.append(record)
+            return record["txid"]
+
+    def append_intents(self, intents: list[dict]) -> list[int]:
+        """Append a batch of intent records with ONE fsync — the gang
+        commit's atomic journal point: either every member's intent is
+        durable before the first API write, or none are."""
+        with self._lock:
+            txids = []
+            for intent in intents:
+                rec = dict(intent)
+                rec["t"] = "intent"
+                rec["txid"] = self._txid
+                self._txid += 1
+                self._fh.write(_encode(rec))
+                self._records.append(rec)
+                txids.append(rec["txid"])
+            self._flush()
+            return txids
+
+    def mark_done(self, txid: int) -> None:
+        """The API write for ``txid`` completed; buffered (no fsync) —
+        a lost done record re-checks one intent on restart, idempotently."""
+        self.append({"t": "done", "intent": txid}, flush=False)
+
+    def flush_buffered(self) -> None:
+        """Push buffered done markers to the OS (no fsync): cheap, and
+        bounds what a crash can force the next reconcile to re-check."""
+        with self._lock:
+            self._fh.flush()
+
+    # -- replay API --------------------------------------------------------
+    def records(self) -> list[dict]:
+        with self._lock:
+            return list(self._records)
+
+    def pending_intents(self) -> list[dict]:
+        """Intents with no matching done record — the writes whose fate
+        the restart reconcile pass must determine from live API state."""
+        with self._lock:
+            done = {r.get("intent") for r in self._records
+                    if r.get("t") == "done"}
+            return [r for r in self._records
+                    if r.get("t") == "intent" and r["txid"] not in done]
+
+    def compact(self, keep: list[dict] | None = None) -> None:
+        """Rewrite the file with only ``keep`` (default: nothing).  Run
+        after a reconcile pass resolved every pending intent."""
+        with self._lock:
+            keep = list(keep or [])
+            self._fh.close()
+            tmp = self.path + ".tmp"
+            with open(tmp, "wb") as fh:
+                for rec in keep:
+                    fh.write(_encode(rec))
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, self.path)
+            self._records = keep
+            self._fh = open(self.path, "ab")
+
+    def close(self) -> None:
+        with self._lock:
+            try:
+                self._fh.flush()
+                self._fh.close()
+            except ValueError:  # already closed
+                pass
+
+
+def bind_intent(pod_uid: str, pod_name: str, namespace: str,
+                node_name: str, gpu_groups: list, epoch: int | None) -> dict:
+    """The intent record for one BindRequest create (Statement.commit)."""
+    return {"kind": "bind", "pod_uid": pod_uid, "pod_name": pod_name,
+            "namespace": namespace, "node": node_name,
+            "gpu_groups": list(gpu_groups or []), "epoch": epoch}
+
+
+def evict_intent(pod_uid: str, pod_name: str, namespace: str,
+                 epoch: int | None) -> dict:
+    """The intent record for one eviction (Statement.commit)."""
+    return {"kind": "evict", "pod_uid": pod_uid, "pod_name": pod_name,
+            "namespace": namespace, "epoch": epoch}

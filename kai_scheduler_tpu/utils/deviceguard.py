@@ -181,6 +181,29 @@ def run_with_deadline(fn, deadline_s: float | None, label: str = "device"):
 
 # -- deterministic fault injection -------------------------------------------
 
+# Control-plane fault modes (injected OUTSIDE the device guard — in the
+# apiserver watch stream, the HTTP client, and statement commit).  The
+# device-path FaultInjector skips these; components query them with
+# control_fault() below.  Specs compose comma-separated:
+#   KAI_FAULT_INJECT="flaky:0.2,watchdrop:3"
+CONTROL_FAULT_MODES = ("watchdrop", "partition", "crash-after-journal")
+
+
+def control_fault(mode: str, env=None) -> str | None:
+    """Return the argument of the control-plane ``KAI_FAULT_INJECT`` spec
+    for ``mode`` (empty string when the mode has no argument), or None
+    when the mode is not armed.  ``watchdrop[:<n>]`` drops the apiserver
+    watch stream after <n> lines, ``partition:<ms>`` fails client
+    requests for a window, ``crash-after-journal`` raises SimulatedCrash
+    between the journal append and the API commit."""
+    env = os.environ if env is None else env
+    for part in (env.get("KAI_FAULT_INJECT") or "").split(","):
+        m, _, arg = part.strip().partition(":")
+        if m.lower() == mode:
+            return arg
+    return None
+
+
 class FaultInjector:
     """Parse and apply a ``KAI_FAULT_INJECT`` spec.
 
@@ -191,10 +214,18 @@ class FaultInjector:
     whose leading array axes are truncated, the XLA wrong-shape failure
     mode).  Injection applies ONLY to the device attempt; the CPU
     fallback path always runs clean, which is exactly the degraded-mode
-    contract under test."""
+    contract under test.
+
+    Comma-separated specs compose with the control-plane modes
+    (CONTROL_FAULT_MODES): the injector uses the first device-path spec
+    and ignores control-plane ones, so one env var drives both planes."""
 
     def __init__(self, spec: str | None, seed: int = 0):
-        self.spec = (spec or "").strip()
+        parts = [p.strip() for p in (spec or "").split(",") if p.strip()]
+        device_parts = [
+            p for p in parts
+            if p.partition(":")[0].lower() not in CONTROL_FAULT_MODES]
+        self.spec = device_parts[0] if device_parts else ""
         self.mode, _, arg = self.spec.partition(":")
         self.mode = self.mode.lower()
         if self.mode not in ("", "hang", "slow", "error", "flaky",

@@ -6,22 +6,46 @@ with LeaseDuration 15s / RenewDeadline 10s / RetryPeriod 2s): candidates
 race to create-or-take a ``Lease`` object through the API (in-memory or
 HTTP — any object store with create/get/update + Conflict on stale
 resourceVersion), the holder renews on a timer, and a candidate takes over
-once ``renewTime + leaseDurationSeconds`` has elapsed.  Because the lease
-lives in the shared API store, election works across processes and hosts —
-unlike the flock elector in ``server.py``, which only serializes schedulers
-on one machine.
+once the holder has failed to renew for a full lease duration.  Because
+the lease lives in the shared API store, election works across processes
+and hosts — unlike the flock elector in ``server.py``, which only
+serializes schedulers on one machine.
+
+Two hardening properties beyond the basic race:
+
+**Monotonic timekeeping.**  Wall clocks on different hosts disagree and
+jump (NTP steps); deciding expiry by comparing *our* wall clock against
+the holder's ``renewTime`` stamp turns every clock step into a spurious
+takeover or a stuck election.  Instead, expiry is *observation-based*
+(the client-go algorithm): a candidate records when the lease's
+``(holderIdentity, renewTime)`` pair last *changed* on its own monotonic
+clock, and takes over only after the pair has been frozen for a full
+``lease_duration``.  The wall-clock stamps remain in the Lease purely as
+human-readable debugging state.  ``clock=`` stays injectable for tests
+(it then drives both stamps and deadlines); ``monotonic=`` can be
+injected separately.
+
+**Fencing epochs.**  Every successful acquisition increments a
+monotonically increasing ``epoch`` stored in the Lease spec.  Mutating
+writes from the leader carry that epoch, and the API store rejects any
+write whose epoch is older than the Lease's current one
+(``kubeapi.Fenced``) — so a deposed leader that is slow to notice (GC
+pause, partition) can never corrupt state.  ``retry_period`` sleeps are
+jittered so a fleet of candidates doesn't thundering-herd the Lease
+object the instant it expires.
 """
 
 from __future__ import annotations
 
 import copy
+import random
 import threading
 import time
 
-from ..controllers.kubeapi import Conflict, NotFound
+from ..controllers.kubeapi import FENCE_NAMESPACE, Conflict, NotFound
 
 LEASE_KIND = "Lease"
-DEFAULT_NAMESPACE = "kai-system"
+DEFAULT_NAMESPACE = FENCE_NAMESPACE
 
 
 class TransientRenewError(Exception):
@@ -34,7 +58,7 @@ class LeaseElector:
                  namespace: str = DEFAULT_NAMESPACE,
                  lease_duration: float = 15.0,
                  retry_period: float = 2.0,
-                 clock=time.time):
+                 clock=time.time, monotonic=None):
         self.api = api
         self.name = name
         self.identity = identity
@@ -42,11 +66,52 @@ class LeaseElector:
         self.lease_duration = lease_duration
         self.retry_period = retry_period
         self.clock = clock
+        # Internal deadlines run on a monotonic clock.  When a test
+        # injects a fake wall clock, that clock drives deadlines too
+        # (the fake stands for all of time); production gets
+        # time.monotonic regardless of wall-clock steps.
+        if monotonic is not None:
+            self.mono = monotonic
+        elif clock is time.time:
+            self.mono = time.monotonic
+        else:
+            self.mono = clock
+        # Deterministic per-identity jitter: candidates spread over
+        # [1.0, 1.5) * retry_period instead of herding the Lease.
+        self._jitter_rng = random.Random(hash(identity) & 0xFFFFFFFF)
+        # Last observed (holder, renewTime) pair and WHEN (on self.mono)
+        # it was first seen — the observation-based expiry state.
+        self._observed: tuple | None = None
+        self._observed_at = 0.0
         self._renew_thread: threading.Thread | None = None
         self._stop = threading.Event()
         self.is_leader = False
+        # Fencing epoch of our CURRENT leadership incarnation; 0 while
+        # not leading.  Writes carrying an older epoch than the Lease's
+        # are rejected by the store (kubeapi.Fenced).
+        self.epoch = 0
+
+    def _jittered(self, period: float) -> float:
+        return period * (1.0 + 0.5 * self._jitter_rng.random())
 
     # -- one acquisition attempt ------------------------------------------
+    def _holder_expired(self, lease: dict) -> bool:
+        """Observation-based expiry: the holder is dead only once its
+        (holderIdentity, renewTime) pair has been frozen for a full
+        lease_duration on OUR monotonic clock.  A fresh observation
+        always starts the timer — never trust wall-clock math across
+        hosts."""
+        spec = lease.get("spec", {})
+        pair = (spec.get("holderIdentity"), spec.get("renewTime"))
+        now = self.mono()
+        if self._observed != pair:
+            self._observed = pair
+            self._observed_at = now
+            return False
+        duration = float(spec.get("leaseDurationSeconds",
+                                  self.lease_duration))
+        return now - self._observed_at >= duration
+
     def try_acquire(self) -> bool:
         now = self.clock()
         spec = {"holderIdentity": self.identity,
@@ -59,7 +124,8 @@ class LeaseElector:
                 self.api.create({"kind": LEASE_KIND,
                                  "metadata": {"name": self.name,
                                               "namespace": self.namespace},
-                                 "spec": spec})
+                                 "spec": dict(spec, epoch=1)})
+                self.epoch = 1
                 return True
             except Conflict:
                 return False
@@ -68,16 +134,19 @@ class LeaseElector:
         # (in-memory get() returns the live stored object).
         lease = copy.deepcopy(lease)
         holder = lease["spec"].get("holderIdentity")
-        renew = float(lease["spec"].get("renewTime", 0))
-        duration = float(lease["spec"].get("leaseDurationSeconds",
-                                           self.lease_duration))
         if holder == self.identity:
             pass  # re-acquire our own lease (restart with same identity)
-        elif holder and now < renew + duration:
-            return False  # current holder is live
+        elif holder and not self._holder_expired(lease):
+            return False  # current holder is live (by our observation)
+        # Every acquisition — takeover, released lease, or our own
+        # restart — is a new leadership incarnation: bump the fencing
+        # epoch so writes from the previous incarnation are rejected.
+        epoch = int(lease["spec"].get("epoch", 0) or 0) + 1
         lease["spec"].update(spec)
+        lease["spec"]["epoch"] = epoch
         try:
             self.api.update(lease)
+            self.epoch = epoch
             return True
         except (Conflict, NotFound):
             return False
@@ -120,7 +189,7 @@ class LeaseElector:
                 return True
             if deadline is not None and time.monotonic() >= deadline:
                 return False
-            time.sleep(self.retry_period)
+            time.sleep(self._jittered(self.retry_period))
         return False
 
     def _start_renewal(self) -> None:
@@ -128,7 +197,7 @@ class LeaseElector:
 
         def loop():
             last_success = time.monotonic()
-            while not self._stop.wait(self.retry_period):
+            while not self._stop.wait(self._jittered(self.retry_period)):
                 try:
                     ok = self.renew()
                 except TransientRenewError:
@@ -155,9 +224,11 @@ class LeaseElector:
             try:
                 lease = self.api.get(LEASE_KIND, self.name, self.namespace)
                 if lease["spec"].get("holderIdentity") == self.identity:
+                    lease = copy.deepcopy(lease)
                     lease["spec"]["holderIdentity"] = ""
                     lease["spec"]["renewTime"] = 0
                     self.api.update(lease)
             except (NotFound, Conflict):
                 pass
         self.is_leader = False
+        self.epoch = 0

@@ -242,8 +242,8 @@ class TestLeaseElection:
 
 class TestWatchTooOld:
     def test_sync_replay_after_ring_eviction(self, server):
-        """A client resuming from before the ring horizon gets a TOO_OLD
-        marker followed by SYNC events replaying current state."""
+        """A client resuming from before the ring horizon gets 410 GONE
+        and re-lists, converging its handlers on current state."""
         from kai_scheduler_tpu.controllers import apiserver as apimod
         server.log._events = server.log._events.__class__(maxlen=4)
         c = HTTPKubeAPI(server.url)
@@ -277,6 +277,76 @@ class TestElectorReacquire:
         e.release()
         assert e.acquire(timeout=2), "elector must be re-entrant"
         e.release()
+
+
+class TestApiserverRestart:
+    def test_watch_survives_full_server_restart(self):
+        """The client watch survives a full ThreadingHTTPServer
+        stop/start on the same port: the restarted server's event seq
+        resets to 0, the client's resume point is now AHEAD of the
+        ring's head, the server answers GONE, and the client re-lists —
+        converging on mutations made while it was down and streaming new
+        events afterwards.  The rebuilt store view matches a fresh
+        snapshot."""
+        from kai_scheduler_tpu.controllers import InMemoryKubeAPI
+        from kai_scheduler_tpu.controllers.cache_builder import ClusterCache
+        from kai_scheduler_tpu.controllers.kubeapi import obj_key
+
+        api = InMemoryKubeAPI()
+        srv = KubeAPIServer(api=api).start()
+        port = srv.port
+        c = HTTPKubeAPI(srv.url)
+        seen = []
+        c.watch("Queue", lambda et, obj: seen.append(
+            (et, obj["metadata"]["name"])))
+        for i in range(3):
+            c.create({"kind": "Queue", "metadata": {"name": f"pre{i}"},
+                      "spec": {}})
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and len(seen) < 3:
+            c.drain()
+            time.sleep(0.02)
+        assert c._watch_seq >= 3
+        # Full restart: stop the HTTP server, mutate the store while no
+        # server runs (those events are lost to any watcher), restart on
+        # the SAME port with a FRESH event log (seq resets to 0).
+        srv.stop()
+        api.create({"kind": "Queue", "metadata": {"name": "while-down"},
+                    "spec": {}})
+        api.delete("Queue", "pre0")
+        srv2 = KubeAPIServer(api=api, port=port).start()
+        try:
+            deadline = time.monotonic() + 15.0
+            while time.monotonic() < deadline:
+                c.drain()
+                names = {n for et, n in seen if et != "DELETED"}
+                if "while-down" in names and ("DELETED", "pre0") in seen:
+                    break
+                time.sleep(0.05)
+            names = {n for et, n in seen if et != "DELETED"}
+            assert "while-down" in names, "relist missed offline mutation"
+            assert ("DELETED", "pre0") in seen, \
+                "relist must synthesize offline deletions"
+            # The stream is LIVE again: post-restart events flow.
+            c.create({"kind": "Queue", "metadata": {"name": "after"},
+                      "spec": {}})
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline and \
+                    ("ADDED", "after") not in seen:
+                c.drain()
+                time.sleep(0.02)
+            assert ("ADDED", "after") in seen
+            # Rebuilt client mirror == the store, and a cache built over
+            # the client matches a fresh in-process Snapshot().
+            assert set(c._known) == set(api.objects)
+            over_wire = ClusterCache(c).snapshot()
+            fresh = ClusterCache(api).snapshot()
+            assert sorted(over_wire.queues) == sorted(fresh.queues)
+            assert sorted(over_wire.nodes) == sorted(fresh.nodes)
+            assert sorted(over_wire.podgroups) == sorted(fresh.podgroups)
+        finally:
+            c.close()
+            srv2.stop()
 
 
 class TestSyncDeletions:

@@ -503,13 +503,18 @@ class TestDeletionAndBinderFailure:
         assert api.list("BindRequest") == []
 
     def test_bind_failure_retries_then_fails_with_rollback(self):
-        """Bind to a nonexistent node retries up to the backoff limit and
-        ends Failed, releasing the GPU reservation it took
-        (bindrequest_controller + Binder.Rollback)."""
+        """Bind to a nonexistent node retries up to the backoff limit
+        with EXPONENTIAL BACKOFF between attempts (no hot loop), ends
+        Failed releasing the GPU reservation it took, and emits a
+        bind_backoff_exceeded event (bindrequest_controller +
+        Binder.Rollback)."""
         from kai_scheduler_tpu.controllers.binder import (
             RESERVATION_NAMESPACE)
         system = System(SystemConfig())
         api = system.api
+        clock = {"t": 100.0}
+        system.binder.now_fn = lambda: clock["t"]
+        system.binder.backoff_base_s = 1.0
         api.create({"kind": "BindRequest",
                     "metadata": {"name": "bad-bind"},
                     "spec": {"podName": "nope", "podUid": "x",
@@ -519,10 +524,29 @@ class TestDeletionAndBinderFailure:
                     "status": {"phase": "Pending"}})
         api.drain()
         br = api.get("BindRequest", "bad-bind")
+        # First attempt failed; the request is backing off, NOT hot-
+        # looping to Failed within one drain pass.
+        assert br["status"]["phase"] == "Pending"
+        assert br["status"]["attempts"] == 1
+        assert br["status"]["backoffUntil"] > clock["t"]
+        # Draining again before the backoff elapses must not burn an
+        # attempt (the hot-loop regression this satellite fixes).
+        api.drain()
+        system.binder.tick()
+        assert api.get("BindRequest", "bad-bind")["status"]["attempts"] == 1
+        # Advance past the backoff: the retry runs, exhausts the limit.
+        clock["t"] += 10.0
+        system.binder.tick()
+        api.drain()
+        br = api.get("BindRequest", "bad-bind")
         assert br["status"]["phase"] == "Failed"
         assert br["status"]["attempts"] >= 2
         # No reservation pod survives the rollback.
         assert api.list("Pod", namespace=RESERVATION_NAMESPACE) == []
+        # The exhaustion is announced loudly.
+        events = [e for e in api.list("Event")
+                  if e["spec"]["reason"] == "bind_backoff_exceeded"]
+        assert events, "bind_backoff_exceeded event missing"
 
 
 class TestAdmissionRuntimeAndMetrics:

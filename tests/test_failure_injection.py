@@ -65,37 +65,52 @@ class TestBinderDeathMidBind:
                            for br in brs)
 
         # "Restart": a brand-new fleet over the same objects finishes.
+        # The successor starts after the crashed requests' backoff
+        # window (binder retries are exponentially backed off now, not
+        # hot-looped).
         reborn = System(SystemConfig(), api=api)
+        reborn.binder.now_fn = lambda: time.time() + 300.0
         for _ in range(3):
             reborn.run_cycle()
         bound = [p for p in api.list("Pod") if p["spec"].get("nodeName")]
         assert len(bound) == 3
 
     def test_exhausted_backoff_rolls_back(self):
-        """A permanently failing bind hits its backoff limit, the request
-        goes Failed, and the pod stays unbound for a future cycle."""
+        """A permanently failing bind hits its backoff limit — one
+        attempt per elapsed backoff window, never a hot loop — the
+        request goes Failed, and the pod stays unbound for a future
+        cycle."""
         system = System(SystemConfig())
         api = system.api
         make_node(api, "n1")
         make_queue(api)
         api.create(make_pod("doomed", queue="q", gpu=2))
         binder = system.binder
+        clock = {"t": 1000.0}
+        binder.now_fn = lambda: clock["t"]
 
         def always_fail(br):
             raise RuntimeError("node gone")
 
         binder._bind = always_fail
+        system.run_cycle()  # schedules + first (failing) bind attempt
+        br = api.list("BindRequest")[0]
+        assert br["status"]["phase"] == "Pending"
+        assert br["status"]["attempts"] == 1
+        # Each elapsed backoff window buys exactly one more attempt.
         for _ in range(4):
-            system.run_cycle()
+            clock["t"] += 120.0  # past the backoff cap
+            system.binder.tick()
+            api.drain()
         brs = [br for br in api.list("BindRequest")]
-        assert all(br["status"]["phase"] == "Failed" for br in brs)
+        assert brs and all(br["status"]["phase"] == "Failed" for br in brs)
         assert not api.get("Pod", "doomed")["spec"].get("nodeName")
 
 
 class TestWatchDropUnderChurn:
     def test_client_reconnect_converges_under_churn(self):
         """A controller's watch stream drops while objects churn; after
-        reconnect (seq resume or TOO_OLD replay) its view converges."""
+        reconnect (seq resume or 410-GONE re-list) its view converges."""
         srv = KubeAPIServer().start()
         try:
             writer = HTTPKubeAPI(srv.url)

@@ -1,0 +1,588 @@
+"""Control-plane reconciler chaos suite (marker ``chaos``, tier-1).
+
+PR 1's device-guard chaos ring killed the *data plane*; this suite kills
+the *control plane* and asserts the three crash-consistency invariants
+of the reconciler (ISSUE 2 acceptance criteria):
+
+(a) **watch-gap recovery** — a watcher that misses more events than the
+    apiserver's ring retains gets an explicit 410 GONE, re-lists, and
+    converges to exactly the state a fresh list sees;
+(b) **fenced leadership** — a deposed leader's late write is rejected
+    with ``Fenced`` at the store, and no object ever carries a stale
+    epoch;
+(c) **crash-safe bind journal** — a kill between the journal append and
+    the API commit leaves zero phantom reservation pods once the
+    restart reconcile pass runs.
+
+Faults are injected deterministically via the extended
+``KAI_FAULT_INJECT`` modes (``watchdrop``, ``partition:<ms>``,
+``crash-after-journal``) — no real cluster, no real TPU, seeded via
+``KAI_FAULT_SEED`` (tools/chaos_matrix.py sweeps the seeds).
+"""
+
+import os
+import time
+import urllib.error
+
+import pytest
+
+from kai_scheduler_tpu.controllers import (HTTPKubeAPI, InMemoryKubeAPI,
+                                           KubeAPIServer, System,
+                                           SystemConfig, make_pod)
+from kai_scheduler_tpu.controllers.binder import (GPU_GROUP_ANNOTATION,
+                                                  RESERVATION_NAMESPACE)
+from kai_scheduler_tpu.controllers.cache_builder import ClusterCache
+from kai_scheduler_tpu.controllers.kubeapi import Fenced, obj_key
+from kai_scheduler_tpu.utils.commitlog import (CommitLog, SimulatedCrash,
+                                               bind_intent)
+from kai_scheduler_tpu.utils.leaderelect import LeaseElector
+from kai_scheduler_tpu.utils.metrics import METRICS
+
+pytestmark = pytest.mark.chaos
+
+
+def make_node(api, name, gpu=8):
+    api.create({"kind": "Node", "metadata": {"name": name},
+                "spec": {},
+                "status": {"allocatable": {"cpu": "32", "memory": "256Gi",
+                                           "nvidia.com/gpu": gpu,
+                                           "pods": 110}}})
+
+
+def make_queue(api, name="q"):
+    api.create({"kind": "Queue", "metadata": {"name": name},
+                "spec": {"deserved": {"cpu": "64", "memory": "512Gi",
+                                      "gpu": 16}}})
+
+
+def reservation_pod(api, group, node="n1"):
+    api.create({
+        "kind": "Pod",
+        "metadata": {"name": f"reservation-{group}",
+                     "namespace": RESERVATION_NAMESPACE,
+                     "labels": {"app": "kai-resource-reservation",
+                                GPU_GROUP_ANNOTATION: group}},
+        "spec": {"nodeName": node},
+        "status": {"phase": "Running"}})
+
+
+class FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# Commit journal (utils/commitlog.py)
+# ---------------------------------------------------------------------------
+
+class TestCommitLog:
+    def test_commitlog_roundtrip_and_pending(self, tmp_path):
+        path = str(tmp_path / "commit.log")
+        log = CommitLog(path)
+        txids = log.append_intents([
+            bind_intent("u1", "p1", "default", "n1", ["g1"], 3),
+            bind_intent("u2", "p2", "default", "n2", [], 3)])
+        log.mark_done(txids[0])
+        log.flush_buffered()
+        log.close()
+        # Reopen (the restart): only the un-done intent is pending, and
+        # the txid counter resumes past everything replayed.
+        log2 = CommitLog(path)
+        pending = log2.pending_intents()
+        assert [p["pod_uid"] for p in pending] == ["u2"]
+        assert pending[0]["epoch"] == 3
+        new_txid = log2.append({"t": "intent", "kind": "bind",
+                                "pod_uid": "u3"})
+        assert new_txid > max(txids)
+        log2.close()
+
+    def test_commitlog_torn_tail_stops_replay(self, tmp_path):
+        path = str(tmp_path / "commit.log")
+        log = CommitLog(path)
+        log.append_intents([bind_intent("u1", "p1", "default", "n1",
+                                        [], None)])
+        log.append_intents([bind_intent("u2", "p2", "default", "n1",
+                                        [], None)])
+        log.close()
+        # Tear the last record mid-line (crash mid-append).
+        with open(path, "rb") as fh:
+            raw = fh.read()
+        with open(path, "wb") as fh:
+            fh.write(raw[:-7])
+        log2 = CommitLog(path)
+        assert [r["pod_uid"] for r in log2.pending_intents()] == ["u1"]
+        # The torn tail was truncated away: appends after a torn-tail
+        # recovery start a clean line and survive the NEXT restart too.
+        log2.append_intents([bind_intent("u3", "p3", "default", "n2",
+                                         [], None)])
+        log2.close()
+        log3 = CommitLog(path)
+        assert [r["pod_uid"] for r in log3.pending_intents()] == \
+            ["u1", "u3"]
+        log3.close()
+
+    def test_commitlog_crc_corruption_stops_replay(self, tmp_path):
+        path = str(tmp_path / "commit.log")
+        log = CommitLog(path)
+        log.append_intents([
+            bind_intent("u1", "p1", "default", "n1", [], None),
+            bind_intent("u2", "p2", "default", "n1", [], None)])
+        log.close()
+        lines = open(path, "rb").read().splitlines(keepends=True)
+        # Flip a payload byte in record 1: its CRC no longer matches, so
+        # replay must trust NOTHING from there on.
+        corrupt = lines[0][:20] + b"X" + lines[0][21:]
+        with open(path, "wb") as fh:
+            fh.write(corrupt + b"".join(lines[1:]))
+        log2 = CommitLog(path)
+        assert log2.pending_intents() == []
+        log2.close()
+
+    def test_commitlog_compact_drops_resolved(self, tmp_path):
+        path = str(tmp_path / "commit.log")
+        log = CommitLog(path)
+        log.append_intents([bind_intent("u1", "p1", "default", "n1",
+                                        [], None)])
+        log.compact()
+        assert log.pending_intents() == []
+        log.close()
+        assert CommitLog(path).pending_intents() == []
+
+
+# ---------------------------------------------------------------------------
+# (a) Watch-gap recovery: 410 GONE + re-list convergence
+# ---------------------------------------------------------------------------
+
+class TestWatchGapRecovery:
+    def test_gap_beyond_ring_converges_to_fresh_list(self):
+        """A watcher that misses MORE events than the ring's capacity
+        gets GONE, re-lists, and converges byte-for-byte to what a fresh
+        list returns — including deletions whose events were evicted."""
+        srv = KubeAPIServer(event_log_capacity=8).start()
+        try:
+            c = HTTPKubeAPI(srv.url)
+            seen = []
+            c.watch("Queue", lambda et, obj: seen.append(
+                (et, obj["metadata"]["name"])))
+            c.create({"kind": "Queue", "metadata": {"name": "doomed"},
+                      "spec": {}})
+            c.wait_for_events()
+            c.drain()
+            gaps_before = METRICS.counters.get("watch_gap_total", 0)
+            # Disconnect; churn way past the ring capacity (>= 8 events
+            # lost, including doomed's DELETED).
+            c._stop.set()
+            time.sleep(0.05)
+            c.delete("Queue", "doomed")
+            for i in range(16):
+                c.create({"kind": "Queue",
+                          "metadata": {"name": f"q{i}"}, "spec": {}})
+            c._stop.clear()
+            c._ensure_watch_thread()
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                c.drain()
+                names = {n for et, n in seen if et != "DELETED"}
+                if ("DELETED", "doomed") in seen \
+                        and {f"q{i}" for i in range(16)} <= names:
+                    break
+                time.sleep(0.02)
+            assert ("DELETED", "doomed") in seen
+            # The client's store view == a fresh list (the invariant).
+            fresh = {obj_key(o): o["metadata"]["resourceVersion"]
+                     for o in c.list("Queue")}
+            mirror = {k: o["metadata"]["resourceVersion"]
+                      for k, o in c._known.items() if k[0] == "Queue"}
+            assert mirror == fresh
+            assert METRICS.counters.get("watch_gap_total", 0) > gaps_before
+            c.close()
+        finally:
+            srv.stop()
+
+    def test_restart_with_caught_up_seq_still_relists(self):
+        """The nasty restart case: the new server's event log has already
+        caught up PAST the client's old cursor before it reconnects, so
+        seq ordering alone looks valid — only the boot-id mismatch can
+        reveal that the numbering belongs to a different lifetime.
+        Without GONE here the client would silently miss the offline
+        mutations (including a deletion) forever."""
+        api = InMemoryKubeAPI()
+        srv = KubeAPIServer(api=api).start()
+        port = srv.port
+        c = HTTPKubeAPI(srv.url)
+        seen = []
+        c.watch("Queue", lambda et, obj: seen.append(
+            (et, obj["metadata"]["name"])))
+        for i in range(5):
+            c.create({"kind": "Queue", "metadata": {"name": f"q{i}"},
+                      "spec": {}})
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and len(seen) < 5:
+            c.drain()
+            time.sleep(0.02)
+        assert c._watch_seq >= 5
+        srv.stop()
+        # Restart on the same port; pump MORE events than the client's
+        # cursor into the fresh log BEFORE serving, so the new head
+        # (9) > client cursor (5): the ordering heuristic alone would
+        # resume "validly" and silently skip events 1..5 of the new
+        # life — among them q0's deletion.
+        srv2 = KubeAPIServer(api=api, port=port)
+        api.delete("Queue", "q0")
+        for i in range(8):
+            api.create({"kind": "Queue", "metadata": {"name": f"r{i}"},
+                        "spec": {}})
+        api.drain()
+        assert srv2.log.seq > c._watch_seq
+        srv2.start()
+        try:
+            deadline = time.monotonic() + 15.0
+            while time.monotonic() < deadline:
+                c.drain()
+                if ("DELETED", "q0") in seen and \
+                        {f"r{i}" for i in range(8)} <= \
+                        {n for et, n in seen if et != "DELETED"}:
+                    break
+                time.sleep(0.05)
+            assert ("DELETED", "q0") in seen, \
+                "boot-id mismatch must force a relist"
+            fresh = {obj_key(o) for o in api.objects.values()}
+            assert set(c._known) == fresh
+            c.close()
+        finally:
+            srv2.stop()
+
+    def test_watchdrop_stream_continuity(self, monkeypatch):
+        """The watchdrop fault kills the stream every N lines; seq-based
+        resumption must deliver every event exactly once anyway."""
+        monkeypatch.setenv("KAI_FAULT_INJECT", "watchdrop:3")
+        srv = KubeAPIServer().start()
+        try:
+            c = HTTPKubeAPI(srv.url)
+            seen = []
+            c.watch("Queue", lambda et, obj: seen.append(
+                (et, obj["metadata"]["name"])))
+            for i in range(12):
+                c.create({"kind": "Queue",
+                          "metadata": {"name": f"w{i}"}, "spec": {}})
+            deadline = time.monotonic() + 10.0
+            want = {("ADDED", f"w{i}") for i in range(12)}
+            while time.monotonic() < deadline and set(seen) != want:
+                c.drain()
+                time.sleep(0.02)
+            assert set(seen) == want
+            # Exactly once: reconnects resume from seq, never replay.
+            assert len(seen) == 12
+            c.close()
+        finally:
+            srv.stop()
+
+    def test_partition_recovery(self, monkeypatch):
+        """A network partition fails every client call for a window; the
+        watcher backs off, reconnects, and the fleet converges once the
+        partition heals — no lost events, no wedged thread."""
+        srv = KubeAPIServer().start()
+        try:
+            c = HTTPKubeAPI(srv.url)
+            seen = []
+            c.watch("Queue", lambda et, obj: seen.append(
+                obj["metadata"]["name"]))
+            c.create({"kind": "Queue", "metadata": {"name": "pre"},
+                      "spec": {}})
+            c.wait_for_events()
+            c.drain()
+            monkeypatch.setenv("KAI_FAULT_INJECT", "partition:300")
+            with pytest.raises(urllib.error.URLError):
+                c.create({"kind": "Queue", "metadata": {"name": "cut"},
+                          "spec": {}})
+            # Window elapses; the same client heals without restart.
+            time.sleep(0.35)
+            c.create({"kind": "Queue", "metadata": {"name": "post"},
+                      "spec": {}})
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline and "post" not in seen:
+                c.drain()
+                time.sleep(0.02)
+            assert "post" in seen
+            c.close()
+        finally:
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# (b) Fenced leadership: a deposed leader can never commit
+# ---------------------------------------------------------------------------
+
+class TestFencedLeadership:
+    def _depose(self, api):
+        """Leader A (epoch 1) is deposed by B (epoch 2); returns both."""
+        clock = FakeClock()
+        a = LeaseElector(api, "sched", "a", lease_duration=10, clock=clock)
+        b = LeaseElector(api, "sched", "b", lease_duration=10, clock=clock)
+        assert a.try_acquire() and a.epoch == 1
+        assert not b.try_acquire()  # observes the live holder
+        clock.t += 11
+        assert b.try_acquire() and b.epoch == 2
+        return a, b
+
+    def test_deposed_leader_bind_rejected_no_stale_epoch(self):
+        """Acceptance (b): the deposed leader's late BindRequest write
+        raises Fenced and no object in the store carries a stale epoch."""
+        api = InMemoryKubeAPI()
+        a, b = self._depose(api)
+
+        class T:  # minimal task for ClusterCache.bind
+            uid, name, namespace = "u1", "p1", "default"
+
+            class res_req:
+                gpu_fraction = 0
+
+        class BR:
+            gpu_groups, backoff_limit = [], 3
+            resource_claims, claim_allocations = [], []
+
+        stale = ClusterCache(api)
+        stale.set_fence("sched", lambda: a.epoch)   # deposed epoch 1
+        with pytest.raises(Fenced):
+            stale.bind(T(), "n1", BR())
+        assert api.list("BindRequest") == []
+        assert METRICS.counters.get("fenced_writes_total", 0) >= 1
+
+        fresh = ClusterCache(api)
+        fresh.set_fence("sched", lambda: b.epoch)   # current epoch 2
+        fresh.bind(T(), "n1", BR())
+        current_epoch = api.get("Lease", "sched",
+                                "kai-system")["spec"]["epoch"]
+        for br in api.list("BindRequest"):
+            assert br["spec"]["schedulerEpoch"] == current_epoch
+        # Nothing anywhere carries an epoch older than the Lease's.
+        for obj in api.objects.values():
+            stamped = obj.get("spec", {}).get("schedulerEpoch")
+            assert stamped is None or stamped >= current_epoch
+
+    def test_fenced_commit_aborts_cycle_with_rollback(self):
+        """A scheduler fenced mid-commit aborts the cycle through the
+        existing abort_uncommitted rollback: no phantom allocations, the
+        daemon survives, and the pod stays Pending for the new leader."""
+        system = System(SystemConfig())
+        api = system.api
+        make_node(api, "n1")
+        make_queue(api)
+        api.create(make_pod("orphaned-decision", queue="q", gpu=1))
+        api.drain()
+        # Depose AFTER the system exists: its writes now carry epoch 1
+        # against a Lease at epoch 2.
+        a, b = self._depose(api)
+        system.set_fence("sched", lambda: a.epoch)
+        aborts_before = METRICS.counters.get("scheduler_cycle_aborts", 0)
+        system.run_cycle()
+        ssn = system.schedulers[0].last_session
+        assert ssn.aborted and "epoch 1" in ssn.aborted
+        assert METRICS.counters.get("scheduler_cycle_aborts", 0) > \
+            aborts_before
+        assert METRICS.counters.get("scheduler_fenced_aborts", 0) >= 1
+        # Nothing committed, nothing phantom: no BindRequest, pod
+        # untouched for the new leader to schedule.
+        assert api.list("BindRequest") == []
+        pod = api.get("Pod", "orphaned-decision")
+        assert not pod["spec"].get("nodeName")
+        # The rolled-back session shows no residual allocation.
+        pg = next(iter(ssn.cluster.podgroups.values()))
+        assert all(t.node_name == "" for t in pg.pods.values())
+
+    def test_fenced_over_http_wire(self):
+        """The fence survives the HTTP dialect: 412 maps back to Fenced."""
+        srv = KubeAPIServer().start()
+        try:
+            c = HTTPKubeAPI(srv.url)
+            a, b = self._depose(c)
+            c.set_fence("sched", lambda: a.epoch)  # stale incarnation
+            with pytest.raises(Fenced):
+                c.create({"kind": "BindRequest",
+                          "metadata": {"name": "late"}, "spec": {}})
+            c.set_fence("sched", lambda: b.epoch)
+            c.create({"kind": "BindRequest",
+                      "metadata": {"name": "ontime"}, "spec": {}})
+            assert [o["metadata"]["name"]
+                    for o in c.list("BindRequest")] == ["ontime"]
+            c.close()
+        finally:
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# (c) Crash-safe bind journal: kill -9 between journal and API commit
+# ---------------------------------------------------------------------------
+
+class TestCrashSafeJournal:
+    def test_crash_after_journal_zero_phantom_reservations(
+            self, tmp_path, monkeypatch):
+        """Acceptance (c): the scheduler journals its bind intents and
+        dies before any API write.  After 'restart', the reconcile pass
+        must leave ZERO phantom reservation pods and re-schedule the pod
+        from scratch."""
+        log_path = str(tmp_path / "bind.journal")
+        system = System(SystemConfig(commitlog_path=log_path))
+        api = system.api
+        make_node(api, "n1")
+        make_queue(api)
+        # A reservation pod orphaned by an EARLIER incarnation's partial
+        # bind: no live pod annotation, no BindRequest references g-dead.
+        reservation_pod(api, "g-dead")
+        # And a legitimately-held reservation that must SURVIVE the GC.
+        reservation_pod(api, "g-live")
+        held = make_pod("holder", queue="q", gpu=1, node_name="n1",
+                        phase="Running")
+        held["metadata"]["annotations"][GPU_GROUP_ANNOTATION] = "g-live"
+        api.create(held)
+        api.create(make_pod("victim-of-crash", queue="q", gpu=1))
+        api.drain()
+        monkeypatch.setenv("KAI_FAULT_INJECT", "crash-after-journal")
+        with pytest.raises(SimulatedCrash):
+            system.run_cycle()
+        monkeypatch.delenv("KAI_FAULT_INJECT")
+        # The intent is durable, the commit never happened.
+        assert api.list("BindRequest") == []
+        assert CommitLog(log_path).pending_intents(), \
+            "crash left no journaled intent to reconcile"
+
+        # ---- restart: same store, same journal, fresh process ----
+        system2 = System(SystemConfig(commitlog_path=log_path), api=api)
+        summary = system2.startup_reconcile()
+        assert summary["lost_commits"] == 1
+        assert summary["orphaned_reservations"] == 1
+        # ZERO phantom reservation pods: every survivor is backed by a
+        # live annotated pod.
+        leftover = {p["metadata"]["labels"][GPU_GROUP_ANNOTATION]
+                    for p in api.list("Pod",
+                                      namespace=RESERVATION_NAMESPACE)}
+        assert leftover == {"g-live"}
+        # The journal is compacted — the next crash replays nothing old.
+        assert system2.commitlog.pending_intents() == []
+        # And the lost decision is simply re-made: the pod binds.
+        for _ in range(3):
+            system2.run_cycle()
+        pod = api.get("Pod", "victim-of-crash")
+        assert pod["spec"].get("nodeName") == "n1"
+
+    def test_clean_commit_reconciles_as_recovered(self, tmp_path):
+        """A commit that finished (intents + API writes + done markers)
+        reconciles with zero lost commits and keeps its BindRequest."""
+        log_path = str(tmp_path / "bind.journal")
+        system = System(SystemConfig(commitlog_path=log_path))
+        api = system.api
+        make_node(api, "n1")
+        make_queue(api)
+        api.create(make_pod("clean", queue="q", gpu=1))
+        api.drain()
+        for _ in range(2):
+            system.run_cycle()
+        assert api.get("Pod", "clean")["spec"].get("nodeName")
+        system2 = System(SystemConfig(commitlog_path=log_path), api=api)
+        summary = system2.startup_reconcile()
+        assert summary["lost_commits"] == 0
+
+    def test_reap_exhausted_bind_requests(self):
+        """Startup reconcile reaps BindRequests past their backoff
+        budget so their pods re-enter scheduling — and reaps BEFORE the
+        orphan scan, so a dead-but-Pending request's reservations are
+        cleaned in the SAME pass, not two restarts later."""
+        api = InMemoryKubeAPI()
+        api.create(make_pod("stuck"))
+        api.create({"kind": "BindRequest",
+                    "metadata": {"name": "bind-stuck"},
+                    "spec": {"podName": "stuck", "podUid": "u-stuck",
+                             "selectedNode": "gone", "backoffLimit": 2,
+                             "selectedGPUGroups": ["g-stuck"]},
+                    "status": {"phase": "Pending", "attempts": 2}})
+        api.create({"kind": "BindRequest",
+                    "metadata": {"name": "bind-dead"},
+                    "spec": {"podName": "stuck", "podUid": "u-dead",
+                             "selectedNode": "gone"},
+                    "status": {"phase": "Failed", "attempts": 3}})
+        # The reservation the exhausted-Pending request took before its
+        # binder died (rollback never ran): must go in THIS pass.
+        reservation_pod(api, "g-stuck")
+        cache = ClusterCache(api)
+        summary = cache.startup_reconcile()
+        assert summary["reaped_bind_requests"] == 2
+        assert api.list("BindRequest") == []
+        assert summary["orphaned_reservations"] == 1
+        assert api.list("Pod", namespace=RESERVATION_NAMESPACE) == []
+
+
+# ---------------------------------------------------------------------------
+# Lease timekeeping under wall-clock jumps (satellite)
+# ---------------------------------------------------------------------------
+
+class TestLeaseMonotonicClock:
+    def test_wall_clock_jump_does_not_steal_live_lease(self):
+        """An NTP step on the candidate must not depose a live leader:
+        expiry is observation-based on the candidate's monotonic clock,
+        not wall-clock arithmetic against the holder's stamp."""
+        api = InMemoryKubeAPI()
+        wall, mono = FakeClock(1000.0), FakeClock(50.0)
+        a = LeaseElector(api, "sched", "a", lease_duration=10,
+                         clock=wall, monotonic=mono)
+        b = LeaseElector(api, "sched", "b", lease_duration=10,
+                         clock=wall, monotonic=mono)
+        assert a.try_acquire()
+        wall.t += 10_000          # candidate's wall clock jumps an hour+
+        assert not b.try_acquire(), \
+            "wall-clock jump must not steal a live lease"
+        # Leader keeps renewing: observation keeps resetting, no steal.
+        mono.t += 6
+        assert a.renew()
+        mono.t += 6
+        assert not b.try_acquire()
+        # Leader actually dies: takeover after a FULL quiet duration.
+        mono.t += 10
+        assert b.try_acquire()
+        assert b.epoch == a.epoch + 1
+
+    def test_epoch_strictly_increases_per_acquisition(self):
+        api = InMemoryKubeAPI()
+        wall, mono = FakeClock(), FakeClock()
+        e = LeaseElector(api, "sched", "x", lease_duration=5,
+                         clock=wall, monotonic=mono)
+        assert e.try_acquire() and e.epoch == 1
+        # Same identity re-acquires (process restart): new incarnation,
+        # higher epoch — its predecessor's writes must fence out.
+        assert e.try_acquire() and e.epoch == 2
+
+    def test_jitter_spreads_retry_period(self):
+        api = InMemoryKubeAPI()
+        e = LeaseElector(api, "sched", "x", retry_period=2.0)
+        samples = {round(e._jittered(2.0), 6) for _ in range(16)}
+        assert all(2.0 <= s < 3.0 for s in samples)
+        assert len(samples) > 1, "jitter must actually vary"
+
+
+# ---------------------------------------------------------------------------
+# Chaos matrix smoke (tier-1 slice of the stress sweep)
+# ---------------------------------------------------------------------------
+
+class TestChaosMatrix:
+    def test_chaos_matrix_smoke(self):
+        """3 iterations of the fast commitlog subset under distinct
+        fault seeds — the tier-1 guard that the matrix harness itself
+        works and the chaos tests are seed-stable."""
+        from kai_scheduler_tpu.tools.chaos_matrix import main
+        rc = main(["--iterations", "3",
+                   "--tests", "tests/test_reconciler.py",
+                   "-k", "commitlog", "--timeout", "120"])
+        assert rc == 0
+
+
+@pytest.mark.stress
+@pytest.mark.slow
+class TestChaosMatrixStress:
+    def test_chaos_matrix_full_sweep(self):
+        """The full matrix: every chaos test, 10 seeds, fail on any
+        flake (slow-gated; CI runs it on the stress path)."""
+        from kai_scheduler_tpu.tools.chaos_matrix import main
+        rc = main(["--iterations", "10", "--timeout", "600"])
+        assert rc == 0
