@@ -15,8 +15,11 @@ import time
 import uuid
 
 from ..utils import backoff_delay
+from ..utils.logging import ScopedLogger
 from ..utils.metrics import METRICS
 from .kubeapi import Conflict, InMemoryKubeAPI
+
+log = ScopedLogger("binder")
 
 RESERVATION_NAMESPACE = "kai-resource-reservation"
 GPU_GROUP_ANNOTATION = "kai.scheduler/gpu-group"
@@ -178,8 +181,13 @@ class Binder:
                 "kind": "Event",
                 "metadata": {"name": f"bind-evt-{uuid.uuid4().hex[:12]}"},
                 "spec": {"reason": reason, "message": message}})
-        except Exception:
-            pass  # events are best-effort, never fail the reconcile
+        except Exception as exc:
+            # Events are best-effort — they never fail the reconcile —
+            # but a store that rejects every Event is an outage signal
+            # the operator must see (KAI007: log + count, never drop).
+            METRICS.inc("binder_event_write_errors")
+            log.v(2).info("event write failed (%s: %s); continuing",
+                          type(exc).__name__, exc)
 
     def _bind(self, br: dict) -> None:
         spec = br["spec"]

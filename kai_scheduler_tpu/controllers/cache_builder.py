@@ -820,7 +820,12 @@ class ClusterCache:
             if status.get("phase") == "Failed" or \
                     (status.get("phase") == "Pending" and exhausted):
                 ns = br["metadata"].get("namespace", "default")
-                self.api.delete("BindRequest", br["metadata"]["name"], ns)
+                # Reaping is a scheduler write like any other: carry the
+                # fence so a deposed instance replaying its journal after
+                # a new leader took over cannot delete the new leader's
+                # requests (KAI005).
+                self.api.delete("BindRequest", br["metadata"]["name"], ns,
+                                **self._fence_kwargs())
                 summary["reaped_bind_requests"] += 1
                 METRICS.inc("bind_requests_reaped_total")
 

@@ -28,6 +28,7 @@ flapping apiserver is not hammered by its whole fleet in lockstep.
 
 from __future__ import annotations
 
+import http.client
 import json
 import random
 import threading
@@ -129,8 +130,14 @@ class HTTPKubeAPI:
             payload = {}
             try:
                 payload = json.loads(e.read() or b"{}")
-            except Exception:
-                pass
+            except (ValueError, OSError, http.client.HTTPException):
+                pass  # unreadable/non-JSON error body: fall back to
+                # the HTTP status mapping below (IncompleteRead from a
+                # truncated body must not bypass NotFound/Conflict)
+            if not isinstance(payload, dict):
+                # Valid JSON but not an object (a proxy answering with a
+                # bare string/array) must not break the status mapping.
+                payload = {}
             msg = payload.get("error", str(e))
             if e.code == 404:
                 raise NotFound(msg) from None

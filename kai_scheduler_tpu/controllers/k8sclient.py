@@ -21,6 +21,7 @@ exec auth provider does the same on Unauthorized).
 
 from __future__ import annotations
 
+import http.client
 import json
 import ssl
 import threading
@@ -229,8 +230,11 @@ class KubernetesKubeAPI:
             detail = ""
             try:
                 detail = json.loads(e.read() or b"{}").get("message", "")
-            except Exception:
-                pass
+            except (ValueError, OSError, AttributeError,
+                    http.client.HTTPException):
+                pass  # unreadable/non-JSON/non-dict error body: keep
+                # the URL; the status mapping (incl. the 401 refresh
+                # retry) below must still run
             if e.code == 404:
                 raise NotFound(detail or url) from None
             if e.code == 409:
@@ -254,7 +258,14 @@ class KubernetesKubeAPI:
         return obj
 
     # -- CRUD (InMemoryKubeAPI surface) ------------------------------------
-    def create(self, obj: dict) -> dict:
+    # Mutators accept (and discard) the fencing epoch/fence kwargs the
+    # in-memory and HTTP stores enforce: a genuine kube-apiserver has no
+    # fence header, so against a real cluster split-brain protection is
+    # the Lease's own optimistic concurrency.  Accepting the kwargs
+    # keeps this client drop-in for fenced callers (ClusterCache's
+    # _fence_kwargs splat) instead of TypeError-ing at runtime.
+    def create(self, obj: dict, epoch: int | None = None,
+               fence: str | None = None) -> dict:
         kind = obj["kind"]
         ns = obj.get("metadata", {}).get("namespace", "default")
         out = self._json("POST", self._path(kind, ns), obj)
@@ -282,7 +293,8 @@ class KubernetesKubeAPI:
         items = self._json("GET", url).get("items", [])
         return [self._normalize(o, kind) for o in items]
 
-    def update(self, obj: dict) -> dict:
+    def update(self, obj: dict, epoch: int | None = None,
+               fence: str | None = None) -> dict:
         kind, ns, name = obj_key(obj)
         out = self._json("PUT", self._path(kind, ns, name), obj)
         obj["metadata"]["resourceVersion"] = \
@@ -290,13 +302,15 @@ class KubernetesKubeAPI:
         return self._normalize(out, kind)
 
     def patch(self, kind: str, name: str, patch: dict,
-              namespace: str = "default") -> dict:
+              namespace: str = "default", epoch: int | None = None,
+              fence: str | None = None) -> dict:
         return self._normalize(
             self._json("PATCH", self._path(kind, namespace, name), patch,
                        content_type="application/merge-patch+json"), kind)
 
     def delete(self, kind: str, name: str,
-               namespace: str = "default") -> None:
+               namespace: str = "default", epoch: int | None = None,
+               fence: str | None = None) -> None:
         try:
             self._json("DELETE", self._path(kind, namespace, name))
         except NotFound:

@@ -190,7 +190,9 @@ def _mint_cert_inprocess(cn: str) -> tuple[bytes, bytes]:
 
     key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
     name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, cn)])
-    now = datetime.datetime.now(datetime.timezone.utc)
+    # X.509 validity windows are wall-clock by definition: peers verify
+    # notBefore/notAfter against THEIR wall clocks, not our monotonic one.
+    now = datetime.datetime.now(datetime.timezone.utc)  # kailint: disable=KAI003 — wall-clock intentional
     cert = (x509.CertificateBuilder()
             .subject_name(name).issuer_name(name)
             .public_key(key.public_key())

@@ -12,6 +12,11 @@ from __future__ import annotations
 import queue
 import threading
 
+from ..utils.logging import ScopedLogger
+from ..utils.metrics import METRICS
+
+log = ScopedLogger("status-updater")
+
 
 class AsyncStatusUpdater:
     def __init__(self, api, num_workers: int = 4):
@@ -71,8 +76,13 @@ class AsyncStatusUpdater:
                     kind, namespace, name = key
                     self.api.patch(kind, name, {"status": payload},
                                    namespace)
-            except Exception:
-                pass  # object vanished; the next cycle re-derives status
+            except Exception as exc:
+                # Usually the object vanished mid-flight (the next cycle
+                # re-derives status), but a store that rejects EVERY
+                # write must be visible, not silent (KAI007).
+                METRICS.inc("status_update_errors")
+                log.v(2).info("status write for %s dropped (%s: %s)",
+                              key, type(exc).__name__, exc)
             finally:
                 self._queue.task_done()
 

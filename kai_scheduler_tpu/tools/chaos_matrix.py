@@ -76,6 +76,11 @@ def main(argv=None) -> int:
                     help="pytest marker to select (default: chaos)")
     ap.add_argument("--timeout", type=float, default=300.0,
                     help="per-iteration timeout in seconds")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="print the fault grid (seed/tests/marker/"
+                         "timeout per iteration) without running "
+                         "anything — lets CI validate the matrix "
+                         "definition cheaply")
     args = ap.parse_args(argv)
 
     seeds = ([int(s) for s in args.seeds.split(",") if s.strip()]
@@ -83,6 +88,16 @@ def main(argv=None) -> int:
     tests = args.tests if args.tests else DEFAULT_TESTS
     repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
+
+    if args.dry_run:
+        for seed in seeds:
+            print(f"seed {seed:>6}  marker={args.marker}  "
+                  f"keyword={args.keyword or '-'}  "
+                  f"timeout={args.timeout:g}s  tests={' '.join(tests)}",
+                  flush=True)
+        print(f"\nchaos matrix (dry run): {len(seeds)} iteration(s) "
+              f"planned, nothing executed", flush=True)
+        return 0
 
     rows, failed = [], []
     for seed in seeds:

@@ -1,0 +1,49 @@
+#!/usr/bin/env bash
+# ci_check.sh — one command reproduces the full static + test gate
+# locally, exactly as CI runs it:
+#
+#   ruff          style/pyflakes subset (config: pyproject.toml; the
+#                 step is skipped with a warning when ruff is not
+#                 installed — the hermetic test image does not bake it)
+#   kailint       the project-specific invariant rules KAI001-KAI008
+#                 (docs/STATIC_ANALYSIS.md) against the committed
+#                 baseline (.kailint-baseline.json)
+#   chaos matrix  --dry-run validation of the fault-grid definition
+#   tier-1 tests  pytest -m 'not slow' on CPU
+#
+# Usage: kai_scheduler_tpu/tools/ci_check.sh [--no-tests]
+set -u
+ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/../.." && pwd)"
+cd "$ROOT"
+fail=0
+
+echo "== ruff =="
+if command -v ruff >/dev/null 2>&1; then
+    ruff check kai_scheduler_tpu/ tests/ bench.py || fail=1
+else
+    echo "skipped: ruff not installed (pip install ruff; config already"
+    echo "in pyproject.toml [tool.ruff])"
+fi
+
+echo
+echo "== kailint =="
+python -m kai_scheduler_tpu.tools.kailint kai_scheduler_tpu/ || fail=1
+
+echo
+echo "== chaos matrix definition (dry run) =="
+python -m kai_scheduler_tpu.tools.chaos_matrix --dry-run || fail=1
+
+if [ "${1:-}" != "--no-tests" ]; then
+    echo
+    echo "== tier-1 tests (pytest -m 'not slow') =="
+    JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+        -p no:cacheprovider || fail=1
+fi
+
+echo
+if [ "$fail" -eq 0 ]; then
+    echo "ci_check: ALL GREEN"
+else
+    echo "ci_check: FAILED (see sections above)"
+fi
+exit "$fail"

@@ -63,6 +63,10 @@ def simulate(payload: dict, backend: str = "numpy") -> dict:
 
     if backend == "jax":
         hier = fsops.QueueHierarchy.build(parent, priority, creation, names)
+        # Offline CLI: there is no Session (and no device-guard) here —
+        # the simulator exists to diff the jax kernel against the
+        # sequential reference below, so the call is direct by design.
+        # kailint: disable=KAI004 — offline simulator, no Session to dispatch through
         fair = fsops.fair_share_levels(total, k, hier, deserved, limit, oqw,
                                        request, usage)
     else:

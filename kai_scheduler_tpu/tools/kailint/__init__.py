@@ -1,0 +1,35 @@
+"""kailint: AST-based invariant checker for the kai_scheduler_tpu contracts.
+
+The hot loop this repo lifts into JAX/XLA only stays fast and
+crash-consistent if a set of conventions hold *everywhere*: ops code must
+be trace-safe, fenced control-plane writes must carry the leadership
+epoch, lease/backoff logic must run on the monotonic clock, and every
+kernel call must route through ``Session.dispatch_kernel``.  PR 1 and
+PR 2 established those contracts by hand; kailint makes them *checked*,
+not remembered — the tier-1 gate (``tests/test_kailint.py``) runs the
+analyzer over the whole package and fails on any non-baselined finding.
+
+Usage::
+
+    python -m kai_scheduler_tpu.tools.kailint kai_scheduler_tpu/
+    python -m kai_scheduler_tpu.tools.kailint --list-rules
+    python -m kai_scheduler_tpu.tools.kailint --write-baseline pkg/
+
+Suppress a deliberate violation on its own line (a reason after the
+rule list is encouraged and conventional)::
+
+    t = time.time()  # kailint: disable=KAI003 — wall-clock intentional
+
+See docs/STATIC_ANALYSIS.md for the rule catalog and workflow.
+"""
+
+from .engine import (  # noqa: F401
+    Engine,
+    Finding,
+    ModuleContext,
+    Report,
+    Rule,
+    load_baseline,
+    write_baseline,
+)
+from .rules import default_rules  # noqa: F401

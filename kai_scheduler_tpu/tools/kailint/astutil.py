@@ -1,0 +1,108 @@
+"""Shared AST helpers for kailint rules."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """``a.b.c`` for a Name/Attribute chain; None for anything else."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> str | None:
+    return dotted_name(call.func)
+
+
+def iter_calls(tree: ast.AST) -> Iterator[ast.Call]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            yield node
+
+
+def is_jit_decorator(dec: ast.AST) -> bool:
+    """``@jax.jit`` / ``@jit`` / ``@functools.partial(jax.jit, ...)`` /
+    ``@partial(jit, ...)``."""
+    name = dotted_name(dec)
+    if name is not None:
+        return name == "jit" or name.endswith(".jit")
+    if isinstance(dec, ast.Call):
+        fn = dotted_name(dec.func) or ""
+        if fn == "jit" or fn.endswith(".jit"):
+            return True
+        if fn == "partial" or fn.endswith(".partial"):
+            return any(is_jit_decorator(a) for a in dec.args)
+    return False
+
+
+def static_argnames_of(dec: ast.AST) -> set[str]:
+    """The ``static_argnames`` of a ``partial(jax.jit, ...)`` decorator."""
+    out: set[str] = set()
+    if not isinstance(dec, ast.Call):
+        return out
+    for kw in dec.keywords:
+        if kw.arg == "static_argnames":
+            for node in ast.walk(kw.value):
+                if isinstance(node, ast.Constant) and \
+                        isinstance(node.value, str):
+                    out.add(node.value)
+    return out
+
+
+def function_params(fn: ast.FunctionDef | ast.AsyncFunctionDef |
+                    ast.Lambda) -> set[str]:
+    args = fn.args
+    names = [a.arg for a in (args.posonlyargs + args.args +
+                             args.kwonlyargs)]
+    if args.vararg:
+        names.append(args.vararg.arg)
+    if args.kwarg:
+        names.append(args.kwarg.arg)
+    return set(names)
+
+
+def top_level_functions(tree: ast.Module) -> dict[str, ast.FunctionDef]:
+    return {node.name: node for node in tree.body
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+
+def local_calls(fn: ast.AST, local_names: set[str]) -> set[str]:
+    """Names from ``local_names`` that ``fn``'s body calls (or merely
+    references — a function passed to ``lax.scan`` is 'called')."""
+    out: set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and node.id in local_names:
+            out.add(node.id)
+    return out
+
+
+def resolve_relative_import(importer_module: str,
+                            node: ast.ImportFrom) -> str | None:
+    """Absolute dotted module for a (possibly relative) ImportFrom seen
+    inside ``importer_module`` (a module, not a package)."""
+    if node.level == 0:
+        return node.module
+    parts = importer_module.split(".")
+    if node.level > len(parts):
+        return None
+    base = parts[:-node.level]
+    if node.module:
+        base += node.module.split(".")
+    return ".".join(base) if base else None
+
+
+def in_path(ctx_path: str, *segments: str) -> bool:
+    """True when any of ``segments`` appears as a path component (or
+    trailing path suffix) of the module's package-relative path."""
+    padded = "/" + ctx_path
+    return any(f"/{seg.strip('/')}/" in padded or
+               padded.endswith("/" + seg.strip("/"))
+               for seg in segments)

@@ -1,0 +1,137 @@
+"""kailint command line.
+
+Exit codes: 0 = clean (every finding suppressed or baselined),
+1 = new findings, 2 = usage/internal error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from .engine import BASELINE_NAME, Engine, load_baseline, write_baseline
+from .rules import RULE_CLASSES, default_rules
+
+
+def _default_baseline_path(paths: list[str]) -> str:
+    """Walk up from the first scanned path looking for a committed
+    baseline; fall back to CWD so --write-baseline has a target."""
+    start = os.path.abspath(paths[0]) if paths else os.getcwd()
+    cur = start if os.path.isdir(start) else os.path.dirname(start)
+    while True:
+        cand = os.path.join(cur, BASELINE_NAME)
+        if os.path.isfile(cand):
+            return cand
+        parent = os.path.dirname(cur)
+        if parent == cur:
+            return os.path.join(os.getcwd(), BASELINE_NAME)
+        cur = parent
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m kai_scheduler_tpu.tools.kailint",
+        description="AST invariant checker for the kai_scheduler_tpu "
+                    "safety contracts (docs/STATIC_ANALYSIS.md)")
+    ap.add_argument("paths", nargs="*", help="files or directories")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline file (default: nearest {BASELINE_NAME})")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, baselined or not")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write current findings as the new baseline")
+    ap.add_argument("--select", default=None,
+                    help="comma-separated rule ids to run (e.g. KAI003)")
+    ap.add_argument("--ignore", default=None,
+                    help="comma-separated rule ids to skip")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for cls in RULE_CLASSES:
+            print(f"{cls.id}  {cls.name:<22} {cls.description}")
+        return 0
+    if not args.paths:
+        ap.print_usage(sys.stderr)
+        print("error: no paths given", file=sys.stderr)
+        return 2
+    for p in args.paths:
+        if not os.path.exists(p):
+            print(f"error: no such path: {p}", file=sys.stderr)
+            return 2
+
+    known = {cls.id.upper() for cls in RULE_CLASSES}
+    filters = {}
+    for flag, spec in (("--select", args.select),
+                      ("--ignore", args.ignore)):
+        if spec is None:
+            filters[flag] = None
+            continue
+        ids = {tok.strip().upper() for tok in spec.split(",")
+               if tok.strip()}
+        unknown = ids - known
+        if unknown:
+            # A typo'd id silently gating nothing is the worst failure
+            # mode a linter can have — refuse loudly instead.
+            print(f"error: unknown rule id(s) for {flag}: "
+                  f"{', '.join(sorted(unknown))} (see --list-rules)",
+                  file=sys.stderr)
+            return 2
+        filters[flag] = ids
+    select, ignore = filters["--select"], filters["--ignore"]
+    engine = Engine(default_rules(), select=select, ignore=ignore)
+
+    baseline_path = args.baseline or _default_baseline_path(args.paths)
+    if args.write_baseline:
+        if select or ignore:
+            # A filtered run sees only a subset of findings; writing it
+            # out would erase every other rule's entries from the ledger.
+            print("error: --write-baseline cannot be combined with "
+                  "--select/--ignore (it would overwrite the other "
+                  "rules' baseline entries)", file=sys.stderr)
+            return 2
+        report = engine.run(args.paths, baseline=None)
+        if report.errors:
+            # Refuse to regenerate the ledger from a partial scan: a
+            # baseline written while a file was unparseable would look
+            # clean for invariants that were never checked.
+            for err in report.errors:
+                print(f"kailint: parse error: {err}", file=sys.stderr)
+            print("error: refusing to write a baseline from a partial "
+                  "scan (fix the parse errors first)", file=sys.stderr)
+            return 2
+        n = write_baseline(baseline_path, report.findings)
+        print(f"kailint: wrote {n} baseline entr"
+              f"{'y' if n == 1 else 'ies'} to {baseline_path}")
+        return 0
+
+    try:
+        baseline = {} if args.no_baseline else load_baseline(baseline_path)
+        report = engine.run(args.paths, baseline=baseline)
+    except (OSError, ValueError, KeyError) as exc:
+        # A corrupt baseline is an internal error (exit 2), never
+        # "findings exist" (exit 1) and never a green gate.
+        print(f"kailint: error: {exc}", file=sys.stderr)
+        return 2
+
+    if args.format == "json":
+        print(json.dumps(report.to_dict(), indent=2))
+        return report.exit_code
+
+    for f in report.findings:
+        print(f.render())
+    for err in report.errors:
+        print(f"kailint: parse error: {err}", file=sys.stderr)
+    summary = (f"kailint: {len(report.findings)} new finding(s), "
+               f"{len(report.baselined)} baselined, "
+               f"{report.suppressed} suppressed, "
+               f"{report.files} file(s)")
+    if report.stale_baseline:
+        summary += (f", {len(report.stale_baseline)} stale baseline "
+                    f"entr{'y' if len(report.stale_baseline) == 1 else 'ies'}"
+                    f" (fixed — prune with --write-baseline)")
+    print(summary)
+    return report.exit_code

@@ -1,0 +1,377 @@
+"""kailint engine: module loading, suppressions, baseline, reporting.
+
+The engine is rule-agnostic plumbing.  It walks ``.py`` files, parses
+each into a :class:`ModuleContext` (AST + per-line suppression map), runs
+every registered rule through a two-pass protocol — ``collect`` over all
+modules first (cross-module facts like "which ops functions are jitted
+kernels"), then ``check`` per module, then ``finalize`` for whole-tree
+rules — and filters the resulting findings through per-line/per-file
+suppressions and the committed baseline.
+
+Finding identity (the baseline key) is deliberately line-number-free:
+``sha1(rule | relpath | normalized source text)``.  Edits above a
+baselined site don't invalidate it; editing the flagged line itself
+does — which is exactly when a human should re-decide.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import io
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Iterable, Iterator
+
+SUPPRESS_RE = re.compile(
+    r"#\s*kailint:\s*disable(?P<file>-file)?\s*=\s*"
+    r"(?P<rules>all|[A-Za-z]+\d+(?:\s*,\s*[A-Za-z]+\d+)*)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str          # posix path, package-relative (kai_scheduler_tpu/..)
+    line: int
+    col: int
+    message: str
+    source: str = ""   # stripped text of the flagged line
+
+    @property
+    def fingerprint(self) -> str:
+        norm = " ".join(self.source.split())
+        raw = f"{self.rule}|{self.path}|{norm}".encode()
+        return hashlib.sha1(raw).hexdigest()[:16]
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message,
+                "source": self.source, "fingerprint": self.fingerprint}
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"{self.rule} {self.message}")
+
+
+class ModuleContext:
+    """One parsed module: AST, source lines, and its suppression map."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        # line number -> set of rule ids (or "ALL") suppressed there
+        self.line_suppressions: dict[int, set[str]] = {}
+        self.file_suppressions: set[str] = set()
+        self._parse_suppressions()
+
+    @property
+    def module_name(self) -> str:
+        return self.path[:-3].replace("/", ".") if \
+            self.path.endswith(".py") else self.path.replace("/", ".")
+
+    def line_at(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def _comment_lines(self) -> dict[int, str]:
+        """line number -> comment text, via the tokenizer — a string
+        literal that merely *mentions* the suppression syntax must not
+        disable enforcement on its line."""
+        out: dict[int, str] = {}
+        try:
+            for tok in tokenize.generate_tokens(
+                    io.StringIO(self.source).readline):
+                if tok.type == tokenize.COMMENT:
+                    out[tok.start[0]] = tok.string
+        except (tokenize.TokenError, IndentationError):
+            # ast.parse accepted the file, so this is near-unreachable;
+            # degrade to the raw lines rather than dropping suppressions.
+            return {i: raw for i, raw in enumerate(self.lines, 1)
+                    if "#" in raw}
+        return out
+
+    def _parse_suppressions(self) -> None:
+        comments = self._comment_lines()
+        pending: set[str] | None = None
+        for i, raw in enumerate(self.lines, 1):
+            stripped = raw.strip()
+            m = SUPPRESS_RE.search(comments.get(i, ""))
+            if m:
+                spec = m.group("rules")
+                rules = ({"ALL"} if spec == "all" else
+                         {r.strip().upper() for r in spec.split(",")})
+                if m.group("file"):
+                    self.file_suppressions |= rules
+                elif stripped.startswith("#"):
+                    # Standalone comment line: applies to the next
+                    # non-comment line (multi-line statements put the
+                    # marker above the statement).
+                    pending = set(rules) | (pending or set())
+                else:
+                    # A code line with its own inline suppression is
+                    # also "the next non-comment line" for any pending
+                    # standalone marker above it — consume the pending
+                    # here, or it would leak onto a later unrelated
+                    # line and silently suppress real findings there.
+                    self.line_suppressions.setdefault(i, set()) \
+                        .update(rules | (pending or set()))
+                    pending = None
+                continue
+            if stripped and not stripped.startswith("#") and pending:
+                self.line_suppressions.setdefault(i, set()) \
+                    .update(pending)
+                pending = None
+
+    def is_suppressed(self, finding: Finding) -> bool:
+        keys = {finding.rule.upper(), "ALL"}
+        if self.file_suppressions & keys:
+            return True
+        return bool(self.line_suppressions.get(finding.line, set()) & keys)
+
+
+class Rule:
+    """Base class: subclasses set ``id``/``name``/``description`` and
+    override any of the three passes."""
+
+    id = "KAI000"
+    name = "base"
+    description = ""
+
+    def applies_to(self, ctx: ModuleContext) -> bool:
+        return True
+
+    def collect(self, ctx: ModuleContext) -> None:
+        """Pass 1 over every module (cross-module fact gathering)."""
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        """Pass 2: yield findings for one module."""
+        return iter(())
+
+    def finalize(self) -> Iterator[Finding]:
+        """Pass 3: whole-tree findings (duplicate registrations etc.)."""
+        return iter(())
+
+    # -- helpers -----------------------------------------------------------
+    def finding(self, ctx: ModuleContext, node: ast.AST,
+                message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        return Finding(rule=self.id, path=ctx.path, line=line, col=col,
+                       message=message, source=ctx.line_at(line))
+
+
+@dataclass
+class Report:
+    findings: list[Finding] = field(default_factory=list)   # non-baselined
+    baselined: list[Finding] = field(default_factory=list)
+    suppressed: int = 0
+    files: int = 0
+    errors: list[str] = field(default_factory=list)
+    stale_baseline: list[dict] = field(default_factory=list)
+
+    @property
+    def exit_code(self) -> int:
+        # Parse errors are exit 2: a file the analyzer could not read is
+        # a file whose invariants went UNCHECKED — that must never look
+        # like a green gate.
+        if self.errors:
+            return 2
+        return 1 if self.findings else 0
+
+    def to_dict(self) -> dict:
+        return {
+            "findings": [f.to_dict() for f in self.findings],
+            "baselined": [f.to_dict() for f in self.baselined],
+            "suppressed": self.suppressed,
+            "files": self.files,
+            "errors": self.errors,
+            "stale_baseline": self.stale_baseline,
+            "exit_code": self.exit_code,
+        }
+
+
+# -- path anchoring ---------------------------------------------------------
+
+def package_relative(path: str) -> str:
+    """Anchor ``path`` at the outermost enclosing package: walk up while
+    an ``__init__.py`` sibling exists, then return the path relative to
+    that package's parent.  Makes findings/baselines stable no matter
+    what directory the analyzer is invoked from."""
+    path = os.path.abspath(path)
+    root = os.path.dirname(path)
+    while os.path.isfile(os.path.join(root, "__init__.py")):
+        parent = os.path.dirname(root)
+        if parent == root:
+            break
+        root = parent
+    return os.path.relpath(path, root).replace(os.sep, "/")
+
+
+def iter_python_files(paths: Iterable[str]) -> Iterator[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+        elif os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(
+                    d for d in dirnames
+                    if not d.startswith(".") and d != "__pycache__")
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        yield os.path.join(dirpath, fn)
+
+
+# -- engine -----------------------------------------------------------------
+
+class Engine:
+    def __init__(self, rules: list[Rule] | None = None,
+                 select: set[str] | None = None,
+                 ignore: set[str] | None = None):
+        if rules is None:
+            from .rules import default_rules
+            rules = default_rules()
+        if select:
+            sel = {s.upper() for s in select}
+            rules = [r for r in rules if r.id.upper() in sel]
+        if ignore:
+            ign = {s.upper() for s in ignore}
+            rules = [r for r in rules if r.id.upper() not in ign]
+        self.rules = rules
+        # A filtered run sees only a subset of findings, so "this
+        # baseline entry matched nothing" proves nothing — stale
+        # reporting is only meaningful on a full-rule run.
+        self.filtered = bool(select or ignore)
+
+    # -- in-memory entry point (fixture tests) ----------------------------
+    def run_modules(self, modules: list[tuple[str, str]]) -> Report:
+        """Run the full pipeline over ``[(relpath, source), ...]``."""
+        # Fresh rule instances per run: stateful rules (KAI004's kernel
+        # map, KAI008's call sites) must not leak facts from a previous
+        # run into this one — a reused Engine is a supported caller.
+        rules = [type(r)() for r in self.rules]
+        report = Report()
+        contexts: list[ModuleContext] = []
+        for relpath, source in modules:
+            try:
+                contexts.append(ModuleContext(relpath, source))
+            except SyntaxError as exc:
+                report.errors.append(f"{relpath}: {exc}")
+        report.files = len(contexts)
+        for rule in rules:
+            for ctx in contexts:
+                if rule.applies_to(ctx):
+                    rule.collect(ctx)
+        raw: list[Finding] = []
+        by_path = {ctx.path: ctx for ctx in contexts}
+        for rule in rules:
+            for ctx in contexts:
+                if rule.applies_to(ctx):
+                    raw.extend(rule.check(ctx))
+        for rule in rules:
+            raw.extend(rule.finalize())
+        seen: set[tuple] = set()
+        for f in sorted(raw, key=lambda f: (f.path, f.line, f.rule)):
+            # One defect, one finding: overlapping walks (nested lock
+            # blocks, nested defs) may surface the same site twice.
+            key = (f.rule, f.path, f.line, f.col, f.message)
+            if key in seen:
+                continue
+            seen.add(key)
+            ctx = by_path.get(f.path)
+            if ctx is not None and ctx.is_suppressed(f):
+                report.suppressed += 1
+            else:
+                report.findings.append(f)
+        return report
+
+    # -- filesystem entry point -------------------------------------------
+    def run(self, paths: Iterable[str],
+            baseline: dict | None = None) -> Report:
+        modules: list[tuple[str, str]] = []
+        errors: list[str] = []
+        for fpath in iter_python_files(paths):
+            try:
+                with open(fpath, encoding="utf-8") as fh:
+                    modules.append((package_relative(fpath), fh.read()))
+            except (OSError, UnicodeDecodeError) as exc:
+                # An unreadable file is an UNCHECKED file — it must land
+                # in report.errors (exit 2), not crash the analyzer.
+                errors.append(f"{fpath}: {exc}")
+        report = self.run_modules(modules)
+        report.errors = errors + report.errors
+        if baseline is not None:
+            apply_baseline(report, baseline,
+                           report_stale=not self.filtered)
+        return report
+
+
+# -- baseline ---------------------------------------------------------------
+
+BASELINE_NAME = ".kailint-baseline.json"
+
+
+def load_baseline(path: str) -> dict:
+    """fingerprint -> entry dict.  Missing file = empty baseline; a
+    shape-corrupt file raises ValueError (exit 2 at the CLI), never a
+    raw traceback that an exit-code consumer misreads as findings."""
+    if not os.path.isfile(path):
+        return {}
+    with open(path, encoding="utf-8") as fh:
+        data = json.load(fh)
+    entries = data.get("entries", []) if isinstance(data, dict) else None
+    if not isinstance(entries, list) or not all(
+            isinstance(e, dict) and "fingerprint" in e for e in entries):
+        raise ValueError(
+            f"{path}: not a kailint baseline (expected an object with "
+            f"an 'entries' list of fingerprinted records); regenerate "
+            f"with --write-baseline")
+    return {e["fingerprint"]: e for e in entries}
+
+
+def write_baseline(path: str, findings: list[Finding]) -> int:
+    seen: dict[str, dict] = {}
+    for f in findings:
+        entry = seen.setdefault(f.fingerprint, {
+            "rule": f.rule, "path": f.path, "source": f.source,
+            "message": f.message, "fingerprint": f.fingerprint,
+            "count": 0})
+        # Identical lines share a fingerprint; the count pins how many
+        # occurrences the ledger covers, so ADDING another copy of a
+        # baselined violation still fails the gate.
+        entry["count"] += 1
+    entries = sorted(seen.values(),
+                     key=lambda e: (e["path"], e["rule"], e["fingerprint"]))
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"version": 1, "tool": "kailint", "entries": entries},
+                  fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    return len(entries)
+
+
+def apply_baseline(report: Report, baseline: dict,
+                   report_stale: bool = True) -> None:
+    """Split report.findings into new vs baselined; record stale
+    baseline entries (fixed sites a human should prune).  Pass
+    ``report_stale=False`` for rule-filtered runs — an entry unmatched
+    because its rule never ran is not stale."""
+    new: list[Finding] = []
+    matched: dict[str, int] = {}
+    for f in report.findings:
+        entry = baseline.get(f.fingerprint)
+        budget = int(entry.get("count", 1)) if entry else 0
+        if entry is not None and matched.get(f.fingerprint, 0) < budget:
+            matched[f.fingerprint] = matched.get(f.fingerprint, 0) + 1
+            report.baselined.append(f)
+        else:
+            new.append(f)
+    report.findings = new
+    if report_stale:
+        report.stale_baseline = [e for fp, e in sorted(baseline.items())
+                                 if fp not in matched]

@@ -1,0 +1,44 @@
+"""kailint rule pack: the PR1/PR2 safety contracts, machine-enforced.
+
+| id     | name                  | contract                                |
+|--------|-----------------------|-----------------------------------------|
+| KAI001 | trace-safety          | ops/parallel code stays jit-traceable   |
+| KAI002 | host-sync-in-hot-path | device syncs only at the guard          |
+| KAI003 | wall-clock-discipline | lease/backoff math on monotonic clocks  |
+| KAI004 | unguarded-dispatch    | kernels route through dispatch_kernel   |
+| KAI005 | unfenced-write        | scheduler writes carry the epoch        |
+| KAI006 | lock-discipline       | `with` locks; no blocking under a lock  |
+| KAI007 | exception-swallowing  | controller errors are logged + counted  |
+| KAI008 | metrics-hygiene       | one name, one instrument, snake_case    |
+
+Each rule is registered here; ``default_rules()`` returns fresh
+instances (rules carry cross-module state between passes, so instances
+must never be shared across engine runs).
+"""
+
+from __future__ import annotations
+
+from ..engine import Rule
+from .clock import WallClockRule
+from .dispatch import UnguardedDispatchRule
+from .excepts import ExceptionSwallowingRule
+from .fencing import UnfencedWriteRule
+from .host_sync import HostSyncRule
+from .locks import LockDisciplineRule
+from .metrics_hygiene import MetricsHygieneRule
+from .trace_safety import TraceSafetyRule
+
+RULE_CLASSES: list[type[Rule]] = [
+    TraceSafetyRule,        # KAI001
+    HostSyncRule,           # KAI002
+    WallClockRule,          # KAI003
+    UnguardedDispatchRule,  # KAI004
+    UnfencedWriteRule,      # KAI005
+    LockDisciplineRule,     # KAI006
+    ExceptionSwallowingRule,  # KAI007
+    MetricsHygieneRule,     # KAI008
+]
+
+
+def default_rules() -> list[Rule]:
+    return [cls() for cls in RULE_CLASSES]

@@ -1,0 +1,95 @@
+"""KAI003: wall-clock discipline in timing-sensitive modules.
+
+Lease expiry, watchdog deadlines, retry backoff, and fencing decisions
+must never be computed from the wall clock: NTP steps turn every clock
+jump into a spurious leader takeover or a watchdog misfire (PR 2 made
+``LeaseElector`` expiry observation-based on ``time.monotonic`` for
+exactly this reason).  In scoped modules (``utils/``, ``controllers/``,
+``framework/``, ``scheduler.py``, ``server.py``) a *call* to
+``time.time()`` / ``datetime.now()`` / ``datetime.utcnow()`` is flagged.
+
+Two sanctioned patterns are NOT flagged:
+
+- injection points — ``def __init__(self, clock=time.time)`` references
+  the function without calling it, and the injected ``self.clock()``
+  call site is opaque to this rule by design;
+- legitimately-wall-clock sites (journal timestamps, certificate
+  validity, ``status.backoffUntil`` that other processes compare against
+  their own wall clock) carry an explicit suppression::
+
+      now = time.time()  # kailint: disable=KAI003 — wall-clock intentional
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..astutil import dotted_name, in_path, iter_calls
+from ..engine import Finding, ModuleContext, Rule
+
+_WALL_CLOCK_CALLS = {
+    "time.time": "time.monotonic() (or an injected clock)",
+    "datetime.now": "time.monotonic() for durations",
+    "datetime.utcnow": "time.monotonic() for durations",
+    "datetime.datetime.now": "time.monotonic() for durations",
+    "datetime.datetime.utcnow": "time.monotonic() for durations",
+}
+
+_SCOPE = ("utils", "controllers", "framework", "scheduler.py", "server.py")
+
+
+class WallClockRule(Rule):
+    id = "KAI003"
+    name = "wall-clock-discipline"
+    description = ("time.time()/datetime.now() in lease/backoff/fencing "
+                   "paths — must be monotonic or an injected clock")
+
+    def applies_to(self, ctx: ModuleContext) -> bool:
+        return in_path(ctx.path, *_SCOPE)
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        aliases = self._import_aliases(ctx.tree)
+        for call in iter_calls(ctx.tree):
+            name = dotted_name(call.func)
+            canonical = aliases.get(name or "", name or "")
+            want = _WALL_CLOCK_CALLS.get(canonical)
+            if want:
+                yield self.finding(
+                    ctx, call,
+                    f"wall-clock `{name}()` in a timing-sensitive module "
+                    f"— use {want}; if wall-clock is intentional, "
+                    f"suppress with a reason")
+
+    @staticmethod
+    def _import_aliases(tree: ast.AST) -> dict[str, str]:
+        """Map aliased call spellings back to canonical dotted names so
+        neither ``from time import time`` / ``from datetime import
+        datetime as dt`` nor ``import time as clk`` / ``import datetime
+        as dt`` can evade the gate."""
+        out: dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom) and not node.level:
+                for alias in node.names:
+                    local = alias.asname or alias.name
+                    if node.module == "time" and alias.name == "time":
+                        out[local] = "time.time"
+                    elif node.module == "datetime" and \
+                            alias.name == "datetime":
+                        out[f"{local}.now"] = "datetime.datetime.now"
+                        out[f"{local}.utcnow"] = \
+                            "datetime.datetime.utcnow"
+            elif isinstance(node, ast.Import):
+                for alias in node.names:
+                    local = alias.asname or alias.name
+                    if alias.name == "time":
+                        out[f"{local}.time"] = "time.time"
+                    elif alias.name == "datetime":
+                        out[f"{local}.datetime.now"] = \
+                            "datetime.datetime.now"
+                        out[f"{local}.datetime.utcnow"] = \
+                            "datetime.datetime.utcnow"
+                        out[f"{local}.now"] = "datetime.datetime.now"
+                        out[f"{local}.utcnow"] = \
+                            "datetime.datetime.utcnow"
+        return out

@@ -1,0 +1,131 @@
+"""KAI004: unguarded device dispatch.
+
+Every kernel invocation from host code must route through
+``Session.dispatch_kernel`` — that is where the watchdog deadline,
+bounded retry, circuit breaker, and CPU degradation live (PR 1).  A
+direct call to a jitted kernel bypasses all of it: a hung device wedges
+the scheduling cycle with no deadline and no breaker trip.
+
+The rule discovers the kernel surface itself rather than keeping a
+hand-maintained list: pass 1 scans ``ops/`` and ``parallel/`` modules
+for top-level functions that are jit-decorated OR (transitively) call a
+jitted sibling — host-facing wrappers like ``allocate_grouped`` dispatch
+to the device even though the ``@jit`` sits on an inner kernel.  Pass 2
+then flags any call to one of those names from host layers, resolving
+``from ..ops.x import k`` aliases and ``from ..ops import x as m;
+m.k(...)`` module aliases.  Calls inside a ``lambda`` are exempt — that
+is precisely the thunk handed to ``dispatch_kernel`` — and so are calls
+inside a named nested function that is itself passed to a
+``dispatch_kernel(...)`` call (the multi-statement thunk idiom).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..astutil import (dotted_name, in_path, is_jit_decorator, local_calls,
+                       resolve_relative_import, top_level_functions)
+from ..engine import Finding, ModuleContext, Rule
+
+
+class UnguardedDispatchRule(Rule):
+    id = "KAI004"
+    name = "unguarded-dispatch"
+    description = ("direct kernel call bypassing Session.dispatch_kernel "
+                   "(no watchdog, no breaker, no CPU fallback)")
+
+    def __init__(self):
+        # module dotted name -> set of kernel (device-dispatching) names
+        self.kernels_by_module: dict[str, set[str]] = {}
+
+    def applies_to(self, ctx: ModuleContext) -> bool:
+        return True
+
+    def collect(self, ctx: ModuleContext) -> None:
+        if not in_path(ctx.path, "ops", "parallel"):
+            return
+        funcs = top_level_functions(ctx.tree)
+        kernels = {name for name, fn in funcs.items()
+                   if any(is_jit_decorator(d) for d in fn.decorator_list)}
+        # Host wrappers that call a kernel dispatch to the device too;
+        # iterate to a fixed point (wrapper-of-wrapper).
+        changed = True
+        while changed:
+            changed = False
+            for name, fn in funcs.items():
+                if name in kernels:
+                    continue
+                if local_calls(fn, kernels):
+                    kernels.add(name)
+                    changed = True
+        if kernels:
+            self.kernels_by_module[ctx.module_name] = kernels
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        # ops/parallel modules compose kernels freely (they ARE the
+        # device layer); the guard boundary is everything else.
+        if in_path(ctx.path, "ops", "parallel") or \
+                ctx.path.endswith("utils/deviceguard.py"):
+            return
+        direct: dict[str, str] = {}    # local alias -> kernel name
+        mod_alias: dict[str, set[str]] = {}  # alias -> kernel names
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ImportFrom):
+                continue
+            resolved = resolve_relative_import(ctx.module_name, node)
+            if resolved is None:
+                continue
+            kernels = self.kernels_by_module.get(resolved)
+            for alias in node.names:
+                if kernels and alias.name in kernels:
+                    direct[alias.asname or alias.name] = alias.name
+                sub = self.kernels_by_module.get(
+                    f"{resolved}.{alias.name}")
+                if sub:
+                    mod_alias[alias.asname or alias.name] = sub
+        if not direct and not mod_alias:
+            return
+        thunks = self._dispatch_thunk_names(ctx.tree)
+        yield from self._walk(ctx, ctx.tree, direct, mod_alias,
+                              thunks, in_thunk=False)
+
+    @staticmethod
+    def _dispatch_thunk_names(tree: ast.AST) -> set[str]:
+        """Names of functions passed (as a bare Name argument) to a
+        ``dispatch_kernel(...)`` call — named thunks are guarded."""
+        out: set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "dispatch_kernel":
+                for arg in node.args:
+                    if isinstance(arg, ast.Name):
+                        out.add(arg.id)
+        return out
+
+    def _walk(self, ctx: ModuleContext, node: ast.AST, direct: dict,
+              mod_alias: dict, thunks: set[str],
+              in_thunk: bool) -> Iterator[Finding]:
+        for child in ast.iter_child_nodes(node):
+            child_in_thunk = in_thunk or isinstance(child, ast.Lambda) \
+                or (isinstance(child, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef))
+                    and child.name in thunks)
+            if isinstance(child, ast.Call) and not child_in_thunk:
+                name = dotted_name(child.func)
+                flagged = None
+                if name in direct:
+                    flagged = direct[name]
+                elif name and "." in name:
+                    base, attr = name.split(".", 1)
+                    if attr in mod_alias.get(base, ()):
+                        flagged = name
+                if flagged:
+                    yield self.finding(
+                        ctx, child,
+                        f"direct call to device kernel `{flagged}` — "
+                        f"wrap it in a thunk and route through "
+                        f"Session.dispatch_kernel")
+            yield from self._walk(ctx, child, direct, mod_alias,
+                                  thunks, child_in_thunk)

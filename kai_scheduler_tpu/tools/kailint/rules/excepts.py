@@ -1,0 +1,72 @@
+"""KAI007: exception swallowing in controller loops.
+
+A reconciler that catches ``Exception`` and does *nothing* converts
+every bug into silence: the loop keeps spinning, the object never
+converges, and the operator has no signal.  The failure modes PR 2
+hardened against (fenced writes, watch gaps, crash recovery) were all
+diagnosed from logs and counters — a swallowed exception deletes that
+evidence.
+
+Scope: ``controllers/`` and ``server.py``.  Flagged: a bare ``except:``
+or ``except Exception/BaseException:`` whose body neither raises nor
+calls anything (no log, no metric, no event) — i.e. pure
+``pass``/``continue``/bare ``return``.  The fix is to narrow the
+exception type, or to log + count (``METRICS.inc``) before moving on;
+both make the handler invisible to this rule.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..astutil import in_path
+from ..engine import Finding, ModuleContext, Rule
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    if isinstance(t, ast.Name):
+        return t.id in _BROAD
+    if isinstance(t, ast.Tuple):
+        return any(isinstance(e, ast.Name) and e.id in _BROAD
+                   for e in t.elts)
+    return False
+
+
+def _swallows(handler: ast.ExceptHandler) -> bool:
+    """True when the body has no observable effect: no raise, no call
+    (log/metric/event), no assignment feeding later handling."""
+    for node in ast.walk(handler):
+        if isinstance(node, (ast.Raise, ast.Call, ast.Assign,
+                             ast.AugAssign, ast.Yield, ast.YieldFrom)):
+            return False
+        if isinstance(node, ast.Return) and node.value is not None:
+            return False
+    return True
+
+
+class ExceptionSwallowingRule(Rule):
+    id = "KAI007"
+    name = "exception-swallowing"
+    description = ("broad except that drops the error in controller "
+                   "loops — narrow it, or log + count")
+
+    def applies_to(self, ctx: ModuleContext) -> bool:
+        return in_path(ctx.path, "controllers", "server.py")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ExceptHandler) and \
+                    _is_broad(node) and _swallows(node):
+                what = "bare except" if node.type is None else \
+                    "except Exception"
+                yield self.finding(
+                    ctx, node,
+                    f"{what} swallows the error — narrow the exception "
+                    f"type, or log it and count it (METRICS.inc) so the "
+                    f"failure is visible")

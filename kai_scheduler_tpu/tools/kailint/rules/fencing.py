@@ -1,0 +1,138 @@
+"""KAI005: unfenced control-plane writes on the scheduler's write path.
+
+PR 2's split-brain defence: every mutating write the *scheduler* makes —
+BindRequest create/supersede/GC-delete and pod eviction — must carry the
+leadership fencing epoch so the store can reject a deposed leader
+(``kubeapi.Fenced``).  One forgotten call site re-opens the hole: a
+paused old leader commits a stale placement after a new leader took
+over.
+
+Scoped to the scheduler write-path modules (``controllers/
+cache_builder.py``, ``framework/statement.py``, ``scheduler.py``).  A
+call is flagged when it mutates a BindRequest (literal ``"BindRequest"``
+kind argument, or a local dict assigned ``"kind": "BindRequest"``) or
+lives inside an ``evict`` method, and carries neither an explicit
+``epoch=``/``fence=`` keyword nor a ``**fence_kwargs`` splat.  The
+binder and other non-leading controllers write unfenced by design and
+are out of scope.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..astutil import dotted_name
+from ..engine import Finding, ModuleContext, Rule
+
+SCOPE = ("controllers/cache_builder.py", "framework/statement.py",
+         "scheduler.py")
+
+_MUTATORS = {"create", "update", "patch", "delete"}
+
+
+class UnfencedWriteRule(Rule):
+    id = "KAI005"
+    name = "unfenced-write"
+    description = ("scheduler write-path BindRequest/evict API call "
+                   "missing the fencing epoch")
+
+    def applies_to(self, ctx: ModuleContext) -> bool:
+        return any(ctx.path.endswith(s) for s in SCOPE)
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_function(ctx, node)
+
+    def _check_function(self, ctx: ModuleContext,
+                        fn: ast.FunctionDef) -> Iterator[Finding]:
+        bind_locals = self._bind_request_locals(fn)
+        fence_locals = self._fence_locals(fn)
+        in_evict = fn.name == "evict"
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            if not isinstance(node.func, ast.Attribute) or \
+                    node.func.attr not in _MUTATORS:
+                continue
+            base = dotted_name(node.func.value) or ""
+            if "api" not in base.split(".")[-1]:
+                continue  # only API-store mutations
+            if not (in_evict or
+                    self._touches_bind_request(node, bind_locals)):
+                continue
+            if self._carries_fence(node, fence_locals):
+                continue
+            yield self.finding(
+                ctx, node,
+                f"unfenced `{node.func.attr}` on the scheduler write "
+                f"path — pass the fencing epoch "
+                f"(**self._fence_kwargs() / epoch=/fence=) so a deposed "
+                f"leader cannot commit")
+
+    @staticmethod
+    def _bind_request_locals(fn: ast.FunctionDef) -> set[str]:
+        """Local names assigned a dict literal with kind BindRequest."""
+        out: set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Dict):
+                if _dict_kind(node.value) == "BindRequest":
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            out.add(tgt.id)
+        return out
+
+    @staticmethod
+    def _touches_bind_request(call: ast.Call,
+                              bind_locals: set[str]) -> bool:
+        for arg in call.args:
+            if isinstance(arg, ast.Constant) and arg.value == "BindRequest":
+                return True
+            if isinstance(arg, ast.Name) and arg.id in bind_locals:
+                return True
+            if isinstance(arg, ast.Dict) and \
+                    _dict_kind(arg) == "BindRequest":
+                return True
+        return False
+
+    @staticmethod
+    def _fence_locals(fn: ast.FunctionDef) -> set[str]:
+        """Local names assigned from a fence-kwargs source (``fk =
+        self._fence_kwargs()`` and the like)."""
+        out: set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call) and \
+                    "fence" in (dotted_name(node.value.func) or "").lower():
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        out.add(tgt.id)
+        return out
+
+    @staticmethod
+    def _carries_fence(call: ast.Call, fence_locals: set[str]) -> bool:
+        for kw in call.keywords:
+            if kw.arg in ("epoch", "fence"):
+                return True
+            if kw.arg is None:
+                # A splat only counts when it visibly derives from a
+                # fence source — `**self._fence_kwargs()` or a local
+                # assigned from one.  `**retry_opts` must NOT pass the
+                # gate just because it is a splat.
+                v = kw.value
+                name = dotted_name(v.func) if isinstance(v, ast.Call) \
+                    else dotted_name(v)
+                if name and ("fence" in name.lower() or
+                             name.split(".")[-1] in fence_locals):
+                    return True
+        return False
+
+
+def _dict_kind(node: ast.Dict) -> str | None:
+    for k, v in zip(node.keys, node.values):
+        if isinstance(k, ast.Constant) and k.value == "kind" and \
+                isinstance(v, ast.Constant):
+            return v.value
+    return None

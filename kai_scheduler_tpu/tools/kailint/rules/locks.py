@@ -1,0 +1,108 @@
+"""KAI006: lock discipline.
+
+Two failure shapes, both of which have bitten every threaded scheduler:
+
+- **Bare ``lock.acquire()``** as a statement: any exception between
+  ``acquire`` and ``release`` leaks the lock and wedges every other
+  thread forever.  ``with lock:`` is exception-safe and costs nothing.
+  (``acquired = lock.acquire(timeout=...)`` try-lock patterns keep the
+  result and are not flagged.)
+
+- **Blocking calls while holding a lock**: an HTTP round trip, fsync,
+  sleep, or device dispatch under a lock turns one slow syscall into a
+  fleet-wide stall — every thread contending on that lock inherits the
+  latency (and, with the device-guard, a hung dispatch holds the lock
+  for the whole watchdog deadline).  Flagged lexically inside ``with
+  <lock>:`` blocks.  Sites where the serialization IS the contract (WAL
+  appends in utils/commitlog.py) carry explicit suppressions.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from ..astutil import dotted_name
+from ..engine import Finding, ModuleContext, Rule
+
+_LOCKISH = {"lock", "mutex", "rlock", "semaphore", "sem"}
+
+_BLOCKING_DOTTED = {
+    "time.sleep", "os.fsync", "urllib.request.urlopen", "subprocess.run",
+    "subprocess.check_call", "subprocess.check_output", "socket.create_connection",
+}
+_BLOCKING_ATTRS = {"fsync", "urlopen", "dispatch_kernel",
+                   "block_until_ready"}
+
+
+def _is_lockish(node: ast.AST) -> bool:
+    name = dotted_name(node)
+    if not name:
+        return False
+    # Whole-word tokens, not substrings: `journal_lock` is a lock,
+    # `clock` (which merely CONTAINS "lock") is not.
+    leaf = name.split(".")[-1].lower()
+    tokens = set(re.split(r"[_\W]+", leaf)) - {""}
+    return bool(tokens & _LOCKISH)
+
+
+class LockDisciplineRule(Rule):
+    id = "KAI006"
+    name = "lock-discipline"
+    description = ("bare lock.acquire() instead of `with`; blocking call "
+                   "made while a lock is held")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Expr) and \
+                    isinstance(node.value, ast.Call):
+                # An .acquire() whose result is DISCARDED (expression
+                # statement) is always wrong: with no args it leaks on
+                # exception; with timeout= the False result is dropped
+                # and the code proceeds unlocked.  Try-lock patterns
+                # keep the result (Assign/If) and are not Expr nodes.
+                call = node.value
+                if isinstance(call.func, ast.Attribute) and \
+                        call.func.attr == "acquire" and \
+                        _is_lockish(call.func.value):
+                    yield self.finding(
+                        ctx, node,
+                        "bare .acquire() on a lock — use `with lock:` "
+                        "(or keep the acquire result and check it) so "
+                        "an exception or timeout cannot leave the lock "
+                        "state wrong")
+            elif isinstance(node, ast.With):
+                if any(_is_lockish(item.context_expr)
+                       for item in node.items):
+                    yield from self._check_held(ctx, node)
+
+    def _check_held(self, ctx: ModuleContext,
+                    with_node: ast.With) -> Iterator[Finding]:
+        for stmt in with_node.body:
+            for node in _walk_executed(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func) or ""
+                attr = node.func.attr if \
+                    isinstance(node.func, ast.Attribute) else name
+                if name in _BLOCKING_DOTTED or attr in _BLOCKING_ATTRS:
+                    yield self.finding(
+                        ctx, node,
+                        f"blocking call `{name or attr}` while holding a "
+                        f"lock — every contending thread inherits this "
+                        f"latency; move it outside the critical section")
+
+
+def _walk_executed(stmt: ast.AST):
+    """Walk like ast.walk but do not descend into nested function or
+    lambda bodies: code merely *defined* under the lock does not run
+    while the lock is held."""
+    stack = [stmt]
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue  # deferred body — not executed under the lock
+        stack.extend(ast.iter_child_nodes(node))

@@ -1,0 +1,94 @@
+"""KAI008: metrics hygiene.
+
+The metrics registry (utils/metrics.py) is schemaless by design — which
+means nothing but convention stops two call sites from colliding: the
+same name used as both a counter and a histogram renders twice in the
+Prometheus text exposition (a scrape error), and a name that isn't
+``snake_case`` breaks every PromQL consumer.  Label-key consistency
+matters for the same reason: ``metric{queue="a"}`` and a bare ``metric``
+are different series that Prometheus refuses to merge.
+
+Per-module checks: metric-name literals must be ``snake_case``
+(``^[a-z][a-z0-9_]*$``, no ``__``, no trailing ``_``).  Whole-tree
+checks (finalize): one name must map to exactly one instrument type
+(inc / observe / set_gauge), and every call site of a name must pass the
+same label-key set.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from ..astutil import dotted_name, iter_calls
+from ..engine import Finding, ModuleContext, Rule
+
+_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+_INSTRUMENTS = {"inc": "counter", "observe": "histogram",
+                "set_gauge": "gauge"}
+
+
+class MetricsHygieneRule(Rule):
+    id = "KAI008"
+    name = "metrics-hygiene"
+    description = ("non-snake_case metric names; one name used as two "
+                   "instrument types; inconsistent label keys")
+
+    def __init__(self):
+        # name -> instrument -> list[(Finding-shaped site, label keys)]
+        self.sites: dict[str, dict[str, list]] = {}
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for call in iter_calls(ctx.tree):
+            if not isinstance(call.func, ast.Attribute):
+                continue
+            instrument = _INSTRUMENTS.get(call.func.attr)
+            if instrument is None:
+                continue
+            base = (dotted_name(call.func.value) or "").split(".")[-1]
+            if base.lower() != "metrics":
+                continue
+            if not call.args or not isinstance(call.args[0], ast.Constant) \
+                    or not isinstance(call.args[0].value, str):
+                continue
+            name = call.args[0].value
+            site = self.finding(ctx, call, "")
+            labels = frozenset(kw.arg for kw in call.keywords
+                               if kw.arg is not None and
+                               kw.arg != "value")
+            self.sites.setdefault(name, {}).setdefault(
+                instrument, []).append((site, labels))
+            if not _NAME_RE.match(name) or "__" in name or \
+                    name.endswith("_"):
+                yield self.finding(
+                    ctx, call,
+                    f"metric name `{name}` is not snake_case "
+                    f"(^[a-z][a-z0-9_]*$) — PromQL consumers break on it")
+
+    def finalize(self) -> Iterator[Finding]:
+        for name, by_instrument in sorted(self.sites.items()):
+            if len(by_instrument) > 1:
+                kinds = "/".join(sorted(by_instrument))
+                for sites in by_instrument.values():
+                    site, _ = sites[0]
+                    yield Finding(
+                        rule=self.id, path=site.path, line=site.line,
+                        col=site.col, source=site.source,
+                        message=(f"metric `{name}` registered as "
+                                 f"{kinds} — one name, one instrument "
+                                 f"type (duplicate registration)"))
+            for instrument, sites in by_instrument.items():
+                label_sets = {labels for _, labels in sites}
+                if len(label_sets) > 1:
+                    site, _ = sites[0]
+                    rendered = " vs ".join(
+                        "{" + ",".join(sorted(s)) + "}"
+                        for s in sorted(label_sets, key=sorted))
+                    yield Finding(
+                        rule=self.id, path=site.path, line=site.line,
+                        col=site.col, source=site.source,
+                        message=(f"metric `{name}` ({instrument}) used "
+                                 f"with inconsistent label keys "
+                                 f"{rendered} — Prometheus treats these "
+                                 f"as unmergeable series"))

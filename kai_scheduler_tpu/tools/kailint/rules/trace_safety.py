@@ -1,0 +1,151 @@
+"""KAI001: trace-safety inside jit-reachable code.
+
+Scope: modules under ``ops/`` and ``parallel/`` — the code that runs
+under ``jax.jit``.  A function is *jit-reachable* when it is decorated
+with ``jax.jit``/``partial(jax.jit, ...)`` or is (transitively) called
+from one that is, within the same module.  Inside that code, host-level
+Python control flow and host materialization break tracing — either a
+``ConcretizationTypeError`` at runtime or, worse, a silent recompile per
+distinct value:
+
+- ``bool(x)`` / ``float(x)`` / ``int(x)`` / ``x.item()`` on a traced
+  value force a device sync at trace time;
+- ``np.*`` calls drop the tracer to host numpy (constant-folds the
+  traced value or crashes);
+- ``if``/``while`` on a traced expression raises under jit.
+
+Static arguments (``static_argnames``) are concrete at trace time and
+exempt; so are shape/dtype accesses, ``is None`` staging checks, and
+host helpers that jitted code never calls.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..astutil import (dotted_name, function_params, in_path,
+                       is_jit_decorator, local_calls, static_argnames_of,
+                       top_level_functions)
+from ..engine import Finding, ModuleContext, Rule
+
+_CASTS = {"bool", "float", "int"}
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "at"}
+_STATIC_CALLS = {"len", "isinstance", "getattr", "hasattr", "range",
+                 "enumerate", "zip", "type", "tuple", "list", "dict"}
+# jax host-introspection calls: concrete Python values at trace time.
+_STATIC_DOTTED = {"jax.default_backend", "jax.device_count",
+                  "jax.local_device_count", "jax.devices",
+                  "jax.local_devices"}
+
+
+class TraceSafetyRule(Rule):
+    id = "KAI001"
+    name = "trace-safety"
+    description = ("host control flow / host numpy / device sync inside "
+                   "jit-reachable ops code")
+
+    def applies_to(self, ctx: ModuleContext) -> bool:
+        return in_path(ctx.path, "ops", "parallel")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        funcs = top_level_functions(ctx.tree)
+        jitted: dict[str, set[str]] = {}
+        for name, fn in funcs.items():
+            if any(is_jit_decorator(d) for d in fn.decorator_list):
+                statics: set[str] = set()
+                for d in fn.decorator_list:
+                    statics |= static_argnames_of(d)
+                jitted[name] = statics
+        # Transitive closure: helpers called from jitted code trace too.
+        reachable: dict[str, set[str]] = dict(jitted)
+        frontier = list(jitted)
+        while frontier:
+            fn = funcs[frontier.pop()]
+            for callee in local_calls(fn, set(funcs)):
+                if callee not in reachable:
+                    reachable[callee] = set()  # helper args: all traced
+                    frontier.append(callee)
+        for name, statics in reachable.items():
+            yield from self._check_function(ctx, funcs[name], statics)
+
+    def _check_function(self, ctx: ModuleContext, fn: ast.FunctionDef,
+                        statics: set[str]) -> Iterator[Finding]:
+        traced_params = function_params(fn) - statics
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                yield from self._check_call(ctx, fn, node, traced_params)
+            elif isinstance(node, (ast.If, ast.While)):
+                if self._is_traced(node.test, traced_params):
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                    yield self.finding(
+                        ctx, node,
+                        f"Python `{kind}` on a traced value in "
+                        f"jit-reachable `{fn.name}` — use lax.cond/"
+                        f"lax.while_loop or jnp.where")
+
+    def _check_call(self, ctx: ModuleContext, fn: ast.FunctionDef,
+                    call: ast.Call,
+                    traced_params: set[str]) -> Iterator[Finding]:
+        name = dotted_name(call.func)
+        if isinstance(call.func, ast.Attribute) and \
+                call.func.attr == "item":
+            yield self.finding(
+                ctx, call,
+                f".item() in jit-reachable `{fn.name}` forces a host "
+                f"sync — keep the value on device")
+            return
+        if name and (name.startswith("np.") or name.startswith("numpy.")):
+            yield self.finding(
+                ctx, call,
+                f"host numpy call `{name}` in jit-reachable `{fn.name}` "
+                f"— use jnp (host numpy constant-folds or crashes the "
+                f"tracer)")
+            return
+        if name in _CASTS and len(call.args) == 1 and \
+                self._is_traced(call.args[0], traced_params):
+            yield self.finding(
+                ctx, call,
+                f"`{name}()` on a traced value in jit-reachable "
+                f"`{fn.name}` forces a host sync at trace time")
+
+    # -- traced-ness heuristic --------------------------------------------
+    def _is_traced(self, node: ast.AST, params: set[str]) -> bool:
+        """Conservative: an expression is traced when it (dataflow-
+        visibly) touches a non-static parameter.  Shape/dtype accesses,
+        ``is``/``is not`` staging checks, and host calls are static."""
+        if isinstance(node, ast.Name):
+            return node.id in params
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return False
+            return self._is_traced(node.value, params)
+        if isinstance(node, ast.Subscript):
+            return self._is_traced(node.value, params)
+        if isinstance(node, ast.UnaryOp):
+            return self._is_traced(node.operand, params)
+        if isinstance(node, ast.BinOp):
+            return self._is_traced(node.left, params) or \
+                self._is_traced(node.right, params)
+        if isinstance(node, ast.BoolOp):
+            return any(self._is_traced(v, params) for v in node.values)
+        if isinstance(node, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot))
+                   for op in node.ops):
+                return False  # `x is None` stages out at trace time
+            return self._is_traced(node.left, params) or \
+                any(self._is_traced(c, params) for c in node.comparators)
+        if isinstance(node, ast.Call):
+            fname = dotted_name(node.func) or ""
+            if fname in _STATIC_DOTTED:
+                return False
+            if fname in _STATIC_CALLS or fname.split(".")[-1] in \
+                    _STATIC_CALLS:
+                return False
+            if fname.startswith(("jnp.", "jax.", "lax.")):
+                return True  # jnp.any(...) & co produce traced arrays
+            if isinstance(node.func, ast.Attribute) and node.func.attr in {
+                    "any", "all", "sum", "max", "min", "mean", "astype"}:
+                return self._is_traced(node.func.value, params)
+            return False  # other host calls are concrete at trace time
+        return False

@@ -186,6 +186,11 @@ class CommitLog:
                 for rec in keep:
                     fh.write(_encode(rec))
                 fh.flush()
+                # fsync-under-lock IS the contract here: compact must
+                # exclude concurrent appends until the durable rewrite
+                # replaces the file, or an append lands in the old inode
+                # and is silently dropped.
+                # kailint: disable=KAI006 — WAL compact serializes against appends by design
                 os.fsync(fh.fileno())
             os.replace(tmp, self.path)
             self._records = keep

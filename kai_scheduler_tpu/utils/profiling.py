@@ -36,7 +36,9 @@ class SamplingProfiler:
     def start(self) -> "SamplingProfiler":
         if self._thread is not None:
             return self
-        self.started_at = time.time()
+        # Monotonic: running_seconds is a duration — an NTP step must
+        # not produce a negative or inflated profile window.
+        self.started_at = time.monotonic()
         self._stop.clear()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="sampling-profiler")
@@ -90,7 +92,7 @@ class SamplingProfiler:
         return {
             "total_samples": total,
             "interval_seconds": self.interval,
-            "running_seconds": round(time.time() - self.started_at, 1)
+            "running_seconds": round(time.monotonic() - self.started_at, 1)
             if self.started_at else 0.0,
             "top_leaves": [
                 {"frame": frame, "samples": count,

@@ -73,6 +73,29 @@ class TestCRUD:
             client.create({"kind": "Queue", "metadata": {"name": "dup"},
                            "spec": {}})
 
+    def test_degenerate_error_bodies_still_map(self, client, monkeypatch):
+        """A proxy/LB answering 404 with a bare JSON string/array, junk
+        bytes, or a body that dies mid-read (IncompleteRead) must still
+        map to NotFound — never crash with an unmapped exception."""
+        import http.client
+        import io
+        import urllib.error
+        import urllib.request
+
+        class TruncatedBody(io.BytesIO):
+            def read(self, *a):
+                raise http.client.IncompleteRead(b"")
+
+        for body in (io.BytesIO(b'"not found"'), io.BytesIO(b"[]"),
+                     io.BytesIO(b"not json at all"), TruncatedBody()):
+            def fake_urlopen(req, timeout=None, _b=body):
+                raise urllib.error.HTTPError(
+                    req.full_url, 404, "Not Found", {}, _b)
+
+            monkeypatch.setattr(urllib.request, "urlopen", fake_urlopen)
+            with pytest.raises(NotFound):
+                client.get("Queue", "absent-via-proxy")
+
     def test_stale_update_conflicts(self, client):
         client.create({"kind": "Queue", "metadata": {"name": "q"},
                        "spec": {}})

@@ -247,6 +247,20 @@ class TestPaths:
         got = client.get("Pod", "p", "team-a")
         assert got["metadata"]["name"] == "p"
 
+    def test_mutators_accept_fence_kwargs(self, stub, client):
+        """Drop-in parity with InMemoryKubeAPI/HTTPKubeAPI: fenced
+        callers splat `**_fence_kwargs()` into every mutation; the real-
+        cluster client must accept (and discard) epoch/fence instead of
+        raising TypeError mid-reap."""
+        obj = client.create({"kind": "Pod",
+                             "metadata": {"name": "pf",
+                                          "namespace": "team-a"},
+                             "spec": {}}, epoch=3, fence="kai-sched")
+        client.update(obj, epoch=3, fence="kai-sched")
+        client.patch("Pod", "pf", {"status": {"phase": "Running"}},
+                     "team-a", epoch=3, fence="kai-sched")
+        client.delete("Pod", "pf", "team-a", epoch=3, fence="kai-sched")
+
     def test_cluster_scoped_crd(self, stub, client):
         client.create({"kind": "Queue", "metadata": {"name": "q"},
                        "spec": {}})

@@ -158,3 +158,36 @@ class TestSimulatorHttp:
                 assert e.code == 404
         finally:
             server.shutdown()
+
+
+class TestChaosMatrixDryRun:
+    """--dry-run lists the fault grid without spawning a single pytest
+    subprocess — CI validates the matrix definition for free."""
+
+    def test_lists_grid_without_executing(self, capsys, monkeypatch):
+        from kai_scheduler_tpu.tools import chaos_matrix
+
+        def boom(*a, **kw):  # any subprocess spawn = the dry run leaked
+            raise AssertionError("dry run must not execute iterations")
+
+        monkeypatch.setattr(chaos_matrix.subprocess, "run", boom)
+        rc = chaos_matrix.main(["--dry-run", "--seeds", "7,11,13",
+                                "--marker", "chaos", "-k", "commitlog"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert out.count("seed ") == 3
+        for seed in ("7", "11", "13"):
+            assert f"seed {seed:>6}" in out
+        assert "keyword=commitlog" in out
+        assert "3 iteration(s) planned" in out
+
+    def test_dry_run_respects_iterations_default_seeds(self, capsys,
+                                                       monkeypatch):
+        from kai_scheduler_tpu.tools import chaos_matrix
+        monkeypatch.setattr(
+            chaos_matrix.subprocess, "run",
+            lambda *a, **kw: (_ for _ in ()).throw(AssertionError()))
+        assert chaos_matrix.main(["--dry-run", "--iterations", "4"]) == 0
+        out = capsys.readouterr().out
+        assert out.count("seed ") == 4
+        assert "nothing executed" in out
