@@ -415,28 +415,41 @@ def main():
                                               "gpu": 1 if i % 2 == 0
                                               else 0}] * pipe_gang}
                          for i in range(pipe_jobs)}}
-            def one_cycle():
+            from kai_scheduler_tpu.utils.tracing import TRACER
+
+            def one_cycle(cycle_no):
+                # Traced like the daemon's run_once: the flight recorder
+                # yields the per-span breakdown (snapshot/plugin/action/
+                # kernel) that lands in the BENCH json below.
                 cluster = build_cluster(cspec)
                 t_it = time.perf_counter()
-                ssn = Session(cluster, SchedulerConfig()).open()
-                for action in build_actions(["allocate"]):
-                    ta = time.perf_counter()
-                    action.execute(ssn)
-                    ssn.phase_timings[f"action_{action.name}"] = \
-                        time.perf_counter() - ta
+                TRACER.begin_cycle(cycle_no)
+                try:
+                    with TRACER.span("snapshot", kind="snapshot"):
+                        ssn = Session(cluster, SchedulerConfig())
+                    ssn.open()
+                    for action in build_actions(["allocate"]):
+                        ta = time.perf_counter()
+                        with TRACER.span(f"action:{action.name}",
+                                         kind="action"):
+                            action.execute(ssn)
+                        ssn.phase_timings[f"action_{action.name}"] = \
+                            time.perf_counter() - ta
+                finally:
+                    trace = TRACER.end_cycle()
                 secs = time.perf_counter() - t_it
                 placed = sum(
                     1 for pg in ssn.cluster.podgroups.values()
                     for t in pg.pods.values() if t.node_name)
-                return secs, placed, ssn.phase_timings
+                return secs, placed, ssn.phase_timings, trace
 
             # Cold = includes this cluster shape's jit compiles (paid once
             # per binary life / compile-cache fill); steady = the cycle
             # the daemon actually repeats.  The reference's Go cycle has
             # no compile analog, so steady is the comparable number.
-            first_s, pipeline_placed, _ = one_cycle()
+            first_s, pipeline_placed, _, _ = one_cycle(1)
             _log(f"host pipeline cold cycle {first_s:.2f}s; steady run")
-            steady_s, pipeline_placed, breakdown = one_cycle()
+            steady_s, pipeline_placed, breakdown, trace = one_cycle(2)
             signal.alarm(0)
             entry = {
                 "config": f"{pipe_nodes}nodes_"
@@ -449,6 +462,8 @@ def main():
                 entry["breakdown_s"] = {
                     k: round(v, 3) for k, v in breakdown.items()
                     if v >= 0.001}
+            if trace is not None:
+                entry["span_summary"] = trace.span_summary()
             result["detail"]["host_pipeline"] = entry
         except _PhaseTimeout:
             result["detail"]["host_pipeline"] = {"error": "phase timed out"}

@@ -546,4 +546,9 @@ def _record_chunk_failure(ssn, job, tasks, failed_task=None,
         msg = (f"Resources were not found for pod {failed_task.namespace}/"
                f"{failed_task.name}")
     job.add_fit_error(msg)
+    # Explainability ledger: the rejection lands in the live cycle trace
+    # the moment it happens (GET /explain?podgroup=<name>); the cycle
+    # driver merges fit errors again at end_cycle, deduplicated.
+    from ..utils.tracing import TRACER
+    TRACER.note_rejection(job.name, msg)
     ssn.cache.record_event("Unschedulable", msg)

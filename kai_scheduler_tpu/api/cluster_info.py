@@ -35,6 +35,11 @@ class BindRequest:
     # ({"name", "node", "devices"}) the binder publishes at bind time.
     resource_claims: list = field(default_factory=list)
     claim_allocations: list = field(default_factory=list)
+    # Flight-recorder correlation: the trace id of the scheduling cycle
+    # that produced this decision (utils/tracing.py); lands in the API
+    # object as spec.traceId so `GET /debug/trace?cycle=<id>` explains
+    # any bind after the fact.
+    trace_id: str | None = None
 
 
 class ClusterInfo:

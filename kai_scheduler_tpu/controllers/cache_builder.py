@@ -21,6 +21,7 @@ from .binder import GPU_GROUP_ANNOTATION
 from .kubeapi import Conflict, InMemoryKubeAPI
 from .podgrouper import POD_GROUP_LABEL, SUBGROUP_LABEL
 from ..utils.metrics import METRICS
+from ..utils.tracing import TRACER
 
 PHASE_TO_STATUS = {
     "Pending": PodStatus.PENDING,
@@ -665,22 +666,29 @@ class ClusterCache:
                      # Leadership epoch of the deciding scheduler —
                      # auditable fencing trail on the object itself.
                      "schedulerEpoch": fk.get("epoch"),
+                     # Flight-recorder correlation: which cycle decided
+                     # this bind (GET /debug/trace?cycle=<id>).
+                     "traceId": getattr(bind_request, "trace_id", None),
                      "resourceClaims": list(
                          getattr(bind_request, "resource_claims", [])),
                      "resourceClaimAllocations": list(
                          getattr(bind_request, "claim_allocations", []))},
             "status": {"phase": "Pending"},
         }
-        try:
-            self.api.create(obj, **fk)
-        except Conflict:
-            # Leftover from a failed earlier attempt: supersede it.  The
-            # common case stays a single API call.
-            self.api.delete("BindRequest", obj["metadata"]["name"],
-                            task.namespace, **fk)
-            obj["metadata"].pop("resourceVersion", None)
-            obj["metadata"].pop("uid", None)
-            self.api.create(obj, **fk)
+        with TRACER.span(f"bind:{task.name}", kind="kubeapi",
+                         op="bindrequest_create", node=node_name,
+                         epoch=fk.get("epoch")) as sp:
+            try:
+                self.api.create(obj, **fk)
+            except Conflict:
+                # Leftover from a failed earlier attempt: supersede it.
+                # The common case stays a single API call.
+                sp.set(superseded=True)
+                self.api.delete("BindRequest", obj["metadata"]["name"],
+                                task.namespace, **fk)
+                obj["metadata"].pop("resourceVersion", None)
+                obj["metadata"].pop("uid", None)
+                self.api.create(obj, **fk)
 
     def task_pipelined(self, task, node_name: str,
                        gpu_group: str = "") -> None:
@@ -697,20 +705,28 @@ class ClusterCache:
             conditions.append(
                 {"type": "TerminationByKaiScheduler", "status": "True",
                  "reason": "Evicted"})
-            self.api.patch(
-                "Pod", task.name,
-                {"status": {"conditions": conditions},
-                 "metadata": {"deletionTimestamp": str(self.now_fn())}},
-                task.namespace, **self._fence_kwargs())
+            fk = self._fence_kwargs()
+            with TRACER.span(f"evict:{task.name}", kind="kubeapi",
+                             op="evict", epoch=fk.get("epoch")):
+                self.api.patch(
+                    "Pod", task.name,
+                    {"status": {"conditions": conditions},
+                     "metadata": {"deletionTimestamp": str(self.now_fn())}},
+                    task.namespace, **fk)
 
     def record_event(self, kind: str, message: str) -> None:
+        # Correlation: events emitted mid-cycle carry the cycle's trace
+        # id (None off the scheduler thread — watch/binder events).
+        trace_id = TRACER.current_trace_id()
         if self.status_updater is not None:
-            self.status_updater.record_event(kind, message)
+            self.status_updater.record_event(kind, message,
+                                             trace_id=trace_id)
             return
         self.api.create({
             "kind": "Event",
             "metadata": {"name": f"evt-{next(_EVENT_SEQ)}"},
-            "spec": {"reason": kind, "message": message},
+            "spec": {"reason": kind, "message": message,
+                     "traceId": trace_id},
         })
 
     def update_job_statuses(self, ssn) -> None:
@@ -730,6 +746,9 @@ class ClusterCache:
                 "type": "Unschedulable", "status": "True",
                 "reason": "SchedulingFailed",
                 "message": pg.fit_errors[-1],
+                # The cycle whose ledger explains this verdict
+                # (GET /explain?podgroup=<name> has the full reason list).
+                "traceId": getattr(ssn, "trace_id", None),
             })
             if self.status_updater is not None:
                 self.status_updater.patch_status(

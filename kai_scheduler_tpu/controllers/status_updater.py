@@ -43,13 +43,19 @@ class AsyncStatusUpdater:
             self._queue.put(key)
 
     def record_event(self, reason: str, message: str,
-                     about: tuple | None = None) -> None:
+                     about: tuple | None = None,
+                     trace_id: str | None = None) -> None:
+        """``trace_id``: the scheduling cycle that emitted the event
+        (utils/tracing.py correlation); captured at enqueue time because
+        the worker thread runs outside any cycle.  Deliberately NOT part
+        of the dedup key — a repeated identical event keeps the first
+        cycle's id instead of fanning out one write per cycle."""
         key = ("Event", reason, message, about)
         with self._lock:
             if key in self._inflight:
                 return
             self._inflight[key] = {"reason": reason, "message": message,
-                                   "about": about}
+                                   "about": about, "trace_id": trace_id}
         self._queue.put(key)
 
     # -- workers -----------------------------------------------------------
@@ -70,7 +76,8 @@ class AsyncStatusUpdater:
                         "metadata": {"name": f"evt-{id(payload):x}-"
                                              f"{abs(hash(key)) % 10**8}"},
                         "spec": {"reason": payload["reason"],
-                                 "message": payload["message"]},
+                                 "message": payload["message"],
+                                 "traceId": payload.get("trace_id")},
                     })
                 else:
                     kind, namespace, name = key

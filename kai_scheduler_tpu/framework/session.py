@@ -23,6 +23,7 @@ from ..api.podgroup_info import PodGroupInfo
 from ..api.snapshot import SnapshotTensors, pack
 from ..ops.allocate import allocate_jobs_kernel
 from ..ops.scoring import BINPACK
+from ..utils.tracing import TRACER
 from .statement import Statement
 
 
@@ -237,6 +238,10 @@ class Session:
         self.cpu_strategy = BINPACK
         self.mutation_count = 0
         self.statements: list[Statement] = []
+        # Flight-recorder correlation: the cycle's trace id (set by the
+        # scheduler); Statement.commit stamps it onto BindRequests so a
+        # bind is traceable back to the cycle that produced it.
+        self.trace_id: str | None = None
         # Whole-cycle deadline (absolute clock value, set by the
         # scheduler's run_once): past it, every kernel dispatch aborts
         # with CycleDeadlineExceeded instead of starting new device work.
@@ -256,7 +261,9 @@ class Session:
         self.plugins = build_plugins(self.config)
         for plugin in self.plugins:
             t = _time.perf_counter()
-            plugin.on_session_open(self)
+            with TRACER.span(f"plugin:{plugin.name}", kind="plugin",
+                             plugin=plugin.name):
+                plugin.on_session_open(self)
             dt = _time.perf_counter() - t
             if dt >= 0.005:  # only phases that matter in the breakdown
                 self.phase_timings[f"plugin_{plugin.name}"] = \
@@ -291,12 +298,23 @@ class Session:
         watchdog deadline, retry, circuit breaker, CPU degradation
         (utils/deviceguard.py).  All session/solver kernel call sites go
         through here so fault handling is uniform and the whole-cycle
-        deadline is enforced at dispatch granularity."""
+        deadline is enforced at dispatch granularity.  Each dispatch is a
+        flight-recorder span carrying the guard's verdict (device vs
+        CPU-fallback, breaker state) for post-mortem triage."""
         from ..utils.deviceguard import device_guard
-        return device_guard().call(
-            thunk, label=label, validate=validate,
-            record_event=getattr(self.cache, "record_event", None),
-            cycle_deadline_at=self.cycle_deadline_at)
+        guard = device_guard()
+        with TRACER.span(f"dispatch:{label}", kind="kernel",
+                         kernel=label) as sp:
+            fb0, to0 = guard.fallback_calls, guard.timeouts
+            try:
+                return guard.call(
+                    thunk, label=label, validate=validate,
+                    record_event=getattr(self.cache, "record_event", None),
+                    cycle_deadline_at=self.cycle_deadline_at)
+            finally:
+                sp.set(fallback=guard.fallback_calls > fb0,
+                       timed_out=guard.timeouts > to0,
+                       breaker=guard.breaker.state)
 
     # -- dense mirrors (single writer: the Statement via sync_node) --------
     @property

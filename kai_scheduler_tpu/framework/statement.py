@@ -299,10 +299,12 @@ class Statement:
         state — no phantom reservations, no half-trusted history."""
         from ..utils import commitlog as cl
         from ..utils.deviceguard import control_fault
+        from ..utils.tracing import TRACER
 
         log = getattr(self.session.cache, "commitlog", None)
         epoch_provider = getattr(self.session.cache, "epoch_provider", None)
         epoch = epoch_provider() if epoch_provider is not None else None
+        trace_id = getattr(self.session, "trace_id", None)
 
         # Pre-pass: build every BindRequest (running the plugin mutators,
         # dynamicresources.go:252) and collect the intent records in op
@@ -317,7 +319,8 @@ class Statement:
                     pod_uid=op.task.uid, pod_name=op.task.name,
                     namespace=op.task.namespace, node_name=op.node_name,
                     gpu_groups=(op.gpu_group.split(",") if op.gpu_group
-                                else []))
+                                else []),
+                    trace_id=trace_id)
                 for mutator in getattr(self.session,
                                        "bind_request_mutators", []):
                     mutator(op.task, br)
@@ -330,8 +333,14 @@ class Statement:
             elif op.kind == "evict" and log is not None:
                 intents.append(cl.evict_intent(
                     op.task.uid, op.task.name, op.task.namespace, epoch))
-        txids = iter(log.append_intents(intents) if log is not None
-                     and intents else ())
+        if log is not None and intents:
+            # The journal append is the commit's one fsync: a span of its
+            # own so a slow disk is distinguishable from slow API writes.
+            with TRACER.span("journal", kind="commit",
+                             intents=len(intents), epoch=epoch):
+                txids = iter(log.append_intents(intents))
+        else:
+            txids = iter(())
         if log is not None and intents \
                 and control_fault("crash-after-journal") is not None:
             # Chaos: die at the worst instant — intents durable, nothing

@@ -17,6 +17,7 @@ from .utils.deviceguard import (CycleDeadlineExceeded, DeviceGuardError,
                                 device_guard)
 from .utils.logging import LOG
 from .utils.metrics import METRICS
+from .utils.tracing import TRACER
 
 
 class Scheduler:
@@ -42,22 +43,98 @@ class Scheduler:
         action's uncommitted statements — committed work stands, phantom
         allocations never reach the cache — and the cycle ends degraded
         instead of wedging the daemon (docs/DEGRADATION.md)."""
-        # Deferred: controllers/__init__ imports this module (operator
-        # builds Schedulers), so a top-level import would be circular.
-        from .controllers.kubeapi import Fenced
         self.session_id += 1
+        guard = device_guard()
+        trace_id = TRACER.begin_cycle(self.session_id)
+        fallbacks0 = guard.fallback_calls
         t0 = time.perf_counter()
         deadline = self.config.cycle_deadline_s
         # The dispatch-level deadline shares t0's origin: taking it after
         # the snapshot build would let kernel dispatches overrun the
         # whole-cycle budget by the full snapshot cost at fleet scale.
-        clock0 = device_guard().clock()
-        cluster = self.cluster_provider()
-        usage = self.usage_provider() if self.usage_provider else None
-        ssn = Session(cluster, self.config, self.cache, queue_usage=usage)
-        if deadline:
-            ssn.cycle_deadline_at = clock0 + deadline
-        ssn.aborted = None
+        clock0 = guard.clock()
+        ssn = None
+        escaped: BaseException | None = None
+        try:
+            with TRACER.span("snapshot", kind="snapshot") as snap_sp:
+                cluster = self.cluster_provider()
+                usage = (self.usage_provider()
+                         if self.usage_provider else None)
+                ssn = Session(cluster, self.config, self.cache,
+                              queue_usage=usage)
+                snap_sp.set(nodes=len(cluster.nodes),
+                            podgroups=len(cluster.podgroups))
+            ssn.trace_id = trace_id
+            if deadline:
+                ssn.cycle_deadline_at = clock0 + deadline
+            ssn.aborted = None
+            return self._run_session(ssn, deadline, t0)
+        except BaseException as exc:
+            # Captured explicitly, NOT via sys.exc_info() in the finally:
+            # that would also see an outer, already-handled exception when
+            # run_once is called from inside an except block, falsely
+            # finalizing a healthy cycle as aborted.
+            escaped = exc
+            raise
+        finally:
+            # Finalize the flight-recorder trace whatever happened —
+            # including exceptions that escaped the action loop's
+            # DeviceGuardError handling (e.g. a provider failure).
+            # getattr: an exception landing between Session construction
+            # and the `ssn.aborted = None` assignment must not turn the
+            # finalize into an AttributeError masking the real error.
+            aborted = getattr(ssn, "aborted", None)
+            if aborted is None and escaped is not None:
+                aborted = f"{type(escaped).__name__}: {escaped}"
+            # Build the explainability ledger capped at the source: on a
+            # sustained over-capacity cluster thousands of groups stay
+            # pending — materializing every reason list only for the
+            # trace's caps to discard it would be per-cycle garbage.
+            from .utils.tracing import CycleTrace
+            cap_groups = CycleTrace.MAX_EXPLAIN_GROUPS
+            cap_reasons = CycleTrace.MAX_REASONS_PER_GROUP
+            explain: dict = {}
+            skipped_groups = 0
+            resolved: list = []
+            if ssn is not None:
+                for pg in ssn.cluster.podgroups.values():
+                    if not pg.fit_errors and not pg.task_fit_errors:
+                        # No rejection this cycle: its stale /explain
+                        # record (if any) drops — the group scheduled or
+                        # stopped pending.  Only this shard's groups are
+                        # in the snapshot, so other shards' records are
+                        # untouched.
+                        resolved.append(pg.name)
+                        continue
+                    if len(explain) >= cap_groups:
+                        skipped_groups += 1
+                        continue
+                    reasons = list(pg.fit_errors[:cap_reasons])
+                    if len(reasons) < cap_reasons:
+                        reasons += [
+                            f"task {uid}: {msg}" for uid, msg in
+                            sorted(pg.task_fit_errors.items())
+                            [:cap_reasons - len(reasons)]]
+                    explain[pg.name] = reasons
+            TRACER.end_cycle(
+                aborted=aborted,
+                degraded=(guard.degraded
+                          or guard.fallback_calls > fallbacks0),
+                explain=explain,
+                # Over-cap groups are counted, never silently dropped;
+                # folded in pre-publication so readers and the
+                # post-mortem dump see the complete trace.
+                dropped_rejections=skipped_groups,
+                # An aborted cycle proved nothing about the groups it
+                # never attempted: keep their records.
+                resolved=(resolved if aborted is None else ()))
+
+    def _run_session(self, ssn: Session, deadline, t0: float) -> Session:
+        """The action loop of one cycle (split from run_once so the
+        flight-recorder finalize wraps the whole body exactly once)."""
+        # Deferred: controllers/__init__ imports this module (operator
+        # builds Schedulers), so a top-level import would be circular.
+        from .controllers.kubeapi import Fenced
 
         def _abort(where: str, exc: Exception) -> None:
             # Device path dead AND no fallback (or the cycle deadline
@@ -103,7 +180,10 @@ class Scheduler:
                         break
                     ta = time.perf_counter()
                     try:
-                        action.execute(ssn)
+                        with TRACER.span(f"action:{action.name}",
+                                         kind="action",
+                                         action=action.name):
+                            action.execute(ssn)
                     except (DeviceGuardError, Fenced) as exc:
                         _abort(f"action {action.name}", exc)
                         break

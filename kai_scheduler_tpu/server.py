@@ -13,6 +13,15 @@ endpoints:
                       breaker reports {"status": "degraded", ...} with
                       HTTP 200 — the daemon is alive and scheduling on
                       the CPU fallback path, not dead (docs/DEGRADATION.md)
+  GET /debug/cycles   flight recorder: last-N cycle summaries (duration,
+                      span breakdown, abort/degraded flags)
+  GET /debug/trace    Chrome trace-event JSON for one cycle
+                      (?cycle=<trace id | cycle number>; default latest)
+                      — load in Perfetto (docs/OBSERVABILITY.md)
+  GET /explain        latest unschedulability reasons for a PodGroup
+                      (?podgroup=<name>; without it, the known names)
+  GET /debug/pprof    the SamplingProfiler's folded stacks (flamegraph/
+                      speedscope-ready; requires --enable-profiler)
 
 Leader election comes in two flavors:
 
@@ -41,6 +50,7 @@ from .utils import parse_bool as _parse_bool
 from .utils.deviceguard import configure_device_guard, device_guard
 from .utils.logging import LOG, init_loggers
 from .utils.metrics import METRICS
+from .utils.tracing import TRACER
 
 
 def healthz_payload(state: dict | None = None) -> dict:
@@ -101,30 +111,30 @@ class LeaderElector:
 def _make_handler(server_state):
     class Handler(BaseHTTPRequestHandler):
         def do_GET(self):
-            if self.path == "/metrics":
+            from urllib.parse import parse_qs
+            path, _, raw_query = self.path.partition("?")
+            q = {k: v[0] for k, v in parse_qs(raw_query).items()}
+            if path == "/metrics":
                 body = METRICS.to_prometheus_text().encode()
                 ctype = "text/plain"
-            elif self.path == "/healthz":
+            elif path == "/healthz":
                 body = json.dumps(healthz_payload(server_state)).encode()
                 ctype = "application/json"
-            elif self.path == "/get-snapshot":
+            elif path == "/get-snapshot":
                 ssn = server_state.get("last_session")
                 body = json.dumps(
                     dump_cluster(ssn) if ssn else {}).encode()
                 ctype = "application/json"
-            elif self.path == "/job-order":
+            elif path == "/job-order":
                 body = json.dumps(
                     server_state.get("job_order", {})).encode()
                 ctype = "application/json"
-            elif self.path.split("?", 1)[0] == "/debug/profile":
-                from urllib.parse import parse_qs, urlparse
+            elif path == "/debug/profile":
                 prof = server_state.get("profiler")
                 if prof is None:
                     self.send_error(
                         404, "profiler disabled (--enable-profiler)")
                     return
-                q = {k: v[0] for k, v in
-                     parse_qs(urlparse(self.path).query).items()}
                 if q.get("summary") in ("1", "true"):
                     body = json.dumps(prof.summary()).encode()
                     ctype = "application/json"
@@ -137,6 +147,44 @@ def _make_handler(server_state):
                         return
                     body = prof.folded(top=top).encode()
                     ctype = "text/plain"
+            elif path == "/debug/cycles":
+                # Flight recorder: last-N cycle summaries, newest first.
+                body = json.dumps({"capacity": TRACER.capacity,
+                                   "cycles": TRACER.cycles()}).encode()
+                ctype = "application/json"
+            elif path == "/debug/trace":
+                trace = TRACER.get_trace(q.get("cycle"))
+                if trace is None:
+                    self.send_error(
+                        404, "no such cycle trace (list: /debug/cycles)")
+                    return
+                body = json.dumps(trace.to_chrome()).encode()
+                ctype = "application/json"
+            elif path == "/explain":
+                name = q.get("podgroup")
+                if not name:
+                    body = json.dumps({
+                        "podgroups": TRACER.explained_podgroups()}).encode()
+                else:
+                    record = TRACER.explain_for(name)
+                    if record is None:
+                        self.send_error(
+                            404, f"no recorded rejection for podgroup "
+                                 f"{name!r}")
+                        return
+                    body = json.dumps(record).encode()
+                ctype = "application/json"
+            elif path == "/debug/pprof":
+                # The SamplingProfiler's collapsed stacks as a first-class
+                # endpoint (was reachable only via /debug/profile's query
+                # dance): pipe into flamegraph.pl / speedscope directly.
+                prof = server_state.get("profiler")
+                if prof is None:
+                    self.send_error(
+                        404, "profiler disabled (--enable-profiler)")
+                    return
+                body = prof.folded().encode()
+                ctype = "text/plain"
             else:
                 self.send_error(404)
                 return

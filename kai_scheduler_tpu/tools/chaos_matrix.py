@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import argparse
 import os
+import shutil
 import subprocess
 import sys
 import time
@@ -34,7 +35,8 @@ DEFAULT_TESTS = ["tests/test_reconciler.py", "tests/test_device_guard.py"]
 
 def run_iteration(seed: int, tests: list[str], marker: str,
                   keyword: str | None, repo_root: str,
-                  timeout_s: float) -> tuple[bool, float, str]:
+                  timeout_s: float,
+                  trace_dir: str | None = None) -> tuple[bool, float, str]:
     """One pytest run under one fault seed; (passed, seconds, tail)."""
     cmd = [sys.executable, "-m", "pytest", "-q", "-p", "no:cacheprovider",
            "-p", "no:randomly", "-m", marker, *tests]
@@ -47,6 +49,13 @@ def run_iteration(seed: int, tests: list[str], marker: str,
     # The matrix must control the fault spec per test, not inherit an
     # outer one armed for a different experiment.
     env.pop("KAI_FAULT_INJECT", None)
+    if trace_dir:
+        # The flight recorder (utils/tracing.py) dumps every aborted or
+        # degraded cycle's Chrome trace JSON here — the post-mortem
+        # artifact for a flaking seed.
+        env["KAI_TRACE_DIR"] = trace_dir
+    else:
+        env.pop("KAI_TRACE_DIR", None)
     t0 = time.monotonic()
     try:
         proc = subprocess.run(cmd, cwd=repo_root, env=env,
@@ -76,6 +85,11 @@ def main(argv=None) -> int:
                     help="pytest marker to select (default: chaos)")
     ap.add_argument("--timeout", type=float, default=300.0,
                     help="per-iteration timeout in seconds")
+    ap.add_argument("--trace-dir", default=None,
+                    help="keep each FAILING iteration's cycle traces "
+                         "(Chrome trace JSON from the flight recorder) "
+                         "under <dir>/seed<seed>/ for post-mortem; "
+                         "passing iterations' traces are cleaned up")
     ap.add_argument("--dry-run", action="store_true",
                     help="print the fault grid (seed/tests/marker/"
                          "timeout per iteration) without running "
@@ -89,11 +103,23 @@ def main(argv=None) -> int:
     repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
 
+    if args.trace_dir:
+        # The child resolves KAI_TRACE_DIR against cwd=repo_root while
+        # the cleanup below resolves against the invoker's cwd — pin
+        # both to one absolute path.
+        args.trace_dir = os.path.abspath(args.trace_dir)
+
+    def seed_trace_dir(seed: int) -> str | None:
+        return (os.path.join(args.trace_dir, f"seed{seed}")
+                if args.trace_dir else None)
+
     if args.dry_run:
         for seed in seeds:
             print(f"seed {seed:>6}  marker={args.marker}  "
                   f"keyword={args.keyword or '-'}  "
-                  f"timeout={args.timeout:g}s  tests={' '.join(tests)}",
+                  f"timeout={args.timeout:g}s  "
+                  f"trace-dir={seed_trace_dir(seed) or '-'}  "
+                  f"tests={' '.join(tests)}",
                   flush=True)
         print(f"\nchaos matrix (dry run): {len(seeds)} iteration(s) "
               f"planned, nothing executed", flush=True)
@@ -101,14 +127,21 @@ def main(argv=None) -> int:
 
     rows, failed = [], []
     for seed in seeds:
+        tdir = seed_trace_dir(seed)
         ok, secs, tail = run_iteration(seed, tests, args.marker,
                                        args.keyword, repo_root,
-                                       args.timeout)
+                                       args.timeout, trace_dir=tdir)
         rows.append((seed, ok, secs))
         status = "ok" if ok else "FLAKE"
         print(f"seed {seed:>6}  {status:<5}  {secs:6.1f}s", flush=True)
+        if ok and tdir:
+            # Chaos tests abort cycles on purpose; only a flaking seed's
+            # traces are post-mortem material.
+            shutil.rmtree(tdir, ignore_errors=True)
         if not ok:
             failed.append(seed)
+            if tdir and os.path.isdir(tdir):
+                print(f"cycle traces kept in {tdir}", flush=True)
             print(tail, flush=True)
 
     print(f"\nchaos matrix: {len(rows) - len(failed)}/{len(rows)} green",

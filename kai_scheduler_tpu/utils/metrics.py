@@ -31,9 +31,16 @@ class Histogram:
         self.n += 1
 
     def quantile(self, q: float) -> float:
+        """Upper bound of the bucket holding the q-quantile observation.
+
+        ``q`` is clamped to [0, 1].  The target is the RANK of the
+        quantile observation (1-based, ceil) — a plain ``acc >= q*n``
+        misreports q=0: the target degenerates to 0, which the very
+        first (possibly empty) bucket satisfies."""
         if self.n == 0:
             return 0.0
-        target = q * self.n
+        q = min(1.0, max(0.0, q))
+        target = max(1, math.ceil(q * self.n))
         acc = 0
         for b in self.buckets:
             acc += self.counts.get(b, 0)
@@ -70,6 +77,19 @@ class Metrics:
         lines = []
         for name, h in self.histograms.items():
             lines.append(f"# TYPE {name} histogram")
+            # Cumulative buckets (the Prometheus histogram contract:
+            # every `le` counts observations <= it, ending at `+Inf`
+            # == _count) — `_sum`/`_count` alone is not scrapeable as a
+            # histogram and breaks histogram_quantile().
+            acc = 0
+            for b in h.buckets:
+                acc += h.counts.get(b, 0)
+                le = "+Inf" if b == math.inf else f"{b:g}"
+                lines.append(f'{name}_bucket{{le="{le}"}} {acc}')
+            if not h.buckets or h.buckets[-1] != math.inf:
+                # Custom bucket lists without an inf edge still need the
+                # mandatory +Inf bucket (== _count).
+                lines.append(f'{name}_bucket{{le="+Inf"}} {h.n}')
             lines.append(f"{name}_sum {h.total}")
             lines.append(f"{name}_count {h.n}")
         for key, v in self.gauges.items():

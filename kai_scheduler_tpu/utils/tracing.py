@@ -1,0 +1,427 @@
+"""Cycle flight recorder: structured span tracing for the decision path.
+
+The per-cycle hot loop (snapshot -> plugin opens -> actions -> kernel
+dispatches -> commit) is the paper's latency-critical contribution, yet
+``phase_timings`` averages cannot answer the two questions that matter
+after an incident: *which span burned the budget of cycle N* and *why is
+this PodGroup still pending*.  This module gives every cycle a structured
+trace — nested spans with monotonic durations, attributes, and error
+status — and keeps the last N complete traces in a bounded in-memory
+**flight recorder**, exportable as Chrome trace-event / Perfetto JSON.
+
+Design constraints (the kailint contracts):
+
+- all timing is ``time.perf_counter`` (KAI003: no wall clock in utils/);
+- span bookkeeping is thread-local and lock-free on the cycle path; the
+  ring lock guards only finished-trace appends and reads (KAI006: no
+  blocking work under a lock — trace-file dumps happen outside it);
+- memory is bounded at every layer: the ring holds ``capacity`` traces,
+  a trace holds at most ``max_spans_per_trace`` spans, and the
+  explainability ledger caps groups/reasons per trace — every overflow
+  is counted (``dropped_spans`` / ``dropped_rejections``), never silent.
+
+Correlation: the scheduler threads the cycle's ``trace_id`` into
+BindRequest specs (``spec.traceId``) and status-updater events
+(``spec.traceId``), so a bind object in the store points back at the
+exact cycle trace that produced it.  Rejection reasons land in a
+per-cycle **explainability ledger** (``CycleTrace.explain``) surfaced at
+``GET /explain?podgroup=<name>``.  See docs/OBSERVABILITY.md.
+
+Post-mortem hook: when ``KAI_TRACE_DIR`` is set, every aborted or
+degraded cycle's Chrome trace JSON is written there as it completes —
+``tools/chaos_matrix.py --trace-dir`` uses this to capture the traces of
+failing chaos iterations.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from collections import deque
+
+from .logging import LOG
+from .metrics import METRICS
+
+
+class Span:
+    """One timed operation inside a cycle trace.
+
+    ``start_s`` is relative to the trace's origin (monotonic), so spans
+    serialize directly into Chrome trace-event ``ts``/``dur`` pairs."""
+
+    __slots__ = ("trace_id", "span_id", "parent_id", "name", "kind",
+                 "start_s", "duration_s", "attrs", "status", "error")
+
+    def __init__(self, trace_id: str, span_id: str, parent_id: str | None,
+                 name: str, kind: str, start_s: float):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.name = name
+        self.kind = kind
+        self.start_s = start_s
+        self.duration_s = 0.0
+        self.attrs: dict = {}
+        self.status = "ok"
+        self.error = ""
+
+    def set(self, **attrs) -> None:
+        """Attach attributes (kernel label, breaker state, ...)."""
+        self.attrs.update(attrs)
+
+    def mark_error(self, message: str) -> None:
+        self.status = "error"
+        self.error = message[:300]
+
+    def to_event(self) -> dict:
+        """Chrome trace-event (Perfetto/about:tracing) complete event."""
+        args = dict(self.attrs)
+        args["status"] = self.status
+        if self.error:
+            args["error"] = self.error
+        if self.parent_id:
+            args["parent"] = self.parent_id
+        return {"name": self.name, "cat": self.kind, "ph": "X",
+                "ts": round(self.start_s * 1e6, 1),
+                "dur": round(self.duration_s * 1e6, 1),
+                "pid": 1, "tid": 1, "id": self.span_id, "args": args}
+
+
+class _NullSpan:
+    """Span opened outside an active cycle (offline sessions, bench
+    setup): every call is a no-op, so instrumented code never branches."""
+
+    __slots__ = ()
+    status = "ok"
+
+    def set(self, **attrs) -> None:
+        pass
+
+    def mark_error(self, message: str) -> None:
+        pass
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _SpanCtx:
+    """Context manager around a span: closes it on exit and converts an
+    escaping exception into error status (the exception still
+    propagates — tracing observes failures, never swallows them)."""
+
+    __slots__ = ("_tracer", "span")
+
+    def __init__(self, tracer: "Tracer", span):
+        self._tracer = tracer
+        self.span = span
+
+    def __enter__(self):
+        return self.span
+
+    def __exit__(self, exc_type, exc, tb):
+        if self.span is not _NULL_SPAN:
+            if exc is not None and self.span.status == "ok":
+                self.span.mark_error(f"{exc_type.__name__}: {exc}")
+            self._tracer._close_span(self.span)
+        return False
+
+
+class CycleTrace:
+    """One complete scheduling cycle: the root span, its children, the
+    abort/degraded verdict, and the explainability ledger."""
+
+    # Ledger bounds: a sustained over-capacity cluster keeps thousands
+    # of PodGroups pending every cycle; without caps the ring would hold
+    # ring-size x pending-groups x reasons strings live.  Overflow is
+    # counted (dropped_rejections), never silent.
+    MAX_EXPLAIN_GROUPS = 256
+    MAX_REASONS_PER_GROUP = 8
+
+    def __init__(self, trace_id: str, cycle: int, max_spans: int):
+        self.trace_id = trace_id
+        self.cycle = cycle
+        self.t0 = time.perf_counter()
+        self.root: Span | None = None
+        self.spans: list[Span] = []   # completed spans, completion order
+        self.max_spans = max_spans
+        self.dropped_spans = 0
+        self.aborted: str | None = None
+        self.degraded = False
+        self.duration_ms = 0.0
+        self.explain: dict[str, list[str]] = {}  # podgroup -> reasons
+        self.dropped_rejections = 0
+
+    def add_rejection(self, podgroup: str, reason: str) -> None:
+        reasons = self.explain.get(podgroup)
+        if reasons is None:
+            if len(self.explain) >= self.MAX_EXPLAIN_GROUPS:
+                self.dropped_rejections += 1
+                return
+            reasons = self.explain[podgroup] = []
+        if reason in reasons:
+            return
+        if len(reasons) >= self.MAX_REASONS_PER_GROUP:
+            self.dropped_rejections += 1
+            return
+        reasons.append(reason)
+
+    def span_summary(self) -> dict:
+        """kind -> {count, total_ms, errors}: where the cycle went."""
+        out: dict = {}
+        for sp in self.spans:
+            entry = out.setdefault(sp.kind, {"count": 0, "total_ms": 0.0,
+                                             "errors": 0})
+            entry["count"] += 1
+            entry["total_ms"] += sp.duration_s * 1e3
+            if sp.status == "error":
+                entry["errors"] += 1
+        for entry in out.values():
+            entry["total_ms"] = round(entry["total_ms"], 3)
+        return out
+
+    def to_summary(self) -> dict:
+        return {"cycle": self.cycle, "trace_id": self.trace_id,
+                "duration_ms": round(self.duration_ms, 3),
+                "aborted": self.aborted, "degraded": self.degraded,
+                "spans": self.span_summary(),
+                "dropped_spans": self.dropped_spans,
+                "dropped_rejections": self.dropped_rejections,
+                "rejected_podgroups": sorted(self.explain)}
+
+    def to_chrome(self) -> dict:
+        """Chrome trace-event JSON: load in Perfetto (ui.perfetto.dev)
+        or chrome://tracing."""
+        return {"displayTimeUnit": "ms",
+                "traceEvents": [sp.to_event() for sp in self.spans],
+                "otherData": {"trace_id": self.trace_id,
+                              "cycle": self.cycle,
+                              "aborted": self.aborted,
+                              "degraded": self.degraded,
+                              "dropped_spans": self.dropped_spans,
+                              "explain": self.explain}}
+
+
+class Tracer:
+    """Thread-safe tracer + bounded flight recorder.
+
+    The active trace is thread-local: one scheduler thread drives one
+    cycle, and spans opened on other threads (status-updater workers)
+    deliberately no-op instead of racing the cycle's span stack.  Reads
+    (`cycles`, `get_trace`, `explain_for`) come from HTTP handler threads
+    and take the ring lock; finished traces are immutable."""
+
+    def __init__(self, capacity: int | None = None,
+                 max_spans_per_trace: int = 512):
+        if capacity is None:
+            try:
+                capacity = int(os.environ.get("KAI_TRACE_CYCLES", 32))
+            except ValueError:
+                capacity = 32
+        self.capacity = max(1, capacity)
+        self.max_spans_per_trace = max(8, max_spans_per_trace)
+        self._ring: deque = deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self._local = threading.local()
+        self._ids = itertools.count(1)
+        # podgroup -> latest rejection record ({"cycle", "trace_id",
+        # "reasons"}); bounded like ClusterCache._warned_selectors.
+        self._explain_latest: dict = {}
+
+    # -- cycle lifecycle ---------------------------------------------------
+    def _state(self) -> dict:
+        st = getattr(self._local, "state", None)
+        if st is None:
+            st = self._local.state = {"trace": None, "stack": []}
+        return st
+
+    def begin_cycle(self, cycle: int) -> str:
+        """Open a cycle trace (and its root span) on this thread; returns
+        the trace id the scheduler threads into binds and events."""
+        st = self._state()
+        if st["trace"] is not None:
+            # An exception escaped the previous cycle driver before
+            # end_cycle ran: finalize the dangling trace as aborted so
+            # the recorder never loses it (and the stack never leaks).
+            self.end_cycle(aborted="trace abandoned by next cycle")
+        trace_id = f"t{next(self._ids):06d}"
+        trace = CycleTrace(trace_id, cycle, self.max_spans_per_trace)
+        root = Span(trace_id, f"s{next(self._ids)}", None,
+                    "cycle", "cycle", 0.0)
+        root.set(cycle=cycle)
+        trace.root = root
+        st["trace"] = trace
+        st["stack"] = [root]
+        return trace_id
+
+    def end_cycle(self, aborted: str | None = None, degraded: bool = False,
+                  explain: dict | None = None,
+                  dropped_rejections: int = 0,
+                  resolved=()) -> CycleTrace | None:
+        """Finalize the active trace: close leftover spans, record the
+        verdict, merge the explainability ledger, push to the ring, emit
+        per-span-kind latency histograms, and (when KAI_TRACE_DIR is
+        armed) dump aborted/degraded traces for post-mortem.
+
+        ``dropped_rejections``: rejections the caller discarded at the
+        source (ledger caps) — folded in BEFORE publication so readers
+        and the post-mortem dump never see a half-counted trace.
+        ``resolved``: PodGroup names this cycle saw WITHOUT any rejection
+        (scheduled, or no longer pending) — their stale ``/explain``
+        records drop, so an operator is never pointed at a group that is
+        actually running."""
+        st = self._state()
+        trace: CycleTrace | None = st["trace"]
+        if trace is None:
+            return None
+        now = time.perf_counter()
+        # Leftover spans above the root belong to an aborted phase whose
+        # exception bypassed their context managers; close deepest-first.
+        while len(st["stack"]) > 1:
+            sp = st["stack"].pop()
+            sp.duration_s = (now - trace.t0) - sp.start_s
+            if aborted and sp.status == "ok":
+                sp.mark_error(aborted)
+            self._record_span(trace, sp)
+        root = st["stack"].pop()
+        root.duration_s = now - trace.t0
+        if aborted:
+            root.mark_error(aborted)
+        trace.spans.append(root)  # the root always survives the span cap
+        trace.aborted = aborted
+        trace.degraded = bool(degraded)
+        trace.duration_ms = root.duration_s * 1e3
+        for podgroup, reasons in (explain or {}).items():
+            for reason in reasons:
+                trace.add_rejection(podgroup, reason)
+        trace.dropped_rejections += int(dropped_rejections)
+        st["trace"] = None
+        st["stack"] = []
+        for sp in trace.spans:
+            METRICS.observe(f"cycle_span_{sp.kind}_latency_ms",
+                            sp.duration_s * 1e3)
+        with self._lock:
+            self._ring.append(trace)
+            for name in resolved:
+                self._explain_latest.pop(name, None)
+            if len(self._explain_latest) >= 4096:
+                # Bounded memory in a long-lived daemon whose PodGroup
+                # names churn: reset over growing forever.
+                self._explain_latest.clear()
+            for podgroup, reasons in trace.explain.items():
+                self._explain_latest[podgroup] = {
+                    "podgroup": podgroup, "cycle": trace.cycle,
+                    "trace_id": trace.trace_id, "reasons": list(reasons)}
+        self._maybe_dump(trace)
+        return trace
+
+    # -- spans -------------------------------------------------------------
+    def span(self, name: str, kind: str, **attrs) -> _SpanCtx:
+        """Open a child span under the current one.  Outside an active
+        cycle this returns a null span — instrumentation is always safe
+        to leave in place."""
+        st = self._state()
+        trace: CycleTrace | None = st["trace"]
+        if trace is None:
+            return _SpanCtx(self, _NULL_SPAN)
+        parent = st["stack"][-1] if st["stack"] else None
+        sp = Span(trace.trace_id, f"s{next(self._ids)}",
+                  parent.span_id if parent is not None else None,
+                  name, kind, time.perf_counter() - trace.t0)
+        if attrs:
+            sp.attrs.update(attrs)
+        st["stack"].append(sp)
+        return _SpanCtx(self, sp)
+
+    def _close_span(self, span: Span) -> None:
+        st = self._state()
+        trace: CycleTrace | None = st["trace"]
+        if st["stack"] and st["stack"][-1] is span:
+            st["stack"].pop()
+        else:  # out-of-order close (defensive): remove wherever it sits
+            try:
+                st["stack"].remove(span)
+            except ValueError:
+                pass
+        if trace is None:
+            return
+        span.duration_s = (time.perf_counter() - trace.t0) - span.start_s
+        self._record_span(trace, span)
+
+    @staticmethod
+    def _record_span(trace: CycleTrace, span: Span) -> None:
+        if len(trace.spans) < trace.max_spans - 1:  # -1: root's seat
+            trace.spans.append(span)
+        else:
+            trace.dropped_spans += 1
+
+    def current_trace_id(self) -> str | None:
+        st = getattr(self._local, "state", None)
+        trace = st["trace"] if st else None
+        return trace.trace_id if trace is not None else None
+
+    def note_rejection(self, podgroup: str, reason: str) -> None:
+        """Record a filter/score rejection into the active cycle's
+        explainability ledger (actions call this as failures happen; the
+        cycle driver merges fit errors again at end_cycle)."""
+        st = getattr(self._local, "state", None)
+        trace = st["trace"] if st else None
+        if trace is not None:
+            trace.add_rejection(podgroup, reason)
+
+    # -- flight-recorder reads (HTTP endpoints, tests) ---------------------
+    def cycles(self) -> list[dict]:
+        """Last-N cycle summaries, newest first (GET /debug/cycles)."""
+        with self._lock:
+            return [t.to_summary() for t in reversed(self._ring)]
+
+    def get_trace(self, key: str | None = None) -> CycleTrace | None:
+        """Look a trace up by trace id or cycle number; None = latest."""
+        with self._lock:
+            if not self._ring:
+                return None
+            if key is None or key == "":
+                return self._ring[-1]
+            for trace in reversed(self._ring):
+                if trace.trace_id == key or str(trace.cycle) == key:
+                    return trace
+        return None
+
+    def explain_for(self, podgroup: str) -> dict | None:
+        """Latest unschedulability record for a PodGroup, or None."""
+        with self._lock:
+            record = self._explain_latest.get(podgroup)
+            return dict(record) if record is not None else None
+
+    def explained_podgroups(self) -> list[str]:
+        with self._lock:
+            return sorted(self._explain_latest)
+
+    def reset(self) -> None:
+        """Drop all recorded state (tests)."""
+        with self._lock:
+            self._ring.clear()
+            self._explain_latest.clear()
+        self._local = threading.local()
+
+    # -- post-mortem dump --------------------------------------------------
+    def _maybe_dump(self, trace: CycleTrace) -> None:
+        out_dir = os.environ.get("KAI_TRACE_DIR")
+        if not out_dir or not (trace.aborted or trace.degraded):
+            return
+        try:
+            os.makedirs(out_dir, exist_ok=True)
+            path = os.path.join(
+                out_dir, f"cycle_{trace.cycle}_{trace.trace_id}.json")
+            with open(path, "w") as fh:
+                json.dump(trace.to_chrome(), fh)
+        except OSError as exc:
+            METRICS.inc("trace_dump_errors")
+            LOG.warning("cycle trace dump to %s failed: %s", out_dir, exc)
+
+
+# Process-wide tracer, like METRICS: every layer of the decision path
+# records into it without plumbing, and the server reads it back out.
+TRACER = Tracer()

@@ -316,7 +316,9 @@ class TestManifestParsing:
             def __init__(self):
                 self.events = []
 
-            def record_event(self, kind, message):
+            def record_event(self, kind, message, trace_id=None):
+                # trace_id: flight-recorder correlation the real
+                # AsyncStatusUpdater accepts (utils/tracing.py).
                 self.events.append((kind, message))
 
         expr = 'device.attributes["weird"].exists(a, a > 3)'

@@ -556,6 +556,45 @@ class TestKAI008MetricsHygiene:
         findings = lint(("kai_scheduler_tpu/controllers/fix.py", src))
         assert [f for f in findings if f.rule == "KAI008"] == []
 
+    def test_cycle_span_family_consistent_usage_is_clean(self):
+        # The flight recorder's per-span-kind latency families
+        # (utils/tracing.py end_cycle): each name is one histogram.
+        src = ("from ..utils.metrics import METRICS\n"
+               "def f(v):\n"
+               "    METRICS.observe('cycle_span_cycle_latency_ms', v)\n"
+               "    METRICS.observe('cycle_span_kernel_latency_ms', v)\n"
+               "    METRICS.observe('cycle_span_action_latency_ms', v)\n"
+               "    METRICS.observe('cycle_span_commit_latency_ms', v)\n"
+               "    METRICS.observe('cycle_span_kubeapi_latency_ms', v)\n")
+        findings = lint(("kai_scheduler_tpu/utils/fix.py", src))
+        assert [f for f in findings if f.rule == "KAI008"] == []
+
+    def test_cycle_span_cross_instrument_collision_fires(self):
+        # A counter reusing a cycle_span_* histogram name would double-
+        # register the family in the exposition: the whole-tree pass
+        # must catch it across modules.
+        a = ("from ..utils.metrics import METRICS\n"
+             "def f(v):\n"
+             "    METRICS.observe('cycle_span_kernel_latency_ms', v)\n")
+        b = ("from ..utils.metrics import METRICS\n"
+             "def g():\n"
+             "    METRICS.inc('cycle_span_kernel_latency_ms')\n")
+        findings = lint(("kai_scheduler_tpu/utils/a.py", a),
+                        ("kai_scheduler_tpu/controllers/b.py", b))
+        assert any(f.rule == "KAI008" and "one instrument" in f.message
+                   and "cycle_span_kernel_latency_ms" in f.message
+                   for f in findings)
+
+    def test_cycle_span_inconsistent_labels_fire(self):
+        src = ("from ..utils.metrics import METRICS\n"
+               "def f(v):\n"
+               "    METRICS.observe('cycle_span_action_latency_ms', v)\n"
+               "    METRICS.observe('cycle_span_action_latency_ms', v,\n"
+               "                    action='allocate')\n")
+        findings = lint(("kai_scheduler_tpu/utils/fix.py", src))
+        assert any(f.rule == "KAI008" and "label keys" in f.message
+                   for f in findings)
+
     def test_engine_reuse_does_not_leak_rule_state(self):
         # A reused Engine is a supported caller (watch mode, hooks):
         # stateful rules must start fresh each run.

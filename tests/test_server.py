@@ -5,6 +5,7 @@ import subprocess
 import sys
 import threading
 import time
+import urllib.error
 import urllib.request
 
 from kai_scheduler_tpu.server import LeaderElector
@@ -59,6 +60,29 @@ def test_daemon_cli_smoke(tmp_path):
         assert "order" in order
         prof = json.loads(get("/debug/profile?summary=1"))
         assert prof["total_samples"] > 0
+        # Flight recorder: cycle summaries, a Chrome trace for the
+        # latest cycle (root span + snapshot/plugin/action children on
+        # an idle cluster), pprof folded stacks, and /explain discovery.
+        cycles = json.loads(get("/debug/cycles"))
+        assert cycles["capacity"] >= 1 and cycles["cycles"]
+        latest = cycles["cycles"][0]
+        assert latest["duration_ms"] >= 0 and not latest["aborted"]
+        assert "cycle" in latest["spans"]
+        # Fetch by id, not default-latest: the daemon is still cycling
+        # every 50ms, so "latest" could move between the two requests.
+        trace = json.loads(get(f"/debug/trace?cycle={latest['trace_id']}"))
+        assert trace["otherData"]["trace_id"] == latest["trace_id"]
+        assert trace["traceEvents"]
+        cats = {e["cat"] for e in trace["traceEvents"]}
+        assert {"cycle", "snapshot", "action"} <= cats
+        explain = json.loads(get("/explain"))
+        assert "podgroups" in explain  # empty cluster: nothing pending
+        try:
+            get("/explain?podgroup=nope")
+            raise AssertionError("expected 404 for unknown podgroup")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+        assert get("/debug/pprof")  # profiler enabled: folded stacks
     finally:
         proc.terminate()
         try:

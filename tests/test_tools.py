@@ -191,3 +191,49 @@ class TestChaosMatrixDryRun:
         out = capsys.readouterr().out
         assert out.count("seed ") == 4
         assert "nothing executed" in out
+
+    def test_dry_run_shows_per_seed_trace_dirs(self, capsys, monkeypatch,
+                                               tmp_path):
+        """--trace-dir: the grid names each seed's flight-recorder dump
+        directory (KAI_TRACE_DIR in the child) without running anything."""
+        import os
+
+        from kai_scheduler_tpu.tools import chaos_matrix
+        monkeypatch.setattr(
+            chaos_matrix.subprocess, "run",
+            lambda *a, **kw: (_ for _ in ()).throw(AssertionError(
+                "dry run must not execute iterations")))
+        rc = chaos_matrix.main(["--dry-run", "--seeds", "5,9",
+                                "--trace-dir", str(tmp_path)])
+        assert rc == 0
+        out = capsys.readouterr().out
+        for seed in ("5", "9"):
+            assert f"trace-dir={os.path.join(tmp_path, f'seed{seed}')}" \
+                in out
+        # Without the flag the column stays empty.
+        assert chaos_matrix.main(["--dry-run", "--seeds", "5"]) == 0
+        assert "trace-dir=-" in capsys.readouterr().out
+
+    def test_run_iteration_arms_trace_dir_env(self, monkeypatch, tmp_path):
+        """The child pytest process inherits KAI_TRACE_DIR (and only when
+        asked): the tracer's aborted-cycle dumps land per seed."""
+        from kai_scheduler_tpu.tools import chaos_matrix
+
+        captured = {}
+
+        class Proc:
+            returncode = 0
+            stdout = stderr = ""
+
+        def fake_run(cmd, cwd=None, env=None, **kw):
+            captured["env"] = env
+            return Proc()
+
+        monkeypatch.setattr(chaos_matrix.subprocess, "run", fake_run)
+        chaos_matrix.run_iteration(3, ["tests/x.py"], "chaos", None,
+                                   str(tmp_path), 5.0,
+                                   trace_dir=str(tmp_path / "seed3"))
+        assert captured["env"]["KAI_TRACE_DIR"] == str(tmp_path / "seed3")
+        chaos_matrix.run_iteration(3, ["tests/x.py"], "chaos", None,
+                                   str(tmp_path), 5.0)
+        assert "KAI_TRACE_DIR" not in captured["env"]

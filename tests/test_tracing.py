@@ -1,0 +1,405 @@
+"""Flight-recorder ring: structured cycle tracing under chaos.
+
+The observability acceptance ladder (ISSUE 4): a traced cycle's root
+span carries snapshot/plugin/action/kernel child kinds; a cycle run
+under fault injection records the aborted span with error status and
+the degraded/CPU-fallback attribute; binds and events correlate back to
+the producing cycle's trace id; `/explain` answers why a PodGroup is
+pending; and the recorder's memory is bounded (ring of N traces, span
+cap per trace).  Also home to the metrics satellites: scrape-compatible
+histogram buckets and edge-quantile correctness.
+"""
+
+import json
+import math
+
+import pytest
+
+from kai_scheduler_tpu.framework.conf import SchedulerConfig
+from kai_scheduler_tpu.scheduler import Scheduler
+from kai_scheduler_tpu.utils.cluster_spec import build_cluster
+from kai_scheduler_tpu.utils.deviceguard import (configure_device_guard,
+                                                 reset_device_guard)
+from kai_scheduler_tpu.utils.metrics import METRICS, Histogram, Metrics
+from kai_scheduler_tpu.utils.tracing import TRACER, Tracer
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def fresh(monkeypatch):
+    """Pristine guard + tracer per test; no KAI_* leakage between tests."""
+    for var in ("KAI_FAULT_INJECT", "KAI_DEVICE_DEADLINE_S",
+                "KAI_DEVICE_RETRIES", "KAI_BREAKER_THRESHOLD",
+                "KAI_BREAKER_COOLOFF_S", "KAI_FAULT_SEED",
+                "KAI_TRACE_DIR"):
+        monkeypatch.delenv(var, raising=False)
+    reset_device_guard()
+    TRACER.reset()
+    yield
+    reset_device_guard()
+    TRACER.reset()
+
+
+def small_cluster():
+    """4 nodes x 8 GPUs, 4 gangs of 2 one-GPU tasks: everything fits."""
+    return build_cluster({
+        "nodes": {f"n{i}": {"gpu": 8} for i in range(4)},
+        "queues": {"q": {}},
+        "jobs": {f"j{i}": {"queue": "q", "min_available": 2,
+                           "tasks": [{"cpu": "1", "mem": "1Gi",
+                                      "gpu": 1}] * 2}
+                 for i in range(4)},
+    })
+
+
+def kinds_of(trace):
+    return {sp.kind for sp in trace.spans}
+
+
+# -- the span tree ------------------------------------------------------------
+
+class TestCycleTrace:
+    def test_healthy_cycle_records_full_span_tree(self):
+        ssn = Scheduler(lambda: small_cluster(),
+                        SchedulerConfig()).run_once()
+        trace = TRACER.get_trace()
+        assert trace is not None and trace.aborted is None
+        # The acceptance span kinds: root + snapshot + plugin + action +
+        # kernel dispatch all present in one cycle.
+        assert {"cycle", "snapshot", "plugin", "action",
+                "kernel"} <= kinds_of(trace)
+        root = trace.spans[-1]
+        assert root.kind == "cycle" and root.status == "ok"
+        # Kernel spans carry the guard verdict: device path, breaker
+        # closed, no fallback.
+        kernels = [sp for sp in trace.spans if sp.kind == "kernel"]
+        assert kernels and all(sp.attrs["fallback"] is False
+                               and sp.attrs["breaker"] == "closed"
+                               for sp in kernels)
+        # Nesting: every non-root span has a parent inside the trace.
+        ids = {sp.span_id for sp in trace.spans}
+        assert all(sp.parent_id in ids for sp in trace.spans
+                   if sp is not root)
+        # Bind-to-cycle correlation on the in-memory path.
+        assert ssn.cluster.bind_requests
+        assert all(br.trace_id == trace.trace_id
+                   for br in ssn.cluster.bind_requests)
+
+    def test_healthy_cycle_inside_except_block_is_not_aborted(self):
+        """run_once called from an except handler (a retry-on-error
+        wrapper): the OUTER handled exception must not leak into the
+        trace finalize — only exceptions escaping run_once count."""
+        sched = Scheduler(lambda: small_cluster(), SchedulerConfig())
+        try:
+            raise RuntimeError("outer, already handled")
+        except RuntimeError:
+            ssn = sched.run_once()
+        assert ssn.aborted is None
+        trace = TRACER.get_trace()
+        assert trace.aborted is None
+        assert trace.spans[-1].status == "ok"
+
+    def test_chrome_export_is_perfetto_shaped(self):
+        Scheduler(lambda: small_cluster(), SchedulerConfig()).run_once()
+        out = json.loads(json.dumps(TRACER.get_trace().to_chrome()))
+        events = out["traceEvents"]
+        assert events and all(e["ph"] == "X" for e in events)
+        for e in events:
+            assert isinstance(e["ts"], (int, float))
+            assert isinstance(e["dur"], (int, float)) and e["dur"] >= 0
+            assert e["cat"] and e["name"] and e["args"]["status"]
+        assert out["otherData"]["trace_id"].startswith("t")
+
+    def test_span_latency_histograms_land_in_metrics(self):
+        Scheduler(lambda: small_cluster(), SchedulerConfig()).run_once()
+        for family in ("cycle_span_cycle_latency_ms",
+                       "cycle_span_kernel_latency_ms",
+                       "cycle_span_action_latency_ms",
+                       "cycle_span_snapshot_latency_ms"):
+            assert METRICS.histograms[family].n >= 1, family
+
+
+# -- chaos: degraded and aborted cycles ---------------------------------------
+
+class TestTracingUnderFaults:
+    def test_hang_cycle_marks_kernel_spans_fallback(self):
+        """KAI_FAULT_INJECT=hang: the cycle completes degraded on the CPU
+        fallback and every kernel span says so (fallback attribute, open
+        breaker), with the trace flagged degraded."""
+        configure_device_guard(deadline_s=0.3, retries=0,
+                               breaker_threshold=1, fault="hang")
+        ssn = Scheduler(lambda: small_cluster(),
+                        SchedulerConfig(cycle_deadline_s=120.0)).run_once()
+        assert ssn.aborted is None
+        trace = TRACER.get_trace()
+        assert trace.degraded is True and trace.aborted is None
+        kernels = [sp for sp in trace.spans if sp.kind == "kernel"]
+        assert kernels and all(sp.attrs["fallback"] for sp in kernels)
+        assert any(sp.attrs["breaker"] == "open" for sp in kernels)
+        assert trace.to_summary()["degraded"] is True
+
+    def test_aborted_cycle_captures_error_span(self, monkeypatch):
+        """A device death mid-action (error fault, fallback disabled):
+        the flight recorder keeps the aborted cycle with the failing
+        kernel + action spans marked error, the root span error'd with
+        the abort reason, and >= 4 child span kinds present."""
+        guard = configure_device_guard(deadline_s=5.0, retries=0,
+                                       breaker_threshold=100,
+                                       fallback_enabled=False)
+
+        class DieMidAction:
+            name = "chaos"
+
+            def execute(self, ssn):
+                guard.set_fault("error")
+                ssn.dispatch_kernel(lambda: 1, label="chaos_kernel")
+
+        monkeypatch.setattr("kai_scheduler_tpu.scheduler.build_actions",
+                            lambda names: [DieMidAction()])
+        ssn = Scheduler(lambda: small_cluster(),
+                        SchedulerConfig()).run_once()
+        assert ssn.aborted and "chaos" in ssn.aborted
+        trace = TRACER.get_trace()
+        assert trace.aborted and "chaos" in trace.aborted
+        assert {"snapshot", "plugin", "action", "kernel"} \
+            <= kinds_of(trace)
+        failing = [sp for sp in trace.spans
+                   if sp.kind == "kernel"
+                   and sp.attrs.get("kernel") == "chaos_kernel"]
+        assert failing and failing[0].status == "error"
+        assert "injected device error" in failing[0].error
+        action = [sp for sp in trace.spans if sp.kind == "action"]
+        assert action and action[0].status == "error"
+        root = trace.spans[-1]
+        assert root.kind == "cycle" and root.status == "error"
+        assert trace.to_summary()["aborted"]
+
+    def test_trace_dir_dumps_aborted_cycle(self, monkeypatch, tmp_path):
+        """KAI_TRACE_DIR (the chaos_matrix --trace-dir hook): an aborted
+        cycle's Chrome trace JSON lands on disk for post-mortem."""
+        monkeypatch.setenv("KAI_TRACE_DIR", str(tmp_path / "traces"))
+        configure_device_guard(deadline_s=5.0, retries=0,
+                               breaker_threshold=100, fault="error",
+                               fallback_enabled=False)
+        ssn = Scheduler(lambda: small_cluster(),
+                        SchedulerConfig()).run_once()
+        assert ssn.aborted
+        dumps = list((tmp_path / "traces").glob("cycle_*.json"))
+        assert len(dumps) == 1
+        data = json.loads(dumps[0].read_text())
+        assert data["otherData"]["aborted"]
+        assert data["traceEvents"]
+
+
+# -- explainability ledger ----------------------------------------------------
+
+class TestExplain:
+    def test_pending_podgroup_has_rejection_reasons(self):
+        cluster = build_cluster({
+            "nodes": {"n1": {"gpu": 8}},
+            "queues": {"q": {}},
+            "jobs": {"fits": {"queue": "q", "tasks": [{"gpu": 2}]},
+                     "too-big": {"queue": "q", "tasks": [{"gpu": 16}]}},
+        })
+        Scheduler(lambda: cluster, SchedulerConfig()).run_once()
+        record = TRACER.explain_for("too-big")
+        assert record is not None
+        assert record["reasons"] and any(
+            "16 gpu" in r for r in record["reasons"])
+        assert record["trace_id"] == TRACER.get_trace().trace_id
+        assert TRACER.explain_for("fits") is None
+        assert "too-big" in TRACER.get_trace().to_summary()[
+            "rejected_podgroups"]
+
+    def test_explain_survives_later_clean_cycles(self):
+        cluster = build_cluster({
+            "nodes": {"n1": {"gpu": 8}},
+            "queues": {"q": {}},
+            "jobs": {"too-big": {"queue": "q", "tasks": [{"gpu": 16}]}},
+        })
+        sched = Scheduler(lambda: cluster, SchedulerConfig())
+        sched.run_once()
+        first = TRACER.explain_for("too-big")
+        sched.run_once()  # still pending: the record refreshes
+        second = TRACER.explain_for("too-big")
+        assert second["cycle"] > first["cycle"]
+
+    def test_record_drops_once_the_group_schedules(self):
+        """A group that was rejected and later binds must not keep
+        serving its stale 'why pending' record — an operator would be
+        pointed at a group that is actually running."""
+        spec = {
+            "nodes": {"n1": {"gpu": 8}},
+            "queues": {"q": {}},
+            "jobs": {"j": {"queue": "q", "tasks": [{"gpu": 16}]}},
+        }
+        sched = Scheduler(lambda: build_cluster(spec), SchedulerConfig())
+        sched.run_once()
+        assert TRACER.explain_for("j") is not None
+        # The job shrinks (user edited it) and now fits.
+        spec["jobs"]["j"] = {"queue": "q", "tasks": [{"gpu": 2}]}
+        ssn = sched.run_once()
+        assert ssn.cluster.bind_requests
+        assert TRACER.explain_for("j") is None
+        assert "j" not in TRACER.explained_podgroups()
+
+
+# -- boundedness --------------------------------------------------------------
+
+class TestFlightRecorderBounds:
+    def test_ring_holds_last_n_traces(self):
+        tracer = Tracer(capacity=3)
+        for cycle in range(1, 8):
+            tracer.begin_cycle(cycle)
+            with tracer.span("s", kind="action"):
+                pass
+            tracer.end_cycle()
+        cycles = tracer.cycles()
+        assert [c["cycle"] for c in cycles] == [7, 6, 5]
+        assert tracer.get_trace("1") is None
+        assert tracer.get_trace(str(7)).cycle == 7
+
+    def test_span_cap_counts_overflow_and_keeps_root(self):
+        tracer = Tracer(capacity=2, max_spans_per_trace=16)
+        tracer.begin_cycle(1)
+        for i in range(40):
+            with tracer.span(f"s{i}", kind="kernel"):
+                pass
+        trace = tracer.end_cycle()
+        assert len(trace.spans) <= 16
+        assert trace.dropped_spans == 40 - (16 - 1)
+        assert trace.spans[-1].kind == "cycle"  # the root always survives
+
+    def test_explain_ledger_is_bounded_with_counted_drops(self):
+        """A sustained over-capacity cluster (thousands of pending
+        groups) must not grow the per-trace ledger without bound."""
+        from kai_scheduler_tpu.utils.tracing import CycleTrace
+        tracer = Tracer(capacity=2)
+        tracer.begin_cycle(1)
+        for g in range(CycleTrace.MAX_EXPLAIN_GROUPS + 50):
+            tracer.note_rejection(f"pg{g}", "no fit")
+        for r in range(CycleTrace.MAX_REASONS_PER_GROUP + 5):
+            tracer.note_rejection("pg0", f"reason {r}")
+        trace = tracer.end_cycle()
+        assert len(trace.explain) == CycleTrace.MAX_EXPLAIN_GROUPS
+        assert len(trace.explain["pg0"]) == \
+            CycleTrace.MAX_REASONS_PER_GROUP
+        # 50 groups over the cap + (13 new reasons for pg0 of which only
+        # 7 fit next to its existing "no fit").
+        assert trace.dropped_rejections == 50 + (
+            (CycleTrace.MAX_REASONS_PER_GROUP + 5)
+            - (CycleTrace.MAX_REASONS_PER_GROUP - 1))
+        assert trace.to_summary()["dropped_rejections"] > 0
+
+    def test_null_span_outside_cycle_is_safe(self):
+        tracer = Tracer(capacity=2)
+        with tracer.span("orphan", kind="kernel") as sp:
+            sp.set(anything=1)
+        assert tracer.cycles() == []
+        assert tracer.current_trace_id() is None
+
+
+# -- fleet correlation (BindRequest spec + events over the API) ---------------
+
+class TestFleetCorrelation:
+    def test_bindrequest_and_event_carry_trace_id(self):
+        from kai_scheduler_tpu.controllers import System, SystemConfig
+        from kai_scheduler_tpu.controllers.kubeapi import make_pod
+
+        system = System(SystemConfig())
+        system.api.create({"kind": "Node", "metadata": {"name": "n1"},
+                           "status": {"allocatable": {
+                               "cpu": "32", "memory": "256Gi",
+                               "nvidia.com/gpu": 8}}})
+        system.api.create({"kind": "Queue", "metadata": {"name": "q"},
+                           "spec": {}})
+        system.api.create(make_pod("p1", queue="q", gpu=1))
+        system.api.create(make_pod("p-huge", queue="q", gpu=64))
+        # BindRequests are consumed (and GC'd) within the same run_cycle,
+        # so capture them at creation time like the binder does.
+        seen_brs = []
+        system.api.watch("BindRequest",
+                         lambda ev, obj: seen_brs.append(obj)
+                         if ev == "ADDED" else None)
+        system.run_cycle()
+        assert seen_brs
+        trace = TRACER.get_trace()
+        assert all(br["spec"]["traceId"] == trace.trace_id
+                   for br in seen_brs)
+        # kubeapi spans recorded the fenced write path (epoch None when
+        # un-fenced, but the span itself must exist).
+        assert any(sp.kind == "kubeapi"
+                   and sp.attrs.get("op") == "bindrequest_create"
+                   for sp in trace.spans)
+        # The unschedulable gang's event correlates to a cycle trace.
+        events = [e for e in system.api.list("Event")
+                  if e["spec"].get("reason") == "Unschedulable"]
+        assert events and all(e["spec"].get("traceId") for e in events)
+        # And its PodGroup condition names the cycle too.
+        conds = [c for pg in system.api.list("PodGroup")
+                 for c in pg.get("status", {}).get("conditions", [])
+                 if c["type"] == "Unschedulable"]
+        assert conds and all(c["traceId"] for c in conds)
+
+
+# -- metrics satellites -------------------------------------------------------
+
+class TestPrometheusHistograms:
+    def test_bucket_lines_are_cumulative_and_end_at_inf(self):
+        m = Metrics()
+        m.observe("cycle_ms", 3.0)      # le=5
+        m.observe("cycle_ms", 3.0)      # le=5
+        m.observe("cycle_ms", 40.0)     # le=50
+        m.observe("cycle_ms", 99999.0)  # le=+Inf
+        text = m.to_prometheus_text()
+        assert '# TYPE cycle_ms histogram' in text
+        assert 'cycle_ms_bucket{le="5"} 2' in text
+        assert 'cycle_ms_bucket{le="50"} 3' in text
+        assert 'cycle_ms_bucket{le="2000"} 3' in text
+        assert 'cycle_ms_bucket{le="+Inf"} 4' in text
+        assert "cycle_ms_sum" in text and "cycle_ms_count 4" in text
+        # Cumulative monotonicity across every bucket line.
+        counts = [float(line.rsplit(" ", 1)[1])
+                  for line in text.splitlines()
+                  if line.startswith("cycle_ms_bucket")]
+        assert counts == sorted(counts)
+
+    def test_custom_buckets_without_inf_still_emit_inf(self):
+        m = Metrics()
+        m.histograms["lat"] = Histogram(buckets=[1, 10])
+        m.observe("lat", 0.5)
+        m.observe("lat", 5000.0)  # beyond the last edge
+        text = m.to_prometheus_text()
+        assert 'lat_bucket{le="10"} 1' in text
+        assert 'lat_bucket{le="+Inf"} 2' in text
+
+
+class TestHistogramQuantile:
+    def test_empty_histogram_is_zero(self):
+        h = Histogram()
+        assert h.quantile(0.0) == 0.0
+        assert h.quantile(0.5) == 0.0
+        assert h.quantile(1.0) == 0.0
+
+    def test_q0_returns_first_nonempty_bucket(self):
+        h = Histogram()
+        h.observe(3.0)   # le=5
+        h.observe(700.0)  # le=1000
+        # Previously q=0 returned bucket 1 (empty): target degenerated
+        # to 0, satisfied before any observation was accumulated.
+        assert h.quantile(0.0) == 5
+        assert h.quantile(1.0) == 1000
+
+    def test_q_is_clamped(self):
+        h = Histogram()
+        h.observe(3.0)
+        assert h.quantile(-1.0) == 5
+        assert h.quantile(2.0) == 5
+
+    def test_mid_quantiles_unchanged(self):
+        h = Histogram()
+        for v in (1, 1, 8, 60, 400, 900, 3000, 9999):
+            h.observe(float(v))
+        assert h.quantile(0.5) == 100   # 4th of 8 obs sits in le=100
+        assert h.quantile(0.99) == math.inf
