@@ -85,6 +85,11 @@ class ClusterInfo:
         self.storage_capacities: dict = storage_capacities or {}
         self.bind_requests: list[BindRequest] = []
         self.now = now
+        # Set by ClusterCache.snapshot (framework/arena.py): marks this
+        # object as the arena's latest view, eligible for the incremental
+        # pack path.  None (the default, and what clones/filters carry)
+        # means "pack from scratch".
+        self.arena_stamp: int | None = None
         # Stable orderings for tensor packing.
         self.node_order: list[str] = sorted(self.nodes)
         for i, name in enumerate(self.node_order):

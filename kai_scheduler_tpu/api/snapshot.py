@@ -138,30 +138,16 @@ def build_codec(cluster: ClusterInfo,
     return codec
 
 
-def pack(cluster: ClusterInfo,
-         jobs: list[PodGroupInfo] | None = None,
-         queue_usage: dict[str, np.ndarray] | None = None,
-         pad_nodes_to: int | None = None,
-         real_allocation: bool = True) -> SnapshotTensors:
-    """Pack the snapshot; ``jobs`` selects the candidate pending jobs
-    (defaults to all jobs with tasks to allocate).  ``pad_nodes_to`` rounds
-    the node axis up to a bucket size to avoid recompilation across cycles.
-    ``real_allocation=False`` additionally admits RELEASING tasks as
-    candidates — only scenario simulation wants that.
-    """
+def _select_jobs(cluster: ClusterInfo,
+                 jobs: list[PodGroupInfo] | None) -> list[PodGroupInfo]:
     if jobs is None:
         jobs = sorted(cluster.pending_jobs(), key=lambda j: j.uid)
     # A job pointing at an unknown queue must not alias onto queue 0.
-    jobs = [pg for pg in jobs if pg.queue_id in cluster.queues]
+    return [pg for pg in jobs if pg.queue_id in cluster.queues]
 
-    # Row indices are epoch-stamped: a task whose tensor_epoch doesn't
-    # match this pack's epoch has a stale tensor_idx (consumers check via
-    # SnapshotTensors.row_of) — O(1) invalidation instead of a walk over
-    # every pod in the cluster.
-    global _PACK_EPOCH
-    _PACK_EPOCH += 1
-    epoch = _PACK_EPOCH
 
+def _select_tasks(jobs: list[PodGroupInfo], real_allocation: bool
+                  ) -> tuple[list[PodInfo], list[int], list[int]]:
     # Pack every candidate task (not just the first gang chunk): actions
     # may allocate a job in several chunks per cycle (elastic growth), and
     # each chunk slices rows out of these arrays by tensor_idx.
@@ -175,6 +161,106 @@ def pack(cluster: ClusterInfo,
         tasks.extend(sel)
         job_start.append(start)
         job_count.append(len(sel))
+    return tasks, job_start, job_count
+
+
+def _stamp_tasks(tasks: list[PodInfo]) -> int:
+    # Row indices are epoch-stamped: a task whose tensor_epoch doesn't
+    # match this pack's epoch has a stale tensor_idx (consumers check via
+    # SnapshotTensors.row_of) — O(1) invalidation instead of a walk over
+    # every pod in the cluster.
+    global _PACK_EPOCH
+    _PACK_EPOCH += 1
+    epoch = _PACK_EPOCH
+    for i, t in enumerate(tasks):
+        t.tensor_idx = i
+        t.tensor_epoch = epoch
+    return epoch
+
+
+def _pack_task_arrays(tasks: list[PodInfo], jobs: list[PodGroupInfo],
+                      codec: LabelCodec, L: int, max_tols: int) -> tuple:
+    t_count = len(tasks)
+    task_req = np.zeros((max(t_count, 1), rs.NUM_RES))
+    task_job = np.zeros(max(t_count, 1), np.int32)
+    task_sel = np.full((max(t_count, 1), L), NO_LABEL, np.int32)
+    task_tol = np.full((max(t_count, 1), max_tols), NO_TAINT, np.int32)
+    job_index = {pg.uid: j for j, pg in enumerate(jobs)}
+    key_cols = codec.key_cols
+    taint_codes = codec.taint_codes
+    if tasks:
+        # Node-fit vectors: MIG profiles are per-node scalar inventory
+        # checked host-side, not whole-GPU draws (MIG jobs route to the
+        # host path in actions/allocate).  Stacked in one pass; the
+        # memoized to_vec returns shared read-only rows.
+        task_req[:t_count] = np.stack(
+            [t.res_req.to_vec(mig_as_gpu=False) for t in tasks])
+        task_job[:t_count] = np.fromiter(
+            (job_index[t.job_id] for t in tasks), np.int32, count=t_count)
+    for i, t in enumerate(tasks):
+        if t.node_selector:
+            for k, v in t.node_selector.items():
+                task_sel[i, key_cols[k]] = codec.value_code(k, v)
+        if t.tolerations:
+            for j, tol in enumerate(sorted(t.tolerations)):
+                if tol in taint_codes:
+                    task_tol[i, j] = taint_codes[tol]
+    return task_req, task_job, task_sel, task_tol
+
+
+def _pack_queue_arrays(cluster: ClusterInfo,
+                       queue_usage: dict | None) -> tuple:
+    queue_uids = sorted(cluster.queues)
+    q_index = {qid: i for i, qid in enumerate(queue_uids)}
+    q = max(len(queue_uids), 1)
+    q_deserved = np.zeros((q, rs.NUM_RES))
+    q_limit = np.full((q, rs.NUM_RES), rs.UNLIMITED)
+    q_oqw = np.ones((q, rs.NUM_RES))
+    q_prio = np.zeros(q, np.int32)
+    q_parent = np.full(q, -1, np.int32)
+    q_creation = np.zeros(q)
+    q_alloc = np.zeros((q, rs.NUM_RES))
+    q_req = np.zeros((q, rs.NUM_RES))
+    q_usage = np.zeros((q, rs.NUM_RES))
+    allocated, requested = cluster.queue_aggregates()
+    for qid, i in q_index.items():
+        info = cluster.queues[qid]
+        q_deserved[i] = info.quota.deserved
+        q_limit[i] = info.quota.limit
+        q_oqw[i] = info.quota.over_quota_weight
+        q_prio[i] = info.priority
+        q_parent[i] = q_index.get(info.parent, -1) if info.parent else -1
+        q_creation[i] = info.creation_ts
+        q_alloc[i] = allocated.get(qid, rs.zeros())
+        q_req[i] = requested.get(qid, rs.zeros())
+        if queue_usage and qid in queue_usage:
+            q_usage[i] = queue_usage[qid]
+    return (queue_uids, q_index, q_deserved, q_limit, q_oqw, q_prio,
+            q_parent, q_creation, q_alloc, q_req, q_usage)
+
+
+def _pack_job_arrays(jobs: list[PodGroupInfo], q_index: dict) -> tuple:
+    job_q = np.array([q_index[pg.queue_id] for pg in jobs] or [0], np.int32)
+    job_min = np.array(
+        [sum(ps.min_available for ps in pg.pod_sets.values()) for pg in jobs]
+        or [0], np.int32)
+    return job_q, job_min
+
+
+def pack(cluster: ClusterInfo,
+         jobs: list[PodGroupInfo] | None = None,
+         queue_usage: dict[str, np.ndarray] | None = None,
+         pad_nodes_to: int | None = None,
+         real_allocation: bool = True) -> SnapshotTensors:
+    """Pack the snapshot; ``jobs`` selects the candidate pending jobs
+    (defaults to all jobs with tasks to allocate).  ``pad_nodes_to`` rounds
+    the node axis up to a bucket size to avoid recompilation across cycles.
+    ``real_allocation=False`` additionally admits RELEASING tasks as
+    candidates — only scenario simulation wants that.
+    """
+    jobs = _select_jobs(cluster, jobs)
+    tasks, job_start, job_count = _select_tasks(jobs, real_allocation)
+    epoch = _stamp_tasks(tasks)
 
     codec = build_codec(cluster, tasks)
     L = max(1, codec.num_cols)
@@ -218,62 +304,14 @@ def pack(cluster: ClusterInfo,
             for j, taint in enumerate(sorted(node.taints)):
                 node_taints[i, j] = taint_codes[taint]
 
-    t_count = len(tasks)
-    task_req = np.zeros((max(t_count, 1), rs.NUM_RES))
-    task_job = np.zeros(max(t_count, 1), np.int32)
-    task_sel = np.full((max(t_count, 1), L), NO_LABEL, np.int32)
-    task_tol = np.full((max(t_count, 1), max_tols), NO_TAINT, np.int32)
-    job_index = {pg.uid: j for j, pg in enumerate(jobs)}
-    if tasks:
-        # Node-fit vectors: MIG profiles are per-node scalar inventory
-        # checked host-side, not whole-GPU draws (MIG jobs route to the
-        # host path in actions/allocate).  Stacked in one pass; the
-        # memoized to_vec returns shared read-only rows.
-        task_req[:t_count] = np.stack(
-            [t.res_req.to_vec(mig_as_gpu=False) for t in tasks])
-        task_job[:t_count] = np.fromiter(
-            (job_index[t.job_id] for t in tasks), np.int32, count=t_count)
-    for i, t in enumerate(tasks):
-        t.tensor_idx = i
-        t.tensor_epoch = epoch
-        if t.node_selector:
-            for k, v in t.node_selector.items():
-                task_sel[i, key_cols[k]] = codec.value_code(k, v)
-        if t.tolerations:
-            for j, tol in enumerate(sorted(t.tolerations)):
-                if tol in taint_codes:
-                    task_tol[i, j] = taint_codes[tol]
+    task_req, task_job, task_sel, task_tol = _pack_task_arrays(
+        tasks, jobs, codec, L, max_tols)
 
-    queue_uids = sorted(cluster.queues)
-    q_index = {qid: i for i, qid in enumerate(queue_uids)}
-    q = max(len(queue_uids), 1)
-    q_deserved = np.zeros((q, rs.NUM_RES))
-    q_limit = np.full((q, rs.NUM_RES), rs.UNLIMITED)
-    q_oqw = np.ones((q, rs.NUM_RES))
-    q_prio = np.zeros(q, np.int32)
-    q_parent = np.full(q, -1, np.int32)
-    q_creation = np.zeros(q)
-    q_alloc = np.zeros((q, rs.NUM_RES))
-    q_req = np.zeros((q, rs.NUM_RES))
-    q_usage = np.zeros((q, rs.NUM_RES))
-    allocated, requested = cluster.queue_aggregates()
-    for qid, i in q_index.items():
-        info = cluster.queues[qid]
-        q_deserved[i] = info.quota.deserved
-        q_limit[i] = info.quota.limit
-        q_oqw[i] = info.quota.over_quota_weight
-        q_prio[i] = info.priority
-        q_parent[i] = q_index.get(info.parent, -1) if info.parent else -1
-        q_creation[i] = info.creation_ts
-        q_alloc[i] = allocated.get(qid, rs.zeros())
-        q_req[i] = requested.get(qid, rs.zeros())
-        if queue_usage and qid in queue_usage:
-            q_usage[i] = queue_usage[qid]
+    (queue_uids, q_index, q_deserved, q_limit, q_oqw, q_prio, q_parent,
+     q_creation, q_alloc, q_req, q_usage) = _pack_queue_arrays(
+        cluster, queue_usage)
 
-    job_q = np.array([q_index[pg.queue_id] for pg in jobs] or [0], np.int32)
-    job_min = np.array(
-        [sum(ps.min_available for ps in pg.pod_sets.values()) for pg in jobs]
-        or [0], np.int32)
+    job_q, job_min = _pack_job_arrays(jobs, q_index)
 
     return SnapshotTensors(
         node_allocatable=node_alloc, node_idle=node_idle,
@@ -292,3 +330,109 @@ def pack(cluster: ClusterInfo,
         job_uids=[pg.uid for pg in jobs], queue_uids=queue_uids,
         codec=codec, pack_epoch=epoch,
     )
+
+
+def pack_incremental(cluster: ClusterInfo, prev: SnapshotTensors,
+                     dirty_nodes: set,
+                     queue_usage: dict[str, np.ndarray] | None = None,
+                     pad_nodes_to: int | None = None,
+                     reuse_tasks: bool = False
+                     ) -> tuple[SnapshotTensors, np.ndarray]:
+    """Delta pack against the previous cycle's tensors (framework/arena).
+
+    Bit-identical to ``pack(cluster, queue_usage=..., pad_nodes_to=...)``
+    under the caller's preconditions (ClusterArena verifies them from the
+    watch-event-derived dirty state before calling):
+
+    - the node set and order are unchanged and no Node object changed
+      (else: topology change, full rebuild);
+    - the label/taint/toleration vocabulary is unchanged — no
+      selector- or toleration-bearing pod was added/modified/removed —
+      so ``prev.codec`` and every codec-derived array width still hold;
+    - ``pad_nodes_to`` matches the previous pack (pow2 bucket growth
+      forces a rebuild);
+    - ``dirty_nodes`` is a superset of every node whose pod set, pod
+      manifests, or accounting changed since ``prev`` was packed.
+
+    Static node arrays (allocatable/labels/taints) are shared BY
+    REFERENCE with ``prev`` — that identity is what lets the device
+    arena key its uploaded copies by generation.  Mutable state arrays
+    are copied and only the dirty rows recomputed.  Task/job/queue
+    arrays rebuild from the live cluster (they are small next to the
+    node axis) unless ``reuse_tasks`` proves nothing feeding them
+    changed, in which case they are shared too.
+
+    Returns ``(tensors, changed_row_indices)``.
+    """
+    jobs = _select_jobs(cluster, None)
+    tasks, job_start, job_count = _select_tasks(jobs, True)
+    epoch = _stamp_tasks(tasks)
+
+    codec = prev.codec
+    L = prev.node_labels.shape[1]
+    max_tols = prev.task_tolerations.shape[1]
+
+    node_names = cluster.node_order
+    node_idle = prev.node_idle.copy()
+    node_rel = prev.node_releasing.copy()
+    node_room = prev.node_pod_room.copy()
+    node_alloc = prev.node_allocatable
+    rows = sorted(cluster.nodes[nm].idx for nm in dirty_nodes
+                  if nm in cluster.nodes)
+    for i in rows:
+        nd = cluster.nodes[node_names[i]]
+        # Same float expressions as the vectorized full-pack fill —
+        # elementwise identical on identical inputs.
+        node_idle[i] = node_alloc[i] - nd.used
+        node_rel[i] = nd.releasing
+        node_room[i] = max(0, nd.max_pods - len(nd.pod_infos))
+
+    if reuse_tasks \
+            and [pg.uid for pg in jobs] == prev.job_uids \
+            and [t.uid for t in tasks] == prev.task_uids \
+            and prev.job_task_count.tolist() == (job_count or [0]) \
+            and sorted(cluster.queues) == prev.queue_uids:
+        # Nothing feeding the task/job/queue families changed: share the
+        # previous arrays outright (the uid checks are the cheap
+        # defensive proof the candidate sets really match).
+        task_req, task_job = prev.task_req, prev.task_job
+        task_sel, task_tol = prev.task_selector, prev.task_tolerations
+        queue_uids = prev.queue_uids
+        q_deserved, q_limit = prev.queue_deserved, prev.queue_limit
+        q_oqw, q_prio = prev.queue_over_quota_weight, prev.queue_priority
+        q_parent, q_creation = prev.queue_parent, prev.queue_creation
+        q_alloc, q_req = prev.queue_allocated, prev.queue_requested
+        q_usage = prev.queue_usage
+        job_q, job_min = prev.job_queue, prev.job_min_available
+        job_start_arr = prev.job_task_start
+        job_count_arr = prev.job_task_count
+        task_uids, job_uids = prev.task_uids, prev.job_uids
+    else:
+        task_req, task_job, task_sel, task_tol = _pack_task_arrays(
+            tasks, jobs, codec, L, max_tols)
+        (queue_uids, q_index, q_deserved, q_limit, q_oqw, q_prio, q_parent,
+         q_creation, q_alloc, q_req, q_usage) = _pack_queue_arrays(
+            cluster, queue_usage)
+        job_q, job_min = _pack_job_arrays(jobs, q_index)
+        job_start_arr = np.array(job_start or [0], np.int32)
+        job_count_arr = np.array(job_count or [0], np.int32)
+        task_uids = [t.uid for t in tasks]
+        job_uids = [pg.uid for pg in jobs]
+
+    snap = SnapshotTensors(
+        node_allocatable=node_alloc, node_idle=node_idle,
+        node_releasing=node_rel, node_labels=prev.node_labels,
+        node_taints=prev.node_taints, node_pod_room=node_room,
+        task_req=task_req, task_job=task_job, task_selector=task_sel,
+        task_tolerations=task_tol,
+        job_queue=job_q, job_min_available=job_min,
+        job_task_start=job_start_arr, job_task_count=job_count_arr,
+        queue_deserved=q_deserved, queue_limit=q_limit,
+        queue_over_quota_weight=q_oqw, queue_priority=q_prio,
+        queue_parent=q_parent, queue_creation=q_creation,
+        queue_allocated=q_alloc, queue_requested=q_req, queue_usage=q_usage,
+        node_names=prev.node_names, task_uids=task_uids,
+        job_uids=job_uids, queue_uids=queue_uids,
+        codec=codec, pack_epoch=epoch,
+    )
+    return snap, np.asarray(rows, np.int64)

@@ -1,0 +1,35 @@
+"""Device-arena scatter kernel: row-delta updates of resident node state.
+
+The mutable node-state tensors (``idle``/``releasing``/``room``) live on
+the device across cycles (framework/arena.py).  When K rows change —
+statements committing placements, watch deltas between cycles — shipping
+a full ``[N,R]`` re-upload pays the transfer floor for the whole cluster;
+this kernel applies just the ``[K]`` row indices + ``[K,R]`` values as one
+jitted scatter, so the transfer scales with the delta, not the fleet.
+
+Callers pad K to a pow2 bucket (padding repeats a real row with its own
+current value — an idempotent write) so the kernel compiles a handful of
+shapes, not one per delta size.  Dispatch is host-side via
+``Session.dispatch_kernel`` (watchdog/breaker/CPU-fallback; KAI004).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def apply_deltas_kernel(idle, releasing, room, rows, idle_vals,
+                        releasing_vals, room_vals):
+    """Scatter row updates into the resident state arrays.
+
+    idle/releasing: ``[N,R]``; room: ``[N]``; rows: ``[K]`` int; the value
+    arrays carry the rows' new contents.  Returns the updated
+    (idle, releasing, room) triple — functional, so a failed dispatch
+    leaves the previous resident arrays untouched.
+    """
+    rows = rows.astype(jnp.int32)
+    return (idle.at[rows].set(idle_vals),
+            releasing.at[rows].set(releasing_vals),
+            room.at[rows].set(room_vals))
