@@ -246,9 +246,10 @@ def _execute_bulk(ssn, jobs):
             # collapse from thousands of steps to a handful).
             kw["independent_jobs"] = np.array(
                 [len(tasks) == 1 for tasks in chunks])
+        node_arrays = ssn._device_arrays()
         result = ssn.dispatch_kernel(
             lambda: kernel(
-                ssn._device_arrays(),
+                node_arrays,
                 np.stack(rows_req), np.array(task_jobs, np.int32),
                 np.stack(rows_sel), np.stack(rows_tol),
                 np.array(job_allowed),

@@ -342,6 +342,20 @@ class ClusterCache:
                 return True
 
             on_resync(_resync_cb)
+        # Persistent device arena (framework/arena.py): cross-cycle
+        # snapshot residency.  snapshot() feeds it the dirty set below;
+        # Sessions built on this cache pack incrementally against it.
+        from ..framework.arena import ClusterArena
+        self.arena = ClusterArena()
+        # Change-detection signatures from the watch-updated store, diffed
+        # per snapshot: the store IS the materialized watch-event stream
+        # (every ADDED/MODIFIED/DELETED bumps a resourceVersion), so
+        # diffing resourceVersions yields exactly the delta the stream
+        # carried — including events whose delivery we never observed.
+        self._node_sigs: dict = {}
+        self._pod_sigs: dict = {}      # uid -> (rv, node_name, vocab)
+        self._group_sigs: dict = {}
+        self._queue_sigs: dict = {}
         # In-memory pipelined assignments surviving between cycles
         # (Cache.TaskPipelined): pod uid -> (node, gpu_group).
         self._pipelined: dict = {}
@@ -449,15 +463,30 @@ class ClusterCache:
         return task
 
     # -- snapshot ------------------------------------------------------------
+    @staticmethod
+    def _sig_rv(obj: dict):
+        """Change signature for one object: its resourceVersion, or (for
+        stores that don't stamp one) a sentinel unequal across snapshots
+        so the object conservatively counts as always-changed."""
+        rv = obj.get("metadata", {}).get("resourceVersion")
+        return rv if rv is not None else object()
+
     def snapshot(self) -> ClusterInfo:
+        arena = self.arena
         if self._resync_pending:
             # Deferred watch-gap invalidation (see _on_watch_resync):
             # rebind, don't clear() — the watch thread may set the flag
             # again concurrently, which the NEXT snapshot then honors.
+            # A resync means an unknown stretch of events was missed:
+            # the arena (packed arrays AND device residency) invalidates
+            # wholesale along with the pod parse cache.
             self._resync_pending = False
             self._pod_cache = {}
+            arena.invalidate("watch-resync")
         nodes = {}
+        node_sigs = {}
         for n in self.api.list("Node"):
+            node_sigs[n["metadata"]["name"]] = self._sig_rv(n)
             spec = n.get("status", {}).get("allocatable", {})
             gpu_mem = n.get("metadata", {}).get("annotations", {}).get(
                 "nvidia.com/gpu.memory")
@@ -475,8 +504,18 @@ class ClusterCache:
                 mig_capacity={k: float(v) for k, v in spec.items()
                               if k.startswith("nvidia.com/mig-")})
 
+        if node_sigs != self._node_sigs:
+            # Any Node add/remove/modify is a topology-class change: the
+            # static arrays, label/taint codec, and node axis may all
+            # shift — rebuild from scratch (the steady-state contract is
+            # that this never fires without real node churn).
+            arena.note_full("node-change")
+        self._node_sigs = node_sigs
+
         queues = {}
+        queue_sigs = {}
         for q in self.api.list("Queue"):
+            queue_sigs[q["metadata"]["name"]] = self._sig_rv(q)
             spec = q.get("spec", {})
             queues[q["metadata"]["name"]] = QueueInfo(
                 q["metadata"]["name"],
@@ -496,8 +535,14 @@ class ClusterCache:
                 if q.parent in queues:
                     queues[q.parent].children.append(name)
 
+        if queue_sigs != self._queue_sigs:
+            arena.note_tasks()  # queue arrays (and job gating) rebuild
+        self._queue_sigs = queue_sigs
+
         podgroups: dict[str, PodGroupInfo] = {}
+        group_sigs = {}
         for pg_obj in self.api.list("PodGroup"):
+            group_sigs[pg_obj["metadata"]["name"]] = self._sig_rv(pg_obj)
             spec = pg_obj.get("spec", {})
             name = pg_obj["metadata"]["name"]
             topo = spec.get("topology") or {}
@@ -530,13 +575,34 @@ class ClusterCache:
                 "kai.scheduler/node-pool")
             podgroups[name] = pg
 
+        if group_sigs != self._group_sigs:
+            arena.note_tasks()  # job arrays / candidate sets rebuild
+        self._group_sigs = group_sigs
+
         seen_uids = set()
         cache_seen = set()
+        pod_sigs: dict = {}
         for pod in self.api.list("Pod"):
             group = pod["metadata"].get("labels", {}).get(POD_GROUP_LABEL)
             if not group or group not in podgroups:
                 continue
             task = self._parse_pod(pod)
+            # Pod-level change signature: a changed pod dirties the node
+            # rows it touches (previous and current placement) and, when
+            # it carries scheduling vocabulary (selectors/tolerations),
+            # poisons the codec reuse.
+            sig = (self._sig_rv(pod), task.node_name,
+                   bool(task.node_selector or task.tolerations))
+            prev_sig = self._pod_sigs.get(task.uid)
+            if prev_sig is None or prev_sig[0] != sig[0]:
+                arena.note_tasks()
+                if sig[2] or (prev_sig is not None and prev_sig[2]):
+                    arena.note_vocab()
+                if prev_sig is not None and prev_sig[1]:
+                    arena.note_nodes((prev_sig[1],))
+                if task.node_name:
+                    arena.note_nodes((task.node_name,))
+            pod_sigs[task.uid] = sig
             cache_seen.add(task.uid)
             if task.status == PodStatus.PENDING:
                 seen_uids.add(task.uid)
@@ -550,6 +616,17 @@ class ClusterCache:
                 if node_name in nodes:
                     task.nominated_node = node_name
             podgroups[group].add_task(task)
+        # Vanished pods (deleted, or dropped out of any live group): the
+        # node they occupied changes, and a vocab-bearing one retires
+        # codec entries.
+        for uid, (_rv, node_name, vocab) in self._pod_sigs.items():
+            if uid not in pod_sigs:
+                arena.note_tasks()
+                if vocab:
+                    arena.note_vocab()
+                if node_name:
+                    arena.note_nodes((node_name,))
+        self._pod_sigs = pod_sigs
         # Forget assignments for pods that vanished or already bound.
         self._pipelined = {
             uid: v for uid, v in self._pipelined.items()
@@ -637,15 +714,20 @@ class ClusterCache:
                 self.api.list("CSIDriver"), self.api.list("StorageClass"),
                 pvc_objs, self.api.list("CSIStorageCapacity"))
 
-        return ClusterInfo(nodes, podgroups, queues, topologies,
-                           now=self.now_fn(),
-                           resource_claims=resource_claims,
-                           config_maps=config_maps, pvcs=pvcs,
-                           resource_slices=resource_slices,
-                           storage_classes=storage_classes,
-                           storage_claims=storage_claims,
-                           storage_capacities=storage_capacities,
-                           device_classes=device_classes)
+        cluster = ClusterInfo(nodes, podgroups, queues, topologies,
+                              now=self.now_fn(),
+                              resource_claims=resource_claims,
+                              config_maps=config_maps, pvcs=pvcs,
+                              resource_slices=resource_slices,
+                              storage_classes=storage_classes,
+                              storage_claims=storage_claims,
+                              storage_capacities=storage_capacities,
+                              device_classes=device_classes)
+        # Only the arena's LATEST stamped view may pack incrementally; an
+        # older ClusterInfo (or one filtered by a shard provider) packs
+        # from scratch.
+        arena.stamp(cluster)
+        return cluster
 
     # -- side-effect executor (framework Session cache interface) ------------
     def bind(self, task, node_name: str, bind_request) -> None:

@@ -108,6 +108,11 @@ class System:
         def provider():
             cluster = cache.snapshot()
             if shard.node_pool_label:
+                # Filtering rewrites the node axis AND the podgroup set
+                # out from under the arena's dirty tracking (a PodGroup
+                # drifting between pools changes the packed view with no
+                # pod event): sharded pools pack from scratch.
+                cluster.arena_stamp = None
                 cluster.nodes = {
                     name: node for name, node in cluster.nodes.items()
                     if node.labels.get(shard.node_pool_label)

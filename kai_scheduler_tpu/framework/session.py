@@ -47,9 +47,12 @@ class InMemoryCache:
 
     # Optional control-plane hooks (same surface as ClusterCache): a
     # crash-safe bind journal and a fencing-epoch provider; statements
-    # consult both at commit time.
+    # consult both at commit time.  ``arena`` may be set to a
+    # framework.arena.ClusterArena to opt a test/offline session into
+    # cross-cycle snapshot + device residency.
     commitlog = None
     epoch_provider = None
+    arena = None
 
     def __init__(self):
         self.bound = []     # (task_uid, node_name)
@@ -190,8 +193,19 @@ class Session:
         import time as _time
         self.phase_timings: dict[str, float] = {}
         _t = _time.perf_counter()
-        self.snapshot: SnapshotTensors = pack(
-            cluster, queue_usage=queue_usage, pad_nodes_to=pad)
+        # Persistent arena (framework/arena.py): when the cache carries
+        # one (ClusterCache does), the pack is incremental against the
+        # previous cycle's arrays and the device tensors stay resident
+        # across sessions.  Caches without an arena (tests, offline
+        # replay) pack from scratch exactly as before.
+        self._arena = getattr(self.cache, "arena", None)
+        self.pack_stats: dict | None = None
+        if self._arena is not None:
+            self.snapshot, self.pack_stats = self._arena.pack(
+                cluster, queue_usage=queue_usage, pad_nodes_to=pad)
+        else:
+            self.snapshot: SnapshotTensors = pack(
+                cluster, queue_usage=queue_usage, pad_nodes_to=pad)
         self.phase_timings["snapshot_pack"] = _time.perf_counter() - _t
         # Dense mutable mirrors: backed by the native C++ state store when
         # available (contiguous C-owned tables, zero-copy views), else
@@ -246,11 +260,16 @@ class Session:
         # scheduler's run_once): past it, every kernel dispatch aborts
         # with CycleDeadlineExceeded instead of starting new device work.
         self.cycle_deadline_at: float | None = None
-        # Device-array cache: static snapshot arrays upload once; mutable
-        # state arrays re-upload only after a statement touched them.
+        # Device-array caches.  With an arena, static tensors and mutable
+        # state live THERE, resident across sessions, and mutable-row
+        # deltas apply by scatter; the session-local dicts below are the
+        # fallback for arena-less sessions (full re-upload when any row
+        # dirtied, the original behavior).  ``_dirty_rows`` tracks which
+        # node rows statements touched since the last device sync — the
+        # scatter path ships only those ``[K,R]`` rows.
         self._static_dev: dict = {}
         self._state_dev: dict = {}
-        self._state_dirty = True
+        self._dirty_rows: set[int] = set()
 
     # -- lifecycle ---------------------------------------------------------
     def open(self) -> "Session":
@@ -293,28 +312,67 @@ class Session:
         return n
 
     # -- guarded device dispatch ------------------------------------------
-    def dispatch_kernel(self, thunk, label: str, validate=None):
+    def dispatch_kernel(self, thunk, label: str, validate=None,
+                        blocking: bool = True):
         """Route one device-kernel dispatch through the device guard:
         watchdog deadline, retry, circuit breaker, CPU degradation
         (utils/deviceguard.py).  All session/solver kernel call sites go
         through here so fault handling is uniform and the whole-cycle
         deadline is enforced at dispatch granularity.  Each dispatch is a
         flight-recorder span carrying the guard's verdict (device vs
-        CPU-fallback, breaker state) for post-mortem triage."""
+        CPU-fallback, breaker state) for post-mortem triage.
+
+        ``blocking=False`` is the pipelined mode: the dispatch returns at
+        ENQUEUE time without forcing device completion, so the caller can
+        overlap host work (or further enqueues) with device execution and
+        synchronize once, at its own guarded fetch — one device round
+        trip instead of a completion wait plus a transfer.  ``validate``
+        then sees lazy arrays (metadata checks only)."""
         from ..utils.deviceguard import device_guard
         guard = device_guard()
         with TRACER.span(f"dispatch:{label}", kind="kernel",
-                         kernel=label) as sp:
+                         kernel=label, pipelined=not blocking) as sp:
             fb0, to0 = guard.fallback_calls, guard.timeouts
             try:
                 return guard.call(
                     thunk, label=label, validate=validate,
                     record_event=getattr(self.cache, "record_event", None),
-                    cycle_deadline_at=self.cycle_deadline_at)
+                    cycle_deadline_at=self.cycle_deadline_at,
+                    materialize=blocking)
             finally:
                 sp.set(fallback=guard.fallback_calls > fb0,
                        timed_out=guard.timeouts > to0,
                        breaker=guard.breaker.state)
+
+    def _dispatch_and_fetch(self, thunk, label: str, validate, t: int):
+        """Pipelined allocation dispatch: enqueue the kernel without
+        blocking, then pay ONE guarded device round trip for the fused
+        ``packed`` fetch (placements ++ pipelined ++ job_success).  The
+        blocking path costs two round trips on the tunneled TPU — a
+        completion wait inside the dispatch plus the transfer at unpack.
+
+        An asynchronous device failure surfaces at the fetch; the repair
+        path re-runs the whole kernel through a blocking dispatch, where
+        the guard's breaker/CPU-fallback machinery takes over — so fault
+        coverage is identical to the blocking path, just deferred."""
+        from ..utils.deviceguard import (CycleDeadlineExceeded,
+                                        DeviceGuardError)
+        result = self.dispatch_kernel(thunk, label=label, validate=validate,
+                                      blocking=False)
+        try:
+            return self.dispatch_kernel(
+                lambda: _unpack_allocation(result, t),
+                label=f"{label}_fetch",
+                validate=lambda r: getattr(r[0], "shape", (0,))[0] == t)
+        except CycleDeadlineExceeded:
+            raise
+        except DeviceGuardError:
+            # The enqueue's lazy result is poisoned (the failure happened
+            # after enqueue, so the first dispatch never saw it): re-run
+            # end to end, blocking, letting the guard degrade if needed.
+            result = self.dispatch_kernel(thunk, label=f"{label}_retry",
+                                          validate=validate)
+            return _unpack_allocation(result, t)
 
     # -- dense mirrors (single writer: the Statement via sync_node) --------
     @property
@@ -349,30 +407,37 @@ class Session:
                 self._native.releasing[i] = node.releasing
                 self._native.room[i] = max(
                     0, node.max_pods - len(node.pod_infos))
-                self._state_dirty = True
+                self._dirty_rows.add(i)
         elif i < self._np_idle.shape[0]:
             self._np_idle[i] = node.idle
             self._np_releasing[i] = node.releasing
             self._np_room[i] = max(0, node.max_pods - len(node.pod_infos))
-            self._state_dirty = True
+            self._dirty_rows.add(i)
 
     def _device_arrays(self):
         """(allocatable, idle, releasing, labels, taints, room) as device
-        arrays, re-uploading mutable state only when dirty."""
+        arrays.  With an arena: served from the cross-session resident
+        cache, dirty rows applied by guarded scatter.  Without: static
+        arrays upload once per session and mutable state re-uploads in
+        full when any row dirtied (the original behavior).  Callers run
+        this on the cycle thread, OUTSIDE dispatch thunks, so the arena's
+        own guarded dispatches never nest inside another guarded call."""
         snap = self.snapshot
+        if self._arena is not None:
+            return self._arena.device_arrays(snap, self)
         if not self._static_dev:
             self._static_dev = {
                 "alloc": jnp.asarray(snap.node_allocatable),
                 "labels": jnp.asarray(snap.node_labels),
                 "taints": jnp.asarray(snap.node_taints),
             }
-        if self._state_dirty or not self._state_dev:
+        if self._dirty_rows or not self._state_dev:
             self._state_dev = {
                 "idle": jnp.asarray(self.node_idle),
                 "rel": jnp.asarray(self.node_releasing),
                 "room": jnp.asarray(self.node_room),
             }
-            self._state_dirty = False
+            self._dirty_rows.clear()
         s, st = self._static_dev, self._state_dev
         return (s["alloc"], st["idle"], st["rel"], s["labels"], s["taints"],
                 st["room"])
@@ -556,9 +621,10 @@ class Session:
             mask_pad = np.ones((t_pad, n_nodes), bool)
             mask_pad[:t] = mask
 
-        result = self.dispatch_kernel(
+        node_arrays = self._device_arrays()
+        placed, piped, success = self._dispatch_and_fetch(
             lambda: allocate_jobs_kernel(
-                *self._device_arrays(),
+                *node_arrays,
                 jnp.asarray(task_req), jnp.asarray(task_job),
                 jnp.asarray(task_sel), jnp.asarray(task_tol),
                 jnp.asarray(job_allowed), jnp.asarray(extra),
@@ -568,8 +634,7 @@ class Session:
                 cpu_strategy=self.cpu_strategy,
                 allow_pipeline=True, pipeline_only=pipeline_only),
             label="allocate_jobs_multi",
-            validate=_allocation_shape_check(t_pad))
-        placed, piped, success = _unpack_allocation(result, t)
+            validate=_allocation_shape_check(t_pad), t=t)
         out = {}
         row = 0
         for j, (job, tasks) in enumerate(job_chunks):
@@ -735,9 +800,10 @@ class Session:
             # rows, extra score terms, and pipeline-only proposals stay
             # on the single-chip kernel (unsupported under shard_map).
             from ..parallel.sharded import sharded_allocate_jobs
-            result = self.dispatch_kernel(
+            node_arrays = self._device_arrays()
+            placed, piped, success = self._dispatch_and_fetch(
                 lambda: sharded_allocate_jobs(
-                    self.mesh, *self._device_arrays(),
+                    self.mesh, *node_arrays,
                     jnp.asarray(task_req), jnp.asarray(task_job),
                     jnp.asarray(task_sel), jnp.asarray(task_tol),
                     jnp.asarray(job_allowed),
@@ -747,11 +813,12 @@ class Session:
                     cpu_strategy=self.cpu_strategy,
                     allow_pipeline=allow_pipeline),
                 label="allocate_jobs_sharded",
-                validate=_allocation_shape_check(t_pad))
+                validate=_allocation_shape_check(t_pad), t=t)
         else:
-            result = self.dispatch_kernel(
+            node_arrays = self._device_arrays()
+            placed, piped, success = self._dispatch_and_fetch(
                 lambda: allocate_jobs_kernel(
-                    *self._device_arrays(),
+                    *node_arrays,
                     jnp.asarray(task_req), jnp.asarray(task_job),
                     jnp.asarray(task_sel), jnp.asarray(task_tol),
                     jnp.asarray(job_allowed), jnp.asarray(extra),
@@ -764,9 +831,7 @@ class Session:
                     allow_pipeline=allow_pipeline,
                     pipeline_only=pipeline_only),
                 label="allocate_jobs",
-                validate=_allocation_shape_check(t_pad))
-
-        placed, piped, success = _unpack_allocation(result, t)
+                validate=_allocation_shape_check(t_pad), t=t)
         if not bool(success[0]):
             return Proposal(False, [])
         placements = []

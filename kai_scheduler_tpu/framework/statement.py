@@ -135,7 +135,7 @@ class Statement:
         table.add_tasks(idx, reqs, statuses)
         ssn.cluster.invalidate_aggregates()
         ssn.mutation_count += 1
-        ssn._state_dirty = True
+        ssn._dirty_rows.update(int(i) for i in idx)
         self.ops.extend(ops)
         return True
 
@@ -223,7 +223,7 @@ class Statement:
             task.node_name = op.prev_node
             task.gpu_group = op.prev_gpu_group
             self.session.mutation_count += 1
-            self.session._state_dirty = True
+            self.session._dirty_rows.add(op.node_idx)
             return
         if op.kind in ("allocate", "pipeline"):
             if node is not None:
@@ -271,7 +271,7 @@ class Statement:
                     self.session._native.add_task(
                         op.node_idx, op.native_req, 2)
                     self.session.mutation_count += 1
-                    self.session._state_dirty = True
+                    self.session._dirty_rows.add(op.node_idx)
                     op.kind = "pipeline"
                     continue
                 node.remove_task(op.task)

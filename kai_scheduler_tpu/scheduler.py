@@ -64,6 +64,11 @@ class Scheduler:
                               queue_usage=usage)
                 snap_sp.set(nodes=len(cluster.nodes),
                             podgroups=len(cluster.podgroups))
+                if ssn.pack_stats:
+                    # Arena pack verdict (delta vs full rebuild) on the
+                    # cycle trace: /debug/trace shows per-cycle pack
+                    # behavior next to the span that paid for it.
+                    snap_sp.set(**ssn.pack_stats)
             ssn.trace_id = trace_id
             if deadline:
                 ssn.cycle_deadline_at = clock0 + deadline

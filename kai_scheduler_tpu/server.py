@@ -148,9 +148,17 @@ def _make_handler(server_state):
                     body = prof.folded(top=top).encode()
                     ctype = "text/plain"
             elif path == "/debug/cycles":
-                # Flight recorder: last-N cycle summaries, newest first.
-                body = json.dumps({"capacity": TRACER.capacity,
-                                   "cycles": TRACER.cycles()}).encode()
+                # Flight recorder: last-N cycle summaries, newest first,
+                # plus the device arena's pack/residency stats (delta
+                # ratio, generation, full-rebuild and scatter totals).
+                payload = {"capacity": TRACER.capacity,
+                           "cycles": TRACER.cycles()}
+                ssn = server_state.get("last_session")
+                arena = getattr(getattr(ssn, "cache", None), "arena",
+                                None)
+                if arena is not None:
+                    payload["arena"] = arena.stats()
+                body = json.dumps(payload).encode()
                 ctype = "application/json"
             elif path == "/debug/trace":
                 trace = TRACER.get_trace(q.get("cycle"))

@@ -453,7 +453,8 @@ class DeviceGuard:
     # -- the guarded dispatch ---------------------------------------------
     def call(self, thunk, label: str = "kernel", validate=None,
              record_event=None, deadline_s: float | None = None,
-             cycle_deadline_at: float | None = None):
+             cycle_deadline_at: float | None = None,
+             materialize: bool = True):
         """Run ``thunk`` (a zero-arg device dispatch) under the full
         guard: watchdog deadline, bounded retry, breaker, CPU fallback.
 
@@ -462,7 +463,14 @@ class DeviceGuard:
         optional (kind, message) sink — breaker trips and degraded calls
         surface as scheduler events.  ``cycle_deadline_at``: absolute
         clock() value; past it the dispatch aborts immediately with
-        CycleDeadlineExceeded (the scheduler's whole-cycle budget)."""
+        CycleDeadlineExceeded (the scheduler's whole-cycle budget).
+        ``materialize=False`` is the pipelined-dispatch mode: the call
+        returns as soon as the kernel is ENQUEUED (no block_until_ready),
+        letting the host overlap work with device execution; validators
+        must then judge metadata only (shapes are known pre-completion),
+        and an asynchronous device failure surfaces at the caller's later
+        guarded fetch, not here.  The CPU fallback path always
+        materializes — there is nothing to overlap with."""
         deadline = self.deadline_s if deadline_s is None else deadline_s
         if cycle_deadline_at is not None:
             # The in-flight watchdog must respect the cycle budget too:
@@ -480,7 +488,8 @@ class DeviceGuard:
             error = None
             for attempt in range(self.retries + 1):
                 try:
-                    result = self._device_attempt(thunk, label, deadline)
+                    result = self._device_attempt(thunk, label, deadline,
+                                                  materialize=materialize)
                     if validate is not None and not validate(result):
                         self.bad_results += 1
                         METRICS.inc("device_guard_bad_results")
@@ -532,13 +541,17 @@ class DeviceGuard:
                               record_event if announce else None,
                               cycle_deadline_at=cycle_deadline_at)
 
-    def _device_attempt(self, thunk, label: str, deadline: float | None):
+    def _device_attempt(self, thunk, label: str, deadline: float | None,
+                        materialize: bool = True):
         injector = self.injector
 
         def attempt(cancel=None):
             if injector.active:
                 injector.before(label, cancel or threading.Event())
-            return injector.transform(_materialize(thunk()))
+            result = thunk()
+            if materialize:
+                result = _materialize(result)
+            return injector.transform(result)
 
         return run_with_deadline(attempt, deadline, label=label)
 
