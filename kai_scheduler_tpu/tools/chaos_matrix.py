@@ -31,6 +31,10 @@ import sys
 import time
 
 DEFAULT_TESTS = ["tests/test_reconciler.py", "tests/test_device_guard.py"]
+# --arena: the device-arena delta suite — fault seeds exercise
+# resync-during-delta and breaker-open-during-scatter interleavings
+# (tests/test_snapshot_delta.py reads KAI_FAULT_SEED into its rng).
+ARENA_TESTS = ["tests/test_snapshot_delta.py"]
 
 
 def run_iteration(seed: int, tests: list[str], marker: str,
@@ -79,6 +83,11 @@ def main(argv=None) -> int:
                          "(overrides --iterations)")
     ap.add_argument("--tests", nargs="*", default=None,
                     help=f"test paths (default: {DEFAULT_TESTS})")
+    ap.add_argument("--arena", action="store_true",
+                    help="arena mode: sweep the device-arena delta suite "
+                         f"({ARENA_TESTS}) — each seed reshuffles the "
+                         "event interleavings around resync-during-delta "
+                         "and breaker-open-during-scatter")
     ap.add_argument("-k", "--keyword", default=None,
                     help="pytest -k filter (narrow the smoke subset)")
     ap.add_argument("--marker", default="chaos",
@@ -99,7 +108,8 @@ def main(argv=None) -> int:
 
     seeds = ([int(s) for s in args.seeds.split(",") if s.strip()]
              if args.seeds else list(range(1, args.iterations + 1)))
-    tests = args.tests if args.tests else DEFAULT_TESTS
+    tests = args.tests if args.tests else (
+        ARENA_TESTS if args.arena else DEFAULT_TESTS)
     repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
 

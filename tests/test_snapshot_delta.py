@@ -1,0 +1,417 @@
+"""Arena delta-pack chaos suite (marker ``chaos``, tier-1).
+
+The persistent device arena (framework/arena.py) replaces the per-cycle
+world rebuild with incremental snapshot packs and scatter-based device
+updates.  Its correctness contract is absolute: a delta-built snapshot
+must be **bit-identical** to a from-scratch ``pack()`` of the same
+cluster, and scheduling on the arena path must produce **identical
+placements** to a fresh session — under any interleaving of cluster
+events.  This suite drives randomized event sequences (add/delete/modify
+node & pod, selector-bearing pods, bind, evict, group churn, resync /
+watch-gap boundaries) against a ``ClusterCache`` and checks both
+invariants at every step, plus the degraded-mode contract (arena device
+caches dropped on breaker/CPU-fallback transitions, scheduling results
+unchanged).
+
+Seeded in the chaos-matrix style: the sweep seed comes from
+``KAI_FAULT_SEED`` (tools/chaos_matrix.py --arena replays the suite under
+many seeds) and composes with the per-test parametrized seed.
+"""
+
+import dataclasses
+import os
+
+import numpy as np
+import pytest
+
+from kai_scheduler_tpu.actions.allocate import AllocateAction
+from kai_scheduler_tpu.api.snapshot import pack
+from kai_scheduler_tpu.controllers import InMemoryKubeAPI
+from kai_scheduler_tpu.controllers.cache_builder import ClusterCache
+from kai_scheduler_tpu.controllers.kubeapi import make_pod
+from kai_scheduler_tpu.controllers.podgrouper import POD_GROUP_LABEL
+from kai_scheduler_tpu.framework.conf import SchedulerConfig
+from kai_scheduler_tpu.framework.session import InMemoryCache, Session
+from kai_scheduler_tpu.utils.deviceguard import (configure_device_guard,
+                                                 reset_device_guard)
+from kai_scheduler_tpu.utils.metrics import METRICS
+
+pytestmark = pytest.mark.chaos
+
+SWEEP_SEED = int(os.environ.get("KAI_FAULT_SEED", "0") or 0)
+
+
+def _node(api, name, gpu=8, labels=None):
+    api.create({"kind": "Node",
+                "metadata": {"name": name, "labels": dict(labels or {})},
+                "spec": {},
+                "status": {"allocatable": {"cpu": "32", "memory": "256Gi",
+                                           "nvidia.com/gpu": gpu,
+                                           "pods": 110}}})
+
+
+def _group(api, name, queue="q0", min_member=1):
+    api.create({"kind": "PodGroup", "metadata": {"name": name},
+                "spec": {"queue": queue, "minMember": min_member}})
+
+
+def _pod(api, name, group, gpu=0, node_selector=None, tolerations=None):
+    api.create(make_pod(name, labels={POD_GROUP_LABEL: group}, gpu=gpu,
+                        node_selector=node_selector,
+                        tolerations=tolerations))
+
+
+class Mutator:
+    """Randomized cluster-event generator over the API store."""
+
+    def __init__(self, api: InMemoryKubeAPI, cache: ClusterCache,
+                 rng: np.random.Generator):
+        self.api = api
+        self.cache = cache
+        self.rng = rng
+        self.node_seq = 0
+        self.pod_seq = 0
+        self.group_seq = 0
+
+    def _pods(self):
+        return [p for p in self.api.list("Pod")
+                if p["metadata"].get("labels", {}).get(POD_GROUP_LABEL)]
+
+    def _pick(self, items):
+        return items[int(self.rng.integers(0, len(items)))] if items \
+            else None
+
+    # -- the event vocabulary ---------------------------------------------
+    def add_node(self):
+        self.node_seq += 1
+        labels = {"zone": f"z{self.node_seq % 3}"} \
+            if self.rng.random() < 0.5 else None
+        _node(self.api, f"dyn-n{self.node_seq}", labels=labels)
+
+    def delete_node(self):
+        node = self._pick(self.api.list("Node"))
+        if node is not None:
+            self.api.delete("Node", node["metadata"]["name"])
+
+    def modify_node(self):
+        node = self._pick(self.api.list("Node"))
+        if node is not None:
+            self.api.patch("Node", node["metadata"]["name"],
+                           {"metadata": {"labels": {
+                               "zone": f"z{int(self.rng.integers(0, 4))}"}}})
+
+    def add_group(self):
+        self.group_seq += 1
+        name = f"dyn-pg{self.group_seq}"
+        size = int(self.rng.integers(1, 4))
+        _group(self.api, name, queue=f"q{self.group_seq % 2}",
+               min_member=size)
+        for k in range(size):
+            self.pod_seq += 1
+            sel = {"zone": "z1"} if self.rng.random() < 0.3 else None
+            _pod(self.api, f"dyn-p{self.pod_seq}", name,
+                 gpu=int(self.rng.integers(0, 3)), node_selector=sel)
+
+    def add_pod(self):
+        group = self._pick(self.api.list("PodGroup"))
+        if group is not None:
+            self.pod_seq += 1
+            _pod(self.api, f"dyn-p{self.pod_seq}",
+                 group["metadata"]["name"],
+                 gpu=int(self.rng.integers(0, 2)))
+
+    def delete_pod(self):
+        pod = self._pick(self._pods())
+        if pod is not None:
+            self.api.delete("Pod", pod["metadata"]["name"],
+                            pod["metadata"].get("namespace", "default"))
+
+    def modify_pod(self):
+        pod = self._pick(self._pods())
+        if pod is not None:
+            gpu = int(self.rng.integers(0, 3))
+            self.api.patch(
+                "Pod", pod["metadata"]["name"],
+                {"spec": {"containers": [
+                    {"name": "main", "resources": {"requests": {
+                        "cpu": "1", "memory": "1Gi",
+                        **({"nvidia.com/gpu": gpu} if gpu else {})}}}]}},
+                pod["metadata"].get("namespace", "default"))
+
+    def bind_pod(self):
+        pod = self._pick([p for p in self._pods()
+                          if not p["spec"].get("nodeName")])
+        node = self._pick(self.api.list("Node"))
+        if pod is not None and node is not None:
+            self.api.patch("Pod", pod["metadata"]["name"],
+                           {"spec": {"nodeName":
+                                     node["metadata"]["name"]}},
+                           pod["metadata"].get("namespace", "default"))
+
+    def evict_pod(self):
+        pod = self._pick([p for p in self._pods()
+                          if p["spec"].get("nodeName")])
+        if pod is not None:
+            self.api.patch("Pod", pod["metadata"]["name"],
+                           {"metadata": {"deletionTimestamp": "1"}},
+                           pod["metadata"].get("namespace", "default"))
+
+    def delete_group(self):
+        group = self._pick(self.api.list("PodGroup"))
+        if group is not None:
+            self.api.delete("PodGroup", group["metadata"]["name"])
+
+    def resync(self):
+        # A watch gap forced a re-list (the PR2 reconciler's 410-GONE
+        # path fires the cache's resync callback exactly like this).
+        self.cache._on_watch_resync()
+
+    def noop(self):
+        pass
+
+    OPS = ("add_node", "delete_node", "modify_node", "add_group",
+           "add_pod", "delete_pod", "modify_pod", "bind_pod", "evict_pod",
+           "delete_group", "resync", "noop", "noop")
+
+    def step(self):
+        for _ in range(int(self.rng.integers(0, 3))):
+            getattr(self, str(self.rng.choice(self.OPS)))()
+
+
+def seed_cluster(api):
+    for i in range(10):
+        _node(api, f"n{i}", labels={"zone": f"z{i % 3}"})
+    for q in range(2):
+        api.create({"kind": "Queue", "metadata": {"name": f"q{q}"},
+                    "spec": {}})
+    for j in range(4):
+        _group(api, f"pg{j}", queue=f"q{j % 2}", min_member=2)
+        for k in range(2):
+            _pod(api, f"p{j}-{k}", f"pg{j}", gpu=1 if j % 2 == 0 else 0)
+
+
+def assert_snapshots_identical(a, b):
+    """Field-by-field bit-identity of two SnapshotTensors."""
+    for f in dataclasses.fields(a):
+        va, vb = getattr(a, f.name), getattr(b, f.name)
+        if isinstance(va, np.ndarray):
+            assert va.shape == vb.shape and va.dtype == vb.dtype, \
+                f"{f.name}: shape/dtype {va.shape}/{va.dtype} != " \
+                f"{vb.shape}/{vb.dtype}"
+            assert np.array_equal(va, vb), f"{f.name}: values differ"
+        elif f.name == "codec":
+            assert (va.key_cols, va.value_codes, va.taint_codes) == \
+                (vb.key_cols, vb.value_codes, vb.taint_codes), \
+                "codec vocabulary differs"
+        elif f.name == "pack_epoch":
+            continue  # monotonic by design, never equal
+        else:
+            assert va == vb, f"{f.name}: {va!r} != {vb!r}"
+
+
+def placements_of(ssn):
+    return sorted(
+        (t.uid, t.node_name, t.status.name)
+        for pg in ssn.cluster.podgroups.values()
+        for t in pg.pods.values())
+
+
+def run_allocate_both_paths(api, cache):
+    """Allocate on the arena path and on a from-scratch session; both see
+    the same store, so their placements must match exactly."""
+    cluster_a = cache.snapshot()
+    side_cache = InMemoryCache()
+    side_cache.arena = cache.arena   # arena path, commits stay in-memory
+    ssn_a = Session(cluster_a, SchedulerConfig(), side_cache)
+    ssn_a.open()
+    AllocateAction().execute(ssn_a)
+
+    cluster_b = ClusterCache(api).snapshot()
+    ssn_b = Session(cluster_b, SchedulerConfig(), InMemoryCache())
+    ssn_b.open()
+    AllocateAction().execute(ssn_b)
+    assert placements_of(ssn_a) == placements_of(ssn_b)
+    return ssn_a
+
+
+# ---------------------------------------------------------------------------
+# Property: delta pack is bit-identical to a from-scratch rebuild
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_delta_pack_bit_identical_under_random_events(seed):
+    rng = np.random.default_rng(1000 * SWEEP_SEED + seed)
+    api = InMemoryKubeAPI()
+    seed_cluster(api)
+    cache = ClusterCache(api)
+    mut = Mutator(api, cache, rng)
+
+    deltas = 0
+    for step in range(30):
+        mut.step()
+        cluster = cache.snapshot()
+        snap_delta, stats = cache.arena.pack(cluster)
+        snap_full = pack(cluster)
+        assert_snapshots_identical(snap_delta, snap_full)
+        if not stats["full_rebuild"]:
+            deltas += 1
+            assert stats["delta_ratio"] <= 1.0
+    # The suite must actually exercise the delta path — an arena that
+    # silently full-rebuilds every cycle would pass identity vacuously.
+    assert deltas >= 5, f"only {deltas}/30 steps took the delta path"
+
+
+@pytest.mark.parametrize("seed", [1, 2])
+def test_allocate_identical_on_arena_and_fresh_paths(seed):
+    rng = np.random.default_rng(2000 * SWEEP_SEED + seed)
+    api = InMemoryKubeAPI()
+    seed_cluster(api)
+    cache = ClusterCache(api)
+    mut = Mutator(api, cache, rng)
+    for step in range(8):
+        mut.step()
+        run_allocate_both_paths(api, cache)
+
+
+# ---------------------------------------------------------------------------
+# Resync / watch-gap boundaries invalidate the arena wholesale
+# ---------------------------------------------------------------------------
+
+def test_resync_during_delta_forces_full_rebuild():
+    api = InMemoryKubeAPI()
+    seed_cluster(api)
+    cache = ClusterCache(api)
+    # Warm: establish the delta path.
+    cache.arena.pack(cache.snapshot())
+    _snap, stats = cache.arena.pack(cache.snapshot())
+    assert not stats["full_rebuild"]
+    gen = cache.arena.generation
+    # The watch gap lands mid-sequence; the next snapshot must rebuild
+    # from scratch (pod parse cache AND arena) and still be identical.
+    cache._on_watch_resync()
+    cluster = cache.snapshot()
+    snap_delta, stats = cache.arena.pack(cluster)
+    assert stats["full_rebuild"] and stats["reason"] == "watch-resync"
+    assert cache.arena.generation == gen + 1
+    assert_snapshots_identical(snap_delta, pack(cluster))
+    # The cycle after the rebuild resumes the delta path.
+    _snap, stats = cache.arena.pack(cache.snapshot())
+    assert not stats["full_rebuild"]
+
+
+def test_topology_and_vocab_changes_force_full_rebuild():
+    api = InMemoryKubeAPI()
+    seed_cluster(api)
+    cache = ClusterCache(api)
+    cache.arena.pack(cache.snapshot())
+
+    _node(api, "late-node")  # topology change
+    cluster = cache.snapshot()
+    snap, stats = cache.arena.pack(cluster)
+    assert stats["full_rebuild"] and stats["reason"] == "node-change"
+    assert_snapshots_identical(snap, pack(cluster))
+
+    _pod(api, "sel-pod", "pg0", node_selector={"zone": "z9"})  # vocab
+    cluster = cache.snapshot()
+    snap, stats = cache.arena.pack(cluster)
+    assert stats["full_rebuild"] and stats["reason"] == "vocab-change"
+    assert_snapshots_identical(snap, pack(cluster))
+
+
+def test_stale_or_foreign_cluster_never_takes_delta_path():
+    api = InMemoryKubeAPI()
+    seed_cluster(api)
+    cache = ClusterCache(api)
+    old_cluster = cache.snapshot()
+    cache.arena.pack(old_cluster)
+    fresh_cluster = cache.snapshot()          # newer stamp
+    _snap, stats = cache.arena.pack(old_cluster)   # stale view
+    assert stats["full_rebuild"] and stats["reason"] == "unstamped-cluster"
+    # The stale pack poisoned the delta baseline: even the latest cluster
+    # must rebuild (the dirty set no longer describes changes since the
+    # baseline), and only a fresh snapshot restores the delta path.
+    _snap, stats = cache.arena.pack(fresh_cluster)
+    assert stats["full_rebuild"] and stats["reason"] == "stale-baseline"
+    _snap, stats = cache.arena.pack(cache.snapshot())
+    assert not stats["full_rebuild"]
+
+
+# ---------------------------------------------------------------------------
+# Device-side: scatter path, residency, and degraded-mode invalidation
+# ---------------------------------------------------------------------------
+
+def test_scatter_updates_only_dirty_rows_and_matches_full_upload():
+    api = InMemoryKubeAPI()
+    seed_cluster(api)
+    cache = ClusterCache(api)
+    METRICS.counters.pop("arena_scatter_rows", None)
+    ssn = run_allocate_both_paths(api, cache)
+    assert ssn.pack_stats is not None
+    # Second cycle adopts the resident device state: the rows the first
+    # cycle's statements touched arrive by scatter, not a full upload.
+    ssn2 = run_allocate_both_paths(api, cache)
+    assert cache.arena.state.resident
+    scattered = METRICS.counters.get("arena_scatter_rows", 0)
+    assert 0 < scattered < len(ssn2.cluster.nodes) * len(placements_of(ssn2))
+
+
+def test_static_tensors_upload_once_per_generation():
+    api = InMemoryKubeAPI()
+    seed_cluster(api)
+    cache = ClusterCache(api)
+    run_allocate_both_paths(api, cache)
+    static_before = cache.arena._static_dev
+    assert static_before is not None
+    run_allocate_both_paths(api, cache)   # same generation: same buffers
+    assert cache.arena._static_dev is static_before
+    _node(api, "gen-bump")                # topology change: new generation
+    run_allocate_both_paths(api, cache)
+    assert cache.arena._static_dev is not static_before
+
+
+def test_breaker_open_during_scatter_invalidates_and_still_schedules():
+    """Chaos: the device dies while the arena is resident.  The guard
+    degrades dispatches to the CPU fallback; the arena must drop its
+    device caches on the transition (never hand a stale device buffer to
+    the fallback path) and scheduling must continue with identical
+    results."""
+    api = InMemoryKubeAPI()
+    seed_cluster(api)
+    cache = ClusterCache(api)
+    try:
+        configure_device_guard(deadline_s=5.0, retries=0,
+                               breaker_threshold=1, fallback_enabled=True,
+                               fault=None, fault_seed=SWEEP_SEED)
+        run_allocate_both_paths(api, cache)   # healthy warm-up, resident
+        assert cache.arena.state.resident
+        inval0 = METRICS.counters.get("arena_device_invalidation_total", 0)
+        # Kill the device path: every dispatch now errors and falls back.
+        from kai_scheduler_tpu.utils.deviceguard import device_guard
+        device_guard().set_fault("error", seed=SWEEP_SEED)
+        ssn = run_allocate_both_paths(api, cache)
+        assert ssn is not None
+        assert METRICS.counters.get(
+            "arena_device_invalidation_total", 0) > inval0
+        # Recovery transition (breaker closes) invalidates once more and
+        # scheduling stays identical on the re-uploaded arena.
+        device_guard().clear_fault()
+        run_allocate_both_paths(api, cache)
+        run_allocate_both_paths(api, cache)
+    finally:
+        reset_device_guard()
+
+
+def test_sharded_provider_cluster_packs_from_scratch():
+    """A node-pool-filtered cluster rewrites the node axis out from under
+    the arena: the operator's shard provider clears the stamp, and the
+    pack must fall back to a full rebuild rather than patch mismatched
+    rows."""
+    api = InMemoryKubeAPI()
+    seed_cluster(api)
+    cache = ClusterCache(api)
+    cache.arena.pack(cache.snapshot())
+    cluster = cache.snapshot()
+    cluster.arena_stamp = None     # what _shard_provider does on filter
+    snap, stats = cache.arena.pack(cluster)
+    assert stats["full_rebuild"] and stats["reason"] == "unstamped-cluster"
+    assert_snapshots_identical(snap, pack(cluster))

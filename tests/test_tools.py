@@ -181,6 +181,27 @@ class TestChaosMatrixDryRun:
         assert "keyword=commitlog" in out
         assert "3 iteration(s) planned" in out
 
+    def test_dry_run_arena_mode_selects_arena_suite(self, capsys,
+                                                    monkeypatch):
+        """--arena sweeps the device-arena delta suite (resync-during-
+        delta / breaker-open-during-scatter interleavings) instead of the
+        default chaos rings; explicit --tests still wins."""
+        from kai_scheduler_tpu.tools import chaos_matrix
+        monkeypatch.setattr(
+            chaos_matrix.subprocess, "run",
+            lambda *a, **kw: (_ for _ in ()).throw(AssertionError(
+                "dry run must not execute iterations")))
+        rc = chaos_matrix.main(["--dry-run", "--arena", "--seeds", "3,5"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert out.count("seed ") == 2
+        assert "tests/test_snapshot_delta.py" in out
+        assert "tests/test_reconciler.py" not in out
+        rc = chaos_matrix.main(["--dry-run", "--arena", "--seeds", "3",
+                                "--tests", "tests/test_device_guard.py"])
+        assert rc == 0
+        assert "tests/test_device_guard.py" in capsys.readouterr().out
+
     def test_dry_run_respects_iterations_default_seeds(self, capsys,
                                                        monkeypatch):
         from kai_scheduler_tpu.tools import chaos_matrix
