@@ -15,6 +15,7 @@ import time
 import uuid
 
 from ..utils import backoff_delay
+from ..utils.lifecycle import LIFECYCLE
 from ..utils.logging import ScopedLogger
 from ..utils.metrics import METRICS
 from .kubeapi import Conflict, InMemoryKubeAPI
@@ -130,19 +131,26 @@ class Binder:
         if status.get("attempts", 0) and \
                 self.now_fn() < status.get("backoffUntil", 0.0):
             return  # backing off; tick() retries once the delay elapses
+        pod_uid = br.get("spec", {}).get("podUid", "")
         try:
             self._bind(br)
             status["phase"] = "Succeeded"
             status.pop("backoffUntil", None)
+            # Lifecycle: terminal success — the timeline closes and the
+            # submit→bound latency publishes.
+            LIFECYCLE.note_bound(pod_uid,
+                                 node=br["spec"].get("selectedNode", ""))
         except Exception as exc:  # retry with backoff limit
             attempts = status.get("attempts", 0) + 1
             status["attempts"] = attempts
+            LIFECYCLE.note_bind_attempt(pod_uid)
             if attempts >= br.get("spec", {}).get("backoffLimit",
                                                   self.backoff_limit):
                 status["phase"] = "Failed"
                 status["reason"] = str(exc)
                 self._rollback(br)
                 METRICS.inc("bind_backoff_exceeded")
+                LIFECYCLE.note_bind_failed(pod_uid)
                 self._record_event(
                     "bind_backoff_exceeded",
                     f"BindRequest {br['metadata']['name']}: "

@@ -20,6 +20,7 @@ from .admission import GPU_FRACTION_ANNOTATION, GPU_MEMORY_ANNOTATION
 from .binder import GPU_GROUP_ANNOTATION
 from .kubeapi import Conflict, InMemoryKubeAPI
 from .podgrouper import POD_GROUP_LABEL, SUBGROUP_LABEL
+from ..utils.lifecycle import LIFECYCLE
 from ..utils.metrics import METRICS
 from ..utils.tracing import TRACER
 
@@ -389,6 +390,10 @@ class ClusterCache:
         scheduler thread, so only flip a flag here; the next snapshot
         drops the cache on its own thread."""
         self._resync_pending = True
+        # Lifecycle: open timelines survive a relist (their pods are
+        # still real) but get flagged — accounting stays coherent across
+        # the gap instead of leaking or double-opening.
+        LIFECYCLE.note_resync()
 
     def _audit_device_selectors(self, owner: str, selectors: list) -> list:
         """Loud failure for selectors outside the supported CEL subset: a
@@ -606,6 +611,10 @@ class ClusterCache:
             cache_seen.add(task.uid)
             if task.status == PodStatus.PENDING:
                 seen_uids.add(task.uid)
+                # Lifecycle: the pod made it into a schedulable snapshot
+                # (idempotent per attempt — one dict probe on repeats).
+                LIFECYCLE.note(task.uid, "snapshotted", podgroup=group,
+                               queue=podgroups[group].queue_id)
             # A remembered pipelined assignment becomes a nomination: the
             # task stays schedulable, the nominated-node boost steers it
             # back to its node, and it binds the moment idle resources
@@ -626,6 +635,9 @@ class ClusterCache:
                     arena.note_vocab()
                 if node_name:
                     arena.note_nodes((node_name,))
+                # Lifecycle: the pod left the store without binding —
+                # close its timeline so no open state leaks.
+                LIFECYCLE.mark_vanished(uid)
         self._pod_sigs = pod_sigs
         # Forget assignments for pods that vanished or already bound.
         self._pipelined = {
@@ -771,6 +783,10 @@ class ClusterCache:
                 obj["metadata"].pop("resourceVersion", None)
                 obj["metadata"].pop("uid", None)
                 self.api.create(obj, **fk)
+        # Lifecycle: the durable bind intent is in the store (stamped
+        # only after the write survived the fence).
+        LIFECYCLE.note(task.uid, "bind_requested", node=node_name,
+                       trace_id=getattr(bind_request, "trace_id", None))
 
     def task_pipelined(self, task, node_name: str,
                        gpu_group: str = "") -> None:
@@ -795,6 +811,9 @@ class ClusterCache:
                     {"status": {"conditions": conditions},
                      "metadata": {"deletionTimestamp": str(self.now_fn())}},
                     task.namespace, **fk)
+            # Lifecycle: the eviction committed — the current attempt
+            # closes; a resubmit opens attempt N+1 on the same timeline.
+            LIFECYCLE.note_evicted(task.uid)
 
     def record_event(self, kind: str, message: str) -> None:
         # Correlation: events emitted mid-cycle carry the cycle's trace

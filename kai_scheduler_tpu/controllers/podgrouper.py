@@ -9,6 +9,7 @@ subgroup when the workload defines pod sets).
 from __future__ import annotations
 
 from ..models import group_workload
+from ..utils.lifecycle import LIFECYCLE
 from .kubeapi import InMemoryKubeAPI
 
 POD_GROUP_LABEL = "kai.scheduler/pod-group"
@@ -33,9 +34,20 @@ class PodGrouper:
         if pod.get("spec", {}).get("schedulerName",
                                    "kai-scheduler") != "kai-scheduler":
             return
+        md = pod["metadata"]
+        if not pod.get("spec", {}).get("nodeName"):
+            # Lifecycle hook: the watch stream delivered an unbound pod
+            # (already-bound pods re-delivering status changes are not
+            # "observed for scheduling" and must not reopen timelines).
+            LIFECYCLE.note(md.get("uid", md["name"]), "watch_observed",
+                           name=md["name"],
+                           namespace=md.get("namespace", "default"))
         top_owner, chain = self.resolve_top_owner(pod)
         meta = group_workload(top_owner, pod, self.api)
         self._ensure_podgroup(meta, pod)
+        if not pod.get("spec", {}).get("nodeName"):
+            LIFECYCLE.note(md.get("uid", md["name"]), "grouped",
+                           podgroup=meta.name, queue=meta.queue or "")
 
     def resolve_top_owner(self, pod: dict):
         """Walk ownerReferences to the root (pkg/podgrouper/topowner/)."""

@@ -12,6 +12,7 @@ from __future__ import annotations
 import queue
 import threading
 
+from ..utils.lifecycle import LIFECYCLE
 from ..utils.logging import ScopedLogger
 from ..utils.metrics import METRICS
 
@@ -35,6 +36,15 @@ class AsyncStatusUpdater:
     # -- enqueue -----------------------------------------------------------
     def patch_status(self, kind: str, name: str, namespace: str,
                      status_patch: dict) -> None:
+        if kind == "PodGroup":
+            # Lifecycle hook (enqueue time, on the cycle thread): the
+            # latest Unschedulable verdict shipped for this group joins
+            # the /debug/latency view next to the /explain ledger.
+            for cond in status_patch.get("conditions") or []:
+                if cond.get("type") == "Unschedulable" \
+                        and cond.get("status") == "True":
+                    LIFECYCLE.note_group_unschedulable(
+                        name, cond.get("message", ""))
         key = (kind, namespace, name)
         with self._lock:
             fresh = key not in self._inflight

@@ -349,12 +349,27 @@ class Statement:
             raise cl.SimulatedCrash(
                 "crash-after-journal: intents journaled, API commit "
                 "not started")
+        from ..utils.lifecycle import LIFECYCLE
         for i, op in enumerate(self.ops):
             if op.kind == "allocate":
+                # Lifecycle: the cycle committed a placement decision for
+                # this pod (stamped before the bind write so the phase
+                # order is scheduled <= bind_requested; an aborted commit
+                # leaves a scheduled-but-unbound attempt a later cycle
+                # completes — monotone either way).
+                LIFECYCLE.note(op.task.uid, "scheduled",
+                               podgroup=op.task.job_id,
+                               node=op.node_name, trace_id=trace_id)
                 self.session.cache.bind(op.task, op.node_name, by_op[i])
                 if log is not None:
                     log.mark_done(next(txids))
             elif op.kind == "pipeline":
+                # Lifecycle: a pipelined decision is still a committed
+                # scheduling verdict — the bind follows once resources
+                # free, on this same attempt.
+                LIFECYCLE.note(op.task.uid, "scheduled",
+                               podgroup=op.task.job_id,
+                               node=op.node_name, trace_id=trace_id)
                 # Pipelined assignments persist in the cache across cycles
                 # (Cache.TaskPipelined, cache/interface.go:36-50) so the
                 # next snapshot rebuilds them.

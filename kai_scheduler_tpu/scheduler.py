@@ -15,6 +15,7 @@ from .framework.conf import SchedulerConfig
 from .framework.session import InMemoryCache, Session
 from .utils.deviceguard import (CycleDeadlineExceeded, DeviceGuardError,
                                 device_guard)
+from .utils.lifecycle import LIFECYCLE
 from .utils.logging import LOG
 from .utils.metrics import METRICS
 from .utils.tracing import TRACER
@@ -205,8 +206,11 @@ class Scheduler:
         # measured by.
         for phase, secs in ssn.phase_timings.items():
             METRICS.observe(f"cycle_phase_latency_{phase}", secs * 1000.0)
-        METRICS.observe("e2e_scheduling_latency_milliseconds",
-                        (time.perf_counter() - t0) * 1000.0)
+        cycle_ms = (time.perf_counter() - t0) * 1000.0
+        METRICS.observe("e2e_scheduling_latency_milliseconds", cycle_ms)
+        # SLO accounting: burn the cycle budget counter when over, and
+        # refresh the lifecycle time-in-state gauges once per cycle.
+        LIFECYCLE.note_cycle(cycle_ms)
         self.last_session = ssn
         return ssn
 

@@ -22,6 +22,13 @@ endpoints:
                       (?podgroup=<name>; without it, the known names)
   GET /debug/pprof    the SamplingProfiler's folded stacks (flamegraph/
                       speedscope-ready; requires --enable-profiler)
+  GET /debug/latency  pod-lifecycle timelines (submit -> watch-observed ->
+                      grouped -> snapshotted -> scheduled -> bind-requested
+                      -> bound/evicted) joined to the /explain ledger
+                      (?queue=|podgroup=|limit=; docs/OBSERVABILITY.md)
+  GET /debug/flame    the continuous fleet profiler's folded stacks
+                      (utils/stackprof.py; arm with --stackprof or
+                      KAI_STACKPROF=1)
 
 Leader election comes in two flavors:
 
@@ -48,8 +55,10 @@ from .framework.conf import SchedulerConfig
 from .plugins.snapshot_plugin import dump_cluster
 from .utils import parse_bool as _parse_bool
 from .utils.deviceguard import configure_device_guard, device_guard
+from .utils.lifecycle import LIFECYCLE
 from .utils.logging import LOG, init_loggers
 from .utils.metrics import METRICS
+from .utils.stackprof import STACKPROF, ensure_started_from_env
 from .utils.tracing import TRACER
 
 
@@ -76,6 +85,12 @@ def healthz_payload(state: dict | None = None) -> dict:
         control["watch_gaps"] = gaps
     if control:
         payload["control_plane"] = control
+    # Degraded observability must itself be observable: a full lifecycle
+    # ring or a profiler that silently never started reads right here.
+    payload["observability"] = {
+        "lifecycle": LIFECYCLE.status(),
+        "stackprof": STACKPROF.status(),
+    }
     return payload
 
 
@@ -182,6 +197,48 @@ def _make_handler(server_state):
                         return
                     body = json.dumps(record).encode()
                 ctype = "application/json"
+            elif path == "/debug/latency":
+                # Lifecycle observatory: timelines (filtered by queue /
+                # podgroup) joined to the flight recorder's /explain
+                # ledger and the status updater's Unschedulable marks.
+                try:
+                    limit = max(1, min(2000, int(q.get("limit", 200))))
+                except ValueError:
+                    self.send_error(400, "limit must be an integer")
+                    return
+                payload = {
+                    "status": LIFECYCLE.status(),
+                    "pod_latency": LIFECYCLE.summary(),
+                    "timelines": LIFECYCLE.timelines(
+                        queue=q.get("queue"),
+                        podgroup=q.get("podgroup"), limit=limit),
+                }
+                podgroup = q.get("podgroup")
+                if podgroup:
+                    payload["explain"] = TRACER.explain_for(podgroup)
+                    mark = LIFECYCLE.group_mark(podgroup)
+                    if mark:
+                        payload["unschedulable_message"] = mark
+                body = json.dumps(payload).encode()
+                ctype = "application/json"
+            elif path == "/debug/flame":
+                # Continuous fleet profiler (whole-cycle host stacks, not
+                # just run_once): folded format for flamegraph.pl /
+                # speedscope.
+                if not STACKPROF.running and not STACKPROF.total_samples:
+                    self.send_error(
+                        404, "stackprof not running (arm with --stackprof "
+                             "or KAI_STACKPROF=1)")
+                    return
+                try:
+                    # Clamped: top=0/-1 would silently drop the heaviest
+                    # stacks via slice semantics.
+                    top = max(1, min(1 << 20, int(q.get("top", 5000))))
+                except ValueError:
+                    self.send_error(400, "top must be an integer")
+                    return
+                body = STACKPROF.folded(top=top).encode()
+                ctype = "text/plain"
             elif path == "/debug/pprof":
                 # The SamplingProfiler's collapsed stacks as a first-class
                 # endpoint (was reachable only via /debug/profile's query
@@ -261,6 +318,12 @@ def run_app(argv=None) -> None:
     ap.add_argument("--profile-dir", default=None,
                     help="write a JAX profiler trace of the run here "
                          "(the pprof/Pyroscope analog)")
+    ap.add_argument("--stackprof", action="store_true",
+                    help="continuous whole-fleet host profiler "
+                         "(utils/stackprof.py, ~67Hz, ring-bounded): "
+                         "folded stacks at GET /debug/flame; "
+                         "KAI_STACKPROF=1 arms it too, KAI_STACKPROF_DIR "
+                         "dumps the profile on exit")
     ap.add_argument("--usage-db", default=None,
                     help="usage client spec for time-based fairness, "
                          "e.g. memory://")
@@ -334,6 +397,10 @@ def run_app(argv=None) -> None:
     if args.enable_profiler:
         from .utils.profiling import SamplingProfiler
         state["profiler"] = SamplingProfiler().start()
+    if args.stackprof:
+        STACKPROF.start()
+    else:
+        ensure_started_from_env()
     handler = _make_handler(state)
     httpd = ThreadingHTTPServer(("127.0.0.1", args.http_port), handler)
     threading.Thread(target=httpd.serve_forever, daemon=True).start()
@@ -377,6 +444,8 @@ def run_app(argv=None) -> None:
         if args.profile_dir:
             import jax
             jax.profiler.stop_trace()
+        if STACKPROF.running:
+            STACKPROF.stop()  # dumps to KAI_STACKPROF_DIR when armed
         httpd.shutdown()
 
 

@@ -35,6 +35,11 @@ DEFAULT_TESTS = ["tests/test_reconciler.py", "tests/test_device_guard.py"]
 # resync-during-delta and breaker-open-during-scatter interleavings
 # (tests/test_snapshot_delta.py reads KAI_FAULT_SEED into its rng).
 ARENA_TESTS = ["tests/test_snapshot_delta.py"]
+# --latency: the pod-lifecycle suite — fault seeds reshuffle watch gaps,
+# binder backoff, fenced aborts, and evict/resubmit interleavings while
+# the timeline invariants (no leaked open phases, monotone stamps, new
+# attempt per resubmit) are asserted each iteration.
+LATENCY_TESTS = ["tests/test_lifecycle.py"]
 
 
 def run_iteration(seed: int, tests: list[str], marker: str,
@@ -88,6 +93,11 @@ def main(argv=None) -> int:
                          f"({ARENA_TESTS}) — each seed reshuffles the "
                          "event interleavings around resync-during-delta "
                          "and breaker-open-during-scatter")
+    ap.add_argument("--latency", action="store_true",
+                    help="latency mode: sweep the pod-lifecycle suite "
+                         f"({LATENCY_TESTS}) — each seed reshuffles "
+                         "watch-gap/backoff/abort interleavings while "
+                         "the timeline invariants are asserted")
     ap.add_argument("-k", "--keyword", default=None,
                     help="pytest -k filter (narrow the smoke subset)")
     ap.add_argument("--marker", default="chaos",
@@ -108,8 +118,14 @@ def main(argv=None) -> int:
 
     seeds = ([int(s) for s in args.seeds.split(",") if s.strip()]
              if args.seeds else list(range(1, args.iterations + 1)))
-    tests = args.tests if args.tests else (
-        ARENA_TESTS if args.arena else DEFAULT_TESTS)
+    if args.tests:
+        tests = args.tests
+    else:
+        # Modes compose: --arena --latency sweeps both suites per seed.
+        tests = (ARENA_TESTS if args.arena else []) + \
+            (LATENCY_TESTS if args.latency else [])
+        if not tests:
+            tests = DEFAULT_TESTS
     repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
 

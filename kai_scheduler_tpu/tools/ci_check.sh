@@ -9,6 +9,8 @@
 #                 (docs/STATIC_ANALYSIS.md) against the committed
 #                 baseline (.kailint-baseline.json)
 #   chaos matrix  --dry-run validation of the fault-grid definition
+#   stackprof     continuous-profiler smoke: profile a short embedded
+#                 fleet burst, fail on an empty folded profile
 #   tier-1 tests  pytest -m 'not slow' on CPU
 #
 # Usage: kai_scheduler_tpu/tools/ci_check.sh [--no-tests]
@@ -32,6 +34,11 @@ python -m kai_scheduler_tpu.tools.kailint kai_scheduler_tpu/ || fail=1
 echo
 echo "== chaos matrix definition (dry run) =="
 python -m kai_scheduler_tpu.tools.chaos_matrix --dry-run || fail=1
+
+echo
+echo "== stackprof smoke (profile a short fleet burst) =="
+JAX_PLATFORMS=cpu python -m kai_scheduler_tpu.utils.stackprof --smoke \
+    || fail=1
 
 if [ "${1:-}" != "--no-tests" ]; then
     echo

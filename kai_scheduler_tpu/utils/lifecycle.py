@@ -1,0 +1,516 @@
+"""Pod-lifecycle SLO tracker: event-sourced submit→bound timelines.
+
+Cycle time says how fast the scheduler loops; it says nothing about how
+long a POD waits.  Kant (arxiv 2510.01256) reports scheduler health as
+end-to-end pod latency percentiles, and the transfer-learning line of
+work (arxiv 2509.22701) consumes exactly these recorded lifecycle traces
+as training features — so submit→bound latency is a first-class,
+continuously measured signal here, not a bench-day artifact.
+
+Every pod the fleet touches gets a **timeline**: an ordered set of phase
+timestamps fed by one-line hooks in the controllers —
+
+    submit ─ watch_observed ─ grouped ─ snapshotted ─ scheduled
+           ─ bind_requested ─ bound | evicted
+
+``submit`` is stamped when the timeline opens (first observation);
+``watch_observed``/``grouped`` come from the PodGrouper's watch handler,
+``snapshotted`` from ``ClusterCache.snapshot``, ``scheduled`` from
+``Statement.commit`` (carrying the cycle's trace id, so a timeline joins
+the flight recorder), ``bind_requested`` from ``ClusterCache.bind`` and
+``bound`` from the Binder's reconciler.  An eviction closes the current
+**attempt** and the next scheduling pass opens a new one — an
+evicted-and-rescheduled pod is ONE coherent timeline with two attempt
+records, never a leaked open state.
+
+Design constraints (the kailint contracts):
+
+- all timing is monotonic (``time.perf_counter`` via an injectable
+  clock — KAI003: no wall clock in utils/);
+- the hot hooks are one dict probe on the no-change path: ``note`` reads
+  the open-timeline map lock-free first (GIL-safe dict get) and takes
+  the lock only when there is something to write — ``snapshot()`` calls
+  it once per pending pod per cycle;
+- memory is bounded at every layer: open timelines are capped
+  (``KAI_LIFECYCLE_OPEN_CAP``, default 8192 — overflow drops the pod and
+  counts ``lifecycle_open_overflow_total``), closed timelines live in a
+  ring (``KAI_LIFECYCLE_RING``, default 2048), attempts per timeline cap
+  at 8 with counted drops;
+- per-queue metric families go through the bounded-cardinality guard in
+  utils/metrics.py (overflow folds into ``other``).
+
+Published signals:
+
+- ``pod_latency_ms{queue=}`` histogram — submit→bound, per queue;
+- ``pod_phase_latency_ms{phase=}`` histogram — time spent in each phase
+  (delta to the next stamped phase) for bound pods;
+- ``slo_pod_latency_burn_total{queue=}`` counter — bound pods whose
+  submit→bound exceeded the pod budget (``KAI_SLO_POD_LATENCY_MS``,
+  default 1000);
+- ``slo_cycle_budget_burn_total`` counter — cycles over the cycle budget
+  (``KAI_SLO_CYCLE_MS``, default 100; fed by ``note_cycle``);
+- ``pods_in_phase{phase=}`` / ``pod_time_in_state_max_ms{phase=}``
+  gauges — how many open pods sit in each phase and the oldest age;
+- ``lifecycle_open_timelines`` / ``lifecycle_ring_occupancy`` gauges.
+
+``GET /debug/latency?queue=|podgroup=`` (server.py) renders timelines
+joined to the flight recorder's ``/explain`` ledger; ``summary()`` feeds
+``bench.py``'s fleet phase its ``pod_latency`` section.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from collections import deque
+
+from .metrics import METRICS
+
+PHASES = ("submit", "watch_observed", "grouped", "snapshotted",
+          "scheduled", "bind_requested", "bound", "evicted")
+# Phases that may open a NEW attempt after the previous one closed
+# (evicted / bind_failed): the pod re-entered scheduling.
+_REOPEN_PHASES = ("snapshotted", "scheduled", "bind_requested",
+                  "watch_observed", "grouped")
+_PHASE_INDEX = {p: i for i, p in enumerate(PHASES)}
+
+MAX_ATTEMPTS = 8
+
+
+def _env_int(name: str, default: int, lo: int = 1) -> int:
+    try:
+        return max(lo, int(os.environ.get(name, default)))
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+class Attempt:
+    """One scheduling attempt: phase -> monotonic timestamp, plus the
+    bind-retry count and the closing outcome."""
+
+    __slots__ = ("phases", "trace_id", "node", "bind_attempts", "outcome")
+
+    def __init__(self):
+        self.phases: dict[str, float] = {}
+        self.trace_id: str | None = None
+        self.node: str = ""
+        self.bind_attempts = 0
+        self.outcome: str | None = None   # bound|evicted|bind_failed|...
+
+    @property
+    def open(self) -> bool:
+        return self.outcome is None
+
+    def to_dict(self, origin: float) -> dict:
+        out = {
+            "phases": {p: round((t - origin) * 1e3, 3)
+                       for p, t in sorted(self.phases.items(),
+                                          key=lambda kv: kv[1])},
+            "outcome": self.outcome,
+        }
+        if self.trace_id:
+            out["trace_id"] = self.trace_id
+        if self.node:
+            out["node"] = self.node
+        if self.bind_attempts:
+            out["bind_attempts"] = self.bind_attempts
+        return out
+
+
+class PodTimeline:
+    """All attempts of one pod, newest last.  ``origin`` is the submit
+    stamp every rendered offset is relative to."""
+
+    __slots__ = ("uid", "name", "namespace", "podgroup", "queue",
+                 "attempts", "dropped_attempts", "resynced", "closed",
+                 "outcome", "origin", "last_ts")
+
+    def __init__(self, uid: str, now: float):
+        self.uid = uid
+        self.name = ""
+        self.namespace = ""
+        self.podgroup = ""
+        self.queue = ""
+        self.attempts: list[Attempt] = [Attempt()]
+        self.attempts[0].phases["submit"] = now
+        self.dropped_attempts = 0
+        self.resynced = False
+        self.closed = False
+        self.outcome: str | None = None
+        self.origin = now
+        self.last_ts = now
+
+    @property
+    def current(self) -> Attempt:
+        return self.attempts[-1]
+
+    def current_phase(self) -> str:
+        att = self.attempts[-1]
+        if not att.phases:
+            return "submit"
+        return max(att.phases, key=att.phases.get)
+
+
+class LifecycleTracker:
+    """Bounded, thread-safe pod-lifecycle store + SLO accountant."""
+
+    def __init__(self, open_cap: int | None = None,
+                 ring: int | None = None,
+                 pod_budget_ms: float | None = None,
+                 cycle_budget_ms: float | None = None,
+                 clock=time.perf_counter):
+        self.open_cap = open_cap if open_cap is not None else \
+            _env_int("KAI_LIFECYCLE_OPEN_CAP", 8192)
+        ring = ring if ring is not None else \
+            _env_int("KAI_LIFECYCLE_RING", 2048)
+        self.pod_budget_ms = pod_budget_ms if pod_budget_ms is not None \
+            else _env_float("KAI_SLO_POD_LATENCY_MS", 1000.0)
+        self.cycle_budget_ms = cycle_budget_ms \
+            if cycle_budget_ms is not None \
+            else _env_float("KAI_SLO_CYCLE_MS", 100.0)
+        self.clock = clock
+        import threading
+        self._lock = threading.Lock()
+        self._open: dict[str, PodTimeline] = {}
+        self._ring: deque = deque(maxlen=max(1, ring))
+        self.open_overflows = 0
+        # PodGroup -> last Unschedulable message the status updater
+        # shipped (bounded; /debug/latency joins it to the timelines).
+        self._group_marks: dict[str, str] = {}
+        self.resyncs = 0
+
+    # -- hot hooks ---------------------------------------------------------
+    def note(self, uid: str, phase: str, name: str = "",
+             namespace: str = "", podgroup: str = "", queue: str = "",
+             trace_id: str | None = None, node: str = "") -> None:
+        """Stamp ``phase`` on the pod's current attempt (idempotent: a
+        phase already stamped this attempt is a lock-free no-op — the
+        common per-cycle ``snapshotted`` path).  Opens the timeline, and
+        a fresh attempt after a closed one, as needed."""
+        tl = self._open.get(uid)
+        if tl is not None and not tl.closed \
+                and phase in tl.current.phases and tl.current.open:
+            return  # fast path: nothing new (GIL-safe read)
+        with self._lock:
+            tl = self._open.get(uid)
+            if tl is None:
+                if len(self._open) >= self.open_cap:
+                    self.open_overflows += 1
+                    METRICS.inc("lifecycle_open_overflow_total")
+                    return
+                tl = self._open[uid] = PodTimeline(uid, self.clock())
+            att = tl.current
+            if not att.open:
+                if phase not in _REOPEN_PHASES:
+                    return  # e.g. a late duplicate close
+                if len(tl.attempts) >= MAX_ATTEMPTS:
+                    tl.dropped_attempts += 1
+                    return
+                att = Attempt()
+                tl.attempts.append(att)
+            if phase in att.phases:
+                return
+            now = self.clock()
+            att.phases[phase] = now
+            tl.last_ts = now
+            if name:
+                tl.name = name
+            if namespace:
+                tl.namespace = namespace
+            if podgroup:
+                tl.podgroup = podgroup
+            if queue:
+                tl.queue = queue
+            if trace_id:
+                att.trace_id = trace_id
+            if node:
+                att.node = node
+
+    def note_bind_attempt(self, uid: str) -> None:
+        """A binder reconcile attempt failed and will back off; counted
+        on the attempt so a backoff-then-success timeline shows how many
+        tries the bind took."""
+        with self._lock:
+            tl = self._open.get(uid)
+            if tl is not None and tl.current.open:
+                tl.current.bind_attempts += 1
+
+    def note_bound(self, uid: str, node: str = "") -> None:
+        """Terminal success: stamp ``bound``, close the timeline, publish
+        the latency histograms and SLO burn."""
+        with self._lock:
+            tl = self._open.pop(uid, None)
+            if tl is None:
+                return
+            att = tl.current
+            now = self.clock()
+            att.phases.setdefault("bound", now)
+            if node:
+                att.node = node
+            att.outcome = "bound"
+            tl.outcome = "bound"
+            tl.closed = True
+            tl.last_ts = now
+            self._ring.append(tl)
+            total_ms = (att.phases["bound"] - tl.origin) * 1e3
+            queue = tl.queue or "unknown"
+            phase_deltas = _phase_deltas(att)
+        # Metric publication outside the lock (KAI006: no foreign calls
+        # under our lock; METRICS has its own guard).
+        METRICS.observe("pod_latency_ms", total_ms, queue=queue)
+        for phase, delta_ms in phase_deltas:
+            METRICS.observe("pod_phase_latency_ms", delta_ms, phase=phase)
+        if total_ms > self.pod_budget_ms:
+            METRICS.inc("slo_pod_latency_burn_total", queue=queue)
+
+    def note_evicted(self, uid: str) -> None:
+        """The scheduler evicted the pod: the current attempt closes
+        ``evicted``; the timeline stays open — a resubmit/reschedule
+        opens attempt N+1 (one coherent timeline per pod)."""
+        with self._lock:
+            tl = self._open.get(uid)
+            if tl is None or tl.closed:
+                return
+            att = tl.current
+            if att.open:
+                now = self.clock()
+                att.phases.setdefault("evicted", now)
+                att.outcome = "evicted"
+                tl.last_ts = now
+        METRICS.inc("pod_evictions_tracked_total")
+
+    def note_bind_failed(self, uid: str) -> None:
+        """Bind backoff exhausted: the attempt closes ``bind_failed``;
+        the reaped pod re-enters scheduling as a new attempt."""
+        with self._lock:
+            tl = self._open.get(uid)
+            if tl is None or tl.closed:
+                return
+            att = tl.current
+            if att.open:
+                att.outcome = "bind_failed"
+                tl.last_ts = self.clock()
+
+    def mark_vanished(self, uid: str) -> None:
+        """The pod left the store (deleted / dropped out of every live
+        group) without binding: close the timeline so nothing leaks.  The
+        outcome keeps the last attempt's verdict (an evicted pod that was
+        then deleted reads ``evicted``, not ``removed``)."""
+        with self._lock:
+            tl = self._open.pop(uid, None)
+            if tl is None:
+                return
+            att = tl.current
+            if att.open:
+                att.outcome = "removed"
+            tl.outcome = att.outcome
+            tl.closed = True
+            self._ring.append(tl)
+
+    def note_resync(self) -> None:
+        """A watch gap forced a re-list: open timelines survive (their
+        pods are still real) but are flagged, and the event is counted —
+        a resynced timeline's phase gaps may include the outage."""
+        with self._lock:
+            self.resyncs += 1
+            for tl in self._open.values():
+                tl.resynced = True
+
+    def note_group_unschedulable(self, podgroup: str, message: str) -> None:
+        """Status-updater hook: the latest Unschedulable verdict shipped
+        for a PodGroup (joined into /debug/latency next to /explain)."""
+        with self._lock:
+            if len(self._group_marks) >= 1024 \
+                    and podgroup not in self._group_marks:
+                self._group_marks.clear()  # bounded in a churning fleet
+            self._group_marks[podgroup] = message[:300]
+
+    def note_cycle(self, duration_ms: float) -> None:
+        """Cycle-budget SLO burn + per-cycle gauge refresh (called once
+        per scheduling cycle from the cycle driver)."""
+        if duration_ms > self.cycle_budget_ms:
+            METRICS.inc("slo_cycle_budget_burn_total")
+        self.publish_gauges()
+
+    # -- publication -------------------------------------------------------
+    def publish_gauges(self) -> None:
+        now = self.clock()
+        with self._lock:
+            per_phase: dict[str, list] = {}
+            for tl in self._open.values():
+                per_phase.setdefault(tl.current_phase(), []).append(
+                    tl.last_ts)
+            open_n = len(self._open)
+            ring_n = len(self._ring)
+        METRICS.set_gauge("lifecycle_open_timelines", float(open_n))
+        METRICS.set_gauge("lifecycle_ring_occupancy", float(ring_n))
+        for phase in PHASES:
+            stamps = per_phase.get(phase)
+            METRICS.set_gauge("pods_in_phase",
+                              float(len(stamps) if stamps else 0),
+                              phase=phase)
+            oldest_ms = ((now - min(stamps)) * 1e3) if stamps else 0.0
+            METRICS.set_gauge("pod_time_in_state_max_ms",
+                              round(oldest_ms, 3), phase=phase)
+
+    # -- reads (bench, /debug/latency, /healthz, tests) --------------------
+    def status(self) -> dict:
+        with self._lock:
+            return {"open_timelines": len(self._open),
+                    "ring_occupancy": len(self._ring),
+                    "ring_capacity": self._ring.maxlen,
+                    "open_cap": self.open_cap,
+                    "open_overflows": self.open_overflows,
+                    "watch_resyncs": self.resyncs}
+
+    def timelines(self, queue: str | None = None,
+                  podgroup: str | None = None,
+                  limit: int = 200) -> list[dict]:
+        """Rendered timelines, newest-closed first then open ones —
+        filtered by queue and/or podgroup for /debug/latency.
+
+        Only cheap dict copies happen under the lock (the same lock the
+        scheduling-path hooks contend on); the sort/round/format work of
+        rendering runs after release, on the copies."""
+        picked = []
+        with self._lock:
+            rows = list(self._ring)[::-1] + list(self._open.values())
+            for tl in rows:
+                if queue and tl.queue != queue:
+                    continue
+                if podgroup and tl.podgroup != podgroup:
+                    continue
+                picked.append((
+                    tl.uid, tl.name, tl.namespace, tl.podgroup, tl.queue,
+                    tl.outcome, tl.resynced, tl.dropped_attempts,
+                    tl.origin,
+                    [(dict(a.phases), a.trace_id, a.node,
+                      a.bind_attempts, a.outcome) for a in tl.attempts]))
+                if len(picked) >= limit:
+                    break
+        out = []
+        for (uid, name, ns, pg, q, outcome, resynced, dropped, origin,
+             attempts) in picked:
+            rendered = []
+            for phases, trace_id, node, bind_attempts, a_out in attempts:
+                att = Attempt()
+                att.phases = phases
+                att.trace_id = trace_id
+                att.node = node
+                att.bind_attempts = bind_attempts
+                att.outcome = a_out
+                rendered.append(att.to_dict(origin))
+            out.append({"uid": uid, "name": name, "namespace": ns,
+                        "podgroup": pg, "queue": q, "outcome": outcome,
+                        "resynced": resynced, "attempts": rendered,
+                        "dropped_attempts": dropped})
+        return out
+
+    def group_mark(self, podgroup: str) -> str | None:
+        with self._lock:
+            return self._group_marks.get(podgroup)
+
+    def summary(self) -> dict:
+        """The bench's ``pod_latency`` section: submit→bound p50/p99 and
+        per-phase medians over the bound timelines in the ring."""
+        totals: list[float] = []
+        deltas: dict[str, list] = {}
+        queues: set = set()
+        with self._lock:
+            bound = [tl for tl in self._ring if tl.outcome == "bound"]
+            for tl in bound:
+                att = tl.attempts[-1]
+                totals.append((att.phases["bound"] - tl.origin) * 1e3)
+                queues.add(tl.queue or "unknown")
+                for phase, delta_ms in _phase_deltas(att):
+                    deltas.setdefault(phase, []).append(delta_ms)
+        if not totals:
+            return {"bound_pods": 0}
+        totals.sort()
+
+        def pct(q):
+            i = min(len(totals) - 1,
+                    max(0, int(round(q * (len(totals) - 1)))))
+            return round(totals[i], 3)
+
+        return {
+            "bound_pods": len(totals),
+            "queues": len(queues),
+            "submit_to_bound_p50_ms": pct(0.5),
+            "submit_to_bound_p99_ms": pct(0.99),
+            "submit_to_bound_max_ms": round(totals[-1], 3),
+            "phase_median_ms": {
+                phase: round(sorted(v)[len(v) // 2], 3)
+                for phase, v in sorted(deltas.items())},
+        }
+
+    def check_invariants(self) -> list[str]:
+        """Timeline invariants the chaos matrix asserts per fault seed:
+        monotone timestamps within each attempt, no closed attempt
+        without an outcome, no open attempt after a closed timeline, and
+        every non-final attempt closed.  Returns violations (empty =
+        healthy)."""
+        bad = []
+        with self._lock:
+            everything = list(self._ring) + list(self._open.values())
+            for tl in everything:
+                for i, att in enumerate(tl.attempts):
+                    stamps = sorted(att.phases.items(), key=lambda kv:
+                                    (kv[1], _PHASE_INDEX.get(kv[0], 99)))
+                    order = [_PHASE_INDEX.get(p, 99) for p, _ in stamps]
+                    if order != sorted(order):
+                        bad.append(f"{tl.uid}: attempt {i} phase order "
+                                   f"{[p for p, _ in stamps]}")
+                    if i < len(tl.attempts) - 1 and att.open:
+                        bad.append(f"{tl.uid}: non-final attempt {i} "
+                                   f"still open")
+                if tl.closed and tl.current.open:
+                    bad.append(f"{tl.uid}: closed timeline with an open "
+                               f"attempt")
+                if tl.closed and tl.outcome is None:
+                    bad.append(f"{tl.uid}: closed without outcome")
+        return bad
+
+    def configure_bounds(self, open_cap: int | None = None,
+                         ring: int | None = None) -> dict:
+        """Resize the tracker's bounds (bench fleet shapes exceed the
+        daemon defaults).  Returns the PREVIOUS bounds so a caller can
+        restore them; the closed ring's contents carry over up to the
+        new capacity."""
+        with self._lock:
+            prev = {"open_cap": self.open_cap,
+                    "ring": self._ring.maxlen}
+            if open_cap is not None:
+                self.open_cap = max(1, int(open_cap))
+            if ring is not None and ring != self._ring.maxlen:
+                self._ring = deque(self._ring, maxlen=max(1, int(ring)))
+        return prev
+
+    def reset(self) -> None:
+        with self._lock:
+            self._open.clear()
+            self._ring.clear()
+            self._group_marks.clear()
+            self.open_overflows = 0
+            self.resyncs = 0
+
+
+def _phase_deltas(att: Attempt) -> list[tuple[str, float]]:
+    """(phase, ms-until-next-stamp) pairs in stamp order — the
+    "time spent in each state" breakdown of one attempt."""
+    stamps = sorted(att.phases.items(), key=lambda kv: kv[1])
+    return [(phase, (stamps[i + 1][1] - t) * 1e3)
+            for i, (phase, t) in enumerate(stamps[:-1])]
+
+
+# Process-wide tracker, like METRICS and TRACER: hooks in controllers,
+# the statement, and the binder record into it without plumbing; the
+# server and bench read it back out.
+LIFECYCLE = LifecycleTracker()

@@ -5,13 +5,34 @@ Mirrors pkg/scheduler/metrics/metrics.go's metric families
 queue fair-share/usage gauges, scenario counters).  Exported as a
 Prometheus text endpoint by the scheduler server; in-process consumers read
 the structured values directly.
+
+Label cardinality is BOUNDED for histograms and counters: families keyed
+by user-controlled values (per-queue latency, per-queue SLO burn) would
+otherwise grow one series per distinct value forever — the classic
+unbounded-label leak that OOMs a long-lived daemon and melts the scrape.
+Each (family, label key) admits at most ``KAI_METRICS_LABEL_CAP`` distinct
+values (default 512); further values fold into ``other`` and increment
+``metrics_label_overflow_total``, so saturation is visible, never silent.
+Gauges are exempt: their families (per-queue fair share) are overwritten
+in place each cycle and sized by the cluster, not by unbounded history.
 """
 
 from __future__ import annotations
 
 import math
+import os
+import threading
 from collections import defaultdict
 from dataclasses import dataclass, field
+
+LABEL_OVERFLOW_VALUE = "other"
+
+
+def _label_cap() -> int:
+    try:
+        return max(1, int(os.environ.get("KAI_METRICS_LABEL_CAP", 512)))
+    except ValueError:
+        return 512
 
 
 @dataclass
@@ -54,49 +75,120 @@ class Histogram:
 
 
 class Metrics:
-    def __init__(self):
+    def __init__(self, label_cap: int | None = None):
         self.histograms: dict[str, Histogram] = defaultdict(Histogram)
         self.gauges: dict[str, float] = {}
         self.counters: dict[str, float] = defaultdict(float)
+        self._label_cap = label_cap
+        # (family, label key) -> seen values; guarded by _label_lock (the
+        # guard mutates across threads: scheduler cycle, watch drain,
+        # status-updater workers all record labeled series).
+        self._label_values: dict = defaultdict(set)
+        self._label_lock = threading.Lock()
+        # Labeled-histogram rendering: series key -> (family, labels).
+        self._histogram_series: dict[str, tuple] = {}
 
-    def observe(self, name: str, value: float) -> None:
-        self.histograms[name].observe(value)
+    def _bound_labels(self, name: str, labels: dict) -> dict:
+        """Cap distinct values per (family, label key); overflow folds
+        into ``other`` and counts.  The cap is read per call so the env
+        knob applies without a restart ceremony in tests."""
+        if not labels:
+            return labels
+        cap = self._label_cap if self._label_cap is not None \
+            else _label_cap()
+        out = {}
+        overflowed = 0
+        with self._label_lock:
+            for k, v in labels.items():
+                v = str(v)
+                seen = self._label_values[(name, k)]
+                if v in seen:
+                    out[k] = v
+                elif len(seen) < cap:
+                    seen.add(v)
+                    out[k] = v
+                else:
+                    out[k] = LABEL_OVERFLOW_VALUE
+                    overflowed += 1
+        if overflowed:
+            self.counters["metrics_label_overflow_total"] += overflowed
+        return out
+
+    def observe(self, name: str, value: float, **labels) -> None:
+        if labels:
+            labels = self._bound_labels(name, labels)
+            key = _key(name, labels)
+            self._histogram_series.setdefault(key, (name, labels))
+            self.histograms[key].observe(value)
+        else:
+            self.histograms[name].observe(value)
 
     def set_gauge(self, name: str, value: float, **labels) -> None:
         self.gauges[_key(name, labels)] = value
 
     def inc(self, name: str, value: float = 1.0, **labels) -> None:
+        if labels:
+            labels = self._bound_labels(name, labels)
         self.counters[_key(name, labels)] += value
 
     def reset(self) -> None:
         self.histograms.clear()
         self.gauges.clear()
         self.counters.clear()
+        with self._label_lock:
+            self._label_values.clear()
+        self._histogram_series.clear()
 
     def to_prometheus_text(self) -> str:
         lines = []
-        for name, h in self.histograms.items():
-            lines.append(f"# TYPE {name} histogram")
-            # Cumulative buckets (the Prometheus histogram contract:
-            # every `le` counts observations <= it, ending at `+Inf`
-            # == _count) — `_sum`/`_count` alone is not scrapeable as a
-            # histogram and breaks histogram_quantile().
-            acc = 0
-            for b in h.buckets:
-                acc += h.counts.get(b, 0)
-                le = "+Inf" if b == math.inf else f"{b:g}"
-                lines.append(f'{name}_bucket{{le="{le}"}} {acc}')
-            if not h.buckets or h.buckets[-1] != math.inf:
-                # Custom bucket lists without an inf edge still need the
-                # mandatory +Inf bucket (== _count).
-                lines.append(f'{name}_bucket{{le="+Inf"}} {h.n}')
-            lines.append(f"{name}_sum {h.total}")
-            lines.append(f"{name}_count {h.n}")
+        # Group histogram series by family first: the text format
+        # requires every line of one family to form a single
+        # uninterrupted block after its # TYPE line — interleaving two
+        # labeled families fails promtool/OpenMetrics-strict scrapers.
+        families: dict[str, list] = {}
+        for key, h in self.histograms.items():
+            family, labels = self._histogram_series.get(key, (key, {}))
+            families.setdefault(family, []).append((labels, h))
+        for family, series in families.items():
+            lines.append(f"# TYPE {family} histogram")
+            for labels, h in series:
+                # Cumulative buckets (the Prometheus histogram contract:
+                # every `le` counts observations <= it, ending at `+Inf`
+                # == _count) — `_sum`/`_count` alone is not scrapeable as
+                # a histogram and breaks histogram_quantile().
+                acc = 0
+                for b in h.buckets:
+                    acc += h.counts.get(b, 0)
+                    le = "+Inf" if b == math.inf else f"{b:g}"
+                    lines.append(f"{family}_bucket"
+                                 f"{_labels_text(labels, le=le)} {acc}")
+                if not h.buckets or h.buckets[-1] != math.inf:
+                    # Custom bucket lists without an inf edge still need
+                    # the mandatory +Inf bucket (== _count).
+                    lines.append(
+                        f"{family}_bucket"
+                        f"{_labels_text(labels, le='+Inf')} {h.n}")
+                lines.append(
+                    f"{family}_sum{_labels_text(labels)} {h.total}")
+                lines.append(
+                    f"{family}_count{_labels_text(labels)} {h.n}")
         for key, v in self.gauges.items():
             lines.append(f"{key} {v}")
         for key, v in self.counters.items():
             lines.append(f"{key} {v}")
         return "\n".join(lines) + "\n"
+
+
+def _labels_text(labels: dict, le: str | None = None) -> str:
+    """Render a label set (optionally with a bucket ``le``) as the
+    ``{k="v",...}`` suffix; empty labels and no le render as nothing."""
+    items = list(sorted(labels.items()))
+    if le is not None:
+        items.append(("le", le))
+    if not items:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in items)
+    return f"{{{inner}}}"
 
 
 def _key(name: str, labels: dict) -> str:

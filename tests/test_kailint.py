@@ -595,6 +595,56 @@ class TestKAI008MetricsHygiene:
         assert any(f.rule == "KAI008" and "label keys" in f.message
                    for f in findings)
 
+    def test_pod_latency_family_consistent_usage_is_clean(self):
+        # The lifecycle observatory's families (utils/lifecycle.py):
+        # labeled histograms/counters behind the cardinality guard, used
+        # with ONE label-key set per family.
+        src = ("from ..utils.metrics import METRICS\n"
+               "def f(v, q, p):\n"
+               "    METRICS.observe('pod_latency_ms', v, queue=q)\n"
+               "    METRICS.observe('pod_phase_latency_ms', v, phase=p)\n"
+               "    METRICS.inc('slo_pod_latency_burn_total', queue=q)\n"
+               "    METRICS.inc('slo_cycle_budget_burn_total')\n"
+               "    METRICS.inc('lifecycle_open_overflow_total')\n"
+               "    METRICS.inc('metrics_label_overflow_total')\n"
+               "    METRICS.set_gauge('lifecycle_open_timelines', v)\n"
+               "    METRICS.set_gauge('pods_in_phase', v, phase=p)\n")
+        findings = lint(("kai_scheduler_tpu/utils/fix.py", src))
+        assert [f for f in findings if f.rule == "KAI008"] == []
+
+    def test_pod_latency_inconsistent_labels_fire(self):
+        # A bare pod_latency_ms observation next to the per-queue one is
+        # an unmergeable-series bug the rule must catch.
+        src = ("from ..utils.metrics import METRICS\n"
+               "def f(v, q):\n"
+               "    METRICS.observe('pod_latency_ms', v, queue=q)\n"
+               "    METRICS.observe('pod_latency_ms', v)\n")
+        findings = lint(("kai_scheduler_tpu/utils/fix.py", src))
+        assert any(f.rule == "KAI008" and "label keys" in f.message
+                   and "pod_latency_ms" in f.message for f in findings)
+
+    def test_stackprof_family_consistent_usage_is_clean(self):
+        src = ("from ..utils.metrics import METRICS\n"
+               "def f(v):\n"
+               "    METRICS.inc('stackprof_samples_total', v)\n"
+               "    METRICS.inc('stackprof_dump_errors_total')\n"
+               "    METRICS.set_gauge('stackprof_dropped_stacks', v)\n")
+        findings = lint(("kai_scheduler_tpu/utils/fix.py", src))
+        assert [f for f in findings if f.rule == "KAI008"] == []
+
+    def test_stackprof_cross_instrument_collision_fires(self):
+        a = ("from ..utils.metrics import METRICS\n"
+             "def f(v):\n"
+             "    METRICS.inc('stackprof_samples_total', v)\n")
+        b = ("from ..utils.metrics import METRICS\n"
+             "def g(v):\n"
+             "    METRICS.observe('stackprof_samples_total', v)\n")
+        findings = lint(("kai_scheduler_tpu/utils/a.py", a),
+                        ("kai_scheduler_tpu/server.py", b))
+        assert any(f.rule == "KAI008" and "one instrument" in f.message
+                   and "stackprof_samples_total" in f.message
+                   for f in findings)
+
     def test_engine_reuse_does_not_leak_rule_state(self):
         # A reused Engine is a supported caller (watch mode, hooks):
         # stateful rules must start fresh each run.

@@ -1,0 +1,517 @@
+"""Pod-lifecycle observatory chaos suite (marker ``chaos``, tier-1).
+
+ISSUE 6 acceptance invariants for the latency observatory
+(utils/lifecycle.py + utils/stackprof.py + the controller hooks):
+
+- the full fleet path (admission -> podgrouper -> scheduler -> binder)
+  produces COMPLETE, monotone, correctly-attributed timelines —
+  submit -> watch_observed -> grouped -> snapshotted -> scheduled ->
+  bind_requested -> bound;
+- a watch-gap relist mid-flight, binder backoff-then-success, a fenced
+  cycle abort, and breaker-open degradation all leave timelines complete
+  and coherent (no leaked open phases, no double-opened timelines);
+- evict -> resubmit produces a NEW attempt record on ONE timeline;
+- every ring/cap bound is respected with counted overflow;
+- the continuous profiler finds an injected synthetic hot phase by name
+  and respects its stack-table ring bound.
+
+``tools/chaos_matrix.py --latency`` sweeps this file under different
+``KAI_FAULT_SEED`` values; the seed reshuffles submission interleavings
+below so each iteration exercises a different event order.
+"""
+
+import os
+import random
+import time
+
+import pytest
+
+from kai_scheduler_tpu.controllers import (InMemoryKubeAPI, System,
+                                           SystemConfig, make_pod,
+                                           owner_ref)
+from kai_scheduler_tpu.controllers.binder import Binder, BindPlugin
+from kai_scheduler_tpu.controllers.cache_builder import ClusterCache
+from kai_scheduler_tpu.utils.deviceguard import (configure_device_guard,
+                                                 reset_device_guard)
+from kai_scheduler_tpu.utils.lifecycle import (LIFECYCLE, MAX_ATTEMPTS,
+                                               LifecycleTracker)
+from kai_scheduler_tpu.utils.leaderelect import LeaseElector
+from kai_scheduler_tpu.utils.metrics import METRICS, Metrics
+from kai_scheduler_tpu.utils.stackprof import (OVERFLOW_STACK,
+                                               STACKPROF, StackProfiler,
+                                               ensure_started_from_env)
+
+pytestmark = pytest.mark.chaos
+
+SEED = int(os.environ.get("KAI_FAULT_SEED", "0") or 0)
+
+
+@pytest.fixture(autouse=True)
+def clean_observatory():
+    LIFECYCLE.reset()
+    reset_device_guard()
+    yield
+    LIFECYCLE.reset()
+    reset_device_guard()
+
+
+def make_node(api, name, gpu=8):
+    api.create({"kind": "Node", "metadata": {"name": name},
+                "spec": {},
+                "status": {"allocatable": {"cpu": "32", "memory": "256Gi",
+                                           "nvidia.com/gpu": gpu,
+                                           "pods": 110}}})
+
+
+def make_queue(api, name="q"):
+    api.create({"kind": "Queue", "metadata": {"name": name},
+                "spec": {"deserved": {"cpu": "64", "memory": "512Gi",
+                                      "gpu": 16}}})
+
+
+def fleet(nodes=2):
+    system = System(SystemConfig())
+    for i in range(nodes):
+        make_node(system.api, f"n{i}")
+    make_queue(system.api)
+    return system
+
+
+def submit_gang(api, name, replicas, queue="q", gpu=1, seed=SEED):
+    """One gang workload through the real grouper path; returns the pod
+    uids.  The fault seed shuffles creation order so the chaos matrix
+    exercises different watch interleavings per iteration."""
+    api.create({"kind": "PyTorchJob", "apiVersion": "kubeflow.org/v1",
+                "metadata": {"name": name, "uid": f"{name}-uid",
+                             "labels": {"kai.scheduler/queue": queue}},
+                "spec": {"pytorchReplicaSpecs": {
+                    "Worker": {"replicas": replicas}}}})
+    ref = owner_ref("PyTorchJob", name, uid=f"{name}-uid",
+                    api_version="kubeflow.org/v1")
+    pods = [make_pod(f"{name}-worker-{k}", owner=ref, gpu=gpu,
+                     labels={"training.kubeflow.org/replica-type":
+                             "worker"})
+            for k in range(replicas)]
+    random.Random(seed).shuffle(pods)
+    uids = []
+    for pod in pods:
+        created = api.create(pod)
+        md = created["metadata"] if isinstance(created, dict) else \
+            pod["metadata"]
+        uids.append(md.get("uid", md["name"]))
+    return uids
+
+
+PIPE_ORDER = ("submit", "watch_observed", "grouped", "snapshotted",
+              "scheduled", "bind_requested", "bound")
+
+
+def assert_complete(tl):
+    """One bound timeline: every pipeline phase stamped, in order."""
+    assert tl["outcome"] == "bound", tl
+    att = tl["attempts"][-1]
+    stamps = att["phases"]
+    assert set(PIPE_ORDER) <= set(stamps), stamps
+    offsets = [stamps[p] for p in PIPE_ORDER]
+    assert offsets == sorted(offsets), stamps
+
+
+# ---------------------------------------------------------------------------
+# Full-fleet timelines
+# ---------------------------------------------------------------------------
+
+class TestFleetTimelines:
+    def test_full_flow_complete_and_attributed(self):
+        system = fleet()
+        uids = submit_gang(system.api, "train", 3)
+        lat_before = _hist_count("pod_latency_ms", queue="q")
+        system.run_cycle()
+        system.run_cycle()
+        rows = {tl["uid"]: tl for tl in LIFECYCLE.timelines()}
+        assert set(uids) <= set(rows)
+        for uid in uids:
+            assert_complete(rows[uid])
+            assert rows[uid]["queue"] == "q"
+            assert rows[uid]["podgroup"]
+            # The scheduled stamp carries the deciding cycle's trace id
+            # (joins the flight recorder).
+            assert rows[uid]["attempts"][-1]["trace_id"]
+        assert LIFECYCLE.check_invariants() == []
+        assert LIFECYCLE.status()["open_timelines"] == 0
+        # Published families: per-queue latency histogram + SLO gauges.
+        assert _hist_count("pod_latency_ms", queue="q") - lat_before == 3
+        assert "lifecycle_ring_occupancy" in METRICS.gauges
+        assert METRICS.gauges[
+            'pods_in_phase{phase="bound"}'] == 0  # all closed
+
+    def test_summary_reports_percentiles_and_phase_medians(self):
+        system = fleet()
+        submit_gang(system.api, "sum", 4)
+        system.run_cycle()
+        summary = LIFECYCLE.summary()
+        assert summary["bound_pods"] == 4
+        assert summary["submit_to_bound_p50_ms"] <= \
+            summary["submit_to_bound_p99_ms"]
+        assert set(summary["phase_median_ms"]) >= {
+            "submit", "snapshotted", "scheduled", "bind_requested"}
+
+    def test_slo_burn_counters(self):
+        # Tracker-level: budgets are injectable, so burn is determinate.
+        t = LifecycleTracker(open_cap=16, ring=16, pod_budget_ms=0.0,
+                             cycle_budget_ms=0.0)
+        burn0 = METRICS.counters.get(
+            'slo_pod_latency_burn_total{queue="qq"}', 0)
+        cyc0 = METRICS.counters.get("slo_cycle_budget_burn_total", 0)
+        t.note("u1", "watch_observed", queue="qq")
+        t.note("u1", "scheduled", queue="qq")
+        t.note_bound("u1")
+        t.note_cycle(50.0)
+        assert METRICS.counters[
+            'slo_pod_latency_burn_total{queue="qq"}'] == burn0 + 1
+        assert METRICS.counters["slo_cycle_budget_burn_total"] == cyc0 + 1
+        # Under-budget costs nothing.
+        t2 = LifecycleTracker(open_cap=16, ring=16, pod_budget_ms=1e9,
+                              cycle_budget_ms=1e9)
+        t2.note("u2", "scheduled", queue="qq")
+        t2.note_bound("u2")
+        t2.note_cycle(50.0)
+        assert METRICS.counters[
+            'slo_pod_latency_burn_total{queue="qq"}'] == burn0 + 1
+        assert METRICS.counters["slo_cycle_budget_burn_total"] == cyc0 + 1
+
+
+def _hist_count(name, **labels):
+    from kai_scheduler_tpu.utils.metrics import _key
+    h = METRICS.histograms.get(_key(name, {k: str(v) for k, v
+                                           in labels.items()}))
+    return h.n if h is not None else 0
+
+
+# ---------------------------------------------------------------------------
+# Chaos: watch gap, binder backoff, fenced abort, breaker degradation
+# ---------------------------------------------------------------------------
+
+class TestWatchGapRelist:
+    def test_relist_mid_flight_keeps_one_coherent_timeline(self):
+        """A 410/relist between observation and scheduling must not leak
+        or double-open timelines — the pods are still real."""
+        system = fleet()
+        uids = submit_gang(system.api, "gap", 3)
+        system.api.drain()          # watch_observed + grouped stamped
+        for sched in system.schedulers:
+            sched.cache._on_watch_resync()   # the HTTPKubeAPI 410 path
+        system.run_cycle()
+        system.run_cycle()
+        rows = {tl["uid"]: tl for tl in LIFECYCLE.timelines()}
+        for uid in uids:
+            assert_complete(rows[uid])
+            assert rows[uid]["resynced"] is True
+            assert len(rows[uid]["attempts"]) == 1
+        assert LIFECYCLE.check_invariants() == []
+        assert LIFECYCLE.status()["watch_resyncs"] >= 1
+
+
+class FlakyBind(BindPlugin):
+    """Fails the first N pre_bind calls, then succeeds — the
+    backoff-then-success shape."""
+
+    def __init__(self, failures):
+        self.left = failures
+
+    def pre_bind(self, api, pod, node_name, bind_request):
+        if self.left > 0:
+            self.left -= 1
+            raise RuntimeError("transient bind failure (chaos)")
+
+
+class TestBinderBackoff:
+    def _bind_request(self, api, uid="u-bb", pod="p-bb"):
+        make_node(api, "n1")
+        api.create(make_pod(pod))
+        api.create({"kind": "BindRequest",
+                    "metadata": {"name": f"bind-{uid}"},
+                    "spec": {"podName": pod, "podUid": uid,
+                             "selectedNode": "n1", "backoffLimit": 3}})
+
+    def test_backoff_then_success_one_attempt_with_retry_count(self):
+        api = InMemoryKubeAPI()
+        clock = [100.0]
+        binder = Binder(api, plugins=[FlakyBind(2)],
+                        now_fn=lambda: clock[0], backoff_base_s=0.1)
+        LIFECYCLE.note("u-bb", "scheduled", name="p-bb", queue="q")
+        self._bind_request(api)
+        api.drain()                      # attempt 1 fails
+        clock[0] += 60.0
+        binder.tick()                    # attempt 2 fails
+        clock[0] += 60.0
+        binder.tick()                    # attempt 3 succeeds
+        [tl] = LIFECYCLE.timelines()
+        assert tl["outcome"] == "bound"
+        att = tl["attempts"][-1]
+        assert att["bind_attempts"] == 2     # the two failures
+        assert "bound" in att["phases"]
+        assert len(tl["attempts"]) == 1      # backoff is NOT a new attempt
+        assert LIFECYCLE.check_invariants() == []
+
+    def test_backoff_exhaustion_closes_attempt_reschedule_reopens(self):
+        api = InMemoryKubeAPI()
+        clock = [100.0]
+        binder = Binder(api, plugins=[FlakyBind(99)],
+                        now_fn=lambda: clock[0], backoff_base_s=0.1)
+        LIFECYCLE.note("u-bb", "scheduled", name="p-bb", queue="q")
+        self._bind_request(api)          # backoffLimit 3
+        api.drain()
+        for _ in range(4):
+            clock[0] += 60.0
+            binder.tick()
+        [tl] = LIFECYCLE.timelines()
+        assert tl["outcome"] is None         # still open: pod re-enters
+        assert tl["attempts"][-1]["outcome"] == "bind_failed"
+        # The reaped pod re-schedules: a NEW attempt on the SAME timeline.
+        LIFECYCLE.note("u-bb", "scheduled")
+        LIFECYCLE.note_bound("u-bb")
+        [tl] = LIFECYCLE.timelines()
+        assert tl["outcome"] == "bound"
+        assert len(tl["attempts"]) == 2
+        assert LIFECYCLE.check_invariants() == []
+
+
+class TestFencedAbort:
+    def test_fenced_cycle_leaves_open_timeline_next_leader_completes(self):
+        """A deposed leader's commit dies at the store: the timeline must
+        show NO bind_requested/bound from the fenced cycle, stay open,
+        and complete cleanly once a valid leader schedules the pod."""
+        system = fleet()
+        [uid] = submit_gang(system.api, "fenced", 1)
+        system.api.drain()
+        clock = [100.0]
+        a = LeaseElector(system.api, "sched", "a", lease_duration=10,
+                         clock=lambda: clock[0])
+        b = LeaseElector(system.api, "sched", "b", lease_duration=10,
+                         clock=lambda: clock[0])
+        assert a.try_acquire()
+        assert not b.try_acquire()           # observes the live holder
+        clock[0] += 11
+        assert b.try_acquire()               # deposes a
+        system.set_fence("sched", lambda: a.epoch)
+        system.run_cycle()                   # fenced commit -> abort
+        assert system.schedulers[0].last_session.aborted
+        rows = {tl["uid"]: tl for tl in LIFECYCLE.timelines()}
+        att = rows[uid]["attempts"][-1]
+        assert "bind_requested" not in att["phases"]
+        assert "bound" not in att["phases"]
+        assert rows[uid]["outcome"] is None  # open, not leaked-closed
+        assert LIFECYCLE.check_invariants() == []
+        # The rightful leader completes the SAME timeline.
+        system.set_fence("sched", lambda: b.epoch)
+        system.run_cycle()
+        system.run_cycle()
+        rows = {tl["uid"]: tl for tl in LIFECYCLE.timelines()}
+        assert_complete(rows[uid])
+        assert len(rows[uid]["attempts"]) == 1
+        assert LIFECYCLE.check_invariants() == []
+
+
+class TestBreakerDegradation:
+    def test_breaker_open_cycles_still_close_timelines(self):
+        """Device dead, breaker open, CPU fallback scheduling: slower,
+        degraded — but the latency accounting stays complete."""
+        configure_device_guard(deadline_s=5.0, retries=0,
+                               breaker_threshold=1, fault="error")
+        system = fleet()
+        uids = submit_gang(system.api, "degraded", 2)
+        system.run_cycle()
+        system.run_cycle()
+        rows = {tl["uid"]: tl for tl in LIFECYCLE.timelines()}
+        for uid in uids:
+            assert_complete(rows[uid])
+        assert LIFECYCLE.check_invariants() == []
+
+
+# ---------------------------------------------------------------------------
+# Evict -> resubmit attempts
+# ---------------------------------------------------------------------------
+
+class TestEvictResubmit:
+    def test_cache_evict_hook_closes_attempt(self):
+        api = InMemoryKubeAPI()
+        api.create(make_pod("victim"))
+
+        class T:
+            uid, name, namespace = "u-v", "victim", "default"
+
+        LIFECYCLE.note("u-v", "scheduled", name="victim", queue="q")
+        ClusterCache(api).evict(T())
+        [tl] = LIFECYCLE.timelines()
+        assert tl["attempts"][-1]["outcome"] == "evicted"
+        assert "evicted" in tl["attempts"][-1]["phases"]
+        assert tl["outcome"] is None     # open for the resubmit
+
+    def test_evict_then_reschedule_is_two_attempts_one_timeline(self):
+        t = LifecycleTracker(open_cap=8, ring=8)
+        t.note("u1", "watch_observed", name="p1", queue="q")
+        t.note("u1", "snapshotted")
+        t.note("u1", "scheduled")
+        t.note_evicted("u1")
+        # Resubmit: the next scheduling pass opens attempt 2.
+        t.note("u1", "snapshotted")
+        t.note("u1", "scheduled")
+        t.note_bound("u1")
+        [tl] = t.timelines()
+        assert tl["outcome"] == "bound"
+        assert len(tl["attempts"]) == 2
+        assert tl["attempts"][0]["outcome"] == "evicted"
+        assert tl["attempts"][1]["outcome"] == "bound"
+        assert t.check_invariants() == []
+
+    def test_vanished_evicted_pod_keeps_evicted_outcome(self):
+        t = LifecycleTracker(open_cap=8, ring=8)
+        t.note("u1", "scheduled", queue="q")
+        t.note_evicted("u1")
+        t.mark_vanished("u1")            # deleted before any resubmit
+        [tl] = t.timelines()
+        assert tl["outcome"] == "evicted"
+        # And a plain vanish (no eviction) closes as removed.
+        t.note("u2", "snapshotted")
+        t.mark_vanished("u2")
+        rows = {r["uid"]: r for r in t.timelines()}
+        assert rows["u2"]["outcome"] == "removed"
+        assert t.check_invariants() == []
+
+    def test_attempt_cap_counts_drops(self):
+        t = LifecycleTracker(open_cap=8, ring=8)
+        for _ in range(MAX_ATTEMPTS + 3):
+            t.note("u1", "scheduled")
+            t.note_evicted("u1")
+        [tl] = t.timelines()
+        assert len(tl["attempts"]) == MAX_ATTEMPTS
+        assert tl["dropped_attempts"] == 3
+
+
+# ---------------------------------------------------------------------------
+# Bounds
+# ---------------------------------------------------------------------------
+
+class TestRingBounds:
+    def test_open_cap_drops_and_counts(self):
+        before = METRICS.counters.get("lifecycle_open_overflow_total", 0)
+        t = LifecycleTracker(open_cap=3, ring=2)
+        for i in range(5):
+            t.note(f"u{i}", "watch_observed")
+        st = t.status()
+        assert st["open_timelines"] == 3
+        assert st["open_overflows"] == 2
+        assert METRICS.counters["lifecycle_open_overflow_total"] == \
+            before + 2
+
+    def test_closed_ring_is_bounded(self):
+        t = LifecycleTracker(open_cap=16, ring=2)
+        for i in range(5):
+            t.note(f"u{i}", "scheduled", queue="q")
+            t.note_bound(f"u{i}")
+        st = t.status()
+        assert st["ring_occupancy"] == 2 and st["ring_capacity"] == 2
+        # Newest survive.
+        assert {tl["uid"] for tl in t.timelines()} == {"u3", "u4"}
+
+
+# ---------------------------------------------------------------------------
+# Metrics label-cardinality guard (satellite)
+# ---------------------------------------------------------------------------
+
+class TestLabelCardinalityGuard:
+    def test_overflow_folds_into_other_and_counts(self):
+        m = Metrics(label_cap=2)
+        for q in ("a", "b", "c", "d"):
+            m.observe("pod_latency_ms", 5.0, queue=q)
+            m.inc("slo_pod_latency_burn_total", queue=q)
+        text = m.to_prometheus_text()
+        assert 'pod_latency_ms_count{queue="a"} 1' in text
+        assert 'pod_latency_ms_count{queue="other"} 2' in text
+        assert 'slo_pod_latency_burn_total{queue="other"} 2' in text
+        assert m.counters["metrics_label_overflow_total"] == 4
+
+    def test_known_values_never_fold(self):
+        m = Metrics(label_cap=2)
+        for _ in range(10):
+            m.observe("pod_latency_ms", 5.0, queue="a")
+            m.observe("pod_latency_ms", 5.0, queue="b")
+        assert m.counters.get("metrics_label_overflow_total", 0) == 0
+
+    def test_labeled_histogram_renders_cumulative_buckets(self):
+        m = Metrics(label_cap=8)
+        m.observe("pod_latency_ms", 15.0, queue="a")
+        text = m.to_prometheus_text()
+        assert '# TYPE pod_latency_ms histogram' in text
+        assert 'pod_latency_ms_bucket{queue="a",le="20"} 1' in text
+        assert 'pod_latency_ms_bucket{queue="a",le="+Inf"} 1' in text
+        assert 'pod_latency_ms_sum{queue="a"} 15.0' in text
+
+    def test_env_tunable_cap(self, monkeypatch):
+        monkeypatch.setenv("KAI_METRICS_LABEL_CAP", "1")
+        m = Metrics()          # no explicit cap: env applies per call
+        m.inc("pods_total", queue="a")
+        m.inc("pods_total", queue="b")
+        assert 'pods_total{queue="other"}' in m.to_prometheus_text()
+
+
+# ---------------------------------------------------------------------------
+# Continuous profiler (utils/stackprof.py)
+# ---------------------------------------------------------------------------
+
+def synthetic_hot_phase(seconds):
+    """A known CPU-burning frame the profiler must find by name — the
+    acceptance-criteria probe for /debug/flame's fidelity."""
+    t0 = time.monotonic()
+    x = 0
+    while time.monotonic() - t0 < seconds:
+        for i in range(2000):   # flat loop: THIS frame is the hot leaf
+            x += i * i
+    return x
+
+
+class TestStackProf:
+    def test_finds_injected_synthetic_hot_phase(self):
+        prof = StackProfiler(hz=250.0, max_stacks=4096)
+        prof.start()
+        synthetic_hot_phase(0.4)
+        prof.stop(dump=False)
+        folded = prof.folded()
+        assert prof.total_samples > 0
+        assert "synthetic_hot_phase" in folded
+        # And it surfaces as a TOP busy frame, not buried noise.
+        tops = [row["frame"] for row in prof.top_frames(3)]
+        assert any("synthetic_hot_phase" in f for f in tops), tops
+
+    def test_stack_table_ring_bound_folds_overflow(self):
+        prof = StackProfiler(hz=250.0, max_stacks=2)
+        # Pre-fill the table to capacity: every novel stack must now
+        # fold into the overflow bucket instead of growing the table.
+        prof.samples.update({"warm;a": 1, "warm;b": 1})
+        prof.start()
+        synthetic_hot_phase(0.3)
+        prof.stop(dump=False)
+        assert OVERFLOW_STACK in prof.samples
+        assert prof.dropped_stacks > 0
+        assert len(prof.samples) == 3    # 2 real + the overflow bucket
+
+    def test_dump_to_stackprof_dir(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("KAI_STACKPROF_DIR", str(tmp_path / "prof"))
+        prof = StackProfiler(hz=250.0)
+        prof.start()
+        synthetic_hot_phase(0.2)
+        prof.stop()                      # dump-on-stop
+        dumps = list((tmp_path / "prof").glob("stackprof_*.folded"))
+        assert len(dumps) == 1
+        assert dumps[0].read_text().strip()
+
+    def test_env_arming(self, monkeypatch):
+        monkeypatch.setenv("KAI_STACKPROF", "1")
+        try:
+            assert ensure_started_from_env() is True
+            assert STACKPROF.running
+        finally:
+            STACKPROF.stop(dump=False)
+            STACKPROF.reset()
+        monkeypatch.setenv("KAI_STACKPROF", "0")
+        assert ensure_started_from_env() is False

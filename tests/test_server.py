@@ -22,6 +22,7 @@ def test_daemon_cli_smoke(tmp_path):
         [sys.executable, "-m", "kai_scheduler_tpu.server",
          "--http-port", str(port), "--cycles", "400",
          "--schedule-period", "0.05", "--enable-profiler",
+         "--stackprof",
          "--lock-file", str(tmp_path / "lease.lock")],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
 
@@ -53,6 +54,11 @@ def test_daemon_cli_smoke(tmp_path):
         health = json.loads(get("/healthz"))
         assert health["status"] == "ok"  # no faults -> breaker closed
         assert health["device_guard"]["state"] == "closed"
+        # Degraded observability is itself observable: lifecycle ring
+        # occupancy + stackprof on/off state ride /healthz.
+        obs = health["observability"]
+        assert obs["lifecycle"]["ring_capacity"] >= 1
+        assert obs["stackprof"]["running"] is True
         snap = json.loads(get("/get-snapshot"))
         assert snap.get("config", {}).get("actions"), snap.keys()
         assert "nodes" in snap
@@ -83,6 +89,19 @@ def test_daemon_cli_smoke(tmp_path):
         except urllib.error.HTTPError as e:
             assert e.code == 404
         assert get("/debug/pprof")  # profiler enabled: folded stacks
+        # Latency observatory: the endpoint serves (an idle cluster has
+        # no timelines, but status/pod_latency structure is present).
+        latency = json.loads(get("/debug/latency"))
+        assert "timelines" in latency and "pod_latency" in latency
+        assert latency["status"]["ring_capacity"] >= 1
+        # Continuous fleet profiler: folded stacks from --stackprof.
+        deadline = time.monotonic() + 30
+        flame = b""
+        while time.monotonic() < deadline and not flame.strip():
+            flame = get("/debug/flame")
+            time.sleep(0.2)
+        assert flame.strip(), "stackprof produced no folded stacks"
+        assert b";" in flame  # stack;frames count lines
     finally:
         proc.terminate()
         try:

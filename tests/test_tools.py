@@ -202,6 +202,29 @@ class TestChaosMatrixDryRun:
         assert rc == 0
         assert "tests/test_device_guard.py" in capsys.readouterr().out
 
+    def test_dry_run_latency_mode_selects_lifecycle_suite(self, capsys,
+                                                          monkeypatch):
+        """--latency sweeps the pod-lifecycle timeline-invariant suite;
+        composing --arena --latency sweeps both per seed."""
+        from kai_scheduler_tpu.tools import chaos_matrix
+        monkeypatch.setattr(
+            chaos_matrix.subprocess, "run",
+            lambda *a, **kw: (_ for _ in ()).throw(AssertionError(
+                "dry run must not execute iterations")))
+        rc = chaos_matrix.main(["--dry-run", "--latency", "--seeds",
+                                "3,5"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert out.count("seed ") == 2
+        assert "tests/test_lifecycle.py" in out
+        assert "tests/test_reconciler.py" not in out
+        rc = chaos_matrix.main(["--dry-run", "--arena", "--latency",
+                                "--seeds", "3"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "tests/test_lifecycle.py" in out
+        assert "tests/test_snapshot_delta.py" in out
+
     def test_dry_run_respects_iterations_default_seeds(self, capsys,
                                                        monkeypatch):
         from kai_scheduler_tpu.tools import chaos_matrix
