@@ -263,6 +263,22 @@ def fleet_phase(n_nodes=2000, n_jobs=8, gang=100, waves=2):
         # thread's stack for the rest of the bench.
         prof.stop(dump=False)
         LIFECYCLE.configure_bounds(**old_bounds)
+    # Incremental host pipeline verdict: the shard cache's last-snapshot
+    # dirty counts and the grouper/cache counters this PR's budget smoke
+    # gates on (tools/fleet_budget.py).
+    from kai_scheduler_tpu.utils.metrics import METRICS
+    cache = system.schedulers[0].cache if system.schedulers else None
+    incremental = {
+        "last_snapshot": getattr(cache, "last_snapshot_stats", {}),
+        "full_refresh_total": METRICS.counters.get(
+            "cluster_cache_full_refresh_total", 0),
+        "owner_cache_hits": METRICS.counters.get(
+            "podgrouper_owner_cache_hits", 0),
+        "owner_cache_misses": METRICS.counters.get(
+            "podgrouper_owner_cache_misses", 0),
+        "stale_writes_skipped": METRICS.counters.get(
+            "stale_write_skipped_total", 0),
+    }
     return {
         "config": f"{n_nodes}nodes_{n_jobs * gang}pods_fleet",
         "cold_wave_s": round(cold_s, 2),
@@ -270,6 +286,7 @@ def fleet_phase(n_nodes=2000, n_jobs=8, gang=100, waves=2):
         "warm_cycle_s": round(float(np.median(warm_cycles)), 3),
         "warm_cycles": len(warm_cycles),
         "pod_latency": pod_latency,
+        "incremental": incremental,
         "stackprof": {
             "samples": prof.total_samples,
             "distinct_stacks": len(prof.samples),

@@ -82,6 +82,31 @@ class NodeInfo:
     def idle(self) -> np.ndarray:
         return self.allocatable - self.used
 
+    def instantiate(self) -> "NodeInfo":
+        """Fresh per-cycle instance from a parsed template (the
+        incremental ClusterCache re-parses a Node manifest only when its
+        resourceVersion moves; every cycle in between starts from here).
+        ``allocatable`` is shared BY REFERENCE — node hardware is
+        immutable within a snapshot (only ``used``/``releasing`` move) —
+        while every container a cycle mutates is fresh."""
+        n = NodeInfo.__new__(NodeInfo)
+        n.name = self.name
+        n.idx = -1
+        n.allocatable = self.allocatable
+        n.used = rs.zeros()
+        n.releasing = rs.zeros()
+        n.labels = dict(self.labels)
+        n.taints = set(self.taints)
+        n.gpu_memory_per_device = self.gpu_memory_per_device
+        n.max_pods = self.max_pods
+        n.pod_infos = {}
+        n.gpu_sharing_groups = {}
+        n.mig_capacity = dict(self.mig_capacity)
+        n.mig_used = {}
+        n.mig_releasing = {}
+        n.accessible_capacities = {}
+        return n
+
     def clone(self) -> "NodeInfo":
         n = NodeInfo(self.name, self.allocatable.copy(), dict(self.labels),
                      set(self.taints), self.gpu_memory_per_device,

@@ -90,7 +90,8 @@ class ResourceClaimPlugin(BindPlugin):
 
 
 class Binder:
-    """BindRequest reconciler with *bounded* retries.
+    """BindRequest reconciler: batched, stale-aware, with *bounded*
+    retries.
 
     A persistently failing bind (node gone, PVC wedged) used to hot-loop:
     every failure re-emitted the request, which failed again in the same
@@ -100,14 +101,31 @@ class Binder:
     recorded in ``status.backoffUntil``; ``tick()`` — called once per
     operator cycle — re-reconciles requests whose backoff elapsed.
     Exhausting the limit emits a ``bind_backoff_exceeded`` event (and
-    counter) and rolls back any reservations the attempts took."""
+    counter) and rolls back any reservations the attempts took.
+
+    Processing is BATCHED per delivery drain: watch events enqueue the
+    request key and the pending queue drains once per batch (the API's
+    drain-idle hook), so a request touched by N events reconciles once.
+    BindRequest STATUS writes dedupe through the AsyncStatusUpdater when
+    one is attached (``_local_phase`` keeps the binder's own view of
+    terminal phases until the async write lands, so a request is never
+    re-bound while its Succeeded patch is in flight).  Requests whose pod
+    vanished (DELETED watch event or deletionTimestamp) before the
+    worker dequeued them are dropped without the doomed API round trip
+    (``stale_write_skipped_total``); stale-request GC reaps the object.
+    """
+
+    # Tombstone bound: cleared wholesale on overflow — losing a
+    # tombstone only costs one doomed (but harmless) bind attempt.
+    GONE_POD_CAP = 8192
 
     # now_fn is WALL clock by default: status.backoffUntil persists in
     # the API object and must stay meaningful to a successor binder in
     # another process (monotonic origins differ per process).
     def __init__(self, api: InMemoryKubeAPI, plugins=None,
                  backoff_limit: int = 3, now_fn=time.time,
-                 backoff_base_s: float = 0.5, backoff_cap_s: float = 60.0):
+                 backoff_base_s: float = 0.5, backoff_cap_s: float = 60.0,
+                 status_updater=None):
         self.api = api
         self.plugins = plugins if plugins is not None else [
             VolumeBindingPlugin(), ResourceClaimPlugin()]
@@ -115,16 +133,105 @@ class Binder:
         self.now_fn = now_fn
         self.backoff_base_s = backoff_base_s
         self.backoff_cap_s = backoff_cap_s
+        self.status_updater = status_updater
         self._jitter_rng = random.Random(0xB17D)
+        # (ns, name) -> latest event payload, drained once per batch.
+        self._pending_brs: dict = {}
+        # (ns, name) -> terminal phase this binder decided but whose
+        # async status write may not have landed in the store yet.
+        self._local_phase: dict = {}
+        # (ns, pod name) tombstones for vanished pods.
+        self._gone_pods: set = set()
         api.watch("BindRequest", self._on_bind_request)
+        api.watch("Pod", self._on_pod_event)
+        idle = getattr(api, "on_drain_idle", None)
+        self._coalesced = idle is not None
+        if idle is not None:
+            idle(self.drain_pending)
 
     def _backoff_delay(self, attempts: int) -> float:
         return backoff_delay(self.backoff_base_s, self.backoff_cap_s,
                              attempts, self._jitter_rng, spread=0.25)
 
+    def _on_pod_event(self, event_type: str, pod: dict) -> None:
+        """Tombstone vanished pods so queued binds/retries for them are
+        dropped instead of paying a doomed API round trip."""
+        md = pod["metadata"]
+        key = (md.get("namespace", "default"), md["name"])
+        if event_type == "DELETED" or md.get("deletionTimestamp"):
+            if len(self._gone_pods) >= self.GONE_POD_CAP:
+                self._gone_pods.clear()
+            self._gone_pods.add(key)
+        elif self._gone_pods:
+            self._gone_pods.discard(key)  # name reused by a fresh pod
+
     def _on_bind_request(self, event_type: str, br: dict) -> None:
+        key = (br["metadata"].get("namespace", "default"),
+               br["metadata"]["name"])
         if event_type == "DELETED":
+            self._pending_brs.pop(key, None)
+            self._local_phase.pop(key, None)
             return
+        phase = br.get("status", {}).get("phase")
+        if phase in ("Succeeded", "Failed"):
+            if self._local_phase.get(key) == phase:
+                self._local_phase.pop(key, None)  # async write landed
+            return
+        if key in self._local_phase:
+            return  # terminal decision already made, write in flight
+        self._pending_brs[key] = br
+        if not self._coalesced:
+            self.drain_pending()
+
+    def drain_pending(self) -> int:
+        """Process the queued BindRequests once per delivery batch: a
+        request touched by N watch events reconciles once, and requests
+        whose pod already vanished are skipped outright."""
+        if not self._pending_brs:
+            return 0
+        pending, self._pending_brs = self._pending_brs, {}
+        processed = 0
+        for key, br in pending.items():
+            if self._skip_stale(key, br):
+                continue
+            self._process(br)
+            processed += 1
+        return processed
+
+    def _skip_stale(self, key, br: dict) -> bool:
+        if key in self._local_phase:
+            return True
+        pod_key = (br["metadata"].get("namespace", "default"),
+                   br.get("spec", {}).get("podName", ""))
+        if pod_key in self._gone_pods:
+            # The pod died between scheduling and binding: drop the
+            # attempt (and its status/bind writes); the stale-request
+            # GC deletes the object.  Reservations earlier attempts took
+            # must release NOW — the retry path that used to exhaust the
+            # backoff (and roll back) never runs again for this request.
+            if br.get("spec", {}).get("selectedGPUGroups"):
+                self._rollback(br)
+            METRICS.inc("stale_write_skipped_total")
+            return True
+        return False
+
+    def _write_status(self, br: dict, status: dict) -> None:
+        ns = br["metadata"].get("namespace", "default")
+        name = br["metadata"]["name"]
+        if self.status_updater is not None:
+            if status.get("phase") in ("Succeeded", "Failed"):
+                self._local_phase[(ns, name)] = status["phase"]
+            # The LIVE status dict, not a copy: on the in-memory
+            # substrate it IS the stored object's status, so a worker
+            # applying it later can never revert a newer in-place state
+            # (a frozen copy could, when a retry advanced the status
+            # between enqueue and apply).
+            self.status_updater.patch_status("BindRequest", name, ns,
+                                             status)
+        else:
+            self.api.patch("BindRequest", name, {"status": status}, ns)
+
+    def _process(self, br: dict) -> None:
         status = br.setdefault("status", {})
         if status.get("phase") in ("Succeeded", "Failed"):
             return
@@ -159,9 +266,7 @@ class Binder:
                 status["phase"] = "Pending"
                 status["backoffUntil"] = \
                     self.now_fn() + self._backoff_delay(attempts)
-        ns = br["metadata"].get("namespace", "default")
-        self.api.patch("BindRequest", br["metadata"]["name"],
-                       {"status": status}, ns)
+        self._write_status(br, status)
 
     def tick(self) -> int:
         """Re-reconcile Pending BindRequests whose backoff has elapsed
@@ -174,9 +279,22 @@ class Binder:
             status = br.get("status", {})
             if status.get("phase") != "Pending":
                 continue
+            key = (br["metadata"].get("namespace", "default"),
+                   br["metadata"]["name"])
+            if key in self._local_phase:
+                # Store still Pending but this binder decided a terminal
+                # phase: the async write is in flight OR was dropped by
+                # a transient API error.  Re-assert it (deduped) so a
+                # dropped write cannot wedge the request forever.
+                self.status_updater.patch_status(
+                    "BindRequest", key[1], key[0],
+                    {"phase": self._local_phase[key]})
+                continue
             if status.get("attempts", 0) and \
                     now >= status.get("backoffUntil", 0.0):
-                self._on_bind_request("MODIFIED", br)
+                if self._skip_stale(key, br):
+                    continue
+                self._process(br)
                 retried += 1
         return retried
 

@@ -302,8 +302,77 @@ def _quota_vec(spec: dict | None):
                 gpu=spec.get("gpu", 0))
 
 
+class _GroupTmpl:
+    """Parsed PodGroup manifest: everything ``snapshot()`` needs to build
+    the per-cycle PodGroupInfo without touching the manifest again."""
+
+    __slots__ = ("name", "namespace", "queue_id", "priority",
+                 "min_available", "preemptible", "creation_ts",
+                 "topology_name", "required_topology_level",
+                 "preferred_topology_level", "pod_sets", "last_start_ts",
+                 "node_pool")
+
+    def instantiate(self) -> PodGroupInfo:
+        pg = PodGroupInfo(
+            self.name, self.name, namespace=self.namespace,
+            queue_id=self.queue_id, priority=self.priority,
+            min_available=self.min_available, preemptible=self.preemptible,
+            creation_ts=self.creation_ts, topology_name=self.topology_name,
+            required_topology_level=self.required_topology_level,
+            preferred_topology_level=self.preferred_topology_level)
+        if self.pod_sets:
+            pg.set_pod_sets([
+                PodSet(name, min_avail, topology_name=topo,
+                       required_topology_level=req,
+                       preferred_topology_level=pref)
+                for name, min_avail, topo, req, pref in self.pod_sets])
+        pg.last_start_ts = self.last_start_ts
+        pg.node_pool = self.node_pool
+        return pg
+
+
+# Kinds the snapshot consumes.  Hot kinds have dedicated parse-template
+# stores; aux kinds rebuild a parsed cache per FAMILY only when one of
+# the family's kinds changed (a PVC feeds both the pvc view and the CSI
+# storage snapshot, hence the tuple values).
+_HOT_KINDS = ("Node", "Queue", "PodGroup", "Pod")
+_AUX_FAMILIES = {
+    "Topology": ("topology",),
+    "ResourceClaim": ("dra",),
+    "ResourceSlice": ("dra",),
+    "DeviceClass": ("dra",),
+    "ConfigMap": ("configmap",),
+    "PersistentVolumeClaim": ("pvc", "storage"),
+    "CSIDriver": ("storage",),
+    "StorageClass": ("storage",),
+    "CSIStorageCapacity": ("storage",),
+}
+_CONSUMED_KINDS = frozenset(_HOT_KINDS) | frozenset(_AUX_FAMILIES)
+
+
 class ClusterCache:
-    """Watches the API and snapshots ClusterInfo each cycle."""
+    """Watches the API and snapshots ClusterInfo each cycle.
+
+    The snapshot is INCREMENTAL: long-lived parse templates (NodeInfo /
+    QueueInfo / PodGroupInfo / PodInfo, plus per-family aux caches) are
+    maintained from watch deltas, and ``snapshot()`` only re-parses
+    objects whose resourceVersion actually moved — the per-cycle cost is
+    instantiation + wiring, not O(cluster) manifest re-parsing.  Dirty
+    sets derive from the store's own change stream:
+
+    - ``InMemoryKubeAPI`` exposes ``watch_sync`` (emit-time callbacks),
+      so mutations mark keys dirty the instant they land — a snapshot
+      taken without an intervening drain still sees everything;
+    - substrates without the hook (HTTP/real clients) fall back to a
+      full per-kind re-list each snapshot, diffed by resourceVersion, so
+      the parse memoization still holds (``cluster_cache_full_refresh_
+      total`` counts these);
+    - a watch resync (the PR 2 relist path) invalidates WHOLESALE:
+      mirrors, templates, and the device arena all rebuild from scratch.
+
+    The correctness contract is bit-identity to a from-scratch parse
+    (tests/test_incremental_cache.py drives randomized churn against it,
+    mirroring how tests/test_snapshot_delta.py proved the arena)."""
 
     def __init__(self, api: InMemoryKubeAPI, now_fn=None,
                  status_updater=None):
@@ -348,15 +417,56 @@ class ClusterCache:
         # Sessions built on this cache pack incrementally against it.
         from ..framework.arena import ClusterArena
         self.arena = ClusterArena()
-        # Change-detection signatures from the watch-updated store, diffed
-        # per snapshot: the store IS the materialized watch-event stream
-        # (every ADDED/MODIFIED/DELETED bumps a resourceVersion), so
-        # diffing resourceVersions yields exactly the delta the stream
-        # carried — including events whose delivery we never observed.
-        self._node_sigs: dict = {}
-        self._pod_sigs: dict = {}      # uid -> (rv, node_name, vocab)
-        self._group_sigs: dict = {}
-        self._queue_sigs: dict = {}
+        # -- incremental ClusterInfo store --------------------------------
+        # Mirrors of the watched store per consumed kind ((ns, name) ->
+        # manifest), maintained from watch deltas (or re-listed per
+        # snapshot on substrates without a change hook).  The parse
+        # layers below read ONLY the mirrors.
+        self._mirror: dict = {k: {} for k in _CONSUMED_KINDS}
+        # Deterministic iteration order (sorted by name, api.list's
+        # ordering), recomputed only when a kind's membership changes.
+        self._order: dict = {k: [] for k in _CONSUMED_KINDS}
+        self._order_stale: dict = {k: True for k in _CONSUMED_KINDS}
+        # key -> rv signature, for the fallback re-list diff.
+        self._kind_sigs: dict = {k: {} for k in _CONSUMED_KINDS}
+        # Parsed templates for the hot kinds: name -> (rv_sig, template).
+        # Templates are immutable; snapshot() instantiates fresh
+        # per-cycle objects from them (the cycle mutates its instances).
+        self._node_tmpl: dict = {}
+        self._queue_tmpl: dict = {}
+        self._group_tmpl: dict = {}
+        # Aux parse caches per family, rebuilt only when dirty.
+        self._aux: dict = {}
+        self._aux_dirty: dict = {f: True for f in
+                                 ("topology", "dra", "configmap", "pvc",
+                                  "storage")}
+        # Dirty keys accumulated from the change stream; the emit-time
+        # hook may fire from ANY thread (async status workers patch
+        # through the same store), so the set is lock-guarded and the
+        # handler does nothing but record.
+        import threading
+        self._changes_lock = threading.Lock()
+        self._changed_keys: set = set()
+        self._primed = False
+        self._watch_mode = False
+        self.last_snapshot_stats: dict = {}
+        watch_sync = getattr(api, "watch_sync", None)
+        if watch_sync is not None:
+            import weakref
+            wref = weakref.ref(self)
+
+            def _change_cb(event_type, obj):
+                cache = wref()
+                if cache is None:
+                    return False  # cache replaced: deregister me
+                cache._note_change(event_type, obj)
+                return True
+
+            watch_sync(_change_cb)
+            self._watch_mode = True
+        # Per-pod view signatures: uid -> (rv, node_name, vocab) for pods
+        # in the scheduled view — the arena's pod-level dirty source.
+        self._pod_sigs: dict = {}
         # In-memory pipelined assignments surviving between cycles
         # (Cache.TaskPipelined): pod uid -> (node, gpu_group).
         self._pipelined: dict = {}
@@ -476,6 +586,260 @@ class ClusterCache:
         rv = obj.get("metadata", {}).get("resourceVersion")
         return rv if rv is not None else object()
 
+    # -- incremental store maintenance ---------------------------------------
+    def _note_change(self, event_type: str, obj: dict) -> None:
+        """Emit-time change hook (ANY thread): record the key, nothing
+        else — snapshot() re-reads authoritative state on its own
+        thread."""
+        kind = obj.get("kind")
+        if kind not in _CONSUMED_KINDS:
+            return
+        md = obj.get("metadata", {})
+        with self._changes_lock:
+            self._changed_keys.add(
+                (kind, md.get("namespace", "default"), md.get("name")))
+
+    def _wholesale_invalidate(self) -> None:
+        """Watch resync: an unknown stretch of events was missed — every
+        mirror, template, and parse cache rebuilds from scratch."""
+        self._mirror = {k: {} for k in _CONSUMED_KINDS}
+        self._order = {k: [] for k in _CONSUMED_KINDS}
+        self._order_stale = {k: True for k in _CONSUMED_KINDS}
+        self._kind_sigs = {k: {} for k in _CONSUMED_KINDS}
+        self._node_tmpl = {}
+        self._queue_tmpl = {}
+        self._group_tmpl = {}
+        self._aux = {}
+        self._aux_dirty = {f: True for f in self._aux_dirty}
+        self._pod_cache = {}
+        with self._changes_lock:
+            self._changed_keys = set()
+        self._primed = False
+
+    def _take_changes(self) -> set:
+        with self._changes_lock:
+            changes, self._changed_keys = self._changed_keys, set()
+        return changes
+
+    def _apply_changes(self, changes: set) -> dict:
+        """Fold accumulated dirty keys into the mirrors (watch mode).
+        Returns per-kind changed counts.  On ANY exception the whole
+        batch is re-queued (folding is idempotent): a half-applied delta
+        must not vanish — an object it carried would stay invisible to
+        scheduling until the next resync."""
+        changed = {k: 0 for k in _HOT_KINDS}
+        try:
+            for kind, ns, name in changes:
+                key = (ns, name)
+                mirror = self._mirror[kind]
+                obj = self.api.get_opt(kind, name, ns)
+                if obj is None:
+                    if mirror.pop(key, None) is None:
+                        continue  # created+deleted between snapshots
+                    self._kind_sigs[kind].pop(key, None)
+                    self._order_stale[kind] = True
+                    self._drop_template(kind, name)
+                else:
+                    sig = self._sig_rv(obj)
+                    if key in mirror \
+                            and self._kind_sigs[kind].get(key) == sig:
+                        # Duplicate dirty mark (e.g. queued during the
+                        # priming list): state already folded — counting
+                        # it would force a spurious arena rebuild.
+                        continue
+                    if key not in mirror:
+                        self._order_stale[kind] = True
+                    mirror[key] = obj
+                    self._kind_sigs[kind][key] = sig
+                if kind in changed:
+                    changed[kind] += 1
+                else:
+                    for family in _AUX_FAMILIES[kind]:
+                        self._aux_dirty[family] = True
+        except BaseException:
+            with self._changes_lock:
+                self._changed_keys |= changes
+            raise
+        return changed
+
+    def _drop_template(self, kind: str, name: str) -> None:
+        """Retire the parse template of a deleted object (the per-cycle
+        builds also prune on shrink, but equal-count churn — one delete
+        plus one add per cycle — would otherwise never trigger it)."""
+        if kind == "Node":
+            self._node_tmpl.pop(name, None)
+        elif kind == "Queue":
+            self._queue_tmpl.pop(name, None)
+        elif kind == "PodGroup":
+            self._group_tmpl.pop(name, None)
+
+    def _refresh_full(self) -> dict:
+        """Fallback / priming path: re-list every consumed kind and diff
+        resourceVersions.  The parse templates still memoize, so even
+        this path never re-parses an unchanged manifest."""
+        METRICS.inc("cluster_cache_full_refresh_total")
+        changed = {k: 0 for k in _HOT_KINDS}
+        for kind in _CONSUMED_KINDS:
+            sigs = {}
+            mirror = {}
+            n_changed = 0
+            old_sigs = self._kind_sigs[kind]
+            for obj in self.api.list(kind):
+                md = obj.get("metadata", {})
+                key = (md.get("namespace", "default"), md.get("name"))
+                sig = self._sig_rv(obj)
+                mirror[key] = obj
+                sigs[key] = sig
+                if old_sigs.get(key) != sig:
+                    n_changed += 1
+            n_changed += sum(1 for key in old_sigs if key not in sigs)
+            for key in old_sigs:
+                if key not in sigs:
+                    self._drop_template(kind, key[1])
+            if mirror.keys() != self._mirror[kind].keys():
+                self._order_stale[kind] = True
+            self._mirror[kind] = mirror
+            self._kind_sigs[kind] = sigs
+            if n_changed:
+                if kind in changed:
+                    changed[kind] = n_changed
+                else:
+                    for family in _AUX_FAMILIES[kind]:
+                        self._aux_dirty[family] = True
+        return changed
+
+    def _iter_order(self, kind: str) -> list:
+        """Mirror keys in api.list order (sorted by name), cached until
+        the kind's membership changes."""
+        if self._order_stale[kind]:
+            self._order[kind] = sorted(self._mirror[kind],
+                                       key=lambda key: key[1])
+            self._order_stale[kind] = False
+        return self._order[kind]
+
+    # -- parse layers (template-memoized) ------------------------------------
+    def _parse_node(self, n: dict) -> NodeInfo:
+        spec = n.get("status", {}).get("allocatable", {})
+        gpu_mem = n.get("metadata", {}).get("annotations", {}).get(
+            "nvidia.com/gpu.memory")
+        return NodeInfo(
+            n["metadata"]["name"],
+            rs.vec_from_spec(spec.get("cpu", "0"),
+                             spec.get("memory", "0"),
+                             float(spec.get("nvidia.com/gpu", 0))),
+            labels=n.get("metadata", {}).get("labels", {}),
+            taints={t["key"] for t in n.get("spec", {}).get(
+                "taints", [])},
+            gpu_memory_per_device=rs.parse_memory(gpu_mem)
+            if gpu_mem else 16 * 2 ** 30,
+            max_pods=int(spec.get("pods", 110)),
+            mig_capacity={k: float(v) for k, v in spec.items()
+                          if k.startswith("nvidia.com/mig-")})
+
+    def _build_nodes(self) -> dict:
+        mirror = self._mirror["Node"]
+        tmpls = self._node_tmpl
+        nodes = {}
+        for key in self._iter_order("Node"):
+            n = mirror[key]
+            name = n["metadata"]["name"]
+            sig = self._sig_rv(n)
+            ent = tmpls.get(name)
+            if ent is None or ent[0] != sig:
+                tmpls[name] = ent = (sig, self._parse_node(n))
+            nodes[name] = ent[1].instantiate()
+        if len(tmpls) > len(nodes):
+            self._node_tmpl = {name: ent for name, ent in tmpls.items()
+                               if name in nodes}
+        return nodes
+
+    def _parse_queue(self, q: dict) -> QueueInfo:
+        spec = q.get("spec", {})
+        return QueueInfo(
+            q["metadata"]["name"],
+            parent=spec.get("parentQueue"),
+            priority=spec.get("priority", 0),
+            creation_ts=float(q["metadata"].get("creationTimestamp",
+                                                0) or 0),
+            quota=QueueQuota.from_spec(
+                deserved=_quota_vec(spec.get("deserved")),
+                limit=_quota_vec(spec.get("limit")),
+                over_quota_weight=spec.get("overQuotaWeight", 1.0)),
+            preempt_min_runtime=spec.get("preemptMinRuntime"),
+            reclaim_min_runtime=spec.get("reclaimMinRuntime"))
+
+    def _build_queues(self) -> dict:
+        mirror = self._mirror["Queue"]
+        tmpls = self._queue_tmpl
+        queues = {}
+        for key in self._iter_order("Queue"):
+            q = mirror[key]
+            name = q["metadata"]["name"]
+            sig = self._sig_rv(q)
+            ent = tmpls.get(name)
+            if ent is None or ent[0] != sig:
+                tmpls[name] = ent = (sig, self._parse_queue(q))
+            t = ent[1]
+            # Per-cycle instance: quota arrays copied (plugins may divide
+            # in place), children rebuilt below.
+            queues[name] = QueueInfo(
+                t.uid, t.name, t.parent, [], t.priority, t.creation_ts,
+                QueueQuota(t.quota.deserved.copy(), t.quota.limit.copy(),
+                           t.quota.over_quota_weight.copy()),
+                t.preempt_min_runtime, t.reclaim_min_runtime)
+        if len(tmpls) > len(queues):
+            self._queue_tmpl = {name: ent for name, ent in tmpls.items()
+                                if name in queues}
+        for name, q in queues.items():
+            if q.parent and q.parent in queues \
+                    and name not in queues[q.parent].children:
+                queues[q.parent].children.append(name)
+        return queues
+
+    def _parse_group(self, pg_obj: dict) -> _GroupTmpl:
+        spec = pg_obj.get("spec", {})
+        topo = spec.get("topology") or {}
+        t = _GroupTmpl()
+        t.name = pg_obj["metadata"]["name"]
+        t.namespace = pg_obj["metadata"].get("namespace", "default")
+        t.queue_id = spec.get("queue", "default")
+        t.priority = spec.get("priority", 50)
+        t.min_available = spec.get("minMember", 1)
+        t.preemptible = spec.get("preemptible", True)
+        t.creation_ts = float(pg_obj["metadata"].get(
+            "creationTimestamp", 0) or 0)
+        t.topology_name = topo.get("name")
+        t.required_topology_level = topo.get("required")
+        t.preferred_topology_level = topo.get("preferred")
+        t.pod_sets = tuple(
+            (ps["name"], ps["minAvailable"],
+             (ps.get("topology") or {}).get("name"),
+             (ps.get("topology") or {}).get("required"),
+             (ps.get("topology") or {}).get("preferred"))
+            for ps in spec.get("podSets") or [])
+        t.last_start_ts = pg_obj.get("status", {}).get(
+            "lastStartTimestamp")
+        t.node_pool = pg_obj["metadata"].get("labels", {}).get(
+            "kai.scheduler/node-pool")
+        return t
+
+    def _build_groups(self) -> dict:
+        mirror = self._mirror["PodGroup"]
+        tmpls = self._group_tmpl
+        podgroups: dict[str, PodGroupInfo] = {}
+        for key in self._iter_order("PodGroup"):
+            pg_obj = mirror[key]
+            name = pg_obj["metadata"]["name"]
+            sig = self._sig_rv(pg_obj)
+            ent = tmpls.get(name)
+            if ent is None or ent[0] != sig:
+                tmpls[name] = ent = (sig, self._parse_group(pg_obj))
+            podgroups[name] = ent[1].instantiate()
+        if len(tmpls) > len(podgroups):
+            self._group_tmpl = {name: ent for name, ent in tmpls.items()
+                                if name in podgroups}
+        return podgroups
+
     def snapshot(self) -> ClusterInfo:
         arena = self.arena
         if self._resync_pending:
@@ -483,111 +847,44 @@ class ClusterCache:
             # rebind, don't clear() — the watch thread may set the flag
             # again concurrently, which the NEXT snapshot then honors.
             # A resync means an unknown stretch of events was missed:
-            # the arena (packed arrays AND device residency) invalidates
-            # wholesale along with the pod parse cache.
+            # the incremental store AND the arena (packed arrays, device
+            # residency) invalidate wholesale along with the pod parse
+            # cache.
             self._resync_pending = False
-            self._pod_cache = {}
+            self._wholesale_invalidate()
             arena.invalidate("watch-resync")
-        nodes = {}
-        node_sigs = {}
-        for n in self.api.list("Node"):
-            node_sigs[n["metadata"]["name"]] = self._sig_rv(n)
-            spec = n.get("status", {}).get("allocatable", {})
-            gpu_mem = n.get("metadata", {}).get("annotations", {}).get(
-                "nvidia.com/gpu.memory")
-            nodes[n["metadata"]["name"]] = NodeInfo(
-                n["metadata"]["name"],
-                rs.vec_from_spec(spec.get("cpu", "0"),
-                                 spec.get("memory", "0"),
-                                 float(spec.get("nvidia.com/gpu", 0))),
-                labels=n.get("metadata", {}).get("labels", {}),
-                taints={t["key"] for t in n.get("spec", {}).get(
-                    "taints", [])},
-                gpu_memory_per_device=rs.parse_memory(gpu_mem)
-                if gpu_mem else 16 * 2 ** 30,
-                max_pods=int(spec.get("pods", 110)),
-                mig_capacity={k: float(v) for k, v in spec.items()
-                              if k.startswith("nvidia.com/mig-")})
-
-        if node_sigs != self._node_sigs:
+        if self._watch_mode and self._primed:
+            changed = self._apply_changes(self._take_changes())
+        else:
+            # The full refresh subsumes every change marked so far:
+            # discard the backlog FIRST (keys marked while the listing
+            # runs stay queued for the next snapshot), or the first
+            # delta snapshot after priming would see the whole setup
+            # history as dirty and force a spurious full rebuild.
+            self._take_changes()
+            changed = self._refresh_full()
+            self._primed = True
+        if changed["Node"]:
             # Any Node add/remove/modify is a topology-class change: the
             # static arrays, label/taint codec, and node axis may all
             # shift — rebuild from scratch (the steady-state contract is
             # that this never fires without real node churn).
             arena.note_full("node-change")
-        self._node_sigs = node_sigs
-
-        queues = {}
-        queue_sigs = {}
-        for q in self.api.list("Queue"):
-            queue_sigs[q["metadata"]["name"]] = self._sig_rv(q)
-            spec = q.get("spec", {})
-            queues[q["metadata"]["name"]] = QueueInfo(
-                q["metadata"]["name"],
-                parent=spec.get("parentQueue"),
-                priority=spec.get("priority", 0),
-                creation_ts=float(q["metadata"].get("creationTimestamp",
-                                                    0) or 0),
-                quota=QueueQuota.from_spec(
-                    deserved=_quota_vec(spec.get("deserved")),
-                    limit=_quota_vec(spec.get("limit")),
-                    over_quota_weight=spec.get("overQuotaWeight", 1.0)),
-                preempt_min_runtime=spec.get("preemptMinRuntime"),
-                reclaim_min_runtime=spec.get("reclaimMinRuntime"))
-        for name, q in queues.items():
-            if q.parent and name not in queues.get(q.parent, QueueInfo(
-                    q.parent)).children:
-                if q.parent in queues:
-                    queues[q.parent].children.append(name)
-
-        if queue_sigs != self._queue_sigs:
+        if changed["Queue"]:
             arena.note_tasks()  # queue arrays (and job gating) rebuild
-        self._queue_sigs = queue_sigs
-
-        podgroups: dict[str, PodGroupInfo] = {}
-        group_sigs = {}
-        for pg_obj in self.api.list("PodGroup"):
-            group_sigs[pg_obj["metadata"]["name"]] = self._sig_rv(pg_obj)
-            spec = pg_obj.get("spec", {})
-            name = pg_obj["metadata"]["name"]
-            topo = spec.get("topology") or {}
-            pg = PodGroupInfo(
-                name, name,
-                namespace=pg_obj["metadata"].get("namespace", "default"),
-                queue_id=spec.get("queue", "default"),
-                priority=spec.get("priority", 50),
-                min_available=spec.get("minMember", 1),
-                preemptible=spec.get("preemptible", True),
-                creation_ts=float(pg_obj["metadata"].get(
-                    "creationTimestamp", 0) or 0),
-                topology_name=topo.get("name"),
-                required_topology_level=topo.get("required"),
-                preferred_topology_level=topo.get("preferred"))
-            pod_sets = spec.get("podSets") or []
-            if pod_sets:
-                pg.set_pod_sets([
-                    PodSet(ps["name"], ps["minAvailable"],
-                           topology_name=(ps.get("topology") or {}).get(
-                               "name"),
-                           required_topology_level=(
-                               ps.get("topology") or {}).get("required"),
-                           preferred_topology_level=(
-                               ps.get("topology") or {}).get("preferred"))
-                    for ps in pod_sets])
-            pg.last_start_ts = pg_obj.get("status", {}).get(
-                "lastStartTimestamp")
-            pg.node_pool = pg_obj["metadata"].get("labels", {}).get(
-                "kai.scheduler/node-pool")
-            podgroups[name] = pg
-
-        if group_sigs != self._group_sigs:
+        if changed["PodGroup"]:
             arena.note_tasks()  # job arrays / candidate sets rebuild
-        self._group_sigs = group_sigs
+
+        nodes = self._build_nodes()
+        queues = self._build_queues()
+        podgroups = self._build_groups()
 
         seen_uids = set()
         cache_seen = set()
         pod_sigs: dict = {}
-        for pod in self.api.list("Pod"):
+        pod_mirror = self._mirror["Pod"]
+        for pod_key in self._iter_order("Pod"):
+            pod = pod_mirror[pod_key]
             group = pod["metadata"].get("labels", {}).get(POD_GROUP_LABEL)
             if not group or group not in podgroups:
                 continue
@@ -647,84 +944,28 @@ class ClusterCache:
         self._pod_cache = {uid: v for uid, v in self._pod_cache.items()
                            if uid in cache_seen}
 
-        topologies = {}
-        for topo in self.api.list("Topology"):
-            topologies[topo["metadata"]["name"]] = {
-                "levels": [lvl["nodeLabel"] for lvl in
-                           topo.get("spec", {}).get("levels", [])]}
+        aux = self._build_aux()
 
-        # DRA objects: structured claims + per-node device inventory
-        # (the upstream DRA manager's ResourceClaim/ResourceSlice views).
-        resource_claims = {}
-        for rc in self.api.list("ResourceClaim"):
-            spec = rc.get("spec", {})
-            device_reqs = (spec.get("devices") or {}).get("requests") \
-                or [{}]
-            alloc = rc.get("status", {}).get("allocation")
-            resource_claims[rc["metadata"]["name"]] = {
-                # Every device request (multi-class claims supported).
-                "requests": [
-                    {"device_class": r.get("deviceClassName", ""),
-                     "count": int(r.get("count", 1)),
-                     "selectors": self._audit_device_selectors(
-                         "ResourceClaim/"
-                         f"{rc['metadata'].get('namespace', 'default')}/"
-                         f"{rc['metadata']['name']}",
-                         _parse_device_selectors(r.get("selectors")))}
-                    for r in device_reqs],
-                # Legacy single-request view kept for older callers.
-                "device_class": device_reqs[0].get("deviceClassName", ""),
-                "count": int(device_reqs[0].get("count", 1)),
-                "allocation": alloc,
-                "allocated": bool(alloc),
-                "node": (alloc or {}).get("node"),
-            }
-        resource_slices: dict = {}
-        for sl in self.api.list("ResourceSlice"):
-            spec = sl.get("spec", {})
-            node = spec.get("nodeName")
-            if not node:
-                continue
-            per_node = resource_slices.setdefault(node, {})
-            driver = spec.get("driver")
-            for dev in spec.get("devices") or []:
-                cls = dev.get("deviceClassName", "")
-                attrs = _parse_device_attributes(dev)
-                caps = _parse_device_capacity(dev)
-                if driver:
-                    # The slice's driver is addressable from CEL
-                    # (device.driver == "...").
-                    attrs.setdefault("driver", driver)
-                entry = ({"name": dev.get("name", ""),
-                          "attributes": attrs, "capacity": caps}
-                         if attrs or caps else dev.get("name", ""))
-                per_node.setdefault(cls, []).append(entry)
-        device_classes = {
-            dc["metadata"]["name"]: {
-                "selectors": self._audit_device_selectors(
-                    f"DeviceClass/{dc['metadata']['name']}",
-                    _parse_device_selectors(
-                        dc.get("spec", {}).get("selectors")))}
-            for dc in self.api.list("DeviceClass")}
-
-        config_maps = {
-            (cm["metadata"].get("namespace", "default"),
-             cm["metadata"]["name"])
-            for cm in self.api.list("ConfigMap")}
-        pvc_objs = self.api.list("PersistentVolumeClaim")
-        pvcs = {}
-        for pvc in pvc_objs:
-            md = pvc["metadata"]
-            pvcs[(md.get("namespace", "default"), md["name"])] = {
-                "bound_node": md.get("annotations", {}).get(
-                    "volume.kubernetes.io/selected-node")}
-
-        # Schedule-time CSI storage (storage.go snapshot* chain).
-        from ..api.storage_info import build_storage_snapshot
-        storage_classes, storage_claims, storage_capacities = \
-            build_storage_snapshot(
-                self.api.list("CSIDriver"), self.api.list("StorageClass"),
-                pvc_objs, self.api.list("CSIStorageCapacity"))
+        # Per-cycle views of the aux caches, at exactly the copy depths
+        # ClusterInfo.clone() uses (sessions mutate these containers the
+        # same way they mutate a clone's).
+        topologies = dict(aux["topologies"])
+        resource_claims = {k: dict(v)
+                           for k, v in aux["resource_claims"].items()}
+        resource_slices = {n: {c: list(d) for c, d in by_class.items()}
+                           for n, by_class in
+                           aux["resource_slices"].items()}
+        device_classes = dict(aux["device_classes"])
+        config_maps = set(aux["config_maps"])
+        pvcs = {k: dict(v) for k, v in aux["pvcs"].items()}
+        storage_classes = dict(aux["storage_classes"])
+        storage_claims = {k: c.clone()
+                          for k, c in aux["storage_claims"].items()}
+        storage_capacities = {}
+        for uid, cap in aux["storage_capacities"].items():
+            cc = cap.clone()
+            cc.provisioned_pvcs = {}  # re-derived by linking + add_task
+            storage_capacities[uid] = cc
 
         cluster = ClusterInfo(nodes, podgroups, queues, topologies,
                               now=self.now_fn(),
@@ -739,7 +980,121 @@ class ClusterCache:
         # older ClusterInfo (or one filtered by a shard provider) packs
         # from scratch.
         arena.stamp(cluster)
+        n_dirty = sum(changed.values())
+        METRICS.set_gauge("snapshot_dirty_objects", n_dirty)
+        self.last_snapshot_stats = {
+            "watch_mode": self._watch_mode,
+            "dirty": dict(changed),
+            "store": {"nodes": len(nodes), "queues": len(queues),
+                      "podgroups": len(podgroups),
+                      "pods": len(self._mirror["Pod"])},
+        }
+        cluster.cache_stats = self.last_snapshot_stats
         return cluster
+
+    def _build_aux(self) -> dict:
+        """Rebuild the aux parse caches whose family saw changes; serve
+        everything else from the previous build."""
+        aux = self._aux
+        if self._aux_dirty["topology"]:
+            aux["topologies"] = {
+                topo["metadata"]["name"]: {
+                    "levels": [lvl["nodeLabel"] for lvl in
+                               topo.get("spec", {}).get("levels", [])]}
+                for topo in self._mirror["Topology"].values()}
+            self._aux_dirty["topology"] = False
+        if self._aux_dirty["dra"]:
+            # DRA objects: structured claims + per-node device inventory
+            # (the upstream DRA manager's ResourceClaim/ResourceSlice
+            # views).
+            resource_claims = {}
+            for rc in self._mirror["ResourceClaim"].values():
+                spec = rc.get("spec", {})
+                device_reqs = (spec.get("devices") or {}).get("requests") \
+                    or [{}]
+                alloc = rc.get("status", {}).get("allocation")
+                resource_claims[rc["metadata"]["name"]] = {
+                    # Every device request (multi-class claims supported).
+                    "requests": [
+                        {"device_class": r.get("deviceClassName", ""),
+                         "count": int(r.get("count", 1)),
+                         "selectors": self._audit_device_selectors(
+                             "ResourceClaim/"
+                             f"{rc['metadata'].get('namespace', 'default')}"
+                             f"/{rc['metadata']['name']}",
+                             _parse_device_selectors(r.get("selectors")))}
+                        for r in device_reqs],
+                    # Legacy single-request view kept for older callers.
+                    "device_class": device_reqs[0].get("deviceClassName",
+                                                       ""),
+                    "count": int(device_reqs[0].get("count", 1)),
+                    "allocation": alloc,
+                    "allocated": bool(alloc),
+                    "node": (alloc or {}).get("node"),
+                }
+            aux["resource_claims"] = resource_claims
+            resource_slices: dict = {}
+            for sl in self._mirror["ResourceSlice"].values():
+                spec = sl.get("spec", {})
+                node = spec.get("nodeName")
+                if not node:
+                    continue
+                per_node = resource_slices.setdefault(node, {})
+                driver = spec.get("driver")
+                for dev in spec.get("devices") or []:
+                    cls = dev.get("deviceClassName", "")
+                    attrs = _parse_device_attributes(dev)
+                    caps = _parse_device_capacity(dev)
+                    if driver:
+                        # The slice's driver is addressable from CEL
+                        # (device.driver == "...").
+                        attrs.setdefault("driver", driver)
+                    entry = ({"name": dev.get("name", ""),
+                              "attributes": attrs, "capacity": caps}
+                             if attrs or caps else dev.get("name", ""))
+                    per_node.setdefault(cls, []).append(entry)
+            aux["resource_slices"] = resource_slices
+            aux["device_classes"] = {
+                dc["metadata"]["name"]: {
+                    "selectors": self._audit_device_selectors(
+                        f"DeviceClass/{dc['metadata']['name']}",
+                        _parse_device_selectors(
+                            dc.get("spec", {}).get("selectors")))}
+                for dc in self._mirror["DeviceClass"].values()}
+            self._aux_dirty["dra"] = False
+        if self._aux_dirty["configmap"]:
+            aux["config_maps"] = {
+                (cm["metadata"].get("namespace", "default"),
+                 cm["metadata"]["name"])
+                for cm in self._mirror["ConfigMap"].values()}
+            self._aux_dirty["configmap"] = False
+        if self._aux_dirty["pvc"]:
+            pvcs = {}
+            for pvc in self._mirror["PersistentVolumeClaim"].values():
+                md = pvc["metadata"]
+                pvcs[(md.get("namespace", "default"), md["name"])] = {
+                    "bound_node": md.get("annotations", {}).get(
+                        "volume.kubernetes.io/selected-node")}
+            aux["pvcs"] = pvcs
+            self._aux_dirty["pvc"] = False
+        if self._aux_dirty["storage"]:
+            # Schedule-time CSI storage (storage.go snapshot* chain).
+            # The built infos are TEMPLATES: snapshot() clones them per
+            # cycle before linking, because linking/placement mutates
+            # them (provisioned_pvcs, reprovision flags).
+            from ..api.storage_info import build_storage_snapshot
+
+            def listed(kind):
+                return sorted(self._mirror[kind].values(),
+                              key=lambda o: o["metadata"]["name"])
+
+            (aux["storage_classes"], aux["storage_claims"],
+             aux["storage_capacities"]) = build_storage_snapshot(
+                listed("CSIDriver"), listed("StorageClass"),
+                listed("PersistentVolumeClaim"),
+                listed("CSIStorageCapacity"))
+            self._aux_dirty["storage"] = False
+        return aux
 
     # -- side-effect executor (framework Session cache interface) ------------
     def bind(self, task, node_name: str, bind_request) -> None:

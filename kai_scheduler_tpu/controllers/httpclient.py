@@ -54,6 +54,10 @@ class HTTPKubeAPI:
         self._watchers: dict[str, list[Callable]] = defaultdict(list)
         self._pending: list[tuple] = []
         self._pending_lock = threading.Lock()
+        # Drain-idle hooks (InMemoryKubeAPI parity): run when drain()'s
+        # queue empties so coalescing controllers (podgrouper/binder)
+        # process their batches before drain returns.
+        self._idle_hooks: list[Callable] = []
         # Keys observed via watch events; used to synthesize DELETED when
         # a GONE re-list shows an object vanished while we were away (an
         # informer diffs its store the same way).
@@ -329,6 +333,12 @@ class HTTPKubeAPI:
                 self._resync_callbacks = [
                     cb for cb in self._resync_callbacks if cb not in dead]
 
+    def on_drain_idle(self, callback: Callable) -> None:
+        """Register a callback run when drain()'s event queue empties
+        (before it returns); return truthy when work was done — the
+        drain loop continues until every hook reports idle."""
+        self._idle_hooks.append(callback)
+
     def drain(self, max_rounds: int = 100) -> int:
         """Deliver queued watch events to handlers on this thread."""
         delivered = 0
@@ -336,7 +346,14 @@ class HTTPKubeAPI:
             with self._pending_lock:
                 batch, self._pending = self._pending, []
             if not batch:
-                break
+                worked = False
+                for cb in list(self._idle_hooks):
+                    worked = bool(cb()) or worked
+                if not worked:
+                    with self._pending_lock:
+                        if not self._pending:
+                            break
+                continue
             for event_type, obj in batch:
                 for handler in list(self._watchers.get(obj["kind"], ())):
                     handler(event_type, obj)

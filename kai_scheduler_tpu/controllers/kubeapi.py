@@ -52,6 +52,18 @@ class InMemoryKubeAPI:
         self._rv = itertools.count(1)
         self._watchers: dict[str, list[Callable]] = defaultdict(list)
         self._pending: list[tuple] = []  # (event_type, obj) queue
+        # Synchronous change subscribers, invoked at EMIT time (not at
+        # drain): the incremental ClusterCache marks objects dirty the
+        # instant they mutate, so a snapshot taken without an intervening
+        # drain() still sees every change — the store IS the materialized
+        # watch stream, and this hook is its zero-lag tap.
+        self._sync_watchers: list[Callable] = []
+        # Drain-idle hooks: run when the event queue empties, before
+        # drain() returns.  Controllers that coalesce events (podgrouper
+        # owner batching, binder request batching) process their pending
+        # queues here; work they produce re-enters the delivery loop, so
+        # drain() still returns only at full quiescence.
+        self._idle_hooks: list[Callable] = []
 
     # -- fencing -----------------------------------------------------------
     def check_fence(self, epoch: int | None, fence: str | None) -> None:
@@ -165,16 +177,42 @@ class InMemoryKubeAPI:
         except ValueError:
             pass
 
+    def watch_sync(self, handler: Callable) -> None:
+        """handler(event_type, obj) invoked synchronously at emit time,
+        on whatever thread performed the mutation.  Handlers MUST be
+        cheap (mark-dirty only) and may return False to deregister
+        (weakref-dead caches of rebuilt shards prune themselves so)."""
+        self._sync_watchers.append(handler)
+
+    def on_drain_idle(self, callback: Callable) -> None:
+        """Register a callback run when drain()'s event queue empties
+        (and before it returns).  Return truthy when work was done —
+        the drain loop keeps going until every hook reports idle."""
+        self._idle_hooks.append(callback)
+
     def _emit(self, event_type: str, obj: dict) -> None:
         self._pending.append((event_type, obj))
+        if self._sync_watchers:
+            dead = [h for h in self._sync_watchers
+                    if h(event_type, obj) is False]
+            if dead:
+                self._sync_watchers = [h for h in self._sync_watchers
+                                       if h not in dead]
 
     def drain(self, max_rounds: int = 100) -> int:
         """Deliver queued events until quiescent (reconcilers may create
-        new objects while handling events).  Returns events delivered."""
+        new objects while handling events).  Returns events delivered.
+        When the queue empties, drain-idle hooks run; work they enqueue
+        (coalesced grouping/binding batches) continues the loop."""
         delivered = 0
         for _ in range(max_rounds):
             if not self._pending:
-                break
+                worked = False
+                for cb in list(self._idle_hooks):
+                    worked = bool(cb()) or worked
+                if not worked and not self._pending:
+                    break
+                continue
             batch, self._pending = self._pending, []
             for event_type, obj in batch:
                 for handler in list(self._watchers.get(obj["kind"], ())):

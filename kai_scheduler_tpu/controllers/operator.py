@@ -75,10 +75,12 @@ class System:
         self.podgrouper = PodGrouper(self.api)
         self.podgroup_controller = PodGroupController(self.api, now_fn)
         self.queue_controller = QueueController(self.api)
-        self.binder = Binder(self.api)
-        self.scale_adjuster = NodeScaleAdjuster(self.api, now_fn)
         from .status_updater import AsyncStatusUpdater
         self.status_updater = AsyncStatusUpdater(self.api)
+        # BindRequest status writes dedupe through the async pool (the
+        # binder keeps its own terminal-phase view until they land).
+        self.binder = Binder(self.api, status_updater=self.status_updater)
+        self.scale_adjuster = NodeScaleAdjuster(self.api, now_fn)
         self.cache = ClusterCache(self.api, now_fn,
                                   status_updater=self.status_updater)
         self._now_fn = now_fn

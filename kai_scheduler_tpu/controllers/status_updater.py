@@ -20,18 +20,52 @@ log = ScopedLogger("status-updater")
 
 
 class AsyncStatusUpdater:
+    # Tombstone bound: cleared wholesale on overflow — losing one only
+    # costs a doomed (but harmless) write attempt.
+    GONE_CAP = 8192
+
     def __init__(self, api, num_workers: int = 4):
         self.api = api
-        self._queue: "queue.Queue" = queue.Queue()
+        # One queue PER worker, keys sharded by hash: all writes for one
+        # object apply on one thread in FIFO order.  A single shared
+        # queue let two workers apply two generations of the same key
+        # out of order (an older payload popped before a newer one could
+        # finish applying after it, reverting the object's status).
+        self._queues: list = [queue.Queue() for _ in range(num_workers)]
         self._inflight: dict = {}     # key -> latest payload (dedup)
         self._lock = threading.RLock()
         self._stop = threading.Event()
+        # (kind, ns, name) of objects that vanished while a patch for
+        # them sat in the queue: the worker drops those writes instead
+        # of paying a doomed API round trip (stale_write_skipped_total).
+        self._gone: set = set()
+        watch = getattr(api, "watch", None)
+        if watch is not None:
+            for kind in ("PodGroup", "BindRequest"):
+                watch(kind, self._on_watch)
         self._workers = [
-            threading.Thread(target=self._worker, daemon=True,
+            threading.Thread(target=self._worker, args=(i,), daemon=True,
                              name=f"status-updater-{i}")
             for i in range(num_workers)]
         for w in self._workers:
             w.start()
+
+    def _shard(self, key) -> "queue.Queue":
+        return self._queues[hash(key) % len(self._queues)]
+
+    def _on_watch(self, event_type: str, obj: dict) -> None:
+        """Tombstone deleted (or deleting) objects; an ADDED event for a
+        reused name lifts the tombstone."""
+        md = obj.get("metadata", {})
+        key = (obj.get("kind"), md.get("namespace", "default"),
+               md.get("name"))
+        with self._lock:
+            if event_type == "DELETED" or md.get("deletionTimestamp"):
+                if len(self._gone) >= self.GONE_CAP:
+                    self._gone.clear()
+                self._gone.add(key)
+            elif self._gone:
+                self._gone.discard(key)
 
     # -- enqueue -----------------------------------------------------------
     def patch_status(self, kind: str, name: str, namespace: str,
@@ -50,7 +84,7 @@ class AsyncStatusUpdater:
             fresh = key not in self._inflight
             self._inflight[key] = status_patch
         if fresh:
-            self._queue.put(key)
+            self._shard(key).put(key)
 
     def record_event(self, reason: str, message: str,
                      about: tuple | None = None,
@@ -66,19 +100,26 @@ class AsyncStatusUpdater:
                 return
             self._inflight[key] = {"reason": reason, "message": message,
                                    "about": about, "trace_id": trace_id}
-        self._queue.put(key)
+        self._shard(key).put(key)
 
     # -- workers -----------------------------------------------------------
-    def _worker(self) -> None:
+    def _worker(self, idx: int) -> None:
+        my_queue = self._queues[idx]
         while not self._stop.is_set():
             try:
-                key = self._queue.get(timeout=0.1)
+                key = my_queue.get(timeout=0.1)
             except queue.Empty:
                 continue
             try:
                 with self._lock:
                     payload = self._inflight.pop(key, None)
+                    gone = key in self._gone
                 if payload is None:
+                    continue
+                if gone:
+                    # The object vanished while this patch was queued:
+                    # the write is doomed — drop it, loudly counted.
+                    METRICS.inc("stale_write_skipped_total")
                     continue
                 if key[0] == "Event":
                     self.api.create({
@@ -101,11 +142,12 @@ class AsyncStatusUpdater:
                 log.v(2).info("status write for %s dropped (%s: %s)",
                               key, type(exc).__name__, exc)
             finally:
-                self._queue.task_done()
+                my_queue.task_done()
 
     def flush(self, timeout: float = 5.0) -> None:
         """Wait for queued work to drain (tests / shutdown)."""
-        self._queue.join()
+        for q in self._queues:
+            q.join()
 
     def stop(self) -> None:
         self._stop.set()

@@ -65,6 +65,13 @@ class Scheduler:
                               queue_usage=usage)
                 snap_sp.set(nodes=len(cluster.nodes),
                             podgroups=len(cluster.podgroups))
+                cache_stats = getattr(cluster, "cache_stats", None)
+                if cache_stats:
+                    # Incremental ClusterInfo verdict: how many objects
+                    # the watch delta actually dirtied this cycle.
+                    snap_sp.set(
+                        dirty_objects=sum(cache_stats["dirty"].values()),
+                        watch_mode=cache_stats["watch_mode"])
                 if ssn.pack_stats:
                     # Arena pack verdict (delta vs full rebuild) on the
                     # cycle trace: /debug/trace shows per-cycle pack

@@ -173,6 +173,12 @@ def _make_handler(server_state):
                                 None)
                 if arena is not None:
                     payload["arena"] = arena.stats()
+                cache_stats = getattr(getattr(ssn, "cache", None),
+                                      "last_snapshot_stats", None)
+                if cache_stats:
+                    # Incremental host pipeline: last snapshot's dirty
+                    # counts, store sizes, and watch-delta mode.
+                    payload["incremental_cache"] = cache_stats
                 body = json.dumps(payload).encode()
                 ctype = "application/json"
             elif path == "/debug/trace":

@@ -40,6 +40,11 @@ ARENA_TESTS = ["tests/test_snapshot_delta.py"]
 # the timeline invariants (no leaked open phases, monotone stamps, new
 # attempt per resubmit) are asserted each iteration.
 LATENCY_TESTS = ["tests/test_lifecycle.py"]
+# --incremental: the incremental-ClusterInfo suite — fault seeds
+# reshuffle add/del/mod churn across every consumed kind, resync
+# boundaries, and fenced evicts while incremental-vs-full equivalence
+# (and identical allocate placements) is asserted at every step.
+INCREMENTAL_TESTS = ["tests/test_incremental_cache.py"]
 
 
 def run_iteration(seed: int, tests: list[str], marker: str,
@@ -98,6 +103,12 @@ def main(argv=None) -> int:
                          f"({LATENCY_TESTS}) — each seed reshuffles "
                          "watch-gap/backoff/abort interleavings while "
                          "the timeline invariants are asserted")
+    ap.add_argument("--incremental", action="store_true",
+                    help="incremental mode: sweep the incremental-"
+                         f"ClusterInfo suite ({INCREMENTAL_TESTS}) — "
+                         "each seed reshuffles churn/resync/fence "
+                         "interleavings while incremental-vs-full "
+                         "snapshot equivalence is asserted")
     ap.add_argument("-k", "--keyword", default=None,
                     help="pytest -k filter (narrow the smoke subset)")
     ap.add_argument("--marker", default="chaos",
@@ -121,9 +132,11 @@ def main(argv=None) -> int:
     if args.tests:
         tests = args.tests
     else:
-        # Modes compose: --arena --latency sweeps both suites per seed.
+        # Modes compose: --arena --latency --incremental sweeps every
+        # selected suite per seed.
         tests = (ARENA_TESTS if args.arena else []) + \
-            (LATENCY_TESTS if args.latency else [])
+            (LATENCY_TESTS if args.latency else []) + \
+            (INCREMENTAL_TESTS if args.incremental else [])
         if not tests:
             tests = DEFAULT_TESTS
     repo_root = os.path.dirname(os.path.dirname(os.path.dirname(

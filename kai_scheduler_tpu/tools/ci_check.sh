@@ -11,6 +11,10 @@
 #   chaos matrix  --dry-run validation of the fault-grid definition
 #   stackprof     continuous-profiler smoke: profile a short embedded
 #                 fleet burst, fail on an empty folded profile
+#   fleet budget  bench.py fleet phase at a small shape vs the committed
+#                 threshold file (docs/scale-tests/fleet_budget.json):
+#                 grouped/snapshotted phase medians, warm cycle, and the
+#                 incremental-cache structural gates must stay in budget
 #   tier-1 tests  pytest -m 'not slow' on CPU
 #
 # Usage: kai_scheduler_tpu/tools/ci_check.sh [--no-tests]
@@ -38,6 +42,11 @@ python -m kai_scheduler_tpu.tools.chaos_matrix --dry-run || fail=1
 echo
 echo "== stackprof smoke (profile a short fleet burst) =="
 JAX_PLATFORMS=cpu python -m kai_scheduler_tpu.utils.stackprof --smoke \
+    || fail=1
+
+echo
+echo "== fleet-phase budget (host-pipeline medians vs committed budget) =="
+JAX_PLATFORMS=cpu python -m kai_scheduler_tpu.tools.fleet_budget \
     || fail=1
 
 if [ "${1:-}" != "--no-tests" ]; then

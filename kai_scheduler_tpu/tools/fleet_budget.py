@@ -1,0 +1,97 @@
+"""Fleet-phase budget smoke: fail CI when the host pipeline rots.
+
+Runs ``bench.fleet_phase`` at a small committed shape and checks the
+result against ``docs/scale-tests/fleet_budget.json``:
+
+- **wall-clock budgets** (generous, noise-tolerant): ``grouped`` and
+  ``snapshotted`` phase medians and the warm cycle must stay under the
+  committed ceilings — the numbers the incremental host pipeline
+  (watch-delta ClusterInfo, owner-coalesced grouping, batched binds)
+  brought down must not silently creep back up;
+- **structural gates** (deterministic): the incremental cache must
+  actually run incrementally (``cluster_cache_full_refresh_total`` stays
+  at priming counts — a fallback-per-cycle regression multiplies it by
+  the cycle count) and the podgrouper's owner-resolution memo must see
+  hits.  Wall clocks flake with CI noise; these do not.
+
+Usage (ci_check.sh runs it):
+
+    JAX_PLATFORMS=cpu python -m kai_scheduler_tpu.tools.fleet_budget
+    ... --budget docs/scale-tests/fleet_budget.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser("kai-fleet-budget")
+    ap.add_argument("--budget", default=None,
+                    help="threshold file (default: "
+                         "docs/scale-tests/fleet_budget.json)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the measured result as JSON")
+    args = ap.parse_args(argv)
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    budget_path = args.budget or os.path.join(
+        repo_root, "docs", "scale-tests", "fleet_budget.json")
+    with open(budget_path) as f:
+        budget = json.load(f)
+
+    sys.path.insert(0, repo_root)
+    import bench
+    from kai_scheduler_tpu.utils.metrics import METRICS
+
+    shape = budget["shape"]
+    refresh0 = METRICS.counters.get("cluster_cache_full_refresh_total", 0)
+    result = bench.fleet_phase(shape["nodes"], shape["jobs"],
+                               shape["gang"])
+    refreshes = METRICS.counters.get(
+        "cluster_cache_full_refresh_total", 0) - refresh0
+    owner_hits = METRICS.counters.get("podgrouper_owner_cache_hits", 0)
+
+    medians = result.get("pod_latency", {}).get("phase_median_ms", {})
+    bound = result.get("pod_latency", {}).get("bound_pods", 0)
+    expect = shape["jobs"] * shape["gang"]
+    checks = [
+        ("bound_pods", bound, ">=", expect),
+        ("warm_cycle_s", result.get("warm_cycle_s"),
+         "<=", budget["max_warm_cycle_s"]),
+        ("grouped_median_ms", medians.get("grouped"),
+         "<=", budget["max_grouped_ms"]),
+        ("snapshotted_median_ms", medians.get("snapshotted"),
+         "<=", budget["max_snapshotted_ms"]),
+        ("cluster_cache_full_refreshes", refreshes,
+         "<=", budget["max_full_refreshes"]),
+        ("podgrouper_owner_cache_hits", owner_hits,
+         ">=", budget["min_owner_cache_hits"]),
+    ]
+
+    failed = []
+    for name, got, op, want in checks:
+        ok = (got is not None
+              and ((op == "<=" and got <= want)
+                   or (op == ">=" and got >= want)))
+        mark = "ok  " if ok else "FAIL"
+        print(f"{mark} {name:32s} {got!r:>12} {op} {want!r}")
+        if not ok:
+            failed.append(name)
+
+    if args.json:
+        print(json.dumps(result))
+    if failed:
+        print(f"fleet budget: FAILED ({', '.join(failed)}); the "
+              f"committed budget is {budget_path}")
+        return 1
+    print("fleet budget: within budget")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

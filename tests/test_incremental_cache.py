@@ -1,0 +1,513 @@
+"""Incremental ClusterInfo chaos suite (marker ``chaos``, tier-1).
+
+The incremental host pipeline (controllers/cache_builder.py) replaces the
+per-cycle re-list + re-parse with a persistent store maintained from
+watch deltas: long-lived Node/Queue/PodGroup/Pod parse templates patched
+as events land, instantiated per cycle.  Its correctness contract is the
+same as the arena's (tests/test_snapshot_delta.py): the incrementally
+maintained ``ClusterInfo`` must be EQUIVALENT to a from-scratch parse of
+the same store — packed tensors bit-identical, object fields equal — and
+scheduling on it must place identically, under any interleaving of
+cluster events, including watch resyncs mid-stream and fenced evicts.
+
+Seeded in the chaos-matrix style: ``KAI_FAULT_SEED`` shifts every
+sequence (tools/chaos_matrix.py --incremental replays the suite under
+many seeds) and composes with the per-test parametrized seed.
+"""
+
+import dataclasses
+import os
+
+import numpy as np
+import pytest
+
+from kai_scheduler_tpu.actions.allocate import AllocateAction
+from kai_scheduler_tpu.api.snapshot import pack
+from kai_scheduler_tpu.controllers import InMemoryKubeAPI
+from kai_scheduler_tpu.controllers.cache_builder import ClusterCache
+from kai_scheduler_tpu.controllers.kubeapi import Fenced, make_pod
+from kai_scheduler_tpu.controllers.podgrouper import POD_GROUP_LABEL
+from kai_scheduler_tpu.framework.conf import SchedulerConfig
+from kai_scheduler_tpu.framework.session import InMemoryCache, Session
+
+pytestmark = pytest.mark.chaos
+
+SWEEP_SEED = int(os.environ.get("KAI_FAULT_SEED", "0") or 0)
+
+
+def _node(api, name, gpu=8, labels=None):
+    api.create({"kind": "Node",
+                "metadata": {"name": name, "labels": dict(labels or {})},
+                "spec": {},
+                "status": {"allocatable": {"cpu": "32", "memory": "256Gi",
+                                           "nvidia.com/gpu": gpu,
+                                           "pods": 110}}})
+
+
+def _queue(api, name, deserved_gpu=None):
+    spec = {}
+    if deserved_gpu is not None:
+        spec["deserved"] = {"gpu": deserved_gpu}
+    api.create({"kind": "Queue", "metadata": {"name": name}, "spec": spec})
+
+
+def _group(api, name, queue="q0", min_member=1):
+    api.create({"kind": "PodGroup", "metadata": {"name": name},
+                "spec": {"queue": queue, "minMember": min_member}})
+
+
+def _pod(api, name, group, gpu=0, node_selector=None, tolerations=None):
+    api.create(make_pod(name, labels={POD_GROUP_LABEL: group}, gpu=gpu,
+                        node_selector=node_selector,
+                        tolerations=tolerations))
+
+
+def seed_cluster(api):
+    for i in range(8):
+        _node(api, f"n{i}", labels={"zone": f"z{i % 3}"})
+    for q in range(2):
+        _queue(api, f"q{q}")
+    for j in range(3):
+        _group(api, f"pg{j}", queue=f"q{j % 2}", min_member=2)
+        for k in range(2):
+            _pod(api, f"p{j}-{k}", f"pg{j}", gpu=1 if j % 2 == 0 else 0)
+
+
+class Mutator:
+    """Randomized cluster-event generator over the API store, covering
+    every kind the snapshot consumes (hot + aux)."""
+
+    def __init__(self, api: InMemoryKubeAPI, cache: ClusterCache,
+                 rng: np.random.Generator):
+        self.api = api
+        self.cache = cache
+        self.rng = rng
+        self.seq = 0
+
+    def _pick(self, items):
+        return items[int(self.rng.integers(0, len(items)))] if items \
+            else None
+
+    def _next(self, prefix):
+        self.seq += 1
+        return f"{prefix}{self.seq}"
+
+    # -- the event vocabulary ---------------------------------------------
+    def add_node(self):
+        labels = {"zone": f"z{self.seq % 3}"} \
+            if self.rng.random() < 0.5 else None
+        _node(self.api, self._next("dyn-n"), labels=labels)
+
+    def delete_node(self):
+        node = self._pick(self.api.list("Node"))
+        if node is not None:
+            self.api.delete("Node", node["metadata"]["name"])
+
+    def modify_node(self):
+        node = self._pick(self.api.list("Node"))
+        if node is not None:
+            self.api.patch("Node", node["metadata"]["name"],
+                           {"metadata": {"labels": {
+                               "zone": f"z{int(self.rng.integers(0, 4))}"}}})
+
+    def add_queue(self):
+        _queue(self.api, self._next("dyn-q"),
+               deserved_gpu=int(self.rng.integers(0, 8)) or None)
+
+    def modify_queue(self):
+        q = self._pick(self.api.list("Queue"))
+        if q is not None:
+            self.api.patch("Queue", q["metadata"]["name"],
+                           {"spec": {"priority":
+                                     int(self.rng.integers(0, 5))}})
+
+    def add_group(self):
+        name = self._next("dyn-pg")
+        size = int(self.rng.integers(1, 4))
+        _group(self.api, name, queue=f"q{self.seq % 2}", min_member=size)
+        for _ in range(size):
+            sel = {"zone": "z1"} if self.rng.random() < 0.3 else None
+            _pod(self.api, self._next("dyn-p"), name,
+                 gpu=int(self.rng.integers(0, 3)), node_selector=sel)
+
+    def modify_group(self):
+        pg = self._pick(self.api.list("PodGroup"))
+        if pg is not None:
+            self.api.patch("PodGroup", pg["metadata"]["name"],
+                           {"spec": {"priority":
+                                     int(self.rng.integers(1, 99))}})
+
+    def delete_group(self):
+        pg = self._pick(self.api.list("PodGroup"))
+        if pg is not None:
+            self.api.delete("PodGroup", pg["metadata"]["name"])
+
+    def _pods(self):
+        return [p for p in self.api.list("Pod")
+                if p["metadata"].get("labels", {}).get(POD_GROUP_LABEL)]
+
+    def add_pod(self):
+        group = self._pick(self.api.list("PodGroup"))
+        if group is not None:
+            _pod(self.api, self._next("dyn-p"),
+                 group["metadata"]["name"],
+                 gpu=int(self.rng.integers(0, 2)))
+
+    def delete_pod(self):
+        pod = self._pick(self._pods())
+        if pod is not None:
+            self.api.delete("Pod", pod["metadata"]["name"],
+                            pod["metadata"].get("namespace", "default"))
+
+    def modify_pod(self):
+        pod = self._pick(self._pods())
+        if pod is not None:
+            gpu = int(self.rng.integers(0, 3))
+            self.api.patch(
+                "Pod", pod["metadata"]["name"],
+                {"spec": {"containers": [
+                    {"name": "main", "resources": {"requests": {
+                        "cpu": "1", "memory": "1Gi",
+                        **({"nvidia.com/gpu": gpu} if gpu else {})}}}]}},
+                pod["metadata"].get("namespace", "default"))
+
+    def bind_pod(self):
+        pod = self._pick([p for p in self._pods()
+                          if not p["spec"].get("nodeName")])
+        node = self._pick(self.api.list("Node"))
+        if pod is not None and node is not None:
+            self.api.patch("Pod", pod["metadata"]["name"],
+                           {"spec": {"nodeName":
+                                     node["metadata"]["name"]}},
+                           pod["metadata"].get("namespace", "default"))
+
+    def evict_pod(self):
+        pod = self._pick([p for p in self._pods()
+                          if p["spec"].get("nodeName")])
+        if pod is not None:
+            self.api.patch("Pod", pod["metadata"]["name"],
+                           {"metadata": {"deletionTimestamp": "1"}},
+                           pod["metadata"].get("namespace", "default"))
+
+    def churn_configmap(self):
+        name = f"cm{self.seq % 4}"
+        if self.api.get_opt("ConfigMap", name) is None:
+            self.api.create({"kind": "ConfigMap",
+                             "metadata": {"name": name}})
+        else:
+            self.api.delete("ConfigMap", name)
+
+    def churn_pvc(self):
+        name = f"pvc{self.seq % 4}"
+        if self.api.get_opt("PersistentVolumeClaim", name) is None:
+            self.api.create({
+                "kind": "PersistentVolumeClaim",
+                "metadata": {"name": name, "annotations": {
+                    "volume.kubernetes.io/selected-node": "n0"}},
+                "spec": {}, "status": {"phase": "Bound"}})
+        else:
+            self.api.delete("PersistentVolumeClaim", name)
+
+    def resync(self):
+        # A watch gap forced a re-list (the PR2 reconciler's 410-GONE
+        # path fires the cache's resync callback exactly like this).
+        self.cache._on_watch_resync()
+
+    def noop(self):
+        pass
+
+    OPS = ("add_node", "delete_node", "modify_node", "add_queue",
+           "modify_queue", "add_group", "modify_group", "delete_group",
+           "add_pod", "delete_pod", "modify_pod", "bind_pod",
+           "evict_pod", "churn_configmap", "churn_pvc", "resync",
+           "noop", "noop")
+
+    def step(self):
+        for _ in range(int(self.rng.integers(0, 3))):
+            getattr(self, str(self.rng.choice(self.OPS)))()
+
+
+# ---------------------------------------------------------------------------
+# Equivalence checker: incremental ClusterInfo vs from-scratch parse
+# ---------------------------------------------------------------------------
+
+def assert_snapshots_identical(a, b):
+    """Field-by-field bit-identity of two SnapshotTensors."""
+    for f in dataclasses.fields(a):
+        va, vb = getattr(a, f.name), getattr(b, f.name)
+        if isinstance(va, np.ndarray):
+            assert va.shape == vb.shape and va.dtype == vb.dtype, \
+                f"{f.name}: shape/dtype {va.shape}/{va.dtype} != " \
+                f"{vb.shape}/{vb.dtype}"
+            assert np.array_equal(va, vb), f"{f.name}: values differ"
+        elif f.name == "codec":
+            assert (va.key_cols, va.value_codes, va.taint_codes) == \
+                (vb.key_cols, vb.value_codes, vb.taint_codes), \
+                "codec vocabulary differs"
+        elif f.name == "pack_epoch":
+            continue  # monotonic by design, never equal
+        else:
+            assert va == vb, f"{f.name}: {va!r} != {vb!r}"
+
+
+def assert_clusters_equivalent(inc, ref):
+    """The incremental ClusterInfo must match a from-scratch parse on
+    every surface the scheduler reads."""
+    assert sorted(inc.nodes) == sorted(ref.nodes)
+    for name, a in inc.nodes.items():
+        b = ref.nodes[name]
+        assert np.array_equal(a.allocatable, b.allocatable), name
+        assert np.array_equal(a.used, b.used), name
+        assert np.array_equal(a.releasing, b.releasing), name
+        assert a.labels == b.labels and a.taints == b.taints, name
+        assert a.max_pods == b.max_pods and a.idx == b.idx, name
+        assert a.mig_capacity == b.mig_capacity, name
+        assert sorted(a.pod_infos) == sorted(b.pod_infos), name
+    assert sorted(inc.queues) == sorted(ref.queues)
+    for name, a in inc.queues.items():
+        b = ref.queues[name]
+        assert (a.parent, sorted(a.children), a.priority,
+                a.creation_ts) == (b.parent, sorted(b.children),
+                                   b.priority, b.creation_ts), name
+        assert np.array_equal(a.quota.deserved, b.quota.deserved), name
+        assert np.array_equal(a.quota.limit, b.quota.limit), name
+    assert sorted(inc.podgroups) == sorted(ref.podgroups)
+    for name, a in inc.podgroups.items():
+        b = ref.podgroups[name]
+        assert (a.queue_id, a.priority, a.preemptible, a.namespace) == \
+            (b.queue_id, b.priority, b.preemptible, b.namespace), name
+        assert sorted(a.pod_sets) == sorted(b.pod_sets), name
+        assert sorted(a.pods) == sorted(b.pods), name
+        for uid, ta in a.pods.items():
+            tb = b.pods[uid]
+            assert (ta.name, ta.status, ta.node_name, ta.subgroup) == \
+                (tb.name, tb.status, tb.node_name, tb.subgroup), uid
+            assert np.array_equal(ta.req_vec(), tb.req_vec()), uid
+            assert ta.node_selector == tb.node_selector, uid
+            assert ta.tolerations == tb.tolerations, uid
+    assert inc.config_maps == ref.config_maps
+    assert inc.pvcs == ref.pvcs
+    assert inc.topologies == ref.topologies
+    assert inc.resource_claims == ref.resource_claims
+    assert inc.device_classes == ref.device_classes
+    # The packed tensor view is the strongest whole-surface check: every
+    # array the kernels consume must be bit-identical.
+    assert_snapshots_identical(pack(inc), pack(ref))
+
+
+def placements_of(ssn):
+    return sorted(
+        (t.uid, t.node_name, t.status.name)
+        for pg in ssn.cluster.podgroups.values()
+        for t in pg.pods.values())
+
+
+def run_allocate_both_paths(api, cache):
+    """Allocate on the incremental snapshot and on a from-scratch one;
+    both see the same store, so placements must match exactly."""
+    cluster_a = cache.snapshot()
+    side_cache = InMemoryCache()
+    side_cache.arena = cache.arena
+    ssn_a = Session(cluster_a, SchedulerConfig(), side_cache)
+    ssn_a.open()
+    AllocateAction().execute(ssn_a)
+
+    cluster_b = ClusterCache(api).snapshot()
+    ssn_b = Session(cluster_b, SchedulerConfig(), InMemoryCache())
+    ssn_b.open()
+    AllocateAction().execute(ssn_b)
+    assert placements_of(ssn_a) == placements_of(ssn_b)
+    return ssn_a
+
+
+# ---------------------------------------------------------------------------
+# Property: incremental ClusterInfo == from-scratch parse under churn
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_incremental_equals_full_under_random_events(seed):
+    rng = np.random.default_rng(3000 * SWEEP_SEED + seed)
+    api = InMemoryKubeAPI()
+    seed_cluster(api)
+    cache = ClusterCache(api)
+    assert cache._watch_mode, "in-memory API must take the watch path"
+
+    incremental_snaps = 0
+    mut = Mutator(api, cache, rng)
+    for _ in range(30):
+        mut.step()
+        inc = cache.snapshot()
+        ref = ClusterCache(api).snapshot()
+        assert_clusters_equivalent(inc, ref)
+        if sum(cache.last_snapshot_stats["dirty"].values()):
+            incremental_snaps += 1
+    # The suite must actually exercise the delta path: a cache that
+    # full-refreshes every cycle (or a churn generator that stops
+    # generating) would pass equivalence vacuously.
+    assert cache.last_snapshot_stats["watch_mode"]
+    assert incremental_snaps >= 5, \
+        f"only {incremental_snaps}/30 steps took the delta path"
+
+
+@pytest.mark.parametrize("seed", [1, 2])
+def test_allocate_identical_on_incremental_and_fresh_paths(seed):
+    rng = np.random.default_rng(4000 * SWEEP_SEED + seed)
+    api = InMemoryKubeAPI()
+    seed_cluster(api)
+    cache = ClusterCache(api)
+    mut = Mutator(api, cache, rng)
+    for _ in range(8):
+        mut.step()
+        run_allocate_both_paths(api, cache)
+
+
+def test_dirty_counts_are_delta_not_cluster_sized():
+    """The watch-delta contract: an unchanged store dirties nothing, one
+    touched pod dirties one object — never O(cluster)."""
+    api = InMemoryKubeAPI()
+    seed_cluster(api)
+    cache = ClusterCache(api)
+    cache.snapshot()
+    cache.snapshot()
+    assert sum(cache.last_snapshot_stats["dirty"].values()) == 0
+    api.patch("Pod", "p0-0", {"metadata": {"labels": {"x": "1"}}})
+    cache.snapshot()
+    assert cache.last_snapshot_stats["dirty"] == {
+        "Node": 0, "Queue": 0, "PodGroup": 0, "Pod": 1}
+
+
+# ---------------------------------------------------------------------------
+# Resync mid-stream: wholesale invalidation, then equivalence resumes
+# ---------------------------------------------------------------------------
+
+def test_resync_mid_stream_invalidates_and_stays_equivalent():
+    api = InMemoryKubeAPI()
+    seed_cluster(api)
+    cache = ClusterCache(api)
+    cache.snapshot()
+    # Mutate; the resync lands BEFORE the next snapshot, simulating a
+    # watch gap that may have swallowed any of these events.
+    _node(api, "post-gap-node")
+    _pod(api, "post-gap-pod", "pg0", gpu=1)
+    cache._on_watch_resync()
+    inc = cache.snapshot()
+    assert_clusters_equivalent(inc, ClusterCache(api).snapshot())
+    assert "post-gap-node" in inc.nodes
+    # The snapshot after the resync takes the delta path again.
+    api.patch("Pod", "post-gap-pod",
+              {"metadata": {"labels": {"y": "2"}}})
+    inc2 = cache.snapshot()
+    assert sum(cache.last_snapshot_stats["dirty"].values()) == 1
+    assert_clusters_equivalent(inc2, ClusterCache(api).snapshot())
+
+
+def test_arena_full_rebuild_on_resync_via_incremental_store():
+    """The resync invalidation must reach the arena too: the pack after
+    the gap rebuilds from scratch and is still bit-identical."""
+    api = InMemoryKubeAPI()
+    seed_cluster(api)
+    cache = ClusterCache(api)
+    cache.arena.pack(cache.snapshot())
+    _snap, stats = cache.arena.pack(cache.snapshot())
+    assert not stats["full_rebuild"]
+    cache._on_watch_resync()
+    cluster = cache.snapshot()
+    snap, stats = cache.arena.pack(cluster)
+    assert stats["full_rebuild"] and stats["reason"] == "watch-resync"
+    assert_snapshots_identical(snap, pack(cluster))
+
+
+# ---------------------------------------------------------------------------
+# Fenced evicts: a deposed leader's writes never corrupt the store view
+# ---------------------------------------------------------------------------
+
+def test_fenced_evict_aborts_and_cache_stays_equivalent():
+    from kai_scheduler_tpu.controllers.kubeapi import FENCE_NAMESPACE
+    api = InMemoryKubeAPI()
+    seed_cluster(api)
+    api.create({"kind": "Lease",
+                "metadata": {"name": "kai-sched",
+                             "namespace": FENCE_NAMESPACE},
+                "spec": {"epoch": 5}})
+    cache = ClusterCache(api)
+    cache.set_fence("kai-sched", lambda: 3)   # stale epoch: deposed
+    cluster = cache.snapshot()
+    api.patch("Pod", "p0-0", {"spec": {"nodeName": "n0"}})
+    cluster = cache.snapshot()
+    task = next(t for pg in cluster.podgroups.values()
+                for t in pg.pods.values() if t.name == "p0-0")
+    before = api.get("Pod", "p0-0").get("metadata", {}).get(
+        "deletionTimestamp")
+    with pytest.raises(Fenced):
+        cache.evict(task)
+    after = api.get("Pod", "p0-0").get("metadata", {}).get(
+        "deletionTimestamp")
+    assert before == after is None, "fenced evict must not land"
+    # The rejected write leaves the incremental view consistent.
+    assert_clusters_equivalent(cache.snapshot(),
+                               ClusterCache(api).snapshot())
+    # A rightful leader (fresh epoch) evicts through the same cache.
+    cache.set_fence("kai-sched", lambda: 6)
+    cache.evict(task)
+    assert api.get("Pod", "p0-0")["metadata"].get("deletionTimestamp")
+    assert_clusters_equivalent(cache.snapshot(),
+                               ClusterCache(api).snapshot())
+
+
+# ---------------------------------------------------------------------------
+# Fallback path: APIs without the emit hook still parse incrementally
+# ---------------------------------------------------------------------------
+
+class _NoHookAPI:
+    """InMemoryKubeAPI minus watch_sync: forces the re-list fallback."""
+
+    def __init__(self, inner):
+        self.inner = inner
+
+    def list(self, *a, **k):
+        return self.inner.list(*a, **k)
+
+    def get_opt(self, *a, **k):
+        return self.inner.get_opt(*a, **k)
+
+
+def test_coalesced_grouping_keeps_pod_keyed_groups_per_pod():
+    """Owner-coalescing must not collapse pod-keyed groupers: each
+    Deployment replica is its OWN inference group even when all three
+    replicas arrive in one drain batch behind one owner."""
+    from kai_scheduler_tpu.controllers.podgrouper import PodGrouper
+    api = InMemoryKubeAPI()
+    grouper = PodGrouper(api)
+    api.create({"kind": "Deployment", "apiVersion": "apps/v1",
+                "metadata": {"name": "web", "uid": "u-dep"},
+                "spec": {"replicas": 3}})
+    from kai_scheduler_tpu.controllers.kubeapi import owner_ref
+    ref = owner_ref("Deployment", "web", uid="u-dep",
+                    api_version="apps/v1")
+    for i in range(3):
+        api.create(make_pod(f"web-rep{i}", owner=ref))
+    api.drain()
+    groups = api.list("PodGroup")
+    assert len(groups) == 3, [g["metadata"]["name"] for g in groups]
+    labels = {p["metadata"]["name"]:
+              p["metadata"]["labels"][POD_GROUP_LABEL]
+              for p in api.list("Pod")}
+    assert len(set(labels.values())) == 3, labels
+    for name, group in labels.items():
+        assert name in group, (name, group)
+    assert grouper._pending == {}
+
+
+def test_fallback_full_refresh_matches_watch_mode():
+    api = InMemoryKubeAPI()
+    seed_cluster(api)
+    watch_cache = ClusterCache(api)
+    nohook_cache = ClusterCache(_NoHookAPI(api))
+    assert not nohook_cache._watch_mode
+    for step in range(3):
+        _pod(api, f"fb-p{step}", "pg0", gpu=1)
+        if step == 1:
+            _node(api, "fb-node")
+        a = watch_cache.snapshot()
+        b = nohook_cache.snapshot()
+        assert_clusters_equivalent(a, b)

@@ -225,6 +225,30 @@ class TestChaosMatrixDryRun:
         assert "tests/test_lifecycle.py" in out
         assert "tests/test_snapshot_delta.py" in out
 
+    def test_dry_run_incremental_mode_selects_cache_suite(self, capsys,
+                                                          monkeypatch):
+        """--incremental sweeps the incremental-ClusterInfo equivalence
+        suite; composing with --arena and --latency sweeps all three."""
+        from kai_scheduler_tpu.tools import chaos_matrix
+        monkeypatch.setattr(
+            chaos_matrix.subprocess, "run",
+            lambda *a, **kw: (_ for _ in ()).throw(AssertionError(
+                "dry run must not execute iterations")))
+        rc = chaos_matrix.main(["--dry-run", "--incremental", "--seeds",
+                                "3,5"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert out.count("seed ") == 2
+        assert "tests/test_incremental_cache.py" in out
+        assert "tests/test_reconciler.py" not in out
+        rc = chaos_matrix.main(["--dry-run", "--arena", "--latency",
+                                "--incremental", "--seeds", "3"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "tests/test_incremental_cache.py" in out
+        assert "tests/test_lifecycle.py" in out
+        assert "tests/test_snapshot_delta.py" in out
+
     def test_dry_run_respects_iterations_default_seeds(self, capsys,
                                                        monkeypatch):
         from kai_scheduler_tpu.tools import chaos_matrix
