@@ -246,19 +246,34 @@ def _execute_bulk(ssn, jobs):
             # collapse from thousands of steps to a handful).
             kw["independent_jobs"] = np.array(
                 [len(tasks) == 1 for tasks in chunks])
+            # Host-mirror releasing hint: engages the fused kernel's
+            # no-releasing specialization without touching device state.
+            kw["has_releasing"] = ssn.has_releasing()
         node_arrays = ssn._device_arrays()
-        result = ssn.dispatch_kernel(
-            lambda: kernel(
-                node_arrays,
-                np.stack(rows_req), np.array(task_jobs, np.int32),
-                np.stack(rows_sel), np.stack(rows_tol),
-                np.array(job_allowed),
-                gpu_strategy=ssn.gpu_strategy,
-                cpu_strategy=ssn.cpu_strategy,
-                **kw),
-            label="allocate_bulk",
-            validate=lambda r: getattr(r.placements, "shape", (0,))[0]
-            >= len(rows_req))
+
+        def dispatch():
+            return ssn.dispatch_kernel(
+                lambda: kernel(
+                    node_arrays,
+                    np.stack(rows_req), np.array(task_jobs, np.int32),
+                    np.stack(rows_sel), np.stack(rows_tol),
+                    np.array(job_allowed),
+                    gpu_strategy=ssn.gpu_strategy,
+                    cpu_strategy=ssn.cpu_strategy,
+                    **kw),
+                label="allocate_bulk",
+                validate=lambda r: getattr(r.placements, "shape", (0,))[0]
+                >= len(rows_req))
+
+        if ssn.mesh is None:
+            # Guard verdict + resolved rung stamped on the cycle thread
+            # (the sharded kernel has no ladder, so mesh dispatches emit
+            # no allocate_fused span).
+            from ..ops.allocate_grouped import fused_dispatch_span
+            with fused_dispatch_span(bulk=True):
+                result = dispatch()
+        else:
+            result = dispatch()
 
         success = np.asarray(result.job_success)
         placements = np.asarray(result.placements)

@@ -177,9 +177,15 @@ class DeviceStateCache:
         k_pad = _next_pow2(k)
         rows_pad = np.full(k_pad, rows[0], np.int64)
         rows_pad[:k] = rows
-        idle_v = np.ascontiguousarray(idle[rows_pad], np.float64)
-        rel_v = np.ascontiguousarray(rel[rows_pad], np.float64)
-        room_v = np.ascontiguousarray(room[rows_pad], np.float64)
+        # Slice values in the RESIDENT dtype: the host mirrors are f64
+        # (exact diffing) but the device arrays follow the backend's
+        # default width — converting here is one fused host pass, where
+        # an f64 np array handed to jnp.asarray under 32-bit mode pays a
+        # separate conversion copy per scatter.
+        dt = np.dtype(self._dev[0].dtype)
+        idle_v = np.ascontiguousarray(idle[rows_pad], dt)
+        rel_v = np.ascontiguousarray(rel[rows_pad], dt)
+        room_v = np.ascontiguousarray(room[rows_pad], dt)
         dev = self._dev
         from ..ops.arena import apply_deltas_kernel
         with TRACER.span("arena_scatter", kind="arena_scatter",

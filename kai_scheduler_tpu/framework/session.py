@@ -270,6 +270,9 @@ class Session:
         self._static_dev: dict = {}
         self._state_dev: dict = {}
         self._dirty_rows: set[int] = set()
+        # Releasing-pool hint memo for the fused grouped kernel (see
+        # has_releasing): (tick, value), recomputed only after mutations.
+        self._rel_hint: tuple[int, bool] | None = None
 
     # -- lifecycle ---------------------------------------------------------
     def open(self) -> "Session":
@@ -392,6 +395,18 @@ class Session:
         if self._native is not None:
             return self._native.room
         return self._np_room
+
+    def has_releasing(self) -> bool:
+        """Host-verified hint: does ANY node row carry releasing
+        capacity?  Feeds the fused grouped kernel's no-releasing
+        specialization (ops/allocate_grouped) straight from the host
+        mirrors — the resident device copy is never fetched for a hint.
+        Memoized on the mutation tick: statements that pipeline/evict
+        bump it, so the memo can never serve a stale False."""
+        if self._rel_hint is None or self._rel_hint[0] != self.mutation_count:
+            self._rel_hint = (self.mutation_count,
+                              bool(self.node_releasing.any()))
+        return self._rel_hint[1]
 
     def sync_node(self, node) -> None:
         # Monotonic mutation tick: plugins key their cluster-scan caches
@@ -738,20 +753,25 @@ class Session:
             else:
                 homogeneous = False
         if homogeneous:
-            from ..ops.allocate_grouped import allocate_grouped
+            from ..ops import allocate_grouped as ag
             node_arrays = self._device_arrays()
-            result = self.dispatch_kernel(
-                lambda: allocate_grouped(
-                    node_arrays, task_req[:t], np.zeros(t, np.int32),
-                    task_sel[:t], task_tol[:t], np.ones(1, bool),
-                    gpu_strategy=self.gpu_strategy,
-                    cpu_strategy=self.cpu_strategy,
-                    allow_pipeline=allow_pipeline,
-                    pipeline_only=pipeline_only,
-                    extra_scores=row_extra,
-                    node_mask=row_mask),
-                label="allocate_grouped",
-                validate=_allocation_shape_check(t))
+            # The span helper stamps the guard verdict + the wrapper's
+            # resolved rung on the cycle thread (the wrapper may run on
+            # the guard's worker thread, where cycle spans no-op).
+            with ag.fused_dispatch_span():
+                result = self.dispatch_kernel(
+                    lambda: ag.allocate_grouped(
+                        node_arrays, task_req[:t], np.zeros(t, np.int32),
+                        task_sel[:t], task_tol[:t], np.ones(1, bool),
+                        gpu_strategy=self.gpu_strategy,
+                        cpu_strategy=self.cpu_strategy,
+                        allow_pipeline=allow_pipeline,
+                        pipeline_only=pipeline_only,
+                        extra_scores=row_extra,
+                        node_mask=row_mask,
+                        has_releasing=self.has_releasing()),
+                    label="allocate_grouped",
+                    validate=_allocation_shape_check(t))
             if not bool(result.job_success[0]):
                 return Proposal(False, [])
             placements = []

@@ -34,11 +34,22 @@ Equivalence to the sequential greedy (tested against the exact kernel):
   next job boundary, exactly like the per-task kernel.
 
 Spread strategy round-robins as nodes fill and must use the exact kernel.
+
+The per-step row + fill implementation is a static three-rung ladder
+(docs/DESIGN.md §3.2b): TPU-Pallas node-tile row kernel -> fused-jnp
+single-pass row with the masked-sum radix-descent fill -> the legacy
+feasibility_row/score_row/histogram composition.  All rungs are
+bit-identical in placements (tests/test_fused_parity.py,
+tools/kernel_parity.py); the wrapper resolves the rung per backend/shape
+(env pin: KAI_FUSED_ALLOC) and counts it in
+``allocate_fused_taken_total``.
 """
 
 from __future__ import annotations
 
+import contextlib
 import functools
+import os
 from typing import NamedTuple
 
 import jax
@@ -46,8 +57,46 @@ import jax.numpy as jnp
 import numpy as np
 
 from .allocate import NEG, AllocationResult
-from .predicates import feasibility_row
-from .scoring import AVAILABILITY, BINPACK, score_row
+from .predicates import feasibility_caps_row, feasibility_row
+from .scoring import AVAILABILITY, BINPACK, score_row, score_row_selected
+
+# Fused-path selection (docs/DESIGN.md fused-kernel section).  The ladder
+# is TPU-Pallas -> fused-jnp -> legacy: ``auto`` resolves per backend and
+# shape; KAI_FUSED_ALLOC pins a rung (parity suites pin ``legacy`` to diff
+# the ladder against the original formulation).
+FUSED_MODES = ("auto", "pallas", "jnp", "legacy")
+_FUSED_ENV = "KAI_FUSED_ALLOC"
+
+# Digit width (bits) of the fused fill's radix descent.  Each level costs
+# one in-prefix mask pass plus (2^W - 1) masked-sum reductions that XLA
+# multi-output-fuses over one read of the keys; W=2 balances level count
+# (16 for u32) against per-level reduction fan-out on both CPU and TPU.
+SELECT_DIGIT_BITS = 2
+
+# Stats of the most recent wrapper dispatch (mode/groups/nodes/
+# releasing_empty): the traced call sites read these to stamp the
+# ``allocate_fused`` span on the cycle thread (the wrapper itself may run
+# on the device guard's worker thread, where cycle spans no-op).
+LAST_DISPATCH: dict = {}
+
+
+@contextlib.contextmanager
+def fused_dispatch_span(**attrs):
+    """Cycle-thread ``allocate_fused`` span around a guarded grouped
+    dispatch: yields, then stamps the guard verdict (fallback/timeout/
+    breaker — the contract every kernel-kind span carries) plus the
+    wrapper's resolved-rung stats from ``LAST_DISPATCH``.  One
+    definition for the session fast path and the bulk action, so the
+    span contract cannot drift one-sided."""
+    from ..utils.deviceguard import device_guard
+    from ..utils.tracing import TRACER
+    guard = device_guard()
+    fb0, to0 = guard.fallback_calls, guard.timeouts
+    with TRACER.span("allocate_fused", kind="kernel", **attrs) as sp:
+        yield
+        sp.set(fallback=guard.fallback_calls > fb0,
+               timed_out=guard.timeouts > to0,
+               breaker=guard.breaker.state, **LAST_DISPATCH)
 
 
 def group_tasks(task_req: np.ndarray, task_job: np.ndarray,
@@ -131,7 +180,7 @@ def _order_segments(seg_nodes, seg_counts, seg_pipe, seg_keys):
     return seg_nodes, seg_counts, seg_pipe > 0
 
 
-def _score_keys(score):
+def _score_keys(score, force_f32: bool = False):
     """Order-preserving unsigned-integer keys for float scores: key(a) >
     key(b) iff a > b.  (levels, utype) size the radix select below.
 
@@ -139,8 +188,17 @@ def _score_keys(score):
     pass cannot lower a u64 bitcast-convert on TPU (crashes at compile),
     and score ORDER at f32 precision is what the hardware natively
     supports — CPU runs (the x64 parity suite) keep the exact u64 path.
+
+    ``force_f32`` SIMULATES the TPU downcast on any backend: the
+    precision-split property suite (tests/test_score_precision.py) pins
+    it to prove f32 keys only ever COLLAPSE f64 ties (downcast is
+    monotone), never invert an ordering — the tier-1 guardian for the
+    bench's TPU-vs-CPU-x64 parity child that otherwise needs a live
+    tunnel.
     """
-    if score.dtype == jnp.float64 and jax.default_backend() != "tpu":
+    # kailint: disable=KAI001 — force_f32 mirrors a static_argname flag
+    if not force_f32 and score.dtype == jnp.float64 \
+            and jax.default_backend() != "tpu":
         bits = jax.lax.bitcast_convert_type(score, jnp.uint64)
         key = jnp.where(bits >> jnp.uint64(63) == 1, ~bits,
                         bits | jnp.uint64(1 << 63))
@@ -218,10 +276,136 @@ def _fill_by_score(key, levels, utype, cap, count):
     return jnp.where(count > 0, take_full + take_eq, 0.0)
 
 
+def _fill_by_score_descent(key, levels, utype, cap, count):
+    """Exact greedy fill with the same take semantics as
+    ``_fill_by_score``, built from fused masked-sum reductions instead of
+    the 256-wide capacity histogram.
+
+    The histogram formulation pays O(items x 256) broadcast-compare work
+    per level; on CPU (and for the Pallas row outputs on TPU) the same
+    threshold digit falls out of 2^W masked capacity sums per W-bit
+    level — XLA multi-output-fuses them over a single read of
+    (key, cap) — so the whole select is O(items x levels) with no
+    scatter, no sort, no materialized one-hot.  Every per-digit sum is
+    computed FRESH from the current in-prefix mask (never derived by
+    subtracting a carried total, which would drag early >2^24-scale f32
+    rounding error into the deep levels where the in-prefix set — and
+    the legacy histogram's sums — have shrunk back to exact range), so
+    the compared region stays exact for the same reason documented on
+    ``_histogram``.
+    """
+    w = SELECT_DIGIT_BITS
+    n_bits = levels * 8
+    while n_bits % w:
+        w -= 1
+    n_levels = n_bits // w
+    mask = utype((1 << w) - 1)
+
+    def level_body(level, state):
+        # A lax loop, not an unrolled Python one: unrolling 16-32 levels
+        # of scalar select machinery ballooned XLA:CPU compile time by
+        # >30s at even trivial shapes; the rolled form compiles in
+        # milliseconds and the per-level loop overhead is noise next to
+        # the masked-sum reductions.
+        prefix, above = state
+        shift = (jnp.asarray(n_bits, utype)
+                 - utype(w) * (level.astype(utype) + utype(1)))
+        cur = key >> shift
+        # Level 0: cur >> w == 0 == prefix, so every key is in-prefix —
+        # no special case (both shifts stay < the key width).
+        capw = jnp.where((cur >> utype(w)) == prefix, cap,
+                         jnp.zeros((), cap.dtype))
+        dig = cur & mask
+        h = [jnp.sum(jnp.where(dig == utype(d), capw,
+                               jnp.zeros((), cap.dtype)))
+             for d in range(1 << w)]
+        # ge[d] = capacity(digit >= d); threshold digit d* is the unique
+        # crossing gt(d) < need <= ge(d) (first match mirrors the
+        # histogram form's argmax; fall to 0 when capacity is short).
+        ge = [None] * (1 << w)
+        acc = jnp.zeros((), cap.dtype)
+        for d in reversed(range(1 << w)):
+            acc = acc + h[d]
+            ge[d] = acc
+        need = count - above
+        d_star = jnp.zeros((), utype)
+        gt_sel = ge[0] - h[0]
+        found = jnp.asarray(False)
+        for d in range(1 << w):
+            gt = ge[d] - h[d]
+            c = (gt < need) & (need <= ge[d]) & ~found
+            d_star = jnp.where(c, utype(d), d_star)
+            gt_sel = jnp.where(c, gt, gt_sel)
+            found = found | c
+        d_star = jnp.where(found, d_star, utype(0))
+        gt_sel = jnp.where(found, gt_sel, ge[0] - h[0])
+        return ((prefix << utype(w)) | d_star, above + gt_sel)
+
+    prefix, above = jax.lax.fori_loop(
+        0, n_levels, level_body,
+        (jnp.zeros((), utype), jnp.zeros((), cap.dtype)))
+    take_full = jnp.where(key > prefix, cap, 0.0)
+    eqcap = jnp.where(key == prefix, cap, 0.0)
+    rem = jnp.maximum(count - above, 0.0)
+    pref = jnp.cumsum(eqcap)
+    take_eq = jnp.clip(rem - (pref - eqcap), 0.0, eqcap)
+    return jnp.where(count > 0, take_full + take_eq, 0.0)
+
+
+def _fused_row(node_allocatable, idle, rel, node_labels, node_taints,
+               room, req, sel, tol, extra_row, mask_row,
+               gpu_strategy: int, cpu_strategy: int,
+               allow_pipeline: bool, pipeline_only: bool,
+               releasing_empty: bool, pipe_items: bool,
+               f32_keys: bool = False):
+    """One fused pass over the node state for one group step:
+    (key_now, key_pipe | None, cap_now, cap_rel | None, levels, utype).
+
+    Composes the unrolled feasibility+capacity helper
+    (predicates.feasibility_caps_row) with the column-selected scorer
+    (scoring.score_row_selected) so the whole row is one elementwise DAG
+    plus the two binpack min/max reductions — no [N]-wide intermediate
+    crosses a fusion boundary more than once.  Formula-identical to the
+    legacy step's feasibility_row + score_row + capacity composition.
+    """
+    fit_now, fit_future, cap_now_f, cap_tot_f = feasibility_caps_row(
+        idle, None if releasing_empty else rel,
+        node_labels, node_taints, room, req, sel, tol)
+    if mask_row is not None:
+        fit_now = fit_now & mask_row
+        fit_future = fit_future & mask_row
+    # The flag params mirror the kernel's static_argnames (the jitted
+    # caller pins them); they are Python bools/ints at trace time.
+    if pipeline_only:  # kailint: disable=KAI001
+        fit_now = jnp.zeros_like(fit_now)
+    feasible = fit_now | (fit_future if (allow_pipeline or pipeline_only)
+                          else jnp.zeros_like(fit_future))
+    if gpu_strategy == cpu_strategy:  # kailint: disable=KAI001
+        score = score_row_selected(node_allocatable, idle, req, feasible,
+                                   fit_now, gpu_strategy, cpu_strategy)
+    else:  # mixed strategies: keep the two-axis canonical form
+        score = score_row(node_allocatable, idle, req, feasible, fit_now,
+                          gpu_strategy, cpu_strategy)
+    if extra_row is not None:
+        score = score + extra_row
+    score = jnp.where(feasible, score, NEG)
+    key_now, levels, utype = _score_keys(score, f32_keys)
+
+    cap_now = jnp.where(fit_now, jnp.minimum(cap_now_f, room), 0.0)
+    cap_tot = jnp.where(feasible, jnp.minimum(cap_tot_f, room), 0.0)
+    if not pipe_items:  # kailint: disable=KAI001
+        return key_now, None, cap_now, None, levels, utype
+    score_pipe = score - jnp.where(fit_now, AVAILABILITY, 0.0)
+    key_pipe, _, _ = _score_keys(score_pipe, f32_keys)
+    return key_now, key_pipe, cap_now, cap_tot, levels, utype
+
+
 @functools.partial(jax.jit,
                    static_argnames=("max_group", "gpu_strategy",
                                     "cpu_strategy", "allow_pipeline",
-                                    "pipeline_only", "single_group_jobs"))
+                                    "pipeline_only", "single_group_jobs",
+                                    "fused_mode", "releasing_empty",
+                                    "f32_keys"))
 def allocate_groups_kernel(node_allocatable, node_idle, node_releasing,
                            node_labels, node_taints, node_pod_room,
                            group_req, group_sel, group_tol, group_count,
@@ -232,7 +416,10 @@ def allocate_groups_kernel(node_allocatable, node_idle, node_releasing,
                            cpu_strategy: int = BINPACK,
                            allow_pipeline: bool = True,
                            pipeline_only: bool = False,
-                           single_group_jobs: bool = False):
+                           single_group_jobs: bool = False,
+                           fused_mode: str = "legacy",
+                           releasing_empty: bool = False,
+                           f32_keys: bool = False):
     """Scan over groups; per group emit up to max_group fill segments.
 
     Returns (seg_nodes [G,K], seg_counts [G,K], seg_pipe [G,K] — phase-B
@@ -254,12 +441,40 @@ def allocate_groups_kernel(node_allocatable, node_idle, node_releasing,
     spans < 10, so a group's fill can never reorder nodes ACROSS extra
     levels mid-fill, and WITHIN a level the pure-binpack invariance
     argument above applies unchanged.  The session fast path checks this
-    before routing (framework/session.py)."""
+    before routing (framework/session.py).
+
+    ``fused_mode`` picks the per-step row implementation (static, decided
+    by the host wrapper — docs/DESIGN.md fused-kernel section):
+    ``legacy`` keeps the original feasibility_row + score_row + histogram
+    composition; ``jnp`` runs the fused single-pass row
+    (predicates.feasibility_caps_row + scoring.score_row_selected) with
+    the masked-sum radix-descent fill; ``pallas`` swaps the row pass for
+    the Pallas node-tile kernel (ops/pallas_kernels.group_step_pallas).
+    ``releasing_empty`` (fused modes only) declares the releasing pool
+    all-zero, which provably collapses the pipeline item tier: fit_future
+    == fit_now, cap_rel == 0, so the step skips the pipe keys, the
+    interleave, and the releasing update entirely.  The wrapper only sets
+    it from a host-verified hint and never under ``pipeline_only`` (a
+    pipeline-only fill mutates releasing below zero, invalidating the
+    premise mid-scan)."""
     G = group_req.shape[0]
     N = node_allocatable.shape[0]
     K = max_group
     if group_indep is None:
         group_indep = jnp.zeros(G, bool)
+    assert fused_mode in ("legacy", "jnp", "pallas"), fused_mode
+    # A pipeline-only fill mutates releasing below zero mid-scan, which
+    # invalidates the all-zero premise the specialization rests on; the
+    # wrapper never combines them, direct callers must not either.
+    assert not (releasing_empty and pipeline_only), \
+        "releasing_empty is unsound under pipeline_only"
+    fused = fused_mode != "legacy"
+    # Pipe (phase-B) items exist unless the releasing tier is provably
+    # dead; legacy always interleaves them (zero-capacity items are
+    # harmless there and keep the original code byte-for-byte).
+    pipe_items = (not fused) or pipeline_only \
+        or (allow_pipeline and not releasing_empty)
+    rel_static = fused and releasing_empty
 
     class Carry(NamedTuple):
         idle: jnp.ndarray
@@ -272,9 +487,12 @@ def allocate_groups_kernel(node_allocatable, node_idle, node_releasing,
         cur_ok: jnp.ndarray
 
     zero = jnp.zeros(())
-    init = Carry(node_idle, node_releasing, node_pod_room,
+    init = Carry(node_idle,
+                 zero if rel_static else node_releasing,
+                 node_pod_room,
                  zero if single_group_jobs else node_idle,
-                 zero if single_group_jobs else node_releasing,
+                 zero if (single_group_jobs or rel_static)
+                 else node_releasing,
                  zero if single_group_jobs else node_pod_room,
                  jnp.array(-1, jnp.int32), jnp.array(False))
 
@@ -298,58 +516,105 @@ def allocate_groups_kernel(node_allocatable, node_idle, node_releasing,
         req = group_req[g]
         count = jnp.where(ok, group_count[g], 0.0)
 
-        fit_now, fit_future = feasibility_row(
-            idle, rel, node_labels, node_taints, room, req,
-            group_sel[g], group_tol[g])
-        if group_mask is not None:
-            mask_row = group_mask[j]
-            fit_now = fit_now & mask_row
-            fit_future = fit_future & mask_row
-        if pipeline_only:
-            fit_now = jnp.zeros_like(fit_now)
-        feasible = fit_now | (fit_future if (allow_pipeline or pipeline_only)
-                              else jnp.zeros_like(fit_future))
-        score = score_row(node_allocatable, idle, req, feasible, fit_now,
-                          gpu_strategy, cpu_strategy)
-        if group_extra is not None:
-            score = score + group_extra[j]
-        score = jnp.where(feasible, score, NEG)
-        # Pipeline items score without the availability boost (the exact
-        # kernel's fit_now term vanishes once a node's idle is spent).
-        score_pipe = score - jnp.where(fit_now, AVAILABILITY, 0.0)
-        key_now, levels, utype = _score_keys(score)
-        key_pipe, _, _ = _score_keys(score_pipe)
+        if fused:
+            extra_row = group_extra[j] if group_extra is not None else None
+            mask_row = group_mask[j] if group_mask is not None else None
+            row_args = (node_allocatable, idle,
+                        None if rel_static else rel,
+                        node_labels, node_taints, room, req,
+                        group_sel[g], group_tol[g], extra_row, mask_row)
+            row_kw = dict(gpu_strategy=gpu_strategy,
+                          cpu_strategy=cpu_strategy,
+                          allow_pipeline=allow_pipeline,
+                          pipeline_only=pipeline_only,
+                          releasing_empty=rel_static,
+                          pipe_items=pipe_items)
+            if fused_mode == "pallas" and gpu_strategy == cpu_strategy:
+                # (Pallas computes at f32 natively — f32_keys is a no-op
+                # there; mixed per-axis strategies keep the two-axis
+                # canonical scorer, which only the jnp row implements.)
+                from .pallas_kernels import group_step_pallas
+                (key_now, key_pipe, cap_now, cap_tot,
+                 levels, utype) = group_step_pallas(*row_args, **row_kw)
+            else:
+                (key_now, key_pipe, cap_now, cap_tot,
+                 levels, utype) = _fused_row(*row_args, f32_keys=f32_keys,
+                                             **row_kw)
+            cap_now = jnp.clip(cap_now, 0.0, count)
+            if pipe_items:
+                cap_rel = jnp.clip(cap_tot - cap_now, 0.0, count)
+                key2 = jnp.stack([key_now, key_pipe], axis=1).reshape(-1)
+                cap2 = jnp.stack([cap_now, cap_rel], axis=1).reshape(-1)
+            else:
+                # Releasing tier provably dead: items ARE nodes — same
+                # ascending-index tie-break, half the fill width.
+                key2, cap2 = key_now, cap_now
+            take2 = jax.lax.cond(
+                count > 0,
+                lambda: _fill_by_score_descent(key2, levels, utype, cap2,
+                                               count),
+                lambda: jnp.zeros_like(cap2))
+        else:
+            fit_now, fit_future = feasibility_row(
+                idle, rel, node_labels, node_taints, room, req,
+                group_sel[g], group_tol[g])
+            if group_mask is not None:
+                mask_row = group_mask[j]
+                fit_now = fit_now & mask_row
+                fit_future = fit_future & mask_row
+            if pipeline_only:
+                fit_now = jnp.zeros_like(fit_now)
+            feasible = fit_now | (fit_future
+                                  if (allow_pipeline or pipeline_only)
+                                  else jnp.zeros_like(fit_future))
+            score = score_row(node_allocatable, idle, req, feasible,
+                              fit_now, gpu_strategy, cpu_strategy)
+            if group_extra is not None:
+                score = score + group_extra[j]
+            score = jnp.where(feasible, score, NEG)
+            # Pipeline items score without the availability boost (the
+            # exact kernel's fit_now term vanishes once a node's idle is
+            # spent).
+            score_pipe = score - jnp.where(fit_now, AVAILABILITY, 0.0)
+            key_now, levels, utype = _score_keys(score, f32_keys)
+            key_pipe, _, _ = _score_keys(score_pipe, f32_keys)
 
-        safe_req = jnp.where(req > 0, req, 1.0)
-        cap_now_f = jnp.min(jnp.where(req[None, :] > 0,
-                                      jnp.floor(idle / safe_req[None, :]),
-                                      jnp.inf), axis=1)
-        cap_tot_f = jnp.min(jnp.where(
-            req[None, :] > 0,
-            jnp.floor((idle + rel) / safe_req[None, :]), jnp.inf), axis=1)
-        cap_now = jnp.where(fit_now, jnp.minimum(cap_now_f, room), 0.0)
-        cap_tot = jnp.where(feasible, jnp.minimum(cap_tot_f, room), 0.0)
-        cap_now = jnp.clip(cap_now, 0.0, count)
-        cap_rel = jnp.clip(cap_tot - cap_now, 0.0, count)
-        if not (allow_pipeline or pipeline_only):
-            cap_rel = jnp.zeros_like(cap_rel)
+            safe_req = jnp.where(req > 0, req, 1.0)
+            cap_now_f = jnp.min(jnp.where(
+                req[None, :] > 0, jnp.floor(idle / safe_req[None, :]),
+                jnp.inf), axis=1)
+            cap_tot_f = jnp.min(jnp.where(
+                req[None, :] > 0,
+                jnp.floor((idle + rel) / safe_req[None, :]), jnp.inf),
+                axis=1)
+            cap_now = jnp.where(fit_now, jnp.minimum(cap_now_f, room), 0.0)
+            cap_tot = jnp.where(feasible, jnp.minimum(cap_tot_f, room),
+                                0.0)
+            cap_now = jnp.clip(cap_now, 0.0, count)
+            cap_rel = jnp.clip(cap_tot - cap_now, 0.0, count)
+            if not (allow_pipeline or pipeline_only):
+                cap_rel = jnp.zeros_like(cap_rel)
 
-        # ONE exact greedy fill, sort-free, over the interleaved 2N
-        # (node, phase) items — item 2n is node n's idle capacity at its
-        # full score, item 2n+1 its releasing capacity without the
-        # availability boost.  Interleaving keeps equal-key ties resolved
-        # by ascending node index, matching the exact kernel's argmax.
-        # The lax.cond skips the radix select entirely for satisfied
-        # demands (padded/gated groups) — most of a backlog cycle's
-        # step cost.
-        key2 = jnp.stack([key_now, key_pipe], axis=1).reshape(-1)
-        cap2 = jnp.stack([cap_now, cap_rel], axis=1).reshape(-1)
-        take2 = jax.lax.cond(
-            count > 0,
-            lambda: _fill_by_score(key2, levels, utype, cap2, count),
-            lambda: jnp.zeros_like(cap2))
-        take_a = take2[0::2]
-        take_b = take2[1::2]
+            # ONE exact greedy fill, sort-free, over the interleaved 2N
+            # (node, phase) items — item 2n is node n's idle capacity at
+            # its full score, item 2n+1 its releasing capacity without
+            # the availability boost.  Interleaving keeps equal-key ties
+            # resolved by ascending node index, matching the exact
+            # kernel's argmax.  The lax.cond skips the radix select
+            # entirely for satisfied demands (padded/gated groups) —
+            # most of a backlog cycle's step cost.
+            key2 = jnp.stack([key_now, key_pipe], axis=1).reshape(-1)
+            cap2 = jnp.stack([cap_now, cap_rel], axis=1).reshape(-1)
+            take2 = jax.lax.cond(
+                count > 0,
+                lambda: _fill_by_score(key2, levels, utype, cap2, count),
+                lambda: jnp.zeros_like(cap2))
+
+        if pipe_items:
+            take_a = take2[0::2]
+            take_b = take2[1::2]
+        else:
+            take_a, take_b = take2, None
         placed = take2.sum()
 
         if single_group_jobs:
@@ -359,17 +624,25 @@ def allocate_groups_kernel(node_allocatable, node_idle, node_releasing,
             # each member job succeeds or fails on its own.
             gang_ok = group_indep[g] | (placed >= count)
             take_a = jnp.where(gang_ok, take_a, 0.0)
-            take_b = jnp.where(gang_ok, take_b, 0.0)
             take2 = jnp.where(gang_ok, take2, 0.0)
+            if take_b is not None:
+                take_b = jnp.where(gang_ok, take_b, 0.0)
 
         idle = idle - take_a[:, None] * req[None, :]
-        rel = rel - take_b[:, None] * req[None, :]
-        room = room - take_a - take_b
+        if not rel_static:
+            rel = rel - (take_b if take_b is not None
+                         else jnp.zeros_like(take_a))[:, None] * req[None, :]
+        room = room - take_a - (take_b if take_b is not None else 0.0)
 
-        # Compact the interleaved items once: item index -> (node, phase).
+        # Compact the items once: with pipe items interleaved, item
+        # index -> (node, phase); without, items are node indices.
         items, counts2, seg_keys = _compact(take2, key2, K)
-        seg_nodes = jnp.where(items >= 0, items >> 1, -1)
-        seg_pipe = (items >= 0) & (items & 1 == 1) & (counts2 > 0)
+        if pipe_items:
+            seg_nodes = jnp.where(items >= 0, items >> 1, -1)
+            seg_pipe = (items >= 0) & (items & 1 == 1) & (counts2 > 0)
+        else:
+            seg_nodes = items
+            seg_pipe = jnp.zeros(K, bool)
         seg_counts = counts2
 
         ok = ok & (placed >= count)
@@ -386,6 +659,10 @@ def allocate_groups_kernel(node_allocatable, node_idle, node_releasing,
     else:
         idle = jnp.where(carry.cur_ok, carry.idle, carry.ck_idle)
         rel = jnp.where(carry.cur_ok, carry.rel, carry.ck_rel)
+    if rel_static:
+        # The scan never touched releasing (cap_rel proven 0): the input
+        # array IS the output, with no per-step carry copies paid.
+        rel = node_releasing
 
     num_jobs = job_allowed.shape[0]
     placed_per_job = jax.ops.segment_sum(group_placed, group_job,
@@ -408,7 +685,9 @@ def _next_pow2(n: int) -> int:
 @functools.partial(jax.jit,
                    static_argnames=("max_group", "t_pad", "gpu_strategy",
                                     "cpu_strategy", "allow_pipeline",
-                                    "pipeline_only", "single_group_jobs"))
+                                    "pipeline_only", "single_group_jobs",
+                                    "fused_mode", "releasing_empty",
+                                    "f32_keys"))
 def _allocate_groups_packed(node_allocatable, node_idle, node_releasing,
                             node_labels, node_taints, node_pod_room,
                             group_req, group_sel, group_tol, group_count,
@@ -458,6 +737,46 @@ def _allocate_groups_packed(node_allocatable, node_idle, node_releasing,
     return packed, idle, rel
 
 
+def _resolve_fused_mode(requested: str | None, n_nodes: int) -> str:
+    """Resolve the fallback ladder TPU-Pallas -> fused-jnp -> legacy.
+
+    Explicit request (session config / tests) wins, then the
+    KAI_FUSED_ALLOC env pin, then ``auto``: the Pallas node-tile kernel
+    on a TPU backend whose node bucket tiles evenly, the fused jnp
+    formulation everywhere else.  ``legacy`` is only ever an explicit
+    choice — it exists for the parity suites and as the operator's
+    escape hatch, not as an automatic fallback target."""
+    mode = (requested or os.environ.get(_FUSED_ENV) or "auto").strip()
+    if mode not in FUSED_MODES:
+        # An unrecognized pin (case typo mid-incident) must be LOUD, not
+        # silently coerced back onto the rung the operator tried to
+        # escape.
+        from ..utils.logging import LOG
+        from ..utils.metrics import METRICS
+        LOG.warning("allocate_grouped: unrecognized %s=%r (valid: %s); "
+                    "using auto", _FUSED_ENV, mode, "|".join(FUSED_MODES))
+        METRICS.inc("allocate_fused_invalid_mode_total")
+        mode = "auto"
+    if mode == "auto":
+        if jax.default_backend() == "tpu":
+            from .pallas_kernels import NODE_TILE, pallas_available
+            if pallas_available() and n_nodes >= NODE_TILE \
+                    and n_nodes % NODE_TILE == 0:
+                return "pallas"
+        return "jnp"
+    if mode == "pallas":
+        # An explicitly pinned Pallas rung still needs a tileable node
+        # bucket and an importable Pallas; downgrade one rung (loudly,
+        # via the downgrade counter) instead of crashing mid-dispatch.
+        from .pallas_kernels import NODE_TILE, pallas_available
+        tile = min(NODE_TILE, max(n_nodes, 1))
+        if not (pallas_available() and n_nodes and n_nodes % tile == 0):
+            from ..utils.metrics import METRICS
+            METRICS.inc("allocate_fused_downgrade_total")
+            return "jnp"
+    return mode
+
+
 def allocate_grouped(node_arrays, task_req, task_job, task_selector,
                      task_tolerations, job_allowed,
                      gpu_strategy: int = BINPACK,
@@ -466,7 +785,10 @@ def allocate_grouped(node_arrays, task_req, task_job, task_selector,
                      pipeline_only: bool = False,
                      independent_jobs=None,
                      extra_scores=None,
-                     node_mask=None) -> AllocationResult:
+                     node_mask=None,
+                     fused_mode: str | None = None,
+                     has_releasing: bool | None = None,
+                     f32_keys: bool | None = None) -> AllocationResult:
     """Host wrapper: group prep -> group-scan kernel (with on-device
     per-task expansion).
 
@@ -482,6 +804,16 @@ def allocate_grouped(node_arrays, task_req, task_job, task_selector,
     see allocate_groups_kernel.  ``node_mask``: [J,N] bool per-job hard
     feasibility rows.  Jobs with either disable group merging across job
     boundaries (rows differ) but still fill in one step per group.
+
+    ``fused_mode``: pallas | jnp | legacy | auto (default: the
+    KAI_FUSED_ALLOC env pin, else auto — see ``_resolve_fused_mode``).
+    ``has_releasing``: host-verified hint that the releasing pool has any
+    nonzero entry; callers holding host mirrors (the session via the
+    arena state cache) pass it so the no-releasing fused specialization
+    engages without fetching resident device state.  ``None`` checks the
+    array directly off-TPU and conservatively assumes releasing capacity
+    on TPU (a hint fetch there would pay the tunnel round trip the arena
+    exists to avoid).
     """
     np_req = np.asarray(task_req)
     np_job = np.asarray(task_job)
@@ -545,7 +877,7 @@ def allocate_grouped(node_arrays, task_req, task_job, task_selector,
         # Per-JOB rows, padded to the job axis; groups gather their job's
         # row on device (no [G,N] host expansion).  f32 is exact for tier
         # constants (multiples of 10 below 2^24).
-        n_nodes = int(np.asarray(node_arrays[0]).shape[0])
+        n_nodes = int(node_arrays[0].shape[0])
         if extra_scores is not None:
             j_extra = np.zeros((n_jobs_padded, n_nodes), np.float32)
             j_extra[:n_real_jobs] = np.asarray(extra_scores)
@@ -555,6 +887,34 @@ def allocate_grouped(node_arrays, task_req, task_job, task_selector,
             j_mask[:n_real_jobs] = np.asarray(node_mask)
             kw["group_mask"] = jnp.asarray(j_mask)
 
+    # Shape metadata only — never np.asarray a possibly-device-resident
+    # tensor here (that is a full host fetch on the tunneled TPU).
+    n_nodes_padded = int(node_arrays[0].shape[0])
+    mode = _resolve_fused_mode(fused_mode, n_nodes_padded)
+    releasing_empty = False
+    if mode != "legacy" and not pipeline_only:
+        if has_releasing is None:
+            # Off-TPU the releasing array is host-adjacent (CPU backend)
+            # so the hint is one cheap scan; on TPU assume releasing
+            # capacity rather than fetch resident arena state for a hint.
+            has_releasing = True if jax.default_backend() == "tpu" \
+                else bool(np.asarray(node_arrays[2]).any())
+        releasing_empty = not has_releasing
+    if f32_keys is None:
+        # KAI_F32_SCORE_KEYS=1 simulates the TPU key downcast on any
+        # backend (the precision-split suite's end-to-end hook).
+        f32_keys = os.environ.get("KAI_F32_SCORE_KEYS") == "1"
+
+    from ..utils.metrics import METRICS
+    if mode != "legacy":
+        METRICS.inc("allocate_fused_taken_total", mode=mode)
+    # The guard may run this wrapper on its watchdog worker thread, where
+    # cycle spans deliberately no-op — so the resolved rung is published
+    # here and the CALL SITES (session fast path, bulk action) emit the
+    # ``allocate_fused`` span on the cycle thread from these stats.
+    LAST_DISPATCH.update(mode=mode, groups=n_real_groups,
+                         nodes=n_nodes_padded,
+                         releasing_empty=releasing_empty)
     packed, idle, rel = _allocate_groups_packed(
         *node_arrays, jnp.asarray(g_req), jnp.asarray(g_sel),
         jnp.asarray(g_tol), jnp.asarray(g_count), jnp.asarray(g_job),
@@ -562,7 +922,8 @@ def allocate_grouped(node_arrays, task_req, task_job, task_selector,
         t_pad=t_pad, group_indep=jnp.asarray(g_indep),
         gpu_strategy=gpu_strategy, cpu_strategy=cpu_strategy,
         allow_pipeline=allow_pipeline, pipeline_only=pipeline_only,
-        single_group_jobs=single, **kw)
+        single_group_jobs=single, fused_mode=mode,
+        releasing_empty=releasing_empty, f32_keys=f32_keys, **kw)
     packed = np.asarray(packed)  # ONE device->host fetch
     enc = packed[:T]
     placements = np.where(enc >= -1, enc, -enc - 2).astype(np.int32)

@@ -30,6 +30,11 @@ def apply_deltas_kernel(idle, releasing, room, rows, idle_vals,
     leaves the previous resident arrays untouched.
     """
     rows = rows.astype(jnp.int32)
-    return (idle.at[rows].set(idle_vals),
-            releasing.at[rows].set(releasing_vals),
-            room.at[rows].set(room_vals))
+    # Pin value dtypes to the resident arrays': a width drift between the
+    # host mirrors and device state (x64 tests vs 32-bit production, or a
+    # future bf16 residency) must scatter in the RESIDENT width instead
+    # of promoting the whole [N,R] state on every delta — the promoted
+    # result would silently evict the cached buffers each cycle.
+    return (idle.at[rows].set(idle_vals.astype(idle.dtype)),
+            releasing.at[rows].set(releasing_vals.astype(releasing.dtype)),
+            room.at[rows].set(room_vals.astype(room.dtype)))

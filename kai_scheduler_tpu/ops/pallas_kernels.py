@@ -1,15 +1,25 @@
-"""Pallas TPU kernels for the per-task hot row ops.
+"""Pallas TPU kernels for the allocation hot row ops.
 
-The gang-allocation inner loop evaluates, per candidate task, a fused
-feasibility + capacity + bin-pack-score pass over every node.  XLA already
-fuses the jnp formulation well; this Pallas version keeps the whole pass in
-one VMEM-resident kernel over node tiles — one HBM read of the node state
-per evaluation, no intermediate materialization — and serves as the
-hand-tuned escape hatch for the largest node counts.
+Two generations of kernels live here:
 
-Semantics match ops.predicates.feasibility_row + the capacity math of
-ops.allocate_grouped (parity-tested); the public entry falls back to the
-jnp path on non-TPU backends or when shapes don't tile.
+- ``task_row_pallas`` — the original per-TASK row pass (feasibility +
+  capacity for one task against all nodes), kept as the escape hatch for
+  the exact per-task kernel's largest shapes;
+- ``group_step_pallas`` — the fused per-GROUP-STEP row pass the grouped
+  fill-plan kernel (ops/allocate_grouped, fused_mode="pallas") runs
+  inside its scan.  One ``pallas_call`` with a (phase, node-tile) grid
+  sweeps the resident node state twice, entirely in VMEM per tile:
+  phase 0 accumulates the bin-pack min/max over the task's valid nodes
+  into SMEM scratch; phase 1 emits the fill keys (sign-flipped f32 score
+  bitcasts, ready for the radix-descent fill) and the idle/total
+  whole-task capacities.  That is TWO HBM reads of the node tensors per
+  group step and zero materialized [N]-wide intermediates, versus the
+  ~dozen reduction-separated passes of the unfused composition.
+
+Semantics match ops.predicates.feasibility_caps_row +
+ops.scoring.score_row_selected at f32 (parity-tested in interpret mode);
+the host wrapper's mode resolution falls back to the fused-jnp path on
+non-TPU backends or when the node bucket doesn't tile.
 """
 
 from __future__ import annotations
@@ -120,6 +130,241 @@ def task_row_pallas(req, sel, tol, node_idle, node_releasing, node_labels,
       node_allocatable.astype(jnp.float32))
     return (fit_now[:, 0] > 0.5, fit_fut[:, 0] > 0.5,
             cap_now[:, 0], cap_tot[:, 0])
+
+
+def _tile_row_terms(req, sel, tol, idle, rel, labels, taints, room,
+                    mask, releasing_empty: bool):
+    """Shared per-tile feasibility + capacity terms (f32, unrolled R).
+
+    Mirrors predicates.feasibility_caps_row on one VMEM-resident tile:
+    req/sel/tol are [1, X] rows, node state is [TILE, X].  Returns
+    (fit_now, fit_future, cap_now_f, cap_tot_f), each [TILE, 1]."""
+    from .predicates import EPS, NO_LABEL, NO_TAINT
+    sel_ok = jnp.all((sel == NO_LABEL) | (sel == labels), axis=-1,
+                     keepdims=True)
+    tolerated = jnp.any(taints[:, :, None] == tol[0][None, None, :],
+                        axis=-1)
+    taint_ok = jnp.all((taints == NO_TAINT) | tolerated, axis=-1,
+                       keepdims=True)
+    hard = sel_ok & taint_ok & (room >= 1.0)
+    if mask is not None:
+        hard = hard & (mask > 0.5)
+
+    r_dims = idle.shape[1]
+    fits_idle = hard
+    fits_total = hard
+    cap_now_f = None
+    cap_tot_f = None
+    for r in range(r_dims):
+        rq = req[0, r]
+        safe = jnp.where(rq > 0, rq, 1.0)
+        col = idle[:, r:r + 1]
+        fits_idle = fits_idle & (rq <= col + EPS)
+        ratio = jnp.where(rq > 0, jnp.floor(col / safe), jnp.inf)
+        cap_now_f = ratio if cap_now_f is None \
+            else jnp.minimum(cap_now_f, ratio)
+        if not releasing_empty:
+            tot = col + rel[:, r:r + 1]
+            fits_total = fits_total & (rq <= tot + EPS)
+            ratio_t = jnp.where(rq > 0, jnp.floor(tot / safe), jnp.inf)
+            cap_tot_f = ratio_t if cap_tot_f is None \
+                else jnp.minimum(cap_tot_f, ratio_t)
+    if releasing_empty:
+        return fits_idle, fits_idle, cap_now_f, cap_now_f
+    return fits_idle, fits_total, cap_now_f, cap_tot_f
+
+
+def _f32_key(score):
+    """Order-preserving u32 key for an f32 score (per-lane form of
+    ops.allocate_grouped._score_keys' f32 branch)."""
+    bits = jax.lax.bitcast_convert_type(score, jnp.uint32)
+    return jnp.where(bits >> jnp.uint32(31) == 1, ~bits,
+                     bits | jnp.uint32(1 << 31))
+
+
+def group_step_pallas(node_allocatable, idle, rel, node_labels,
+                      node_taints, room, req, sel, tol, extra_row,
+                      mask_row, gpu_strategy: int, cpu_strategy: int,
+                      allow_pipeline: bool, pipeline_only: bool,
+                      releasing_empty: bool, pipe_items: bool,
+                      interpret: bool | None = None):
+    """Fused per-group-step row pass over node tiles: returns
+    (key_now, key_pipe | None, cap_now, cap_tot | None, levels, utype)
+    exactly like ops.allocate_grouped._fused_row, computed at f32.
+
+    Grid (2, n_tiles): phase 0 reduces the selected resource column's
+    valid min/max into SMEM scratch; phase 1 recomputes the tile terms
+    from VMEM and writes keys + capacities.  ``interpret`` defaults to
+    True off-TPU (the test suite's parity path); on TPU the kernel
+    compiles to Mosaic."""
+    from jax.experimental import pallas as pl
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    from ..api.resources import RES_CPU, RES_GPU
+    from .scoring import (AVAILABILITY, MAX_HIGH_DENSITY, RESOURCE_TYPE,
+                          SPREAD)
+
+    n = idle.shape[0]
+    tile = min(NODE_TILE, n)
+    if n % tile != 0:
+        raise ValueError(f"node count {n} must tile by {tile}")
+    n_tiles = n // tile
+    r = idle.shape[1]
+    L = node_labels.shape[1]
+    tt = node_taints.shape[1]
+    have_rel = not releasing_empty
+    have_extra = extra_row is not None
+    have_mask = mask_row is not None
+
+    def kernel(*refs):
+        it = iter(refs)
+        req_ref, sel_ref, tol_ref = next(it), next(it), next(it)
+        alloc_ref, idle_ref = next(it), next(it)
+        rel_ref = next(it) if have_rel else None
+        labels_ref, taints_ref, room_ref = next(it), next(it), next(it)
+        extra_ref = next(it) if have_extra else None
+        mask_ref = next(it) if have_mask else None
+        key_now_ref = next(it)
+        cap_now_ref = next(it)
+        key_pipe_ref = next(it) if pipe_items else None
+        cap_tot_ref = next(it) if pipe_items else None
+        minmax = next(it)  # SMEM scratch [2]
+
+        phase = pl.program_id(0)
+        j = pl.program_id(1)
+
+        reqv = req_ref[...]
+        idlev = idle_ref[...]
+        relv = rel_ref[...] if have_rel else None
+        roomv = room_ref[...]
+        allocv = alloc_ref[...]
+        maskv = mask_ref[...] if have_mask else None
+
+        fit_now, fit_future, cap_now_f, cap_tot_f = _tile_row_terms(
+            reqv, sel_ref[...], tol_ref[...], idlev, relv,
+            labels_ref[...], taints_ref[...], roomv, maskv,
+            releasing_empty)
+        if pipeline_only:
+            fit_now = jnp.zeros_like(fit_now)
+        feasible = fit_now | (fit_future
+                              if (allow_pipeline or pipeline_only)
+                              else jnp.zeros_like(fit_future))
+
+        is_gpu_job = reqv[0, RES_GPU] > 0.0
+        free = jnp.where(is_gpu_job, idlev[:, RES_GPU:RES_GPU + 1],
+                         idlev[:, RES_CPU:RES_CPU + 1])
+        axcap = jnp.where(is_gpu_job, allocv[:, RES_GPU:RES_GPU + 1],
+                          allocv[:, RES_CPU:RES_CPU + 1])
+        has_res = axcap > 0.0
+        valid = feasible & has_res
+
+        @pl.when(phase == 0)
+        def _accumulate():
+            tile_min = jnp.min(jnp.where(valid, free, jnp.inf))
+            tile_max = jnp.max(jnp.where(valid, free, -jnp.inf))
+
+            @pl.when(j == 0)
+            def _init():
+                minmax[0] = tile_min
+                minmax[1] = tile_max
+
+            @pl.when(j != 0)
+            def _fold():
+                minmax[0] = jnp.minimum(minmax[0], tile_min)
+                minmax[1] = jnp.maximum(minmax[1], tile_max)
+
+        @pl.when(phase == 1)
+        def _emit():
+            if gpu_strategy == SPREAD:  # == cpu_strategy (wrapper gate)
+                placement = jnp.where(
+                    has_res, free / jnp.where(has_res, axcap, 1.0), 0.0)
+            else:
+                min_free = minmax[0]
+                max_free = minmax[1]
+                span = max_free - min_free
+                flat = span <= 0.0
+                placement = MAX_HIGH_DENSITY * (
+                    1.0 - (free - min_free) / jnp.where(flat, 1.0, span))
+                placement = jnp.where(flat, MAX_HIGH_DENSITY, placement)
+                placement = jnp.where(has_res, placement, 0.0)
+            node_has_gpu = allocv[:, RES_GPU:RES_GPU + 1] > 0.0
+            rtype = jnp.where(
+                jnp.where(is_gpu_job, node_has_gpu, ~node_has_gpu),
+                RESOURCE_TYPE, 0.0)
+            score = placement + rtype \
+                + jnp.where(fit_now, AVAILABILITY, 0.0)
+            if have_extra:
+                score = score + extra_ref[...]
+            score = jnp.where(feasible, score, NEG)
+            key_now_ref[...] = _f32_key(score)
+            cap_now_ref[...] = jnp.where(
+                fit_now, jnp.minimum(cap_now_f, roomv), 0.0)
+            if pipe_items:
+                score_pipe = score - jnp.where(fit_now, AVAILABILITY, 0.0)
+                key_pipe_ref[...] = _f32_key(score_pipe)
+                cap_tot_ref[...] = jnp.where(
+                    feasible, jnp.minimum(cap_tot_f, roomv), 0.0)
+
+        # Phase 0 leaves the output blocks untouched; write zeros so the
+        # inter-visit flush is deterministic (phase 1 overwrites).
+        @pl.when(phase == 0)
+        def _zero_outputs():
+            key_now_ref[...] = jnp.zeros_like(key_now_ref)
+            cap_now_ref[...] = jnp.zeros_like(cap_now_ref)
+            if pipe_items:
+                key_pipe_ref[...] = jnp.zeros_like(key_pipe_ref)
+                cap_tot_ref[...] = jnp.zeros_like(cap_tot_ref)
+
+    def node_block(cols):
+        return pl.BlockSpec((tile, cols), lambda p, j: (j, 0))
+
+    def bcast_block(cols):
+        return pl.BlockSpec((1, cols), lambda p, j: (0, 0))
+
+    in_specs = [bcast_block(r), bcast_block(L), bcast_block(tol.shape[0]),
+                node_block(r), node_block(r)]
+    args = [req[None, :].astype(jnp.float32),
+            sel[None, :].astype(jnp.int32),
+            tol[None, :].astype(jnp.int32),
+            node_allocatable.astype(jnp.float32),
+            idle.astype(jnp.float32)]
+    if have_rel:
+        in_specs.append(node_block(r))
+        args.append(rel.astype(jnp.float32))
+    in_specs += [node_block(L), node_block(tt), node_block(1)]
+    args += [node_labels.astype(jnp.int32), node_taints.astype(jnp.int32),
+             room.astype(jnp.float32)[:, None]]
+    if have_extra:
+        in_specs.append(node_block(1))
+        args.append(extra_row.astype(jnp.float32)[:, None])
+    if have_mask:
+        in_specs.append(node_block(1))
+        args.append(mask_row.astype(jnp.float32)[:, None])
+
+    n_outs = 4 if pipe_items else 2
+    out_shape = ([jax.ShapeDtypeStruct((n, 1), jnp.uint32),
+                  jax.ShapeDtypeStruct((n, 1), jnp.float32)]
+                 + ([jax.ShapeDtypeStruct((n, 1), jnp.uint32),
+                     jax.ShapeDtypeStruct((n, 1), jnp.float32)]
+                    if pipe_items else []))
+    from jax.experimental.pallas import tpu as pltpu
+    scratch = [pltpu.SMEM((2,), jnp.float32)]
+
+    outs = pl.pallas_call(
+        kernel,
+        grid=(2, n_tiles),
+        in_specs=in_specs,
+        out_specs=[node_block(1)] * n_outs,
+        out_shape=out_shape,
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(*args)
+    key_now = outs[0][:, 0]
+    cap_now = outs[1][:, 0]
+    key_pipe = outs[2][:, 0] if pipe_items else None
+    cap_tot = outs[3][:, 0] if pipe_items else None
+    return key_now, key_pipe, cap_now, cap_tot, 4, jnp.uint32
 
 
 def pallas_available() -> bool:

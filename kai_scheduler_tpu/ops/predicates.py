@@ -55,6 +55,57 @@ def feasibility_masks(node_idle, node_releasing, node_labels, node_taints,
     )(task_req, task_selector, task_tolerations)
 
 
+def feasibility_caps_row(idle, releasing, labels, taints, room,
+                         req, selector, tolerations):
+    """Fused single-pass variant of ``feasibility_row`` + the grouped
+    kernel's whole-task capacity math: one read of the node state yields
+    (fit_now, fit_future, cap_now_f, cap_tot_f), each [N].
+
+    The resource axis is unrolled (R is static and small), so XLA sees a
+    single elementwise DAG per node instead of a chain of [N,R]
+    broadcast+reduce ops — the per-group-step formulation the fused
+    allocation kernel (ops/allocate_grouped) runs inside its scan.  The
+    float semantics are formula-identical to ``feasibility_row``:
+    comparisons against ``idle + EPS``, capacity as floor(idle/req)
+    bounded later by the caller; min/all over R reassociate only exact
+    operations (min is exact; the boolean chain is order-free).
+
+    ``releasing=None`` declares the caller has proven the releasing pool
+    empty: fit_future and cap_tot_f alias the fit-now outputs (with
+    releasing == 0 the legacy formulas reduce to exactly that, including
+    EPS behaviour).
+    """
+    sel_ok = jnp.all((selector[None, :] == NO_LABEL)
+                     | (selector[None, :] == labels), axis=-1)
+    tol = jnp.any(taints[:, :, None] == tolerations[None, None, :], axis=-1)
+    taint_ok = jnp.all((taints == NO_TAINT) | tol, axis=-1)
+    hard = sel_ok & taint_ok & (room >= 1.0)
+
+    r_dims = idle.shape[1]
+    fits_idle = hard
+    fits_total = hard
+    cap_now_f = None
+    cap_tot_f = None
+    inf = jnp.asarray(jnp.inf, idle.dtype)
+    for r in range(r_dims):
+        rq = req[r]
+        safe = jnp.where(rq > 0, rq, 1.0)
+        col = idle[:, r]
+        fits_idle = fits_idle & (rq <= col + EPS)
+        ratio = jnp.where(rq > 0, jnp.floor(col / safe), inf)
+        cap_now_f = ratio if cap_now_f is None \
+            else jnp.minimum(cap_now_f, ratio)
+        if releasing is not None:
+            tot = col + releasing[:, r]
+            fits_total = fits_total & (rq <= tot + EPS)
+            ratio_t = jnp.where(rq > 0, jnp.floor(tot / safe), inf)
+            cap_tot_f = ratio_t if cap_tot_f is None \
+                else jnp.minimum(cap_tot_f, ratio_t)
+    if releasing is None:
+        return fits_idle, fits_idle, cap_now_f, cap_now_f
+    return fits_idle, fits_total, cap_now_f, cap_tot_f
+
+
 # -- standalone sub-masks (used directly by tests/tools) --------------------
 
 @jax.jit

@@ -86,6 +86,56 @@ def score_row(allocatable, idle, req, fit_any, fit_now,
     return placement + rtype + avail
 
 
+def score_row_selected(allocatable, idle, req, fit_any, fit_now,
+                       gpu_strategy: int, cpu_strategy: int, minmax=None):
+    """Value-identical reformulation of ``score_row`` that SELECTS the
+    scored resource column first (one [N] where) and runs the
+    binpack/spread arithmetic once, instead of evaluating both the GPU
+    and the CPU axis and where-merging at the end — ``is_gpu_job`` is a
+    traced scalar, so the two-branch form pays for both axes on every
+    scan step.
+
+    Exactness: every step (masked min/max, span, the scaled-density
+    formula) is elementwise or an exact reduction over the selected
+    column, so selecting before computing equals computing both branches
+    and selecting after.  Only valid when both strategies agree (the
+    strategy choice is static Python); the caller falls back to
+    ``score_row`` otherwise.
+    """
+    assert gpu_strategy == cpu_strategy, \
+        "column-selected scoring needs one strategy for both axes"
+    strategy = gpu_strategy
+    is_gpu_job = req[RES_GPU] > 0.0
+    free = jnp.where(is_gpu_job, idle[:, RES_GPU], idle[:, RES_CPU])
+    cap = jnp.where(is_gpu_job, allocatable[:, RES_GPU],
+                    allocatable[:, RES_CPU])
+    has_res = cap > 0.0
+    if strategy == SPREAD:
+        placement = jnp.where(has_res,
+                              free / jnp.where(has_res, cap, 1.0), 0.0)
+    else:
+        if minmax is not None:
+            min_free = jnp.where(is_gpu_job, minmax[0, RES_GPU],
+                                 minmax[0, RES_CPU])
+            max_free = jnp.where(is_gpu_job, minmax[1, RES_GPU],
+                                 minmax[1, RES_CPU])
+        else:
+            valid = fit_any & has_res
+            min_free = jnp.min(jnp.where(valid, free, jnp.inf))
+            max_free = jnp.max(jnp.where(valid, free, -jnp.inf))
+        span = max_free - min_free
+        flat = span <= 0.0
+        placement = MAX_HIGH_DENSITY * (
+            1.0 - (free - min_free) / jnp.where(flat, 1.0, span))
+        placement = jnp.where(flat, MAX_HIGH_DENSITY, placement)
+        placement = jnp.where(has_res, placement, 0.0)
+    node_has_gpu = allocatable[:, RES_GPU] > 0.0
+    rtype = jnp.where(jnp.where(is_gpu_job, node_has_gpu, ~node_has_gpu),
+                      RESOURCE_TYPE, 0.0)
+    avail = jnp.where(fit_now, AVAILABILITY, 0.0)
+    return placement + rtype + avail
+
+
 @functools.partial(jax.jit, static_argnames=("gpu_strategy", "cpu_strategy"))
 def placement_scores(node_allocatable, node_idle, task_req, fit_mask,
                      gpu_strategy: int = BINPACK,

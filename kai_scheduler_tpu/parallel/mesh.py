@@ -32,6 +32,23 @@ def node_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(NODE_AXIS))
 
 
+def shard_map_compat(mesh: Mesh, in_specs, out_specs):
+    """``jax.shard_map`` across jax versions.
+
+    Newer jax exposes it at the top level with ``check_vma``; 0.4.x only
+    has ``jax.experimental.shard_map.shard_map`` with the equivalent
+    ``check_rep`` knob.  Both sharded kernels decorate through here so
+    the multi-chip suite runs on whichever jax the image bakes in."""
+    import functools
+    if hasattr(jax, "shard_map"):
+        return functools.partial(jax.shard_map, mesh=mesh,
+                                 in_specs=in_specs, out_specs=out_specs,
+                                 check_vma=False)
+    from jax.experimental.shard_map import shard_map as _shard_map
+    return functools.partial(_shard_map, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_rep=False)
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 

@@ -45,6 +45,11 @@ LATENCY_TESTS = ["tests/test_lifecycle.py"]
 # boundaries, and fenced evicts while incremental-vs-full equivalence
 # (and identical allocate placements) is asserted at every step.
 INCREMENTAL_TESTS = ["tests/test_incremental_cache.py"]
+# --fused: the fused-allocation parity ring — each seed regenerates the
+# randomized workloads (tests/test_fused_parity.py reads KAI_FAULT_SEED
+# into its instance generator) and re-proves legacy/jnp/Pallas
+# bit-identity plus the breaker-open fallback.
+FUSED_TESTS = ["tests/test_fused_parity.py"]
 
 
 def run_iteration(seed: int, tests: list[str], marker: str,
@@ -109,6 +114,11 @@ def main(argv=None) -> int:
                          "each seed reshuffles churn/resync/fence "
                          "interleavings while incremental-vs-full "
                          "snapshot equivalence is asserted")
+    ap.add_argument("--fused", action="store_true",
+                    help="fused mode: sweep the fused-allocation parity "
+                         f"ring ({FUSED_TESTS}) — each seed regenerates "
+                         "the randomized workloads and re-proves "
+                         "legacy/jnp/Pallas placement bit-identity")
     ap.add_argument("-k", "--keyword", default=None,
                     help="pytest -k filter (narrow the smoke subset)")
     ap.add_argument("--marker", default="chaos",
@@ -132,11 +142,12 @@ def main(argv=None) -> int:
     if args.tests:
         tests = args.tests
     else:
-        # Modes compose: --arena --latency --incremental sweeps every
-        # selected suite per seed.
+        # Modes compose: --arena --latency --incremental --fused sweeps
+        # every selected suite per seed.
         tests = (ARENA_TESTS if args.arena else []) + \
             (LATENCY_TESTS if args.latency else []) + \
-            (INCREMENTAL_TESTS if args.incremental else [])
+            (INCREMENTAL_TESTS if args.incremental else []) + \
+            (FUSED_TESTS if args.fused else [])
         if not tests:
             tests = DEFAULT_TESTS
     repo_root = os.path.dirname(os.path.dirname(os.path.dirname(

@@ -9,6 +9,9 @@
 #                 (docs/STATIC_ANALYSIS.md) against the committed
 #                 baseline (.kailint-baseline.json)
 #   chaos matrix  --dry-run validation of the fault-grid definition
+#   kernel parity fused-allocation ladder (Pallas/jnp/legacy) vs the
+#                 exact kernel: placements must be bit-identical
+#                 (tools/kernel_parity.py --smoke)
 #   stackprof     continuous-profiler smoke: profile a short embedded
 #                 fleet burst, fail on an empty folded profile
 #   fleet budget  bench.py fleet phase at a small shape vs the committed
@@ -38,6 +41,11 @@ python -m kai_scheduler_tpu.tools.kailint kai_scheduler_tpu/ || fail=1
 echo
 echo "== chaos matrix definition (dry run) =="
 python -m kai_scheduler_tpu.tools.chaos_matrix --dry-run || fail=1
+
+echo
+echo "== kernel-parity smoke (fused ladder vs legacy vs exact) =="
+JAX_PLATFORMS=cpu python -m kai_scheduler_tpu.tools.kernel_parity \
+    --smoke || fail=1
 
 echo
 echo "== stackprof smoke (profile a short fleet burst) =="

@@ -11,8 +11,16 @@ result against ``docs/scale-tests/fleet_budget.json``:
 - **structural gates** (deterministic): the incremental cache must
   actually run incrementally (``cluster_cache_full_refresh_total`` stays
   at priming counts — a fallback-per-cycle regression multiplies it by
-  the cycle count) and the podgrouper's owner-resolution memo must see
-  hits.  Wall clocks flake with CI noise; these do not.
+  the cycle count), the podgrouper's owner-resolution memo must see
+  hits, and the GROUPED ALLOCATION path must actually take the fused
+  kernel (``allocate_fused_taken_total`` counts per wrapper dispatch —
+  a silent fall-back-to-legacy regression zeroes it while every
+  wall-clock gate still passes on a fast machine);
+- **allocate-kernel ceiling**: the grouped kernel itself is re-measured
+  at a small committed shape (``allocate_shape``) and its median must
+  stay under ``max_allocate_ms`` — the device-path analog of the
+  host-pipeline medians above, so a fused-kernel regression is caught
+  here instead of three PRs later at bench scale.
 
 Usage (ci_check.sh runs it):
 
@@ -50,11 +58,40 @@ def main(argv=None) -> int:
 
     shape = budget["shape"]
     refresh0 = METRICS.counters.get("cluster_cache_full_refresh_total", 0)
+
+    def fused_taken():
+        return sum(v for k, v in METRICS.counters.items()
+                   if str(k).startswith("allocate_fused_taken_total"))
+
+    fused0 = fused_taken()
     result = bench.fleet_phase(shape["nodes"], shape["jobs"],
                                shape["gang"])
     refreshes = METRICS.counters.get(
         "cluster_cache_full_refresh_total", 0) - refresh0
     owner_hits = METRICS.counters.get("podgrouper_owner_cache_hits", 0)
+    fused_calls = fused_taken() - fused0
+
+    # Allocate-kernel micro-measurement: the grouped kernel alone at the
+    # committed shape, warm median over 5 runs.
+    import time as _time
+
+    import numpy as np
+
+    from kai_scheduler_tpu.ops.allocate_grouped import allocate_grouped
+    ashape = budget.get("allocate_shape",
+                        {"nodes": 1024, "jobs": 16, "gang": 64})
+    arrs = bench.build_arrays(ashape["nodes"], ashape["jobs"],
+                              ashape["gang"], placeable=True)
+    anodes, atasks = arrs[:6], arrs[6:10]
+    # kailint: disable=KAI004 — budget micro-bench, no Session to dispatch through
+    allocate_grouped(anodes, *atasks, arrs[10])  # warm/compile
+    ts = []
+    for _ in range(5):
+        t0 = _time.perf_counter()
+        # kailint: disable=KAI004 — budget micro-bench, no Session to dispatch through
+        allocate_grouped(anodes, *atasks, arrs[10])
+        ts.append((_time.perf_counter() - t0) * 1000.0)
+    allocate_ms = float(np.median(ts))
 
     medians = result.get("pod_latency", {}).get("phase_median_ms", {})
     bound = result.get("pod_latency", {}).get("bound_pods", 0)
@@ -71,6 +108,10 @@ def main(argv=None) -> int:
          "<=", budget["max_full_refreshes"]),
         ("podgrouper_owner_cache_hits", owner_hits,
          ">=", budget["min_owner_cache_hits"]),
+        ("allocate_fused_taken", fused_calls,
+         ">=", budget.get("min_fused_taken", 1)),
+        ("allocate_kernel_median_ms", round(allocate_ms, 1),
+         "<=", budget.get("max_allocate_ms", 400)),
     ]
 
     failed = []

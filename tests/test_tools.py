@@ -249,6 +249,28 @@ class TestChaosMatrixDryRun:
         assert "tests/test_lifecycle.py" in out
         assert "tests/test_snapshot_delta.py" in out
 
+    def test_dry_run_fused_mode_selects_parity_suite(self, capsys,
+                                                     monkeypatch):
+        """--fused sweeps the fused-allocation parity ring; composing
+        with --incremental sweeps both suites per seed."""
+        from kai_scheduler_tpu.tools import chaos_matrix
+        monkeypatch.setattr(
+            chaos_matrix.subprocess, "run",
+            lambda *a, **kw: (_ for _ in ()).throw(AssertionError(
+                "dry run must not execute iterations")))
+        rc = chaos_matrix.main(["--dry-run", "--fused", "--seeds", "3,5"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert out.count("seed ") == 2
+        assert "tests/test_fused_parity.py" in out
+        assert "tests/test_reconciler.py" not in out
+        rc = chaos_matrix.main(["--dry-run", "--fused", "--incremental",
+                                "--seeds", "3"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "tests/test_fused_parity.py" in out
+        assert "tests/test_incremental_cache.py" in out
+
     def test_dry_run_respects_iterations_default_seeds(self, capsys,
                                                        monkeypatch):
         from kai_scheduler_tpu.tools import chaos_matrix
