@@ -295,6 +295,292 @@ def fleet_phase(n_nodes=2000, n_jobs=8, gang=100, waves=2):
     }
 
 
+def forest_parent_indices(n_queues, roots=16, fanouts=(2, 2, 2, 2, 2, 8)):
+    """Parent index per queue (-1 = root) for the multi-tenant org
+    forest: ``roots`` top-level tenants, breadth-first fanout per depth
+    (depth ~ len(fanouts)).  The one source of truth for the churn
+    ring's topology — the API-object builder and the fair-share
+    microbench both derive from it, so the committed ``fairshare-10k-ab``
+    rows measure exactly the forest the ``churn-ring`` row runs."""
+    parent = np.full(n_queues, -1, np.int64)
+    cur = list(range(min(roots, n_queues)))
+    next_id, depth = len(cur), 1
+    while next_id < n_queues:
+        nxt = []
+        fanout = fanouts[min(depth - 1, len(fanouts) - 1)]
+        for p in cur:
+            for _ in range(fanout):
+                if next_id >= n_queues:
+                    break
+                parent[next_id] = p
+                nxt.append(next_id)
+                next_id += 1
+            if next_id >= n_queues:
+                break
+        cur = nxt or cur
+        depth += 1
+    return parent
+
+
+def build_queue_forest(n_queues, roots=16, fanouts=(2, 2, 2, 2, 2, 8)):
+    """Queue manifests for the forest of ``forest_parent_indices``.
+    Returns (queue_objs, leaf_names) — pods submit against the leaves."""
+    parent = forest_parent_indices(n_queues, roots, fanouts)
+    names = [f"org-{i:03d}" if parent[i] < 0 else f"q{i:05d}"
+             for i in range(n_queues)]
+    has_child = set(parent[parent >= 0].tolist())
+    leaves = [names[i] for i in range(n_queues) if i not in has_child]
+    objs = [{"kind": "Queue", "metadata": {"name": names[i]},
+             "spec": ({"parentQueue": names[parent[i]]}
+                      if parent[i] >= 0 else {})}
+            for i in range(n_queues)]
+    return objs, leaves
+
+
+def fairshare_microbench(n_queues=10000, roots=16,
+                         fanouts=(2, 2, 2, 2, 2, 8), bands=1,
+                         mode="forest", iters=7, seed=0):
+    """The fair-share STEP alone at scale: what one cycle of the
+    proportion plugin's division costs in each mode.
+
+    ``looped`` measures what every cycle paid before the forest kernel:
+    a fresh ``QueueHierarchy.build`` (the plugin rebuilt it per cycle)
+    plus one ``divide_groups_jax`` dispatch per level.  ``forest``
+    measures the shipped path: the prep-cache hash plus ONE fused
+    dispatch (ops/fairshare.fair_share_forest).  Both paths produce
+    bit-identical shares (asserted here; property-tested in
+    tests/test_fairshare_forest.py)."""
+    from kai_scheduler_tpu.ops import fairshare as fs
+    from kai_scheduler_tpu.utils.metrics import METRICS
+
+    rng = np.random.default_rng(seed)
+    R = 3
+    q = n_queues
+    parent = forest_parent_indices(q, roots, fanouts)
+    priority = rng.choice(np.arange(bands) * 50, q)
+    creation = rng.uniform(0, 1e6, q)
+    uids = [f"tenant-{i:05d}" for i in range(q)]
+    deserved = np.where(rng.random((q, R)) < 0.5, 0.0,
+                        rng.integers(1, 8, (q, R)).astype(float))
+    limit = np.where(rng.random((q, R)) < 0.9, -1.0,
+                     rng.integers(16, 64, (q, R)).astype(float))
+    oqw = rng.integers(1, 4, (q, R)).astype(float)
+    request = fs.roll_up_requests(
+        parent, rng.integers(0, 30, (q, R)).astype(float))
+    usage = rng.uniform(0, 0.2, (q, R))
+    total = np.full(R, 2e5)
+    hier_depth = int(max(
+        len(fs.QueueHierarchy.build(parent, priority, creation,
+                                    uids).levels), 1)) - 1
+
+    def step_looped():
+        h = fs.QueueHierarchy.build(parent, priority, creation, uids)
+        # kailint: disable=KAI004 — offline micro-bench, no Session to dispatch through
+        return fs.fair_share_levels(total, 1.0, h, deserved, limit, oqw,
+                                    request, usage)
+
+    def step_forest():
+        prep = fs.prepared_forest(parent, priority, creation, uids,
+                                  deserved, limit, oqw)
+        # kailint: disable=KAI004 — offline micro-bench, no Session to dispatch through
+        return fs.fair_share_forest(total, 1.0, prep, request, usage)
+
+    step = step_forest if mode == "forest" else step_looped
+    reuse0 = METRICS.counters.get("fairshare_prep_reuse_total", 0)
+    disp0 = METRICS.counters.get("fairshare_dispatch_total", 0)
+    out = step()  # warm (compiles; fills the prep cache)
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        step()
+        ts.append((time.perf_counter() - t0) * 1000.0)
+    result = {
+        "queues": q,
+        "depth": hier_depth,
+        "bands": bands,
+        "mode": mode,
+        "fairshare_step_ms": round(float(np.median(ts)), 2),
+        "prep_reuse": int(METRICS.counters.get(
+            "fairshare_prep_reuse_total", 0) - reuse0),
+        "dispatches": int(METRICS.counters.get(
+            "fairshare_dispatch_total", 0) - disp0),
+        "iters": iters,
+    }
+    if mode == "forest":
+        # Cross-mode bit-parity on THIS instance, not just the suite's.
+        assert np.array_equal(out, step_looped()), \
+            "forest fair share diverged from per-level path"
+    return result
+
+
+def churn_phase(n_nodes=256, n_queues=10000, cycles=8,
+                submit_per_cycle=400, mode="forest", seed=0,
+                gpu_per_node=8):
+    """The heavy-traffic multi-tenant churn ring (ROADMAP item 3).
+
+    A full ``System`` over one in-memory apiserver with an O(10k)-queue
+    forest (depth >= 5), driven by a CONTINUOUS stream — every cycle
+    submits a burst of pods across random leaf queues, completes a
+    random slice of bound pods, and evicts a few more (the kubelet
+    analog then finalizes terminations) — not a one-shot fill.  Reports
+    p99 submit→bound pod latency from the lifecycle tracker alongside
+    cycle time and the fair-share step median for the selected mode.
+
+    Capacity math (the burst-row convention): the stream is
+    GPU-throughput-bound.  Cumulative submissions exceed the
+    ``n_nodes * gpu_per_node`` slot pool, so at most
+    ``slots + completed + evicted`` pods can ever be bound;
+    ``expected_bound`` records that ceiling so a partially-bound row
+    reads as the designed saturation, not a placement bug."""
+    from kai_scheduler_tpu.controllers import (ShardSpec, System,
+                                               SystemConfig, make_pod)
+    from kai_scheduler_tpu.framework.conf import SchedulerConfig
+    from kai_scheduler_tpu.utils.lifecycle import LIFECYCLE
+    from kai_scheduler_tpu.utils.metrics import METRICS
+
+    rng = np.random.default_rng(seed)
+    cfg = SchedulerConfig(actions=["allocate"], fused_fairshare=mode)
+    system = System(SystemConfig(shards=[ShardSpec(config=cfg)]))
+    api = system.api
+    t_setup = time.perf_counter()
+    for i in range(n_nodes):
+        api.create({"kind": "Node",
+                    "metadata": {"name": f"cn{i:05d}"}, "spec": {},
+                    "status": {"allocatable": {
+                        "cpu": "64", "memory": "512Gi",
+                        "nvidia.com/gpu": gpu_per_node, "pods": 110}}})
+    queue_objs, leaves = build_queue_forest(n_queues)
+    for obj in queue_objs:
+        api.create(obj)
+    setup_s = time.perf_counter() - t_setup
+    _log(f"churn setup: {n_nodes} nodes, {len(queue_objs)} queues "
+         f"({len(leaves)} leaves) in {setup_s:.1f}s")
+
+    total_pods = submit_per_cycle * cycles
+    old_bounds = LIFECYCLE.configure_bounds(
+        open_cap=max(8192, total_pods * 2), ring=max(2048, total_pods * 2))
+    serial = completed = evicted = 0
+    cycle_ts, fairshare_ts = [], []
+    try:
+        # Warmup: two cycles with a half burst pay the XLA compiles (the
+        # forest kernel + this shape's allocate ladder) so the measured
+        # stream reports steady-state latencies, then the tracker resets.
+        for _ in range(2):
+            for _ in range(submit_per_cycle // 2):
+                api.create(make_pod(f"churn-warm-{serial:06d}",
+                                    queue=leaves[serial % len(leaves)],
+                                    gpu=1))
+                serial += 1
+            system.run_cycle()
+        # Warmup pods leave the cluster: the measured stream starts from
+        # empty capacity so the throughput math below is exact.
+        for p in api.list("Pod"):
+            api.delete("Pod", p["metadata"]["name"],
+                       p["metadata"].get("namespace", "default"))
+        api.drain()
+        system.run_cycle()
+        _log("churn warmup done; measuring stream")
+        LIFECYCLE.reset()
+        reuse0 = METRICS.counters.get("fairshare_prep_reuse_total", 0)
+        for _ in range(cycles):
+            leaf_idx = rng.integers(0, len(leaves), submit_per_cycle)
+            for li in leaf_idx:
+                api.create(make_pod(f"churn-{serial:06d}",
+                                    queue=leaves[int(li)], gpu=1))
+                serial += 1
+            bound = [p for p in api.list("Pod")
+                     if p["spec"].get("nodeName")
+                     and not p["metadata"].get("deletionTimestamp")]
+            rng.shuffle(bound)
+            n_complete = int(len(bound) * 0.2)
+            n_evict = int(len(bound) * 0.05)
+            for p in bound[:n_complete]:
+                api.delete("Pod", p["metadata"]["name"],
+                           p["metadata"].get("namespace", "default"))
+            completed += n_complete
+            for p in bound[n_complete:n_complete + n_evict]:
+                # The stream's evict arm: involuntary removal mid-run
+                # (deletionTimestamp now, finalized below).
+                p["metadata"]["deletionTimestamp"] = "evicted"
+                api.update(p)
+            evicted += n_evict
+            t0 = time.perf_counter()
+            system.run_cycle()
+            cycle_ts.append(time.perf_counter() - t0)
+            ssn = system.schedulers[0].last_session
+            if ssn is not None and "fairshare" in ssn.phase_timings:
+                fairshare_ts.append(ssn.phase_timings["fairshare"])
+            # Kubelet analog: terminations complete.
+            for p in api.list("Pod"):
+                if p["metadata"].get("deletionTimestamp"):
+                    api.delete("Pod", p["metadata"]["name"],
+                               p["metadata"].get("namespace", "default"))
+            api.drain()
+        pod_latency = LIFECYCLE.summary()
+    finally:
+        LIFECYCLE.configure_bounds(**old_bounds)
+
+    slots = n_nodes * gpu_per_node
+    expected_bound = min(total_pods, slots + completed + evicted)
+    return {
+        "config": f"{n_nodes}nodes_{n_queues}queues_"
+                  f"{submit_per_cycle}per_cycle",
+        "fairshare_mode": mode,
+        "queues": n_queues,
+        "leaves": len(leaves),
+        "cycles": cycles,
+        "submitted": total_pods,
+        "completed": completed,
+        "evicted": evicted,
+        "setup_s": round(setup_s, 1),
+        "cold_cycle_s": round(cycle_ts[0], 2),
+        "cycle_s": round(float(np.median(cycle_ts[1:] or cycle_ts)), 3),
+        "fairshare_step_ms": round(
+            float(np.median(fairshare_ts[1:] or fairshare_ts)) * 1000.0,
+            2) if fairshare_ts else None,
+        "fairshare_prep_reuse": int(METRICS.counters.get(
+            "fairshare_prep_reuse_total", 0) - reuse0),
+        "pod_latency": pod_latency,
+        "expected_bound": expected_bound,
+        "capacity_note": (
+            f"throughput-bound: {n_nodes} nodes x {gpu_per_node} GPUs = "
+            f"{slots} slots vs {total_pods} one-GPU submissions; "
+            f"{completed} completed + {evicted} evicted recycle their "
+            f"slots, so at most {expected_bound} can be bound"),
+    }
+
+
+def churn_main(iters: int = 7) -> int:
+    """The committed churn-ring artifact (one commit, one machine):
+
+    1. same-commit fair-share A/B at 10k queues / depth 8 — the looped
+       (per-level, per-cycle prep) step vs the fused single-dispatch
+       forest step, appended as two ``fairshare-10k-ab`` rows;
+    2. the churn ring itself at O(10k) queues with the fused path,
+       appended as a ``churn-ring`` row carrying p99 submit→bound.
+    """
+    _enable_compile_cache()
+    import jax
+
+    backend = jax.default_backend()
+    ab = {}
+    for mode in ("looped", "forest"):
+        r = fairshare_microbench(mode=mode, iters=iters)
+        ab[mode] = r
+        _log(f"fairshare A/B {mode}: {r['fairshare_step_ms']}ms")
+        _append_result_row({"scenario": "fairshare-10k-ab",
+                            "backend": backend, **r})
+    speedup = ab["looped"]["fairshare_step_ms"] / max(
+        ab["forest"]["fairshare_step_ms"], 1e-9)
+    _log(f"fair-share step speedup: {speedup:.2f}x")
+
+    row = churn_phase()
+    _append_result_row({"scenario": "churn-ring", "backend": backend,
+                        "fairshare_speedup_vs_looped": round(speedup, 2),
+                        **row})
+    return 0
+
+
 def tas_phase(dims, gang, iters: int = 5):
     """TAS measurement at one mesh shape: per-level domain aggregation
     (segment sums over the node axis) for a 3-level mesh, then one gang
@@ -1239,5 +1525,11 @@ if __name__ == "__main__":
         # Same-commit legacy-vs-fused pair at the committed large-gang
         # CPU shape, appended to results.jsonl.
         sys.exit(large_gang_ab_main())
+    elif "--churn" in sys.argv:
+        # Multi-tenant churn ring at O(10k) queues: same-commit
+        # looped-vs-forest fair-share A/B rows + the continuous
+        # submit/complete/evict stream with p99 submit→bound, appended
+        # to results.jsonl.
+        sys.exit(churn_main())
     else:
         sys.exit(orchestrate())

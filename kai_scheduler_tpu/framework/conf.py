@@ -77,6 +77,12 @@ class SchedulerConfig:
     # 0 disables bulk mode.
     bulk_allocation_threshold: int = 32
     bulk_allocation_max_rounds: int = 8
+    # Fair-share division path: "forest" runs the whole queue hierarchy
+    # as ONE jitted dispatch with cached host prep (ops/fairshare.py
+    # fair_share_forest, DESIGN §2b); "levels" keeps the per-level
+    # dispatch loop (the pre-forest baseline, kept for A/B benches and
+    # as the parity reference).
+    fused_fairshare: str = "forest"
     # Whole-cycle deadline in seconds (0 disables).  Enforced by the
     # cycle driver between actions AND inside them at kernel-dispatch
     # granularity (Session.dispatch_kernel): past the deadline the cycle
@@ -142,9 +148,17 @@ class SchedulerConfig:
                     "node_pad_bucket", "bulk_allocation_threshold",
                     "max_scenarios_per_job", "max_victims_considered",
                     "scenario_prescreen_max", "scenario_prescreen_after",
-                    "batched_scenario_confirm", "cycle_deadline_s"):
+                    "batched_scenario_confirm", "cycle_deadline_s",
+                    "fused_fairshare"):
             if key in d:
                 setattr(config, key, d[key])
+        if config.fused_fairshare not in ("forest", "levels"):
+            # Loud, not silent: a typo'd mode would otherwise fall into
+            # the slow per-level loop on a 10k-queue cluster (the
+            # operator's args validation surfaces this rejection).
+            raise ValueError(
+                f"fused_fairshare must be 'forest' or 'levels', got "
+                f"{config.fused_fairshare!r}")
         if "queue_depth_per_action" in d:
             config.queue_depth_per_action = dict(d["queue_depth_per_action"])
         gates = d.get("feature_gates", d.get("featureGates"))

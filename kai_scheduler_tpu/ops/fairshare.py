@@ -14,17 +14,29 @@ resource_division.go:26-357 and proportion.go:403-440):
 3. *Hierarchy*: each parent's fair share becomes the pool divided among its
    children (proportion.go:410-425).
 
-Two implementations, property-tested against each other:
+Three implementations, property-tested against each other:
 - ``set_resources_share_np``: sequential numpy reference, one queue group.
-- ``fair_share_levels``: jitted JAX kernel.  Queue groups (siblings under one
-  parent) become segment ids so every level of the hierarchy is one
-  vectorized division over all groups at once; priority bands are a static
-  unroll; the round loop is a ``lax.while_loop`` fixed point.
+- ``fair_share_levels``: jitted JAX kernel, ONE DISPATCH PER LEVEL.  Queue
+  groups (siblings under one parent) become segment ids so every level of
+  the hierarchy is one vectorized division over all groups at once;
+  priority bands are a static unroll; the round loop is a
+  ``lax.while_loop`` fixed point.
+- ``fair_share_forest``: the whole forest as ONE jitted dispatch
+  (docs/DESIGN.md §2b).  Levels pack into a dense ``[L, Qmax]`` layout
+  (global queue indices, -1 padding), sibling groups stay segment ids with
+  one shared padding dump group, priority bands fold into a
+  ``lax.fori_loop``, and the level recursion (parent fair share feeds the
+  children's pool) unrolls statically inside the single jit.  The host
+  prep (``prepared_forest``) is cached across cycles keyed on the queue
+  set + weights, so a steady 10k-queue cluster pays one dispatch and
+  O(hash) host work per cycle.
 """
 
 from __future__ import annotations
 
 import functools
+import threading
+from collections import OrderedDict
 from dataclasses import dataclass
 
 import jax
@@ -325,9 +337,11 @@ def fair_share_levels(total: np.ndarray, k_value: float,
     fair = np.zeros((q, r))
     if q == 0:
         return fair
+    from ..utils.metrics import METRICS
     for depth, idxs in enumerate(hierarchy.levels):
         if len(idxs) == 0:
             continue
+        METRICS.inc("fairshare_dispatch_total")
         if depth == 0:
             group_of = np.zeros(len(idxs), np.int32)
             group_totals = total[None, :]
@@ -347,6 +361,382 @@ def fair_share_levels(total: np.ndarray, k_value: float,
             k_value)
         fair[idxs] = np.asarray(out)
     return fair
+
+
+# ---------------------------------------------------------------------------
+# Queue-forest kernel: the WHOLE hierarchy in one jitted dispatch
+# ---------------------------------------------------------------------------
+
+# group_parent sentinel: a group whose pool is the cluster total (roots).
+ROOT_GROUP = -1
+
+
+@dataclass(frozen=True)
+class ForestSpec:
+    """Static structure of the whole queue forest (trace-time constants).
+
+    ``level_dims[l] = (G_l, S_l)``: level l packs into a dense
+    ``[G_l, S_l]`` sibling-group matrix (groups x max-siblings, slot -1
+    padding).  Per-level tight dims keep the fused kernel's work at the
+    per-level path's operand sizes instead of paying the deepest level's
+    width at every depth.  ``level_bands[l]`` lists the dense band ids
+    actually present among level l's queues: the band fold iterates only
+    those (a band with no member queues is a no-op in the reference
+    sweep — zero grants, zero remainders — so skipping it is exact)."""
+    level_dims: tuple
+    level_bands: tuple
+    num_bands: int
+    num_queues: int
+    max_rounds: int = 64
+
+    @property
+    def num_levels(self) -> int:
+        return len(self.level_dims)
+
+    @property
+    def padded_slots(self) -> int:
+        return sum(g * s for g, s in self.level_dims)
+
+
+@dataclass
+class QueueForest:
+    """Dense level-batched layout of one queue forest.
+
+    Per level l (device-resident, uploaded once at build; the prep cache
+    keeps them alive across cycles):
+    - ``level_qidx[l]`` [G_l, S_l]: global queue index per slot, -1 pad;
+    - ``level_parent[l]`` [G_l]: global queue index whose fair share is
+      the group's pool, or ROOT_GROUP for the cluster total.
+    Group order is ascending unique parent index and slot order within a
+    group is ascending queue index — the same operand order the
+    per-level path's segment reductions see (bit-parity, DESIGN §2b).
+    """
+    level_qidx: tuple
+    level_parent: tuple
+
+
+def build_forest(hierarchy: QueueHierarchy
+                 ) -> tuple[ForestSpec, QueueForest]:
+    """Pack a QueueHierarchy into the dense per-level group matrices."""
+    num_q = hierarchy.parent.shape[0]
+    dims, band_ids, qidx_arrays, parent_arrays = [], [], [], []
+    for depth, idxs in enumerate(hierarchy.levels):
+        if depth == 0 or len(idxs) == 0:
+            parents = np.full(len(idxs), ROOT_GROUP, np.int64)
+        else:
+            parents = hierarchy.parent[idxs]
+        present = np.unique(hierarchy.band_of_queue[idxs]) if len(idxs) \
+            else np.zeros(1, np.int64)
+        band_ids.append(tuple(int(b) for b in present))
+        gp, g_of = np.unique(parents, return_inverse=True)
+        G = max(1, len(gp))
+        sizes = np.bincount(g_of, minlength=G).astype(np.int64)
+        S = max(1, int(sizes.max()) if sizes.size else 1)
+        qidx = np.full((G, S), -1, np.int32)
+        # Slot = position within the group, in ascending queue order
+        # (idxs ascending; np.unique's inverse preserves that order).
+        slot = np.zeros(len(idxs), np.int64)
+        seen = np.zeros(G, np.int64)
+        for i, g in enumerate(g_of):
+            slot[i] = seen[g]
+            seen[g] += 1
+        qidx[g_of, slot] = idxs
+        dims.append((G, S))
+        qidx_arrays.append(jnp.asarray(qidx))
+        parent_arrays.append(jnp.asarray(
+            (gp if len(gp) else np.array([ROOT_GROUP])).astype(np.int32)))
+    if not dims:
+        dims = [(1, 1)]
+        band_ids = [(0,)]
+        qidx_arrays = [jnp.full((1, 1), -1, jnp.int32)]
+        parent_arrays = [jnp.full((1,), ROOT_GROUP, jnp.int32)]
+    spec = ForestSpec(level_dims=tuple(dims), level_bands=tuple(band_ids),
+                      num_bands=hierarchy.num_bands, num_queues=num_q)
+    forest = QueueForest(tuple(qidx_arrays), tuple(parent_arrays))
+    return spec, forest
+
+
+def _divide_level_dense(spec: ForestSpec, bands: tuple, pool, band_q,
+                        deserved, limit, oqw, request, usage,
+                        tiebreak_rank, k_value):
+    """One level's division over the dense [G, S, R] group layout.
+
+    The same fixed-point math as ``divide_groups_jax`` with the segment
+    machinery dissolved: segment sums become axis-1 row reductions (the
+    accumulation visits siblings in the same ascending order), segment
+    gathers become [G, 1, R] broadcasts, and the in-group
+    largest-remainder ranking becomes a per-row lexsort.  No scatter or
+    gather appears anywhere in the round loop — the CPU/TPU cost of the
+    old per-level kernel was dominated by 3 scatter-adds per round.
+    Priority bands fold into a ``fori_loop`` carrying a [B, G, S, R]
+    remainder stack.  Padding slots carry all-zero inputs: requestable
+    0 keeps them unsatisfied-never-active through every phase, and
+    trailing +0.0 terms cannot change a row reduction's value."""
+    G, S = pool.shape[0], deserved.shape[1]
+    R = deserved.shape[2]
+
+    requestable = jnp.where(limit == UNLIMITED, request,
+                            jnp.minimum(limit, request))
+    my_total = pool[:, None, :]  # [G,1,R] broadcast
+    eff_deserved = jnp.where(deserved == UNLIMITED,
+                             jnp.broadcast_to(my_total, deserved.shape),
+                             deserved)
+    fair0 = jnp.minimum(eff_deserved, requestable)
+    remaining0 = jnp.maximum(pool - fair0.sum(axis=1), 0.0)  # [G,R]
+
+    def run_band(band, fair, remaining, rem_frac0):
+        in_band = (band_q == band)[:, :, None]  # [G,S,1]
+
+        def cond(carry):
+            fair, remaining, rem_frac, go, i = carry
+            return go & (i < spec.max_rounds)
+
+        def body(carry):
+            fair, remaining, rem_frac, _, i = carry
+            unsat = in_band & (requestable - fair > EPS)
+            tw = jnp.where(unsat, oqw, 0.0).sum(axis=1)  # [G,R]
+            tw_b = tw[:, None, :]
+            n_w = jnp.where(unsat & (tw_b > 0), oqw / jnp.where(
+                tw_b > 0, tw_b, 1.0), 0.0)
+            share_w = jnp.where(unsat,
+                                jnp.maximum(0.0,
+                                            n_w + k_value * (n_w - usage)),
+                                0.0)
+            sw = share_w.sum(axis=1)[:, None, :]  # [G,1,R]
+            active = unsat & (share_w > 0) & (sw > 0)
+            fair_q = jnp.where(active,
+                               remaining[:, None, :] * share_w
+                               / jnp.where(sw > 0, sw, 1.0), 0.0)
+            rem_req = requestable - fair
+            satisfied_now = rem_req <= fair_q
+            give = jnp.where(active,
+                             jnp.where(satisfied_now, rem_req,
+                                       jnp.floor(fair_q)), 0.0)
+            new_frac = jnp.where(active,
+                                 jnp.where(satisfied_now, 0.0,
+                                           fair_q - jnp.floor(fair_q)),
+                                 rem_frac)
+            fair = fair + give
+            remaining = jnp.maximum(remaining - give.sum(axis=1), 0.0)
+            another = (active & (rem_req < fair_q)) \
+                & (remaining[:, None, :] > EPS)
+            go = jnp.any(another)
+            return fair, remaining, new_frac, go, i + 1
+
+        fair, remaining, rem_frac, _, _ = jax.lax.while_loop(
+            cond, body,
+            (fair, remaining, rem_frac0, jnp.array(True), jnp.array(0)))
+        return fair, remaining, rem_frac
+
+    # Band fold: a fori_loop over the band ids actually present at this
+    # level (dense, descending-priority order), not 0..num_bands-1 — an
+    # absent band's sweep grants nothing and leaves no remainders, so
+    # skipping it is exactly the reference's no-op.
+    band_vec = jnp.asarray(bands, jnp.int32)
+    n_bands = len(bands)
+
+    def band_body(bi, carry):
+        fair, remaining, rem_frac_all = carry
+        fair, remaining, rem_frac = run_band(
+            band_vec[bi], fair, remaining, jnp.zeros_like(fair))
+        rem_frac_all = rem_frac_all.at[bi].set(rem_frac)
+        return fair, remaining, rem_frac_all
+
+    fair, remaining, rem_frac_all = jax.lax.fori_loop(
+        0, n_bands, band_body,
+        (fair0, remaining0, jnp.zeros((n_bands, G, S, R))))
+
+    def distribute(fair, remaining, rem_frac):
+        member = rem_frac > 0.0  # [G,S,R]
+
+        def per_resource(fair_r, remaining_r, frac_r, member_r):
+            # [G,S] each; remaining_r [G].
+            frac_r = jnp.round(frac_r, FRAC_DECIMALS)
+            order = jnp.lexsort((tiebreak_rank, -frac_r,
+                                 jnp.where(member_r, 0, 1)), axis=-1)
+            # order is a per-row permutation; its argsort is the inverse
+            # permutation = each slot's in-group largest-remainder rank.
+            rank = jnp.argsort(order, axis=-1)
+            amount = jnp.where(
+                member_r,
+                jnp.clip(remaining_r[:, None] - rank.astype(fair_r.dtype),
+                         0.0, 1.0),
+                0.0)
+            fair_r = fair_r + amount
+            remaining_r = jnp.maximum(
+                remaining_r - amount.sum(axis=1), 0.0)
+            return fair_r, remaining_r
+
+        outs = [per_resource(fair[:, :, r], remaining[:, r],
+                             rem_frac[:, :, r], member[:, :, r])
+                for r in range(R)]
+        fair = jnp.stack([o[0] for o in outs], axis=2)
+        remaining = jnp.stack([o[1] for o in outs], axis=1)
+        return fair, remaining
+
+    def dist_body(bi, carry):
+        fair, remaining = carry
+        return distribute(fair, remaining, rem_frac_all[bi])
+
+    fair, remaining = jax.lax.fori_loop(0, n_bands, dist_body,
+                                        (fair, remaining))
+    return fair
+
+
+@functools.partial(jax.jit, static_argnames=("spec",))
+def fair_share_forest_jax(spec: ForestSpec, level_qidx, level_parent,
+                          band_of, deserved, limit, oqw,
+                          request, usage, tiebreak_rank, total, k_value):
+    """The whole hierarchical division as one jitted program.
+
+    Per-queue arrays are the global (unpadded) [Q,R] stacks; padding
+    happens here by appending one zero row every padded slot gathers
+    (zero request/weight/deserved makes a padding slot inert in every
+    phase).  Levels unroll statically at their own [G_l, S_l] shapes:
+    level l's group pools gather the fair shares level l-1 just wrote,
+    which is exactly the per-level recursion of ``fair_share_levels``
+    fused into one dispatch."""
+    Q = spec.num_queues
+    R = deserved.shape[1]
+    zrow = jnp.zeros((1, R), deserved.dtype)
+    des_p = jnp.concatenate([deserved, zrow])
+    lim_p = jnp.concatenate([limit, zrow])
+    oqw_p = jnp.concatenate([oqw, zrow])
+    req_p = jnp.concatenate([request, zrow])
+    use_p = jnp.concatenate([usage, zrow])
+    band_p = jnp.concatenate([band_of, jnp.zeros(1, band_of.dtype)])
+    tie_p = jnp.concatenate(
+        [tiebreak_rank, jnp.full((1,), Q, tiebreak_rank.dtype)])
+
+    fair_all = jnp.zeros((Q + 1, R))
+    for level in range(spec.num_levels):
+        qidx = level_qidx[level]               # [G,S]
+        valid = qidx >= 0
+        qi = jnp.where(valid, qidx, Q)         # padding reads the zero row
+        gp = level_parent[level]               # [G]
+        pool = jnp.where((gp >= 0)[:, None],
+                         fair_all[jnp.clip(gp, 0, Q)],
+                         jnp.broadcast_to(total, (gp.shape[0], R)))
+        out = _divide_level_dense(
+            spec, spec.level_bands[level], pool, band_p[qi], des_p[qi],
+            lim_p[qi], oqw_p[qi], req_p[qi], use_p[qi], tie_p[qi],
+            k_value)
+        # Padding slots all write the zero row at index Q (identical
+        # values, so duplicate-index scatter order cannot matter).
+        fair_all = fair_all.at[qi.reshape(-1)].set(
+            jnp.where(valid[:, :, None], out, 0.0).reshape(-1, R))
+    return fair_all[:Q]
+
+
+@dataclass
+class ForestPrep:
+    """Arena-resident host prep for one queue forest: the built
+    hierarchy, the dense layout, and the device-resident slow-moving
+    tensors (weights and the hierarchy's band/tiebreak vectors) that are
+    part of the cache key and therefore constant for the cache entry's
+    lifetime.  Only ``request``/``usage`` move cycle to cycle."""
+    hierarchy: QueueHierarchy
+    spec: ForestSpec
+    forest: QueueForest
+    deserved: jnp.ndarray
+    limit: jnp.ndarray
+    oqw: jnp.ndarray
+    band_of: jnp.ndarray
+    tiebreak: jnp.ndarray
+
+
+def fair_share_forest(total: np.ndarray, k_value: float, prep: ForestPrep,
+                      request: np.ndarray, usage: np.ndarray
+                      ) -> np.ndarray:
+    """Full hierarchical fair share in ONE kernel dispatch.
+
+    Same contract as ``fair_share_levels`` (``request`` rolled up the
+    parent chain; returns [Q,R] for every queue) — property-tested
+    bit-identical against it on randomized forests."""
+    q = request.shape[0]
+    if q == 0:
+        return np.zeros((q, request.shape[1] if request.ndim == 2
+                         else 0))
+    from ..utils.metrics import METRICS
+    METRICS.inc("fairshare_dispatch_total")
+    out = fair_share_forest_jax(
+        prep.spec, prep.forest.level_qidx, prep.forest.level_parent,
+        prep.band_of, prep.deserved, prep.limit, prep.oqw,
+        jnp.asarray(request), jnp.asarray(usage), prep.tiebreak,
+        jnp.asarray(total), k_value)
+    return np.asarray(out)
+
+
+# Host-prep memo: (queue set, priorities, creations, weights) -> built
+# hierarchy + forest layout + resident weight tensors.  A steady cluster
+# re-divides every cycle with unchanged structure; rebuilding the
+# O(Q·depth) hierarchy prep and re-uploading the layout and weights each
+# time was pure waste.  Bounded LRU: churn between a few shapes (chaos
+# suites, sharded pools) stays cached.  _FOREST_LOCK serializes the
+# cache AND the guard-watch init: concurrent sharded schedulers call
+# prepared_forest from their own cycle threads (chaos_matrix --shards),
+# and an unlocked OrderedDict corrupts under interleaved
+# get/move_to_end/popitem.
+_FOREST_CACHE: OrderedDict = OrderedDict()
+_FOREST_CACHE_MAX = 8
+_FOREST_LOCK = threading.Lock()
+_GUARD_WATCH = None
+
+
+def prepared_forest(parent: np.ndarray, priority: np.ndarray,
+                    creation: np.ndarray, uids: list[str],
+                    deserved: np.ndarray, limit: np.ndarray,
+                    oqw: np.ndarray, out_info: dict | None = None
+                    ) -> ForestPrep:
+    """Build (or reuse) the host prep for one queue forest.
+
+    The cache key is the full queue-set identity (uids, parents,
+    priorities, creation stamps) plus the quota weights, so any change
+    to the forest shape or weights rebuilds while steady cycles pay one
+    hash (``fairshare_prep_reuse_total``).  A device-guard transition
+    (breaker flip or closed-breaker fallback) drops the cache: the
+    resident weight tensors may sit on the dead side of the fallback
+    boundary, same hazard the arena invalidates on.
+
+    ``out_info`` (optional dict) receives ``{"reused": bool}`` for THIS
+    call — a per-call verdict the global counter cannot give once
+    concurrent shards share the cache."""
+    global _GUARD_WATCH
+    import hashlib
+
+    from ..framework.arena import GuardWatch
+    from ..utils.deviceguard import device_guard
+    from ..utils.metrics import METRICS
+    h = hashlib.blake2b(digest_size=16)
+    for arr in (parent, priority, creation, deserved, limit, oqw):
+        h.update(np.ascontiguousarray(arr).tobytes())
+    h.update("\x00".join(uids).encode())
+    key = h.digest()
+    with _FOREST_LOCK:
+        if _GUARD_WATCH is None:
+            _GUARD_WATCH = GuardWatch()
+        if _GUARD_WATCH.transitioned(device_guard()):
+            _FOREST_CACHE.clear()
+        hit = _FOREST_CACHE.get(key)
+        if out_info is not None:
+            out_info["reused"] = hit is not None
+        if hit is not None:
+            _FOREST_CACHE.move_to_end(key)
+            METRICS.inc("fairshare_prep_reuse_total")
+            return hit
+        # Build under the lock: concurrent shards share one queue set,
+        # so racing threads would build the same entry twice; the loser
+        # of an unlocked race would also evict the winner's live entry.
+        hierarchy = QueueHierarchy.build(parent, priority, creation, uids)
+        spec, forest = build_forest(hierarchy)
+        prep = ForestPrep(hierarchy, spec, forest, jnp.asarray(deserved),
+                          jnp.asarray(limit), jnp.asarray(oqw),
+                          jnp.asarray(hierarchy.band_of_queue),
+                          jnp.asarray(hierarchy.tiebreak_rank))
+        _FOREST_CACHE[key] = prep
+        while len(_FOREST_CACHE) > _FOREST_CACHE_MAX:
+            _FOREST_CACHE.popitem(last=False)
+        return prep
 
 
 def roll_up_requests(parent: np.ndarray, leaf_values: np.ndarray

@@ -176,7 +176,22 @@ class ProportionPlugin(Plugin):
             q = self.queues.get(q.parent) if q.parent else None
 
     def _set_fair_share(self, ssn) -> None:
-        """Run the hierarchical division kernel (proportion.go:403-440)."""
+        """Run the hierarchical division kernel (proportion.go:403-440).
+
+        Two paths behind ``config.fused_fairshare`` (bit-identical,
+        property-tested):
+        - ``forest`` (default): ONE jitted dispatch for the whole queue
+          hierarchy, with the host prep (hierarchy build, dense level
+          layout, weight-tensor upload) cached across cycles keyed on
+          the queue set + weights (ops/fairshare.prepared_forest) — a
+          steady 10k-queue forest pays one hash and one dispatch;
+        - ``levels``: the pre-forest per-level dispatch loop, kept as
+          the A/B baseline and parity reference.
+        """
+        import time as _time
+
+        from ..utils.metrics import METRICS
+        from ..utils.tracing import TRACER
         qids = sorted(self.queues)
         index = {qid: i for i, qid in enumerate(qids)}
         n = len(qids)
@@ -187,21 +202,57 @@ class ProportionPlugin(Plugin):
                            for q in qids], np.int64)
         priority = np.array([self.queues[q].priority for q in qids])
         creation = np.array([self.queues[q].creation_ts for q in qids])
-        hier = fsops.QueueHierarchy.build(parent, priority, creation, qids)
         stack = lambda attr: np.stack(
             [getattr(self.queues[q], attr) for q in qids])
+        deserved, limit = stack("deserved"), stack("limit")
+        oqw = stack("over_quota_weight")
+        request, usage = stack("request"), stack("usage")
+        mode = getattr(ssn.config, "fused_fairshare", "forest")
+        validate = lambda r: getattr(r, "shape", (0,))[0] >= n
+        t_step = _time.perf_counter()
         # Guarded like every other device dispatch: session open must
         # degrade to the CPU fallback on a dead device, not wedge the
         # cycle before its first action.
-        fair = ssn.dispatch_kernel(
-            lambda: fsops.fair_share_levels(
-                self.total, ssn.config.k_value, hier,
-                stack("deserved"), stack("limit"),
-                stack("over_quota_weight"),
-                stack("request"), stack("usage")),
-            label="fair_share",
-            validate=lambda r: getattr(r, "shape", (0,))[0] >= n)
-        from ..utils.metrics import METRICS
+        with TRACER.span("fairshare", kind="fairshare", queues=n,
+                         mode=mode) as sp:
+            if mode == "forest":
+                # The prep (hierarchy build + layout/weight uploads)
+                # lives INSIDE the guarded thunk: its jnp.asarray calls
+                # touch the device, and on a guard fallback the thunk
+                # re-runs on the CPU backend AFTER fallback_calls
+                # bumped — so prepared_forest's GuardWatch drops the
+                # dead-device cache entry and rebuilds host-side.
+                info: dict = {}
+
+                def forest_thunk():
+                    prep = fsops.prepared_forest(
+                        parent, priority, creation, qids, deserved,
+                        limit, oqw, out_info=info)
+                    info["prep"] = prep
+                    return fsops.fair_share_forest(
+                        self.total, ssn.config.k_value, prep, request,
+                        usage)
+
+                fair = ssn.dispatch_kernel(forest_thunk,
+                                           label="fair_share",
+                                           validate=validate)
+                prep = info.get("prep")
+                if prep is not None:
+                    sp.set(levels=prep.spec.num_levels,
+                           bands=prep.spec.num_bands,
+                           prep_reused=bool(info.get("reused")))
+            else:
+                hier = fsops.QueueHierarchy.build(parent, priority,
+                                                  creation, qids)
+                fair = ssn.dispatch_kernel(
+                    lambda: fsops.fair_share_levels(
+                        self.total, ssn.config.k_value, hier, deserved,
+                        limit, oqw, request, usage),
+                    label="fair_share", validate=validate)
+        # The fair-share STEP cost (prep + division dispatch, not the
+        # attribute stacking above): the number the churn bench's A/B
+        # rows and the fleet-budget ceiling gate on.
+        ssn.phase_timings["fairshare"] = _time.perf_counter() - t_step
         for qid, i in index.items():
             self.queues[qid].fair_share = fair[i]
             # Queue fair-share/usage gauges (metrics.UpdateQueueFairShare,

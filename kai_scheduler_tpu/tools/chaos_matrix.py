@@ -50,6 +50,14 @@ INCREMENTAL_TESTS = ["tests/test_incremental_cache.py"]
 # into its instance generator) and re-proves legacy/jnp/Pallas
 # bit-identity plus the breaker-open fallback.
 FUSED_TESTS = ["tests/test_fused_parity.py"]
+# --shards: the concurrent-sharded-schedulers churn ring — each seed
+# reshuffles the submit/complete stream while two shards cycle in real
+# threads against one apiserver, asserting zero double-binds,
+# fenced-loser abort, and cross-shard reclaim; plus the queue-forest
+# fair-share parity ring (the division both shards rely on), whose
+# randomized forests the seed also regenerates.
+SHARDS_TESTS = ["tests/test_concurrent_shards.py",
+                "tests/test_fairshare_forest.py"]
 
 
 def run_iteration(seed: int, tests: list[str], marker: str,
@@ -119,6 +127,13 @@ def main(argv=None) -> int:
                          f"ring ({FUSED_TESTS}) — each seed regenerates "
                          "the randomized workloads and re-proves "
                          "legacy/jnp/Pallas placement bit-identity")
+    ap.add_argument("--shards", action="store_true",
+                    help="shards mode: sweep the concurrent-shards churn "
+                         f"ring ({SHARDS_TESTS}) — each seed reshuffles "
+                         "the submit/complete stream and the randomized "
+                         "queue forests while zero-double-bind, "
+                         "fenced-loser-abort, and fair-share bit-parity "
+                         "are asserted")
     ap.add_argument("-k", "--keyword", default=None,
                     help="pytest -k filter (narrow the smoke subset)")
     ap.add_argument("--marker", default="chaos",
@@ -142,12 +157,13 @@ def main(argv=None) -> int:
     if args.tests:
         tests = args.tests
     else:
-        # Modes compose: --arena --latency --incremental --fused sweeps
-        # every selected suite per seed.
+        # Modes compose: --arena --latency --incremental --fused
+        # --shards sweeps every selected suite per seed.
         tests = (ARENA_TESTS if args.arena else []) + \
             (LATENCY_TESTS if args.latency else []) + \
             (INCREMENTAL_TESTS if args.incremental else []) + \
-            (FUSED_TESTS if args.fused else [])
+            (FUSED_TESTS if args.fused else []) + \
+            (SHARDS_TESTS if args.shards else [])
         if not tests:
             tests = DEFAULT_TESTS
     repo_root = os.path.dirname(os.path.dirname(os.path.dirname(

@@ -16,8 +16,11 @@
 #                 fleet burst, fail on an empty folded profile
 #   fleet budget  bench.py fleet phase at a small shape vs the committed
 #                 threshold file (docs/scale-tests/fleet_budget.json):
-#                 grouped/snapshotted phase medians, warm cycle, and the
-#                 incremental-cache structural gates must stay in budget
+#                 grouped/snapshotted phase medians, warm cycle, the
+#                 incremental-cache structural gates, the fused-allocate
+#                 kernel ceiling, and the 10k-queue fair-share step
+#                 ceiling + single-dispatch/prep-reuse structural gates
+#                 must stay in budget
 #   tier-1 tests  pytest -m 'not slow' on CPU
 #
 # Usage: kai_scheduler_tpu/tools/ci_check.sh [--no-tests]

@@ -20,7 +20,15 @@ result against ``docs/scale-tests/fleet_budget.json``:
   at a small committed shape (``allocate_shape``) and its median must
   stay under ``max_allocate_ms`` — the device-path analog of the
   host-pipeline medians above, so a fused-kernel regression is caught
-  here instead of three PRs later at bench scale.
+  here instead of three PRs later at bench scale;
+- **fair-share ceiling + structure**: the queue-forest division is
+  re-measured at the committed 10k-queue shape (``fairshare_shape``)
+  — its step median must stay under ``max_fairshare_ms`` (a silent
+  fall-back to the per-level loop measures several times higher and
+  trips this even on a fast machine), the prep cache must actually
+  reuse (``min_prep_reuse`` hits of ``fairshare_prep_reuse_total``),
+  and ``fairshare_dispatch_total`` must show exactly ONE dispatch per
+  division — the structural single-dispatch guarantee of DESIGN §2b.
 
 Usage (ci_check.sh runs it):
 
@@ -93,6 +101,14 @@ def main(argv=None) -> int:
         ts.append((_time.perf_counter() - t0) * 1000.0)
     allocate_ms = float(np.median(ts))
 
+    # Fair-share micro-measurement: the queue-forest division at the
+    # committed 10k-queue shape (warm prep cache, median over 5 runs).
+    fshape = budget.get("fairshare_shape", {"queues": 10000, "bands": 1})
+    fs_iters = 5
+    fsres = bench.fairshare_microbench(n_queues=fshape["queues"],
+                                       bands=fshape.get("bands", 1),
+                                       iters=fs_iters)
+
     medians = result.get("pod_latency", {}).get("phase_median_ms", {})
     bound = result.get("pod_latency", {}).get("bound_pods", 0)
     expect = shape["jobs"] * shape["gang"]
@@ -112,6 +128,15 @@ def main(argv=None) -> int:
          ">=", budget.get("min_fused_taken", 1)),
         ("allocate_kernel_median_ms", round(allocate_ms, 1),
          "<=", budget.get("max_allocate_ms", 400)),
+        ("fairshare_step_median_ms", fsres["fairshare_step_ms"],
+         "<=", budget.get("max_fairshare_ms", 150)),
+        ("fairshare_prep_reuse", fsres["prep_reuse"],
+         ">=", budget.get("min_prep_reuse", fs_iters - 1)),
+        # Structural: one jitted dispatch per division (warm call + one
+        # per measured iteration) — a per-level fallback multiplies this
+        # by the hierarchy depth.
+        ("fairshare_dispatches", fsres["dispatches"],
+         "<=", fs_iters + 1),
     ]
 
     failed = []

@@ -623,6 +623,32 @@ class TestKAI008MetricsHygiene:
         assert any(f.rule == "KAI008" and "label keys" in f.message
                    and "pod_latency_ms" in f.message for f in findings)
 
+    def test_fairshare_family_consistent_usage_is_clean(self):
+        # The queue-forest fair-share families (ops/fairshare.py): prep
+        # cache reuse + single-dispatch counters, unlabeled.
+        src = ("from ..utils.metrics import METRICS\n"
+               "def f():\n"
+               "    METRICS.inc('fairshare_prep_reuse_total')\n"
+               "    METRICS.inc('fairshare_dispatch_total')\n"
+               "    METRICS.observe('cycle_span_fairshare_latency_ms', 1)\n")
+        findings = lint(("kai_scheduler_tpu/utils/fix.py", src))
+        assert [f for f in findings if f.rule == "KAI008"] == []
+
+    def test_fairshare_cross_instrument_collision_fires(self):
+        # A gauge reusing the dispatch counter's name would corrupt the
+        # structural one-dispatch-per-cycle gate (tools/fleet_budget.py).
+        a = ("from ..utils.metrics import METRICS\n"
+             "def f():\n"
+             "    METRICS.inc('fairshare_dispatch_total')\n")
+        b = ("from ..utils.metrics import METRICS\n"
+             "def g(v):\n"
+             "    METRICS.set_gauge('fairshare_dispatch_total', v)\n")
+        findings = lint(("kai_scheduler_tpu/utils/a.py", a),
+                        ("kai_scheduler_tpu/controllers/b.py", b))
+        assert any(f.rule == "KAI008" and "one instrument" in f.message
+                   and "fairshare_dispatch_total" in f.message
+                   for f in findings)
+
     def test_stackprof_family_consistent_usage_is_clean(self):
         src = ("from ..utils.metrics import METRICS\n"
                "def f(v):\n"

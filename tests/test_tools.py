@@ -271,6 +271,30 @@ class TestChaosMatrixDryRun:
         assert "tests/test_fused_parity.py" in out
         assert "tests/test_incremental_cache.py" in out
 
+    def test_dry_run_shards_mode_selects_churn_suites(self, capsys,
+                                                      monkeypatch):
+        """--shards sweeps the concurrent-shards churn ring plus the
+        queue-forest fair-share parity ring; composing with --fused
+        sweeps both families per seed."""
+        from kai_scheduler_tpu.tools import chaos_matrix
+        monkeypatch.setattr(
+            chaos_matrix.subprocess, "run",
+            lambda *a, **kw: (_ for _ in ()).throw(AssertionError(
+                "dry run must not execute iterations")))
+        rc = chaos_matrix.main(["--dry-run", "--shards", "--seeds", "3,5"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert out.count("seed ") == 2
+        assert "tests/test_concurrent_shards.py" in out
+        assert "tests/test_fairshare_forest.py" in out
+        assert "tests/test_reconciler.py" not in out
+        rc = chaos_matrix.main(["--dry-run", "--shards", "--fused",
+                                "--seeds", "3"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "tests/test_concurrent_shards.py" in out
+        assert "tests/test_fused_parity.py" in out
+
     def test_dry_run_respects_iterations_default_seeds(self, capsys,
                                                        monkeypatch):
         from kai_scheduler_tpu.tools import chaos_matrix
