@@ -178,7 +178,8 @@ def measure_rtt():
     return float(np.median(ts))
 
 
-def fleet_phase(n_nodes=2000, n_jobs=8, gang=100, waves=2):
+def fleet_phase(n_nodes=2000, n_jobs=8, gang=100, waves=2,
+                pipelined=False):
     """End-to-end fleet measurement with the latency observatory armed.
 
     Builds the full ``System`` (admission -> podgrouper -> scheduler ->
@@ -188,6 +189,13 @@ def fleet_phase(n_nodes=2000, n_jobs=8, gang=100, waves=2):
     the lifecycle tracker, measured on the WARM wave (the cold wave pays
     the XLA compiles; its number is reported separately), plus the
     continuous profiler's top busy frames — the host bottleneck by name.
+
+    ``pipelined=True`` arms the overlapped cycle (DESIGN §10): commit
+    I/O + binder round trips run on the commit-executor thread, so the
+    measured ``warm_cycle_s`` is the main-thread cycle interval — the
+    pipeline's real throughput period (the depth-1 token wait absorbs
+    any commit-stage excess), reported alongside the achieved
+    ``overlap_ratio``.
     """
     from kai_scheduler_tpu.controllers import (System, SystemConfig,
                                                make_pod, owner_ref)
@@ -202,7 +210,7 @@ def fleet_phase(n_nodes=2000, n_jobs=8, gang=100, waves=2):
         open_cap=max(8192, wave_pods * 2), ring=max(2048, wave_pods * 2))
     prof = StackProfiler(hz=97.0, max_stacks=8192)
     prof.start()
-    system = System(SystemConfig())
+    system = System(SystemConfig(pipelined_cycles=pipelined))
     api = system.api
     for i in range(n_nodes):
         api.create({"kind": "Node",
@@ -235,12 +243,17 @@ def fleet_phase(n_nodes=2000, n_jobs=8, gang=100, waves=2):
 
     def run_until_bound(expect, max_cycles=6):
         ts = []
-        for _ in range(max_cycles):
+        # Pipelined mode: binds land asynchronously, so the loop gets a
+        # small cycle allowance for the commit stage to catch up; the
+        # trailing flush waits out the final in-flight batch so
+        # pod_latency below sees every bound note.
+        for _ in range(max_cycles + (2 if pipelined else 0)):
             t_it = time.perf_counter()
             system.run_cycle()
             ts.append(time.perf_counter() - t_it)
             if LIFECYCLE.summary().get("bound_pods", 0) >= expect:
                 break
+        system.flush_pipeline()
         return ts
 
     try:
@@ -256,7 +269,9 @@ def fleet_phase(n_nodes=2000, n_jobs=8, gang=100, waves=2):
         # Wave 2: warm — the measured submit→bound SLO.
         LIFECYCLE.reset()
         submit_wave(2)
+        t_w = time.perf_counter()
         warm_cycles = run_until_bound(wave_pods)
+        warm_wave_s = time.perf_counter() - t_w
         pod_latency = LIFECYCLE.summary()
     finally:
         # A phase timeout must not leave a 97Hz sampler walking every
@@ -279,11 +294,13 @@ def fleet_phase(n_nodes=2000, n_jobs=8, gang=100, waves=2):
         "stale_writes_skipped": METRICS.counters.get(
             "stale_write_skipped_total", 0),
     }
-    return {
+    result = {
         "config": f"{n_nodes}nodes_{n_jobs * gang}pods_fleet",
+        "pipelined": bool(pipelined),
         "cold_wave_s": round(cold_s, 2),
         "cold_bound_pods": cold_bound,
         "warm_cycle_s": round(float(np.median(warm_cycles)), 3),
+        "warm_wave_s": round(warm_wave_s, 3),
         "warm_cycles": len(warm_cycles),
         "pod_latency": pod_latency,
         "incremental": incremental,
@@ -293,6 +310,287 @@ def fleet_phase(n_nodes=2000, n_jobs=8, gang=100, waves=2):
             "top_frames": prof.top_frames(6),
         },
     }
+    if pipelined and system.pipeline_stats:
+        ratios = [row["overlap_ratio"] for row in system.pipeline_stats]
+        result["pipeline"] = {
+            "overlap_ratio_mean": round(float(np.mean(ratios)), 3),
+            "overlap_ratio_max": round(float(np.max(ratios)), 3),
+            "executor": system.commit_executor.stats(),
+        }
+    system.stop_pipeline()
+    return result
+
+
+def burst_phase(n_nodes=400, over=2.0, cycles=4, pipelined=False,
+                gpu_per_node=8, baseline=False):
+    """System-level burst: ``over``x GPU-oversubscribed single-pod
+    workloads through the WHOLE fleet (admission -> grouper -> scheduler
+    -> binder -> status updater).  Exactly the GPU capacity binds; the
+    other half is a standing backlog whose re-attempt + status churn is
+    what the steady cycle measures — the shape where commit I/O, status
+    writes, and watch fanout dominate, i.e. what the overlapped pipeline
+    (DESIGN §10) and the coalescing/dedupe satellites attack."""
+    from kai_scheduler_tpu.controllers import (ShardSpec, System,
+                                               SystemConfig, make_pod)
+    from kai_scheduler_tpu.framework.conf import SchedulerConfig
+    from kai_scheduler_tpu.utils.metrics import METRICS
+
+    capacity = n_nodes * gpu_per_node
+    n_pods = int(capacity * over)
+    # Allocate-only: the burst row measures the backlog's re-attempt +
+    # status/fanout churn (the write-path costs this PR targets), not
+    # scenario-simulation depth — the reclaim ring measures that.
+    cfg = SchedulerConfig(actions=["allocate"])
+    system = System(SystemConfig(shards=[ShardSpec(config=cfg)],
+                                 pipelined_cycles=pipelined))
+    api = system.api
+    if baseline:
+        # Pre-PR10 behavior: rewrite every backlog group's Unschedulable
+        # condition every cycle (the A/B baseline, like PR9's "looped"
+        # fair-share mode).
+        for s_ in system.schedulers:
+            s_.cache.status_dedupe = False
+    for i in range(n_nodes):
+        api.create({"kind": "Node",
+                    "metadata": {"name": f"bn{i:05d}"}, "spec": {},
+                    "status": {"allocatable": {
+                        "cpu": "64", "memory": "512Gi",
+                        "nvidia.com/gpu": gpu_per_node, "pods": 110}}})
+    for q in range(4):
+        api.create({"kind": "Queue", "metadata": {"name": f"bq{q}"},
+                    "spec": {}})
+    for i in range(n_pods):
+        api.create(make_pod(f"burst-{i:06d}", queue=f"bq{i % 4}", gpu=1))
+    system.drain()
+    coalesced0 = METRICS.counters.get("watch_events_coalesced_total", 0)
+    deduped0 = METRICS.counters.get("status_writes_deduped_total", 0)
+    ts = []
+    for _ in range(cycles):
+        t0 = time.perf_counter()
+        system.run_cycle()
+        ts.append(time.perf_counter() - t0)
+    system.flush_pipeline()
+    system.drain()
+    bound = len([p for p in api.list("Pod")
+                 if p["spec"].get("nodeName")])
+    result = {
+        "config": f"{n_nodes}nodes_{n_pods}pods_burst",
+        "pipelined": bool(pipelined),
+        "status_dedupe": not baseline,
+        "first_cycle_s": round(ts[0], 3),
+        "steady_cycle_s": round(float(np.median(ts[1:] or ts)), 3),
+        "cycles": cycles,
+        "pods_bound": bound,
+        "expected_bound": capacity,
+        "capacity_note": (
+            f"capacity-bound: {n_nodes} nodes x {gpu_per_node} GPUs = "
+            f"{capacity} slots vs {n_pods} one-GPU pods "
+            f"({over:g}x demand)"),
+        "watch_events_coalesced": int(METRICS.counters.get(
+            "watch_events_coalesced_total", 0) - coalesced0),
+        "status_writes_deduped": int(METRICS.counters.get(
+            "status_writes_deduped_total", 0) - deduped0),
+    }
+    if pipelined and system.pipeline_stats:
+        ratios = [row["overlap_ratio"] for row in system.pipeline_stats]
+        result["overlap_ratio_mean"] = round(float(np.mean(ratios)), 3)
+    system.stop_pipeline()
+    return result
+
+
+def reclaim_system_phase(n_nodes=200, starved_jobs=16, starved_gpu=8,
+                         batched=True, gpu_per_node=8,
+                         substrate="memory"):
+    """System-level reclaim: queue q0 hogs the whole GPU pool (4x its
+    deserved share), then a starved queue's jobs arrive and the reclaim
+    action evicts victims — ``starved_jobs * starved_gpu`` serialized
+    eviction writes on the commit path.  ``batched=False`` forces the
+    per-victim synchronous write train (the A/B baseline);
+    ``batched=True`` routes the batch through the async status updater
+    with one flush per gang batch (``ClusterCache.evict_many``).
+
+    ``substrate="http"`` runs the whole fleet against a real
+    ``KubeAPIServer`` over loopback HTTP — eviction writes then cost
+    genuine round trips, which is the regime the batching targets (on
+    the in-memory store a patch is microseconds and thread-pool
+    coordination costs more than it saves; ``evict_write_ms`` reports
+    the write train either way so the row is apples-to-apples)."""
+    from kai_scheduler_tpu.controllers import (System, SystemConfig,
+                                               make_pod)
+
+    capacity = n_nodes * gpu_per_node
+    server = client = None
+    if substrate == "http":
+        from kai_scheduler_tpu.controllers.apiserver import KubeAPIServer
+        from kai_scheduler_tpu.controllers.httpclient import HTTPKubeAPI
+        server = KubeAPIServer().start()
+        client = HTTPKubeAPI(server.url)
+        system = System(SystemConfig(), api=client)
+    else:
+        system = System(SystemConfig())
+    api = system.api
+    per_queue = capacity // 4
+    for i in range(n_nodes):
+        api.create({"kind": "Node",
+                    "metadata": {"name": f"rn{i:05d}"}, "spec": {},
+                    "status": {"allocatable": {
+                        "cpu": "64", "memory": "512Gi",
+                        "nvidia.com/gpu": gpu_per_node, "pods": 110}}})
+    for q in range(4):
+        api.create({"kind": "Queue", "metadata": {"name": f"rq{q}"},
+                    "spec": {"deserved": {
+                        "cpu": str(64 * n_nodes // 4),
+                        "memory": f"{512 * n_nodes // 4}Gi",
+                        "gpu": per_queue}}})
+    for i in range(capacity):
+        api.create(make_pod(f"hog-{i:06d}", queue="rq0", gpu=1))
+    system.drain()
+    for _ in range(4):
+        system.run_cycle()
+        if len([p for p in api.list("Pod")
+                if p["spec"].get("nodeName")]) >= capacity:
+            break
+    # The starved queue's work arrives into the full cluster.
+    for j in range(starved_jobs):
+        api.create(make_pod(f"starved-{j:03d}", queue="rq1",
+                            gpu=starved_gpu))
+    system.drain()
+    caches = [s.cache for s in system.schedulers] + [system.cache]
+    for cache in caches:
+        cache.evict_batching = batched
+        cache.last_evict_write_s = 0.0
+    try:
+        t0 = time.perf_counter()
+        system.run_cycle()
+        reclaim_s = time.perf_counter() - t0
+        evicted = len([p for p in api.list("Pod")
+                       if p["metadata"].get("deletionTimestamp")])
+    finally:
+        if client is not None:
+            client.close()
+        if server is not None:
+            server.stop()
+    return {
+        "config": f"{n_nodes}nodes_{capacity}hogs_"
+                  f"{starved_jobs}x{starved_gpu}gpu_reclaim",
+        "substrate": substrate,
+        "evict_batched": bool(batched),
+        "reclaim_cycle_s": round(reclaim_s, 3),
+        # The write train alone (the part batching targets; the rest of
+        # the cycle is scenario-solver work already measured elsewhere).
+        "evict_write_ms": round(sum(c.last_evict_write_s
+                                    for c in caches) * 1000.0, 2),
+        "evictions": evicted,
+        "nodes": n_nodes,
+    }
+
+
+def reclaim_ab_main() -> int:
+    """Same-commit reclaim A/B (satellite): per-victim synchronous
+    eviction writes vs the batched ``evict_many`` path, recorded as two
+    ``reclaim-ab`` rows in results.jsonl."""
+    _enable_compile_cache()
+    import jax
+
+    backend = jax.default_backend()
+    # Warmup pass (in-memory, small): pays the reclaim solver's XLA
+    # compiles so the A/B pair measures writes, not compilation.
+    reclaim_system_phase(n_nodes=20, starved_jobs=4, batched=True)
+    rows = {}
+    for batched in (False, True):
+        r = reclaim_system_phase(n_nodes=48, starved_jobs=16,
+                                 starved_gpu=8, batched=batched,
+                                 substrate="http")
+        rows[batched] = r
+        _log(f"reclaim A/B batched={batched}: cycle "
+             f"{r['reclaim_cycle_s']}s, write train "
+             f"{r['evict_write_ms']}ms, {r['evictions']} evictions")
+        _append_result_row({"scenario": "reclaim-ab",
+                            "backend": backend, **r})
+    speedup = rows[False]["evict_write_ms"] / max(
+        rows[True]["evict_write_ms"], 1e-9)
+    _log(f"reclaim evict-write-train speedup: {speedup:.2f}x "
+         f"(evictions {rows[False]['evictions']} vs "
+         f"{rows[True]['evictions']})")
+    return 0
+
+
+def pipeline_ab_main() -> int:
+    """The tentpole's committed artifact (one commit, one machine):
+    serial-vs-pipelined A/B pairs on the fleet (2000n/4000p) and burst
+    (400n, 2x oversubscribed) shapes — identical ``pods_bound`` is
+    asserted, the steady-cycle ratio is the headline — plus the
+    pipelined churn ring carrying p99 submit→bound."""
+    _enable_compile_cache()
+    import jax
+
+    backend = jax.default_backend()
+    # Warmup: a small fleet + burst pass pays the XLA compiles so the
+    # A/B pairs below measure the scheduler, not compilation order.
+    fleet_phase(200, 4, 50)
+    burst_phase(24, cycles=2)
+    # --- fleet 2000n/4000p -----------------------------------------------
+    fleet = {}
+    for pipelined in (False, True):
+        r = fleet_phase(2000, 8, 500, pipelined=pipelined)
+        fleet[pipelined] = r
+        _log(f"fleet A/B pipelined={pipelined}: warm "
+             f"{r['warm_cycle_s']}s, bound "
+             f"{r['pod_latency'].get('bound_pods')}")
+        row = {"scenario": "fleet-pipeline-ab", "backend": backend,
+               "mode": "pipelined" if pipelined else "serial",
+               "config": r["config"],
+               "warm_cycle_s": r["warm_cycle_s"],
+               "warm_wave_s": r.get("warm_wave_s"),
+               "cold_wave_s": r["cold_wave_s"],
+               "pods_bound": r["pod_latency"].get("bound_pods"),
+               "p50_submit_bound_ms":
+                   r["pod_latency"].get("submit_to_bound_p50_ms"),
+               "p99_submit_bound_ms":
+                   r["pod_latency"].get("submit_to_bound_p99_ms")}
+        if "pipeline" in r:
+            row["overlap_ratio_mean"] = \
+                r["pipeline"]["overlap_ratio_mean"]
+        _append_result_row(row)
+    assert fleet[False]["pod_latency"].get("bound_pods") == \
+        fleet[True]["pod_latency"].get("bound_pods"), \
+        "pipelined fleet bound a different pod count than serial"
+    _log(f"fleet steady-cycle: serial {fleet[False]['warm_cycle_s']}s "
+         f"-> pipelined {fleet[True]['warm_cycle_s']}s "
+         f"({fleet[False]['warm_cycle_s'] / max(fleet[True]['warm_cycle_s'], 1e-9):.2f}x)")
+
+    # --- burst 400n, 2x oversubscribed -----------------------------------
+    # Three rungs, one commit: "baseline" re-creates the pre-PR10 cycle
+    # (serial, Unschedulable conditions rewritten every cycle — the
+    # self-inflicted O(backlog) churn), "serial" is the new write path
+    # without overlap, "pipelined" is the shipped mode.
+    burst = {}
+    for mode, pipelined, baseline in (("baseline", False, True),
+                                      ("serial", False, False),
+                                      ("pipelined", True, False)):
+        r = burst_phase(400, pipelined=pipelined, baseline=baseline)
+        burst[mode] = r
+        _log(f"burst A/B {mode}: steady {r['steady_cycle_s']}s, "
+             f"bound {r['pods_bound']}")
+        _append_result_row({"scenario": "burst-pipeline-ab",
+                            "backend": backend, "mode": mode, **r})
+    assert burst["baseline"]["pods_bound"] == \
+        burst["pipelined"]["pods_bound"] == \
+        burst["serial"]["pods_bound"], \
+        "burst A/B rungs bound different pod counts"
+    _log(f"burst steady-cycle: baseline "
+         f"{burst['baseline']['steady_cycle_s']}s -> pipelined "
+         f"{burst['pipelined']['steady_cycle_s']}s "
+         f"({burst['baseline']['steady_cycle_s'] / max(burst['pipelined']['steady_cycle_s'], 1e-9):.2f}x)")
+
+    # --- pipelined churn ring (p99 submit→bound headline) -----------------
+    row = churn_phase(pipelined=True)
+    _append_result_row({"scenario": "churn-ring", "backend": backend,
+                        "pipelined": True, **row})
+    _log(f"pipelined churn ring: cycle {row['cycle_s']}s, p99 "
+         f"submit→bound "
+         f"{row['pod_latency'].get('submit_to_bound_p99_ms')}ms")
+    return 0
 
 
 def forest_parent_indices(n_queues, roots=16, fanouts=(2, 2, 2, 2, 2, 8)):
@@ -415,7 +713,7 @@ def fairshare_microbench(n_queues=10000, roots=16,
 
 def churn_phase(n_nodes=256, n_queues=10000, cycles=8,
                 submit_per_cycle=400, mode="forest", seed=0,
-                gpu_per_node=8):
+                gpu_per_node=8, pipelined=False):
     """The heavy-traffic multi-tenant churn ring (ROADMAP item 3).
 
     A full ``System`` over one in-memory apiserver with an O(10k)-queue
@@ -440,7 +738,8 @@ def churn_phase(n_nodes=256, n_queues=10000, cycles=8,
 
     rng = np.random.default_rng(seed)
     cfg = SchedulerConfig(actions=["allocate"], fused_fairshare=mode)
-    system = System(SystemConfig(shards=[ShardSpec(config=cfg)]))
+    system = System(SystemConfig(shards=[ShardSpec(config=cfg)],
+                                 pipelined_cycles=pipelined))
     api = system.api
     t_setup = time.perf_counter()
     for i in range(n_nodes):
@@ -477,8 +776,9 @@ def churn_phase(n_nodes=256, n_queues=10000, cycles=8,
         for p in api.list("Pod"):
             api.delete("Pod", p["metadata"]["name"],
                        p["metadata"].get("namespace", "default"))
-        api.drain()
+        system.drain()
         system.run_cycle()
+        system.flush_pipeline()
         _log("churn warmup done; measuring stream")
         LIFECYCLE.reset()
         reuse0 = METRICS.counters.get("fairshare_prep_reuse_total", 0)
@@ -515,16 +815,21 @@ def churn_phase(n_nodes=256, n_queues=10000, cycles=8,
                 if p["metadata"].get("deletionTimestamp"):
                     api.delete("Pod", p["metadata"]["name"],
                                p["metadata"].get("namespace", "default"))
-            api.drain()
+            system.drain()
+        # Pipelined mode: the last cycles' binds are still in flight —
+        # land them before reading the latency summary.
+        system.flush_pipeline()
+        system.drain()
         pod_latency = LIFECYCLE.summary()
     finally:
         LIFECYCLE.configure_bounds(**old_bounds)
 
     slots = n_nodes * gpu_per_node
     expected_bound = min(total_pods, slots + completed + evicted)
-    return {
+    result = {
         "config": f"{n_nodes}nodes_{n_queues}queues_"
                   f"{submit_per_cycle}per_cycle",
+        "pipelined": bool(pipelined),
         "fairshare_mode": mode,
         "queues": n_queues,
         "leaves": len(leaves),
@@ -548,6 +853,11 @@ def churn_phase(n_nodes=256, n_queues=10000, cycles=8,
             f"{completed} completed + {evicted} evicted recycle their "
             f"slots, so at most {expected_bound} can be bound"),
     }
+    if pipelined and system.pipeline_stats:
+        ratios = [row["overlap_ratio"] for row in system.pipeline_stats]
+        result["overlap_ratio_mean"] = round(float(np.mean(ratios)), 3)
+    system.stop_pipeline()
+    return result
 
 
 def churn_main(iters: int = 7) -> int:
@@ -1531,5 +1841,16 @@ if __name__ == "__main__":
         # submit/complete/evict stream with p99 submit→bound, appended
         # to results.jsonl.
         sys.exit(churn_main())
+    elif "--pipeline-ab" in sys.argv:
+        # Overlapped-cycle A/B (DESIGN §10): serial-vs-pipelined pairs
+        # on the fleet (2000n/4000p) and burst (400n) shapes with
+        # identical pods_bound asserted, plus the pipelined churn ring
+        # carrying p99 submit→bound, appended to results.jsonl.
+        sys.exit(pipeline_ab_main())
+    elif "--reclaim-ab" in sys.argv:
+        # Same-commit reclaim eviction-write A/B: per-victim synchronous
+        # writes vs the batched evict_many path, appended to
+        # results.jsonl.
+        sys.exit(reclaim_ab_main())
     else:
         sys.exit(orchestrate())

@@ -392,6 +392,17 @@ class ClusterCache:
         # operator; Statement.commit journals intents through it and
         # startup_reconcile replays it after a restart.
         self.commitlog = None
+        # Batched eviction writes (evict_many): False forces the
+        # per-victim synchronous path — the A/B baseline for the
+        # reclaim bench (bench.py --reclaim-ab).  last_evict_write_s
+        # accumulates the write-train wall time either way (the bench's
+        # apples-to-apples number).
+        self.evict_batching = True
+        self.last_evict_write_s = 0.0
+        # Unschedulable-condition dedupe in update_job_statuses: False
+        # restores the rewrite-every-cycle behavior — the pre-PR10 A/B
+        # baseline for the burst bench.
+        self.status_dedupe = True
         # Watch-gap recovery: after the HTTP client re-lists past a 410
         # GONE, derived caches keyed on resourceVersions it may have
         # missed must be rebuilt.  Registered through a weakref: shard
@@ -470,6 +481,22 @@ class ClusterCache:
         # In-memory pipelined assignments surviving between cycles
         # (Cache.TaskPipelined): pod uid -> (node, gpu_group).
         self._pipelined: dict = {}
+        # -- speculative view (overlapped pipeline, DESIGN §10) -----------
+        # pod uid -> (seq, kind, node): placements/evictions whose commit
+        # I/O is still in flight on the commit executor.  snapshot()
+        # overlays these onto the parsed pods — a speculatively-bound pod
+        # reads BOUND on its node, a speculatively-evicted one RELEASING —
+        # so cycle N+1's world view includes cycle N's decisions BEFORE
+        # the watch echo of the async writes arrives.  Entries are
+        # sealed per cycle (seal_speculation) and cleared by the cycle's
+        # commit epilogue once the writes + binder round trip finished
+        # (by then the store echo carries the same state, so snapshots
+        # are equivalent at EVERY point of the overlap).  Guarded by
+        # _changes_lock: registered on the scheduler thread, cleared on
+        # the commit-executor thread.
+        self._speculative: dict = {}
+        self._spec_unsealed: dict = {}   # uid -> seq (current cycle's)
+        self._spec_seq = itertools.count(1)
         # Manifest-parse cache: pod uid -> (resourceVersion, template
         # PodInfo).  A pod whose resourceVersion hasn't moved re-parses
         # nothing; instances share the template's immutable pieces
@@ -569,11 +596,15 @@ class ClusterCache:
         gpu_group = md.get("annotations", {}).get(GPU_GROUP_ANNOTATION)
         if gpu_group:
             task.gpu_group = gpu_group
-        if rv is not None:
+        if rv is not None and md.get("resourceVersion") == rv:
             # Template is a dedicated instance: the returned task mutates
             # during the cycle (statements), the template never does.
             # instantiate() shares the immutable pieces, so the memoized
-            # request vectors survive across cycles.
+            # request vectors survive across cycles.  The rv re-check
+            # guards the overlapped pipeline: a commit-executor patch
+            # racing this parse (live dicts, in-memory store) must not
+            # persist a torn read under the pre-bump resourceVersion —
+            # uncached, the next snapshot re-parses the settled object.
             self._pod_cache[uid] = (rv, task.instantiate())
         return task
 
@@ -883,17 +914,66 @@ class ClusterCache:
         cache_seen = set()
         pod_sigs: dict = {}
         pod_mirror = self._mirror["Pod"]
+        # Frozen copy of the speculative view (overlapped commits whose
+        # writes are still in flight): applied onto the parsed pods below
+        # so this snapshot sees the previous cycle's decisions whether or
+        # not their watch echo has arrived.  A frozen copy — the commit
+        # epilogue may clear entries concurrently, and a half-applied
+        # clear mid-loop would make the snapshot internally inconsistent.
+        with self._changes_lock:
+            speculative = dict(self._speculative) if self._speculative \
+                else {}
+        n_overlaid = 0
         for pod_key in self._iter_order("Pod"):
             pod = pod_mirror[pod_key]
             group = pod["metadata"].get("labels", {}).get(POD_GROUP_LABEL)
             if not group or group not in podgroups:
                 continue
             task = self._parse_pod(pod)
+            # Speculative overlay: an in-flight bind reads as BOUND on
+            # its node (exactly what the store shows once the binder's
+            # echo lands); an in-flight evict reads RELEASING.  The
+            # overlay participates in the change signature below, so
+            # applying/clearing it dirties the arena the same way a real
+            # manifest change would.
+            spec_entry = speculative.get(task.uid)
+            if spec_entry is not None:
+                _seq, spec_kind, spec_node = spec_entry
+                if spec_kind == "bind":
+                    if task.status == PodStatus.PENDING \
+                            and not task.node_name \
+                            and spec_node in nodes:
+                        task.status = PodStatus.BOUND
+                        task.node_name = spec_node
+                        n_overlaid += 1
+                    elif task.status == PodStatus.RELEASING \
+                            and not task.node_name \
+                            and spec_node in nodes:
+                        # Deleted/evicted before the bind echo landed:
+                        # the serial path would show RELEASING on the
+                        # decided node — overlay the node, keep the
+                        # terminal-bound state.
+                        task.node_name = spec_node
+                        n_overlaid += 1
+                    else:  # echo landed (or pod moved on): no-op overlay
+                        spec_entry = None
+                elif spec_kind == "evict":
+                    if task.status not in (PodStatus.SUCCEEDED,
+                                           PodStatus.FAILED,
+                                           PodStatus.RELEASING):
+                        task.status = PodStatus.RELEASING
+                        n_overlaid += 1
+                    else:
+                        spec_entry = None
             # Pod-level change signature: a changed pod dirties the node
             # rows it touches (previous and current placement) and, when
             # it carries scheduling vocabulary (selectors/tolerations),
-            # poisons the codec reuse.
-            sig = (self._sig_rv(pod), task.node_name,
+            # poisons the codec reuse.  The speculative overlay folds
+            # into the rv component: overlay transitions re-dirty the
+            # pod even though the manifest's resourceVersion never moved.
+            sig = ((self._sig_rv(pod),
+                    spec_entry[1:] if spec_entry is not None else None),
+                   task.node_name,
                    bool(task.node_selector or task.tolerations))
             prev_sig = self._pod_sigs.get(task.uid)
             if prev_sig is None or prev_sig[0] != sig[0]:
@@ -988,6 +1068,10 @@ class ClusterCache:
             "store": {"nodes": len(nodes), "queues": len(queues),
                       "podgroups": len(podgroups),
                       "pods": len(self._mirror["Pod"])},
+            # Overlapped-pipeline verdict: how much of this snapshot's
+            # placement state came from the speculative view (in-flight
+            # commits) rather than the store echo.
+            "speculative_overlaid": n_overlaid,
         }
         cluster.cache_stats = self.last_snapshot_stats
         return cluster
@@ -1149,6 +1233,75 @@ class ClusterCache:
         (Cache.TaskPipelined, cache/interface.go:44)."""
         self._pipelined[task.uid] = (node_name, gpu_group)
 
+    # -- speculative view (overlapped commits, DESIGN §10) -------------------
+    def speculate(self, entries) -> dict:
+        """Register in-flight commit decisions: ``entries`` is
+        [(uid, kind, node)] with kind "bind" | "evict".  Returns
+        {uid: seq} — the handle the commit epilogue (or a fenced
+        rollback) later clears.  Called on the scheduler thread at
+        commit-enqueue time, BEFORE any durable write."""
+        out = {}
+        with self._changes_lock:
+            for uid, kind, node in entries:
+                seq = next(self._spec_seq)
+                self._speculative[uid] = (seq, kind, node)
+                self._spec_unsealed[uid] = seq
+                out[uid] = seq
+        METRICS.set_gauge("pipeline_speculative_entries",
+                          len(self._speculative))
+        return out
+
+    def seal_speculation(self) -> dict:
+        """Take ownership of every entry registered since the last seal
+        (one cycle's worth): the cycle epilogue clears exactly this set
+        after its writes + binder round trip landed."""
+        with self._changes_lock:
+            sealed, self._spec_unsealed = self._spec_unsealed, {}
+        return sealed
+
+    def clear_speculation(self, handle: dict) -> int:
+        """Drop sealed entries whose seq still matches (an entry
+        superseded by a NEWER decision for the same pod — e.g. a
+        speculatively-bound pod evicted the very next cycle — stays).
+        Runs on the commit-executor thread; the next snapshot's
+        signature diff re-dirties the affected pods/nodes on its own."""
+        cleared = 0
+        with self._changes_lock:
+            for uid, seq in handle.items():
+                entry = self._speculative.get(uid)
+                if entry is not None and entry[0] == seq:
+                    del self._speculative[uid]
+                    cleared += 1
+                # seq-conditional: cycle N's epilogue (commit-executor
+                # thread) must not unregister a NEWER decision for the
+                # same pod that cycle N+1's decision phase speculated
+                # concurrently — that entry belongs to N+1's seal, and
+                # dropping it here would leave it uncleared forever.
+                if self._spec_unsealed.get(uid) == seq:
+                    del self._spec_unsealed[uid]
+        METRICS.set_gauge("pipeline_speculative_entries",
+                          len(self._speculative))
+        return cleared
+
+    def rollback_speculation(self, handle: dict, reason: str) -> int:
+        """Fenced/failed overlapped commit: the decisions never became
+        durable — remove their speculative view so the next snapshot
+        re-schedules the pods from scratch (the serial path's
+        abort_uncommitted analog, one pipeline stage later)."""
+        rolled = self.clear_speculation(handle)
+        if rolled:
+            METRICS.inc("pipeline_speculation_rollback_total", rolled)
+            self.record_event(
+                "SpeculationRolledBack",
+                f"{rolled} overlapped commit decision(s) rolled back: "
+                f"{reason}")
+        return rolled
+
+    def speculation_stats(self) -> dict:
+        with self._changes_lock:
+            return {"entries": len(self._speculative),
+                    "unsealed": len(self._spec_unsealed)}
+
     def evict(self, task) -> None:
         """Delete the pod + patch the eviction condition
         (cache/evictor/default_evictor.go:24-45)."""
@@ -1170,6 +1323,106 @@ class ClusterCache:
             # closes; a resubmit opens attempt N+1 on the same timeline.
             LIFECYCLE.note_evicted(task.uid)
 
+    def evict_many(self, tasks) -> int:
+        """Batched eviction writes: one dedicated patch per victim is
+        built host-side and routed through the async status-updater
+        worker pool with ONE flush for the whole gang batch, instead of
+        one synchronous API round trip per victim (the serialized write
+        train that dominated the 400-node reclaim cycle).  The fence
+        kwargs ride in the payload so a deposed leader's eviction is
+        still rejected at apply time (KAI005 intent).  Falls back to the
+        per-victim synchronous path when no async updater is attached."""
+        import time as _time
+        tasks = list(tasks)
+        if not tasks:
+            return 0
+        updater = self.status_updater
+        if not self.evict_batching or updater is None \
+                or not hasattr(updater, "submit_patch"):
+            t0 = _time.perf_counter()
+            for task in tasks:
+                self.evict(task)
+            dt = _time.perf_counter() - t0
+            self.last_evict_write_s += dt
+            METRICS.observe("evict_write_latency_ms", dt * 1000.0)
+            return len(tasks)
+        fk = self._fence_kwargs()
+        # Loud deposal check BEFORE enqueueing: the synchronous evict
+        # path raised Fenced at the patch — the batch path must not
+        # silently downgrade that to a per-write drop on the worker.
+        # (A depose in the enqueue->apply window is still rejected at
+        # the store; only the loud abort moves here.)
+        check_fence = getattr(self.api, "check_fence", None)
+        if check_fence is not None and fk:
+            check_fence(fk.get("epoch"), fk.get("fence"))
+        enqueued = 0
+        t0 = _time.perf_counter()
+        # Per-victim outcome, written on the worker threads (per-key
+        # dict stores are atomic): absent = write landed, "vanished" =
+        # pod gone before the write (the serial path's silent no-op),
+        # exception = the write failed.  Worker-side failures surface
+        # HERE after the flush exactly like the synchronous evict —
+        # Fenced first, then any other failure — so the commit never
+        # marks a failed eviction done and never proceeds to a bind
+        # whose victim still holds its capacity.
+        outcomes: dict = {}
+        with TRACER.span("evict_batch", kind="kubeapi",
+                         op="evict_batch", victims=len(tasks),
+                         epoch=fk.get("epoch")):
+            now = str(self.now_fn())
+
+            def build_evict(name, namespace, uid):
+                # Runs ON THE WORKER: the read-modify-write round trip
+                # parallelizes across the pool instead of serializing
+                # per-victim reads on the commit thread.
+                def build():
+                    pod = self.api.get_opt("Pod", name, namespace)
+                    if pod is None:
+                        outcomes[uid] = "vanished"
+                        return None   # vanished: skip the doomed write
+                    conditions = list(pod.get("status", {}).get(
+                        "conditions", []))
+                    conditions.append(
+                        {"type": "TerminationByKaiScheduler",
+                         "status": "True", "reason": "Evicted"})
+                    return {"status": {"conditions": conditions},
+                            "metadata": {"deletionTimestamp": now}}
+                return build
+
+            for task in tasks:
+                updater.submit_patch(
+                    "Pod", task.name, task.namespace,
+                    build=build_evict(task.name, task.namespace,
+                                      task.uid),
+                    fence_kwargs=fk,
+                    on_error=lambda exc, uid=task.uid:
+                        outcomes.__setitem__(uid, exc))
+                enqueued += 1
+            METRICS.inc("evict_writes_batched_total", enqueued)
+            # One flush per gang batch: the commit returns with every
+            # eviction durably applied (or loudly raised), matching the
+            # synchronous path's guarantees at a fraction of its
+            # serialized round-trip cost.
+            updater.flush()
+        dt = _time.perf_counter() - t0
+        self.last_evict_write_s += dt
+        METRICS.observe("evict_write_latency_ms", dt * 1000.0)
+        # Lifecycle attempts close only for evictions that actually
+        # landed — vanished pods stay a no-op and failed writes stay
+        # open, exactly like the per-victim synchronous path.
+        for task in tasks:
+            if task.uid not in outcomes:
+                LIFECYCLE.note_evicted(task.uid)
+        from .kubeapi import Fenced
+        failures = [exc for exc in outcomes.values()
+                    if isinstance(exc, BaseException)]
+        for exc in failures:
+            if isinstance(exc, Fenced):
+                raise exc
+        if failures:
+            raise failures[0]
+        return enqueued
+
     def record_event(self, kind: str, message: str) -> None:
         # Correlation: events emitted mid-cycle carry the cycle's trace
         # id (None off the scheduler thread — watch/binder events).
@@ -1189,12 +1442,36 @@ class ClusterCache:
         """Push scheduling explanations onto PodGroup statuses
         (status_updater markPodGroupUnschedulable,
         default_status_updater.go:295); routed through the async worker
-        pool when one is attached."""
+        pool when one is attached.
+
+        DEDUPED: a group whose current Unschedulable condition already
+        carries the same message is skipped — on a sustained
+        over-capacity backlog the un-deduped path rewrote thousands of
+        identical conditions per cycle, and every rewrite bumped the
+        object's resourceVersion, forcing the incremental cache to
+        re-parse the whole backlog next snapshot (self-inflicted
+        O(backlog) host work)."""
+        group_mirror = self._mirror.get("PodGroup", {})
         for pg in ssn.cluster.podgroups.values():
             if not pg.fit_errors:
                 continue
-            obj = self.api.get_opt("PodGroup", pg.uid, pg.namespace)
+            # The watch-fresh mirror already holds the manifest: no API
+            # read per backlog group (3200 pending groups used to cost
+            # 3200 reads per cycle just to decide "nothing changed").
+            obj = group_mirror.get((pg.namespace, pg.uid)) \
+                or self.api.get_opt("PodGroup", pg.uid, pg.namespace)
             if obj is None:
+                continue
+            current = next(
+                (c for c in obj.get("status", {}).get("conditions", [])
+                 if c.get("type") == "Unschedulable"
+                 and c.get("status") == "True"), None)
+            if self.status_dedupe and current is not None \
+                    and current.get("message") == pg.fit_errors[-1]:
+                # Same verdict as last cycle: rewriting it (with only a
+                # fresh traceId) is churn, not information — /explain
+                # still has the live per-cycle ledger.
+                METRICS.inc("status_writes_deduped_total")
                 continue
             conditions = [c for c in obj.get("status", {}).get(
                 "conditions", []) if c.get("type") != "Unschedulable"]

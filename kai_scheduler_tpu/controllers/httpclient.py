@@ -41,7 +41,7 @@ from typing import Callable
 from ..utils import backoff_delay
 from ..utils.deviceguard import control_fault
 from ..utils.metrics import METRICS
-from .kubeapi import Conflict, Fenced, NotFound, obj_key
+from .kubeapi import Conflict, Fenced, NotFound, coalesce_events, obj_key
 
 RECONNECT_BASE_S = 0.2
 RECONNECT_CAP_S = 5.0
@@ -340,7 +340,11 @@ class HTTPKubeAPI:
         self._idle_hooks.append(callback)
 
     def drain(self, max_rounds: int = 100) -> int:
-        """Deliver queued watch events to handlers on this thread."""
+        """Deliver queued watch events to handlers on this thread.  Like
+        the in-memory substrate, fanout coalesces per batch: a MODIFIED
+        burst for one key collapses to its newest event (latest
+        resourceVersion wins) before subscriber delivery, counted by
+        ``watch_events_coalesced_total``."""
         delivered = 0
         for _ in range(max_rounds):
             with self._pending_lock:
@@ -354,7 +358,7 @@ class HTTPKubeAPI:
                         if not self._pending:
                             break
                 continue
-            for event_type, obj in batch:
+            for event_type, obj in coalesce_events(batch):
                 for handler in list(self._watchers.get(obj["kind"], ())):
                     handler(event_type, obj)
                 for handler in list(self._watchers.get("*", ())):

@@ -15,6 +15,7 @@ Objects are plain dicts shaped like K8s manifests:
 from __future__ import annotations
 
 import itertools
+import threading
 import uuid
 from collections import defaultdict
 from typing import Callable
@@ -52,6 +53,13 @@ class InMemoryKubeAPI:
         self._rv = itertools.count(1)
         self._watchers: dict[str, list[Callable]] = defaultdict(list)
         self._pending: list[tuple] = []  # (event_type, obj) queue
+        # Store mutex: CRUD and list() run from multiple threads once the
+        # overlapped pipeline is armed (the commit executor writes binds
+        # while the scheduler thread snapshots) and under concurrent
+        # sharded schedulers.  RLock: patch() nests get()+update().
+        # Handler delivery in drain() stays OUTSIDE the lock — handlers
+        # re-enter the API freely.
+        self._store_lock = threading.RLock()
         # Synchronous change subscribers, invoked at EMIT time (not at
         # drain): the incremental ClusterCache marks objects dirty the
         # instant they mutate, so a snapshot taken without an intervening
@@ -73,7 +81,8 @@ class InMemoryKubeAPI:
         that never lead write unfenced)."""
         if fence is None or epoch is None:
             return
-        lease = self.objects.get(("Lease", FENCE_NAMESPACE, fence))
+        with self._store_lock:
+            lease = self.objects.get(("Lease", FENCE_NAMESPACE, fence))
         if lease is None:
             return
         current = int(lease.get("spec", {}).get("epoch", 0) or 0)
@@ -87,31 +96,36 @@ class InMemoryKubeAPI:
     def create(self, obj: dict, epoch: int | None = None,
                fence: str | None = None) -> dict:
         self.check_fence(epoch, fence)
-        md = obj.setdefault("metadata", {})
-        md.setdefault("namespace", "default")
-        md.setdefault("uid", uuid.uuid4().hex[:12])
-        md["resourceVersion"] = str(next(self._rv))
-        key = obj_key(obj)
-        if key in self.objects:
-            raise Conflict(f"{key} already exists")
-        self.objects[key] = obj
-        self._emit("ADDED", obj)
+        with self._store_lock:
+            md = obj.setdefault("metadata", {})
+            md.setdefault("namespace", "default")
+            md.setdefault("uid", uuid.uuid4().hex[:12])
+            md["resourceVersion"] = str(next(self._rv))
+            key = obj_key(obj)
+            if key in self.objects:
+                raise Conflict(f"{key} already exists")
+            self.objects[key] = obj
+            self._emit("ADDED", obj)
         return obj
 
     def get(self, kind: str, name: str, namespace: str = "default") -> dict:
         key = (kind, namespace, name)
-        if key not in self.objects:
-            raise NotFound(str(key))
-        return self.objects[key]
+        with self._store_lock:
+            if key not in self.objects:
+                raise NotFound(str(key))
+            return self.objects[key]
 
     def get_opt(self, kind: str, name: str,
                 namespace: str = "default") -> dict | None:
-        return self.objects.get((kind, namespace, name))
+        with self._store_lock:
+            return self.objects.get((kind, namespace, name))
 
     def list(self, kind: str, namespace: str | None = None,
              label_selector: dict | None = None) -> list[dict]:
         out = []
-        for (k, ns, _), obj in self.objects.items():
+        with self._store_lock:
+            items = list(self.objects.items())
+        for (k, ns, _), obj in items:
             if k != kind:
                 continue
             if namespace is not None and ns != namespace:
@@ -128,36 +142,41 @@ class InMemoryKubeAPI:
                fence: str | None = None) -> dict:
         self.check_fence(epoch, fence)
         key = obj_key(obj)
-        if key not in self.objects:
-            raise NotFound(str(key))
-        # Optimistic concurrency: a stale resourceVersion loses the write
-        # race (K8s update semantics; what makes Lease elections safe).
-        current = self.objects[key]
-        sent_rv = obj.get("metadata", {}).get("resourceVersion")
-        if (obj is not current and sent_rv is not None
-                and sent_rv != current["metadata"].get("resourceVersion")):
-            raise Conflict(f"{key} resourceVersion {sent_rv} is stale")
-        obj["metadata"]["resourceVersion"] = str(next(self._rv))
-        self.objects[key] = obj
-        self._emit("MODIFIED", obj)
+        with self._store_lock:
+            if key not in self.objects:
+                raise NotFound(str(key))
+            # Optimistic concurrency: a stale resourceVersion loses the
+            # write race (K8s update semantics; what makes Lease
+            # elections safe).
+            current = self.objects[key]
+            sent_rv = obj.get("metadata", {}).get("resourceVersion")
+            if (obj is not current and sent_rv is not None
+                    and sent_rv !=
+                    current["metadata"].get("resourceVersion")):
+                raise Conflict(f"{key} resourceVersion {sent_rv} is stale")
+            obj["metadata"]["resourceVersion"] = str(next(self._rv))
+            self.objects[key] = obj
+            self._emit("MODIFIED", obj)
         return obj
 
     def patch(self, kind: str, name: str, patch: dict,
               namespace: str = "default", epoch: int | None = None,
               fence: str | None = None) -> dict:
         self.check_fence(epoch, fence)
-        obj = self.get(kind, name, namespace)
-        _deep_merge(obj, patch)
-        return self.update(obj)
+        with self._store_lock:
+            obj = self.get(kind, name, namespace)
+            _deep_merge(obj, patch)
+            return self.update(obj)
 
     def delete(self, kind: str, name: str,
                namespace: str = "default", epoch: int | None = None,
                fence: str | None = None) -> None:
         self.check_fence(epoch, fence)
         key = (kind, namespace, name)
-        obj = self.objects.pop(key, None)
-        if obj is not None:
-            self._emit("DELETED", obj)
+        with self._store_lock:
+            obj = self.objects.pop(key, None)
+            if obj is not None:
+                self._emit("DELETED", obj)
 
     # -- watch -------------------------------------------------------------
     def watch(self, kind: str, handler: Callable) -> None:
@@ -203,24 +222,65 @@ class InMemoryKubeAPI:
         """Deliver queued events until quiescent (reconcilers may create
         new objects while handling events).  Returns events delivered.
         When the queue empties, drain-idle hooks run; work they enqueue
-        (coalesced grouping/binding batches) continues the loop."""
+        (coalesced grouping/binding batches) continues the loop.
+
+        Fanout is COALESCED per batch: a MODIFIED burst for one object
+        collapses to its latest event before subscriber delivery
+        (``coalesce_events`` — latest-rv wins; ADDED/DELETED boundaries
+        are preserved), so N writers touching one pod cost one handler
+        pass, not N."""
         delivered = 0
         for _ in range(max_rounds):
-            if not self._pending:
+            with self._store_lock:
+                batch, self._pending = self._pending, []
+            if not batch:
                 worked = False
                 for cb in list(self._idle_hooks):
                     worked = bool(cb()) or worked
-                if not worked and not self._pending:
-                    break
+                with self._store_lock:
+                    if not worked and not self._pending:
+                        break
                 continue
-            batch, self._pending = self._pending, []
-            for event_type, obj in batch:
+            for event_type, obj in coalesce_events(batch):
                 for handler in list(self._watchers.get(obj["kind"], ())):
                     handler(event_type, obj)
                 for handler in list(self._watchers.get("*", ())):
                     handler(event_type, obj)
                 delivered += 1
         return delivered
+
+
+def coalesce_events(batch: list) -> list:
+    """Per-key watch-event dedupe for one delivery batch: a MODIFIED is
+    dropped when a LATER MODIFIED for the same object exists in the
+    batch (latest resourceVersion wins — on the in-memory store every
+    queued MODIFIED references the live object anyway, so intermediate
+    deliveries carry no information).  ADDED and DELETED events are
+    never dropped and never reordered, so lifecycle boundaries —
+    including delete-then-recreate inside one batch — reach subscribers
+    intact.  Drops are counted in ``watch_events_coalesced_total``."""
+    if len(batch) < 2:
+        return batch
+    seen_modified: set = set()
+    out_rev = []
+    dropped = 0
+    for event_type, obj in reversed(batch):
+        if event_type == "MODIFIED":
+            try:
+                key = obj_key(obj)
+            except KeyError:
+                out_rev.append((event_type, obj))
+                continue
+            if key in seen_modified:
+                dropped += 1
+                continue
+            seen_modified.add(key)
+        out_rev.append((event_type, obj))
+    if dropped:
+        from ..utils.metrics import METRICS
+        METRICS.inc("watch_events_coalesced_total", dropped)
+    out_rev.reverse()
+    return out_rev
 
 
 def replace_status(api, kind: str, name: str, status: dict,

@@ -41,6 +41,14 @@ class SystemConfig:
     # False = companion-controller mode: never build schedulers, even when
     # SchedulingShard objects appear (the scheduler deployment owns them).
     scheduling_enabled: bool = True
+    # Overlapped fleet cycle (DESIGN §10): run stage C — journal fsync,
+    # BindRequest/evict/status writes, binder round trips — on a commit-
+    # executor thread so cycle N's commit I/O overlaps cycle N+1's host
+    # prep and device work.  ``run_cycle`` then returns after the
+    # decision phase with the commit batch in flight; call
+    # ``flush_pipeline()`` before asserting on store state.  False keeps
+    # the serial cycle byte-for-byte (existing tests/deployments).
+    pipelined_cycles: bool = False
     require_queue_label: bool = False
     now_fn: object = None
     # Time-based fairness: usage-db client spec ("memory://", None = off)
@@ -96,6 +104,31 @@ class System:
         # Fencing state, armed by set_fence() once a Lease is held.
         self._fence_name: str | None = None
         self._epoch_provider = None
+        # -- overlapped pipeline state (DESIGN §10) -----------------------
+        import threading
+        from collections import deque
+        # Serializes event drains / binder ticks / GC across the cycle
+        # thread and the commit-executor thread: controller state
+        # (grouper batches, binder queues) is single-threaded by this
+        # lock, wherever the drain runs.
+        self._control_lock = threading.RLock()
+        self._pipe_lock = threading.Lock()
+        # cycle id -> [(cache, speculation handle)] awaiting their
+        # commit epilogue's clear (poison recovery clears leftovers).
+        self._pending_spec: dict = {}
+        self._pipeline_cycle = 0
+        self._older_token = 0
+        self._last_token = 0
+        self.pipeline_stats: deque = deque(maxlen=256)
+        self.commit_executor = None
+        # Sticky serial fallback after a poisoned (fenced/crashed)
+        # commit stream: a deposed instance must not resume overlapping
+        # on its own — enable_pipeline() re-arms explicitly.  A
+        # breaker-open drain is NOT sticky: overlap resumes when the
+        # device path heals.
+        self._pipeline_suspended = False
+        if self.config.pipelined_cycles:
+            self.enable_pipeline()
         self.schedulers = []
         self._config_rv = None     # last reconciled Config resourceVersion
         self._global_sched_args = {}  # Config CRD spec.scheduler.args
@@ -306,29 +339,249 @@ class System:
         BEFORE the first scheduling cycle."""
         return self.cache.startup_reconcile(self.commitlog)
 
+    # -- overlapped pipeline (DESIGN §10) ------------------------------------
+    def enable_pipeline(self):
+        """Arm the overlapped cycle: stage-C commit work runs on a
+        dedicated executor thread from the next ``run_cycle`` on."""
+        from ..framework.pipeline import CommitExecutor
+        if self.commit_executor is None:
+            self.commit_executor = CommitExecutor()
+        self._pipeline_suspended = False
+        return self.commit_executor
+
+    def _pipeline_ready(self) -> bool:
+        """Overlap only while the device path is healthy: a breaker that
+        is not closed (or an executor poisoned by a fenced/crashed
+        commit) drains the pipeline back to the serial path — degraded
+        mode must stay the simple, proven code path."""
+        ex = self.commit_executor
+        if ex is None or ex.poisoned is not None \
+                or self._pipeline_suspended:
+            return False
+        from ..utils.deviceguard import device_guard
+        guard = device_guard()
+        return not guard.degraded and guard.breaker.state == "closed"
+
+    def drain(self, max_rounds: int = 100) -> int:
+        """Control-locked event drain: safe against a concurrently
+        running commit epilogue (benches and tests drive churn through
+        this instead of ``api.drain()`` once the pipeline is armed)."""
+        with self._control_lock:
+            return self.api.drain(max_rounds)
+
+    def flush_pipeline(self, timeout: float = 60.0) -> None:
+        """Wait for every in-flight commit batch and epilogue; re-raises
+        the first recorded commit error (a chaos ``SimulatedCrash``
+        included) so nothing fails silently.  Call before asserting on
+        store state in pipelined mode."""
+        ex = self.commit_executor
+        if ex is None:
+            return
+        ex.wait_token(ex.token(), timeout=timeout)
+        ex.raise_pending()
+
+    def stop_pipeline(self, timeout: float = 60.0) -> None:
+        """Tear the pipeline down: wait out in-flight commit work and
+        join the executor thread.  For shutdown paths and benches that
+        build many Systems — without this every pipelined System leaks
+        one polling daemon thread for the life of the process.
+        ``enable_pipeline()`` re-arms."""
+        ex = self.commit_executor
+        if ex is None:
+            return
+        ex.wait_token(ex.token(), timeout=timeout)
+        ex.stop()
+        self.commit_executor = None
+
+    def _drain_pipeline_to_serial(self) -> None:
+        """Drain the pipeline back to the serial path: wait out in-flight
+        commit work, run any epilogue a poisoned executor skipped (so
+        bind echoes land and no placement is lost), and clear leftover
+        speculation.  The next cycle then runs serially against the true
+        store state."""
+        from ..utils.logging import LOG
+        from ..utils.metrics import METRICS
+        ex = self.commit_executor
+        if ex is None:
+            return
+        if not ex.wait_token(ex.token(), timeout=60.0):
+            # A commit batch is wedged past the drain budget: do NOT
+            # clear speculation or tokens — the batch's writes may still
+            # land, and dropping the speculative view now would let the
+            # serial snapshot re-schedule pods the batch then binds
+            # (double-bind).  Leave state intact; the next cycle retries
+            # the drain, and the overlay keeps snapshots correct
+            # meanwhile.
+            METRICS.inc("pipeline_drain_timeouts_total")
+            LOG.error("pipeline drain timed out with commit work still "
+                      "in flight; retrying next cycle")
+            return
+        reason = ex.poisoned
+        if reason is not None:
+            METRICS.inc("pipeline_drained_to_serial_total")
+            LOG.warning("pipeline drained to serial path: %s", reason)
+            ex.clear_poison()
+            # Sticky: a fenced/crashed commit stream does not resume
+            # overlapping on its own (enable_pipeline re-arms).
+            self._pipeline_suspended = True
+        with self._pipe_lock:
+            leftovers = list(self._pending_spec.items())
+            self._pending_spec.clear()
+        if leftovers:
+            # Skipped epilogues: deliver the landed writes' echoes and
+            # release the (already-landed) speculative entries — the
+            # fenced rollback removed the un-landed ones at fault time.
+            self._run_control_epilogue()
+            for _cid, sealed in leftovers:
+                for cache, handle in sealed:
+                    cache.clear_speculation(handle)
+        self._older_token = self._last_token = 0
+        ex.raise_pending()
+
+    def _run_control_epilogue(self) -> None:
+        """The post-decision controller pass shared by the serial cycle
+        and the commit epilogue: deliver events, run the binder, flush
+        status writes, reconcile queues, GC stale binds."""
+        from .kubeapi import Fenced
+        with self._control_lock:
+            self.api.drain()
+            self.binder.tick()
+        self.status_updater.flush()
+        with self._control_lock:
+            self.queue_controller.reconcile_if_dirty()
+            try:
+                self.cache.gc_stale_bind_requests()
+            except Fenced:
+                # Deposed between cycles: GC writes are the new leader's
+                # job now; the daemon's election loop stands this one
+                # down.
+                pass
+            self.api.drain()
+
+    def _record_decisions(self, ssn) -> None:
+        if self.usage_db is not None \
+                and getattr(ssn, "proportion", None) is not None:
+            for qid, attrs in ssn.proportion.queues.items():
+                self.usage_db.record(self._now_fn(), qid,
+                                     attrs.allocated)
+
     def run_cycle(self) -> None:
         """One end-to-end tick: drain controller events, run every shard's
-        scheduling cycle, drain the binder's work."""
-        from .kubeapi import Fenced
-        self.api.drain()
+        scheduling cycle, drain the binder's work.  With the pipeline
+        armed (SystemConfig.pipelined_cycles / enable_pipeline) the
+        commit/binder stage runs on the executor thread and this call
+        returns after the decision phase — see DESIGN §10."""
+        if self.commit_executor is not None and not self._pipeline_ready():
+            self._drain_pipeline_to_serial()
+        if self.commit_executor is not None and self._pipeline_ready():
+            return self._run_cycle_pipelined()
+        with self._control_lock:
+            self.api.drain()
         self.reconcile_config()
         self.reconcile_shards()
         for scheduler in self.schedulers:
             ssn = scheduler.run_once()
             scheduler.cache.update_job_statuses(ssn)
-            if self.usage_db is not None \
-                    and getattr(ssn, "proportion", None) is not None:
-                for qid, attrs in ssn.proportion.queues.items():
-                    self.usage_db.record(self._now_fn(), qid,
-                                         attrs.allocated)
-        self.api.drain()
-        self.binder.tick()
-        self.status_updater.flush()
-        self.queue_controller.reconcile_if_dirty()
+            self._record_decisions(ssn)
+        self._run_control_epilogue()
+
+    def _run_cycle_pipelined(self) -> None:
+        """The overlapped cycle: stage A (drain + snapshot) and stage B
+        (plugins + actions + device dispatch) on this thread; stage C
+        (journal fsync, bind/evict/status writes, binder round trips)
+        in flight on the commit executor — cycle N's stage C overlaps
+        cycle N+1's stages A+B.  Decisions become visible to the next
+        snapshot through the speculative view the moment they are made,
+        so placements are identical to the serial path at every point
+        of the overlap (tests/test_pipeline_cycle.py asserts
+        bit-identity under randomized churn)."""
+        import time as _time
+
+        from ..utils.metrics import METRICS
+
+        ex = self.commit_executor
+        t0 = _time.monotonic()
+        # Pipeline depth 1: cycle N waits for cycle N-2's commit batch —
+        # at most one cycle's stage C is ever in flight, bounding both
+        # memory and the speculation horizon.  A wedged batch (store
+        # stalled past the wait budget) SKIPS this cycle instead of
+        # overlapping anyway: sealing more speculation on top of an
+        # unbounded in-flight tail would break exactly that bound.
+        if self._older_token:
+            if not ex.wait_token(self._older_token):
+                from ..utils.logging import LOG
+                METRICS.inc("pipeline_depth_wait_timeouts_total")
+                LOG.error("pipelined cycle skipped: older commit batch "
+                          "still in flight past the wait budget")
+                return
+        # -- stage A: host prep ------------------------------------------
+        with self._control_lock:
+            self.api.drain()
+        self.reconcile_config()
+        self.reconcile_shards()
+        # -- stage B: decisions (device dispatch + speculative commits) --
+        cycle_sessions = []
+        for scheduler in self.schedulers:
+            scheduler.commit_executor = ex
+            try:
+                ssn = scheduler.run_once()
+            finally:
+                scheduler.commit_executor = None
+            cycle_sessions.append((scheduler, ssn))
+            self._record_decisions(ssn)
+        # -- stage C: seal the cycle's speculation, enqueue the epilogue -
+        sealed = [(s.cache, s.cache.seal_speculation())
+                  for s, _ in cycle_sessions]
+        self._pipeline_cycle += 1
+        cycle_id = self._pipeline_cycle
+        with self._pipe_lock:
+            self._pending_spec[cycle_id] = sealed
         try:
-            self.cache.gc_stale_bind_requests()
-        except Fenced:
-            # Deposed between cycles: GC writes are the new leader's job
-            # now; the daemon's election loop will stand this one down.
-            pass
-        self.api.drain()
+            ex.submit(lambda: self._commit_epilogue(cycle_id,
+                                                    cycle_sessions),
+                      label=f"epilogue-{cycle_id}")
+        except Exception:
+            # Executor poisoned by a commit batch THIS cycle enqueued:
+            # recover now (runs the epilogue synchronously + clears
+            # speculation); the next run_cycle goes serial.
+            self._drain_pipeline_to_serial()
+            return
+        self._older_token, self._last_token = \
+            self._last_token, ex.token()
+        # -- overlap accounting ------------------------------------------
+        t1 = _time.monotonic()
+        busy = ex.busy_seconds(t0, t1)
+        ratio = min(1.0, busy / max(t1 - t0, 1e-9))
+        METRICS.set_gauge("cycle_overlap_ratio", ratio)
+        self.pipeline_stats.append({
+            "cycle": cycle_id,
+            "main_thread_s": round(t1 - t0, 4),
+            "commit_busy_s": round(busy, 4),
+            "overlap_ratio": round(ratio, 4)})
+        if ex.poisoned is not None:
+            self._drain_pipeline_to_serial()
+
+    def _commit_epilogue(self, cycle_id: int, cycle_sessions) -> None:
+        """Stage C tail, on the commit executor: ship the cycle's status
+        explanations, deliver bind echoes, run the binder + GC, then
+        release the cycle's speculative view (by which time the store
+        echo carries the same placements, so snapshots never observe a
+        gap)."""
+        import time as _time
+
+        from ..utils.tracing import TRACER
+        t0 = _time.perf_counter()
+        try:
+            for scheduler, ssn in cycle_sessions:
+                scheduler.cache.update_job_statuses(ssn)
+            self._run_control_epilogue()
+        finally:
+            with self._pipe_lock:
+                sealed = self._pending_spec.pop(cycle_id, [])
+            for cache, handle in sealed:
+                cache.clear_speculation(handle)
+            dt = _time.perf_counter() - t0
+            for _s, ssn in cycle_sessions:
+                TRACER.attach_async_span(
+                    getattr(ssn, "trace_id", None), "stage:epilogue",
+                    "commit_async", dt)

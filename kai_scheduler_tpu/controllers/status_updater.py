@@ -24,6 +24,13 @@ class AsyncStatusUpdater:
     # costs a doomed (but harmless) write attempt.
     GONE_CAP = 8192
 
+    # Cross-cycle event dedupe ring: an identical (reason, message,
+    # about) event re-emitted every cycle for a standing backlog (e.g.
+    # per-job Unschedulable announcements) writes ONCE until the ring
+    # resets at capacity — the reference's event-recorder aggregation,
+    # minus the count field.  /explain keeps the live per-cycle truth.
+    RECENT_EVENT_CAP = 8192
+
     def __init__(self, api, num_workers: int = 4):
         self.api = api
         # One queue PER worker, keys sharded by hash: all writes for one
@@ -39,6 +46,7 @@ class AsyncStatusUpdater:
         # them sat in the queue: the worker drops those writes instead
         # of paying a doomed API round trip (stale_write_skipped_total).
         self._gone: set = set()
+        self._recent_events: set = set()
         watch = getattr(api, "watch", None)
         if watch is not None:
             for kind in ("PodGroup", "BindRequest"):
@@ -86,6 +94,40 @@ class AsyncStatusUpdater:
         if fresh:
             self._shard(key).put(key)
 
+    def submit_patch(self, kind: str, name: str, namespace: str,
+                     patch: dict | None = None,
+                     fence_kwargs: dict | None = None,
+                     build=None, on_error=None) -> None:
+        """Generalized async OBJECT patch (metadata + status + spec), for
+        write paths that batch through the worker pool instead of paying
+        one synchronous API round trip per object — the reclaim path's
+        eviction writes (``ClusterCache.evict_many``) route here.  Unlike
+        ``patch_status`` the payload is the full merge-patch document,
+        and ``fence_kwargs`` carries the scheduler's leadership epoch so
+        the store can still reject a deposed leader at apply time.
+        Dedup: a newer patch for the same object supersedes a queued
+        older one (latest decision wins, same as status writes).
+
+        ``build``: zero-arg callable run ON THE WORKER just before the
+        write, returning the patch document (None = skip).  Read-modify-
+        write patches pass their read side here so the whole round trip
+        parallelizes across workers instead of serializing the reads on
+        the enqueueing thread.
+
+        ``on_error``: callable(exc) invoked on the worker when the write
+        fails — batch callers (evict_many) collect failures so a fenced
+        write is surfaced loudly instead of folded into the generic
+        drop-and-count path."""
+        key = ("ObjPatch", kind, namespace, name)
+        payload = {"kind": kind, "name": name, "namespace": namespace,
+                   "patch": patch, "build": build, "on_error": on_error,
+                   "fence": dict(fence_kwargs or {})}
+        with self._lock:
+            fresh = key not in self._inflight
+            self._inflight[key] = payload
+        if fresh:
+            self._shard(key).put(key)
+
     def record_event(self, reason: str, message: str,
                      about: tuple | None = None,
                      trace_id: str | None = None) -> None:
@@ -98,6 +140,18 @@ class AsyncStatusUpdater:
         with self._lock:
             if key in self._inflight:
                 return
+            if key in self._recent_events:
+                # Already announced (cross-cycle dedupe): a standing
+                # backlog must not mint one identical Event object per
+                # group per cycle.
+                METRICS.inc("event_writes_deduped_total")
+                return
+            if len(self._recent_events) >= self.RECENT_EVENT_CAP:
+                # Bounded memory over distinct-event churn: reset and
+                # accept occasional re-announcements over growing
+                # forever (the _warned_selectors convention).
+                self._recent_events.clear()
+            self._recent_events.add(key)
             self._inflight[key] = {"reason": reason, "message": message,
                                    "about": about, "trace_id": trace_id}
         self._shard(key).put(key)
@@ -130,6 +184,19 @@ class AsyncStatusUpdater:
                                  "message": payload["message"],
                                  "traceId": payload.get("trace_id")},
                     })
+                elif key[0] == "ObjPatch":
+                    # Generalized fenced object patch (submit_patch):
+                    # the eviction batch path.  The fence kwargs were
+                    # captured at enqueue — a deposed leader's write is
+                    # rejected here by the store, exactly like the
+                    # synchronous path.
+                    patch = payload["patch"]
+                    if payload.get("build") is not None:
+                        patch = payload["build"]()
+                    if patch is not None:
+                        self.api.patch(payload["kind"], payload["name"],
+                                       patch, payload["namespace"],
+                                       **payload["fence"])
                 else:
                     kind, namespace, name = key
                     self.api.patch(kind, name, {"status": payload},
@@ -141,6 +208,19 @@ class AsyncStatusUpdater:
                 METRICS.inc("status_update_errors")
                 log.v(2).info("status write for %s dropped (%s: %s)",
                               key, type(exc).__name__, exc)
+                on_error = (payload.get("on_error")
+                            if isinstance(payload, dict) else None)
+                if on_error is not None:
+                    try:
+                        on_error(exc)
+                    except Exception as cb_exc:
+                        # The error channel must never kill a worker, but
+                        # a broken callback must be visible (KAI007).
+                        METRICS.inc("status_update_errors")
+                        log.v(1).info(
+                            "status on_error callback for %s failed "
+                            "(%s: %s)", key, type(cb_exc).__name__,
+                            cb_exc)
             finally:
                 my_queue.task_done()
 

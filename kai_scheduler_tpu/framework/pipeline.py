@@ -1,0 +1,220 @@
+"""Commit executor: stage C of the overlapped fleet cycle.
+
+The serial fleet cycle pays three phases back to back — host prep (watch
+drain, grouper batches, incremental snapshot), device dispatch, and
+commit I/O (journal fsync, BindRequest/evict/status API writes, binder
+round trips).  The pipelined cycle (DESIGN §10) moves every durable side
+effect onto ONE dedicated commit-executor thread so cycle N's commit I/O
+overlaps cycle N+1's host prep and device work:
+
+- ``Statement.commit`` enqueues its write batch here the moment the
+  placement decision is final (the speculative view in the cluster cache
+  makes the decision visible to the next snapshot before any write
+  lands — cache_builder ``speculate``);
+- the operator enqueues the cycle epilogue (event drain, binder tick,
+  status flush, GC) after the decision phase, so binder/status round
+  trips never sit on the cycle path;
+- FIFO on a single thread preserves the serial mode's write order:
+  cycle N's writes all land before cycle N+1's, and the epilogue sees
+  every bind of its own cycle.
+
+Failure discipline: an exception inside a batch is recorded and counted
+(``commit_executor_errors_total``), never swallowed silently — callers
+surface it at the next ``flush()``/cycle boundary.  A fencing rejection
+(``kubeapi.Fenced``) or a simulated crash POISONS the executor: queued
+work is dropped (a deposed/crashed scheduler must not keep committing)
+and the operator drains the pipeline back to the serial path.
+
+Overlap accounting: the executor keeps a bounded ring of busy intervals
+(monotonic clock) so the operator can report ``cycle_overlap_ratio`` —
+the fraction of each main-thread cycle during which the commit thread
+was doing work.  A silently-serialized pipeline reads as ratio ~0 and
+trips the fleet-budget ``min_overlap_ratio`` gate.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from collections import deque
+
+from ..utils.logging import LOG
+from ..utils.metrics import METRICS
+
+
+class CommitExecutorPoisoned(Exception):
+    """Submitting to (or flushing) a poisoned executor: a fencing
+    rejection or simulated crash stopped the commit stream."""
+
+
+class CommitExecutor:
+    """Single-threaded FIFO executor for commit-side work.
+
+    One thread, by design: durable side effects must land in decision
+    order (the same order the serial path writes them), and the commit
+    journal is single-writer.  Concurrency comes from overlapping this
+    thread with the scheduler's host-prep/device phases, not from
+    parallel writes.
+    """
+
+    # Bounded busy-interval ring: enough for overlap accounting over any
+    # realistic cycle window, bounded against a long-lived daemon.
+    BUSY_RING = 4096
+
+    def __init__(self, name: str = "commit-executor"):
+        self.name = name
+        self._queue: queue.Queue = queue.Queue()
+        self._lock = threading.Lock()
+        self._busy: deque = deque(maxlen=self.BUSY_RING)
+        self._busy_since: float | None = None
+        self._errors: list[BaseException] = []
+        self._poisoned: str | None = None
+        self._submitted = 0
+        self._completed = 0
+        self._completed_cv = threading.Condition(self._lock)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._worker, daemon=True,
+                                        name=name)
+        self._thread.start()
+
+    # -- submission --------------------------------------------------------
+    def submit(self, fn, label: str = "commit", on_skip=None) -> int:
+        """Enqueue one unit of commit work; returns a token that
+        ``wait_token`` can block on.  Raises ``CommitExecutorPoisoned``
+        when the commit stream is stopped — the caller must fall back to
+        the serial path (or surface the abort).  ``on_skip`` runs if the
+        task is dropped by poisoning (a fenced/crashed stream): commit
+        batches use it to roll back their speculative view at fault
+        time, not at the eventual drain."""
+        with self._lock:
+            if self._poisoned is not None:
+                raise CommitExecutorPoisoned(self._poisoned)
+            self._submitted += 1
+            token = self._submitted
+        METRICS.inc("commit_executor_batches_total")
+        self._queue.put((token, label, fn, on_skip))
+        METRICS.set_gauge("commit_executor_queue_depth",
+                          self._queue.qsize())
+        return token
+
+    def token(self) -> int:
+        """Watermark over everything submitted so far (0 = nothing)."""
+        with self._lock:
+            return self._submitted
+
+    # -- worker ------------------------------------------------------------
+    def _worker(self) -> None:
+        while not self._stop.is_set():
+            try:
+                token, label, fn, on_skip = self._queue.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            t0 = time.monotonic()
+            with self._lock:
+                self._busy_since = t0
+                skip = self._poisoned is not None
+            try:
+                if not skip:
+                    fn()
+                elif on_skip is not None:
+                    on_skip()
+            except BaseException as exc:  # recorded, surfaced at flush
+                METRICS.inc("commit_executor_errors_total")
+                with self._lock:
+                    if len(self._errors) < 64:
+                        self._errors.append(exc)
+                LOG.warning("commit executor: %s failed (%s: %s)",
+                            label, type(exc).__name__, exc)
+            finally:
+                t1 = time.monotonic()
+                with self._completed_cv:
+                    self._busy.append((t0, t1))
+                    self._busy_since = None
+                    self._completed = max(self._completed, token)
+                    self._completed_cv.notify_all()
+                self._queue.task_done()
+                METRICS.set_gauge("commit_executor_queue_depth",
+                                  self._queue.qsize())
+
+    # -- synchronization ---------------------------------------------------
+    def wait_token(self, token: int, timeout: float = 60.0) -> bool:
+        """Block until every task submitted at or before ``token`` has
+        completed (or was skipped by poisoning)."""
+        deadline = time.monotonic() + timeout
+        with self._completed_cv:
+            while self._completed < token:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._completed_cv.wait(remaining)
+        return True
+
+    def flush(self, timeout: float = 60.0) -> None:
+        """Drain everything queued so far.  Re-raises the FIRST recorded
+        error (chaos crashes included) so a test or the serial-fallback
+        path never silently loses a failed commit."""
+        self.wait_token(self.token(), timeout=timeout)
+        self.raise_pending()
+
+    def raise_pending(self) -> None:
+        with self._lock:
+            if not self._errors:
+                return
+            exc, self._errors = self._errors[0], []
+        raise exc
+
+    def take_errors(self) -> list[BaseException]:
+        with self._lock:
+            errors, self._errors = self._errors, []
+        return errors
+
+    # -- poisoning (fenced depose / simulated crash) -----------------------
+    def poison(self, reason: str) -> None:
+        """Stop the commit stream: queued tasks are skipped, submissions
+        rejected, until ``clear_poison``.  The operator drains the
+        pipeline to the serial path when it observes this."""
+        with self._lock:
+            if self._poisoned is None:
+                self._poisoned = reason
+        METRICS.inc("commit_executor_poisoned_total")
+        LOG.warning("commit executor poisoned: %s", reason)
+
+    @property
+    def poisoned(self) -> str | None:
+        with self._lock:
+            return self._poisoned
+
+    def clear_poison(self) -> None:
+        with self._lock:
+            self._poisoned = None
+
+    # -- overlap accounting ------------------------------------------------
+    def busy_seconds(self, since: float, until: float) -> float:
+        """Seconds this thread spent executing within [since, until]
+        (monotonic clock), for the operator's overlap ratio."""
+        total = 0.0
+        with self._lock:
+            intervals = list(self._busy)
+            open_since = self._busy_since
+        for t0, t1 in intervals:
+            lo, hi = max(t0, since), min(t1, until)
+            if hi > lo:
+                total += hi - lo
+        if open_since is not None:
+            lo, hi = max(open_since, since), until
+            if hi > lo:
+                total += hi - lo
+        return total
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"submitted": self._submitted,
+                    "completed": self._completed,
+                    "queue_depth": self._queue.qsize(),
+                    "poisoned": self._poisoned,
+                    "pending_errors": len(self._errors)}
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2.0)

@@ -260,6 +260,10 @@ class Session:
         # scheduler's run_once): past it, every kernel dispatch aborts
         # with CycleDeadlineExceeded instead of starting new device work.
         self.cycle_deadline_at: float | None = None
+        # Overlapped pipeline: commit executor for stage-C write batches
+        # (framework/pipeline.py), armed per cycle by the scheduler.
+        # None = synchronous commits (the serial path).
+        self.commit_executor = None
         # Device-array caches.  With an arena, static tensors and mutable
         # state live THERE, resident across sessions, and mutable-row
         # deltas apply by scatter; the session-local dicts below are the

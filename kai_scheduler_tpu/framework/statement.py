@@ -296,23 +296,71 @@ class Statement:
         completed write appends a buffered ``done`` marker.  A crash
         anywhere in between leaves a journal the restart reconcile pass
         (``ClusterCache.startup_reconcile``) resolves against live API
-        state — no phantom reservations, no half-trusted history."""
-        from ..utils import commitlog as cl
-        from ..utils.deviceguard import control_fault
-        from ..utils.tracing import TRACER
+        state — no phantom reservations, no half-trusted history.
 
-        log = getattr(self.session.cache, "commitlog", None)
-        epoch_provider = getattr(self.session.cache, "epoch_provider", None)
+        OVERLAPPED mode (DESIGN §10): when the session carries a commit
+        executor (``Session.commit_executor``, armed by the pipelined
+        operator cycle) and the cache supports the speculative view, the
+        decision is registered speculatively on THIS thread — the next
+        snapshot already sees it — and the whole durable write batch
+        (journal fsync + API writes) is enqueued to the commit-executor
+        thread, overlapping the next cycle's host prep and device work.
+        Write order, journal discipline, and fencing are preserved: the
+        executor is single-threaded FIFO and every write still carries
+        the leadership epoch read at write time."""
+        from ..utils.lifecycle import LIFECYCLE
+
+        cache = self.session.cache
+        log = getattr(cache, "commitlog", None)
+        epoch_provider = getattr(cache, "epoch_provider", None)
         epoch = epoch_provider() if epoch_provider is not None else None
         trace_id = getattr(self.session, "trace_id", None)
 
-        # Pre-pass: build every BindRequest (running the plugin mutators,
-        # dynamicresources.go:252) and collect the intent records in op
-        # order, so the whole gang's intents hit the journal in one
-        # fsync'd batch before any API write.
+        binds, by_op, intents, intent_ops = self._build_commit_batch(
+            log, epoch, trace_id)
+
+        # Lifecycle 'scheduled' stamps happen at DECISION time on the
+        # cycle thread, for allocate and pipeline ops alike (stamped
+        # before any bind write so the phase order stays monotone:
+        # scheduled <= bind_requested, whichever thread writes).
+        for op in self.ops:
+            if op.kind in ("allocate", "pipeline"):
+                LIFECYCLE.note(op.task.uid, "scheduled",
+                               podgroup=op.task.job_id,
+                               node=op.node_name, trace_id=trace_id)
+            if op.kind == "pipeline":
+                # Pipelined assignments persist in the cache across
+                # cycles (Cache.TaskPipelined, cache/interface.go:36-50)
+                # so the next snapshot rebuilds them.  In-memory: always
+                # on the decision thread.
+                task_pipelined = getattr(cache, "task_pipelined", None)
+                if task_pipelined is not None:
+                    task_pipelined(op.task, op.node_name, op.gpu_group)
+
+        executor = getattr(self.session, "commit_executor", None)
+        if executor is not None and hasattr(cache, "speculate"):
+            self._commit_overlapped(executor, cache, log, binds, by_op,
+                                    intents, intent_ops, epoch)
+        else:
+            self._commit_serial(cache, log, binds, by_op, intents,
+                                intent_ops, epoch)
+        self.committed = True
+        self.session.cluster.bind_requests.extend(binds)
+        return binds
+
+    def _build_commit_batch(self, log, epoch, trace_id):
+        """Pre-pass: build every BindRequest (running the plugin
+        mutators, dynamicresources.go:252) and collect the intent
+        records in op order, so the whole gang's intents hit the journal
+        in one fsync'd batch before any API write.  ``intent_ops`` maps
+        each intent to its op index — done markers stay correct however
+        the writes are batched downstream."""
+        from ..utils import commitlog as cl
+
         binds: list[BindRequest] = []
         by_op: dict[int, BindRequest] = {}
         intents: list[dict] = []
+        intent_ops: list[int] = []
         for i, op in enumerate(self.ops):
             if op.kind == "allocate":
                 br = BindRequest(
@@ -330,62 +378,170 @@ class Statement:
                     intents.append(cl.bind_intent(
                         op.task.uid, op.task.name, op.task.namespace,
                         op.node_name, br.gpu_groups, epoch))
+                    intent_ops.append(i)
             elif op.kind == "evict" and log is not None:
                 intents.append(cl.evict_intent(
                     op.task.uid, op.task.name, op.task.namespace, epoch))
-        if log is not None and intents:
-            # The journal append is the commit's one fsync: a span of its
-            # own so a slow disk is distinguishable from slow API writes.
-            with TRACER.span("journal", kind="commit",
-                             intents=len(intents), epoch=epoch):
-                txids = iter(log.append_intents(intents))
-        else:
-            txids = iter(())
-        if log is not None and intents \
-                and control_fault("crash-after-journal") is not None:
+                intent_ops.append(i)
+        return binds, by_op, intents, intent_ops
+
+    def _journal_batch(self, log, intents, intent_ops, epoch) -> dict:
+        """Append + fsync the intent batch; returns op index -> txid.
+        Raises the chaos ``SimulatedCrash`` AFTER the fsync — intents
+        durable, nothing committed — on whichever thread runs the batch
+        (the restart reconcile pass must cope either way)."""
+        from ..utils import commitlog as cl
+        from ..utils.deviceguard import control_fault
+        from ..utils.tracing import TRACER
+
+        if log is None or not intents:
+            return {}
+        # The journal append is the commit's one fsync: a span of its
+        # own so a slow disk is distinguishable from slow API writes.
+        with TRACER.span("journal", kind="commit",
+                         intents=len(intents), epoch=epoch):
+            txids = log.append_intents(intents)
+        txid_of = dict(zip(intent_ops, txids))
+        if control_fault("crash-after-journal") is not None:
             # Chaos: die at the worst instant — intents durable, nothing
             # committed.  The restart reconcile pass must make this
             # indistinguishable from "never decided".
             raise cl.SimulatedCrash(
                 "crash-after-journal: intents journaled, API commit "
                 "not started")
-        from ..utils.lifecycle import LIFECYCLE
-        for i, op in enumerate(self.ops):
+        return txid_of
+
+    def _apply_writes(self, cache, log, by_op, txid_of, ops, intents,
+                      landed=None) -> None:
+        """The ONE durable-write loop both commit paths share: apply
+        every side effect in op order — evictions batch through
+        ``cache.evict_many`` (one flush per gang batch) when the cache
+        supports it; a bind flushes the pending evict batch first, so
+        writes land in op order ACROSS kinds (a crash between them must
+        never leave a bind durable against capacity whose victim was
+        not evicted).  ``landed`` (overlapped mode) collects the uid of
+        every write that reached the store — the fenced-rollback path
+        rolls back exactly the rest."""
+        evict_batch: list[tuple[int, object]] = []
+        evict_many = getattr(cache, "evict_many", None)
+
+        def note_landed(uid) -> None:
+            if landed is not None:
+                landed.add(uid)
+
+        def flush_evicts() -> None:
+            if not evict_batch:
+                return
+            evict_many([task for _i, task in evict_batch])
+            for i, task in evict_batch:
+                note_landed(task.uid)
+                if i in txid_of:
+                    log.mark_done(txid_of[i])
+            evict_batch.clear()
+
+        for i, op in enumerate(ops):
             if op.kind == "allocate":
-                # Lifecycle: the cycle committed a placement decision for
-                # this pod (stamped before the bind write so the phase
-                # order is scheduled <= bind_requested; an aborted commit
-                # leaves a scheduled-but-unbound attempt a later cycle
-                # completes — monotone either way).
-                LIFECYCLE.note(op.task.uid, "scheduled",
-                               podgroup=op.task.job_id,
-                               node=op.node_name, trace_id=trace_id)
-                self.session.cache.bind(op.task, op.node_name, by_op[i])
-                if log is not None:
-                    log.mark_done(next(txids))
-            elif op.kind == "pipeline":
-                # Lifecycle: a pipelined decision is still a committed
-                # scheduling verdict — the bind follows once resources
-                # free, on this same attempt.
-                LIFECYCLE.note(op.task.uid, "scheduled",
-                               podgroup=op.task.job_id,
-                               node=op.node_name, trace_id=trace_id)
-                # Pipelined assignments persist in the cache across cycles
-                # (Cache.TaskPipelined, cache/interface.go:36-50) so the
-                # next snapshot rebuilds them.
-                task_pipelined = getattr(self.session.cache,
-                                         "task_pipelined", None)
-                if task_pipelined is not None:
-                    task_pipelined(op.task, op.node_name, op.gpu_group)
+                flush_evicts()
+                cache.bind(op.task, op.node_name, by_op[i])
+                note_landed(op.task.uid)
+                if i in txid_of:
+                    log.mark_done(txid_of[i])
             elif op.kind == "evict":
-                self.session.cache.evict(op.task)
-                if log is not None:
-                    log.mark_done(next(txids))
+                if evict_many is not None:
+                    evict_batch.append((i, op.task))
+                else:
+                    cache.evict(op.task)
+                    note_landed(op.task.uid)
+                    if i in txid_of:
+                        log.mark_done(txid_of[i])
+        flush_evicts()
         if log is not None and intents:
             log.flush_buffered()
-        self.committed = True
-        self.session.cluster.bind_requests.extend(binds)
-        return binds
+
+    def _commit_serial(self, cache, log, binds, by_op, intents,
+                       intent_ops, epoch) -> None:
+        """The synchronous write path (no executor): journal, then the
+        shared write loop."""
+        txid_of = self._journal_batch(log, intents, intent_ops, epoch)
+        self._apply_writes(cache, log, by_op, txid_of, self.ops, intents)
+
+    def _commit_overlapped(self, executor, cache, log, binds, by_op,
+                           intents, intent_ops, epoch) -> None:
+        """Register the decision speculatively and hand the durable
+        writes to the commit executor.  On a fencing rejection mid-batch
+        the UN-LANDED decisions' speculative view rolls back and the
+        executor poisons (the operator then drains the pipeline to the
+        serial path); landed writes stand, exactly like a serial
+        mid-commit depose."""
+        import time as _time
+
+        from ..utils.tracing import TRACER
+
+        trace_id = getattr(self.session, "trace_id", None)
+        spec_entries = []
+        for i, op in enumerate(self.ops):
+            if op.kind == "allocate":
+                spec_entries.append((op.task.uid, "bind", op.node_name))
+            elif op.kind == "evict":
+                spec_entries.append((op.task.uid, "evict", ""))
+        handle = cache.speculate(spec_entries)
+        ops = list(self.ops)
+
+        def run_batch() -> None:
+            t_batch = _time.perf_counter()
+            try:
+                self._run_overlapped_batch(executor, cache, log, by_op,
+                                           intents, intent_ops, epoch,
+                                           handle, ops)
+            finally:
+                # The commit stage finishes after its cycle's trace was
+                # finalized: attach the span post-hoc so /debug/trace
+                # still shows where cycle N's commit budget went.
+                TRACER.attach_async_span(
+                    trace_id, "stage:commit", "commit_async",
+                    _time.perf_counter() - t_batch,
+                    ops=len(ops), binds=len(binds))
+
+        executor.submit(
+            run_batch, label="commit-batch",
+            # Dropped by poisoning (an earlier batch hit the fence or a
+            # crash): these decisions will never be durable — roll back
+            # their speculative view at fault time.
+            on_skip=lambda: cache.rollback_speculation(
+                handle, "commit skipped: pipeline poisoned"))
+
+    def _run_overlapped_batch(self, executor, cache, log, by_op, intents,
+                              intent_ops, epoch, handle, ops) -> None:
+        from ..controllers.kubeapi import Fenced
+        from ..utils import commitlog as cl
+        from ..utils.metrics import METRICS
+
+        txid_of = {}
+        try:
+            txid_of = self._journal_batch(log, intents, intent_ops,
+                                          epoch)
+        except cl.SimulatedCrash:
+            # Crash semantics: this scheduler is dead — nothing else it
+            # queued may commit.  The speculation stays (a real crash
+            # takes the whole process); the test/restart path reconciles
+            # from the journal.
+            executor.poison("crash-after-journal")
+            raise
+        landed: set = set()
+        try:
+            self._apply_writes(cache, log, by_op, txid_of, ops, intents,
+                               landed=landed)
+        except Fenced as exc:
+            # Deposed mid-overlap: the store rejected the write.
+            # Decisions whose writes never landed roll back their
+            # speculative view — the rightful leader re-schedules those
+            # pods; landed writes stand (they carried a then-valid
+            # epoch).
+            remaining = {uid: seq for uid, seq in handle.items()
+                         if uid not in landed}
+            cache.rollback_speculation(remaining, f"fenced: {exc}")
+            METRICS.inc("pipeline_fenced_commits_total")
+            executor.poison(f"fenced commit: {exc}")
 
     def discard(self) -> None:
         """Roll everything back (an action abandoning its statement)."""

@@ -33,6 +33,12 @@ class Scheduler:
         self.usage_provider = usage_provider
         self.session_id = 0
         self.last_session = None  # kept for introspection endpoints
+        # Overlapped pipeline (DESIGN §10): when the operator arms a
+        # commit executor here, Statement.commit registers decisions
+        # speculatively and ships the durable writes to it — cycle N's
+        # commit I/O overlaps cycle N+1's host prep.  None = the serial
+        # path, byte-for-byte the pre-pipeline behavior.
+        self.commit_executor = None
 
     def run_once(self) -> Session:
         """One scheduling cycle (scheduler.go:113-138).
@@ -78,6 +84,9 @@ class Scheduler:
                     # behavior next to the span that paid for it.
                     snap_sp.set(**ssn.pack_stats)
             ssn.trace_id = trace_id
+            ssn.commit_executor = self.commit_executor
+            if self.commit_executor is not None:
+                TRACER.note_pipelined()
             if deadline:
                 ssn.cycle_deadline_at = clock0 + deadline
             ssn.aborted = None

@@ -91,6 +91,11 @@ def healthz_payload(state: dict | None = None) -> dict:
         "lifecycle": LIFECYCLE.status(),
         "stackprof": STACKPROF.status(),
     }
+    executor = getattr(state.get("system"), "commit_executor", None)
+    if executor is not None:
+        # Overlapped pipeline: queue depth / poison state — a poisoned
+        # executor means the fleet fell back to the serial cycle path.
+        payload["pipeline"] = executor.stats()
     return payload
 
 
@@ -179,15 +184,26 @@ def _make_handler(server_state):
                     # Incremental host pipeline: last snapshot's dirty
                     # counts, store sizes, and watch-delta mode.
                     payload["incremental_cache"] = cache_stats
+                system = server_state.get("system")
+                executor = getattr(system, "commit_executor", None)
+                if executor is not None:
+                    # Overlapped pipeline: per-cycle stage overlap plus
+                    # the commit executor's live state (DESIGN §10).
+                    payload["pipeline"] = {
+                        "executor": executor.stats(),
+                        "recent_cycles": list(system.pipeline_stats),
+                    }
                 body = json.dumps(payload).encode()
                 ctype = "application/json"
             elif path == "/debug/trace":
-                trace = TRACER.get_trace(q.get("cycle"))
-                if trace is None:
+                # Serialized under the ring lock: async commit-stage
+                # spans may still be attaching to a finalized trace.
+                chrome = TRACER.export_chrome(q.get("cycle"))
+                if chrome is None:
                     self.send_error(
                         404, "no such cycle trace (list: /debug/cycles)")
                     return
-                body = json.dumps(trace.to_chrome()).encode()
+                body = json.dumps(chrome).encode()
                 ctype = "application/json"
             elif path == "/explain":
                 name = q.get("podgroup")
@@ -349,6 +365,13 @@ def run_app(argv=None) -> None:
                          "(utils/commitlog.py); statement commits "
                          "journal intents and a restart replays them — "
                          "unset disables journaling")
+    ap.add_argument("--pipeline", nargs="?", const=True, default=False,
+                    type=_parse_bool,
+                    help="overlapped fleet cycle (DESIGN §10): commit "
+                         "I/O and binder round trips run on a commit-"
+                         "executor thread, overlapping the next cycle's "
+                         "host prep; drains to the serial path on "
+                         "breaker-open or a fenced commit")
     args = ap.parse_args(argv)
 
     init_loggers(args.verbosity)
@@ -388,9 +411,10 @@ def run_app(argv=None) -> None:
                           config)],
         usage_db=args.usage_db,
         commitlog_path=args.commit_log,
+        pipelined_cycles=bool(args.pipeline),
         scheduling_enabled=not args.controllers_only), api=api)
 
-    state: dict = {}
+    state: dict = {"system": system}
     if lease_elector is not None:
         # Fenced leadership: scheduler writes carry the Lease epoch; a
         # deposed incarnation's writes are rejected at the store.
@@ -447,6 +471,14 @@ def run_app(argv=None) -> None:
                 break
             time.sleep(args.schedule_period)
     finally:
+        try:
+            # Overlapped pipeline: in-flight commit batches must land
+            # before the daemon exits (a clean shutdown loses nothing),
+            # then the executor thread joins.
+            system.flush_pipeline()
+            system.stop_pipeline()
+        except Exception as exc:
+            LOG.warning("pipeline flush on shutdown: %s", exc)
         if args.profile_dir:
             import jax
             jax.profiler.stop_trace()

@@ -58,6 +58,11 @@ FUSED_TESTS = ["tests/test_fused_parity.py"]
 # randomized forests the seed also regenerates.
 SHARDS_TESTS = ["tests/test_concurrent_shards.py",
                 "tests/test_fairshare_forest.py"]
+# --pipeline: the overlapped-cycle suite — each seed reshuffles the
+# randomized churn stream while serial-vs-pipelined placement
+# bit-identity, fenced-depose speculation rollback, crash-after-journal
+# replay, and breaker-open drain-to-serial are asserted.
+PIPELINE_TESTS = ["tests/test_pipeline_cycle.py"]
 
 
 def run_iteration(seed: int, tests: list[str], marker: str,
@@ -134,6 +139,13 @@ def main(argv=None) -> int:
                          "queue forests while zero-double-bind, "
                          "fenced-loser-abort, and fair-share bit-parity "
                          "are asserted")
+    ap.add_argument("--pipeline", action="store_true",
+                    help="pipeline mode: sweep the overlapped-cycle "
+                         f"suite ({PIPELINE_TESTS}) — each seed "
+                         "reshuffles the churn stream while serial-vs-"
+                         "pipelined bit-identity, fenced rollback, "
+                         "crash-after-journal replay, and breaker-open "
+                         "drain-to-serial are asserted")
     ap.add_argument("-k", "--keyword", default=None,
                     help="pytest -k filter (narrow the smoke subset)")
     ap.add_argument("--marker", default="chaos",
@@ -158,12 +170,13 @@ def main(argv=None) -> int:
         tests = args.tests
     else:
         # Modes compose: --arena --latency --incremental --fused
-        # --shards sweeps every selected suite per seed.
+        # --shards --pipeline sweeps every selected suite per seed.
         tests = (ARENA_TESTS if args.arena else []) + \
             (LATENCY_TESTS if args.latency else []) + \
             (INCREMENTAL_TESTS if args.incremental else []) + \
             (FUSED_TESTS if args.fused else []) + \
-            (SHARDS_TESTS if args.shards else [])
+            (SHARDS_TESTS if args.shards else []) + \
+            (PIPELINE_TESTS if args.pipeline else [])
         if not tests:
             tests = DEFAULT_TESTS
     repo_root = os.path.dirname(os.path.dirname(os.path.dirname(

@@ -18,9 +18,10 @@
 #                 threshold file (docs/scale-tests/fleet_budget.json):
 #                 grouped/snapshotted phase medians, warm cycle, the
 #                 incremental-cache structural gates, the fused-allocate
-#                 kernel ceiling, and the 10k-queue fair-share step
-#                 ceiling + single-dispatch/prep-reuse structural gates
-#                 must stay in budget
+#                 kernel ceiling, the 10k-queue fair-share step
+#                 ceiling + single-dispatch/prep-reuse structural gates,
+#                 and the overlapped-pipeline re-run (identical bound
+#                 pods, overlap-ratio floor) must stay in budget
 #   tier-1 tests  pytest -m 'not slow' on CPU
 #
 # Usage: kai_scheduler_tpu/tools/ci_check.sh [--no-tests]
@@ -44,6 +45,8 @@ python -m kai_scheduler_tpu.tools.kailint kai_scheduler_tpu/ || fail=1
 echo
 echo "== chaos matrix definition (dry run) =="
 python -m kai_scheduler_tpu.tools.chaos_matrix --dry-run || fail=1
+python -m kai_scheduler_tpu.tools.chaos_matrix --pipeline --dry-run \
+    || fail=1
 
 echo
 echo "== kernel-parity smoke (fused ladder vs legacy vs exact) =="

@@ -109,6 +109,17 @@ def main(argv=None) -> int:
                                        bands=fshape.get("bands", 1),
                                        iters=fs_iters)
 
+    # Overlapped-pipeline smoke (DESIGN §10): the SAME fleet shape with
+    # the commit executor armed.  min_overlap_ratio is the structural
+    # gate — a pipeline that silently serialized (executor idle while
+    # the cycle thread works) reads ~0 here while every wall clock still
+    # passes on a fast machine; identical bound-pods proves the
+    # speculative view never lost or doubled a placement.
+    pres = bench.fleet_phase(shape["nodes"], shape["jobs"],
+                             shape["gang"], pipelined=True)
+    p_bound = pres.get("pod_latency", {}).get("bound_pods", 0)
+    p_overlap = pres.get("pipeline", {}).get("overlap_ratio_mean")
+
     medians = result.get("pod_latency", {}).get("phase_median_ms", {})
     bound = result.get("pod_latency", {}).get("bound_pods", 0)
     expect = shape["jobs"] * shape["gang"]
@@ -137,6 +148,12 @@ def main(argv=None) -> int:
         # by the hierarchy depth.
         ("fairshare_dispatches", fsres["dispatches"],
          "<=", fs_iters + 1),
+        ("pipelined_bound_pods", p_bound, ">=", expect),
+        ("pipelined_warm_cycle_s", pres.get("warm_cycle_s"),
+         "<=", budget.get("max_pipelined_warm_cycle_s",
+                          budget["max_warm_cycle_s"])),
+        ("pipeline_overlap_ratio", p_overlap,
+         ">=", budget.get("min_overlap_ratio", 0.08)),
     ]
 
     failed = []

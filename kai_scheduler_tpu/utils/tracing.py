@@ -362,6 +362,60 @@ class Tracer:
         trace = st["trace"] if st else None
         return trace.trace_id if trace is not None else None
 
+    def note_pipelined(self) -> None:
+        """Mark the active cycle trace as running in overlapped-pipeline
+        mode (the root span carries ``pipelined=True``)."""
+        st = getattr(self._local, "state", None)
+        trace = st["trace"] if st else None
+        if trace is not None and trace.root is not None:
+            trace.root.set(pipelined=True)
+
+    def attach_async_span(self, trace_id: str | None, name: str,
+                          kind: str, duration_s: float, **attrs) -> bool:
+        """Attach a completed span to an ALREADY-FINALIZED trace still in
+        the ring — the overlapped pipeline's commit stages finish after
+        their cycle's ``end_cycle`` ran on the scheduler thread, and the
+        flight recorder must still show where cycle N's commit budget
+        went.  Thread-safe (ring lock); a trace that already aged out of
+        the ring drops the span (returns False)."""
+        if trace_id is None:
+            return False
+        with self._lock:
+            for trace in reversed(self._ring):
+                if trace.trace_id != trace_id:
+                    continue
+                root = trace.root
+                sp = Span(trace_id, f"s{next(self._ids)}",
+                          root.span_id if root is not None else None,
+                          name, kind,
+                          max(0.0, time.perf_counter() - trace.t0
+                              - duration_s))
+                sp.duration_s = duration_s
+                if attrs:
+                    sp.attrs.update(attrs)
+                self._record_span(trace, sp)
+                break
+            else:
+                return False
+        METRICS.observe(f"cycle_span_{kind}_latency_ms",
+                        duration_s * 1e3)
+        return True
+
+    def export_chrome(self, key: str | None = None) -> dict | None:
+        """Chrome-trace JSON for one ring entry, serialized UNDER the
+        ring lock (async commit spans may still be attaching to a
+        finalized trace — an unlocked ``to_chrome`` could read a
+        half-appended span list)."""
+        with self._lock:
+            if not self._ring:
+                return None
+            if key is None or key == "":
+                return self._ring[-1].to_chrome()
+            for trace in reversed(self._ring):
+                if trace.trace_id == key or str(trace.cycle) == key:
+                    return trace.to_chrome()
+        return None
+
     def note_rejection(self, podgroup: str, reason: str) -> None:
         """Record a filter/score rejection into the active cycle's
         explainability ledger (actions call this as failures happen; the

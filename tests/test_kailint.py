@@ -649,6 +649,47 @@ class TestKAI008MetricsHygiene:
                    and "fairshare_dispatch_total" in f.message
                    for f in findings)
 
+    def test_pipeline_family_consistent_usage_is_clean(self):
+        # The overlapped-cycle families (framework/pipeline.py +
+        # operator/cache_builder): overlap gauge, commit-executor
+        # counters/gauge, speculation + coalescing + dedupe counters.
+        src = ("from ..utils.metrics import METRICS\n"
+               "def f(v):\n"
+               "    METRICS.set_gauge('cycle_overlap_ratio', v)\n"
+               "    METRICS.inc('commit_executor_batches_total')\n"
+               "    METRICS.inc('commit_executor_errors_total')\n"
+               "    METRICS.inc('commit_executor_poisoned_total')\n"
+               "    METRICS.set_gauge('commit_executor_queue_depth', v)\n"
+               "    METRICS.set_gauge('pipeline_speculative_entries', v)\n"
+               "    METRICS.inc('pipeline_speculation_rollback_total', v)\n"
+               "    METRICS.inc('pipeline_fenced_commits_total')\n"
+               "    METRICS.inc('pipeline_drained_to_serial_total')\n"
+               "    METRICS.inc('pipeline_drain_timeouts_total')\n"
+               "    METRICS.inc('event_writes_deduped_total')\n"
+               "    METRICS.inc('watch_events_coalesced_total', v)\n"
+               "    METRICS.inc('status_writes_deduped_total')\n"
+               "    METRICS.inc('evict_writes_batched_total', v)\n"
+               "    METRICS.observe('evict_write_latency_ms', v)\n"
+               "    METRICS.observe('cycle_span_commit_async_latency_ms',"
+               " v)\n")
+        findings = lint(("kai_scheduler_tpu/utils/fix.py", src))
+        assert [f for f in findings if f.rule == "KAI008"] == []
+
+    def test_pipeline_cross_instrument_collision_fires(self):
+        # A counter reusing the overlap gauge's name would corrupt the
+        # structural min_overlap_ratio gate (tools/fleet_budget.py).
+        a = ("from ..utils.metrics import METRICS\n"
+             "def f(v):\n"
+             "    METRICS.set_gauge('cycle_overlap_ratio', v)\n")
+        b = ("from ..utils.metrics import METRICS\n"
+             "def g():\n"
+             "    METRICS.inc('cycle_overlap_ratio')\n")
+        findings = lint(("kai_scheduler_tpu/framework/a.py", a),
+                        ("kai_scheduler_tpu/controllers/b.py", b))
+        assert any(f.rule == "KAI008" and "one instrument" in f.message
+                   and "cycle_overlap_ratio" in f.message
+                   for f in findings)
+
     def test_stackprof_family_consistent_usage_is_clean(self):
         src = ("from ..utils.metrics import METRICS\n"
                "def f(v):\n"

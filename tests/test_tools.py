@@ -295,6 +295,30 @@ class TestChaosMatrixDryRun:
         assert "tests/test_concurrent_shards.py" in out
         assert "tests/test_fused_parity.py" in out
 
+    def test_dry_run_pipeline_mode_selects_overlap_suite(self, capsys,
+                                                         monkeypatch):
+        """--pipeline sweeps the overlapped-cycle suite (serial-vs-
+        pipelined bit-identity + fenced rollback + crash replay +
+        breaker drain); composes with the other modes."""
+        from kai_scheduler_tpu.tools import chaos_matrix
+        monkeypatch.setattr(
+            chaos_matrix.subprocess, "run",
+            lambda *a, **kw: (_ for _ in ()).throw(AssertionError(
+                "dry run must not execute iterations")))
+        rc = chaos_matrix.main(["--dry-run", "--pipeline",
+                                "--seeds", "3,5"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert out.count("seed ") == 2
+        assert "tests/test_pipeline_cycle.py" in out
+        assert "tests/test_reconciler.py" not in out
+        rc = chaos_matrix.main(["--dry-run", "--pipeline", "--arena",
+                                "--seeds", "3"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "tests/test_pipeline_cycle.py" in out
+        assert "tests/test_snapshot_delta.py" in out
+
     def test_dry_run_respects_iterations_default_seeds(self, capsys,
                                                        monkeypatch):
         from kai_scheduler_tpu.tools import chaos_matrix
