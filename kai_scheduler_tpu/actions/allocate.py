@@ -102,7 +102,7 @@ def _execute_bulk(ssn, jobs):
     for pg in jobs:
         tasks = pg.tasks_to_allocate(
             subgroup_order_fn=ssn.pod_set_order_key,
-            task_order_fn=ssn.task_order_key)
+            task_order_fn=ssn.task_order_key, cache_ordered=True)
         host_side = (
             not tasks
             or any(t.is_fractional or t.resource_claims
@@ -191,7 +191,7 @@ def _execute_bulk(ssn, jobs):
         for pg in ordered:
             tasks = pg.tasks_to_allocate(
                 subgroup_order_fn=ssn.pod_set_order_key,
-                task_order_fn=ssn.task_order_key)
+                task_order_fn=ssn.task_order_key, cache_ordered=True)
             gate = ssn.is_job_over_queue_capacity(pg, tasks).schedulable \
                 and ssn.check_pre_predicates(tasks).schedulable \
                 if tasks else False
@@ -307,7 +307,7 @@ def _execute_bulk(ssn, jobs):
         if pg.has_tasks_to_allocate() and not pg.fit_errors:
             tasks = pg.tasks_to_allocate(
                 subgroup_order_fn=ssn.pod_set_order_key,
-                task_order_fn=ssn.task_order_key)
+                task_order_fn=ssn.task_order_key, cache_ordered=True)
             if tasks:
                 _record_chunk_failure(ssn, pg, tasks)
     return leftovers
@@ -325,7 +325,7 @@ def attempt_to_allocate_job(ssn, job: PodGroupInfo,
     tasks = job.tasks_to_allocate(
         subgroup_order_fn=ssn.pod_set_order_key,
         task_order_fn=ssn.task_order_key,
-        real_allocation=not pipeline_only)
+        real_allocation=not pipeline_only, cache_ordered=True)
     if not tasks:
         return False
 

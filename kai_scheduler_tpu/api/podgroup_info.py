@@ -111,8 +111,10 @@ class PodGroupInfo:
         self.required_topology_level = required_topology_level
         self.preferred_topology_level = preferred_topology_level
         self.topology_name = topology_name
-        # caches (invalidated on status change, job_info.go:281)
-        self._tasks_to_allocate: Optional[list[PodInfo]] = None
+        # caches (invalidated on status change, job_info.go:281);
+        # _tasks_to_allocate holds (tag, [tasks]) — the tag pins which
+        # ordering fns produced the list.
+        self._tasks_to_allocate: Optional[tuple] = None
         self._signature: Optional[str] = None
         self._init_resource: Optional[np.ndarray] = None
         # Incremental status counters: has_tasks_to_allocate is called
@@ -219,7 +221,8 @@ class PodGroupInfo:
 
     def tasks_to_allocate(self, subgroup_order_fn: Callable | None = None,
                           task_order_fn: Callable | None = None,
-                          real_allocation: bool = True) -> list[PodInfo]:
+                          real_allocation: bool = True,
+                          cache_ordered: bool = False) -> list[PodInfo]:
         """Select the next chunk of tasks to try to place.
 
         Mirrors GetTasksToAllocate (allocation_info.go:26): while any podset
@@ -227,12 +230,23 @@ class PodGroupInfo:
         (minAvailable - allocated) chunk; once all podsets are satisfied, grow
         elastically one task at a time from one podset per attempt (:145-177).
         """
-        # The cache is only valid for the default orderings; explicit
-        # ordering functions always recompute.
-        cacheable = (real_allocation and subgroup_order_fn is None
-                     and task_order_fn is None)
-        if cacheable and self._tasks_to_allocate is not None:
-            return self._tasks_to_allocate
+        # The cache is valid for the default orderings, or — when the
+        # caller vouches its explicit ordering fns are pure functions of
+        # immutable task identity (``cache_ordered``) — keyed by the fns
+        # themselves: bound-method equality carries the owning session's
+        # identity, so a new session (or different fns) can never be
+        # served a stale chunk.  Status transitions invalidate either
+        # way (invalidate_caches).
+        if subgroup_order_fn is None and task_order_fn is None:
+            tag = "__default__"
+        elif cache_ordered:
+            tag = (subgroup_order_fn, task_order_fn)
+        else:
+            tag = None
+        cacheable = real_allocation and tag is not None
+        if cacheable and self._tasks_to_allocate is not None \
+                and self._tasks_to_allocate[0] == tag:
+            return self._tasks_to_allocate[1]
 
         unsatisfied = [ps for ps in self.pod_sets.values()
                        if ps.num_active_allocated() < ps.min_available]
@@ -262,7 +276,7 @@ class PodGroupInfo:
             taken_subgroups += 1
 
         if cacheable:
-            self._tasks_to_allocate = out
+            self._tasks_to_allocate = (tag, out)
         return out
 
     def has_tasks_to_allocate(self, real_allocation: bool = True) -> bool:

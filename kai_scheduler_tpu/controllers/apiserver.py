@@ -45,6 +45,7 @@ so callers cannot tell the substrates apart.
 from __future__ import annotations
 
 import copy
+import itertools
 import json
 import threading
 import uuid
@@ -90,8 +91,18 @@ class EventLog:
             return self._seq - len(self._events)
 
     def since(self, seq: int) -> list:
+        """Events with seq > ``seq``.  Sequences are assigned contiguously,
+        so the suffix is a tail slice of the ring — O(result), not a scan
+        of the whole retained history per watcher wakeup."""
         with self.cond:
-            return [e for e in self._events if e[0] > seq]
+            missing = self._seq - seq
+            if missing <= 0:
+                return []
+            if missing >= len(self._events):
+                return list(self._events)
+            tail = list(itertools.islice(reversed(self._events), missing))
+            tail.reverse()
+            return tail
 
 
 class KubeAPIServer:
@@ -266,11 +277,12 @@ def _make_handler(server: "KubeAPIServer"):
             self.send_header("Transfer-Encoding", "chunked")
             self.end_headers()
 
-            def send_line(payload: dict) -> None:
+            def chunk(payload: dict) -> bytes:
                 line = (json.dumps(payload) + "\n").encode()
-                self.wfile.write(f"{len(line):x}\r\n".encode())
-                self.wfile.write(line + b"\r\n")
-                self.wfile.flush()
+                return f"{len(line):x}\r\n".encode() + line + b"\r\n"
+
+            def send_line(payload: dict) -> None:
+                self.wfile.write(chunk(payload))
 
             # Chaos: drop the stream after N lines (watchdrop fault) —
             # the client must reconnect with its seq and lose nothing.
@@ -301,12 +313,36 @@ def _make_handler(server: "KubeAPIServer"):
                            "seq": seq})
                 while not server._closing.is_set():
                     events = server.log.since(seq)
+                    if events and events[0][0] != seq + 1:
+                        # This watcher overran the ring mid-stream: the
+                        # events between its cursor and the retained
+                        # window were evicted while it stalled.  Same
+                        # contract as resume-from-outside-the-window:
+                        # one explicit GONE line, then close — the
+                        # client re-lists.  Never silently skip history.
+                        send_line({"type": "GONE", "code": 410,
+                                   "seq": server.log.seq,
+                                   "boot": server.boot_id,
+                                   "oldest": server.log.oldest()})
+                        return
+                    # One write per batch: wfile is unbuffered, so a
+                    # bind wave's burst of events is accumulated into a
+                    # single buffer and leaves in one sendall instead of
+                    # one syscall per event.
+                    buf = bytearray()
+                    dropped = False
                     for eseq, etype, obj in events:
-                        send_line({"seq": eseq, "type": etype, "object": obj})
+                        buf += chunk({"seq": eseq, "type": etype,
+                                      "object": obj})
                         seq = eseq
                         sent += 1
                         if drop_after is not None and sent >= drop_after:
-                            return  # injected mid-stream connection drop
+                            dropped = True  # injected mid-stream drop
+                            break
+                    if buf:
+                        self.wfile.write(buf)
+                    if dropped:
+                        return
                     with server.log.cond:
                         if server.log.seq == seq \
                                 and not server._closing.is_set():

@@ -141,7 +141,14 @@ class Session:
         self.job_key_fns: list[Callable] = []
         self.job_keys_complete: bool = True
         self.queue_key_fn: Callable | None = None
+        # Contract: registered fns must be pure functions of immutable
+        # task identity (name/subgroup/uid).  task_order_key memoizes
+        # per uid for the whole session, and chunks sorted by these keys
+        # are cached per session (tasks_to_allocate cache_ordered) — a
+        # state-dependent ordering fn would be silently frozen at its
+        # first evaluation.
         self.task_order_fns: list[Callable] = []
+        self._task_order_key_cache: dict = {}
         self.pod_set_order_fns: list[Callable] = []
         self.over_capacity_fns: list[Callable] = []
         self.non_preemptible_over_quota_fns: list[Callable] = []
@@ -495,8 +502,17 @@ class Session:
         return -1 if l.uid < r.uid else (1 if l.uid > r.uid else 0)
 
     def task_order_key(self, task: PodInfo):
-        return tuple(fn(task) for fn in self.task_order_fns) + (
-            task.name, task.uid)
+        # Memoized per session: the registered fns are fixed once the
+        # session opens and the key depends only on immutable task
+        # identity — while one allocation cycle can sort the same task
+        # many times (eligibility split, per-round gating, fit errors).
+        cache = self._task_order_key_cache
+        key = cache.get(task.uid)
+        if key is None:
+            key = tuple(fn(task) for fn in self.task_order_fns) + (
+                task.name, task.uid)
+            cache[task.uid] = key
+        return key
 
     def pod_set_order_key(self, ps):
         return tuple(fn(ps) for fn in self.pod_set_order_fns) + (ps.name,)

@@ -73,28 +73,101 @@ class TestCRUD:
             client.create({"kind": "Queue", "metadata": {"name": "dup"},
                            "spec": {}})
 
-    def test_degenerate_error_bodies_still_map(self, client, monkeypatch):
+    def test_degenerate_error_bodies_still_map(self, client):
         """A proxy/LB answering 404 with a bare JSON string/array, junk
         bytes, or a body that dies mid-read (IncompleteRead) must still
-        map to NotFound — never crash with an unmapped exception."""
+        map to NotFound — never crash with an unmapped exception.
+
+        Planted as the client's cached keep-alive connection so the real
+        transport path (including the drain-and-reuse logic) runs."""
         import http.client
-        import io
-        import urllib.error
-        import urllib.request
 
-        class TruncatedBody(io.BytesIO):
-            def read(self, *a):
-                raise http.client.IncompleteRead(b"")
+        def truncated():
+            raise http.client.IncompleteRead(b"")
 
-        for body in (io.BytesIO(b'"not found"'), io.BytesIO(b"[]"),
-                     io.BytesIO(b"not json at all"), TruncatedBody()):
-            def fake_urlopen(req, timeout=None, _b=body):
-                raise urllib.error.HTTPError(
-                    req.full_url, 404, "Not Found", {}, _b)
+        class FakeResp:
+            def __init__(self, body_fn):
+                self.status = 404
+                self._body_fn = body_fn
 
-            monkeypatch.setattr(urllib.request, "urlopen", fake_urlopen)
+            def read(self):
+                return self._body_fn()
+
+        class FakeConn:
+            def __init__(self, body_fn):
+                self._body_fn = body_fn
+
+            def request(self, *a, **k):
+                pass
+
+            def getresponse(self):
+                return FakeResp(self._body_fn)
+
+            def close(self):
+                pass
+
+        for body_fn in (lambda: b'"not found"', lambda: b"[]",
+                        lambda: b"not json at all", truncated):
+            client._local.conn = FakeConn(body_fn)
             with pytest.raises(NotFound):
                 client.get("Queue", "absent-via-proxy")
+            client._local.conn = None
+
+    def test_stale_keepalive_retry_is_method_aware(self, client):
+        """A cached conn the server closed while idle: reads replay
+        transparently on a fresh connection, but a mutation that died
+        awaiting its response must surface URLError instead of being
+        replayed — the first send may already have been processed, and
+        a replay would turn that success into a spurious Conflict."""
+        import http.client
+        import urllib.error
+
+        client.create({"kind": "Queue", "metadata": {"name": "ka"},
+                       "spec": {}})
+
+        class DeadConn:
+            def request(self, *a, **k):
+                pass  # the write lands in the dead socket's buffer
+
+            def getresponse(self):
+                raise http.client.RemoteDisconnected("idle conn closed")
+
+            def close(self):
+                pass
+
+        client._local.conn = DeadConn()
+        assert client.get("Queue", "ka")["metadata"]["name"] == "ka"
+
+        client._local.conn = DeadConn()
+        with pytest.raises(urllib.error.URLError):
+            client.patch("Queue", "ka", {"spec": {"x": 1}})
+        # the dead conn was dropped, so the next call just works
+        assert client.get("Queue", "ka")["spec"] == {}
+
+    def test_base_url_path_prefix_preserved(self, server):
+        """A base_url with a path (apiserver behind a reverse-proxy
+        route) must prefix every request path, exactly like the old
+        base_url + path transport did."""
+        import http.client
+
+        c = HTTPKubeAPI(server.url + "/kube")
+        seen = []
+
+        class RecordingConn:
+            def request(self, method, path, **k):
+                seen.append(path)
+                raise http.client.CannotSendRequest()
+
+            def close(self):
+                pass
+
+        # send-phase failure -> retried on a real conn, which hits the
+        # real server at the prefixed path (unrouted there, so 404).
+        c._local.conn = RecordingConn()
+        with pytest.raises(NotFound):
+            c.get("Queue", "absent")
+        assert seen == ["/kube/apis/Queue/default/absent"]
+        c.close()
 
     def test_stale_update_conflicts(self, client):
         client.create({"kind": "Queue", "metadata": {"name": "q"},
@@ -264,6 +337,27 @@ class TestLeaseElection:
 
 
 class TestWatchTooOld:
+    def test_since_exposes_mid_stream_eviction_gap(self):
+        """The tail-slice `since` keeps seqs contiguous with the cursor
+        whenever no history was lost — and a discontiguous head is
+        exactly how the streamer detects that a stalled watcher overran
+        the ring mid-stream (it answers GONE instead of silently
+        skipping the evicted events)."""
+        from kai_scheduler_tpu.controllers.apiserver import EventLog
+
+        log = EventLog(capacity=4)
+        for i in range(8):
+            log.append("ADDED", {"metadata": {"name": f"q{i}"}})
+        # Cursor at 2: events 3-4 were evicted (ring holds 5-8), so the
+        # returned head is discontiguous with the cursor -> GONE.
+        events = log.since(2)
+        assert [e[0] for e in events] == [5, 6, 7, 8]
+        assert events[0][0] != 2 + 1
+        # Contiguous cursors inside the window: complete suffix, no gap.
+        assert [e[0] for e in log.since(4)] == [5, 6, 7, 8]
+        assert [e[0] for e in log.since(6)] == [7, 8]
+        assert log.since(8) == []
+
     def test_sync_replay_after_ring_eviction(self, server):
         """A client resuming from before the ring horizon gets 410 GONE
         and re-lists, converging its handlers on current state."""
