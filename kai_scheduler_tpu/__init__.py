@@ -20,3 +20,18 @@ Layers (mirroring SURVEY.md §1):
 """
 
 __version__ = "0.1.0"
+
+# KAI_LOCKTRACE=1 (runtime lock-order validation, utils/locktrace.py):
+# install the tracing lock factories at the EARLIEST in-package point —
+# module-level singletons (the metrics registry, lifecycle tracker,
+# flight recorder) create their locks when their module first imports,
+# which for `python -m kai_scheduler_tpu.server` is before any main()
+# runs.  A lock created before install is invisible to the journal.
+# locktrace itself imports only stdlib, so this adds nothing to the
+# un-traced import path.
+import os as _os
+
+if _os.environ.get("KAI_LOCKTRACE", "") not in ("", "0", "false"):
+    from .utils.locktrace import install_from_env as _locktrace_install
+
+    _locktrace_install()

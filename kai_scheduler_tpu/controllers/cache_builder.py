@@ -432,7 +432,12 @@ class ClusterCache:
         # Mirrors of the watched store per consumed kind ((ns, name) ->
         # manifest), maintained from watch deltas (or re-listed per
         # snapshot on substrates without a change hook).  The parse
-        # layers below read ONLY the mirrors.
+        # layers below read ONLY the mirrors.  The mirrors and the prep
+        # caches below are SINGLE-WRITER on the scheduler thread (watch
+        # hooks only enqueue keys into the lock-guarded _changed_keys;
+        # snapshot() applies them on its own thread) — machine-checked
+        # by kairace KRC003.
+        # kairace: single-writer=main
         self._mirror: dict = {k: {} for k in _CONSUMED_KINDS}
         # Deterministic iteration order (sorted by name, api.list's
         # ordering), recomputed only when a kind's membership changes.
@@ -443,11 +448,15 @@ class ClusterCache:
         # Parsed templates for the hot kinds: name -> (rv_sig, template).
         # Templates are immutable; snapshot() instantiates fresh
         # per-cycle objects from them (the cycle mutates its instances).
+        # kairace: single-writer=main
         self._node_tmpl: dict = {}
+        # kairace: single-writer=main
         self._queue_tmpl: dict = {}
+        # kairace: single-writer=main
         self._group_tmpl: dict = {}
         # Aux parse caches per family, rebuilt only when dirty.
         self._aux: dict = {}
+        # kairace: single-writer=main
         self._aux_dirty: dict = {f: True for f in
                                  ("topology", "dra", "configmap", "pvc",
                                   "storage")}
@@ -480,6 +489,7 @@ class ClusterCache:
         self._pod_sigs: dict = {}
         # In-memory pipelined assignments surviving between cycles
         # (Cache.TaskPipelined): pod uid -> (node, gpu_group).
+        # kairace: single-writer=main
         self._pipelined: dict = {}
         # -- speculative view (overlapped pipeline, DESIGN §10) -----------
         # pod uid -> (seq, kind, node): placements/evictions whose commit
@@ -502,6 +512,7 @@ class ClusterCache:
         # nothing; instances share the template's immutable pieces
         # (ResourceRequirements with its memoized vectors, affinity
         # terms), which dominates snapshot cost at fleet scale.
+        # kairace: single-writer=main
         self._pod_cache: dict = {}
         # (owner, expression) pairs already warned about: an unsupported
         # CEL selector is re-parsed every snapshot, but the user should
@@ -526,6 +537,11 @@ class ClusterCache:
         WATCH thread while snapshot() may be iterating the cache on the
         scheduler thread, so only flip a flag here; the next snapshot
         drops the cache on its own thread."""
+        # GIL-atomic bool latch, BY DESIGN lock-free on the watch hot
+        # path: snapshot() rebinds to False BEFORE invalidating, so a
+        # concurrent re-set here is never lost — it re-invalidates on
+        # the next snapshot (see the consume-site comment).
+        # kairace: disable=KRC001
         self._resync_pending = True
         # Lifecycle: open timelines survive a relist (their pods are
         # still real) but get flagged — accounting stays coherent across

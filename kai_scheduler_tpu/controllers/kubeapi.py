@@ -198,37 +198,50 @@ class InMemoryKubeAPI:
                 self._emit("DELETED", obj)
 
     # -- watch -------------------------------------------------------------
+    # Registration is locked against _emit's concurrent dead-handler
+    # prune (which REBINDS _sync_watchers under the store lock on the
+    # commit-executor/status-worker thread): an unsynchronized append
+    # could land on the replaced list and be silently lost — the exact
+    # bug httpclient.on_resync documents.  kairace KRC001 caught the
+    # asymmetry here.
     def watch(self, kind: str, handler: Callable) -> None:
         """handler(event_type, obj); delivered on drain()."""
-        self._watchers[kind].append(handler)
+        with self._store_lock:
+            self._watchers[kind].append(handler)
 
     def watch_any(self, handler: Callable) -> None:
         """handler(event_type, obj) for EVERY kind; delivered on drain().
         Used by the HTTP apiserver to fan events out to remote watchers."""
-        self._watchers["*"].append(handler)
+        with self._store_lock:
+            self._watchers["*"].append(handler)
 
     def unwatch_any(self, handler: Callable) -> None:
         """Unregister a watch_any handler (a stopped apiserver must not
         keep deep-copying every future event into a log nobody reads)."""
-        try:
-            self._watchers["*"].remove(handler)
-        except ValueError:
-            pass
+        with self._store_lock:
+            try:
+                self._watchers["*"].remove(handler)
+            except ValueError:
+                pass
 
     def watch_sync(self, handler: Callable) -> None:
         """handler(event_type, obj) invoked synchronously at emit time,
         on whatever thread performed the mutation.  Handlers MUST be
         cheap (mark-dirty only) and may return False to deregister
         (weakref-dead caches of rebuilt shards prune themselves so)."""
-        self._sync_watchers.append(handler)
+        with self._store_lock:
+            self._sync_watchers.append(handler)
 
     def on_drain_idle(self, callback: Callable) -> None:
         """Register a callback run when drain()'s event queue empties
         (and before it returns).  Return truthy when work was done —
         the drain loop keeps going until every hook reports idle."""
-        self._idle_hooks.append(callback)
+        with self._store_lock:
+            self._idle_hooks.append(callback)
 
     def _emit(self, event_type: str, obj: dict) -> None:
+        # Always called under _store_lock (CRUD holds it), so the prune's
+        # list rebinding cannot race a watch_sync registration.
         self._pending.append((event_type, obj))
         if self._sync_watchers:
             dead = [h for h in self._sync_watchers

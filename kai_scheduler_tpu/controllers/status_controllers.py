@@ -123,6 +123,11 @@ class QueueController:
         # Debounced: queue aggregation scans every PodGroup, so running it
         # per event is quadratic during drains — mark dirty and let
         # reconcile_if_dirty() (called once per cycle) do the sweep.
+        # GIL-atomic bool latch: the consumer clears BEFORE sweeping, so
+        # an event landing mid-sweep re-arms the flag and the next cycle
+        # re-reconciles; an event landing before the sweep's list() is
+        # already included.  No ordering loses a reconcile.
+        # kairace: disable=KRC001
         self._dirty = True
 
     def reconcile_if_dirty(self) -> None:

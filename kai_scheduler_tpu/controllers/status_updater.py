@@ -45,7 +45,9 @@ class AsyncStatusUpdater:
         # (kind, ns, name) of objects that vanished while a patch for
         # them sat in the queue: the worker drops those writes instead
         # of paying a doomed API round trip (stale_write_skipped_total).
+        # kairace: single-writer=hook
         self._gone: set = set()
+        # kairace: single-writer=main
         self._recent_events: set = set()
         watch = getattr(api, "watch", None)
         if watch is not None:

@@ -106,7 +106,11 @@ class DeviceStateCache:
     because the previous cycle's statements committed placements."""
 
     def __init__(self):
+        # Device residency is scheduler-thread-owned (single-writer):
+        # dispatch/scatter happen on the cycle path only.
+        # kairace: single-writer=main
         self._dev: tuple | None = None    # (idle, rel, room) device arrays
+        # kairace: single-writer=main
         self._host: tuple | None = None   # matching host copies
         self._owner = None                # session the cache is synced to
 
@@ -220,18 +224,30 @@ class ClusterArena:
     serves the resident device tensors."""
 
     def __init__(self):
+        # Single-writer structure (DESIGN §9): the scheduler thread owns
+        # every arena mutation — watch hooks mark dirt through the
+        # cache's queued changes, never here.  The annotations are
+        # machine-checked by kairace KRC003 (docs/STATIC_ANALYSIS.md).
+        # kairace: single-writer=main
         self.generation = 0
+        # kairace: single-writer=main
         self._prev: SnapshotTensors | None = None
+        # kairace: single-writer=main
         self._prev_pad: int | None = None
+        # kairace: single-writer=main
         self._prev_usage: dict | None = None
         self._prev_node_order: list | None = None
         # Accumulated dirty state since the last pack.
+        # kairace: single-writer=main
         self._dirty_nodes: set[str] = set()
+        # kairace: single-writer=main
         self._tasks_dirty = True
+        # kairace: single-writer=main
         self._vocab_dirty = False
         self._full_reason: str | None = "first-snapshot"
         # Stamp: only the owning cache's LATEST snapshot may take the
         # delta path (an older/foreign ClusterInfo packs from scratch).
+        # kairace: single-writer=main
         self._stamp = 0
         self._latest_stamp: int | None = None
         # Device residency.

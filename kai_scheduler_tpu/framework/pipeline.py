@@ -67,10 +67,17 @@ class CommitExecutor:
         self._queue: queue.Queue = queue.Queue()
         self._lock = threading.Lock()
         self._busy: deque = deque(maxlen=self.BUSY_RING)
+        # Split-ownership counters (DESIGN §10): the scheduler thread
+        # owns submission, the worker owns completion; each side reads
+        # the other's counter under _lock.  Declared so kairace KRC003
+        # catches any future write from the wrong side.
+        # kairace: single-writer=CommitExecutor._worker
         self._busy_since: float | None = None
         self._errors: list[BaseException] = []
         self._poisoned: str | None = None
+        # kairace: single-writer=main
         self._submitted = 0
+        # kairace: single-writer=CommitExecutor._worker
         self._completed = 0
         self._completed_cv = threading.Condition(self._lock)
         self._stop = threading.Event()

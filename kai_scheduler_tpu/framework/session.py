@@ -257,7 +257,12 @@ class Session:
                             enumerate(self.snapshot.node_names)}
         self.gpu_strategy = BINPACK
         self.cpu_strategy = BINPACK
+        # Sessions are scheduler-thread-owned end to end: statements
+        # mutate mirrors on the cycle path only (commit I/O ships OUT of
+        # the session to the executor; it never writes back in).
+        # kairace: single-writer=main
         self.mutation_count = 0
+        # kairace: single-writer=main
         self.statements: list[Statement] = []
         # Flight-recorder correlation: the cycle's trace id (set by the
         # scheduler); Statement.commit stamps it onto BindRequests so a

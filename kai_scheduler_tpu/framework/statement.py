@@ -42,7 +42,12 @@ class _Op:
 class Statement:
     def __init__(self, session: "Session"):
         self.session = session
+        # Op recording and commit both run on the scheduler thread; the
+        # commit executor only ever executes the already-frozen closures
+        # (DESIGN §10) — it never touches the op list.
+        # kairace: single-writer=main
         self.ops: list[_Op] = []
+        # kairace: single-writer=main
         self.committed = False
         # Deferred-sync mode for bulk application: node-state mirror
         # pushes collapse to one sync per touched node instead of one per

@@ -124,6 +124,7 @@ class TopologySession:
             self.trees[name] = build_tree(
                 name, levels, ssn.snapshot.node_names, node_labels)
         # job uid -> [N] preferred-level score boosts (set by subset_nodes).
+        # kairace: single-writer=main
         self._job_node_scores: dict[str, np.ndarray] = {}
 
     # -- constraint resolution ---------------------------------------------

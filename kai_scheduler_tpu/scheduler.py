@@ -31,7 +31,9 @@ class Scheduler:
         self.config = config or SchedulerConfig()
         self.cache = cache or InMemoryCache()
         self.usage_provider = usage_provider
+        # kairace: single-writer=main
         self.session_id = 0
+        # kairace: single-writer=main
         self.last_session = None  # kept for introspection endpoints
         # Overlapped pipeline (DESIGN §10): when the operator arms a
         # commit executor here, Statement.commit registers decisions

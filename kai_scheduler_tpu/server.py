@@ -56,6 +56,8 @@ from .plugins.snapshot_plugin import dump_cluster
 from .utils import parse_bool as _parse_bool
 from .utils.deviceguard import configure_device_guard, device_guard
 from .utils.lifecycle import LIFECYCLE
+from .utils.locktrace import TRACER as LOCKTRACE
+from .utils.locktrace import sync_metrics as locktrace_sync_metrics
 from .utils.logging import LOG, init_loggers
 from .utils.metrics import METRICS
 from .utils.stackprof import STACKPROF, ensure_started_from_env
@@ -72,6 +74,13 @@ def healthz_payload(state: dict | None = None) -> dict:
     guard = device_guard()
     payload = {"status": "degraded" if guard.degraded else "ok",
                "device_guard": guard.status()}
+    if LOCKTRACE.installed:
+        # Runtime lock-order validator (KAI_LOCKTRACE=1): surface the
+        # journal so a fleet run shows the validator actually recorded
+        # orders — and loudly shows any contradiction vs the static
+        # kairace graph (docs/STATIC_ANALYSIS.md).
+        locktrace_sync_metrics()
+        payload["locktrace"] = LOCKTRACE.stats()
     state = state or {}
     elector = state.get("lease_elector")
     control: dict = {}
@@ -135,6 +144,8 @@ def _make_handler(server_state):
             path, _, raw_query = self.path.partition("?")
             q = {k: v[0] for k, v in parse_qs(raw_query).items()}
             if path == "/metrics":
+                if LOCKTRACE.installed:
+                    locktrace_sync_metrics()
                 body = METRICS.to_prometheus_text().encode()
                 ctype = "text/plain"
             elif path == "/healthz":
@@ -375,6 +386,9 @@ def run_app(argv=None) -> None:
     args = ap.parse_args(argv)
 
     init_loggers(args.verbosity)
+    # KAI_LOCKTRACE=1 is honored by the package __init__ (the factories
+    # must be patched before module-level singletons create their
+    # locks); by the time run_app executes the shim is already live.
     if args.fault_inject or args.device_deadline is not None:
         configure_device_guard(fault=args.fault_inject,
                                deadline_s=args.device_deadline)

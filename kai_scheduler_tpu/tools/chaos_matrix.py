@@ -68,7 +68,8 @@ PIPELINE_TESTS = ["tests/test_pipeline_cycle.py"]
 def run_iteration(seed: int, tests: list[str], marker: str,
                   keyword: str | None, repo_root: str,
                   timeout_s: float,
-                  trace_dir: str | None = None) -> tuple[bool, float, str]:
+                  trace_dir: str | None = None,
+                  extra_env: dict | None = None) -> tuple[bool, float, str]:
     """One pytest run under one fault seed; (passed, seconds, tail)."""
     cmd = [sys.executable, "-m", "pytest", "-q", "-p", "no:cacheprovider",
            "-p", "no:randomly", "-m", marker, *tests]
@@ -81,6 +82,13 @@ def run_iteration(seed: int, tests: list[str], marker: str,
     # The matrix must control the fault spec per test, not inherit an
     # outer one armed for a different experiment.
     env.pop("KAI_FAULT_INJECT", None)
+    # Likewise the locktrace contract: only --races arms it, with a
+    # per-seed journal path — an inherited KAI_LOCKTRACE would make
+    # iterations overwrite each other's dumps.
+    for var in ("KAI_LOCKTRACE", "KAI_LOCKTRACE_OUT",
+                "KAI_LOCKTRACE_GRAPH"):
+        env.pop(var, None)
+    env.update(extra_env or {})
     if trace_dir:
         # The flight recorder (utils/tracing.py) dumps every aborted or
         # degraded cycle's Chrome trace JSON here — the post-mortem
@@ -146,6 +154,15 @@ def main(argv=None) -> int:
                          "pipelined bit-identity, fenced rollback, "
                          "crash-after-journal replay, and breaker-open "
                          "drain-to-serial are asserted")
+    ap.add_argument("--races", action="store_true",
+                    help="runtime lock-order validation: every iteration "
+                         "runs with KAI_LOCKTRACE=1 (threading factories "
+                         "traced, per-thread acquisition orders recorded "
+                         "— utils/locktrace.py) and the merged observed "
+                         "orders are checked against the static kairace "
+                         "lock graph; any contradiction, uncovered "
+                         "threaded subsystem, or empty journal fails "
+                         "the sweep.  Composes with every mode flag")
     ap.add_argument("-k", "--keyword", default=None,
                     help="pytest -k filter (narrow the smoke subset)")
     ap.add_argument("--marker", default="chaos",
@@ -198,18 +215,57 @@ def main(argv=None) -> int:
                   f"keyword={args.keyword or '-'}  "
                   f"timeout={args.timeout:g}s  "
                   f"trace-dir={seed_trace_dir(seed) or '-'}  "
+                  f"races={'on' if args.races else 'off'}  "
                   f"tests={' '.join(tests)}",
                   flush=True)
+        if args.races:
+            print("races mode: each iteration runs with KAI_LOCKTRACE=1 "
+                  "+ a per-seed journal; merged orders are validated "
+                  "against the static kairace lock graph", flush=True)
         print(f"\nchaos matrix (dry run): {len(seeds)} iteration(s) "
               f"planned, nothing executed", flush=True)
         return 0
+
+    races_dir, races_graph = None, None
+    if args.races:
+        # The static contract is computed ONCE per sweep (the package
+        # doesn't change mid-run) and handed to every iteration: the
+        # child validates online (live contradiction counters in
+        # /metrics), the parent re-validates the merged journals below.
+        import json as _json
+        import tempfile
+
+        from .kairace.cli import lock_graph, package_root
+        races_graph = lock_graph([package_root()])
+        if races_graph["errors"]:
+            for err in races_graph["errors"]:
+                print(f"races: static-graph parse error: {err}",
+                      flush=True)
+            return 1
+        races_dir = tempfile.mkdtemp(prefix="kai-locktrace-")
+        graph_path = os.path.join(races_dir, "lock_graph.json")
+        with open(graph_path, "w", encoding="utf-8") as fh:
+            _json.dump(races_graph, fh)
+        print(f"races: static lock graph: "
+              f"{len(races_graph['locks'])} lock(s), "
+              f"{len(races_graph['edges'])} order edge(s)", flush=True)
+
+    def races_env(seed: int) -> dict:
+        if not args.races:
+            return {}
+        return {"KAI_LOCKTRACE": "1",
+                "KAI_LOCKTRACE_OUT": os.path.join(races_dir,
+                                                  f"seed{seed}.json"),
+                "KAI_LOCKTRACE_GRAPH": os.path.join(races_dir,
+                                                    "lock_graph.json")}
 
     rows, failed = [], []
     for seed in seeds:
         tdir = seed_trace_dir(seed)
         ok, secs, tail = run_iteration(seed, tests, args.marker,
                                        args.keyword, repo_root,
-                                       args.timeout, trace_dir=tdir)
+                                       args.timeout, trace_dir=tdir,
+                                       extra_env=races_env(seed))
         rows.append((seed, ok, secs))
         status = "ok" if ok else "FLAKE"
         print(f"seed {seed:>6}  {status:<5}  {secs:6.1f}s", flush=True)
@@ -225,12 +281,69 @@ def main(argv=None) -> int:
 
     print(f"\nchaos matrix: {len(rows) - len(failed)}/{len(rows)} green",
           flush=True)
+
+    races_red = False
+    if args.races:
+        races_red = not _report_races(races_dir, races_graph, seeds)
+        if races_red or failed:
+            # Post-mortem material: the per-seed journals + the static
+            # graph they were validated against.
+            print(f"races: journals kept in {races_dir}", flush=True)
+        else:
+            # A green sweep's journals are pure $TMPDIR litter —
+            # repeated CI/soak runs would accumulate them unbounded.
+            shutil.rmtree(races_dir, ignore_errors=True)
+
     if failed:
         print("replay a flake with: "
               f"KAI_FAULT_SEED={failed[0]} python -m pytest -m "
               f"{args.marker} {' '.join(tests)}", flush=True)
         return 1
-    return 0
+    return 1 if races_red else 0
+
+
+def _report_races(races_dir: str, graph: dict, seeds: list) -> bool:
+    """Merge the per-seed locktrace journals, validate against the
+    static graph, print the coverage table.  True = validator green."""
+    import json as _json
+
+    from ..utils.locktrace import validate_observed
+    dumps = []
+    for seed in seeds:
+        path = os.path.join(races_dir, f"seed{seed}.json")
+        try:
+            with open(path, encoding="utf-8") as fh:
+                dumps.append(_json.load(fh))
+        except (OSError, ValueError):
+            print(f"races: seed {seed}: no journal at {path} "
+                  f"(iteration died before the atexit dump?)",
+                  flush=True)
+    report = validate_observed(graph, dumps)
+
+    print("\nraces: observed lock orders per threaded subsystem:",
+          flush=True)
+    for sub, ent in report["subsystems"].items():
+        print(f"  {sub:<34} locks={ent['locks_created']:>4}  "
+              f"acquires={ent['acquires']:>7}  "
+              f"orders={ent['orders']:>3}", flush=True)
+    print(f"races: {len(report['orders'])} distinct order(s), "
+          f"{len(report['contradictions'])} contradiction(s), "
+          f"{len(report['uncovered_subsystems'])} uncovered "
+          f"subsystem(s)", flush=True)
+    for c in report["contradictions"]:
+        a, b = c["observed"]
+        print(f"races: CONTRADICTION: observed {a} -> {b} but the "
+              f"static graph orders {c['static_path']} — the analyzer "
+              f"missed an acquisition path or an annotation rotted",
+              flush=True)
+    for sub in report["uncovered_subsystems"]:
+        print(f"races: UNCOVERED: {sub} created statically-known locks "
+              f"but recorded zero acquisitions — the sweep never "
+              f"exercised it", flush=True)
+    if not report["orders"]:
+        print("races: EMPTY journal — a validator that records nothing "
+              "validates nothing", flush=True)
+    return report["ok"]
 
 
 if __name__ == "__main__":

@@ -8,7 +8,13 @@
 #   kailint       the project-specific invariant rules KAI001-KAI008
 #                 (docs/STATIC_ANALYSIS.md) against the committed
 #                 baseline (.kailint-baseline.json)
+#   kairace       the whole-program thread-role & lock-contract rules
+#                 KRC001-KRC005 (docs/STATIC_ANALYSIS.md) — the
+#                 committed baseline (.kairace-baseline.json) is EMPTY
+#                 by contract, so any finding is a new race to fix
 #   chaos matrix  --dry-run validation of the fault-grid definition
+#                 (including the --races KAI_LOCKTRACE lock-order
+#                 validation mode)
 #   kernel parity fused-allocation ladder (Pallas/jnp/legacy) vs the
 #                 exact kernel: placements must be bit-identical
 #                 (tools/kernel_parity.py --smoke)
@@ -43,9 +49,15 @@ echo "== kailint =="
 python -m kai_scheduler_tpu.tools.kailint kai_scheduler_tpu/ || fail=1
 
 echo
+echo "== kairace (thread-role & lock-contract analyzer) =="
+python -m kai_scheduler_tpu.tools.kairace kai_scheduler_tpu/ || fail=1
+
+echo
 echo "== chaos matrix definition (dry run) =="
 python -m kai_scheduler_tpu.tools.chaos_matrix --dry-run || fail=1
 python -m kai_scheduler_tpu.tools.chaos_matrix --pipeline --dry-run \
+    || fail=1
+python -m kai_scheduler_tpu.tools.chaos_matrix --races --dry-run \
     || fail=1
 
 echo

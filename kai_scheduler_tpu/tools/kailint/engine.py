@@ -26,9 +26,14 @@ import tokenize
 from dataclasses import dataclass, field
 from typing import Iterable, Iterator
 
-SUPPRESS_RE = re.compile(
-    r"#\s*kailint:\s*disable(?P<file>-file)?\s*=\s*"
-    r"(?P<rules>all|[A-Za-z]+\d+(?:\s*,\s*[A-Za-z]+\d+)*)")
+def suppress_re(tool: str) -> re.Pattern:
+    """The per-tool suppression marker.  The engine is shared chassis
+    (kailint and kairace both run on it); each tool reads only its OWN
+    ``# <tool>: disable=`` comments, so a kairace suppression never
+    silently disables a kailint rule on the same line (and vice versa)."""
+    return re.compile(
+        rf"#\s*{re.escape(tool)}:\s*disable(?P<file>-file)?\s*=\s*"
+        r"(?P<rules>all|[A-Za-z]+\d+(?:\s*,\s*[A-Za-z]+\d+)*)")
 
 
 @dataclass(frozen=True)
@@ -39,6 +44,12 @@ class Finding:
     col: int
     message: str
     source: str = ""   # stripped text of the flagged line
+    # Other sites that constitute the SAME defect (multi-site contract
+    # findings: e.g. KRC001 reports one write but the conflict is the
+    # SET of writes).  A suppression at any related site silences the
+    # finding — the author reviewed that site of the conflict.  Excluded
+    # from the fingerprint and the baseline schema on purpose.
+    related: tuple = ()   # ((path, line), ...)
 
     @property
     def fingerprint(self) -> str:
@@ -59,9 +70,11 @@ class Finding:
 class ModuleContext:
     """One parsed module: AST, source lines, and its suppression map."""
 
-    def __init__(self, path: str, source: str):
+    def __init__(self, path: str, source: str, tool: str = "kailint"):
         self.path = path.replace(os.sep, "/")
         self.source = source
+        self.tool = tool
+        self._suppress_re = suppress_re(tool)
         self.lines = source.splitlines()
         self.tree = ast.parse(source, filename=path)
         # line number -> set of rule ids (or "ALL") suppressed there
@@ -101,7 +114,7 @@ class ModuleContext:
         pending: set[str] | None = None
         for i, raw in enumerate(self.lines, 1):
             stripped = raw.strip()
-            m = SUPPRESS_RE.search(comments.get(i, ""))
+            m = self._suppress_re.search(comments.get(i, ""))
             if m:
                 spec = m.group("rules")
                 rules = ({"ALL"} if spec == "all" else
@@ -129,10 +142,18 @@ class ModuleContext:
                 pending = None
 
     def is_suppressed(self, finding: Finding) -> bool:
-        keys = {finding.rule.upper(), "ALL"}
+        if self.is_line_suppressed(finding.rule, None):
+            return True
+        return self.is_line_suppressed(finding.rule, finding.line)
+
+    def is_line_suppressed(self, rule: str, line: int | None) -> bool:
+        """``line=None`` asks only about file-level suppression."""
+        keys = {rule.upper(), "ALL"}
         if self.file_suppressions & keys:
             return True
-        return bool(self.line_suppressions.get(finding.line, set()) & keys)
+        if line is None:
+            return False
+        return bool(self.line_suppressions.get(line, set()) & keys)
 
 
 class Rule:
@@ -233,7 +254,9 @@ def iter_python_files(paths: Iterable[str]) -> Iterator[str]:
 class Engine:
     def __init__(self, rules: list[Rule] | None = None,
                  select: set[str] | None = None,
-                 ignore: set[str] | None = None):
+                 ignore: set[str] | None = None,
+                 tool: str = "kailint"):
+        self.tool = tool
         if rules is None:
             from .rules import default_rules
             rules = default_rules()
@@ -260,7 +283,8 @@ class Engine:
         contexts: list[ModuleContext] = []
         for relpath, source in modules:
             try:
-                contexts.append(ModuleContext(relpath, source))
+                contexts.append(ModuleContext(relpath, source,
+                                              tool=self.tool))
             except SyntaxError as exc:
                 report.errors.append(f"{relpath}: {exc}")
         report.files = len(contexts)
@@ -285,7 +309,18 @@ class Engine:
                 continue
             seen.add(key)
             ctx = by_path.get(f.path)
-            if ctx is not None and ctx.is_suppressed(f):
+            suppressed = ctx is not None and ctx.is_suppressed(f)
+            if not suppressed:
+                # A multi-site finding (f.related) is one defect spread
+                # over several sites; a suppression at ANY of them is a
+                # reviewed decision about the whole conflict.
+                for rpath, rline in f.related:
+                    rctx = by_path.get(rpath)
+                    if rctx is not None and \
+                            rctx.is_line_suppressed(f.rule, rline):
+                        suppressed = True
+                        break
+            if suppressed:
                 report.suppressed += 1
             else:
                 report.findings.append(f)
@@ -317,7 +352,7 @@ class Engine:
 BASELINE_NAME = ".kailint-baseline.json"
 
 
-def load_baseline(path: str) -> dict:
+def load_baseline(path: str, tool: str = "kailint") -> dict:
     """fingerprint -> entry dict.  Missing file = empty baseline; a
     shape-corrupt file raises ValueError (exit 2 at the CLI), never a
     raw traceback that an exit-code consumer misreads as findings."""
@@ -329,13 +364,14 @@ def load_baseline(path: str) -> dict:
     if not isinstance(entries, list) or not all(
             isinstance(e, dict) and "fingerprint" in e for e in entries):
         raise ValueError(
-            f"{path}: not a kailint baseline (expected an object with "
+            f"{path}: not a {tool} baseline (expected an object with "
             f"an 'entries' list of fingerprinted records); regenerate "
             f"with --write-baseline")
     return {e["fingerprint"]: e for e in entries}
 
 
-def write_baseline(path: str, findings: list[Finding]) -> int:
+def write_baseline(path: str, findings: list[Finding],
+                   tool: str = "kailint") -> int:
     seen: dict[str, dict] = {}
     for f in findings:
         entry = seen.setdefault(f.fingerprint, {
@@ -349,7 +385,7 @@ def write_baseline(path: str, findings: list[Finding]) -> int:
     entries = sorted(seen.values(),
                      key=lambda e: (e["path"], e["rule"], e["fingerprint"]))
     with open(path, "w", encoding="utf-8") as fh:
-        json.dump({"version": 1, "tool": "kailint", "entries": entries},
+        json.dump({"version": 1, "tool": tool, "entries": entries},
                   fh, indent=2, sort_keys=True)
         fh.write("\n")
     return len(entries)

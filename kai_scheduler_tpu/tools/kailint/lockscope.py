@@ -1,0 +1,279 @@
+"""Shared lock-scope collector: the ONE place that knows what a lock is.
+
+Both kailint's KAI006 (lock discipline) and kairace (the whole-program
+thread-role & lock-contract analyzer, ``tools/kairace/``) need the same
+facts about a module:
+
+- which attributes/globals are synchronization primitives, discovered by
+  TYPE (``self._x = threading.RLock()``) and not just by name — KAI006's
+  original name heuristic missed every ``RLock``/``Condition`` whose
+  name didn't contain "lock";
+- which Condition objects ALIAS an underlying lock
+  (``threading.Condition(self._lock)`` — acquiring the condition IS
+  acquiring ``_lock``, so guard analysis must treat them as one);
+- which attributes hold instances of in-tree classes
+  (``self.log = EventLog(...)``), so ``with self.log.cond:`` resolves to
+  ``EventLog.cond``;
+- the lexical ``with <lock>:`` regions of a function, with nesting.
+
+Keeping this in one module means the two tools cannot drift: a new lock
+kind (or a new aliasing form) taught here is immediately visible to both
+the lint rule and the race analyzer.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+
+from .astutil import dotted_name
+
+# Factory callables that mint a synchronization primitive, mapped to the
+# primitive kind.  Bare names cover ``from threading import Lock``.
+LOCK_FACTORY_KINDS = {
+    "threading.Lock": "lock", "Lock": "lock",
+    "_thread.allocate_lock": "lock",
+    "threading.RLock": "rlock", "RLock": "rlock",
+    "threading.Condition": "condition", "Condition": "condition",
+    "threading.Semaphore": "semaphore", "Semaphore": "semaphore",
+    "threading.BoundedSemaphore": "semaphore",
+    "BoundedSemaphore": "semaphore",
+}
+
+# Name tokens that mark a lock even without a visible factory call (a
+# lock received as a parameter, or created behind a helper).  Whole-word
+# tokens: `journal_lock` is a lock, `clock` is not.
+LOCKISH_TOKENS = {"lock", "mutex", "rlock", "semaphore", "sem",
+                  "cond", "condition", "cv"}
+
+# Primitives that are NOT locks for ordering/guard purposes: calling
+# their methods is thread-safe by construction and holding no lock while
+# doing so is fine.
+EVENT_FACTORIES = {"threading.Event", "Event", "queue.Queue", "Queue",
+                   "queue.SimpleQueue", "SimpleQueue",
+                   "collections.deque", "deque",
+                   "threading.local", "local", "threading.Barrier",
+                   "Barrier"}
+
+
+def lockish_name(node: ast.AST) -> bool:
+    """Name-token heuristic (KAI006's original detector, now shared)."""
+    name = dotted_name(node)
+    if not name:
+        return False
+    leaf = name.split(".")[-1].lower()
+    tokens = set(re.split(r"[_\W]+", leaf)) - {""}
+    return bool(tokens & LOCKISH_TOKENS)
+
+
+@dataclass
+class LockDecl:
+    """One declared synchronization attribute/global."""
+    kind: str                  # lock | rlock | condition | semaphore
+    line: int                  # declaration line (creation site)
+    alias_of: str | None = None   # Condition(self._x): alias of attr x
+
+
+@dataclass
+class ModuleLocks:
+    """Per-module lock facts (one collector pass over the AST)."""
+    # class name -> {attr name -> LockDecl}
+    class_locks: dict[str, dict[str, LockDecl]] = field(
+        default_factory=dict)
+    # module-global name -> LockDecl
+    module_locks: dict[str, LockDecl] = field(default_factory=dict)
+    # class name -> {attr name -> class name} for self.x = KnownClass()
+    attr_classes: dict[str, dict[str, str]] = field(default_factory=dict)
+    # class name -> {attr name} for Event/Queue/deque-typed attrs
+    class_events: dict[str, set[str]] = field(default_factory=dict)
+    # module-global Event/Queue names
+    module_events: set[str] = field(default_factory=set)
+    # every class name defined in the module (incl. nested)
+    classes: set[str] = field(default_factory=set)
+
+    def lock_kind(self, cls: str | None, attr: str) -> str | None:
+        if cls is not None:
+            decl = self.class_locks.get(cls, {}).get(attr)
+            if decl is not None:
+                return decl.kind
+        return None
+
+    def resolve_alias(self, cls: str, attr: str) -> str:
+        """Follow Condition->lock aliasing to the base attribute."""
+        seen = set()
+        while True:
+            decl = self.class_locks.get(cls, {}).get(attr)
+            if decl is None or decl.alias_of is None or attr in seen:
+                return attr
+            seen.add(attr)
+            attr = decl.alias_of
+
+
+def _factory_kind(value: ast.AST) -> str | None:
+    if isinstance(value, ast.Call):
+        name = dotted_name(value.func)
+        if name in LOCK_FACTORY_KINDS:
+            return LOCK_FACTORY_KINDS[name]
+    return None
+
+
+def _is_event_factory(value: ast.AST) -> bool:
+    if isinstance(value, ast.Call):
+        name = dotted_name(value.func)
+        return name in EVENT_FACTORIES
+    return False
+
+
+def collect_module_locks(tree: ast.Module,
+                         known_classes: set[str] | None = None
+                         ) -> ModuleLocks:
+    """One pass over a module AST: every ``self.x = <factory>()`` /
+    ``X = <factory>()`` declaration, Condition aliasing, and in-tree
+    instance attributes.  ``known_classes``: class names from OTHER
+    modules, so ``self.log = EventLog(...)`` resolves across imports."""
+    out = ModuleLocks()
+    known_classes = known_classes or set()
+
+    class_stack: list[str] = []
+
+    def visit(node: ast.AST) -> None:
+        if isinstance(node, ast.ClassDef):
+            out.classes.add(node.name)
+            class_stack.append(node.name)
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+            class_stack.pop()
+            return
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            value = node.value
+            if value is not None:
+                _record_assignment(out, class_stack, targets, value,
+                                   node.lineno, known_classes)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and class_stack:
+            _record_param_types(out, class_stack[-1], node, known_classes)
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+
+    visit(tree)
+    return out
+
+
+def _record_param_types(out: ModuleLocks, cls: str,
+                        fn: ast.FunctionDef | ast.AsyncFunctionDef,
+                        known_classes: set[str]) -> None:
+    """``self.api = api`` where the ``api`` parameter is annotated with
+    an in-tree class types the attribute (the dominant injection idiom:
+    ``def __init__(self, api: InMemoryKubeAPI)``)."""
+    ann: dict[str, str] = {}
+    for arg in fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs:
+        if arg.annotation is not None:
+            name = dotted_name(arg.annotation)
+            if name is None and isinstance(arg.annotation, ast.BinOp):
+                # `api: InMemoryKubeAPI | None` — take the left arm
+                name = dotted_name(arg.annotation.left)
+            if name:
+                leaf = name.split(".")[-1]
+                if leaf in known_classes or leaf in out.classes:
+                    ann[arg.arg] = leaf
+    if not ann:
+        return
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            value = node.value
+            # `self.api = api or InMemoryKubeAPI()` unwraps to the param
+            if isinstance(value, ast.BoolOp) and value.values:
+                value = value.values[0]
+            if isinstance(value, ast.Name) and value.id in ann:
+                for target in node.targets:
+                    if isinstance(target, ast.Attribute) and \
+                            isinstance(target.value, ast.Name) and \
+                            target.value.id == "self":
+                        out.attr_classes.setdefault(cls, {}) \
+                            .setdefault(target.attr, ann[value.id])
+
+
+def _record_assignment(out: ModuleLocks, class_stack: list[str],
+                       targets: list[ast.AST], value: ast.AST,
+                       lineno: int, known_classes: set[str]) -> None:
+    if isinstance(value, ast.BoolOp) and value.values:
+        # `self.api = api or InMemoryKubeAPI()`: the fallback arm still
+        # types the attribute.
+        for arm in value.values:
+            if isinstance(arm, ast.Call):
+                value = arm
+                break
+    kind = _factory_kind(value)
+    cls = class_stack[-1] if class_stack else None
+    for target in targets:
+        self_attr = (isinstance(target, ast.Attribute)
+                     and isinstance(target.value, ast.Name)
+                     and target.value.id == "self")
+        if kind is not None:
+            alias = None
+            if kind == "condition" and isinstance(value, ast.Call) \
+                    and value.args:
+                inner = value.args[0]
+                if isinstance(inner, ast.Attribute) and \
+                        isinstance(inner.value, ast.Name) and \
+                        inner.value.id == "self":
+                    alias = inner.attr
+            if self_attr and cls is not None:
+                out.class_locks.setdefault(cls, {})[target.attr] = \
+                    LockDecl(kind, lineno, alias_of=alias)
+            elif isinstance(target, ast.Name) and not class_stack:
+                out.module_locks[target.id] = LockDecl(kind, lineno)
+        elif _is_event_factory(value):
+            if self_attr and cls is not None:
+                out.class_events.setdefault(cls, set()).add(target.attr)
+            elif isinstance(target, ast.Name) and not class_stack:
+                out.module_events.add(target.id)
+        elif isinstance(value, ast.Call):
+            ctor = dotted_name(value.func)
+            leaf = ctor.split(".")[-1] if ctor else None
+            if leaf and (leaf in out.classes or leaf in known_classes) \
+                    and self_attr and cls is not None:
+                out.attr_classes.setdefault(cls, {})[target.attr] = leaf
+
+
+# -- lexical with-scope walking ---------------------------------------------
+
+def walk_executed(stmt: ast.AST):
+    """ast.walk that does NOT descend into nested function/lambda bodies:
+    code merely *defined* under a lock does not run while it is held."""
+    stack = [stmt]
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue  # deferred body — not executed here
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def iter_with_lock_scopes(func_node: ast.AST, is_lock) -> list:
+    """Every ``with <lock>:`` region in ``func_node``'s executed body:
+    ``[(with_node, lock_exprs, enclosing_lock_exprs)]`` where
+    ``enclosing_lock_exprs`` are the lock expressions of lexically
+    enclosing ``with`` blocks (nesting order preserved).  ``is_lock`` is
+    a predicate over the context expression."""
+    scopes: list = []
+
+    def visit(node: ast.AST, held: tuple) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)) and node is not func_node:
+            return  # deferred body
+        if isinstance(node, ast.With):
+            locks = [item.context_expr for item in node.items
+                     if is_lock(item.context_expr)]
+            if locks:
+                scopes.append((node, locks, list(held)))
+                held = held + tuple(locks)
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    visit(func_node, ())
+    return scopes

@@ -1,12 +1,16 @@
 """KAI006: lock discipline.
 
-Two failure shapes, both of which have bitten every threaded scheduler:
+Three failure shapes, all of which have bitten every threaded scheduler:
 
 - **Bare ``lock.acquire()``** as a statement: any exception between
   ``acquire`` and ``release`` leaks the lock and wedges every other
   thread forever.  ``with lock:`` is exception-safe and costs nothing.
   (``acquired = lock.acquire(timeout=...)`` try-lock patterns keep the
-  result and are not flagged.)
+  result and are not flagged.)  Locks are recognized by NAME (whole-word
+  tokens: lock/mutex/rlock/semaphore/cond/cv) **and by TYPE** via the
+  shared lock-scope collector (``tools/kailint/lockscope.py``): an
+  ``RLock``/``Condition``/``Semaphore`` assigned to an innocently named
+  attribute is still a lock.
 
 - **Blocking calls while holding a lock**: an HTTP round trip, fsync,
   sleep, or device dispatch under a lock turns one slow syscall into a
@@ -15,18 +19,29 @@ Two failure shapes, both of which have bitten every threaded scheduler:
   for the whole watchdog deadline).  Flagged lexically inside ``with
   <lock>:`` blocks.  Sites where the serialization IS the contract (WAL
   appends in utils/commitlog.py) carry explicit suppressions.
+
+- **``notify``/``wait`` outside the condition's lock**: calling
+  ``Condition.notify()``/``notify_all()``/``wait()`` without holding the
+  condition raises ``RuntimeError`` at runtime — but only on the
+  interleaving that reaches it, which is exactly the interleaving a test
+  suite misses.  Flagged statically; ``threading.Condition(self._lock)``
+  aliasing is honored, so ``with self._lock: self._cv.notify()`` is
+  clean.
+
+The lock-scope collector is shared with kairace (the whole-program
+thread-role analyzer) so the two tools cannot drift on what counts as a
+lock.
 """
 
 from __future__ import annotations
 
 import ast
-import re
 from typing import Iterator
 
 from ..astutil import dotted_name
 from ..engine import Finding, ModuleContext, Rule
-
-_LOCKISH = {"lock", "mutex", "rlock", "semaphore", "sem"}
+from ..lockscope import (ModuleLocks, collect_module_locks, lockish_name,
+                         walk_executed)
 
 _BLOCKING_DOTTED = {
     "time.sleep", "os.fsync", "urllib.request.urlopen", "subprocess.run",
@@ -35,52 +50,130 @@ _BLOCKING_DOTTED = {
 _BLOCKING_ATTRS = {"fsync", "urlopen", "dispatch_kernel",
                    "block_until_ready"}
 
-
-def _is_lockish(node: ast.AST) -> bool:
-    name = dotted_name(node)
-    if not name:
-        return False
-    # Whole-word tokens, not substrings: `journal_lock` is a lock,
-    # `clock` (which merely CONTAINS "lock") is not.
-    leaf = name.split(".")[-1].lower()
-    tokens = set(re.split(r"[_\W]+", leaf)) - {""}
-    return bool(tokens & _LOCKISH)
+_CONDITION_METHODS = {"notify", "notify_all", "wait", "wait_for"}
 
 
 class LockDisciplineRule(Rule):
     id = "KAI006"
     name = "lock-discipline"
     description = ("bare lock.acquire() instead of `with`; blocking call "
-                   "made while a lock is held")
+                   "made while a lock is held; Condition notify/wait "
+                   "outside its lock")
 
     def check(self, ctx: ModuleContext) -> Iterator[Finding]:
-        for node in ast.walk(ctx.tree):
-            if isinstance(node, ast.Expr) and \
-                    isinstance(node.value, ast.Call):
+        locks = collect_module_locks(ctx.tree)
+        yield from self._visit(ctx, locks, ctx.tree, cls=None, held=())
+
+    # -- lock identity ------------------------------------------------------
+    def _declared_kind(self, locks: ModuleLocks, cls: str | None,
+                       node: ast.AST) -> str | None:
+        """Primitive kind of a lock expression, via the collector: a
+        self-attr declared in the enclosing class, a module global, or a
+        one-hop instance attribute (``self.log.cond``)."""
+        if isinstance(node, ast.Attribute):
+            base = node.value
+            if isinstance(base, ast.Name) and base.id == "self" and cls:
+                decl = locks.class_locks.get(cls, {}).get(node.attr)
+                return decl.kind if decl else None
+            if isinstance(base, ast.Attribute) and \
+                    isinstance(base.value, ast.Name) and \
+                    base.value.id == "self" and cls:
+                owner = locks.attr_classes.get(cls, {}).get(base.attr)
+                if owner:
+                    decl = locks.class_locks.get(owner, {}).get(node.attr)
+                    return decl.kind if decl else None
+        elif isinstance(node, ast.Name):
+            decl = locks.module_locks.get(node.id)
+            return decl.kind if decl else None
+        return None
+
+    def _is_event(self, locks: ModuleLocks, cls: str | None,
+                  node: ast.AST) -> bool:
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id == "self" and cls:
+            return node.attr in locks.class_events.get(cls, set())
+        if isinstance(node, ast.Name):
+            return node.id in locks.module_events
+        return False
+
+    def _is_lockish(self, locks: ModuleLocks, cls: str | None,
+                    node: ast.AST) -> bool:
+        if self._declared_kind(locks, cls, node) is not None:
+            return True
+        # Name tokens only count when the attribute is not KNOWN to be a
+        # non-lock primitive (an Event named `_sem_ready` is an Event).
+        return lockish_name(node) and not self._is_event(locks, cls, node)
+
+    def _canonical(self, locks: ModuleLocks, cls: str | None,
+                   node: ast.AST) -> str:
+        """Identity for held-vs-used comparison: self attrs resolve
+        Condition->lock aliases; everything else compares dotted text."""
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id == "self" and cls:
+            return f"{cls}.{locks.resolve_alias(cls, node.attr)}"
+        return dotted_name(node) or ast.dump(node)
+
+    # -- the walk -----------------------------------------------------------
+    def _visit(self, ctx: ModuleContext, locks: ModuleLocks,
+               node: ast.AST, cls: str | None,
+               held: tuple) -> Iterator[Finding]:
+        if isinstance(node, ast.ClassDef):
+            for child in ast.iter_child_nodes(node):
+                yield from self._visit(ctx, locks, child, node.name, held)
+            return
+        if isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
+            call = node.value
+            if isinstance(call.func, ast.Attribute) and \
+                    call.func.attr == "acquire" and \
+                    self._is_lockish(locks, cls, call.func.value):
                 # An .acquire() whose result is DISCARDED (expression
                 # statement) is always wrong: with no args it leaks on
                 # exception; with timeout= the False result is dropped
                 # and the code proceeds unlocked.  Try-lock patterns
                 # keep the result (Assign/If) and are not Expr nodes.
-                call = node.value
-                if isinstance(call.func, ast.Attribute) and \
-                        call.func.attr == "acquire" and \
-                        _is_lockish(call.func.value):
+                yield self.finding(
+                    ctx, node,
+                    "bare .acquire() on a lock — use `with lock:` "
+                    "(or keep the acquire result and check it) so "
+                    "an exception or timeout cannot leave the lock "
+                    "state wrong")
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _CONDITION_METHODS:
+            recv = node.func.value
+            if self._declared_kind(locks, cls, recv) == "condition":
+                want = self._canonical(locks, cls, recv)
+                if want not in held:
                     yield self.finding(
                         ctx, node,
-                        "bare .acquire() on a lock — use `with lock:` "
-                        "(or keep the acquire result and check it) so "
-                        "an exception or timeout cannot leave the lock "
-                        "state wrong")
-            elif isinstance(node, ast.With):
-                if any(_is_lockish(item.context_expr)
-                       for item in node.items):
-                    yield from self._check_held(ctx, node)
+                        f"Condition.{node.func.attr}() without holding "
+                        f"the condition's lock — RuntimeError at "
+                        f"runtime, but only on the interleaving that "
+                        f"reaches it; wrap in `with {dotted_name(recv)}:`")
+        if isinstance(node, ast.With):
+            lock_items = [item.context_expr for item in node.items
+                          if self._is_lockish(locks, cls,
+                                              item.context_expr)]
+            if lock_items:
+                yield from self._check_held(ctx, node)
+                held = held + tuple(self._canonical(locks, cls, e)
+                                    for e in lock_items)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            # A nested def/lambda body is deferred: locks held HERE are
+            # not held when it runs, so its walk starts with empty held.
+            for child in ast.iter_child_nodes(node):
+                yield from self._visit(ctx, locks, child, cls, ())
+            return
+        for child in ast.iter_child_nodes(node):
+            yield from self._visit(ctx, locks, child, cls, held)
 
     def _check_held(self, ctx: ModuleContext,
                     with_node: ast.With) -> Iterator[Finding]:
         for stmt in with_node.body:
-            for node in _walk_executed(stmt):
+            for node in walk_executed(stmt):
                 if not isinstance(node, ast.Call):
                     continue
                 name = dotted_name(node.func) or ""
@@ -92,17 +185,3 @@ class LockDisciplineRule(Rule):
                         f"blocking call `{name or attr}` while holding a "
                         f"lock — every contending thread inherits this "
                         f"latency; move it outside the critical section")
-
-
-def _walk_executed(stmt: ast.AST):
-    """Walk like ast.walk but do not descend into nested function or
-    lambda bodies: code merely *defined* under the lock does not run
-    while the lock is held."""
-    stack = [stmt]
-    while stack:
-        node = stack.pop()
-        yield node
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
-                             ast.Lambda)):
-            continue  # deferred body — not executed under the lock
-        stack.extend(ast.iter_child_nodes(node))

@@ -1,0 +1,16 @@
+"""kairace — whole-program thread-role & lock-contract analyzer.
+
+Built on the kailint engine chassis (3-pass rules, fingerprint
+baseline, ``# kairace: disable=`` suppressions, text/JSON CLI, exit
+codes 0/1/2) and the shared lock-scope collector
+(``tools/kailint/lockscope.py``).  See docs/STATIC_ANALYSIS.md for the
+KRC rule catalog, the thread-role table, and the single-writer
+annotation how-to; ``utils/locktrace.py`` + ``chaos_matrix --races``
+validate the static lock graph against observed runtime orders.
+"""
+
+from .cli import build_engine, lock_graph, main, role_table
+from .rules import RULE_CLASSES, default_rules
+
+__all__ = ["build_engine", "default_rules", "lock_graph", "main",
+           "role_table", "RULE_CLASSES"]

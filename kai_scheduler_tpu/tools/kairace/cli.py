@@ -1,0 +1,212 @@
+"""kairace command line.
+
+Exit codes (kailint chassis): 0 = clean (every finding suppressed or
+baselined), 1 = new findings, 2 = usage/internal error (including a file
+the analyzer could not parse — an unchecked file is never a green one).
+
+Beyond linting, two machine-readable exports feed the runtime validator:
+
+  --lock-graph   the static lock acquisition graph (canonical lock names,
+                 creation sites, order edges) that ``chaos_matrix
+                 --races`` checks observed ``KAI_LOCKTRACE`` orders
+                 against;
+  --roles        the thread-role table (role -> entry points) documented
+                 in docs/STATIC_ANALYSIS.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from ..kailint.engine import (Engine, load_baseline, write_baseline)
+from .program import build_program
+from .rules import RULE_CLASSES, default_rules
+
+BASELINE_NAME = ".kairace-baseline.json"
+
+
+def package_root() -> str:
+    """Default scan target: the kai_scheduler_tpu package itself."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def _default_baseline_path(paths: list[str]) -> str:
+    start = os.path.abspath(paths[0]) if paths else os.getcwd()
+    cur = start if os.path.isdir(start) else os.path.dirname(start)
+    while True:
+        cand = os.path.join(cur, BASELINE_NAME)
+        if os.path.isfile(cand):
+            return cand
+        parent = os.path.dirname(cur)
+        if parent == cur:
+            return os.path.join(os.getcwd(), BASELINE_NAME)
+        cur = parent
+
+
+def build_engine(select=None, ignore=None) -> Engine:
+    return Engine(default_rules(), select=select, ignore=ignore,
+                  tool="kairace")
+
+
+def _program_for(paths: list[str]):
+    """Build the whole-program index directly (for --lock-graph/--roles
+    and the chaos-matrix validator)."""
+    import ast as _ast
+
+    from ..kailint.engine import iter_python_files, package_relative
+    modules = []
+    errors = []
+    for fpath in iter_python_files(paths):
+        try:
+            with open(fpath, encoding="utf-8") as fh:
+                src = fh.read()
+            modules.append((package_relative(fpath),
+                            _ast.parse(src, filename=fpath), src))
+        except (OSError, UnicodeDecodeError, SyntaxError) as exc:
+            errors.append(f"{fpath}: {exc}")
+    return build_program(modules), errors
+
+
+def lock_graph(paths: list[str]) -> dict:
+    """The static lock graph: ``{"locks": {name: [{file, line}]},
+    "edges": [[held, acquired]]}`` — the contract the KAI_LOCKTRACE
+    runtime validator checks observed orders against."""
+    prog, errors = _program_for(paths)
+    return {
+        "locks": {name: [{"file": f, "line": ln} for f, ln in sites]
+                  for name, sites in sorted(prog.lock_sites.items())},
+        "edges": sorted([a, b] for (a, b) in prog.order_edges),
+        "errors": errors,
+    }
+
+
+def role_table(paths: list[str]) -> dict:
+    prog, errors = _program_for(paths)
+    roles: dict = {}
+    for spawn in prog.spawns:
+        entry = roles.setdefault(spawn.role, {"entry_points": set(),
+                                              "kind": spawn.kind})
+        tgt = (f"{spawn.target[0]}:{spawn.target[2]}"
+               if spawn.target else f"{spawn.path}:{spawn.line}")
+        entry["entry_points"].add(tgt)
+    return {
+        "roles": {r: {"kind": v["kind"],
+                      "entry_points": sorted(v["entry_points"])}
+                  for r, v in sorted(roles.items())},
+        "annotations": {f"{c}.{a}": sorted(rs) for (c, a), rs
+                        in sorted(prog.annotations.items())},
+        "errors": errors,
+    }
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m kai_scheduler_tpu.tools.kairace",
+        description="whole-program thread-role & lock-contract analyzer "
+                    "for kai_scheduler_tpu (docs/STATIC_ANALYSIS.md); "
+                    "runs on the kailint engine chassis")
+    ap.add_argument("paths", nargs="*",
+                    help="files or directories (default: the whole "
+                         "kai_scheduler_tpu package)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline file (default: nearest {BASELINE_NAME})")
+    ap.add_argument("--no-baseline", action="store_true")
+    ap.add_argument("--write-baseline", action="store_true")
+    ap.add_argument("--select", default=None,
+                    help="comma-separated rule ids to run (e.g. KRC002)")
+    ap.add_argument("--ignore", default=None)
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--lock-graph", action="store_true",
+                    help="print the static lock acquisition graph as "
+                         "JSON (locks, creation sites, order edges) and "
+                         "exit — the KAI_LOCKTRACE validator's contract")
+    ap.add_argument("--roles", action="store_true",
+                    help="print the thread-role table (role -> entry "
+                         "points) and single-writer annotations as JSON")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for cls in RULE_CLASSES:
+            print(f"{cls.id}  {cls.name:<22} {cls.description}")
+        return 0
+    paths = args.paths or [package_root()]
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"error: no such path: {p}", file=sys.stderr)
+            return 2
+    if args.lock_graph or args.roles:
+        payload = lock_graph(paths) if args.lock_graph \
+            else role_table(paths)
+        print(json.dumps(payload, indent=2))
+        return 2 if payload["errors"] else 0
+
+    known = {cls.id.upper() for cls in RULE_CLASSES}
+    filters = {}
+    for flag, spec in (("--select", args.select),
+                       ("--ignore", args.ignore)):
+        if spec is None:
+            filters[flag] = None
+            continue
+        ids = {tok.strip().upper() for tok in spec.split(",")
+               if tok.strip()}
+        unknown = ids - known
+        if unknown:
+            print(f"error: unknown rule id(s) for {flag}: "
+                  f"{', '.join(sorted(unknown))} (see --list-rules)",
+                  file=sys.stderr)
+            return 2
+        filters[flag] = ids
+    select, ignore = filters["--select"], filters["--ignore"]
+    engine = build_engine(select=select, ignore=ignore)
+
+    baseline_path = args.baseline or _default_baseline_path(paths)
+    if args.write_baseline:
+        if select or ignore:
+            print("error: --write-baseline cannot be combined with "
+                  "--select/--ignore (it would overwrite the other "
+                  "rules' baseline entries)", file=sys.stderr)
+            return 2
+        report = engine.run(paths, baseline=None)
+        if report.errors:
+            for err in report.errors:
+                print(f"kairace: parse error: {err}", file=sys.stderr)
+            print("error: refusing to write a baseline from a partial "
+                  "scan (fix the parse errors first)", file=sys.stderr)
+            return 2
+        n = write_baseline(baseline_path, report.findings,
+                           tool="kairace")
+        print(f"kairace: wrote {n} baseline entr"
+              f"{'y' if n == 1 else 'ies'} to {baseline_path}")
+        return 0
+
+    try:
+        baseline = {} if args.no_baseline else \
+            load_baseline(baseline_path, tool="kairace")
+        report = engine.run(paths, baseline=baseline)
+    except (OSError, ValueError, KeyError) as exc:
+        print(f"kairace: error: {exc}", file=sys.stderr)
+        return 2
+
+    if args.format == "json":
+        print(json.dumps(report.to_dict(), indent=2))
+        return report.exit_code
+
+    for f in report.findings:
+        print(f.render())
+    for err in report.errors:
+        print(f"kairace: parse error: {err}", file=sys.stderr)
+    summary = (f"kairace: {len(report.findings)} new finding(s), "
+               f"{len(report.baselined)} baselined, "
+               f"{report.suppressed} suppressed, "
+               f"{report.files} file(s)")
+    if report.stale_baseline:
+        summary += (f", {len(report.stale_baseline)} stale baseline "
+                    f"entr{'y' if len(report.stale_baseline) == 1 else 'ies'}"
+                    f" (fixed — prune with --write-baseline)")
+    print(summary)
+    return report.exit_code

@@ -1,0 +1,977 @@
+"""kairace whole-program model: thread roles, lock scopes, access facts.
+
+This is the analysis substrate under the KRC rules (``rules.py``).  One
+pass over every module builds a :class:`Program`:
+
+- **Functions** — every def/method/nested def/lambda gets a ``FuncId``
+  ``(module path, class name or None, qualified name)`` and a scan of
+  its *executed* body (nested function bodies are deferred code and
+  belong to their own FuncId).
+
+- **Thread roles** — entry points are discovered statically:
+  ``threading.Thread(target=...)``, ``<executor>.submit(fn)``,
+  ``watch``/``watch_any``/``watch_sync``/``on_resync``/``on_drain_idle``
+  hook registrations, and ``BaseHTTPRequestHandler`` subclasses.  A
+  *runs-on* set then propagates over the call graph to a fixpoint;
+  functions with no in-tree callers and no entry seed run on ``main``.
+
+- **Lock scopes** — the shared collector (``kailint/lockscope.py``)
+  names every synchronization attribute by TYPE, honors
+  ``Condition(lock)`` aliasing, and canonical lock names
+  (``Class.attr`` / ``module.GLOBAL``) make guard sets comparable
+  program-wide.  Guard sets are **interprocedural**: a function called
+  only from inside ``with self._control_lock:`` blocks inherits that
+  guard (the meet over its call sites), so the operator's
+  control-epilogue discipline is visible to the rules without lexical
+  locks in every callee.
+
+- **Acquisition order** — every acquisition records edges from each
+  already-held lock (lexical + inherited + transitively via callees),
+  giving the static lock graph that KRC002 cycles over and the
+  ``KAI_LOCKTRACE`` runtime validator (``utils/locktrace.py``) checks
+  observed orders against.
+
+Single-writer annotations: ``# kairace: single-writer=<role>[,<role>]``
+on (or immediately above) a ``self.attr = ...`` assignment declares the
+only roles allowed to mutate that field after ``__init__``; KRC003
+enforces the declaration and KRC001/4/5 defer to it.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from dataclasses import dataclass, field
+
+from ..kailint.astutil import dotted_name, resolve_relative_import
+from ..kailint.lockscope import (ModuleLocks, collect_module_locks,
+                                 lockish_name)
+
+FuncId = tuple  # (module path, class name | None, qualified func name)
+
+SPAWN_THREAD_CTORS = {"threading.Thread", "Thread", "threading.Timer",
+                      "Timer"}
+HOOK_METHODS = {"watch", "watch_any", "watch_sync", "on_resync",
+                "on_drain_idle"}
+HTTP_HANDLER_BASES = {"BaseHTTPRequestHandler",
+                      "http.server.BaseHTTPRequestHandler",
+                      "SimpleHTTPRequestHandler"}
+
+# Method names that mutate the receiver container in place.
+MUTATOR_METHODS = {"append", "add", "update", "pop", "popitem", "clear",
+                   "extend", "remove", "discard", "insert", "setdefault",
+                   "sort", "reverse"}
+
+# Names excluded from unique-method-name call resolution: shadowed by
+# builtin container/IO/threading methods, so `self._inflight.get(...)`
+# never resolves to some in-tree class's `get`.
+CHA_BLOCKLIST = {
+    "get", "put", "set", "add", "pop", "run", "join", "wait", "send",
+    "read", "write", "close", "open", "start", "stop", "items", "keys",
+    "values", "append", "extend", "update", "clear", "copy", "sort",
+    "reverse", "index", "count", "split", "strip", "seek", "flush",
+    "remove", "discard", "insert", "setdefault", "popitem", "popleft",
+    "appleft", "appendleft", "acquire", "release", "notify", "notify_all",
+    "wait_for", "is_set", "cancel", "encode", "decode", "format",
+    "search", "match", "sub", "findall", "group", "dump", "dumps",
+    "load", "loads", "next", "submit", "result", "done", "empty",
+    "qsize", "task_done", "get_nowait", "put_nowait", "list", "dict",
+    "keys", "exists", "mkdir", "name", "kind", "path",
+}
+
+ANNOTATION_RE = re.compile(
+    r"#\s*kairace:\s*single-writer\s*=\s*"
+    r"(?P<roles>[A-Za-z0-9_.\-]+(?:\s*,\s*[A-Za-z0-9_.\-]+)*)")
+
+MAIN_ROLE = "main"
+HOOK_ROLE = "hook"
+HTTP_ROLE = "http-handler"
+EXECUTOR_ROLE = "executor"
+
+
+@dataclass
+class Access:
+    """One field read/write: ``target`` is ``(class, attr)`` for
+    instance fields or ``("<module stem>", name)`` for globals."""
+    kind: str            # read | write
+    write_kind: str      # "" | bind | aug | item | mutcall | deep | del
+    target: tuple
+    func: FuncId
+    path: str
+    line: int
+    col: int
+    lexical_guards: frozenset
+    in_init: bool
+
+
+@dataclass
+class CallSite:
+    caller: FuncId
+    callee: FuncId
+    line: int
+    lexical_held: frozenset
+
+
+@dataclass
+class Spawn:
+    """Thread/executor/hook entry point discovered at a call site."""
+    role: str
+    target: FuncId | None   # None: external callable (serve_forever)
+    path: str
+    line: int
+    func: FuncId            # function containing the spawn site
+    self_attr_args: tuple   # bare `self.<attr>` positional args (KRC005)
+    kind: str               # thread | submit | hook
+
+
+@dataclass
+class FuncInfo:
+    fid: FuncId
+    node: ast.AST
+    path: str
+    cls: str | None
+    is_init: bool
+
+
+@dataclass
+class Program:
+    functions: dict = field(default_factory=dict)     # FuncId -> FuncInfo
+    calls: list = field(default_factory=list)         # [CallSite]
+    accesses: list = field(default_factory=list)      # [Access]
+    spawns: list = field(default_factory=list)        # [Spawn]
+    # (class, attr) -> declared single-writer role set
+    annotations: dict = field(default_factory=dict)
+    # (class, attr) -> (path, line) of the annotation (for KRC003 msgs)
+    annotation_sites: dict = field(default_factory=dict)
+    # canonical lock name -> [(path, line)] creation sites
+    lock_sites: dict = field(default_factory=dict)
+    # acquisition-order edges: (held, acquired) -> (path, line) sample
+    order_edges: dict = field(default_factory=dict)
+    # FuncId -> runs-on role set (after propagation)
+    roles: dict = field(default_factory=dict)
+    # FuncId -> interprocedurally inherited guard set H(f)
+    inherited_guards: dict = field(default_factory=dict)
+    # class name -> module path (first definition wins)
+    class_module: dict = field(default_factory=dict)
+    # per-class excluded attrs (locks/events/queues — sync primitives)
+    sync_attrs: dict = field(default_factory=dict)
+    # (class, attr) -> True when assigned a mutable container literal
+    mutable_fields: dict = field(default_factory=dict)
+
+    def guards_at(self, access: Access) -> frozenset:
+        return access.lexical_guards | self.inherited_guards.get(
+            access.func, frozenset())
+
+    def roles_of(self, fid: FuncId) -> frozenset:
+        return self.roles.get(fid, frozenset((MAIN_ROLE,)))
+
+
+def _comment_lines(source: str) -> dict[int, str]:
+    out: dict[int, str] = {}
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                out[tok.start[0]] = tok.string
+    except (tokenize.TokenError, IndentationError):
+        for i, raw in enumerate(source.splitlines(), 1):
+            if "#" in raw:
+                out[i] = raw
+    return out
+
+
+def _mod_stem(path: str) -> str:
+    base = path.rsplit("/", 1)[-1]
+    return base[:-3] if base.endswith(".py") else base
+
+
+class _ModuleFacts:
+    """Per-module resolution state built before body scanning."""
+
+    def __init__(self, path: str, tree: ast.Module, source: str):
+        self.path = path
+        self.tree = tree
+        self.source = source
+        self.stem = _mod_stem(path)
+        self.module_name = path[:-3].replace("/", ".") \
+            if path.endswith(".py") else path.replace("/", ".")
+        self.locks: ModuleLocks | None = None    # filled in pass 2
+        # alias -> (module_name, symbol) for `from X import y [as a]`
+        self.imports: dict[str, tuple] = {}
+        # alias -> module_name for `import X [as a]`
+        self.module_imports: dict[str, str] = {}
+        # class name -> {method name -> FuncId}
+        self.class_methods: dict[str, dict] = {}
+        # top-level function name -> FuncId
+        self.module_funcs: dict = {}
+        # classes whose methods run on the http-handler role
+        self.handler_classes: set = set()
+        # (class, attr) -> lambda FuncId  (self.x = lambda ...)
+        self.attr_lambdas: dict = {}
+        self.comments = _comment_lines(source)
+
+    def collect_imports(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.ImportFrom):
+                mod = resolve_relative_import(self.module_name, node)
+                if mod is None:
+                    continue
+                for alias in node.names:
+                    self.imports[alias.asname or alias.name] = \
+                        (mod, alias.name)
+            elif isinstance(node, ast.Import):
+                for alias in node.names:
+                    self.module_imports[alias.asname or alias.name] = \
+                        alias.name
+
+
+class ProgramBuilder:
+    def __init__(self, modules: list):
+        """``modules``: [(path, tree, source)]."""
+        self.program = Program()
+        self.mods = [_ModuleFacts(p, t, s) for p, t, s in modules]
+        self.by_module_name = {m.module_name: m for m in self.mods}
+        # global name tables
+        self.all_classes: dict[str, _ModuleFacts] = {}
+        # method name -> [(class, FuncId)] for unique-name resolution
+        self.methods_by_name: dict[str, list] = {}
+
+    # -- pass 1: declarations ---------------------------------------------
+    def _index_functions(self, mod: _ModuleFacts) -> None:
+        prog = self.program
+
+        def qual(parts: list[str]) -> str:
+            return ".".join(parts)
+
+        def visit(node, cls: str | None, prefix: list[str]) -> None:
+            if isinstance(node, ast.ClassDef):
+                self.all_classes.setdefault(node.name, mod)
+                prog.class_module.setdefault(node.name, mod.path)
+                mod.class_methods.setdefault(node.name, {})
+                if any((dotted_name(b) or "").split(".")[-1]
+                       in {b.split(".")[-1] for b in HTTP_HANDLER_BASES}
+                       for b in node.bases):
+                    mod.handler_classes.add(node.name)
+                for child in ast.iter_child_nodes(node):
+                    visit(child, node.name, prefix + [node.name])
+                return
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fid = (mod.path, cls, qual(prefix + [node.name]))
+                prog.functions[fid] = FuncInfo(
+                    fid, node, mod.path, cls,
+                    is_init=node.name in ("__init__", "__post_init__"))
+                if cls is not None and len(prefix) >= 1 and \
+                        prefix[-1] == cls:
+                    mod.class_methods[cls][node.name] = fid
+                    self.methods_by_name.setdefault(node.name, []) \
+                        .append((cls, fid))
+                elif cls is None and not prefix:
+                    mod.module_funcs[node.name] = fid
+                for child in ast.iter_child_nodes(node):
+                    visit(child, cls, prefix + [node.name])
+                return
+            for child in ast.iter_child_nodes(node):
+                visit(child, cls, prefix)
+
+        visit(mod.tree, None, [])
+
+    # -- lock naming --------------------------------------------------------
+    def canonical_lock(self, mod: _ModuleFacts, cls: str | None,
+                       node: ast.AST) -> str | None:
+        """Canonical program-wide name for a lock expression; None when
+        the expression is not a lock.  Unresolvable lockish expressions
+        get an opaque ``?dotted`` name (distinct, excluded from cycle
+        detection)."""
+        locks = mod.locks
+        if isinstance(node, ast.Attribute):
+            base = node.value
+            if isinstance(base, ast.Name) and base.id == "self" and cls:
+                decl = locks.class_locks.get(cls, {}).get(node.attr)
+                if decl is not None:
+                    return f"{cls}.{locks.resolve_alias(cls, node.attr)}"
+                if node.attr in locks.class_events.get(cls, set()):
+                    return None
+                if lockish_name(node):
+                    return f"{cls}.{node.attr}"
+                return None
+            if isinstance(base, ast.Attribute) and \
+                    isinstance(base.value, ast.Name) and \
+                    base.value.id == "self" and cls:
+                owner = locks.attr_classes.get(cls, {}).get(base.attr)
+                if owner:
+                    omod = self.all_classes.get(owner, mod)
+                    odecl = (omod.locks or locks).class_locks.get(
+                        owner, {}).get(node.attr)
+                    if odecl is not None:
+                        return f"{owner}." + \
+                            (omod.locks or locks).resolve_alias(
+                                owner, node.attr)
+        elif isinstance(node, ast.Name):
+            decl = locks.module_locks.get(node.id)
+            if decl is not None:
+                return f"{mod.stem}.{node.id}"
+            if node.id in locks.module_events:
+                return None
+            if lockish_name(node):
+                return f"?{mod.stem}.{node.id}"
+            return None
+        if lockish_name(node):
+            return f"?{dotted_name(node) or 'lock'}"
+        return None
+
+    # -- call resolution ----------------------------------------------------
+    def resolve_call(self, mod: _ModuleFacts, cls: str | None,
+                     scope_funcs: dict, func: ast.AST) -> FuncId | None:
+        """Best-effort static callee for a Call's func expression."""
+        if isinstance(func, ast.Name):
+            name = func.id
+            if name in scope_funcs:
+                return scope_funcs[name]
+            if name in mod.module_funcs:
+                return mod.module_funcs[name]
+            if name in mod.imports:
+                imod_name, symbol = mod.imports[name]
+                imod = self.by_module_name.get(imod_name)
+                if imod is not None:
+                    if symbol in imod.module_funcs:
+                        return imod.module_funcs[symbol]
+                    if symbol in imod.class_methods:
+                        return imod.class_methods[symbol].get("__init__")
+            if name in self.all_classes:
+                owner = self.all_classes[name]
+                return owner.class_methods.get(name, {}).get("__init__")
+            return None
+        if isinstance(func, ast.Attribute):
+            base = func.value
+            meth = func.attr
+            if isinstance(base, ast.Name):
+                if base.id == "self" and cls is not None:
+                    if meth in mod.class_methods.get(cls, {}):
+                        return mod.class_methods[cls][meth]
+                    lam = mod.attr_lambdas.get((cls, meth))
+                    if lam is not None:
+                        return lam
+                    # typed attr: self.api.create -> class method
+                if base.id in self.all_classes:
+                    owner = self.all_classes[base.id]
+                    return owner.class_methods.get(base.id, {}).get(meth)
+                if base.id in mod.module_imports:
+                    imod = self.by_module_name.get(
+                        mod.module_imports[base.id])
+                    if imod is not None and meth in imod.module_funcs:
+                        return imod.module_funcs[meth]
+            if isinstance(base, ast.Attribute) and \
+                    isinstance(base.value, ast.Name) and \
+                    base.value.id == "self" and cls is not None:
+                owner = (mod.locks.attr_classes.get(cls, {})
+                         .get(base.attr)) if mod.locks else None
+                if owner:
+                    omod = self.all_classes.get(owner)
+                    if omod is not None:
+                        return omod.class_methods.get(owner, {}).get(meth)
+            # unique-method-name resolution with a stdlib-shadow blocklist
+            if meth not in CHA_BLOCKLIST and len(meth) >= 4:
+                cands = self.methods_by_name.get(meth, [])
+                if len(cands) == 1:
+                    return cands[0][1]
+        return None
+
+    def resolve_callable_ref(self, mod: _ModuleFacts, cls: str | None,
+                             scope_funcs: dict,
+                             node: ast.AST) -> FuncId | None:
+        """A callable passed by reference (thread target, hook cb)."""
+        if isinstance(node, ast.Lambda):
+            return None  # handled by the caller (synthetic FuncId)
+        if isinstance(node, ast.Name):
+            if node.id in scope_funcs:
+                return scope_funcs[node.id]
+            return mod.module_funcs.get(node.id)
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id == "self" and cls is not None:
+            fid = mod.class_methods.get(cls, {}).get(node.attr)
+            if fid is not None:
+                return fid
+            return mod.attr_lambdas.get((cls, node.attr))
+        return None
+
+    # -- pass 2: body scan --------------------------------------------------
+    def _scan_module(self, mod: _ModuleFacts) -> None:
+        prog = self.program
+        # single-writer annotations: comment line -> next assignment
+        pending_annot: dict[int, frozenset] = {}
+        for lineno, comment in mod.comments.items():
+            m = ANNOTATION_RE.search(comment)
+            if m:
+                roles = frozenset(r.strip() for r in
+                                  m.group("roles").split(",") if r.strip())
+                pending_annot[lineno] = roles
+
+        def note_annotation(cls, attr, lineno):
+            # annotation on the same line, or standalone on the line above
+            roles = pending_annot.get(lineno) or pending_annot.get(
+                lineno - 1)
+            if roles:
+                prog.annotations[(cls, attr)] = roles
+                prog.annotation_sites[(cls, attr)] = (mod.path, lineno)
+
+        # lock creation sites for the runtime validator's site map
+        for cls_name, attrs in (mod.locks.class_locks or {}).items():
+            for attr, decl in attrs.items():
+                base = mod.locks.resolve_alias(cls_name, attr)
+                if base == attr:  # aliases map to their base lock
+                    prog.lock_sites.setdefault(
+                        f"{cls_name}.{attr}", []).append(
+                        (mod.path, decl.line))
+                else:
+                    prog.lock_sites.setdefault(
+                        f"{cls_name}.{base}", []).append(
+                        (mod.path, decl.line))
+        for name, decl in mod.locks.module_locks.items():
+            prog.lock_sites.setdefault(
+                f"{mod.stem}.{name}", []).append((mod.path, decl.line))
+        for cls_name in mod.locks.class_locks:
+            prog.sync_attrs.setdefault(cls_name, set()).update(
+                mod.locks.class_locks[cls_name])
+        for cls_name, attrs in mod.locks.class_events.items():
+            prog.sync_attrs.setdefault(cls_name, set()).update(attrs)
+
+        for fid, info in list(prog.functions.items()):
+            if info.path != mod.path:
+                continue
+            self._scan_function(mod, info, note_annotation)
+
+    def _scan_function(self, mod: _ModuleFacts, info: FuncInfo,
+                       note_annotation) -> None:
+        prog = self.program
+        cls = info.cls
+        fid = info.fid
+        # nested defs visible by name from this body
+        scope_funcs = {}
+        for child_fid, child in prog.functions.items():
+            if child.path == mod.path and child.cls == cls and \
+                    child_fid[2].startswith(fid[2] + ".") and \
+                    child_fid[2].count(".") == fid[2].count(".") + 1:
+                scope_funcs[child_fid[2].rsplit(".", 1)[-1]] = child_fid
+        body = (info.node.body
+                if isinstance(info.node, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef))
+                else [info.node.body])
+        lambda_count = [0]
+        skip_loads: set = set()
+
+        def self_attr(node) -> str | None:
+            if isinstance(node, ast.Attribute) and \
+                    isinstance(node.value, ast.Name) and \
+                    node.value.id == "self":
+                # `self.__dict__.setdefault(...)` is the frozen-dataclass
+                # memoization idiom (GIL-atomic, benign duplicate build),
+                # not a shared field.
+                if node.attr.startswith("__") and node.attr.endswith("__"):
+                    return None
+                return node.attr
+            return None
+
+        def is_sync_attr(attr: str) -> bool:
+            if cls is None:
+                return True
+            if attr in prog.sync_attrs.get(cls, set()):
+                return True
+            # method references (`self._worker`, `self.flush`) are not
+            # data fields
+            return attr in mod.class_methods.get(cls, {})
+
+        def record_access(kind, write_kind, target, node, held):
+            prog.accesses.append(Access(
+                kind=kind, write_kind=write_kind, target=target,
+                func=fid, path=mod.path, line=node.lineno,
+                col=getattr(node, "col_offset", 0),
+                lexical_guards=frozenset(held),
+                in_init=info.is_init))
+
+        def global_names() -> set:
+            out = set()
+            for n in ast.walk(info.node):
+                if isinstance(n, ast.Global):
+                    out.update(n.names)
+            return out
+
+        func_globals = global_names() if not isinstance(
+            info.node, ast.Lambda) else set()
+
+        def handle_spawn(call: ast.Call, held) -> bool:
+            """Thread()/submit()/hook-registration detection."""
+            name = dotted_name(call.func) or ""
+            leafattr = call.func.attr if isinstance(call.func,
+                                                    ast.Attribute) else name
+            target_node = None
+            kind = None
+            if name in SPAWN_THREAD_CTORS:
+                kind = "thread"
+                for kw in call.keywords:
+                    if kw.arg == "target":
+                        target_node = kw.value
+                if name.endswith("Timer") and target_node is None and \
+                        len(call.args) >= 2:
+                    target_node = call.args[1]
+            elif leafattr == "submit" and call.args:
+                kind = "submit"
+                target_node = call.args[0]
+            elif leafattr in HOOK_METHODS:
+                kind = "hook"
+                # callback is whichever arg resolves to a callable
+                for arg in call.args:
+                    if isinstance(arg, ast.Lambda) or \
+                            self.resolve_callable_ref(
+                                mod, cls, scope_funcs, arg) is not None:
+                        target_node = arg
+                        break
+                if target_node is None:
+                    return False
+            if kind is None:
+                return False
+            role = None
+            if kind == "thread":
+                for kw in call.keywords:
+                    if kw.arg == "name" and \
+                            isinstance(kw.value, ast.Constant) and \
+                            isinstance(kw.value.value, str):
+                        role = kw.value.value
+            target_fid = None
+            if isinstance(target_node, ast.Lambda):
+                lambda_count[0] += 1
+                target_fid = (mod.path, cls,
+                              f"{fid[2]}.<lambda{target_node.lineno}>")
+                prog.functions[target_fid] = FuncInfo(
+                    target_fid, target_node, mod.path, cls, is_init=False)
+                self._scan_function(mod, prog.functions[target_fid],
+                                    note_annotation)
+            elif target_node is not None:
+                target_fid = self.resolve_callable_ref(
+                    mod, cls, scope_funcs, target_node)
+            if role is None:
+                if kind == "hook":
+                    role = HOOK_ROLE
+                elif kind == "submit":
+                    role = EXECUTOR_ROLE
+                elif target_fid is not None:
+                    tcls = prog.functions[target_fid].cls
+                    leaf = target_fid[2].rsplit(".", 1)[-1]
+                    role = f"{tcls}.{leaf}" if tcls else \
+                        f"{_mod_stem(target_fid[0])}.{leaf}"
+                elif target_node is not None:
+                    leaf = (dotted_name(target_node) or "thread") \
+                        .rsplit(".", 1)[-1]
+                    role = leaf.lstrip("_") or "thread"
+                else:
+                    role = "thread"
+            args_attrs = tuple(
+                a for a in (self_attr(arg) for arg in call.args)
+                if a is not None)
+            # Thread(..., args=(self.x,)) publication
+            for kw in call.keywords:
+                if kw.arg == "args" and isinstance(kw.value,
+                                                   (ast.Tuple, ast.List)):
+                    args_attrs += tuple(
+                        a for a in (self_attr(e) for e in kw.value.elts)
+                        if a is not None)
+            prog.spawns.append(Spawn(
+                role=role, target=target_fid, path=mod.path,
+                line=call.lineno, func=fid,
+                self_attr_args=args_attrs, kind=kind))
+            return True
+
+        def scan(node, held: tuple) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return  # own FuncId; scanned separately
+            if isinstance(node, ast.Lambda):
+                # un-spawned lambda: body executes on the enclosing
+                # function's role eventually — fold its accesses/calls
+                # into this function, with NO inherited held locks.
+                for child in ast.iter_child_nodes(node):
+                    scan(child, ())
+                return
+            if isinstance(node, ast.With):
+                names = []
+                for item in node.items:
+                    lname = self.canonical_lock(mod, cls,
+                                                item.context_expr)
+                    if lname is not None:
+                        names.append(lname)
+                        for h in held:
+                            if h != lname:
+                                prog.order_edges.setdefault(
+                                    (h, lname),
+                                    (mod.path, item.context_expr.lineno))
+                    scan(item.context_expr, held)
+                inner = held + tuple(n for n in names if n not in held)
+                for stmt in node.body:
+                    scan(stmt, inner)
+                return
+            if isinstance(node, ast.Assign):
+                scan(node.value, held)
+                for target in node.targets:
+                    attr = self_attr(target)
+                    if attr is not None and cls is not None:
+                        if isinstance(node.value, ast.Lambda):
+                            lambda_count[0] += 1
+                            lam_fid = (mod.path, cls,
+                                       f"{fid[2]}.<lambda{node.lineno}>")
+                            prog.functions[lam_fid] = FuncInfo(
+                                lam_fid, node.value, mod.path, cls,
+                                is_init=False)
+                            mod.attr_lambdas[(cls, attr)] = lam_fid
+                            self._scan_function(
+                                mod, prog.functions[lam_fid],
+                                note_annotation)
+                        note_annotation(cls, attr, node.lineno)
+                        if not is_sync_attr(attr):
+                            if isinstance(node.value, (ast.Dict, ast.List,
+                                                       ast.Set,
+                                                       ast.ListComp,
+                                                       ast.DictComp,
+                                                       ast.SetComp)):
+                                prog.mutable_fields[(cls, attr)] = True
+                            elif isinstance(node.value, ast.Call):
+                                ctor = dotted_name(node.value.func) or ""
+                                if ctor.split(".")[-1] in ("dict", "list",
+                                                           "set",
+                                                           "defaultdict",
+                                                           "OrderedDict"):
+                                    prog.mutable_fields[(cls, attr)] = True
+                            record_access("write", "bind", (cls, attr),
+                                          target, held)
+                        continue
+                    # self.a.b = v / self.a[k] = v mutate the object in a
+                    if isinstance(target, ast.Attribute):
+                        inner = self_attr(target.value)
+                        if inner is not None and cls is not None and \
+                                not is_sync_attr(inner):
+                            record_access("write", "deep", (cls, inner),
+                                          target, held)
+                            skip_loads.add(id(target.value))
+                    elif isinstance(target, ast.Subscript):
+                        inner = self_attr(target.value)
+                        if inner is not None and cls is not None and \
+                                not is_sync_attr(inner):
+                            record_access("write", "item", (cls, inner),
+                                          target, held)
+                            skip_loads.add(id(target.value))
+                        else:
+                            scan(target, held)
+                    elif isinstance(target, ast.Name):
+                        if target.id in func_globals:
+                            record_access("write", "bind",
+                                          (mod.stem, target.id),
+                                          target, held)
+                    elif isinstance(target, (ast.Tuple, ast.List)):
+                        # `a, self.x = ...` tuple unpacking: each elt is
+                        # its own Store target — a rebinding of a field
+                        # hides here just as well as in a plain Assign.
+                        for elt in target.elts:
+                            eattr = self_attr(elt)
+                            if eattr is not None and cls is not None and \
+                                    not is_sync_attr(eattr):
+                                record_access("write", "bind",
+                                              (cls, eattr), elt, held)
+                            elif isinstance(elt, ast.Name) and \
+                                    elt.id in func_globals:
+                                record_access("write", "bind",
+                                              (mod.stem, elt.id),
+                                              elt, held)
+                            elif isinstance(elt, ast.Subscript):
+                                inner = self_attr(elt.value)
+                                if inner is not None and cls is not None \
+                                        and not is_sync_attr(inner):
+                                    record_access("write", "item",
+                                                  (cls, inner), elt, held)
+                                    skip_loads.add(id(elt.value))
+                                else:
+                                    scan(elt, held)
+                            else:
+                                scan(elt, held)
+                return
+            if isinstance(node, ast.AugAssign):
+                scan(node.value, held)
+                attr = self_attr(node.target)
+                if attr is not None and cls is not None and \
+                        not is_sync_attr(attr):
+                    record_access("write", "aug", (cls, attr),
+                                  node.target, held)
+                    record_access("read", "", (cls, attr),
+                                  node.target, held)
+                elif isinstance(node.target, ast.Name) and \
+                        node.target.id in func_globals:
+                    record_access("write", "aug",
+                                  (mod.stem, node.target.id),
+                                  node.target, held)
+                elif isinstance(node.target, ast.Subscript):
+                    inner = self_attr(node.target.value)
+                    if inner is not None and cls is not None and \
+                            not is_sync_attr(inner):
+                        record_access("write", "item", (cls, inner),
+                                      node.target, held)
+                return
+            if isinstance(node, ast.AnnAssign):
+                if node.value is not None:
+                    scan(node.value, held)
+                attr = self_attr(node.target)
+                if attr is not None and cls is not None and \
+                        not is_sync_attr(attr):
+                    note_annotation(cls, attr, node.lineno)
+                    record_access("write", "bind", (cls, attr),
+                                  node.target, held)
+                return
+            if isinstance(node, ast.Delete):
+                for target in node.targets:
+                    if isinstance(target, ast.Subscript):
+                        inner = self_attr(target.value)
+                        if inner is not None and cls is not None and \
+                                not is_sync_attr(inner):
+                            record_access("write", "item", (cls, inner),
+                                          target, held)
+                            skip_loads.add(id(target.value))
+                    attr = self_attr(target)
+                    if attr is not None and cls is not None and \
+                            not is_sync_attr(attr):
+                        record_access("write", "del", (cls, attr),
+                                      target, held)
+                for target in node.targets:
+                    scan(target, held)
+                return
+            if isinstance(node, ast.Call):
+                spawned = handle_spawn(node, held)
+                # receiver mutators: self.x.append(...)
+                if isinstance(node.func, ast.Attribute):
+                    recv = node.func.value
+                    attr = self_attr(recv)
+                    if attr is not None and cls is not None and \
+                            node.func.attr in MUTATOR_METHODS and \
+                            not is_sync_attr(attr):
+                        record_access("write", "mutcall", (cls, attr),
+                                      recv, held)
+                        skip_loads.add(id(recv))
+                callee = self.resolve_call(mod, cls, scope_funcs,
+                                           node.func)
+                if callee is not None and not spawned:
+                    prog.calls.append(CallSite(
+                        caller=fid, callee=callee, line=node.lineno,
+                        lexical_held=frozenset(held)))
+                for child in ast.iter_child_nodes(node):
+                    scan(child, held)
+                return
+            if isinstance(node, ast.Attribute) and \
+                    isinstance(node.ctx, ast.Load):
+                attr = self_attr(node)
+                if attr is not None and cls is not None and \
+                        id(node) not in skip_loads and \
+                        not is_sync_attr(attr):
+                    record_access("read", "", (cls, attr), node, held)
+                scan(node.value, held)
+                return
+            for child in ast.iter_child_nodes(node):
+                scan(child, held)
+
+        for stmt in body:
+            scan(stmt, ())
+
+    # -- pass 3: fixpoints --------------------------------------------------
+    def _propagate(self) -> None:
+        prog = self.program
+        callees_of: dict = {}
+        callers_of: dict = {}
+        for site in prog.calls:
+            if site.callee in prog.functions and \
+                    site.caller in prog.functions:
+                callees_of.setdefault(site.caller, []).append(site)
+                callers_of.setdefault(site.callee, []).append(site)
+
+        # roles -------------------------------------------------------------
+        seeded: dict = {}
+        for spawn in prog.spawns:
+            if spawn.target is not None and spawn.target in prog.functions:
+                seeded.setdefault(spawn.target, set()).add(spawn.role)
+        for mod in self.mods:
+            for cls in mod.handler_classes:
+                for fid in mod.class_methods.get(cls, {}).values():
+                    seeded.setdefault(fid, set()).add(HTTP_ROLE)
+        roles: dict = {fid: set(r) for fid, r in seeded.items()}
+        for fid in prog.functions:
+            if fid not in roles and fid not in callers_of:
+                roles[fid] = {MAIN_ROLE}
+        changed = True
+        while changed:
+            changed = False
+            for site in prog.calls:
+                src = roles.get(site.caller)
+                if not src or site.callee not in prog.functions:
+                    continue
+                dst = roles.setdefault(site.callee, set())
+                before = len(dst)
+                dst |= src
+                if len(dst) != before:
+                    changed = True
+        prog.roles = {fid: frozenset(r) for fid, r in roles.items()}
+
+        # inherited guards H(f) = meet over call sites ----------------------
+        universe = frozenset(prog.lock_sites) | frozenset(
+            l for edge in prog.order_edges for l in edge)
+        H: dict = {}
+        for fid in prog.functions:
+            if fid in seeded or fid not in callers_of:
+                H[fid] = frozenset()
+            else:
+                H[fid] = universe
+        changed = True
+        rounds = 0
+        while changed and rounds < 50:
+            changed = False
+            rounds += 1
+            for fid, sites in callers_of.items():
+                if fid in seeded:
+                    continue
+                met = None
+                for site in sites:
+                    eff = H.get(site.caller, frozenset()) | \
+                        site.lexical_held
+                    met = eff if met is None else (met & eff)
+                met = met if met is not None else frozenset()
+                if met != H.get(fid):
+                    H[fid] = met
+                    changed = True
+        prog.inherited_guards = H
+
+        # acquisition sets + interprocedural order edges --------------------
+        lex_acquires: dict = {fid: set() for fid in prog.functions}
+        for fid, info in prog.functions.items():
+            mod = next(m for m in self.mods if m.path == info.path)
+            acq = set()
+
+            def collect_with(node):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef,
+                                     ast.Lambda)) and node is not info.node:
+                    return
+                if isinstance(node, ast.With):
+                    for item in node.items:
+                        name = self.canonical_lock(mod, info.cls,
+                                                   item.context_expr)
+                        if name is not None:
+                            acq.add(name)
+                for child in ast.iter_child_nodes(node):
+                    collect_with(child)
+
+            collect_with(info.node)
+            lex_acquires[fid] = acq
+
+        A: dict = dict(lex_acquires)
+        changed = True
+        rounds = 0
+        while changed and rounds < 50:
+            changed = False
+            rounds += 1
+            for site in prog.calls:
+                if site.callee not in A or site.caller not in A:
+                    continue
+                before = len(A[site.caller])
+                A[site.caller] |= A[site.callee]
+                if len(A[site.caller]) != before:
+                    changed = True
+        for site in prog.calls:
+            eff_held = prog.inherited_guards.get(
+                site.caller, frozenset()) | site.lexical_held
+            for h in eff_held:
+                for m in A.get(site.callee, ()):
+                    if h != m:
+                        prog.order_edges.setdefault(
+                            (h, m), (site.caller[0], site.line))
+
+    def build(self) -> Program:
+        for mod in self.mods:
+            mod.collect_imports()
+            self._index_functions(mod)
+        known = set(self.all_classes)
+        for mod in self.mods:
+            mod.locks = collect_module_locks(mod.tree,
+                                             known_classes=known)
+        for mod in self.mods:
+            self._scan_module(mod)
+        self._propagate()
+        return self.program
+
+
+def build_program(modules: list) -> Program:
+    """``modules``: [(path, tree, source)] — the kairace pass-1 product."""
+    return ProgramBuilder(modules).build()
+
+
+def order_cycles(edges: dict) -> list:
+    """Cycles in the acquisition graph (KRC002): returns a list of
+    ``(cycle_locks, (path, line))`` — one entry per strongly connected
+    component with more than one node.  Opaque ``?``-named locks are
+    excluded (their identity is not established)."""
+    graph: dict = {}
+    for (a, b) in edges:
+        if a.startswith("?") or b.startswith("?"):
+            continue
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+    # Tarjan SCC
+    index_counter = [0]
+    stack: list = []
+    lowlink: dict = {}
+    index: dict = {}
+    on_stack: dict = {}
+    sccs: list = []
+
+    def strongconnect(v):
+        work = [(v, iter(sorted(graph[v])))]
+        index[v] = lowlink[v] = index_counter[0]
+        index_counter[0] += 1
+        stack.append(v)
+        on_stack[v] = True
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = lowlink[w] = index_counter[0]
+                    index_counter[0] += 1
+                    stack.append(w)
+                    on_stack[w] = True
+                    work.append((w, iter(sorted(graph[w]))))
+                    advanced = True
+                    break
+                elif on_stack.get(w):
+                    lowlink[node] = min(lowlink[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                lowlink[parent] = min(lowlink[parent], lowlink[node])
+            if lowlink[node] == index[node]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on_stack[w] = False
+                    scc.append(w)
+                    if w == node:
+                        break
+                if len(scc) > 1:
+                    sccs.append(sorted(scc))
+
+    for v in sorted(graph):
+        if v not in index:
+            strongconnect(v)
+    out = []
+    for scc in sccs:
+        # anchor at one edge inside the cycle
+        anchor = None
+        for (a, b), site in sorted(edges.items()):
+            if a in scc and b in scc:
+                anchor = site
+                break
+        out.append((scc, anchor or ("", 0)))
+    return out

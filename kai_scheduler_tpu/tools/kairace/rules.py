@@ -1,0 +1,292 @@
+"""kairace rule pack: the concurrency contracts, machine-enforced.
+
+| id     | name                   | contract                                |
+|--------|------------------------|-----------------------------------------|
+| KRC001 | multi-role-write       | a field written on >=2 thread roles     |
+|        |                        | shares a common lock across ALL writes  |
+| KRC002 | lock-order-inversion   | the static acquisition graph is acyclic |
+| KRC003 | single-writer          | `# kairace: single-writer=<role>`       |
+|        |                        | fields are mutated only on that role    |
+| KRC004 | guard-asymmetry        | if every read of a shared field is      |
+|        |                        | guarded, every write holds that lock too|
+| KRC005 | unguarded-publication  | mutable state handed to a thread/       |
+|        |                        | executor has a lock or is never mutated |
+
+All five run on the shared :class:`~.program.Program` index (built once
+per engine run and cached): pass 1 discovers thread roles and lock
+declarations, pass 2 maps lock scopes to the accesses they dominate,
+pass 3 (these rules' ``finalize``) reports contract violations.
+
+Write kinds covered: rebinding (``self.x = ...``), augmented assignment,
+item stores (``self.x[k] = v`` / ``del self.x[k]``), container mutator
+calls (``self.x.append(...)``), and sub-object attribute stores
+(``self.x.y = v``).  ``__init__`` writes are exempt (object construction
+happens-before any thread can see the instance).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..kailint.engine import Finding, ModuleContext, Rule
+from .program import (MAIN_ROLE, Program, build_program, order_cycles)
+
+
+class _ProgramRule(Rule):
+    """Base: collect module contexts; build (or reuse) the whole-program
+    index in finalize.  The index is cached per input fingerprint so the
+    five rules don't each re-run the three analysis passes."""
+
+    _cache: dict = {}   # class-level: fingerprint -> Program
+
+    def __init__(self):
+        self._modules: list = []
+
+    def collect(self, ctx: ModuleContext) -> None:
+        self._modules.append((ctx.path, ctx.tree, ctx.source))
+
+    def _program(self) -> Program:
+        key = tuple((path, hash(src)) for path, _t, src in self._modules)
+        cached = _ProgramRule._cache.get(key)
+        if cached is None:
+            cached = build_program(self._modules)
+            # single-slot cache: successive rule instances in ONE engine
+            # run share it; a new input set evicts the old program.
+            _ProgramRule._cache = {key: cached}
+        return cached
+
+    # helpers ---------------------------------------------------------------
+    def _finding_at(self, path: str, line: int, col: int,
+                    message: str, source_line: str = "",
+                    related: tuple = ()) -> Finding:
+        return Finding(rule=self.id, path=path, line=line, col=col,
+                       message=message, source=source_line,
+                       related=related)
+
+    def _line_of(self, path: str, line: int) -> str:
+        for p, _t, src in self._modules:
+            if p == path:
+                lines = src.splitlines()
+                if 1 <= line <= len(lines):
+                    return lines[line - 1].strip()
+        return ""
+
+
+def _fmt_roles(roles) -> str:
+    return ", ".join(sorted(roles))
+
+
+def _fmt_locks(locks) -> str:
+    return ", ".join(sorted(locks)) if locks else "no lock"
+
+
+def _field_table(prog: Program) -> dict:
+    """(class, attr) -> {"writes": [...], "reads": [...]} of non-init
+    accesses (plus init writes kept separately for container typing).
+
+    Mutator-method writes (``self.x.update(...)``) only count when the
+    field is KNOWN to hold a mutable container — `.update()` on an API
+    client or `.pop()` on a template object is a method call, not a
+    container mutation."""
+    table: dict = {}
+    for acc in prog.accesses:
+        if acc.kind == "write" and acc.write_kind == "mutcall" and \
+                not prog.mutable_fields.get(acc.target):
+            continue
+        entry = table.setdefault(acc.target, {"writes": [], "reads": [],
+                                              "init_writes": []})
+        if acc.kind == "write":
+            (entry["init_writes"] if acc.in_init
+             else entry["writes"]).append(acc)
+        elif not acc.in_init:
+            entry["reads"].append(acc)
+    return table
+
+
+class MultiRoleWriteRule(_ProgramRule):
+    id = "KRC001"
+    name = "multi-role-write"
+    description = ("field written on >=2 thread roles without a common "
+                   "lock across all writes")
+
+    def finalize(self) -> Iterator[Finding]:
+        prog = self._program()
+        for target, entry in sorted(_field_table(prog).items()):
+            if target in prog.annotations:
+                continue  # KRC003 enforces the declared contract instead
+            writes = entry["writes"]
+            if not writes:
+                continue
+            roles = set()
+            for w in writes:
+                roles |= prog.roles_of(w.func)
+            if len(roles) < 2:
+                continue
+            common = None
+            for w in writes:
+                g = prog.guards_at(w)
+                common = g if common is None else (common & g)
+            if common:
+                continue
+            worst = min(writes, key=lambda w: (len(prog.guards_at(w)),
+                                               w.path, w.line))
+            cls, attr = target
+            yield self._finding_at(
+                worst.path, worst.line, worst.col,
+                f"`{cls}.{attr}` is written on roles "
+                f"[{_fmt_roles(roles)}] with no common lock across its "
+                f"writes (this one holds {_fmt_locks(prog.guards_at(worst))})"
+                f" — guard every write with one lock, or declare the "
+                f"contract with `# kairace: single-writer=<role>`",
+                self._line_of(worst.path, worst.line),
+                related=tuple(sorted({(w.path, w.line) for w in writes
+                                      if w is not worst})))
+
+
+class LockOrderInversionRule(_ProgramRule):
+    id = "KRC002"
+    name = "lock-order-inversion"
+    description = "cycle in the static lock acquisition-order graph"
+
+    def finalize(self) -> Iterator[Finding]:
+        prog = self._program()
+        for cycle, (path, line) in order_cycles(prog.order_edges):
+            yield self._finding_at(
+                path or (self._modules[0][0] if self._modules else ""),
+                line or 1, 0,
+                f"lock-order inversion: [{' -> '.join(cycle)}] can be "
+                f"acquired in conflicting orders on different threads — "
+                f"pick one global order and refactor the inner "
+                f"acquisition out",
+                self._line_of(path, line) if path else "")
+
+
+class SingleWriterRule(_ProgramRule):
+    id = "KRC003"
+    name = "single-writer"
+    description = ("`# kairace: single-writer=<role>` field mutated off "
+                   "the declared role")
+
+    def finalize(self) -> Iterator[Finding]:
+        prog = self._program()
+        table = _field_table(prog)
+        for target, declared in sorted(prog.annotations.items()):
+            entry = table.get(target)
+            if entry is None:
+                continue
+            cls, attr = target
+            for w in entry["writes"]:
+                roles = prog.roles_of(w.func)
+                extra = roles - declared
+                if extra:
+                    yield self._finding_at(
+                        w.path, w.line, w.col,
+                        f"`{cls}.{attr}` is declared single-writer="
+                        f"{_fmt_roles(declared)} but this write also "
+                        f"runs on [{_fmt_roles(extra)}] — move the "
+                        f"mutation onto the owning role (queue/handoff) "
+                        f"or update the annotation",
+                        self._line_of(w.path, w.line))
+
+
+class GuardAsymmetryRule(_ProgramRule):
+    id = "KRC004"
+    name = "guard-asymmetry"
+    description = ("every read of a shared field is guarded but a write "
+                   "bypasses the lock")
+
+    def finalize(self) -> Iterator[Finding]:
+        prog = self._program()
+        for target, entry in sorted(_field_table(prog).items()):
+            if target in prog.annotations:
+                continue
+            writes, reads = entry["writes"], entry["reads"]
+            if not writes or not reads:
+                continue
+            roles = set()
+            for acc in writes + reads:
+                roles |= prog.roles_of(acc.func)
+            if len(roles) < 2:
+                continue
+            read_common = None
+            for r in reads:
+                g = prog.guards_at(r)
+                read_common = g if read_common is None \
+                    else (read_common & g)
+            if not read_common:
+                continue  # lock-free reads are the author's choice
+            # KRC001 already covers multi-role writes with no common
+            # lock — skip the whole field, not each write.
+            w_roles = set()
+            for w in writes:
+                w_roles |= prog.roles_of(w.func)
+            common_w = None
+            for w in writes:
+                g = prog.guards_at(w)
+                common_w = g if common_w is None else (common_w & g)
+            if len(w_roles) >= 2 and not common_w:
+                continue
+            cls, attr = target
+            for w in writes:
+                if prog.guards_at(w) & read_common:
+                    continue
+                yield self._finding_at(
+                    w.path, w.line, w.col,
+                    f"`{cls}.{attr}`: every read holds "
+                    f"[{_fmt_locks(read_common)}] but this write holds "
+                    f"{_fmt_locks(prog.guards_at(w))} — the readers' "
+                    f"lock protects nothing unless writers take it too",
+                    self._line_of(w.path, w.line))
+
+
+class UnguardedPublicationRule(_ProgramRule):
+    id = "KRC005"
+    name = "unguarded-publication"
+    description = ("mutable field handed to a thread/executor while "
+                   "also mutated without a lock")
+
+    def finalize(self) -> Iterator[Finding]:
+        prog = self._program()
+        table = _field_table(prog)
+        seen = set()
+        for spawn in prog.spawns:
+            for attr in spawn.self_attr_args:
+                fn = prog.functions.get(spawn.func)
+                cls = fn.cls if fn else None
+                if cls is None:
+                    continue
+                target = (cls, attr)
+                if target in prog.annotations or target in seen:
+                    continue
+                if not prog.mutable_fields.get(target):
+                    continue
+                entry = table.get(target)
+                if not entry:
+                    continue
+                unguarded = [w for w in entry["writes"]
+                             if not prog.guards_at(w)]
+                if not unguarded:
+                    continue
+                seen.add(target)
+                w = unguarded[0]
+                yield self._finding_at(
+                    spawn.path, spawn.line, 0,
+                    f"`{cls}.{attr}` (mutable) is handed to a "
+                    f"{spawn.kind} here but is mutated without a lock "
+                    f"at {w.path}:{w.line} — publish a snapshot/copy, "
+                    f"hand off through a queue, or lock both sides",
+                    self._line_of(spawn.path, spawn.line))
+
+
+RULE_CLASSES = [
+    MultiRoleWriteRule,       # KRC001
+    LockOrderInversionRule,   # KRC002
+    SingleWriterRule,         # KRC003
+    GuardAsymmetryRule,       # KRC004
+    UnguardedPublicationRule,  # KRC005
+]
+
+
+def default_rules() -> list:
+    return [cls() for cls in RULE_CLASSES]

@@ -85,6 +85,23 @@ class LeaseElector:
         self._observed_at = 0.0
         self._renew_thread: threading.Thread | None = None
         self._stop = threading.Event()
+        # Election-state lock: is_leader/epoch/_observed are written by
+        # BOTH the candidate (acquire/release, main thread) and the
+        # renewal loop (its own thread).  The phases mostly alternate,
+        # but release() only joins the loop with a TIMEOUT — a renew
+        # wedged in a slow API call can complete after release cleared
+        # the state, so the writes must serialize (kairace KRC001).  API
+        # round trips stay OUTSIDE the lock (KAI006).
+        self._state_lock = threading.Lock()
+        # Incarnation generation, bumped by every release(): a renew
+        # wedged in a slow API call can resume AFTER release cleared
+        # the state — and after a subsequent acquire() re-cleared
+        # _stop, so the stop flag alone cannot fence it out.  Late
+        # results carry the generation they started under and are
+        # dropped on mismatch (epoch adoption AND the old renewal
+        # loop itself, which must not keep running beside the new
+        # incarnation's).
+        self._gen = 0
         self.is_leader = False
         # Fencing epoch of our CURRENT leadership incarnation; 0 while
         # not leading.  Writes carrying an older epoch than the Lease's
@@ -104,15 +121,17 @@ class LeaseElector:
         spec = lease.get("spec", {})
         pair = (spec.get("holderIdentity"), spec.get("renewTime"))
         now = self.mono()
-        if self._observed != pair:
-            self._observed = pair
-            self._observed_at = now
-            return False
+        with self._state_lock:
+            if self._observed != pair:
+                self._observed = pair
+                self._observed_at = now
+                return False
         duration = float(spec.get("leaseDurationSeconds",
                                   self.lease_duration))
         return now - self._observed_at >= duration
 
     def try_acquire(self) -> bool:
+        gen = self._gen
         now = self.clock()
         spec = {"holderIdentity": self.identity,
                 "leaseDurationSeconds": self.lease_duration,
@@ -125,8 +144,7 @@ class LeaseElector:
                                  "metadata": {"name": self.name,
                                               "namespace": self.namespace},
                                  "spec": dict(spec, epoch=1)})
-                self.epoch = 1
-                return True
+                return self._adopt_epoch(1, gen)
             except Conflict:
                 return False
         # Work on a copy: mutating the store's own dict would bypass the
@@ -146,9 +164,28 @@ class LeaseElector:
         lease["spec"]["epoch"] = epoch
         try:
             self.api.update(lease)
-            self.epoch = epoch
-            return True
+            return self._adopt_epoch(epoch, gen)
         except (Conflict, NotFound):
+            return False
+
+    def _adopt_epoch(self, epoch: int, gen: int) -> bool:
+        """Record a won incarnation — UNLESS release() already ran: a
+        renew wedged in a slow API call can re-enter try_acquire after
+        the candidate stood down, and a resurrected epoch would let the
+        old incarnation's writes pass the fence.  (The store-side lease
+        then sits unrenewed until it expires, which is the normal
+        takeover path.)  The _stop check alone is not enough: a
+        release() + re-acquire() pair CLEARS _stop again, so the late
+        adoption also carries the generation its try_acquire started
+        under and is dropped if any release ran in between.  Returns
+        whether the epoch was adopted — a dropped adoption makes
+        try_acquire report False (the lease CAS landed, but WE are not
+        leading: nobody would renew it, and a True here would hand the
+        caller a leadership whose fenced writes all bounce on epoch 0)."""
+        with self._state_lock:
+            if gen == self._gen and not self._stop.is_set():
+                self.epoch = epoch
+                return True
             return False
 
     def renew(self) -> bool:
@@ -183,21 +220,48 @@ class LeaseElector:
         self._stop.clear()
         deadline = None if timeout is None else time.monotonic() + timeout
         while not self._stop.is_set():
+            gen = self._gen
             if self.try_acquire():
-                self.is_leader = True
-                self._start_renewal()
-                return True
+                with self._state_lock:
+                    if gen != self._gen or self._stop.is_set():
+                        # release() (the documented cross-thread stop
+                        # path) landed between our winning CAS and here:
+                        # the stand-down wins — reporting True would
+                        # hand back a leadership release() already
+                        # cleared (epoch 0, no renewal), and clearing
+                        # _stop below would erase the stop request.
+                        return False
+                    self.is_leader = True
+                # Same race, later window: release() can land between
+                # the locked is_leader write above and here.  Renewal
+                # only arms if the generation still matches — and a
+                # True with no renewal loop would be a dead leadership
+                # (is_leader already re-cleared, epoch 0), so the
+                # arming result IS the acquire result.
+                return self._start_renewal(gen)
             if deadline is not None and time.monotonic() >= deadline:
                 return False
             time.sleep(self._jittered(self.retry_period))
         return False
 
-    def _start_renewal(self) -> None:
-        self._stop.clear()
+    def _start_renewal(self, gen: int) -> bool:
+        """Arm the renewal loop for the incarnation won under ``gen``.
+        False when release() raced the acquisition (generation moved):
+        the stand-down wins, _stop stays set, no loop starts."""
+        with self._state_lock:
+            if gen != self._gen:
+                return False
+            self._stop.clear()
 
         def loop():
             last_success = time.monotonic()
             while not self._stop.wait(self._jittered(self.retry_period)):
+                if self._gen != gen:
+                    # release() + re-acquire() happened while this loop
+                    # slept or was wedged: the NEW incarnation has its
+                    # own renewal thread — this one must die, not renew
+                    # beside it.
+                    return
                 try:
                     ok = self.renew()
                 except TransientRenewError:
@@ -207,13 +271,21 @@ class LeaseElector:
                     if time.monotonic() - last_success < self.lease_duration:
                         continue
                     ok = False
+                if self._stop.is_set() or self._gen != gen:
+                    # release() ran while this renew was in flight: the
+                    # candidate already cleared the election state — a
+                    # late renew result must not touch it.
+                    return
                 if not ok:
-                    self.is_leader = False
+                    with self._state_lock:
+                        if self._gen == gen:
+                            self.is_leader = False
                     return
                 last_success = time.monotonic()
 
         self._renew_thread = threading.Thread(target=loop, daemon=True)
         self._renew_thread.start()
+        return True
 
     def release(self) -> None:
         """Stop renewing and hand the lease off immediately."""
@@ -230,5 +302,7 @@ class LeaseElector:
                     self.api.update(lease)
             except (NotFound, Conflict):
                 pass
-        self.is_leader = False
-        self.epoch = 0
+        with self._state_lock:
+            self._gen += 1
+            self.is_leader = False
+            self.epoch = 0

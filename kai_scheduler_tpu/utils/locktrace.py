@@ -1,0 +1,566 @@
+"""KAI_LOCKTRACE runtime lock-order validator.
+
+kairace (``tools/kairace/``) computes the STATIC lock acquisition graph
+— which lock is ever acquired while another is held, program-wide.  This
+shim records the DYNAMIC side: with ``KAI_LOCKTRACE=1``, the
+``threading`` lock factories are replaced with tracing proxies, and
+every real acquisition appends order edges (held-lock -> acquired-lock)
+to a process-wide journal.  ``chaos_matrix --races`` then checks the
+observed orders against the static graph:
+
+- an observed edge whose REVERSE is reachable in the static graph is a
+  **contradiction** — either the analyzer missed an acquisition path
+  (false negative) or an annotation/document rotted; both are bugs;
+- the per-subsystem acquisition counts prove the sweep actually
+  exercised each threaded component's locks (a validator that records
+  nothing validates nothing).
+
+Lock identity is the CREATION SITE (``file:line`` of the factory call),
+which is exactly what the static side exports per canonical lock name in
+``kairace --lock-graph`` (``locks: {name: [{file, line}]}``), so the two
+sides join without any runtime knowledge of attribute names.
+
+Env contract:
+
+- ``KAI_LOCKTRACE=1``       install the shim (tests/conftest.py honors
+                            this before any suite code creates locks)
+- ``KAI_LOCKTRACE_OUT``     dump the journal as JSON at process exit
+- ``KAI_LOCKTRACE_GRAPH``   path to a ``kairace --lock-graph`` JSON;
+                            when set, contradictions are detected ONLINE
+                            and counted live
+
+Metrics (``locktrace_orders_recorded_total``,
+``locktrace_contradictions_total``) are published via
+:func:`sync_metrics` — called from ``/healthz`` and the Prometheus
+render path, NEVER from inside an acquire (incrementing a counter takes
+the metrics registry's own lock, which is itself traced: the hot path
+must not re-enter it).
+"""
+
+from __future__ import annotations
+
+import _thread
+import atexit
+import json
+import os
+import sys
+import threading
+
+# Originals, captured at import time so install() can patch and
+# uninstall() can restore, and so the tracer's own internals never go
+# through the proxies.
+_REAL = {
+    "Lock": threading.Lock,
+    "RLock": threading.RLock,
+    "Condition": threading.Condition,
+    "Semaphore": threading.Semaphore,
+    "BoundedSemaphore": threading.BoundedSemaphore,
+}
+
+_PKG_MARKER = "kai_scheduler_tpu"
+
+
+def _relpath(path: str) -> str:
+    """Package-relative path, matching kailint's ``package_relative``
+    (so runtime sites join against static lock_sites keys)."""
+    path = path.replace(os.sep, "/")
+    idx = path.rfind(_PKG_MARKER + "/")
+    return path[idx:] if idx >= 0 else path
+
+
+def _creation_site() -> str:
+    """``file:line`` of the first frame outside this module and the
+    threading internals — the ``self._lock = threading.Lock()`` line."""
+    frame = sys._getframe(2)
+    here = __file__.replace(os.sep, "/")
+    while frame is not None:
+        fname = frame.f_code.co_filename.replace(os.sep, "/")
+        if not fname.endswith("threading.py") and fname != here:
+            return f"{_relpath(fname)}:{frame.f_lineno}"
+        frame = frame.f_back
+    return "<unknown>:0"
+
+
+def _internal_to_threading() -> bool:
+    """True when the factory was invoked from inside threading.py
+    itself — ``threading.Event()`` builds a ``Condition(Lock())``,
+    ``Thread`` builds its started-Event, ``Barrier`` its condition.
+    Those internals must NOT be traced: the frame walk above would
+    blame the USER'S ``self._stop = threading.Event()`` line, and
+    ``_site_name_map``'s +-2 fuzz then joins that site to an adjacent
+    real lock's canonical name — `event.wait()` would count as
+    acquisitions of (and order edges through) a lock that was never
+    touched: fake coverage for the --races gate and potential bogus
+    contradictions."""
+    frame = sys._getframe(2)  # the traced factory's caller
+    return frame is not None and \
+        frame.f_code.co_filename.replace(os.sep, "/") \
+             .endswith("threading.py")
+
+
+class LockTracer:
+    def __init__(self):
+        # Raw lock: journal mutation must not trace itself.
+        self._guard = _thread.allocate_lock()
+        self._tls = threading.local()
+        self.edges: dict = {}        # (site_a, site_b) -> count
+        self.acquires: dict = {}     # site -> count
+        self.creations: dict = {}    # site -> count
+        self.contradictions: list = []   # [(held_name, acquired_name)]
+        self._graph_names: dict = {}     # site -> canonical lock name
+        self._static_edges: set = set()  # (name, name)
+        self._succ: dict = {}            # name -> set(name), static graph
+        self._observed_names: set = set()   # (name, name) seen at runtime
+        self._reach_memo: dict = {}
+        self._published = {"orders": 0, "contradictions": 0}
+        self.installed = False
+
+    # -- per-thread held stack --------------------------------------------
+    def _held(self) -> list:
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        return held
+
+    def note_create(self, site: str) -> None:
+        with self._guard:
+            self.creations[site] = self.creations.get(site, 0) + 1
+
+    def note_acquire(self, site: str) -> None:
+        held = self._held()
+        new_edges = []
+        for h in held:
+            if h != site:
+                new_edges.append((h, site))
+        held.append(site)
+        with self._guard:
+            self.acquires[site] = self.acquires.get(site, 0) + 1
+            for edge in new_edges:
+                first = edge not in self.edges
+                self.edges[edge] = self.edges.get(edge, 0) + 1
+                # Gate on a LOADED graph (names mapped), not on it
+                # having edges: mutual-observed inversion detection
+                # works on an edge-free graph too.
+                if first and self._graph_names:
+                    self._check_online(edge)
+
+    def note_release(self, site: str, recursive: bool = False) -> None:
+        held = self._held()
+        if recursive:
+            self._tls.held = [h for h in held if h != site]
+            return
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] == site:
+                del held[i]
+                return
+
+    # -- static-graph join -------------------------------------------------
+    def load_static_graph(self, graph: dict) -> None:
+        """``graph``: the ``kairace --lock-graph`` payload.  Sites map to
+        canonical names; a creation line may sit one line off the
+        declaration's (multi-line assignment), so join with a +-2 line
+        tolerance."""
+        with self._guard:
+            self._graph_names = _site_name_map(graph)
+            self._static_edges = {tuple(e) for e in graph.get("edges", [])}
+            # Adjacency once, up front: _reachable runs inside the
+            # lock-acquire hot path (under _guard) — a per-expansion
+            # scan of the whole edge set would put an O(V*E) walk in
+            # every first-time acquisition.
+            self._succ = {}
+            for a, b in self._static_edges:
+                self._succ.setdefault(a, set()).add(b)
+            self._observed_names = set()
+            self._reach_memo = {}
+
+    def _reachable(self, src: str, dst: str) -> bool:
+        """Path src -> ... -> dst in the static graph (memoized DFS)."""
+        key = (src, dst)
+        memo = self._reach_memo
+        if key in memo:
+            return memo[key]
+        seen, stack = set(), [src]
+        found = False
+        while stack:
+            node = stack.pop()
+            if node == dst:
+                found = True
+                break
+            if node in seen:
+                continue
+            seen.add(node)
+            stack.extend(self._succ.get(node, ()))
+        memo[key] = found
+        return found
+
+    def _check_online(self, edge: tuple) -> None:
+        # caller holds self._guard
+        a = self._graph_names.get(edge[0])
+        b = self._graph_names.get(edge[1])
+        if a is None or b is None or a == b:
+            return
+        # Two triggers: the reverse order is statically REACHABLE (the
+        # analyzer knew about a path b -> ... -> a), or the reverse was
+        # OBSERVED at runtime (strongest evidence there is — a
+        # deadlock-capable inversion even when the static graph missed
+        # both paths, e.g. dynamic dispatch it cannot resolve).
+        if (a, b) not in self._static_edges and self._reachable(b, a):
+            self.contradictions.append((a, b))
+        elif (b, a) in self._observed_names:
+            self.contradictions.append((a, b))
+        self._observed_names.add((a, b))
+
+    # -- reporting ---------------------------------------------------------
+    def mapped_edges(self) -> dict:
+        """Observed edges joined to canonical names (unmapped sites —
+        stdlib/test locks — drop out): (name_a, name_b) -> count."""
+        out: dict = {}
+        with self._guard:
+            for (sa, sb), n in self.edges.items():
+                a, b = self._graph_names.get(sa), self._graph_names.get(sb)
+                if a is not None and b is not None and a != b:
+                    out[(a, b)] = out.get((a, b), 0) + n
+        return out
+
+    def stats(self) -> dict:
+        with self._guard:
+            return {
+                "orders_recorded": len(self.edges),
+                "acquires": sum(self.acquires.values()),
+                "sites": len(self.acquires),
+                "contradictions": len(self.contradictions),
+            }
+
+    def dump(self) -> dict:
+        with self._guard:
+            return {
+                "edges": sorted([a, b, n] for (a, b), n
+                                in self.edges.items()),
+                "acquires": dict(sorted(self.acquires.items())),
+                "creations": dict(sorted(self.creations.items())),
+                "contradictions": [list(c) for c in self.contradictions],
+            }
+
+    def reset(self) -> None:
+        with self._guard:
+            self.edges.clear()
+            self.acquires.clear()
+            self.creations.clear()
+            self.contradictions.clear()
+            self._observed_names.clear()
+            self._published = {"orders": 0, "contradictions": 0}
+
+
+TRACER = LockTracer()
+
+
+def sync_metrics() -> None:
+    """Publish journal sizes as counters (delta since last sync).  Safe
+    to call from any thread; called OUTSIDE the acquire hot path only
+    (see module docstring for why)."""
+    from .metrics import METRICS
+    with TRACER._guard:
+        orders = len(TRACER.edges)
+        contras = len(TRACER.contradictions)
+        d_orders = orders - TRACER._published["orders"]
+        d_contras = contras - TRACER._published["contradictions"]
+        TRACER._published = {"orders": orders, "contradictions": contras}
+    if d_orders > 0:
+        METRICS.inc("locktrace_orders_recorded_total", d_orders)
+    if d_contras > 0:
+        METRICS.inc("locktrace_contradictions_total", d_contras)
+
+
+# -- proxies -----------------------------------------------------------------
+
+class _TracedLock:
+    """Plain Lock proxy; also what Semaphore wraps."""
+
+    def __init__(self, inner, site: str):
+        self._inner = inner
+        self.site = site
+        TRACER.note_create(site)
+
+    def acquire(self, blocking=True, timeout=-1):
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            TRACER.note_acquire(self.site)
+        return ok
+
+    def release(self):
+        self._inner.release()
+        TRACER.note_release(self.site)
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __getattr__(self, name):
+        # Stdlib internals reach past the public protocol —
+        # concurrent.futures registers `_at_fork_reinit` with
+        # os.register_at_fork at IMPORT time, threading's fork hooks do
+        # the same — so unknown attributes delegate to the real lock
+        # (only missing ones reach here; the traced methods above win).
+        if name == "_inner":
+            raise AttributeError(name)
+        return getattr(self._inner, name)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return f"<traced {self._inner!r} @ {self.site}>"
+
+
+class _TracedRLock(_TracedLock):
+    """RLock proxy: exposes the ``_release_save``/``_acquire_restore``/
+    ``_is_owned`` protocol so ``threading.Condition`` wait() keeps the
+    held-stack honest (wait RELEASES the lock — the tracer must not
+    think it is still held while the thread sleeps)."""
+
+    def _release_save(self):
+        state = self._inner._release_save()
+        TRACER.note_release(self.site, recursive=True)
+        return state
+
+    def _acquire_restore(self, state):
+        self._inner._acquire_restore(state)
+        TRACER.note_acquire(self.site)
+
+    def _is_owned(self):
+        return self._inner._is_owned()
+
+
+class _TracedSemaphore:
+    """Semaphore proxy: acquisition order still matters (a semaphore
+    held while taking a lock is an ordering edge), release has no owner
+    thread so the stack pop is best-effort on the releasing thread."""
+
+    def __init__(self, inner, site: str):
+        self._inner = inner
+        self.site = site
+        TRACER.note_create(site)
+
+    def acquire(self, blocking=True, timeout=None):
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            TRACER.note_acquire(self.site)
+        return ok
+
+    def release(self, n=1):
+        self._inner.release(n)
+        TRACER.note_release(self.site)
+
+    def __getattr__(self, name):
+        if name == "_inner":
+            raise AttributeError(name)
+        return getattr(self._inner, name)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+
+def _lock_factory():
+    if _internal_to_threading():
+        return _REAL["Lock"]()
+    return _TracedLock(_REAL["Lock"](), _creation_site())
+
+
+def _rlock_factory():
+    if _internal_to_threading():
+        return _REAL["RLock"]()
+    return _TracedRLock(_REAL["RLock"](), _creation_site())
+
+
+def _condition_factory(lock=None):
+    """``Condition(self._lock)`` ALIASES the lock: handing the existing
+    proxy to the real Condition means waiting/notifying records against
+    the very same site — the aliasing kairace resolves statically."""
+    if lock is None and not _internal_to_threading():
+        lock = _TracedRLock(_REAL["RLock"](), _creation_site())
+    return _REAL["Condition"](lock)
+
+
+def _semaphore_factory(value=1):
+    if _internal_to_threading():
+        return _REAL["Semaphore"](value)
+    return _TracedSemaphore(_REAL["Semaphore"](value), _creation_site())
+
+
+def _bounded_semaphore_factory(value=1):
+    if _internal_to_threading():
+        return _REAL["BoundedSemaphore"](value)
+    return _TracedSemaphore(_REAL["BoundedSemaphore"](value),
+                            _creation_site())
+
+
+def install() -> LockTracer:
+    """Patch the threading factories.  Locks created BEFORE install are
+    invisible — install from conftest/process start, before any suite
+    code constructs its objects."""
+    if TRACER.installed:
+        return TRACER
+    threading.Lock = _lock_factory
+    threading.RLock = _rlock_factory
+    threading.Condition = _condition_factory
+    threading.Semaphore = _semaphore_factory
+    threading.BoundedSemaphore = _bounded_semaphore_factory
+    TRACER.installed = True
+
+    graph_path = os.environ.get("KAI_LOCKTRACE_GRAPH")
+    if graph_path and os.path.isfile(graph_path):
+        try:
+            with open(graph_path, encoding="utf-8") as fh:
+                TRACER.load_static_graph(json.load(fh))
+        except (OSError, ValueError):
+            pass  # validation degrades to offline; recording continues
+
+    out = os.environ.get("KAI_LOCKTRACE_OUT")
+    if out:
+        atexit.register(_dump_to, out)
+    return TRACER
+
+
+def uninstall() -> None:
+    for name, real in _REAL.items():
+        setattr(threading, name, real)
+    TRACER.installed = False
+
+
+def _dump_to(path: str) -> None:
+    try:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump(TRACER.dump(), fh, indent=2, sort_keys=True)
+            fh.write("\n")
+    except OSError:
+        pass  # a failed dump must not fail the traced process
+
+
+def _site_name_map(graph: dict) -> dict:
+    """site (``file:line``) -> canonical lock name, with the same +-2
+    line tolerance as :meth:`LockTracer.load_static_graph` (a creation
+    call can sit a line off its declaration in a wrapped assignment)."""
+    names: dict = {}
+    # Exact declaration lines claim their site FIRST — two locks
+    # declared on adjacent lines must never steal each other's site via
+    # the fuzzy fill.
+    for name, sites in graph.get("locks", {}).items():
+        for ent in sites:
+            names[f"{ent['file']}:{ent['line']}"] = name
+    for name, sites in graph.get("locks", {}).items():
+        for ent in sites:
+            for delta in (1, -1, 2, -2):
+                names.setdefault(f"{ent['file']}:{ent['line'] + delta}",
+                                 name)
+    return names
+
+
+def _subsystem(site: str) -> str:
+    """``kai_scheduler_tpu/utils/statusworker.py:41`` ->
+    ``utils/statusworker`` — the per-component grouping the
+    ``chaos_matrix --races`` coverage gate reports on."""
+    path = site.rsplit(":", 1)[0]
+    if path.startswith(_PKG_MARKER + "/"):
+        path = path[len(_PKG_MARKER) + 1:]
+    return path[:-3] if path.endswith(".py") else path
+
+
+def validate_observed(graph: dict, dumps: list) -> dict:
+    """Join merged ``KAI_LOCKTRACE_OUT`` journals against a static
+    ``kairace --lock-graph`` payload (the offline half of the validator;
+    the online half is :meth:`LockTracer._check_online`).
+
+    Returns orders (mapped edges), contradictions (observed order whose
+    reverse is statically reachable — analyzer false negative or rotted
+    annotation), and per-subsystem coverage: every subsystem that
+    CREATED a statically-known lock must show at least one acquisition,
+    else the sweep never exercised it and proved nothing about it."""
+    names = _site_name_map(graph)
+    static_edges = {tuple(e) for e in graph.get("edges", [])}
+
+    succ: dict = {}
+    for a, b in static_edges:
+        succ.setdefault(a, set()).add(b)
+
+    def reachable(src: str, dst: str) -> bool:
+        seen, stack = set(), [src]
+        while stack:
+            node = stack.pop()
+            if node == dst:
+                return True
+            if node in seen:
+                continue
+            seen.add(node)
+            stack.extend(succ.get(node, ()))
+        return False
+
+    orders: dict = {}
+    subsystems: dict = {}
+    contradictions: list = []
+    unmapped = 0
+
+    def sub_entry(site: str) -> dict:
+        return subsystems.setdefault(_subsystem(site), {
+            "locks_created": 0, "acquires": 0, "orders": 0})
+
+    for dump in dumps:
+        for site, n in dump.get("creations", {}).items():
+            if site in names:
+                sub_entry(site)["locks_created"] += n
+        for site, n in dump.get("acquires", {}).items():
+            if site in names:
+                sub_entry(site)["acquires"] += n
+        for sa, sb, n in dump.get("edges", []):
+            a, b = names.get(sa), names.get(sb)
+            if a is None or b is None or a == b:
+                unmapped += 1
+                continue
+            first = (a, b) not in orders
+            orders[(a, b)] = orders.get((a, b), 0) + n
+            sub_entry(sa)["orders"] += 1 if first else 0
+            sub_entry(sb)["orders"] += 1 if first else 0
+            if first and (a, b) not in static_edges and reachable(b, a):
+                contradictions.append(
+                    {"observed": [a, b],
+                     "static_path": f"{b} -> ... -> {a}"})
+
+    # Observed-vs-observed inversions: both orders in the merged
+    # journals (possibly from different seeds) is a deadlock-capable
+    # cycle even when the static graph missed BOTH acquisition paths —
+    # the strongest evidence the journals can carry, and invisible to
+    # the static-reachability check above.
+    for (a, b) in sorted(orders):
+        if (b, a) in orders and a < b:
+            contradictions.append(
+                {"observed": [a, b],
+                 "static_path": f"{b} -> {a} also observed at runtime"})
+
+    uncovered = sorted(s for s, ent in subsystems.items()
+                       if ent["locks_created"] and not ent["acquires"])
+    return {
+        "orders": {f"{a} -> {b}": n
+                   for (a, b), n in sorted(orders.items())},
+        "contradictions": contradictions,
+        "subsystems": dict(sorted(subsystems.items())),
+        "uncovered_subsystems": uncovered,
+        "unmapped_edges": unmapped,
+        "ok": not contradictions and not uncovered and bool(orders),
+    }
+
+
+def install_from_env() -> bool:
+    """Honor ``KAI_LOCKTRACE=1`` (the conftest/server entry hook)."""
+    if os.environ.get("KAI_LOCKTRACE", "") not in ("", "0", "false"):
+        install()
+        return True
+    return False

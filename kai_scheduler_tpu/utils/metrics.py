@@ -85,6 +85,14 @@ class Metrics:
         # status-updater workers all record labeled series).
         self._label_values: dict = defaultdict(set)
         self._label_lock = threading.Lock()
+        # Registry mutation lock: `counters[key] += v` is a read-modify-
+        # write — two threads (status workers, commit executor, HTTP
+        # handlers, samplers) interleaving between the read and the
+        # store LOSE increments, and histogram observes tear
+        # (counts/total/n updated non-atomically).  Every mutation takes
+        # this lock (kairace KRC001); reads stay lock-free — a torn read
+        # of a monotonically growing counter is at worst one tick stale.
+        self._data_lock = threading.Lock()
         # Labeled-histogram rendering: series key -> (family, labels).
         self._histogram_series: dict[str, tuple] = {}
 
@@ -111,35 +119,51 @@ class Metrics:
                     out[k] = LABEL_OVERFLOW_VALUE
                     overflowed += 1
         if overflowed:
-            self.counters["metrics_label_overflow_total"] += overflowed
+            with self._data_lock:
+                self.counters["metrics_label_overflow_total"] += overflowed
         return out
 
     def observe(self, name: str, value: float, **labels) -> None:
         if labels:
             labels = self._bound_labels(name, labels)
             key = _key(name, labels)
-            self._histogram_series.setdefault(key, (name, labels))
-            self.histograms[key].observe(value)
+            with self._data_lock:
+                self._histogram_series.setdefault(key, (name, labels))
+                self.histograms[key].observe(value)
         else:
-            self.histograms[name].observe(value)
+            with self._data_lock:
+                self.histograms[name].observe(value)
 
     def set_gauge(self, name: str, value: float, **labels) -> None:
-        self.gauges[_key(name, labels)] = value
+        with self._data_lock:
+            self.gauges[_key(name, labels)] = value
 
     def inc(self, name: str, value: float = 1.0, **labels) -> None:
         if labels:
             labels = self._bound_labels(name, labels)
-        self.counters[_key(name, labels)] += value
+        with self._data_lock:
+            self.counters[_key(name, labels)] += value
 
     def reset(self) -> None:
-        self.histograms.clear()
-        self.gauges.clear()
-        self.counters.clear()
+        with self._data_lock:
+            self.histograms.clear()
+            self.gauges.clear()
+            self.counters.clear()
+            self._histogram_series.clear()
         with self._label_lock:
             self._label_values.clear()
-        self._histogram_series.clear()
 
     def to_prometheus_text(self) -> str:
+        # The whole render holds _data_lock: a first-time inc/observe on
+        # another thread INSERTS into these dicts, and a dict resize
+        # during iteration is a RuntimeError (a 500ing scrape), not a
+        # stale read.  Render is pure string work at scrape frequency —
+        # instruments blocking on it for a few hundred microseconds is
+        # the cheap side of that trade.
+        with self._data_lock:
+            return self._render_locked()
+
+    def _render_locked(self) -> str:
         lines = []
         # Group histogram series by family first: the text format
         # requires every line of one family to form a single

@@ -20,6 +20,16 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# KAI_LOCKTRACE=1 (chaos_matrix --races): install the runtime lock-order
+# validator BEFORE any suite module constructs scheduler objects — locks
+# created before install are invisible to the journal.  The shim dumps
+# observed acquisition orders to KAI_LOCKTRACE_OUT at process exit; the
+# matrix harness joins them against the static kairace lock graph.
+if os.environ.get("KAI_LOCKTRACE"):
+    from kai_scheduler_tpu.utils.locktrace import install_from_env
+
+    install_from_env()
+
 # The environment's accelerator plugin (registered from sitecustomize before
 # this file runs) force-updates jax_platforms; point it back at CPU before
 # any backend initializes.

@@ -456,6 +456,99 @@ class TestKAI006LockDiscipline:
         findings = lint(("kai_scheduler_tpu/utils/fix.py", src))
         assert [f for f in findings if f.rule == "KAI006"] == []
 
+    # -- type-based lock identity (shared lockscope collector) ---------
+
+    def test_fires_on_bare_acquire_of_innocently_named_rlock(self):
+        # An RLock assigned to a non-lockish attribute name is still a
+        # lock: identity comes from the declared TYPE via the shared
+        # lock-scope collector, not just the name token.
+        src = ("import threading\n"
+               "class C:\n"
+               "    def __init__(self):\n"
+               "        self._state = threading.RLock()\n"
+               "    def f(self):\n"
+               "        self._state.acquire()\n"
+               "        self.n += 1\n"
+               "        self._state.release()\n")
+        findings = lint(("kai_scheduler_tpu/utils/fix.py", src))
+        assert any(f.rule == "KAI006" and "acquire" in f.message
+                   for f in findings)
+
+    def test_fires_on_blocking_call_under_typed_semaphore(self):
+        src = ("import os, threading\n"
+               "class C:\n"
+               "    def __init__(self):\n"
+               "        self._slots = threading.Semaphore(4)\n"
+               "    def f(self, fh):\n"
+               "        with self._slots:\n"
+               "            os.fsync(fh.fileno())\n")
+        findings = lint(("kai_scheduler_tpu/utils/fix.py", src))
+        assert any(f.rule == "KAI006" and "fsync" in f.message
+                   for f in findings)
+
+    def test_event_named_like_a_lock_is_not_a_lock(self):
+        # The collector knows the primitive kind: an Event named
+        # `_sem_ready` must not be treated as a lock by the name token.
+        src = ("import threading\n"
+               "class C:\n"
+               "    def __init__(self):\n"
+               "        self._sem_ready = threading.Event()\n"
+               "    def f(self):\n"
+               "        self._sem_ready.wait()\n")
+        findings = lint(("kai_scheduler_tpu/utils/fix.py", src))
+        assert [f for f in findings if f.rule == "KAI006"] == []
+
+    # -- Condition notify/wait outside its lock ------------------------
+
+    def test_fires_on_notify_outside_condition_lock(self):
+        src = ("import threading\n"
+               "class C:\n"
+               "    def __init__(self):\n"
+               "        self._cv = threading.Condition()\n"
+               "    def f(self):\n"
+               "        self._cv.notify()\n")
+        findings = lint(("kai_scheduler_tpu/utils/fix.py", src))
+        assert any(f.rule == "KAI006" and "notify" in f.message
+                   for f in findings)
+
+    def test_notify_inside_with_condition_is_clean(self):
+        src = ("import threading\n"
+               "class C:\n"
+               "    def __init__(self):\n"
+               "        self._cv = threading.Condition()\n"
+               "    def f(self):\n"
+               "        with self._cv:\n"
+               "            self._cv.notify_all()\n")
+        findings = lint(("kai_scheduler_tpu/utils/fix.py", src))
+        assert [f for f in findings if f.rule == "KAI006"] == []
+
+    def test_condition_lock_aliasing_is_honored(self):
+        # Condition(self._lock) ALIASES the lock: holding self._lock IS
+        # holding the condition, so notify under it is clean — while a
+        # notify under a DIFFERENT lock still fires.
+        clean = ("import threading\n"
+                 "class C:\n"
+                 "    def __init__(self):\n"
+                 "        self._lock = threading.Lock()\n"
+                 "        self._cv = threading.Condition(self._lock)\n"
+                 "    def f(self):\n"
+                 "        with self._lock:\n"
+                 "            self._cv.notify()\n")
+        findings = lint(("kai_scheduler_tpu/utils/fix.py", clean))
+        assert [f for f in findings if f.rule == "KAI006"] == []
+        wrong = ("import threading\n"
+                 "class C:\n"
+                 "    def __init__(self):\n"
+                 "        self._lock = threading.Lock()\n"
+                 "        self._other = threading.Lock()\n"
+                 "        self._cv = threading.Condition(self._lock)\n"
+                 "    def f(self):\n"
+                 "        with self._other:\n"
+                 "            self._cv.notify()\n")
+        findings = lint(("kai_scheduler_tpu/utils/fix.py", wrong))
+        assert any(f.rule == "KAI006" and "notify" in f.message
+                   for f in findings)
+
 
 # ---------------------------------------------------------------------------
 # KAI007 exception-swallowing
@@ -710,6 +803,30 @@ class TestKAI008MetricsHygiene:
                         ("kai_scheduler_tpu/server.py", b))
         assert any(f.rule == "KAI008" and "one instrument" in f.message
                    and "stackprof_samples_total" in f.message
+                   for f in findings)
+
+    def test_locktrace_family_consistent_usage_is_clean(self):
+        # The KAI_LOCKTRACE validator counters (utils/locktrace.py,
+        # published from /healthz + the Prometheus render path).
+        src = ("from ..utils.metrics import METRICS\n"
+               "def f(v):\n"
+               "    METRICS.inc('locktrace_orders_recorded_total', v)\n"
+               "    METRICS.inc('locktrace_contradictions_total', v)\n")
+        findings = lint(("kai_scheduler_tpu/utils/fix.py", src))
+        assert [f for f in findings if f.rule == "KAI008"] == []
+
+    def test_locktrace_cross_instrument_collision_fires(self):
+        a = ("from ..utils.metrics import METRICS\n"
+             "def f(v):\n"
+             "    METRICS.inc('locktrace_orders_recorded_total', v)\n")
+        b = ("from ..utils.metrics import METRICS\n"
+             "def g(v):\n"
+             "    METRICS.set_gauge('locktrace_orders_recorded_total',"
+             " v)\n")
+        findings = lint(("kai_scheduler_tpu/utils/a.py", a),
+                        ("kai_scheduler_tpu/server.py", b))
+        assert any(f.rule == "KAI008" and "one instrument" in f.message
+                   and "locktrace_orders_recorded_total" in f.message
                    for f in findings)
 
     def test_engine_reuse_does_not_leak_rule_state(self):

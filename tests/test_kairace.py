@@ -1,0 +1,1061 @@
+"""kairace: the thread-role & lock-contract analyzer, tested (tier-1).
+
+Mirrors ``test_kailint.py``'s three layers:
+
+1. per-rule fixtures — every KRC rule has a seeded violation that FIRES
+   and a clean case that stays silent;
+2. analysis mechanics — thread-role discovery/propagation, lock-scope
+   and guard inheritance, suppressions (tool-scoped: a kailint marker
+   never silences kairace), the EMPTY-baseline drift gate, CLI exit
+   codes, and the lock-graph/role-table exports;
+3. the package gate — the analyzer runs over the real
+   ``kai_scheduler_tpu/`` tree and must report ZERO findings against a
+   baseline that stays empty forever (fix-don't-baseline);
+
+plus the runtime side: ``utils/locktrace.py`` unit tests and one
+regression test per real race this PR fixed (kubeapi watcher
+registration, metrics read-modify-write, elector late-renew).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from kai_scheduler_tpu.tools.kailint.engine import Engine, load_baseline
+from kai_scheduler_tpu.tools.kairace.cli import (lock_graph,
+                                                 main as kairace_main,
+                                                 role_table)
+from kai_scheduler_tpu.tools.kairace.program import build_program
+from kai_scheduler_tpu.tools.kairace.rules import default_rules
+from kai_scheduler_tpu.utils import locktrace
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = os.path.join(REPO_ROOT, "kai_scheduler_tpu")
+BASELINE = os.path.join(REPO_ROOT, ".kairace-baseline.json")
+
+
+def race(*modules: tuple[str, str], select: set | None = None):
+    """Run the kairace rule pack over inline fixture modules."""
+    report = Engine(default_rules(), select=select,
+                    tool="kairace").run_modules(list(modules))
+    assert not report.errors, report.errors
+    return report.findings
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+def program_of(*modules: tuple[str, str]):
+    return build_program([(path, ast.parse(src), src)
+                          for path, src in modules])
+
+
+# ---------------------------------------------------------------------------
+# KRC001 multi-role-write
+# ---------------------------------------------------------------------------
+
+class TestKRC001MultiRoleWrite:
+    def test_fires_on_unguarded_two_role_write(self):
+        src = ("import threading\n"
+               "class C:\n"
+               "    def __init__(self):\n"
+               "        self.n = 0\n"
+               "        self._lock = threading.Lock()\n"
+               "        threading.Thread(target=self.worker).start()\n"
+               "    def worker(self):\n"
+               "        self.n = 1\n"
+               "    def bump(self):\n"
+               "        self.n = 2\n")
+        findings = race(("kai_scheduler_tpu/utils/fix.py", src))
+        assert any(f.rule == "KRC001" and "C.n" in f.message
+                   for f in findings)
+
+    def test_clean_when_all_writes_share_a_lock(self):
+        src = ("import threading\n"
+               "class C:\n"
+               "    def __init__(self):\n"
+               "        self.n = 0\n"
+               "        self._lock = threading.Lock()\n"
+               "        threading.Thread(target=self.worker).start()\n"
+               "    def worker(self):\n"
+               "        with self._lock:\n"
+               "            self.n = 1\n"
+               "    def bump(self):\n"
+               "        with self._lock:\n"
+               "            self.n = 2\n")
+        assert race(("kai_scheduler_tpu/utils/fix.py", src)) == []
+
+    def test_init_writes_are_exempt(self):
+        # Construction happens-before any thread can see the instance.
+        src = ("import threading\n"
+               "class C:\n"
+               "    def __init__(self):\n"
+               "        self.n = 0\n"
+               "        threading.Thread(target=self.worker).start()\n"
+               "    def worker(self):\n"
+               "        self.n = 1\n")
+        assert race(("kai_scheduler_tpu/utils/fix.py", src)) == []
+
+    def test_interprocedural_guard_inheritance(self):
+        # _apply is ONLY called under the lock: its writes inherit the
+        # guard even without a lexical `with` of its own.
+        src = ("import threading\n"
+               "class C:\n"
+               "    def __init__(self):\n"
+               "        self.n = 0\n"
+               "        self._lock = threading.Lock()\n"
+               "        threading.Thread(target=self.worker).start()\n"
+               "    def worker(self):\n"
+               "        with self._lock:\n"
+               "            self._apply()\n"
+               "    def bump(self):\n"
+               "        with self._lock:\n"
+               "            self._apply()\n"
+               "    def _apply(self):\n"
+               "        self.n += 1\n")
+        assert race(("kai_scheduler_tpu/utils/fix.py", src)) == []
+
+    def test_tuple_unpacking_write_is_seen(self):
+        # `x, self.n = ...` is a rebinding of the field too.
+        src = ("import threading\n"
+               "class C:\n"
+               "    def __init__(self):\n"
+               "        self.n = 0\n"
+               "        threading.Thread(target=self.worker).start()\n"
+               "    def worker(self):\n"
+               "        self.n = 1\n"
+               "    def take(self):\n"
+               "        x, self.n = self.n, 0\n"
+               "        return x\n")
+        findings = race(("kai_scheduler_tpu/utils/fix.py", src))
+        assert any(f.rule == "KRC001" and "C.n" in f.message
+                   for f in findings)
+
+    def test_mutator_call_counts_as_write_on_known_container(self):
+        src = ("import threading\n"
+               "class C:\n"
+               "    def __init__(self):\n"
+               "        self.items = []\n"
+               "        threading.Thread(target=self.worker).start()\n"
+               "    def worker(self):\n"
+               "        self.items.append(1)\n"
+               "    def push(self):\n"
+               "        self.items.append(2)\n")
+        findings = race(("kai_scheduler_tpu/utils/fix.py", src))
+        assert any(f.rule == "KRC001" and "C.items" in f.message
+                   for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# KRC002 lock-order-inversion
+# ---------------------------------------------------------------------------
+
+class TestKRC002LockOrderInversion:
+    def test_fires_on_ab_ba_cycle(self):
+        src = ("import threading\n"
+               "class C:\n"
+               "    def __init__(self):\n"
+               "        self._a = threading.Lock()\n"
+               "        self._b = threading.Lock()\n"
+               "    def f(self):\n"
+               "        with self._a:\n"
+               "            with self._b:\n"
+               "                pass\n"
+               "    def g(self):\n"
+               "        with self._b:\n"
+               "            with self._a:\n"
+               "                pass\n")
+        findings = race(("kai_scheduler_tpu/utils/fix.py", src))
+        assert any(f.rule == "KRC002" and "C._a" in f.message
+                   and "C._b" in f.message for f in findings)
+
+    def test_clean_on_consistent_order(self):
+        src = ("import threading\n"
+               "class C:\n"
+               "    def __init__(self):\n"
+               "        self._a = threading.Lock()\n"
+               "        self._b = threading.Lock()\n"
+               "    def f(self):\n"
+               "        with self._a:\n"
+               "            with self._b:\n"
+               "                pass\n"
+               "    def g(self):\n"
+               "        with self._a:\n"
+               "            with self._b:\n"
+               "                pass\n")
+        assert race(("kai_scheduler_tpu/utils/fix.py", src)) == []
+
+    def test_interprocedural_inversion(self):
+        # f holds A and calls h (which takes B); g holds B and calls k
+        # (which takes A): the cycle only exists across calls.
+        src = ("import threading\n"
+               "class C:\n"
+               "    def __init__(self):\n"
+               "        self._a = threading.Lock()\n"
+               "        self._b = threading.Lock()\n"
+               "    def f(self):\n"
+               "        with self._a:\n"
+               "            self.grab_b()\n"
+               "    def grab_b(self):\n"
+               "        with self._b:\n"
+               "            pass\n"
+               "    def g(self):\n"
+               "        with self._b:\n"
+               "            self.grab_a()\n"
+               "    def grab_a(self):\n"
+               "        with self._a:\n"
+               "            pass\n")
+        findings = race(("kai_scheduler_tpu/utils/fix.py", src))
+        assert "KRC002" in rules_of(findings)
+
+
+# ---------------------------------------------------------------------------
+# KRC003 single-writer
+# ---------------------------------------------------------------------------
+
+class TestKRC003SingleWriter:
+    def test_fires_on_off_role_write(self):
+        src = ("import threading\n"
+               "class C:\n"
+               "    def __init__(self):\n"
+               "        # kairace: single-writer=main\n"
+               "        self.state = {}\n"
+               "        threading.Thread(target=self.worker).start()\n"
+               "    def worker(self):\n"
+               "        self.state['k'] = 1\n")
+        findings = race(("kai_scheduler_tpu/utils/fix.py", src))
+        assert any(f.rule == "KRC003" and "C.state" in f.message
+                   for f in findings)
+
+    def test_clean_on_declared_role(self):
+        src = ("import threading\n"
+               "class C:\n"
+               "    def __init__(self):\n"
+               "        # kairace: single-writer=main\n"
+               "        self.state = {}\n"
+               "        threading.Thread(target=self.worker).start()\n"
+               "    def worker(self):\n"
+               "        x = self.state\n"          # reads are free
+               "    def apply(self):\n"
+               "        self.state['k'] = 1\n")    # main-role write
+        assert race(("kai_scheduler_tpu/utils/fix.py", src)) == []
+
+    def test_annotation_on_same_line(self):
+        src = ("import threading\n"
+               "class C:\n"
+               "    def __init__(self):\n"
+               "        self.state = {}  # kairace: single-writer=main\n"
+               "        threading.Thread(target=self.worker).start()\n"
+               "    def worker(self):\n"
+               "        self.state['k'] = 1\n")
+        findings = race(("kai_scheduler_tpu/utils/fix.py", src))
+        assert "KRC003" in rules_of(findings)
+
+    def test_named_thread_role(self):
+        # Thread(name=...) names the role; the annotation can use it.
+        src = ("import threading\n"
+               "class C:\n"
+               "    def __init__(self):\n"
+               "        # kairace: single-writer=flusher\n"
+               "        self.buf = {}\n"
+               "        threading.Thread(target=self.worker,\n"
+               "                         name='flusher').start()\n"
+               "    def worker(self):\n"
+               "        self.buf['k'] = 1\n")
+        assert race(("kai_scheduler_tpu/utils/fix.py", src)) == []
+
+
+# ---------------------------------------------------------------------------
+# KRC004 guard-asymmetry
+# ---------------------------------------------------------------------------
+
+class TestKRC004GuardAsymmetry:
+    def test_fires_on_unguarded_write_with_guarded_reads(self):
+        src = ("import threading\n"
+               "class C:\n"
+               "    def __init__(self):\n"
+               "        self._lock = threading.Lock()\n"
+               "        self.val = 0\n"
+               "        threading.Thread(target=self.reader).start()\n"
+               "    def reader(self):\n"
+               "        with self._lock:\n"
+               "            return self.val\n"
+               "    def writer(self):\n"
+               "        self.val = 9\n")
+        findings = race(("kai_scheduler_tpu/utils/fix.py", src))
+        assert any(f.rule == "KRC004" and "C.val" in f.message
+                   for f in findings)
+
+    def test_clean_when_writer_takes_the_lock(self):
+        src = ("import threading\n"
+               "class C:\n"
+               "    def __init__(self):\n"
+               "        self._lock = threading.Lock()\n"
+               "        self.val = 0\n"
+               "        threading.Thread(target=self.reader).start()\n"
+               "    def reader(self):\n"
+               "        with self._lock:\n"
+               "            return self.val\n"
+               "    def writer(self):\n"
+               "        with self._lock:\n"
+               "            self.val = 9\n")
+        assert race(("kai_scheduler_tpu/utils/fix.py", src)) == []
+
+    def test_lock_free_reads_are_authors_choice(self):
+        # No guarded read anywhere: KRC004 has no readers' contract to
+        # defend (single-role writes keep KRC001 out too).
+        src = ("import threading\n"
+               "class C:\n"
+               "    def __init__(self):\n"
+               "        self.val = 0\n"
+               "        threading.Thread(target=self.reader).start()\n"
+               "    def reader(self):\n"
+               "        return self.val\n"
+               "    def writer(self):\n"
+               "        self.val = 9\n")
+        assert race(("kai_scheduler_tpu/utils/fix.py", src)) == []
+
+
+# ---------------------------------------------------------------------------
+# KRC005 unguarded-publication
+# ---------------------------------------------------------------------------
+
+class TestKRC005UnguardedPublication:
+    def test_fires_on_published_mutable_with_unguarded_writes(self):
+        src = ("import threading\n"
+               "def work(buf):\n"
+               "    return len(buf)\n"
+               "class C:\n"
+               "    def __init__(self):\n"
+               "        self.buf = []\n"
+               "        self.start()\n"
+               "    def start(self):\n"
+               "        threading.Thread(target=work,\n"
+               "                         args=(self.buf,)).start()\n"
+               "    def add(self, x):\n"
+               "        self.buf.append(x)\n")
+        findings = race(("kai_scheduler_tpu/utils/fix.py", src))
+        assert any(f.rule == "KRC005" and "C.buf" in f.message
+                   for f in findings)
+
+    def test_clean_when_mutation_is_guarded(self):
+        src = ("import threading\n"
+               "def work(buf):\n"
+               "    return len(buf)\n"
+               "class C:\n"
+               "    def __init__(self):\n"
+               "        self.buf = []\n"
+               "        self._lock = threading.Lock()\n"
+               "        self.start()\n"
+               "    def start(self):\n"
+               "        threading.Thread(target=work,\n"
+               "                         args=(self.buf,)).start()\n"
+               "    def add(self, x):\n"
+               "        with self._lock:\n"
+               "            self.buf.append(x)\n")
+        assert race(("kai_scheduler_tpu/utils/fix.py", src)) == []
+
+
+# ---------------------------------------------------------------------------
+# thread-role discovery & propagation
+# ---------------------------------------------------------------------------
+
+class TestRolePropagation:
+    def test_thread_target_and_call_graph(self):
+        src = ("import threading\n"
+               "class C:\n"
+               "    def __init__(self):\n"
+               "        threading.Thread(target=self.worker).start()\n"
+               "    def worker(self):\n"
+               "        self.helper()\n"
+               "    def helper(self):\n"
+               "        pass\n"
+               "    def cycle(self):\n"
+               "        self.helper()\n")
+        prog = program_of(("kai_scheduler_tpu/utils/fix.py", src))
+        path = "kai_scheduler_tpu/utils/fix.py"
+        worker = (path, "C", "C.worker")
+        helper = (path, "C", "C.helper")
+        cycle = (path, "C", "C.cycle")
+        assert prog.roles_of(worker) == frozenset({"C.worker"})
+        # helper is reachable from BOTH the spawned worker and the
+        # main-role cycle(): it runs on both.
+        assert prog.roles_of(helper) == frozenset({"C.worker", "main"})
+        assert prog.roles_of(cycle) == frozenset({"main"})
+
+    def test_named_thread_executor_and_hook_roles(self):
+        src = ("import threading\n"
+               "class C:\n"
+               "    def __init__(self, api, pool):\n"
+               "        threading.Thread(target=self.flush,\n"
+               "                         name='flusher').start()\n"
+               "        pool.submit(self.commit)\n"
+               "        api.watch_sync(self.on_event)\n"
+               "    def flush(self):\n"
+               "        pass\n"
+               "    def commit(self):\n"
+               "        pass\n"
+               "    def on_event(self, et, obj):\n"
+               "        pass\n")
+        prog = program_of(("kai_scheduler_tpu/utils/fix.py", src))
+        path = "kai_scheduler_tpu/utils/fix.py"
+        assert prog.roles_of((path, "C", "C.flush")) == \
+            frozenset({"flusher"})
+        assert prog.roles_of((path, "C", "C.commit")) == \
+            frozenset({"executor"})
+        assert prog.roles_of((path, "C", "C.on_event")) == \
+            frozenset({"hook"})
+
+    def test_http_handler_methods_get_http_role(self):
+        src = ("from http.server import BaseHTTPRequestHandler\n"
+               "class H(BaseHTTPRequestHandler):\n"
+               "    def do_GET(self):\n"
+               "        self.respond()\n"
+               "    def respond(self):\n"
+               "        pass\n")
+        prog = program_of(("kai_scheduler_tpu/utils/fix.py", src))
+        path = "kai_scheduler_tpu/utils/fix.py"
+        assert "http-handler" in prog.roles_of((path, "H", "H.do_GET"))
+        assert "http-handler" in prog.roles_of((path, "H", "H.respond"))
+
+    def test_lock_graph_and_role_table_on_real_package(self):
+        graph = lock_graph([PACKAGE])
+        assert graph["errors"] == []
+        assert "InMemoryKubeAPI._store_lock" in graph["locks"]
+        assert "Metrics._data_lock" in graph["locks"]
+        assert len(graph["edges"]) >= 10
+        # The graph must be acyclic — KRC002 enforces it; --lock-graph
+        # is what the runtime validator trusts.
+        roles = role_table([PACKAGE])
+        assert roles["errors"] == []
+        assert "hook" in roles["roles"]
+        assert any(".".join(k.split(".")[:1]) == "ClusterArena"
+                   for k in roles["annotations"])
+
+
+# ---------------------------------------------------------------------------
+# suppressions & baseline
+# ---------------------------------------------------------------------------
+
+FIRING = ("import threading\n"
+          "class C:\n"
+          "    def __init__(self):\n"
+          "        self.n = 0\n"
+          "        threading.Thread(target=self.worker).start()\n"
+          "    def worker(self):\n"
+          "        self.n = 1\n"
+          "    def bump(self):\n"
+          "        {marker}\n"
+          "        self.n = 2\n")
+
+
+class TestSuppressionsAndBaseline:
+    def test_inline_suppression_silences_the_finding(self):
+        src = FIRING.format(marker="# kairace: disable=KRC001")
+        assert race(("kai_scheduler_tpu/utils/fix.py", src)) == []
+
+    def test_kailint_marker_does_not_silence_kairace(self):
+        # Tool-scoped suppressions: the engine is shared chassis, the
+        # markers are not.
+        src = FIRING.format(marker="# kailint: disable=KRC001")
+        findings = race(("kai_scheduler_tpu/utils/fix.py", src))
+        assert "KRC001" in rules_of(findings)
+
+    def test_kairace_marker_does_not_silence_kailint(self):
+        src = ("class C:\n"
+               "    def f(self):\n"
+               "        # kairace: disable=KAI006\n"
+               "        self._lock.acquire()\n")
+        from kai_scheduler_tpu.tools.kailint import default_rules as kl
+        report = Engine(kl()).run_modules(
+            [("kai_scheduler_tpu/utils/fix.py", src)])
+        assert any(f.rule == "KAI006" for f in report.findings)
+
+    def test_committed_baseline_is_empty_forever(self):
+        """The kairace baseline is EMPTY by contract (fix-don't-
+        baseline): a finding is a race to fix or a contract to annotate,
+        never debt to park.  This gate keeps it that way."""
+        entries = load_baseline(BASELINE, tool="kairace")
+        assert entries == {}, (
+            "the kairace baseline must stay empty — fix the race or "
+            "annotate/suppress WITH A REASON at the site instead")
+
+    def test_baselined_finding_would_still_gate(self, tmp_path):
+        # Even a non-empty baseline keeps exit 1 for NEW findings.
+        mod = tmp_path / "fix.py"
+        mod.write_text(FIRING.format(marker="pass"))
+        rc = kairace_main([str(mod), "--no-baseline"])
+        assert rc == 1
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+class TestCLI:
+    def test_exit_0_on_clean_file(self, tmp_path, capsys):
+        mod = tmp_path / "clean.py"
+        mod.write_text("def f():\n    return 1\n")
+        assert kairace_main([str(mod), "--no-baseline"]) == 0
+        assert "0 new finding(s)" in capsys.readouterr().out
+
+    def test_exit_1_on_findings_and_json_shape(self, tmp_path, capsys):
+        mod = tmp_path / "racy.py"
+        mod.write_text(FIRING.format(marker="pass"))
+        rc = kairace_main([str(mod), "--no-baseline", "--format", "json"])
+        assert rc == 1
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["findings"]
+        assert payload["findings"][0]["rule"] == "KRC001"
+
+    def test_exit_2_on_missing_path(self, capsys):
+        assert kairace_main(["/no/such/dir"]) == 2
+
+    def test_exit_2_on_unknown_rule_id(self, tmp_path, capsys):
+        mod = tmp_path / "clean.py"
+        mod.write_text("x = 1\n")
+        assert kairace_main([str(mod), "--select", "KRC999"]) == 2
+
+    def test_exit_2_on_unparseable_file(self, tmp_path):
+        mod = tmp_path / "broken.py"
+        mod.write_text("def f(:\n")
+        assert kairace_main([str(mod), "--no-baseline"]) == 2
+
+    def test_select_narrows_rules(self, tmp_path):
+        mod = tmp_path / "racy.py"
+        mod.write_text(FIRING.format(marker="pass"))
+        assert kairace_main([str(mod), "--no-baseline",
+                             "--select", "KRC002"]) == 0
+
+    def test_list_rules(self, capsys):
+        assert kairace_main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        for rid in ("KRC001", "KRC002", "KRC003", "KRC004", "KRC005"):
+            assert rid in out
+
+    def test_lock_graph_export(self, tmp_path, capsys):
+        mod = tmp_path / "locks.py"
+        mod.write_text(
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._a = threading.Lock()\n"
+            "        self._b = threading.Lock()\n"
+            "    def f(self):\n"
+            "        with self._a:\n"
+            "            with self._b:\n"
+            "                pass\n")
+        assert kairace_main([str(mod), "--lock-graph"]) == 0
+        payload = json.loads(capsys.readouterr().out)
+        assert ["C._a", "C._b"] in payload["edges"]
+        assert payload["locks"]["C._a"][0]["line"] == 4
+
+
+# ---------------------------------------------------------------------------
+# package gate
+# ---------------------------------------------------------------------------
+
+class TestPackageGate:
+    def test_tree_is_clean_with_empty_baseline(self):
+        """Zero findings over the real package WITHOUT any baseline: a
+        failure here is a new race/inversion/contract break — fix it or
+        document a suppression at the site (docs/STATIC_ANALYSIS.md)."""
+        engine = Engine(default_rules(), tool="kairace")
+        report = engine.run([PACKAGE], baseline=None)
+        assert report.errors == []
+        rendered = "\n".join(f.render() for f in report.findings)
+        assert report.findings == [], (
+            f"new kairace findings:\n{rendered}")
+
+    def test_cli_entrypoint_runs_clean(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "kai_scheduler_tpu.tools.kairace"],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=180)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "0 new finding(s)" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# runtime validator (utils/locktrace.py)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def traced():
+    locktrace.TRACER.reset()
+    locktrace.install()
+    try:
+        yield locktrace.TRACER
+    finally:
+        locktrace.uninstall()
+        locktrace.TRACER.reset()
+
+
+class TestLockTrace:
+    def test_install_uninstall_restores_factories(self):
+        real = threading.Lock
+        locktrace.install()
+        try:
+            assert threading.Lock is not real
+        finally:
+            locktrace.uninstall()
+        assert threading.Lock is real
+
+    def test_records_nested_acquisition_order(self, traced):
+        a = threading.Lock()
+        b = threading.Lock()
+        with a:
+            with b:
+                pass
+        assert any(sa == a.site and sb == b.site
+                   for (sa, sb) in traced.edges)
+        assert not any(sa == b.site and sb == a.site
+                       for (sa, sb) in traced.edges)
+
+    def test_condition_aliases_its_lock(self, traced):
+        lock = threading.RLock()
+        cv = threading.Condition(lock)
+        with cv:
+            cv.notify_all()
+        # Acquiring the condition IS acquiring the lock: one site, no
+        # self-edge.
+        assert traced.acquires.get(lock.site, 0) >= 1
+        assert all(sa != sb for (sa, sb) in traced.edges)
+
+    def test_wait_releases_the_held_stack(self, traced):
+        outer = threading.Lock()
+        cv = threading.Condition()
+        done = []
+
+        def waiter():
+            with cv:
+                cv.wait(timeout=0.2)
+                done.append(True)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.05)
+        with cv:
+            cv.notify_all()
+        t.join(timeout=2)
+        assert done
+        # The waiter slept with cv RELEASED: a lock taken by another
+        # thread during the wait must not produce a cv->outer edge from
+        # the waiter's stale stack.
+        with outer:
+            pass
+        assert not any(sb == outer.site for (_sa, sb) in traced.edges)
+
+    def test_online_contradiction_detection(self, traced):
+        a = threading.Lock()
+        b = threading.Lock()
+        traced.load_static_graph({
+            "locks": {"T.a": [{"file": a.site.rsplit(":", 1)[0],
+                               "line": int(a.site.rsplit(":", 1)[1])}],
+                      "T.b": [{"file": b.site.rsplit(":", 1)[0],
+                               "line": int(b.site.rsplit(":", 1)[1])}]},
+            "edges": [["T.a", "T.b"]],
+        })
+        with b:          # observed b -> a; static orders a -> b
+            with a:
+                pass
+        assert ("T.b", "T.a") in traced.contradictions
+
+    def test_online_mutual_observed_inversion(self, traced):
+        # Neither order is in the static graph; observing BOTH at
+        # runtime is a deadlock-capable inversion regardless.
+        a = threading.Lock()
+        b = threading.Lock()
+        traced.load_static_graph({
+            "locks": {"T.a": [{"file": a.site.rsplit(":", 1)[0],
+                               "line": int(a.site.rsplit(":", 1)[1])}],
+                      "T.b": [{"file": b.site.rsplit(":", 1)[0],
+                               "line": int(b.site.rsplit(":", 1)[1])}]},
+            "edges": [],
+        })
+        with a:
+            with b:
+                pass
+        assert traced.contradictions == []
+        with b:
+            with a:
+                pass
+        assert ("T.b", "T.a") in traced.contradictions
+
+    def test_event_internals_are_not_traced(self, traced):
+        # threading.Event builds a Condition(Lock()) INSIDE threading.py;
+        # blaming the user's `threading.Event()` line for that internal
+        # lock would let _site_name_map's +-2 fuzz join it to an
+        # ADJACENT real lock's name — event.wait() would then count as
+        # acquisitions of a lock that was never touched (fake --races
+        # coverage, bogus contradictions).
+        lock = threading.Lock()          # adjacent declaration
+        event = threading.Event()        # internals must stay invisible
+        event.set()
+        assert event.wait(timeout=1)
+        assert traced.acquires == {}     # nothing recorded for the Event
+        with lock:                       # the real lock still traces
+            pass
+        assert list(traced.acquires) == [lock.site]
+
+    def test_stdlib_fork_hooks_see_through_the_proxy(self, traced):
+        # concurrent.futures.thread registers _at_fork_reinit with
+        # os.register_at_fork at IMPORT time; the proxy must delegate
+        # internals it doesn't trace, or armed sweeps die on the first
+        # module that imports an executor.
+        lock = threading.Lock()
+        assert callable(lock._at_fork_reinit)
+        import importlib
+
+        import concurrent.futures.thread as cft
+        importlib.reload(cft)
+        with cft.ThreadPoolExecutor(max_workers=1) as pool:
+            assert pool.submit(lambda: 41 + 1).result(timeout=10) == 42
+
+    def test_sync_metrics_publishes_counters(self, traced, monkeypatch):
+        from kai_scheduler_tpu.utils.metrics import METRICS
+        METRICS.reset()
+        a = threading.Lock()
+        b = threading.Lock()
+        traced.load_static_graph({"locks": {}, "edges": []})
+        with a:
+            with b:
+                pass
+        locktrace.sync_metrics()
+        assert METRICS.counters[
+            "locktrace_orders_recorded_total"] >= 1
+        assert "locktrace_contradictions_total" not in METRICS.counters
+
+
+class TestValidateObserved:
+    GRAPH = {
+        "locks": {
+            "C.a": [{"file": "kai_scheduler_tpu/utils/x.py", "line": 4}],
+            "C.b": [{"file": "kai_scheduler_tpu/utils/x.py", "line": 5}],
+            "D.c": [{"file": "kai_scheduler_tpu/controllers/y.py",
+                     "line": 9}],
+        },
+        "edges": [["C.a", "C.b"]],
+    }
+
+    def test_green_run(self):
+        dump = {"creations": {"kai_scheduler_tpu/utils/x.py:4": 1,
+                              "kai_scheduler_tpu/utils/x.py:5": 1},
+                "acquires": {"kai_scheduler_tpu/utils/x.py:4": 3,
+                             "kai_scheduler_tpu/utils/x.py:5": 3},
+                "edges": [["kai_scheduler_tpu/utils/x.py:4",
+                           "kai_scheduler_tpu/utils/x.py:5", 3]]}
+        report = locktrace.validate_observed(self.GRAPH, [dump])
+        assert report["ok"]
+        assert report["orders"] == {"C.a -> C.b": 3}
+        assert report["contradictions"] == []
+        assert report["subsystems"]["utils/x"]["acquires"] == 6
+
+    def test_contradiction_fails(self):
+        dump = {"creations": {}, "acquires": {},
+                "edges": [["kai_scheduler_tpu/utils/x.py:5",
+                           "kai_scheduler_tpu/utils/x.py:4", 1]]}
+        report = locktrace.validate_observed(self.GRAPH, [dump])
+        assert not report["ok"]
+        assert report["contradictions"][0]["observed"] == ["C.b", "C.a"]
+
+    def test_uncovered_subsystem_fails(self):
+        # D.c was created but never acquired: the sweep proved nothing
+        # about controllers/y.
+        dump = {"creations": {"kai_scheduler_tpu/utils/x.py:4": 1,
+                              "kai_scheduler_tpu/controllers/y.py:9": 1},
+                "acquires": {"kai_scheduler_tpu/utils/x.py:4": 2},
+                "edges": [["kai_scheduler_tpu/utils/x.py:4",
+                           "kai_scheduler_tpu/utils/x.py:5", 1]]}
+        report = locktrace.validate_observed(self.GRAPH, [dump])
+        assert not report["ok"]
+        assert report["uncovered_subsystems"] == ["controllers/y"]
+
+    def test_empty_journal_fails(self):
+        report = locktrace.validate_observed(self.GRAPH, [])
+        assert not report["ok"]
+
+    def test_mutual_observed_orders_fail_even_off_the_static_graph(self):
+        # Seed 1 records C.b -> D.c, seed 2 records D.c -> C.b: neither
+        # direction is in the static graph (the analyzer missed both
+        # paths), so static reachability is silent — but the merged
+        # journals literally contain a deadlock-capable inversion.
+        a = {"creations": {}, "acquires": {},
+             "edges": [["kai_scheduler_tpu/utils/x.py:5",
+                        "kai_scheduler_tpu/controllers/y.py:9", 1]]}
+        b = {"creations": {}, "acquires": {},
+             "edges": [["kai_scheduler_tpu/controllers/y.py:9",
+                        "kai_scheduler_tpu/utils/x.py:5", 2]]}
+        report = locktrace.validate_observed(self.GRAPH, [a, b])
+        assert not report["ok"]
+        assert any("also observed" in c["static_path"]
+                   for c in report["contradictions"])
+
+
+# ---------------------------------------------------------------------------
+# regression tests: the races this PR fixed (one per real bug)
+# ---------------------------------------------------------------------------
+
+class TestFixedRaces:
+    def test_metrics_increments_are_not_lost_across_threads(self):
+        """`counters[key] += v` was a bare read-modify-write: status
+        workers, the commit executor, HTTP handlers, and samplers all
+        increment concurrently, and interleaved RMWs LOSE ticks.  Every
+        mutation now serializes on Metrics._data_lock."""
+        from kai_scheduler_tpu.utils.metrics import Metrics
+        m = Metrics()
+        n_threads, per_thread = 8, 2000
+        barrier = threading.Barrier(n_threads)
+
+        def hammer():
+            barrier.wait()
+            for _ in range(per_thread):
+                m.inc("race_regression_total")
+                m.observe("race_regression_seconds", 0.001)
+
+        threads = [threading.Thread(target=hammer)
+                   for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert m.counters["race_regression_total"] == \
+            n_threads * per_thread
+        assert m.histograms["race_regression_seconds"].n == \
+            n_threads * per_thread
+
+    def test_kubeapi_watch_sync_registration_survives_prune(self):
+        """watch_sync() appended to _sync_watchers with no lock while
+        _emit (under the store lock, on commit/status threads) REBINDS
+        the list to prune dead handlers: a registration landing on the
+        replaced list was silently lost.  Registration now takes the
+        store lock."""
+        from kai_scheduler_tpu.controllers.kubeapi import InMemoryKubeAPI
+        api = InMemoryKubeAPI()
+        # A handler that deregisters immediately: every emit while one
+        # is registered triggers the prune's list rebinding.
+        stop = threading.Event()
+        seen: list = []
+
+        def churn():
+            i = 0
+            while not stop.is_set():
+                api.watch_sync(lambda et, obj: False)  # prune fodder
+                api.create({"kind": "Pod",
+                            "metadata": {"name": f"p{i}"}})
+                i += 1
+
+        t = threading.Thread(target=churn)
+        t.start()
+        try:
+            keepers = []
+            for i in range(200):
+                def keeper(et, obj, _i=i):
+                    seen.append(_i)
+                    return True
+                keepers.append(keeper)
+                api.watch_sync(keeper)
+            stop.set()
+            t.join(timeout=10)
+            # Every keeper must still be registered: one more event must
+            # reach all 200.
+            seen.clear()
+            api.create({"kind": "Pod", "metadata": {"name": "probe"}})
+            assert sorted(seen) == list(range(200))
+        finally:
+            stop.set()
+            t.join(timeout=10)
+
+    def test_elector_late_renew_cannot_resurrect_epoch(self):
+        """release() joins the renewal thread with a TIMEOUT: a renew
+        wedged in a slow API call used to complete afterwards and write
+        is_leader/epoch back over the cleared state — a deposed leader
+        whose writes would pass the fence again.  Election state now
+        serializes on _state_lock and a late renew/try_acquire result is
+        dropped once _stop is set."""
+        from kai_scheduler_tpu.utils.leaderelect import LeaseElector
+
+        class SlowAPI:
+            """In-memory lease store whose update() can be made to block
+            until released — the wedged renew."""
+
+            def __init__(self):
+                self.objects: dict = {}
+                self.block = threading.Event()
+                self.proceed = threading.Event()
+                self.blocking = False
+
+            def create(self, obj):
+                self.objects[obj["metadata"]["name"]] = obj
+
+            def get(self, kind, name, namespace=None):
+                from kai_scheduler_tpu.controllers.kubeapi import NotFound
+                if name not in self.objects:
+                    raise NotFound(name)
+                return self.objects[name]
+
+            def update(self, obj):
+                if self.blocking:
+                    # Wedge exactly ONE update — the in-flight renew.
+                    # release() writes the lease too and must not block,
+                    # or the harness deadlocks the thread under test.
+                    self.blocking = False
+                    self.block.set()           # renew is now in flight
+                    assert self.proceed.wait(timeout=10)
+                self.objects[obj["metadata"]["name"]] = obj
+
+        api = SlowAPI()
+        elector = LeaseElector(api, "sched", "me", retry_period=0.01,
+                               lease_duration=0.5)
+        assert elector.acquire(timeout=2)
+        assert elector.is_leader and elector.epoch == 1
+
+        api.blocking = True                    # wedge the next renew
+        assert api.block.wait(timeout=10)      # renew is mid-update
+        elector.release()                      # join times out; clears
+        assert not elector.is_leader and elector.epoch == 0
+        api.proceed.set()                      # late renew completes
+        if elector._renew_thread is not None:
+            elector._renew_thread.join(timeout=10)
+        # The late result must not touch the cleared election state.
+        assert not elector.is_leader
+        assert elector.epoch == 0
+
+    def test_stale_renewal_generation_dies_after_reacquire(self):
+        """The _stop flag alone cannot fence out a wedged renew: a
+        release() + re-acquire() pair CLEARS _stop again, so a renew
+        that slept through both would see the flag down and keep
+        running beside the new incarnation's loop — and a late
+        try_acquire result could adopt a stale epoch over the new one.
+        Every release() bumps a generation; stale-generation loops
+        exit and stale adoptions are dropped."""
+        from kai_scheduler_tpu.utils.leaderelect import LeaseElector
+
+        class SlowAPI:
+            def __init__(self):
+                self.objects: dict = {}
+                self.block = threading.Event()
+                self.proceed = threading.Event()
+                self.blocking = False
+
+            def create(self, obj):
+                self.objects[obj["metadata"]["name"]] = obj
+
+            def get(self, kind, name, namespace=None):
+                from kai_scheduler_tpu.controllers.kubeapi import NotFound
+                if name not in self.objects:
+                    raise NotFound(name)
+                return self.objects[name]
+
+            def update(self, obj):
+                if self.blocking:
+                    self.blocking = False
+                    self.block.set()
+                    assert self.proceed.wait(timeout=10)
+                self.objects[obj["metadata"]["name"]] = obj
+
+        api = SlowAPI()
+        elector = LeaseElector(api, "sched", "me", retry_period=0.01,
+                               lease_duration=5.0)
+        assert elector.acquire(timeout=2)
+        assert elector.epoch == 1
+        old_thread = elector._renew_thread
+
+        api.blocking = True                    # wedge the next renew
+        assert api.block.wait(timeout=10)
+        elector.release()                      # gen bump; join times out
+        assert elector.acquire(timeout=2)      # new incarnation
+        assert elector.epoch == 2 and elector.is_leader
+        new_thread = elector._renew_thread
+        assert new_thread is not old_thread
+
+        api.proceed.set()                      # wedged renew completes
+        old_thread.join(timeout=10)
+        # The stale loop must DIE (not renew beside the new one), and
+        # a stale-generation adoption must be a no-op.
+        assert not old_thread.is_alive()
+        assert elector._adopt_epoch(99, gen=elector._gen - 1) is False
+        assert elector.epoch == 2 and elector.is_leader
+        elector.release()
+        # try_acquire straight after release(): the lease CAS may land,
+        # but adoption is dropped (stop still set) — it must report
+        # False, not hand back a "leadership" whose fenced writes all
+        # bounce on epoch 0.
+        assert elector.try_acquire() is False
+        assert elector.epoch == 0 and not elector.is_leader
+
+    def test_release_racing_a_winning_acquire_stands_down(self):
+        """release() landing between acquire()'s winning lease CAS and
+        its is_leader/_start_renewal tail used to be silently undone:
+        acquire set is_leader=True and _start_renewal cleared _stop
+        unconditionally, leaving a renewed lease + is_leader + epoch 0
+        AFTER release() returned.  The acquisition tail is now fenced
+        on the generation: the stand-down wins and acquire reports
+        False."""
+        from kai_scheduler_tpu.utils.leaderelect import LeaseElector
+
+        class API:
+            def __init__(self):
+                self.objects: dict = {}
+
+            def create(self, obj):
+                self.objects[obj["metadata"]["name"]] = obj
+
+            def get(self, kind, name, namespace=None):
+                from kai_scheduler_tpu.controllers.kubeapi import NotFound
+                if name not in self.objects:
+                    raise NotFound(name)
+                return self.objects[name]
+
+            def update(self, obj):
+                self.objects[obj["metadata"]["name"]] = obj
+
+        elector = LeaseElector(API(), "sched", "me", retry_period=0.01,
+                               lease_duration=5.0)
+        real = elector.try_acquire
+
+        def cas_then_concurrent_release():
+            ok = real()
+            if ok:
+                # The release lands right after the winning CAS, before
+                # acquire()'s tail runs — the narrowest interleaving of
+                # the documented cross-thread stop path.
+                elector.release()
+            return ok
+
+        elector.try_acquire = cas_then_concurrent_release
+        assert elector.acquire(timeout=2) is False
+        assert not elector.is_leader
+        assert elector.epoch == 0
+        t = elector._renew_thread
+        assert t is None or not t.is_alive()
+
+        # Later window of the same race: release() lands AFTER acquire
+        # set is_leader=True but before renewal armed.  _start_renewal's
+        # arming result is the acquire result — True with no renewal
+        # loop would be a dead leadership.
+        elector.try_acquire = real
+        real_sr = elector._start_renewal
+
+        def release_then_arm(gen):
+            elector.release()
+            return real_sr(gen)
+
+        elector._start_renewal = release_then_arm
+        assert elector.acquire(timeout=2) is False
+        assert not elector.is_leader
+        assert elector.epoch == 0
+        t = elector._renew_thread
+        assert t is None or not t.is_alive()
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-q"]))

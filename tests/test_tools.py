@@ -319,6 +319,35 @@ class TestChaosMatrixDryRun:
         assert "tests/test_pipeline_cycle.py" in out
         assert "tests/test_snapshot_delta.py" in out
 
+    def test_dry_run_races_mode_arms_locktrace(self, capsys, monkeypatch):
+        """--races: the grid shows races=on per seed plus the
+        KAI_LOCKTRACE banner, without building the static lock graph or
+        running anything; composes with the suite-selection modes."""
+        from kai_scheduler_tpu.tools import chaos_matrix
+        monkeypatch.setattr(
+            chaos_matrix.subprocess, "run",
+            lambda *a, **kw: (_ for _ in ()).throw(AssertionError(
+                "dry run must not execute iterations")))
+        rc = chaos_matrix.main(["--dry-run", "--races", "--seeds", "3,5"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert out.count("races=on") == 2
+        assert "KAI_LOCKTRACE=1" in out
+        assert "static kairace lock graph" in out
+        rc = chaos_matrix.main(["--dry-run", "--races", "--pipeline",
+                                "--seeds", "3"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "races=on" in out
+        assert "tests/test_pipeline_cycle.py" in out
+        # Without the flag the validator stays dark (an inherited
+        # KAI_LOCKTRACE env var must not arm it implicitly).
+        rc = chaos_matrix.main(["--dry-run", "--seeds", "3"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "races=off" in out
+        assert "KAI_LOCKTRACE" not in out
+
     def test_dry_run_respects_iterations_default_seeds(self, capsys,
                                                        monkeypatch):
         from kai_scheduler_tpu.tools import chaos_matrix
