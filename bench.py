@@ -642,6 +642,200 @@ def pipeline_ab_main() -> int:
     return 0
 
 
+def columnar_ab_main() -> int:
+    """Columnar host-state A/B (DESIGN §11), one commit, one machine:
+    object-path vs array-native snapshot pairs on the fleet
+    (2000n/4000p) shape and the churn ring.  Identical ``pods_bound``
+    is asserted on the fleet pair; the acceptance artifacts are the
+    ``snapshotted``/``grouped`` phase medians and the direct
+    ``snapshot_build_latency_ms`` median per mode, with
+    ``columnar_fallback_total`` required to stay flat (0 new fallbacks)
+    across the columnar legs."""
+    _enable_compile_cache()
+    import jax
+
+    from kai_scheduler_tpu.utils.metrics import METRICS
+
+    backend = jax.default_backend()
+
+    def _snapshot_build_median(before_counts):
+        h = METRICS.histograms.get("snapshot_build_latency_ms")
+        if h is None:
+            return None
+        delta = {b: h.counts.get(b, 0) - before_counts.get(b, 0)
+                 for b in h.buckets}
+        n = sum(delta.values())
+        if n <= 0:
+            return None
+        target = max(1, -(-n // 2))
+        acc = 0
+        for b in h.buckets:
+            acc += delta[b]
+            if acc >= target:
+                return b
+        return h.buckets[-1]
+
+    def _hist_counts():
+        h = METRICS.histograms.get("snapshot_build_latency_ms")
+        return dict(h.counts) if h is not None else {}
+
+    # Warmup: pay the XLA compiles outside the measured pairs.
+    fleet_phase(200, 4, 50)
+
+    # --- fleet 2000n/4000p pair -------------------------------------------
+    fleet = {}
+    for columnar in (False, True):
+        os.environ["KAI_COLUMNAR"] = "1" if columnar else "0"
+        mode = "columnar" if columnar else "object"
+        fb0 = METRICS.counters.get("columnar_fallback_total", 0)
+        h0 = _hist_counts()
+        r = fleet_phase(2000, 8, 500)
+        fleet[columnar] = r
+        fallbacks = METRICS.counters.get(
+            "columnar_fallback_total", 0) - fb0
+        medians = r["pod_latency"].get("phase_median_ms", {})
+        row = {"scenario": "fleet-columnar-ab", "backend": backend,
+               "mode": mode, "config": r["config"],
+               "warm_cycle_s": r["warm_cycle_s"],
+               "cold_wave_s": r["cold_wave_s"],
+               "warm_wave_s": r.get("warm_wave_s"),
+               "pods_bound": r["pod_latency"].get("bound_pods"),
+               "snapshotted_median_ms": medians.get("snapshotted"),
+               "grouped_median_ms": medians.get("grouped"),
+               "snapshot_build_median_ms": _snapshot_build_median(h0),
+               "p50_submit_bound_ms":
+                   r["pod_latency"].get("submit_to_bound_p50_ms"),
+               "p99_submit_bound_ms":
+                   r["pod_latency"].get("submit_to_bound_p99_ms"),
+               "columnar_fallbacks": fallbacks}
+        _append_result_row(row)
+        _log(f"fleet columnar A/B {mode}: warm {r['warm_cycle_s']}s, "
+             f"snapshotted {medians.get('snapshotted')}ms, grouped "
+             f"{medians.get('grouped')}ms, fallbacks {fallbacks}")
+        if columnar:
+            assert fallbacks == 0, \
+                f"columnar fleet leg took {fallbacks} fallback(s)"
+    assert fleet[False]["pod_latency"].get("bound_pods") == \
+        fleet[True]["pod_latency"].get("bound_pods"), \
+        "columnar fleet bound a different pod count than object path"
+    m0 = fleet[False]["pod_latency"]["phase_median_ms"]
+    m1 = fleet[True]["pod_latency"]["phase_median_ms"]
+    _log(f"fleet snapshotted median: object {m0.get('snapshotted')}ms "
+         f"-> columnar {m1.get('snapshotted')}ms "
+         f"({m0.get('snapshotted', 0) / max(m1.get('snapshotted', 1), 1e-9):.2f}x); "
+         f"grouped {m0.get('grouped')}ms -> {m1.get('grouped')}ms")
+
+    # --- fleet steady-state pair, interleaved ------------------------------
+    # The wave pair above binds its 4000 pods in one or two mega-cycles,
+    # so its phase medians carry 1-2 samples each and the noise of a
+    # shared host.  The steady pair is the controlled experiment: both
+    # Systems live in ONE process, 2000n/4000p bound, and the cycles
+    # interleave object/columnar sample by sample — host drift and GC
+    # spikes land on both modes equally, and every number is an exact
+    # perf_counter median over the interleaved samples.
+    def _build_steady(columnar):
+        os.environ["KAI_COLUMNAR"] = "1" if columnar else "0"
+        from kai_scheduler_tpu.controllers import (System, SystemConfig,
+                                                   make_pod, owner_ref)
+        system = System(SystemConfig())
+        api = system.api
+        for i in range(2000):
+            api.create({"kind": "Node",
+                        "metadata": {"name": f"sn{i:05d}"}, "spec": {},
+                        "status": {"allocatable": {
+                            "cpu": "32", "memory": "256Gi",
+                            "nvidia.com/gpu": 8, "pods": 110}}})
+        for q in range(8):
+            api.create({"kind": "Queue",
+                        "metadata": {"name": f"fq{q}"}, "spec": {}})
+        for j in range(8):
+            name = f"steady-j{j}"
+            api.create({
+                "kind": "PyTorchJob", "apiVersion": "kubeflow.org/v1",
+                "metadata": {"name": name, "uid": f"{name}-uid",
+                             "labels": {"kai.scheduler/queue":
+                                        f"fq{j % 8}"}},
+                "spec": {"pytorchReplicaSpecs": {
+                    "Worker": {"replicas": 500}}}})
+            ref = owner_ref("PyTorchJob", name, uid=f"{name}-uid",
+                            api_version="kubeflow.org/v1")
+            for k in range(500):
+                api.create(make_pod(
+                    f"{name}-worker-{k:04d}", owner=ref,
+                    gpu=1 if j % 2 == 0 else 0,
+                    labels={"training.kubeflow.org/replica-type":
+                            "worker"}))
+        for _ in range(8):
+            system.run_cycle()
+        bound = sum(1 for p in api.list("Pod")
+                    if p["spec"].get("nodeName"))
+        return system, bound
+
+    systems = {}
+    for columnar in (False, True):
+        systems[columnar] = _build_steady(columnar)
+    assert systems[False][1] == systems[True][1] == 4000, \
+        "steady A/B: both modes must bind the full 4000-pod fleet"
+    samples = {False: {"snap": [], "cycle": []},
+               True: {"snap": [], "cycle": []}}
+    # NOTE: the mode is fixed at ClusterCache construction (the env var
+    # is read once in _build_steady); nothing mode-dependent happens per
+    # rep here — the two Systems simply interleave their samples.
+    for _rep in range(9):
+        for columnar in (False, True):
+            system, _ = systems[columnar]
+            cache = system.schedulers[0].cache
+            t0 = time.perf_counter()
+            cache.snapshot()
+            samples[columnar]["snap"].append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            system.run_cycle()
+            samples[columnar]["cycle"].append(time.perf_counter() - t0)
+    steady = {}
+    for columnar in (False, True):
+        mode = "columnar" if columnar else "object"
+        snap_ms = float(np.median(samples[columnar]["snap"])) * 1000.0
+        cyc_ms = float(np.median(samples[columnar]["cycle"])) * 1000.0
+        steady[columnar] = (snap_ms, cyc_ms)
+        _append_result_row({
+            "scenario": "fleet-steady-columnar-ab", "backend": backend,
+            "mode": mode, "config": "2000nodes_4000pods_steady",
+            "samples": len(samples[columnar]["snap"]),
+            "interleaved": True,
+            "snapshot_build_median_ms": round(snap_ms, 1),
+            "steady_cycle_median_ms": round(cyc_ms, 1),
+            "pods_bound": systems[columnar][1]})
+    _log(f"fleet steady (interleaved): snapshot build "
+         f"{steady[False][0]:.0f}ms -> {steady[True][0]:.0f}ms "
+         f"({steady[False][0] / max(steady[True][0], 1e-9):.2f}x); "
+         f"cycle {steady[False][1]:.0f}ms -> {steady[True][1]:.0f}ms "
+         f"({steady[False][1] / max(steady[True][1], 1e-9):.2f}x)")
+    del systems
+
+    # --- churn ring pair ---------------------------------------------------
+    for columnar in (False, True):
+        os.environ["KAI_COLUMNAR"] = "1" if columnar else "0"
+        mode = "columnar" if columnar else "object"
+        fb0 = METRICS.counters.get("columnar_fallback_total", 0)
+        h0 = _hist_counts()
+        row = churn_phase()
+        fallbacks = METRICS.counters.get(
+            "columnar_fallback_total", 0) - fb0
+        _append_result_row({
+            "scenario": "churn-columnar-ab", "backend": backend,
+            "mode": mode,
+            "snapshot_build_median_ms": _snapshot_build_median(h0),
+            "columnar_fallbacks": fallbacks, **row})
+        _log(f"churn columnar A/B {mode}: cycle {row['cycle_s']}s, p99 "
+             f"{row['pod_latency'].get('submit_to_bound_p99_ms')}ms, "
+             f"fallbacks {fallbacks}")
+        if columnar:
+            assert fallbacks == 0, \
+                f"columnar churn leg took {fallbacks} fallback(s)"
+    os.environ.pop("KAI_COLUMNAR", None)
+    return 0
+
+
 def forest_parent_indices(n_queues, roots=16, fanouts=(2, 2, 2, 2, 2, 8)):
     """Parent index per queue (-1 = root) for the multi-tenant org
     forest: ``roots`` top-level tenants, breadth-first fanout per depth
@@ -1896,6 +2090,12 @@ if __name__ == "__main__":
         # identical pods_bound asserted, plus the pipelined churn ring
         # carrying p99 submit→bound, appended to results.jsonl.
         sys.exit(pipeline_ab_main())
+    elif "--columnar-ab" in sys.argv:
+        # Columnar host-state A/B (DESIGN §11): object-path vs
+        # array-native snapshot pairs on the fleet (2000n/4000p) shape
+        # and the churn ring, identical pods_bound asserted, appended
+        # to results.jsonl.
+        sys.exit(columnar_ab_main())
     elif "--reclaim-ab" in sys.argv:
         # Same-commit reclaim eviction-write A/B: per-victim synchronous
         # writes vs the batched evict_many path, appended to

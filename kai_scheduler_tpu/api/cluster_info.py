@@ -55,7 +55,8 @@ class ClusterInfo:
                  storage_classes: dict | None = None,
                  storage_claims: dict | None = None,
                  storage_capacities: dict | None = None,
-                 device_classes: dict | None = None):
+                 device_classes: dict | None = None,
+                 prewired: bool = False):
         self.nodes: dict[str, NodeInfo] = nodes or {}
         self.podgroups: dict[str, PodGroupInfo] = podgroups or {}
         self.queues: dict[str, QueueInfo] = queues or {}
@@ -90,11 +91,23 @@ class ClusterInfo:
         # pack path.  None (the default, and what clones/filters carry)
         # means "pack from scratch".
         self.arena_stamp: int | None = None
+        # Columnar fast-path hints (controllers/cache_builder.py
+        # _snapshot_columnar): exact facts about the pod population
+        # ("no pod carries a selector/affinity term/host port",
+        # precomputed max toleration width) that let pack() and the
+        # per-cycle plugin scans skip their O(pods) walks with identical
+        # results.  None on every other construction path (clones,
+        # filters, tests) — consumers must treat absence as "walk".
+        self.columnar_hints: dict | None = None
         # Stable orderings for tensor packing.
         self.node_order: list[str] = sorted(self.nodes)
         for i, name in enumerate(self.node_order):
             self.nodes[name].idx = i
-        self._wire_tasks_to_nodes()
+        if not prewired:
+            # The columnar snapshot path pre-wires placement accounting
+            # as one vectorized segment reduction (bit-identical to this
+            # walk); every other constructor wires per task here.
+            self._wire_tasks_to_nodes()
         if self.storage_capacities or self.storage_claims:
             from .storage_info import link_storage_objects
             link_storage_objects(self.storage_claims,

@@ -122,12 +122,16 @@ def build_codec(cluster: ClusterInfo,
     # Label keys constrained by ANY pod need columns — scenario simulation
     # re-encodes evicted (non-candidate) tasks for re-placement, so the
     # vocabulary must cover every pod (candidates included), not just this
-    # cycle's candidate list.
-    for pg in cluster.podgroups.values():
-        for t in pg.pods.values():
-            if t.node_selector:
-                for k in t.node_selector:
-                    codec.key_col(k)
+    # cycle's candidate list.  A columnar snapshot proves the whole pod
+    # population selector-free up front (DESIGN §11) — same empty key
+    # set, no O(pods) walk.
+    hints = getattr(cluster, "columnar_hints", None)
+    if not (hints and hints.get("no_selectors")):
+        for pg in cluster.podgroups.values():
+            for t in pg.pods.values():
+                if t.node_selector:
+                    for k in t.node_selector:
+                        codec.key_col(k)
     for node in cluster.nodes.values():
         if node.labels:
             for k, v in node.labels.items():
@@ -265,9 +269,16 @@ def pack(cluster: ClusterInfo,
     codec = build_codec(cluster, tasks)
     L = max(1, codec.num_cols)
     max_taints = max([len(n.taints) for n in cluster.nodes.values()] + [1])
-    # Toleration width covers every pod (scenario re-encoding needs it).
-    max_tols = max([len(t.tolerations) for pg in cluster.podgroups.values()
-                    for t in pg.pods.values()] + [1])
+    # Toleration width covers every pod (scenario re-encoding needs it);
+    # a columnar snapshot carries the exact width as a hint (the same
+    # max over the same population, reduced on the column).
+    hints = getattr(cluster, "columnar_hints", None)
+    if hints and "max_tols" in hints:
+        max_tols = hints["max_tols"]
+    else:
+        max_tols = max([len(t.tolerations)
+                        for pg in cluster.podgroups.values()
+                        for t in pg.pods.values()] + [1])
 
     node_names = cluster.node_order
     n = len(node_names)

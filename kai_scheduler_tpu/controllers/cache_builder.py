@@ -13,6 +13,8 @@ from __future__ import annotations
 import itertools
 import re
 
+import numpy as np
+
 from ..api import (ClusterInfo, NodeInfo, PodGroupInfo, PodInfo, PodSet,
                    PodStatus, QueueInfo, QueueQuota, resources as rs)
 from ..api.resources import ResourceRequirements
@@ -514,6 +516,54 @@ class ClusterCache:
         # terms), which dominates snapshot cost at fleet scale.
         # kairace: single-writer=main
         self._pod_cache: dict = {}
+        # -- columnar manifest store (framework/columnar.py, DESIGN §11) --
+        # Struct-of-arrays pod columns maintained O(delta) from the same
+        # change stream as the mirrors; snapshot() takes an array-native
+        # fast path over them (vectorized accounting + fast-instantiated
+        # views, bit-identical to the object walk) and falls back to the
+        # object path wholesale on resync / vocab overflow / feature-
+        # bearing pods (columnar_fallback_total counts these).  All
+        # column mutations happen in _apply_changes/_refresh_full on the
+        # scheduler thread.
+        # kairace: single-writer=main
+        import os as _os
+        self._columnar_enabled = _os.environ.get(
+            "KAI_COLUMNAR", "1") not in ("0", "false", "off")
+        from ..framework.columnar import ColumnarPods, VocabOverflow
+        # kairace: single-writer=main
+        self._columnar = ColumnarPods() if self._columnar_enabled else None
+        self._vocab_overflow_exc = VocabOverflow
+        # Delta events accumulated across apply attempts (uids of
+        # changed/removed pods + touched PodGroup names): consumed by
+        # snapshot() only after a SUCCESSFUL fold, so a re-queued batch
+        # (exception mid-apply) never loses the events its completed
+        # keys already recorded — the retry's sig-match skip would
+        # otherwise leave them invisible to the O(delta) candidates
+        # scan.
+        # kairace: single-writer=main
+        self._pending_col_events: dict = {
+            "pods_changed": set(), "pods_removed": set(),
+            "groups": set()}
+        # Overlay sig components applied by the LAST snapshot (uid ->
+        # ("bind"|"evict", node)): the columnar path diffs against this
+        # to find pods whose effective state moved without a manifest
+        # change (speculative entries appearing/expiring).
+        # kairace: single-writer=main
+        self._prev_overlay: dict = {}
+        # Cached snapshot-order row index: (store.version, id(order
+        # list)) -> np.ndarray of rows, rebuilt only on membership
+        # change.
+        self._col_rows_cache: tuple | None = None
+        # Queue record batch (columnar fast path): stacked quota
+        # matrices + precomputed children/ancestor tables, rebuilt only
+        # when a Queue manifest changes — the per-cycle QueueInfo build
+        # then slices rows out of three wholesale matrix copies instead
+        # of copying three arrays per queue (the dominant snapshot cost
+        # at the 10k-queue churn shape).
+        # kairace: single-writer=main
+        self._queue_cols: dict | None = None
+        # Last columnar-path verdict for /debug/cycles + stats.
+        self.last_columnar_stats: dict = {}
         # (owner, expression) pairs already warned about: an unsupported
         # CEL selector is re-parsed every snapshot, but the user should
         # see ONE loud event per expression, not one per cycle.
@@ -577,12 +627,20 @@ class ClusterCache:
         return selectors
 
     def _parse_pod(self, pod: dict) -> PodInfo:
+        """Fresh per-cycle PodInfo for ``pod`` (template-memoized)."""
+        return self._parse_pod_template(pod).instantiate()
+
+    def _parse_pod_template(self, pod: dict) -> PodInfo:
+        """The IMMUTABLE parsed template for ``pod``, cached per
+        uid+resourceVersion — what the columnar store keeps per row
+        (``_col_upsert``); per-cycle instances derive from it via
+        ``instantiate``/``instantiate_fast`` and may mutate freely."""
         md = pod["metadata"]
         uid = md.get("uid", md["name"])
         rv = md.get("resourceVersion")
         cached = self._pod_cache.get(uid)
         if cached is not None and rv is not None and cached[0] == rv:
-            return cached[1].instantiate()
+            return cached[1]
         phase = pod.get("status", {}).get("phase", "Pending")
         status = PHASE_TO_STATUS.get(phase, PodStatus.UNKNOWN)
         if (status == PodStatus.PENDING
@@ -613,15 +671,14 @@ class ClusterCache:
         if gpu_group:
             task.gpu_group = gpu_group
         if rv is not None and md.get("resourceVersion") == rv:
-            # Template is a dedicated instance: the returned task mutates
-            # during the cycle (statements), the template never does.
-            # instantiate() shares the immutable pieces, so the memoized
-            # request vectors survive across cycles.  The rv re-check
-            # guards the overlapped pipeline: a commit-executor patch
-            # racing this parse (live dicts, in-memory store) must not
-            # persist a torn read under the pre-bump resourceVersion —
-            # uncached, the next snapshot re-parses the settled object.
-            self._pod_cache[uid] = (rv, task.instantiate())
+            # The parsed object IS the template: callers receive
+            # instantiate() copies, so the template never mutates.  The
+            # rv re-check guards the overlapped pipeline: a
+            # commit-executor patch racing this parse (live dicts,
+            # in-memory store) must not persist a torn read under the
+            # pre-bump resourceVersion — uncached, the next snapshot
+            # re-parses the settled object.
+            self._pod_cache[uid] = (rv, task)
         return task
 
     # -- snapshot ------------------------------------------------------------
@@ -659,6 +716,16 @@ class ClusterCache:
         self._aux = {}
         self._aux_dirty = {f: True for f in self._aux_dirty}
         self._pod_cache = {}
+        if self._columnar is not None:
+            # The columns rebuild with the mirrors at the next priming
+            # re-list; clearing also resets the interned vocabularies
+            # (the only recovery from a vocab overflow).
+            self._columnar.clear()
+        self._col_rows_cache = None
+        self._queue_cols = None
+        self._pending_col_events = {"pods_changed": set(),
+                                    "pods_removed": set(),
+                                    "groups": set()}
         with self._changes_lock:
             self._changed_keys = set()
         self._primed = False
@@ -668,21 +735,60 @@ class ClusterCache:
             changes, self._changed_keys = self._changed_keys, set()
         return changes
 
+    def _col_upsert(self, key: tuple, obj: dict,
+                    events: dict) -> str | None:
+        """Fold one pod manifest into the columnar store; returns the
+        pod's uid.  A same-name recreate's replaced uid is accounted as
+        removed (its signature must reap).  A vocab overflow latches in
+        the store (the snapshot gate checks it) — the mirror fold must
+        still proceed, so the object path stays authoritative."""
+        store = self._columnar
+        if store is None:
+            return None
+        tmpl = self._parse_pod_template(obj)
+        group = obj["metadata"].get("labels", {}).get(POD_GROUP_LABEL)
+        try:
+            replaced = store.upsert(key, self._sig_rv(obj), tmpl, group)
+        except self._vocab_overflow_exc:
+            return tmpl.uid
+        if replaced is not None:
+            events["pods_removed"].add(replaced)
+        return tmpl.uid
+
+    def _col_remove(self, key: tuple, events: dict) -> None:
+        store = self._columnar
+        if store is None:
+            return
+        uid = store.remove(key)
+        if uid is not None:
+            events["pods_removed"].add(uid)
+
     def _apply_changes(self, changes: set) -> dict:
-        """Fold accumulated dirty keys into the mirrors (watch mode).
-        Returns per-kind changed counts.  On ANY exception the whole
-        batch is re-queued (folding is idempotent): a half-applied delta
-        must not vanish — an object it carried would stay invisible to
-        scheduling until the next resync."""
+        """Fold accumulated dirty keys into the mirrors (watch mode) and
+        the columnar store; delta events (changed/removed pod uids +
+        touched PodGroup names — the columnar snapshot's O(delta) dirty
+        source) accumulate in ``_pending_col_events``.  On ANY exception
+        the whole batch is re-queued (folding is idempotent): a
+        half-applied delta must not vanish — an object it carried would
+        stay invisible to scheduling until the next resync.  Within one
+        key the columnar fold + event record happen BEFORE the
+        mirror/sig write, so a retry's sig-match skip can only ever skip
+        keys whose columnar state and events already landed."""
         changed = {k: 0 for k in _HOT_KINDS}
+        events = self._pending_col_events
         try:
             for kind, ns, name in changes:
                 key = (ns, name)
                 mirror = self._mirror[kind]
                 obj = self.api.get_opt(kind, name, ns)
                 if obj is None:
-                    if mirror.pop(key, None) is None:
+                    if key not in mirror:
                         continue  # created+deleted between snapshots
+                    if kind == "Pod":
+                        self._col_remove(key, events)
+                    elif kind == "PodGroup":
+                        events["groups"].add(name)
+                    mirror.pop(key, None)
                     self._kind_sigs[kind].pop(key, None)
                     self._order_stale[kind] = True
                     self._drop_template(kind, name)
@@ -694,6 +800,12 @@ class ClusterCache:
                         # priming list): state already folded — counting
                         # it would force a spurious arena rebuild.
                         continue
+                    if kind == "Pod":
+                        uid = self._col_upsert(key, obj, events)
+                        if uid is not None:
+                            events["pods_changed"].add(uid)
+                    elif kind == "PodGroup" and key not in mirror:
+                        events["groups"].add(name)
                     if key not in mirror:
                         self._order_stale[kind] = True
                     mirror[key] = obj
@@ -723,9 +835,13 @@ class ClusterCache:
     def _refresh_full(self) -> dict:
         """Fallback / priming path: re-list every consumed kind and diff
         resourceVersions.  The parse templates still memoize, so even
-        this path never re-parses an unchanged manifest."""
+        this path never re-parses an unchanged manifest.  Delta events
+        accumulate in ``_pending_col_events`` exactly as in
+        ``_apply_changes``, so the columnar fast path works on re-list
+        substrates too."""
         METRICS.inc("cluster_cache_full_refresh_total")
         changed = {k: 0 for k in _HOT_KINDS}
+        events = self._pending_col_events
         for kind in _CONSUMED_KINDS:
             sigs = {}
             mirror = {}
@@ -739,10 +855,20 @@ class ClusterCache:
                 sigs[key] = sig
                 if old_sigs.get(key) != sig:
                     n_changed += 1
+                    if kind == "Pod":
+                        uid = self._col_upsert(key, obj, events)
+                        if uid is not None:
+                            events["pods_changed"].add(uid)
+                    elif kind == "PodGroup" and key not in old_sigs:
+                        events["groups"].add(key[1])
             n_changed += sum(1 for key in old_sigs if key not in sigs)
             for key in old_sigs:
                 if key not in sigs:
                     self._drop_template(kind, key[1])
+                    if kind == "Pod":
+                        self._col_remove(key, events)
+                    elif kind == "PodGroup":
+                        events["groups"].add(key[1])
             if mirror.keys() != self._mirror[kind].keys():
                 self._order_stale[kind] = True
             self._mirror[kind] = mirror
@@ -802,7 +928,7 @@ class ClusterCache:
 
     def _parse_queue(self, q: dict) -> QueueInfo:
         spec = q.get("spec", {})
-        return QueueInfo(
+        info = QueueInfo(
             q["metadata"]["name"],
             parent=spec.get("parentQueue"),
             priority=spec.get("priority", 0),
@@ -814,6 +940,14 @@ class ClusterCache:
                 over_quota_weight=spec.get("overQuotaWeight", 1.0)),
             preempt_min_runtime=spec.get("preemptMinRuntime"),
             reclaim_min_runtime=spec.get("reclaimMinRuntime"))
+        # Spec-level signature RIDES THE TEMPLATE (never a side table):
+        # every consumer of the parse — object path, columnar path,
+        # template drops, wholesale invalidation — stays coherent by
+        # construction, because a re-parse always carries its own spec's
+        # signature (the columnar build compares against exactly this).
+        info._spec_sig = repr((spec, q["metadata"].get(
+            "creationTimestamp")))
+        return info
 
     def _build_queues(self) -> dict:
         mirror = self._mirror["Queue"]
@@ -841,6 +975,102 @@ class ClusterCache:
             if q.parent and q.parent in queues \
                     and name not in queues[q.parent].children:
                 queues[q.parent].children.append(name)
+        return queues
+
+    def _build_queues_columnar(self) -> dict:
+        """Array-native ``_build_queues`` (DESIGN §11): quota vectors
+        live as stacked [Q, R] matrices rebuilt only when a Queue
+        manifest changes; each cycle copies the three matrices WHOLESALE
+        and hands every QueueInfo row views — same values, same
+        per-cycle isolation (plugins divide quota in place), a fraction
+        of the 3-arrays-per-queue copy cost at 10k queues.  Children
+        lists and parent-chain (ancestor) tables precompute with the
+        batch; the proportion roll-up reuses the chains."""
+        order = self._iter_order("Queue")
+        mirror = self._mirror["Queue"]
+        tmpls = self._queue_tmpl
+        templates = []
+        for key in order:
+            q = mirror[key]
+            name = q["metadata"]["name"]
+            sig = self._sig_rv(q)
+            ent = tmpls.get(name)
+            if ent is None or ent[0] != sig:
+                spec_sig = repr((q.get("spec"),
+                                 q["metadata"].get("creationTimestamp")))
+                if ent is not None \
+                        and getattr(ent[1], "_spec_sig",
+                                    None) == spec_sig:
+                    # Status-only churn: the rv moved but nothing
+                    # QueueInfo reads did — keep the template (and the
+                    # stacked rows derived from it).  The signature
+                    # lives ON the template (see _parse_queue), so an
+                    # object-path re-parse in between can never leave a
+                    # stale match behind.
+                    ent = (sig, ent[1])
+                else:
+                    ent = (sig, self._parse_queue(q))
+                tmpls[name] = ent
+            templates.append(ent[1])
+        if len(tmpls) > len(templates):
+            live = {key[1] for key in order}
+            self._queue_tmpl = {n: e for n, e in tmpls.items()
+                                if n in live}
+        qc = self._queue_cols
+        same = (qc is not None and qc["order"] is order
+                and len(qc["templates"]) == len(templates)
+                and all(a is b for a, b in zip(qc["templates"],
+                                               templates)))
+        if not same:
+            n = len(templates)
+            if n:
+                des = np.stack([t.quota.deserved for t in templates])
+                lim = np.stack([t.quota.limit for t in templates])
+                oqw = np.stack([t.quota.over_quota_weight
+                                for t in templates])
+            else:
+                des = lim = oqw = np.zeros((0, rs.NUM_RES))
+            pos = {t.name: i for i, t in enumerate(templates)}
+            children: list = [[] for _ in range(n)]
+            for t in templates:
+                if t.parent and t.parent in pos:
+                    children[pos[t.parent]].append(t.name)
+            # Ancestor chains (own idx first) for the proportion
+            # roll-up's expanded add.at — identical to the per-queue
+            # parent walk.
+            chains = []
+            depth = 1
+            for i, t in enumerate(templates):
+                chain = [i]
+                seen = {i}
+                parent = t.parent
+                while parent:
+                    j = pos.get(parent)
+                    if j is None or j in seen:
+                        break
+                    chain.append(j)
+                    seen.add(j)
+                    parent = templates[j].parent
+                chains.append(chain)
+                depth = max(depth, len(chain))
+            anc = np.full((n, depth), -1, np.int64)
+            for i, chain in enumerate(chains):
+                anc[i, :len(chain)] = chain
+            self._queue_cols = qc = {
+                "order": order, "templates": templates, "des": des,
+                "lim": lim, "oqw": oqw, "children": children,
+                "anc": anc}
+        templates = qc["templates"]
+        des = qc["des"].copy()
+        lim = qc["lim"].copy()
+        oqw = qc["oqw"].copy()
+        children = qc["children"]
+        queues = {}
+        for i, t in enumerate(templates):
+            queues[t.name] = QueueInfo(
+                t.uid, t.name, t.parent, list(children[i]), t.priority,
+                t.creation_ts, QueueQuota(des[i], lim[i], oqw[i]),
+                t.preempt_min_runtime, t.reclaim_min_runtime)
         return queues
 
     def _parse_group(self, pg_obj: dict) -> _GroupTmpl:
@@ -888,7 +1118,10 @@ class ClusterCache:
         return podgroups
 
     def snapshot(self) -> ClusterInfo:
+        import time as _time
+        t0 = _time.perf_counter()
         arena = self.arena
+        resync_fired = False
         if self._resync_pending:
             # Deferred watch-gap invalidation (see _on_watch_resync):
             # rebind, don't clear() — the watch thread may set the flag
@@ -900,6 +1133,8 @@ class ClusterCache:
             self._resync_pending = False
             self._wholesale_invalidate()
             arena.invalidate("watch-resync")
+            resync_fired = True
+        was_primed = self._primed
         if self._watch_mode and self._primed:
             changed = self._apply_changes(self._take_changes())
         else:
@@ -911,6 +1146,13 @@ class ClusterCache:
             self._take_changes()
             changed = self._refresh_full()
             self._primed = True
+        # Consume the fold's accumulated delta events only now, after
+        # it SUCCEEDED — events recorded by a re-queued (failed) apply
+        # survive here for the retry's snapshot.
+        events = self._pending_col_events
+        self._pending_col_events = {"pods_changed": set(),
+                                    "pods_removed": set(),
+                                    "groups": set()}
         if changed["Node"]:
             # Any Node add/remove/modify is a topology-class change: the
             # static arrays, label/taint codec, and node axis may all
@@ -922,6 +1164,400 @@ class ClusterCache:
         if changed["PodGroup"]:
             arena.note_tasks()  # job arrays / candidate sets rebuild
 
+        cluster = None
+        reason = self._columnar_verdict(was_primed, resync_fired)
+        if reason is None:
+            try:
+                with TRACER.span("snapshot_columnar",
+                                 kind="snapshot_columnar") as sp:
+                    cluster = self._snapshot_columnar(changed, events, sp)
+            except Exception:
+                # The fast path must degrade, never crash the cycle; the
+                # parity ring (tests/test_columnar_store.py) keeps this
+                # branch honest — it asserts fast-path snapshots DO
+                # happen, so a silent always-fallback fails there.
+                from ..utils.logging import LOG
+                LOG.warning("columnar snapshot failed; falling back to "
+                            "the object path", exc_info=True)
+                reason = "error"
+        if cluster is None:
+            if reason not in ("disabled", "priming"):
+                # Priming/disabled are not degradations; resync, vocab
+                # overflow, feature-bearing pods, and fast-path errors
+                # are — tools/fleet_budget.py gates this at 0 on the
+                # warm fleet shape.
+                METRICS.inc("columnar_fallback_total")
+            self.last_columnar_stats = {"path": "object",
+                                        "reason": reason}
+            cluster = self._snapshot_objects(changed)
+        self.last_snapshot_stats["columnar"] = self.last_columnar_stats
+        METRICS.observe("snapshot_build_latency_ms",
+                        (_time.perf_counter() - t0) * 1000.0)
+        return cluster
+
+    def _columnar_verdict(self, was_primed: bool,
+                          resync_fired: bool) -> str | None:
+        """None = take the array-native path; otherwise the fallback
+        reason (DESIGN §11 invalidation table)."""
+        if not self._columnar_enabled:
+            return "disabled"
+        if resync_fired:
+            return "resync"
+        if not was_primed:
+            return "priming"
+        store = self._columnar
+        if store.overflowed:
+            return "vocab-overflow"
+        from ..framework.columnar import FLAG_COMPLEX
+        if np.count_nonzero(
+                store.flags[:store.n_alloc] & FLAG_COMPLEX):
+            # Fractional/MIG/gpu-memory/storage/affinity-bearing pods
+            # need accounting the vectorized path does not model.
+            return "complex-pods"
+        if self._mirror["PersistentVolumeClaim"] \
+                or self._mirror["CSIStorageCapacity"]:
+            # Schedule-time CSI storage links claims onto pods and nodes
+            # at snapshot build — object path only.
+            return "storage"
+        return None
+
+    def _build_cluster(self, nodes: dict, podgroups: dict, queues: dict,
+                       prewired: bool) -> ClusterInfo:
+        """Shared tail of both snapshot paths: per-cycle aux views at
+        clone depths + the ClusterInfo itself."""
+        aux = self._build_aux()
+        # Per-cycle views of the aux caches, at exactly the copy depths
+        # ClusterInfo.clone() uses (sessions mutate these containers the
+        # same way they mutate a clone's).
+        topologies = dict(aux["topologies"])
+        resource_claims = {k: dict(v)
+                           for k, v in aux["resource_claims"].items()}
+        resource_slices = {n: {c: list(d) for c, d in by_class.items()}
+                           for n, by_class in
+                           aux["resource_slices"].items()}
+        device_classes = dict(aux["device_classes"])
+        config_maps = set(aux["config_maps"])
+        pvcs = {k: dict(v) for k, v in aux["pvcs"].items()}
+        storage_classes = dict(aux["storage_classes"])
+        storage_claims = {k: c.clone()
+                          for k, c in aux["storage_claims"].items()}
+        storage_capacities = {}
+        for uid, cap in aux["storage_capacities"].items():
+            cc = cap.clone()
+            cc.provisioned_pvcs = {}  # re-derived by linking + add_task
+            storage_capacities[uid] = cc
+        return ClusterInfo(nodes, podgroups, queues, topologies,
+                           now=self.now_fn(),
+                           resource_claims=resource_claims,
+                           config_maps=config_maps, pvcs=pvcs,
+                           resource_slices=resource_slices,
+                           storage_classes=storage_classes,
+                           storage_claims=storage_claims,
+                           storage_capacities=storage_capacities,
+                           device_classes=device_classes,
+                           prewired=prewired)
+
+    def _snapshot_columnar(self, changed: dict, events: dict,
+                           span) -> ClusterInfo:
+        """Array-native snapshot build (DESIGN §11): one index build +
+        vectorized segment reductions over the columnar store, with
+        per-cycle ``PodInfo`` views fast-instantiated from row
+        templates.  Bit-identical to ``_snapshot_objects`` — every
+        float accumulation below runs in the SAME order as the object
+        walk it replaces (``np.add.at`` applies sequentially in index
+        order), and the dirty/arena bookkeeping is computed O(delta)
+        from the fold's change events instead of an O(pods) rescan."""
+        from ..framework.columnar import (FLAG_SELECTOR, FLAG_TOLERATIONS,
+                                          _ACTIVE_ALLOCATED, _PENDING,
+                                          _RELEASING)
+        store = self._columnar
+        arena = self.arena
+        _BOUND = int(PodStatus.BOUND)
+        _DONE = (int(PodStatus.SUCCEEDED), int(PodStatus.FAILED),
+                 _RELEASING)
+
+        nodes = self._build_nodes()
+        queues = self._build_queues_columnar()
+        podgroups = self._build_groups()
+
+        ordered_keys = self._iter_order("Pod")
+        rcache = self._col_rows_cache
+        if rcache is not None and rcache[0] == store.version \
+                and rcache[1] is ordered_keys:
+            rows = rcache[2]
+        else:
+            rows = store.live_rows(ordered_keys)
+            self._col_rows_cache = (store.version, ordered_keys, rows)
+
+        # -- index build: group/node id -> snapshot position lookups ----
+        gvocab = store.group_vocab
+        n_gvocab = len(gvocab.strs)
+        glist = list(podgroups.values())
+        gpos_lut = np.full(n_gvocab + 1, -1, np.int64)
+        for pos, pg in enumerate(glist):
+            gid = gvocab.ids.get(pg.uid)
+            if gid is not None:
+                gpos_lut[gid] = pos
+        gids = store.group_id[rows]
+        gpos = gpos_lut[np.where(gids >= 0, gids, n_gvocab)]
+        live_mask = gpos >= 0
+        live = rows[live_mask]
+        # Wire order: groups outer (podgroups insertion order = name
+        # order), pods inner (name order) — the exact walk order of
+        # _wire_tasks_to_nodes / queue_aggregates on the object path.
+        order = np.argsort(gpos[live_mask], kind="stable")
+        wrows = live[order]
+        gpos_w = gpos[live_mask][order]
+
+        status = store.status[wrows]          # fancy index: fresh copy
+        node_ids = store.node_id[wrows]
+        reqs = store.req[wrows]
+        flags = store.flags[wrows]
+
+        node_order = sorted(nodes)
+        node_pos = {name: i for i, name in enumerate(node_order)}
+        nvocab = store.node_vocab
+        nv_lut = np.full(len(nvocab.strs) + 1, -1, np.int64)
+        for name, nid in nvocab.ids.items():
+            idx = node_pos.get(name)
+            if idx is not None:
+                nv_lut[nid] = idx
+        eff_idx = nv_lut[np.where(node_ids >= 0, node_ids,
+                                  len(nvocab.strs))]
+
+        # -- speculative overlay (DESIGN §10), applied on the columns ----
+        with self._changes_lock:
+            speculative = dict(self._speculative) if self._speculative \
+                else {}
+        applied_overlay: dict = {}
+        overlay_names: dict = {}
+        n_overlaid = 0
+        row_pos: dict = {}
+        if speculative:
+            row_pos = {int(r): i for i, r in enumerate(wrows)}
+            for uid, (_seq, kind, node) in speculative.items():
+                srow = store.uid_rows.get(uid)
+                i = row_pos.get(srow) if srow is not None else None
+                if i is None:
+                    continue
+                st = int(status[i])
+                if kind == "bind":
+                    if st == _PENDING and node_ids[i] < 0 \
+                            and node in nodes:
+                        status[i] = _BOUND
+                        eff_idx[i] = node_pos[node]
+                        applied_overlay[uid] = ("bind", node)
+                        overlay_names[i] = node
+                        n_overlaid += 1
+                    elif st == _RELEASING and node_ids[i] < 0 \
+                            and node in nodes:
+                        # Deleted/evicted before the bind echo landed:
+                        # overlay the node, keep the terminal state.
+                        eff_idx[i] = node_pos[node]
+                        applied_overlay[uid] = ("bind", node)
+                        overlay_names[i] = node
+                        n_overlaid += 1
+                elif kind == "evict":
+                    if st not in _DONE:
+                        status[i] = _RELEASING
+                        applied_overlay[uid] = ("evict", node)
+                        n_overlaid += 1
+
+        # -- vectorized accounting (bit-identical: same order, same
+        #    expressions as NodeInfo.add_task / queue_aggregates) -------
+        n_res = reqs.shape[1]
+        n_nodes = len(node_order)
+        active = (status & _ACTIVE_ALLOCATED) > 0
+        releasing = status == _RELEASING
+        pending = status == _PENDING
+        placed = eff_idx >= 0
+        used_mat = np.zeros((n_nodes, n_res))
+        rel_mat = np.zeros((n_nodes, n_res))
+        acct = placed & (active | releasing)
+        np.add.at(used_mat, eff_idx[acct], reqs[acct])
+        relp = placed & releasing
+        np.add.at(rel_mat, eff_idx[relp], reqs[relp])
+        for i, name in enumerate(node_order):
+            nd = nodes[name]
+            nd.used = used_mat[i]
+            nd.releasing = rel_mat[i]
+
+        q_uids = list(queues)
+        qpos = {qid: i for i, qid in enumerate(q_uids)}
+        nq = max(len(q_uids), 1)
+        gq_lut = np.full(max(len(glist), 1) + 1, -1, np.int64)
+        for pos, pg in enumerate(glist):
+            gq_lut[pos] = qpos.get(pg.queue_id, -1)
+        qidx = gq_lut[gpos_w] if gpos_w.size else gpos_w
+        qok = qidx >= 0
+        alloc_mat = np.zeros((nq, n_res))
+        req_mat = np.zeros((nq, n_res))
+        am = qok & active
+        np.add.at(alloc_mat, qidx[am], reqs[am])
+        rm = qok & (active | pending)
+        np.add.at(req_mat, qidx[rm], reqs[rm])
+        allocated = {qid: alloc_mat[i] for i, qid in enumerate(q_uids)}
+        requested = {qid: req_mat[i] for i, qid in enumerate(q_uids)}
+
+        ng = max(len(glist), 1)
+        pend_counts = np.bincount(gpos_w[pending], minlength=ng)
+        rel_counts = np.bincount(gpos_w[releasing], minlength=ng)
+        for pos, pg in enumerate(glist):
+            pg._pending_count = int(pend_counts[pos])
+            pg._releasing_count = int(rel_counts[pos])
+
+        # -- per-cycle views: PodInfo.from_columns per row ---------------
+        node_list = [nodes[name] for name in node_order]
+        tmpl_col = store.tmpl
+        wrows_l = wrows.tolist()
+        gpos_l = gpos_w.tolist()
+        eff_l = eff_idx.tolist()
+        tasks = []
+        for i, row in enumerate(wrows_l):
+            task = tmpl_col[row].instantiate_fast()
+            pg = glist[gpos_l[i]]
+            task.job_id = pg.uid
+            pg.pods[task.uid] = task
+            ps = pg.pod_sets.get(task.subgroup)
+            if ps is None:
+                ps = pg.pod_sets.get("default")
+                if ps is None:
+                    ps = PodSet("default", 1)
+                    pg.pod_sets["default"] = ps
+            ps.pods[task.uid] = task
+            ni = eff_l[i]
+            if ni >= 0:
+                node_list[ni].pod_infos[task.uid] = task
+            tasks.append(task)
+        if applied_overlay:
+            for uid in applied_overlay:
+                i = row_pos[store.uid_rows[uid]]
+                task = tasks[i]
+                task.status = PodStatus(int(status[i]))
+                nm = overlay_names.get(i)
+                if nm:
+                    task.node_name = nm
+
+        # -- pending extras: lifecycle + pipelined nominations -----------
+        seen_uids = set()
+        for i in np.nonzero(pending)[0].tolist():
+            task = tasks[i]
+            pg = glist[gpos_l[i]]
+            seen_uids.add(task.uid)
+            LIFECYCLE.note(task.uid, "snapshotted", podgroup=pg.uid,
+                           queue=pg.queue_id)
+            if task.uid in self._pipelined:
+                node_name, _pgroup = self._pipelined[task.uid]
+                if node_name in nodes:
+                    task.nominated_node = node_name
+        if self._pipelined:
+            self._pipelined = {
+                uid: v for uid, v in self._pipelined.items()
+                if uid in seen_uids}
+        for uid in events["pods_removed"]:
+            self._pod_cache.pop(uid, None)
+
+        # -- O(delta) signature/arena bookkeeping ------------------------
+        candidates = (events["pods_changed"] | events["pods_removed"]
+                      | set(applied_overlay) | set(self._prev_overlay))
+        for gname in events["groups"]:
+            gid = gvocab.ids.get(gname)
+            if gid is not None:
+                for r in rows[gids == gid].tolist():
+                    candidates.add(store.uid[r])
+        for uid in candidates:
+            row = store.uid_rows.get(uid)
+            present = False
+            if row is not None:
+                gid = int(store.group_id[row])
+                present = gid >= 0 and gpos_lut[gid] >= 0
+            prev_sig = self._pod_sigs.get(uid)
+            if not present:
+                if prev_sig is not None:
+                    arena.note_tasks()
+                    if prev_sig[2]:
+                        arena.note_vocab()
+                    if prev_sig[1]:
+                        arena.note_nodes((prev_sig[1],))
+                    LIFECYCLE.mark_vanished(uid)
+                    del self._pod_sigs[uid]
+                continue
+            comp = applied_overlay.get(uid)
+            if comp is not None and comp[0] == "bind":
+                node_name = comp[1]
+            else:
+                node_name = nvocab.str_of(int(store.node_id[row]))
+            vocab = bool(int(store.flags[row])
+                         & (FLAG_SELECTOR | FLAG_TOLERATIONS))
+            sig = ((store.rv[row], comp), node_name, vocab)
+            if prev_sig is None or prev_sig[0] != sig[0]:
+                arena.note_tasks()
+                if sig[2] or (prev_sig is not None and prev_sig[2]):
+                    arena.note_vocab()
+                if prev_sig is not None and prev_sig[1]:
+                    arena.note_nodes((prev_sig[1],))
+                if node_name:
+                    arena.note_nodes((node_name,))
+            self._pod_sigs[uid] = sig
+        self._prev_overlay = applied_overlay
+
+        cluster = self._build_cluster(nodes, podgroups, queues,
+                                      prewired=True)
+        # Exact pod-population facts for pack()'s and the plugins'
+        # O(pods) scans (identical results, no walk).
+        cluster.columnar_hints = {
+            "no_affinity_terms": True,
+            "no_host_ports": True,
+            "no_selectors": not bool(np.any(flags & FLAG_SELECTOR)),
+            "max_tols": int(max(1, store.tol_len[wrows].max()))
+            if wrows.size else 1,
+        }
+        # Memoized queue aggregates (same accumulation order as the
+        # object walk); statement mutations invalidate and recompute
+        # from the materialized objects as usual.
+        cluster._queue_aggregates = (allocated, requested)
+        # Wire-order row batch for plugin-side vectorization (the
+        # proportion roll-up): request rows + queue index + status masks,
+        # exactly the walk's inputs in the walk's order.
+        pre_lut = np.array([bool(pg.preemptible) for pg in glist]
+                           + [True])
+        cluster.columnar_batch = {
+            "q_uids": q_uids,
+            "qidx": qidx,
+            "reqs": reqs,
+            "active": active,
+            "pending": pending,
+            "preemptible": pre_lut[gpos_w] if gpos_w.size
+            else np.zeros(0, bool),
+            # Precomputed ancestor-chain table (own idx first, aligned
+            # with q_uids) for the proportion roll-up.
+            "queue_anc": self._queue_cols["anc"]
+            if self._queue_cols is not None else None,
+        }
+        arena.stamp(cluster)
+        n_dirty = sum(changed.values())
+        METRICS.set_gauge("snapshot_dirty_objects", n_dirty)
+        METRICS.set_gauge("snapshot_columnar_rows", int(wrows.size))
+        self.last_columnar_stats = {
+            "path": "columnar", "reason": "",
+            "rows": int(wrows.size), "dirty_pods": len(candidates),
+            "overlaid": n_overlaid, "store": store.stats(),
+        }
+        span.set(rows=int(wrows.size), dirty=len(candidates),
+                 overlaid=n_overlaid)
+        self.last_snapshot_stats = {
+            "watch_mode": self._watch_mode,
+            "dirty": dict(changed),
+            "store": {"nodes": len(nodes), "queues": len(queues),
+                      "podgroups": len(podgroups),
+                      "pods": len(self._mirror["Pod"])},
+            "speculative_overlaid": n_overlaid,
+        }
+        cluster.cache_stats = self.last_snapshot_stats
+        return cluster
+
+    def _snapshot_objects(self, changed: dict) -> ClusterInfo:
+        arena = self.arena
         nodes = self._build_nodes()
         queues = self._build_queues()
         podgroups = self._build_groups()
@@ -940,6 +1576,7 @@ class ClusterCache:
             speculative = dict(self._speculative) if self._speculative \
                 else {}
         n_overlaid = 0
+        overlay_now: dict = {}
         for pod_key in self._iter_order("Pod"):
             pod = pod_mirror[pod_key]
             group = pod["metadata"].get("labels", {}).get(POD_GROUP_LABEL)
@@ -987,6 +1624,10 @@ class ClusterCache:
             # poisons the codec reuse.  The speculative overlay folds
             # into the rv component: overlay transitions re-dirty the
             # pod even though the manifest's resourceVersion never moved.
+            if spec_entry is not None:
+                # Record the applied component so a later columnar
+                # snapshot can diff overlay transitions O(in-flight).
+                overlay_now[task.uid] = spec_entry[1:]
             sig = ((self._sig_rv(pod),
                     spec_entry[1:] if spec_entry is not None else None),
                    task.node_name,
@@ -1039,39 +1680,10 @@ class ClusterCache:
         # Drop parse-cache entries for vanished pods.
         self._pod_cache = {uid: v for uid, v in self._pod_cache.items()
                            if uid in cache_seen}
+        self._prev_overlay = overlay_now
 
-        aux = self._build_aux()
-
-        # Per-cycle views of the aux caches, at exactly the copy depths
-        # ClusterInfo.clone() uses (sessions mutate these containers the
-        # same way they mutate a clone's).
-        topologies = dict(aux["topologies"])
-        resource_claims = {k: dict(v)
-                           for k, v in aux["resource_claims"].items()}
-        resource_slices = {n: {c: list(d) for c, d in by_class.items()}
-                           for n, by_class in
-                           aux["resource_slices"].items()}
-        device_classes = dict(aux["device_classes"])
-        config_maps = set(aux["config_maps"])
-        pvcs = {k: dict(v) for k, v in aux["pvcs"].items()}
-        storage_classes = dict(aux["storage_classes"])
-        storage_claims = {k: c.clone()
-                          for k, c in aux["storage_claims"].items()}
-        storage_capacities = {}
-        for uid, cap in aux["storage_capacities"].items():
-            cc = cap.clone()
-            cc.provisioned_pvcs = {}  # re-derived by linking + add_task
-            storage_capacities[uid] = cc
-
-        cluster = ClusterInfo(nodes, podgroups, queues, topologies,
-                              now=self.now_fn(),
-                              resource_claims=resource_claims,
-                              config_maps=config_maps, pvcs=pvcs,
-                              resource_slices=resource_slices,
-                              storage_classes=storage_classes,
-                              storage_claims=storage_claims,
-                              storage_capacities=storage_capacities,
-                              device_classes=device_classes)
+        cluster = self._build_cluster(nodes, podgroups, queues,
+                                      prewired=False)
         # Only the arena's LATEST stamped view may pack incrementally; an
         # older ClusterInfo (or one filtered by a shard provider) packs
         # from scratch.

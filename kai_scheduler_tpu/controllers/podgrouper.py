@@ -34,16 +34,47 @@ OWNER_CACHE_CAP = 4096
 
 class PodGrouper:
     def __init__(self, api: InMemoryKubeAPI):
+        import threading
         self.api = api
         # Pending-owner queue: owner key -> {pod key: pod manifest}.
         # Filled by the watch handler, drained once per delivery batch.
         self._pending: dict = {}
         # (ns, kind, name, rv) -> (top_owner, chain) memo.
         self._owner_cache: dict = {}
+        # (okey, owner rv, pod signature) -> PodGroupMetadata for
+        # base-input groupers (models/groupers.grouper_pod_signature):
+        # one metadata derivation per owner batch, not per pod.
+        self._meta_cache: dict = {}
+        # Owner deletions observed at emit time (ANY thread; the lock
+        # guards the handoff) — drained at the top of drain_pending,
+        # where matching memo entries evict.  Without this, an owner
+        # DELETED and recreated at a LOWER rv by the apiserver after a
+        # restart could be served from the stale (ns,kind,name,rv) memo.
+        self._evict_lock = threading.Lock()
+        self._evicted_owners: list = []
         # Whether the most recent resolve_top_owner synthesized a parent
         # (drain_pending must then resolve per pod, not per owner).
         self._last_walk_synthesized = False
         api.watch("Pod", self._on_pod)
+        watch_sync = getattr(api, "watch_sync", None)
+        if watch_sync is not None:
+            import weakref
+            wref = weakref.ref(self)
+
+            def _owner_event(event_type, obj):
+                grouper = wref()
+                if grouper is None:
+                    return False  # grouper replaced: deregister
+                if event_type == "DELETED" \
+                        and obj.get("kind") not in ("Pod", "Event"):
+                    md = obj.get("metadata", {})
+                    with grouper._evict_lock:
+                        grouper._evicted_owners.append(
+                            (md.get("namespace", "default"),
+                             obj.get("kind"), md.get("name")))
+                return True
+
+            watch_sync(_owner_event)
         idle = getattr(api, "on_drain_idle", None)
         self._coalesced = idle is not None
         if idle is not None:
@@ -82,30 +113,78 @@ class PodGrouper:
             # (per-event, the pre-coalescing behavior).
             self.drain_pending()
 
+    def _apply_owner_evictions(self) -> None:
+        """Fold emit-time owner deletions into the memos: every cached
+        resolution or metadata touching a deleted owner identity is
+        dropped, so a same-name owner recreated at a LOWER resource-
+        version (apiserver restart resets the counter) can never be
+        served a stale chain."""
+        with self._evict_lock:
+            if not self._evicted_owners:
+                return
+            evicted, self._evicted_owners = self._evicted_owners, []
+        dead = set(evicted)
+        self._owner_cache = {
+            k: v for k, v in self._owner_cache.items()
+            if (k[0], k[1], k[2]) not in dead}
+        self._meta_cache = {
+            k: v for k, v in self._meta_cache.items()
+            if k[0] not in dead and k[1] not in dead}
+
     def drain_pending(self) -> int:
         """Process the pending-owner queue: ONE owner-chain walk per
-        owner, metadata derived PER POD (pod-keyed groupers — e.g. each
-        Deployment replica is its own inference group — stay correct),
-        and ONE PodGroup upsert per distinct group per drain, then
-        per-pod labeling (a label write only when the pod's labels
-        actually change).  Returns the number of owners processed (the
-        drain-idle contract: truthy = more events may have been
-        produced)."""
+        owner and — for groupers whose pod-derived inputs are just the
+        ``_base`` pair — ONE metadata derivation per (owner, pod
+        signature) per batch (``grouper_vectorized_batches_total``);
+        pod-keyed groupers (e.g. each Deployment replica is its own
+        inference group) still derive per pod.  ONE PodGroup upsert per
+        distinct group per drain, then per-pod labeling (a label write
+        only when the pod's labels actually change).  Returns the number
+        of owners processed (the drain-idle contract: truthy = more
+        events may have been produced)."""
+        self._apply_owner_evictions()
         if not self._pending:
             return 0
+        from ..models.groupers import grouper_pod_signature, resolve_grouper
         pending, self._pending = self._pending, {}
         ensured: set = set()
-        for _okey, pods in pending.items():
+        batched_owners = 0
+        for okey, pods in pending.items():
             rep = next(iter(pods.values()))
             top_owner, _chain = self.resolve_top_owner(rep)
             shared_top = not self._last_walk_synthesized
+            grouper = owner_rv = top_id = None
+            if shared_top:
+                grouper = resolve_grouper(
+                    top_owner.get("apiVersion", "v1"),
+                    top_owner.get("kind", "Pod"))
+                t_md = top_owner.get("metadata", {})
+                owner_rv = t_md.get("resourceVersion")
+                top_id = (t_md.get("namespace", "default"),
+                          top_owner.get("kind"), t_md.get("name"))
+            owner_batched = False
             for pod in pods.values():
                 if not shared_top and pod is not rep:
                     # A synthesized owner embeds the resolving pod's own
                     # labels: the representative's result must not leak
                     # onto its batch-mates — re-resolve per pod.
                     top_owner, _chain = self.resolve_top_owner(pod)
-                meta = group_workload(top_owner, pod, self.api)
+                meta = None
+                if shared_top and owner_rv is not None:
+                    psig = grouper_pod_signature(grouper, pod)
+                    if psig is not None:
+                        mkey = (okey, top_id, owner_rv, psig)
+                        meta = self._meta_cache.get(mkey)
+                        if meta is None:
+                            meta = group_workload(top_owner, pod,
+                                                  self.api)
+                            if len(self._meta_cache) >= OWNER_CACHE_CAP:
+                                self._meta_cache.pop(
+                                    next(iter(self._meta_cache)))
+                            self._meta_cache[mkey] = meta
+                        owner_batched = True
+                if meta is None:
+                    meta = group_workload(top_owner, pod, self.api)
                 key = (meta.namespace, meta.name)
                 if key not in ensured:
                     ensured.add(key)
@@ -116,7 +195,12 @@ class PodGrouper:
                     LIFECYCLE.note(md.get("uid", md["name"]), "grouped",
                                    podgroup=meta.name,
                                    queue=meta.queue or "")
+            if owner_batched:
+                batched_owners += 1
         METRICS.inc("podgrouper_owner_batches_total", len(pending))
+        if batched_owners:
+            METRICS.inc("grouper_vectorized_batches_total",
+                        batched_owners)
         return len(pending)
 
     def resolve_top_owner(self, pod: dict):

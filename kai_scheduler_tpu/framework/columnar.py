@@ -1,0 +1,268 @@
+"""Columnar host state: struct-of-arrays pod/node manifests.
+
+ROADMAP item 1 taken to its conclusion (Kant's incremental-state
+argument, arxiv 2510.01256; Tesserae's persistent placement state, arxiv
+2508.04953): the scheduler's host state lives in arrays end to end.  The
+device arena (framework/arena.py) already keeps the *packed* snapshot
+resident across cycles and patches it by rv-diffed deltas; this module
+extends the same pattern UPSTREAM of object construction — the watched
+store itself is mirrored as NumPy record batches (one row per pod,
+interned-string vocab tables for names), maintained O(delta) from watch
+events by ``ClusterCache`` (controllers/cache_builder.py).
+
+``ClusterCache.snapshot()`` uses the columns for an array-native fast
+path (DESIGN §11): per-node used/releasing accounting, pod-room counts,
+queue aggregates, per-group status counters, and the pack-time
+vocabulary scans all become vectorized segment reductions over these
+columns — in the SAME accumulation order as the per-object walks they
+replace (``np.add.at`` applies updates sequentially in index order, so
+float sums stay bit-identical) — and per-cycle ``PodInfo`` views
+materialize from row templates (``materialize_row``, the
+``PodInfo.from_columns`` seam) via ``PodInfo.instantiate_fast`` instead
+of the copy-protocol path.  The fast path is bit-identical to the object
+path and falls back to it wholesale on resync / vocab overflow /
+feature-bearing pods (``columnar_fallback_total``, gated to zero on the
+warm fleet shape by tools/fleet_budget.py).
+
+Single-writer contract: every column mutation happens on the scheduler
+thread inside ``ClusterCache._apply_changes`` / ``_refresh_full`` (watch
+hooks only enqueue keys; kairace KRC003 checks the annotations below).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..api import resources as rs
+from ..api.pod_status import PodStatus
+
+# Row flags: which parse-time features a pod carries.  SELECTOR/TOLS
+# stay on the fast path (the codec handles them; they only disable the
+# pack-time vocabulary shortcut); COMPLEX forces the wholesale fallback
+# — the pod needs accounting the vectorized path does not model
+# (fractional/MIG/gpu-memory devices, sharing groups, storage linking,
+# affinity/predicate inventories).
+FLAG_SELECTOR = 1
+FLAG_TOLERATIONS = 2
+FLAG_COMPLEX = 4
+
+# Statuses folded into the vectorized node/queue accounting masks
+# (api/pod_status.py): parse-time statuses only — ALLOCATED/PIPELINED
+# never appear in a freshly built snapshot.
+_ACTIVE_ALLOCATED = int(PodStatus.ALLOCATED | PodStatus.PIPELINED
+                        | PodStatus.BINDING | PodStatus.BOUND
+                        | PodStatus.RUNNING)
+_RELEASING = int(PodStatus.RELEASING)
+_PENDING = int(PodStatus.PENDING)
+
+
+class VocabOverflow(Exception):
+    """The interned-string table hit its cap; the store is no longer
+    authoritative and the snapshot must take the object path."""
+
+
+class StringVocab:
+    """Interned strings <-> dense int32 ids (the node/group name codec).
+
+    Ids are append-only: a deleted node's id stays reserved so pod rows
+    referencing it never dangle.  Overflow (cap hit) latches sticky —
+    the owning store reports it and the snapshot falls back wholesale
+    until a rebuild resets the vocabulary.
+    """
+
+    __slots__ = ("ids", "strs", "cap", "overflowed")
+
+    def __init__(self, cap: int | None = None):
+        self.ids: dict[str, int] = {}
+        self.strs: list[str] = []
+        self.cap = cap or int(os.environ.get(
+            "KAI_COLUMNAR_VOCAB_CAP", str(1 << 20)))
+        self.overflowed = False
+
+    def intern(self, s: str | None) -> int:
+        if not s:
+            return -1
+        i = self.ids.get(s)
+        if i is None:
+            if len(self.strs) >= self.cap:
+                self.overflowed = True
+                raise VocabOverflow(s)
+            i = len(self.strs)
+            self.ids[s] = i
+            self.strs.append(s)
+        return i
+
+    def str_of(self, i: int) -> str:
+        return self.strs[i] if i >= 0 else ""
+
+
+class ColumnarPods:
+    """Struct-of-arrays pod manifests: one row per (namespace, name) key.
+
+    Columns are parallel NumPy arrays over a capacity-doubling row arena
+    with a free list; object columns carry the strings/templates the
+    per-cycle views need.  Everything here is derived at watch-delta
+    apply time from the SAME parse (`ClusterCache._parse_pod`) the
+    object path uses, so a materialized view is the object path's pod.
+    """
+
+    # kairace: single-writer=main
+    def __init__(self):
+        self.node_vocab = StringVocab()
+        self.group_vocab = StringVocab()
+        self.subgroup_vocab = StringVocab()
+        cap = 64
+        # -- record batch ------------------------------------------------
+        self.status = np.zeros(cap, np.int32)     # PodStatus int value
+        self.node_id = np.full(cap, -1, np.int32)   # node_vocab id
+        self.group_id = np.full(cap, -1, np.int32)  # group_vocab id
+        self.subgroup_id = np.full(cap, -1, np.int32)
+        self.req = np.zeros((cap, rs.NUM_RES))    # to_vec(mig_as_gpu=False)
+        self.flags = np.zeros(cap, np.int32)
+        self.tol_len = np.zeros(cap, np.int32)    # len(tolerations)
+        self.uid = np.empty(cap, object)
+        self.rv = np.empty(cap, object)           # _sig_rv change signature
+        self.tmpl = np.empty(cap, object)         # parsed PodInfo template
+        # -- row index ---------------------------------------------------
+        self.rows: dict = {}        # (ns, name) -> row
+        self.uid_rows: dict = {}    # uid -> row
+        self.free: list[int] = []
+        self.n_alloc = 0            # high-water row mark
+        # Bumped on any membership change (add/remove/row reuse): cached
+        # per-snapshot orderings key on it.
+        self.version = 0
+
+    # -- maintenance (scheduler thread only) -----------------------------
+    def _grow(self) -> None:
+        cap = self.status.shape[0] * 2
+        for name in ("status", "node_id", "group_id", "subgroup_id",
+                     "flags", "tol_len"):
+            old = getattr(self, name)
+            fresh = np.full(cap, -1, np.int32) if name.endswith("_id") \
+                else np.zeros(cap, np.int32)
+            fresh[:old.shape[0]] = old
+            setattr(self, name, fresh)
+        req = np.zeros((cap, self.req.shape[1]))
+        req[:self.req.shape[0]] = self.req
+        self.req = req
+        for name in ("uid", "rv", "tmpl"):
+            old = getattr(self, name)
+            fresh = np.empty(cap, object)
+            fresh[:old.shape[0]] = old
+            setattr(self, name, fresh)
+
+    @staticmethod
+    def _flags_of(tmpl) -> int:
+        r = tmpl.res_req
+        complex_pod = bool(
+            tmpl.affinity_terms or tmpl.anti_affinity_terms
+            or tmpl.preferred_affinity_terms
+            or tmpl.preferred_anti_affinity_terms
+            or tmpl.node_affinity_required or tmpl.node_affinity_preferred
+            or tmpl.host_ports or tmpl.required_configmaps
+            or tmpl.pvc_names or tmpl.resource_claims
+            or tmpl.gpu_group or tmpl.accepted_resource_types is not None
+            or r.mig_resources or r.gpu_fraction > 0.0
+            or r.gpu_memory_bytes > 0.0)
+        return ((FLAG_SELECTOR if tmpl.node_selector else 0)
+                | (FLAG_TOLERATIONS if tmpl.tolerations else 0)
+                | (FLAG_COMPLEX if complex_pod else 0))
+
+    def upsert(self, key: tuple, rv_sig, tmpl,
+               group: str | None) -> str | None:
+        """Fold one parsed pod into the columns.  ``tmpl`` is the parse
+        result (never mutated after this point); ``group`` is the
+        pod-group label (None = ungrouped, excluded from snapshots).
+        Returns the uid this key PREVIOUSLY held when it differs (a
+        same-name recreate) — the caller must account it as removed."""
+        replaced = None
+        row = self.rows.get(key)
+        if row is None:
+            if self.free:
+                row = self.free.pop()
+            else:
+                row = self.n_alloc
+                if row >= self.status.shape[0]:
+                    self._grow()
+                self.n_alloc += 1
+            self.rows[key] = row
+            self.version += 1
+        else:
+            old_uid = self.uid[row]
+            if old_uid != tmpl.uid:
+                self.uid_rows.pop(old_uid, None)
+                replaced = old_uid
+        self.status[row] = int(tmpl.status)
+        self.node_id[row] = self.node_vocab.intern(tmpl.node_name)
+        self.group_id[row] = self.group_vocab.intern(group)
+        self.subgroup_id[row] = self.subgroup_vocab.intern(tmpl.subgroup)
+        self.req[row] = tmpl.res_req.to_vec(mig_as_gpu=False)
+        self.flags[row] = self._flags_of(tmpl)
+        self.tol_len[row] = len(tmpl.tolerations)
+        self.uid[row] = tmpl.uid
+        self.rv[row] = rv_sig
+        self.tmpl[row] = tmpl
+        self.uid_rows[tmpl.uid] = row
+        return replaced
+
+    def remove(self, key: tuple) -> str | None:
+        """Drop one pod's row; returns its uid (for vanish accounting)."""
+        row = self.rows.pop(key, None)
+        if row is None:
+            return None
+        uid = self.uid[row]
+        self.uid_rows.pop(uid, None)
+        self.tmpl[row] = None
+        self.uid[row] = None
+        self.rv[row] = None
+        self.group_id[row] = -1
+        self.node_id[row] = -1
+        self.status[row] = 0
+        self.flags[row] = 0
+        self.free.append(row)
+        self.version += 1
+        return uid
+
+    def clear(self) -> None:
+        """Wholesale invalidation (watch resync): rebuilt at the next
+        priming refresh, vocabularies reset with it."""
+        self.__init__()
+
+    # -- snapshot-side reads ---------------------------------------------
+    def row_of_uid(self, uid: str) -> int | None:
+        return self.uid_rows.get(uid)
+
+    @property
+    def overflowed(self) -> bool:
+        return (self.node_vocab.overflowed or self.group_vocab.overflowed
+                or self.subgroup_vocab.overflowed)
+
+    def live_rows(self, ordered_keys: list) -> np.ndarray:
+        """Row indices in snapshot iteration order (the cache's
+        name-sorted pod order)."""
+        rows = self.rows
+        return np.fromiter((rows[k] for k in ordered_keys), np.int64,
+                           count=len(ordered_keys))
+
+    def complex_count(self, rows: np.ndarray) -> int:
+        return int(np.count_nonzero(
+            self.flags[rows] & FLAG_COMPLEX)) if rows.size else 0
+
+    def stats(self) -> dict:
+        return {
+            "rows": len(self.rows),
+            "capacity": int(self.status.shape[0]),
+            "node_vocab": len(self.node_vocab.strs),
+            "group_vocab": len(self.group_vocab.strs),
+            "vocab_overflowed": self.overflowed,
+        }
+
+
+def materialize_row(pods: ColumnarPods, row: int):
+    """``PodInfo.from_columns``: the per-cycle object view of one row.
+
+    The row template is the same parse the object path caches, so the
+    fast instantiate is field-for-field the object path's pod."""
+    return pods.tmpl[row].instantiate_fast()

@@ -384,6 +384,32 @@ GROUPER_TABLE = {
 }
 
 
+# Groupers whose pod-derived inputs are EXACTLY the ``_base`` pair
+# (queue label + spec.priorityClassName): for these, pods of one owner
+# that agree on that pair produce identical metadata, so the
+# owner-coalesced drain can derive the PodGroup once per owner batch
+# (podgrouper "vectorized grouping", DESIGN §11) instead of once per
+# pod.  Pod-keyed groupers — deployment/pod/spark/lws/grove names or
+# chains embed per-pod identity — and cronjob/skip-top-owner (pod owner
+# references) are deliberately absent.
+for _g in (default_grouper, k8s_job_grouper, kubeflow_grouper,
+           mpi_grouper, notebook_grouper, ray_grouper, jobset_grouper,
+           knative_grouper, kubevirt_grouper, aml_grouper,
+           spotrequest_grouper):
+    _g.pod_inputs = "base"
+
+
+def grouper_pod_signature(grouper, pod: dict) -> tuple | None:
+    """The pod-derived inputs of a batchable grouper, or None when the
+    grouper reads more of the pod than ``_base`` does (must run per
+    pod)."""
+    if getattr(grouper, "pod_inputs", None) != "base":
+        return None
+    md = pod.get("metadata", {})
+    return (md.get("labels", {}).get(QUEUE_LABEL),
+            pod.get("spec", {}).get("priorityClassName"))
+
+
 def resolve_grouper(api_version: str, kind: str):
     group = api_version.split("/")[0] if "/" in api_version else ""
     return GROUPER_TABLE.get((group, kind), default_grouper)

@@ -228,6 +228,12 @@ class UpstreamPredicatesPlugin(Plugin):
             return self._ports_cache[1]
         n = self.ssn.node_idle.shape[0]
         out: dict = {}
+        hints = getattr(self.ssn.cluster, "columnar_hints", None)
+        if hints and hints.get("no_host_ports"):
+            # Columnar snapshot: no pod in the population carries a host
+            # port — identical (empty) occupancy, no O(pods) walk.
+            self._ports_cache = (tick, out)
+            return out
         for pg in self.ssn.cluster.podgroups.values():
             for t in pg.pods.values():
                 if not t.host_ports or not t.node_name:

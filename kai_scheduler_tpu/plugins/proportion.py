@@ -148,6 +148,9 @@ class ProportionPlugin(Plugin):
         # (proportion.go:347-401).  Pending gpu-memory requests are charged
         # gpu_memory / MinNodeGPUMemory devices rather than a whole GPU.
         min_gpu_mem = self.min_gpu_mem = cluster.min_node_gpu_memory()
+        batch = getattr(cluster, "columnar_batch", None)
+        if batch is not None and self._roll_up_columnar(batch):
+            return
         for pg in cluster.podgroups.values():
             if pg.queue_id not in self.queues:
                 continue
@@ -167,6 +170,59 @@ class ProportionPlugin(Plugin):
                     # (proportion.go updateQueuesCurrentResourceUsage) —
                     # unschedulable gated pods must not inflate fair share.
                     self._walk(pg.queue_id, "request", req)
+
+    def _roll_up_columnar(self, batch: dict) -> bool:
+        """Vectorized ``_walk`` roll-up over the columnar snapshot batch
+        (DESIGN §11): per pod, its request is added to its queue and
+        every ancestor — expressed as one ``np.add.at`` per attribute
+        over ancestor-expanded indices in pod order, which applies the
+        exact same sequential float folds as the per-pod walk (each
+        accumulator starts at zero and receives its adds in the same
+        order), so fair-share inputs are bit-identical.  The batch only
+        exists on simple-pod columnar snapshots, where every request
+        vector is context-free (no gpu-memory/MIG resolution)."""
+        q_uids = batch["q_uids"]
+        if list(self.queues) != q_uids:
+            return False  # queue view drifted: take the object walk
+        qidx = np.asarray(batch["qidx"])
+        reqs = batch["reqs"]
+        n_q = len(q_uids)
+        if n_q == 0 or qidx.size == 0:
+            return True
+        anc = batch.get("queue_anc")
+        if anc is None or anc.shape[0] != n_q:
+            # The batch's ancestor table (built with the queue columns,
+            # aligned with q_uids) is the one source of chains; without
+            # it — or on a shape drift — the object walk is the truth.
+            return False
+        depth = anc.shape[1]
+        valid = qidx >= 0
+        exp = anc[np.where(valid, qidx, 0)]       # [P, D]
+        exp[~valid] = -1
+        flat = exp.reshape(-1)
+        ok = flat >= 0
+        rep = np.repeat(reqs, depth, axis=0)
+        active = np.asarray(batch["active"])
+        pending = np.asarray(batch["pending"])
+        non_preempt = active & ~np.asarray(batch["preemptible"])
+        versions = np.zeros(n_q, np.int64)
+        for attr, mask in (("allocated", active),
+                           ("request", active | pending),
+                           ("allocated_non_preemptible", non_preempt)):
+            m = np.repeat(mask, depth) & ok
+            if not m.any():
+                continue
+            mat = np.zeros((n_q, reqs.shape[1]))
+            np.add.at(mat, flat[m], rep[m])
+            counts = np.bincount(flat[m], minlength=n_q)
+            versions += counts
+            for i in np.nonzero(counts)[0].tolist():
+                # Accumulators start at rs.zeros(), so the add.at fold
+                # (same adds, same order, from zero) IS the walked value.
+                setattr(self.queues[q_uids[i]], attr, mat[i])
+        for i in np.nonzero(versions)[0].tolist():
+            self.queues[q_uids[i]].version += int(versions[i])
+        return True
 
     def _walk(self, qid: str, attr: str, req: np.ndarray) -> None:
         q = self.queues.get(qid)

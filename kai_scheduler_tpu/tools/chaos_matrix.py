@@ -63,6 +63,12 @@ SHARDS_TESTS = ["tests/test_concurrent_shards.py",
 # bit-identity, fenced-depose speculation rollback, crash-after-journal
 # replay, and breaker-open drain-to-serial are asserted.
 PIPELINE_TESTS = ["tests/test_pipeline_cycle.py"]
+# --columnar: the columnar host-state parity ring — each seed reshuffles
+# the randomized watch-delta stream (add/del/mod/resync/fence, plus
+# speculative overlays and vocab overflow) while columnar-vs-object
+# ClusterInfo equivalence, pack bit-identity, and identical allocate
+# placements are asserted at every step.
+COLUMNAR_TESTS = ["tests/test_columnar_store.py"]
 
 
 def run_iteration(seed: int, tests: list[str], marker: str,
@@ -154,6 +160,13 @@ def main(argv=None) -> int:
                          "pipelined bit-identity, fenced rollback, "
                          "crash-after-journal replay, and breaker-open "
                          "drain-to-serial are asserted")
+    ap.add_argument("--columnar", action="store_true",
+                    help="columnar mode: sweep the columnar host-state "
+                         f"parity ring ({COLUMNAR_TESTS}) — each seed "
+                         "reshuffles the watch-delta stream while "
+                         "columnar-vs-object equivalence, pack "
+                         "bit-identity, and identical allocate "
+                         "placements are asserted")
     ap.add_argument("--races", action="store_true",
                     help="runtime lock-order validation: every iteration "
                          "runs with KAI_LOCKTRACE=1 (threading factories "
@@ -187,13 +200,15 @@ def main(argv=None) -> int:
         tests = args.tests
     else:
         # Modes compose: --arena --latency --incremental --fused
-        # --shards --pipeline sweeps every selected suite per seed.
+        # --shards --pipeline --columnar sweeps every selected suite
+        # per seed.
         tests = (ARENA_TESTS if args.arena else []) + \
             (LATENCY_TESTS if args.latency else []) + \
             (INCREMENTAL_TESTS if args.incremental else []) + \
             (FUSED_TESTS if args.fused else []) + \
             (SHARDS_TESTS if args.shards else []) + \
-            (PIPELINE_TESTS if args.pipeline else [])
+            (PIPELINE_TESTS if args.pipeline else []) + \
+            (COLUMNAR_TESTS if args.columnar else [])
         if not tests:
             tests = DEFAULT_TESTS
     repo_root = os.path.dirname(os.path.dirname(os.path.dirname(

@@ -26,8 +26,10 @@
 #                 incremental-cache structural gates, the fused-allocate
 #                 kernel ceiling, the 10k-queue fair-share step
 #                 ceiling + single-dispatch/prep-reuse structural gates,
-#                 and the overlapped-pipeline re-run (identical bound
-#                 pods, overlap-ratio floor) must stay in budget
+#                 the overlapped-pipeline re-run (identical bound
+#                 pods, overlap-ratio floor), and the columnar
+#                 host-state gates (zero fallbacks warm, columnar rows
+#                 served, snapshot-build ceiling) must stay in budget
 #   tier-1 tests  pytest -m 'not slow' on CPU
 #
 # Usage: kai_scheduler_tpu/tools/ci_check.sh [--no-tests]
@@ -56,6 +58,8 @@ echo
 echo "== chaos matrix definition (dry run) =="
 python -m kai_scheduler_tpu.tools.chaos_matrix --dry-run || fail=1
 python -m kai_scheduler_tpu.tools.chaos_matrix --pipeline --dry-run \
+    || fail=1
+python -m kai_scheduler_tpu.tools.chaos_matrix --columnar --dry-run \
     || fail=1
 python -m kai_scheduler_tpu.tools.chaos_matrix --races --dry-run \
     || fail=1

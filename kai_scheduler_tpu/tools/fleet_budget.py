@@ -66,6 +66,7 @@ def main(argv=None) -> int:
 
     shape = budget["shape"]
     refresh0 = METRICS.counters.get("cluster_cache_full_refresh_total", 0)
+    col_fb0 = METRICS.counters.get("columnar_fallback_total", 0)
 
     def fused_taken():
         return sum(v for k, v in METRICS.counters.items()
@@ -120,6 +121,20 @@ def main(argv=None) -> int:
     p_bound = pres.get("pod_latency", {}).get("bound_pods", 0)
     p_overlap = pres.get("pipeline", {}).get("overlap_ratio_mean")
 
+    # Columnar host-state gates (DESIGN §11): the warm fleet shape must
+    # stay on the array-native snapshot path end to end — a single
+    # fallback (resync aside, none should fire here) or a zero
+    # columnar-rows gauge means the fast path silently rotted while
+    # every wall clock still passes on a fast machine.  The build-time
+    # ceiling is the direct analog of the phase medians: the median of
+    # snapshot_build_latency_ms across every cycle both fleet runs took.
+    col_fallbacks = METRICS.counters.get(
+        "columnar_fallback_total", 0) - col_fb0
+    col_rows = METRICS.gauges.get("snapshot_columnar_rows", 0)
+    snap_hist = METRICS.histograms.get("snapshot_build_latency_ms")
+    snap_build_ms = round(snap_hist.quantile(0.5), 1) \
+        if snap_hist is not None else None
+
     medians = result.get("pod_latency", {}).get("phase_median_ms", {})
     bound = result.get("pod_latency", {}).get("bound_pods", 0)
     expect = shape["jobs"] * shape["gang"]
@@ -148,6 +163,12 @@ def main(argv=None) -> int:
         # by the hierarchy depth.
         ("fairshare_dispatches", fsres["dispatches"],
          "<=", fs_iters + 1),
+        ("columnar_fallbacks", col_fallbacks,
+         "<=", budget.get("max_columnar_fallbacks", 0)),
+        ("columnar_rows", col_rows,
+         ">=", budget.get("min_columnar_rows", 1)),
+        ("snapshot_build_median_ms", snap_build_ms,
+         "<=", budget.get("max_snapshot_build_ms", 400)),
         ("pipelined_bound_pods", p_bound, ">=", expect),
         ("pipelined_warm_cycle_s", pres.get("warm_cycle_s"),
          "<=", budget.get("max_pipelined_warm_cycle_s",

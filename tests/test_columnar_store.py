@@ -1,0 +1,555 @@
+"""Columnar host-state parity ring (marker ``chaos``, tier-1).
+
+The columnar manifest store (framework/columnar.py + the array-native
+``ClusterCache.snapshot`` fast path, DESIGN §11) keeps pods as
+struct-of-arrays maintained from watch deltas and rebuilds the per-cycle
+world view by vectorized segment reductions + fast-instantiated row
+views.  Its correctness contract is the arena's and the incremental
+store's, one layer further up: a columnar snapshot must be EQUIVALENT to
+the object-path parse of the same store — object fields equal, packed
+tensors bit-identical, ``allocate`` placing identically — under any
+interleaving of cluster events, including watch resyncs, fenced evicts,
+speculative overlays, vocab overflow, and feature-bearing pods that
+force the wholesale fallback.
+
+Seeded in the chaos-matrix style: ``KAI_FAULT_SEED`` shifts every
+sequence (tools/chaos_matrix.py --columnar replays the suite under many
+seeds) and composes with the per-test parametrized seed.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from kai_scheduler_tpu.actions.allocate import AllocateAction
+from kai_scheduler_tpu.controllers import InMemoryKubeAPI
+from kai_scheduler_tpu.controllers.cache_builder import ClusterCache
+from kai_scheduler_tpu.controllers.kubeapi import make_pod, owner_ref
+from kai_scheduler_tpu.controllers.podgrouper import POD_GROUP_LABEL
+from kai_scheduler_tpu.framework.conf import SchedulerConfig
+from kai_scheduler_tpu.framework.session import InMemoryCache, Session
+from kai_scheduler_tpu.utils.metrics import METRICS
+
+from test_incremental_cache import (Mutator, _group, _node, _pod,
+                                    assert_clusters_equivalent,
+                                    placements_of, seed_cluster)
+
+pytestmark = pytest.mark.chaos
+
+SWEEP_SEED = int(os.environ.get("KAI_FAULT_SEED", "0") or 0)
+
+
+def columnar_cache(api, monkeypatch, enabled=True):
+    monkeypatch.setenv("KAI_COLUMNAR", "1" if enabled else "0")
+    return ClusterCache(api)
+
+
+def fallbacks():
+    return METRICS.counters.get("columnar_fallback_total", 0)
+
+
+class ColumnarMutator(Mutator):
+    """The incremental suite's mutator minus PVC churn: a present PVC
+    legitimately forces the storage fallback every snapshot (covered by
+    its own test below), which would starve the fast-path coverage this
+    ring exists to provide."""
+
+    OPS = tuple(op for op in Mutator.OPS if op != "churn_pvc")
+
+
+# ---------------------------------------------------------------------------
+# Property: columnar ClusterInfo == object-path parse under churn
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_columnar_equals_object_under_random_events(seed, monkeypatch):
+    rng = np.random.default_rng(11000 * SWEEP_SEED + seed)
+    api = InMemoryKubeAPI()
+    seed_cluster(api)
+    col = columnar_cache(api, monkeypatch, enabled=True)
+    obj = columnar_cache(api, monkeypatch, enabled=False)
+    assert col._columnar_enabled and not obj._columnar_enabled
+
+    columnar_snaps = 0
+    mut = ColumnarMutator(api, col, rng)
+    for _ in range(30):
+        mut.step()
+        inc = col.snapshot()
+        ref = obj.snapshot()
+        assert_clusters_equivalent(inc, ref)
+        if col.last_columnar_stats.get("path") == "columnar":
+            columnar_snaps += 1
+    # The ring must actually exercise the fast path: a cache that falls
+    # back every cycle would pass equivalence vacuously.  (The mutator's
+    # PVC churn legitimately forces storage fallbacks on some steps.)
+    assert columnar_snaps >= 5, \
+        f"only {columnar_snaps}/30 steps took the columnar path"
+
+
+@pytest.mark.parametrize("seed", [1, 2])
+def test_allocate_identical_on_columnar_and_object_paths(seed,
+                                                         monkeypatch):
+    rng = np.random.default_rng(12000 * SWEEP_SEED + seed)
+    api = InMemoryKubeAPI()
+    seed_cluster(api)
+    col = columnar_cache(api, monkeypatch, enabled=True)
+    obj = columnar_cache(api, monkeypatch, enabled=False)
+    mut = ColumnarMutator(api, col, rng)
+    for _ in range(8):
+        mut.step()
+        side = InMemoryCache()
+        side.arena = col.arena
+        ssn_a = Session(col.snapshot(), SchedulerConfig(), side)
+        ssn_a.open()
+        AllocateAction().execute(ssn_a)
+        ssn_b = Session(obj.snapshot(), SchedulerConfig(),
+                        InMemoryCache())
+        ssn_b.open()
+        AllocateAction().execute(ssn_b)
+        assert placements_of(ssn_a) == placements_of(ssn_b)
+        # Fair-share inputs (the vectorized proportion roll-up) must be
+        # bit-identical too, not just the final placements.
+        qa = getattr(ssn_a, "proportion", None)
+        qb = getattr(ssn_b, "proportion", None)
+        if qa is not None and qb is not None:
+            assert sorted(qa.queues) == sorted(qb.queues)
+            for qid, a in qa.queues.items():
+                b = qb.queues[qid]
+                assert np.array_equal(a.allocated, b.allocated), qid
+                assert np.array_equal(a.request, b.request), qid
+                assert np.array_equal(a.allocated_non_preemptible,
+                                      b.allocated_non_preemptible), qid
+
+
+# ---------------------------------------------------------------------------
+# Fallback gates: counted, equivalent, and recoverable
+# ---------------------------------------------------------------------------
+
+def test_complex_pod_forces_counted_fallback_then_recovers(monkeypatch):
+    api = InMemoryKubeAPI()
+    seed_cluster(api)
+    cache = columnar_cache(api, monkeypatch, enabled=True)
+    cache.snapshot()
+    cache.snapshot()
+    assert cache.last_columnar_stats["path"] == "columnar"
+    # A fractional-GPU pod needs sharing-group accounting: wholesale
+    # fallback, counted, still equivalent.
+    api.create(make_pod(
+        "frac-pod", labels={POD_GROUP_LABEL: "pg0"},
+        annotations={"gpu-fraction": "0.5"}))
+    before = fallbacks()
+    inc = cache.snapshot()
+    assert cache.last_columnar_stats == {"path": "object",
+                                         "reason": "complex-pods"}
+    assert fallbacks() == before + 1
+    assert_clusters_equivalent(
+        inc, columnar_cache(api, monkeypatch, False).snapshot())
+    # Deleting the feature-bearing pod restores the fast path.
+    api.delete("Pod", "frac-pod")
+    inc = cache.snapshot()
+    assert cache.last_columnar_stats["path"] == "columnar"
+    assert_clusters_equivalent(
+        inc, columnar_cache(api, monkeypatch, False).snapshot())
+
+
+def test_resync_falls_back_counted_then_fast_path_resumes(monkeypatch):
+    api = InMemoryKubeAPI()
+    seed_cluster(api)
+    cache = columnar_cache(api, monkeypatch, enabled=True)
+    cache.snapshot()
+    cache.snapshot()
+    _node(api, "post-gap-node")
+    _pod(api, "post-gap-pod", "pg0", gpu=1)
+    cache._on_watch_resync()
+    before = fallbacks()
+    inc = cache.snapshot()
+    assert cache.last_columnar_stats == {"path": "object",
+                                         "reason": "resync"}
+    assert fallbacks() == before + 1
+    assert_clusters_equivalent(
+        inc, columnar_cache(api, monkeypatch, False).snapshot())
+    assert "post-gap-node" in inc.nodes
+    # The snapshot after the gap rebuilt the columns: fast path resumes
+    # and stays equivalent.
+    api.patch("Pod", "post-gap-pod",
+              {"metadata": {"labels": {"y": "2"}}})
+    inc2 = cache.snapshot()
+    assert cache.last_columnar_stats["path"] == "columnar"
+    assert_clusters_equivalent(
+        inc2, columnar_cache(api, monkeypatch, False).snapshot())
+
+
+def test_vocab_overflow_falls_back_until_resync_shrinks(monkeypatch):
+    monkeypatch.setenv("KAI_COLUMNAR_VOCAB_CAP", "4")
+    api = InMemoryKubeAPI()
+    for i in range(6):
+        _node(api, f"n{i}")
+    _group(api, "pg0")
+    _pod(api, "p0", "pg0")
+    cache = columnar_cache(api, monkeypatch, enabled=True)
+    cache.snapshot()
+    cache.snapshot()
+    assert cache.last_columnar_stats["path"] == "columnar"
+    # Bind churn interns node names into the pod columns: blow the cap.
+    for i in range(6):
+        _pod(api, f"ov-{i}", "pg0")
+        api.patch("Pod", f"ov-{i}", {"spec": {"nodeName": f"n{i}"}})
+    before = fallbacks()
+    inc = cache.snapshot()
+    assert cache.last_columnar_stats == {"path": "object",
+                                         "reason": "vocab-overflow"}
+    assert fallbacks() > before
+    assert_clusters_equivalent(
+        inc, columnar_cache(api, monkeypatch, False).snapshot())
+    # Overflow is sticky until a wholesale rebuild resets the vocab.
+    cache.snapshot()
+    assert cache.last_columnar_stats["reason"] == "vocab-overflow"
+    for i in range(6):
+        api.delete("Pod", f"ov-{i}")
+    cache._on_watch_resync()
+    cache.snapshot()           # priming rebuild, object path
+    inc = cache.snapshot()     # vocab fits again: fast path resumes
+    assert cache.last_columnar_stats["path"] == "columnar"
+    assert_clusters_equivalent(
+        inc, columnar_cache(api, monkeypatch, False).snapshot())
+
+
+def test_queue_spec_change_during_object_path_never_serves_stale(
+        monkeypatch):
+    """A queue spec edited (and reverted) while a complex pod holds the
+    cache on the OBJECT path: when the fast path resumes, its
+    status-churn template reuse must not resurrect the stale parse.
+    The spec signature rides the template itself, so an object-path
+    re-parse in between can never leave a stale match behind."""
+    api = InMemoryKubeAPI()
+    seed_cluster(api)
+    api.patch("Queue", "q0", {"spec": {"deserved": {"gpu": 4}}})
+    cache = columnar_cache(api, monkeypatch, enabled=True)
+    cache.snapshot()
+    inc = cache.snapshot()
+    assert cache.last_columnar_stats["path"] == "columnar"
+    assert inc.queues["q0"].quota.deserved[2] == 4
+    # Complex pod -> object path; the spec changes and reverts there.
+    api.create(make_pod("frac", labels={POD_GROUP_LABEL: "pg0"},
+                        annotations={"gpu-fraction": "0.5"}))
+    api.patch("Queue", "q0", {"spec": {"deserved": {"gpu": 99}}})
+    mid = cache.snapshot()
+    assert cache.last_columnar_stats["path"] == "object"
+    assert mid.queues["q0"].quota.deserved[2] == 99
+    api.patch("Queue", "q0", {"spec": {"deserved": {"gpu": 4}}})
+    cache.snapshot()
+    api.delete("Pod", "frac")
+    inc = cache.snapshot()
+    assert cache.last_columnar_stats["path"] == "columnar"
+    assert inc.queues["q0"].quota.deserved[2] == 4, \
+        "stale queue template served after an object-path re-parse"
+    assert_clusters_equivalent(
+        inc, columnar_cache(api, monkeypatch, False).snapshot())
+
+
+def test_same_name_recreate_with_new_uid_reaps_old_signature(
+        monkeypatch):
+    """A pod deleted and recreated under the same (ns, name) but a new
+    uid between two snapshots: the old uid's signature must reap (the
+    object path's full rescan catches this implicitly; the columnar
+    path must account the replaced uid as removed)."""
+    api = InMemoryKubeAPI()
+    seed_cluster(api)
+    col = columnar_cache(api, monkeypatch, enabled=True)
+    obj = columnar_cache(api, monkeypatch, enabled=False)
+    pod = make_pod("re-pod", labels={POD_GROUP_LABEL: "pg0"}, gpu=1)
+    pod["metadata"]["uid"] = "uid-A"
+    api.create(pod)
+    assert_clusters_equivalent(col.snapshot(), obj.snapshot())
+    assert_clusters_equivalent(col.snapshot(), obj.snapshot())
+    assert "uid-A" in col._pod_sigs
+    api.delete("Pod", "re-pod")
+    pod2 = make_pod("re-pod", labels={POD_GROUP_LABEL: "pg0"}, gpu=2)
+    pod2["metadata"]["uid"] = "uid-B"
+    api.create(pod2)
+    inc, ref = col.snapshot(), obj.snapshot()
+    assert col.last_columnar_stats["path"] == "columnar"
+    assert_clusters_equivalent(inc, ref)
+    assert "uid-A" not in col._pod_sigs
+    assert "uid-B" in col._pod_sigs
+
+
+def test_requeued_apply_keeps_delta_events_for_the_retry(monkeypatch):
+    """An exception mid-fold re-queues the whole batch; keys whose
+    mirror/columns already folded are sig-skipped on the retry, so the
+    delta events they recorded must SURVIVE to the retry's snapshot —
+    otherwise the O(delta) candidates scan misses them and the arena
+    schedules against stale placement state."""
+    api = InMemoryKubeAPI()
+    seed_cluster(api)
+    col = columnar_cache(api, monkeypatch, enabled=True)
+    obj = columnar_cache(api, monkeypatch, enabled=False)
+    assert_clusters_equivalent(col.snapshot(), obj.snapshot())
+    api.patch("Pod", "p0-0", {"spec": {"nodeName": "n0"}})
+    api.patch("Pod", "p1-0", {"spec": {"nodeName": "n1"}})
+    real_get_opt = api.get_opt
+    calls = {"n": 0}
+
+    def flaky_get_opt(kind, name, ns="default"):
+        if kind == "Pod":
+            calls["n"] += 1
+            if calls["n"] == 2:
+                raise RuntimeError("injected torn read")
+        return real_get_opt(kind, name, ns)
+
+    monkeypatch.setattr(api, "get_opt", flaky_get_opt)
+    with pytest.raises(RuntimeError):
+        col.snapshot()
+    monkeypatch.setattr(api, "get_opt", real_get_opt)
+    inc = col.snapshot()   # retry: re-queued keys fold, events intact
+    assert col.last_columnar_stats["path"] == "columnar"
+    ref = obj.snapshot()
+    assert_clusters_equivalent(inc, ref)
+    placed = {t.name: t.node_name for pg in inc.podgroups.values()
+              for t in pg.pods.values() if t.node_name}
+    assert placed.get("p0-0") == "n0" and placed.get("p1-0") == "n1"
+
+
+# ---------------------------------------------------------------------------
+# Speculative overlay (overlapped pipeline) on the columnar path
+# ---------------------------------------------------------------------------
+
+def test_speculative_overlay_identical_on_both_paths(monkeypatch):
+    api = InMemoryKubeAPI()
+    seed_cluster(api)
+    col = columnar_cache(api, monkeypatch, enabled=True)
+    obj = columnar_cache(api, monkeypatch, enabled=False)
+    col.snapshot()
+    obj.snapshot()
+    pend = next(p for p in api.list("Pod")
+                if not p["spec"].get("nodeName"))
+    uid = pend["metadata"].get("uid", pend["metadata"]["name"])
+    bound = next(p for p in api.list("Pod")
+                 if p["spec"].get("nodeName")) \
+        if any(p["spec"].get("nodeName") for p in api.list("Pod")) \
+        else None
+    entries = [(uid, "bind", "n0")]
+    if bound is not None:
+        entries.append((bound["metadata"].get(
+            "uid", bound["metadata"]["name"]), "evict", ""))
+    h_col = col.speculate(entries)
+    h_obj = obj.speculate(entries)
+    inc = col.snapshot()
+    ref = obj.snapshot()
+    assert col.last_columnar_stats["path"] == "columnar"
+    assert inc.cache_stats["speculative_overlaid"] \
+        == ref.cache_stats["speculative_overlaid"] >= 1
+    assert_clusters_equivalent(inc, ref)
+    task = next(t for pg in inc.podgroups.values()
+                for t in pg.pods.values() if t.uid == uid)
+    assert task.status.name == "BOUND" and task.node_name == "n0"
+    # Clearing the speculation re-dirties the overlaid pods on both
+    # paths: the next snapshots agree again (and pack stays identical).
+    col.clear_speculation(h_col)
+    obj.clear_speculation(h_obj)
+    assert_clusters_equivalent(col.snapshot(), obj.snapshot())
+
+
+# ---------------------------------------------------------------------------
+# Fenced evicts through a columnar cache
+# ---------------------------------------------------------------------------
+
+def test_fenced_evict_aborts_and_columnar_cache_stays_equivalent(
+        monkeypatch):
+    from kai_scheduler_tpu.controllers.kubeapi import (FENCE_NAMESPACE,
+                                                       Fenced)
+    api = InMemoryKubeAPI()
+    seed_cluster(api)
+    api.create({"kind": "Lease",
+                "metadata": {"name": "kai-sched",
+                             "namespace": FENCE_NAMESPACE},
+                "spec": {"epoch": 5}})
+    cache = columnar_cache(api, monkeypatch, enabled=True)
+    cache.set_fence("kai-sched", lambda: 3)   # stale epoch: deposed
+    cache.snapshot()
+    api.patch("Pod", "p0-0", {"spec": {"nodeName": "n0"}})
+    cluster = cache.snapshot()
+    assert cache.last_columnar_stats["path"] == "columnar"
+    task = next(t for pg in cluster.podgroups.values()
+                for t in pg.pods.values() if t.name == "p0-0")
+    with pytest.raises(Fenced):
+        cache.evict(task)
+    assert_clusters_equivalent(
+        cache.snapshot(), columnar_cache(api, monkeypatch, False)
+        .snapshot())
+    cache.set_fence("kai-sched", lambda: 6)   # rightful leader
+    cache.evict(task)
+    assert api.get("Pod", "p0-0")["metadata"].get("deletionTimestamp")
+    assert_clusters_equivalent(
+        cache.snapshot(), columnar_cache(api, monkeypatch, False)
+        .snapshot())
+
+
+# ---------------------------------------------------------------------------
+# The from_columns materializer and the steady-state contract
+# ---------------------------------------------------------------------------
+
+def test_instantiate_fast_equals_instantiate():
+    pod = make_pod("rich", labels={POD_GROUP_LABEL: "g", "a": "b"},
+                   gpu=2, node_selector={"zone": "z1"},
+                   tolerations=["taintx"], queue="qz")
+    pod["metadata"]["resourceVersion"] = "9"
+    pod["spec"]["affinity"] = {"podAntiAffinity": {
+        "requiredDuringSchedulingIgnoredDuringExecution": [
+            {"labelSelector": {"matchLabels": {"a": "b"}},
+             "topologyKey": "zone"}]}}
+    api = InMemoryKubeAPI()
+    cache = ClusterCache(api)
+    tmpl = cache._parse_pod_template(pod)
+    slow = tmpl.instantiate()
+    fast = tmpl.instantiate_fast()
+    assert slow.__dict__.keys() == fast.__dict__.keys()
+    for field, want in slow.__dict__.items():
+        got = fast.__dict__[field]
+        if isinstance(want, np.ndarray):
+            assert np.array_equal(want, got), field
+        else:
+            assert want == got, field
+    # Containers are fresh per instance, shared immutables by reference.
+    assert fast.labels is not tmpl.labels
+    assert fast.tolerations is not tmpl.tolerations
+    assert fast.res_req is tmpl.res_req
+
+
+def test_warm_fleet_stays_columnar_with_zero_fallbacks(monkeypatch):
+    from kai_scheduler_tpu.controllers import System, SystemConfig
+    monkeypatch.setenv("KAI_COLUMNAR", "1")
+    system = System(SystemConfig())
+    api = system.api
+    for i in range(20):
+        _node(api, f"fn{i}")
+    api.create({"kind": "Queue", "metadata": {"name": "default"},
+                "spec": {}})
+    ref = owner_ref("PyTorchJob", "job-a", uid="job-a-uid",
+                    api_version="kubeflow.org/v1")
+    api.create({"kind": "PyTorchJob", "apiVersion": "kubeflow.org/v1",
+                "metadata": {"name": "job-a", "uid": "job-a-uid"},
+                "spec": {"pytorchReplicaSpecs": {
+                    "Worker": {"replicas": 12}}}})
+    for k in range(12):
+        api.create(make_pod(f"job-a-worker-{k}", owner=ref, gpu=1))
+    before = fallbacks()
+    for _ in range(4):
+        system.run_cycle()
+    cache = system.schedulers[0].cache
+    assert cache.last_columnar_stats["path"] == "columnar"
+    bound = sum(1 for p in api.list("Pod") if p["spec"].get("nodeName"))
+    assert bound == 12
+    # Warm steady cycles: no fallbacks, O(delta)=0 dirty bookkeeping.
+    system.run_cycle()
+    system.run_cycle()
+    assert fallbacks() == before
+    assert cache.last_columnar_stats["dirty_pods"] == 0
+    assert cache.last_columnar_stats["rows"] == 12
+    assert METRICS.gauges.get("snapshot_columnar_rows") == 12
+
+
+# ---------------------------------------------------------------------------
+# Satellite fix: grouper owner-cache eviction on DELETED owners
+# ---------------------------------------------------------------------------
+
+class _RestartableAPI:
+    """Minimal grouper-facing API with hand-controlled resourceVersions:
+    lets the test recreate a deleted owner at a LOWER rv, exactly what a
+    restarted apiserver's reset counter produces."""
+
+    def __init__(self):
+        self.objs: dict = {}
+        self._sync: list = []
+
+    # grouper surface
+    def watch(self, kind, handler):
+        pass
+
+    def watch_sync(self, handler):
+        self._sync.append(handler)
+
+    def get_opt(self, kind, name, namespace="default"):
+        return self.objs.get((kind, namespace, name))
+
+    def put(self, kind, name, obj, namespace="default"):
+        self.objs[(kind, namespace, name)] = obj
+
+    def delete(self, kind, name, namespace="default"):
+        obj = self.objs.pop((kind, namespace, name), None)
+        if obj is not None:
+            for h in list(self._sync):
+                h("DELETED", obj)
+
+
+def _owner_obj(kind, name, rv, labels=None):
+    return {"kind": kind, "apiVersion": "batch/v1",
+            "metadata": {"name": name, "uid": f"{name}-uid",
+                         "namespace": "default",
+                         "resourceVersion": rv,
+                         "labels": dict(labels or {})}}
+
+
+def test_owner_cache_evicts_on_delete_before_lower_rv_recreate():
+    from kai_scheduler_tpu.controllers.podgrouper import PodGrouper
+    api = _RestartableAPI()
+    grouper = PodGrouper(api)
+    api.put("Job", "train", _owner_obj("Job", "train", "900",
+                                       {"kai.scheduler/queue": "qa"}))
+    pod = make_pod("train-0",
+                   owner=owner_ref("Job", "train", uid="train-uid",
+                                   api_version="batch/v1"))
+    top, _chain = grouper.resolve_top_owner(pod)
+    assert top["metadata"]["labels"]["kai.scheduler/queue"] == "qa"
+    top, _chain = grouper.resolve_top_owner(pod)   # memo hit
+    assert top["metadata"]["labels"]["kai.scheduler/queue"] == "qa"
+    # Apiserver restart: owner deleted, recreated with NEW content at a
+    # LOWER rv.  Without eviction the (ns,kind,name,rv) memo would keep
+    # serving the dead owner's chain if the rv ever repeats.
+    api.delete("Job", "train")
+    api.put("Job", "train", _owner_obj("Job", "train", "900",
+                                       {"kai.scheduler/queue": "qb"}))
+    grouper._apply_owner_evictions()
+    top, _chain = grouper.resolve_top_owner(pod)
+    assert top["metadata"]["labels"]["kai.scheduler/queue"] == "qb", \
+        "stale owner served from the memo after DELETED + recreate"
+
+
+def test_batched_meta_one_derivation_per_owner_batch(monkeypatch):
+    """Vectorized grouping: a kubeflow gang arriving in one drain batch
+    derives its PodGroup metadata once, not once per pod — and the
+    result is identical to per-pod derivation."""
+    from kai_scheduler_tpu.controllers.podgrouper import PodGrouper
+    from kai_scheduler_tpu.models import groupers as gmod
+    api = InMemoryKubeAPI()
+    PodGrouper(api)
+    calls = []
+    orig = gmod.kubeflow_grouper
+
+    def counting(owner, pod, g_api=None):
+        calls.append(pod["metadata"]["name"])
+        return orig(owner, pod, g_api)
+
+    counting.pod_inputs = "base"
+    monkeypatch.setitem(gmod.GROUPER_TABLE,
+                        ("kubeflow.org", "PyTorchJob"), counting)
+    before = METRICS.counters.get("grouper_vectorized_batches_total", 0)
+    api.create({"kind": "PyTorchJob", "apiVersion": "kubeflow.org/v1",
+                "metadata": {"name": "tj", "uid": "tj-uid"},
+                "spec": {"pytorchReplicaSpecs": {
+                    "Worker": {"replicas": 6}}}})
+    ref = owner_ref("PyTorchJob", "tj", uid="tj-uid",
+                    api_version="kubeflow.org/v1")
+    for k in range(6):
+        api.create(make_pod(f"tj-worker-{k}", owner=ref))
+    api.drain()
+    assert len(calls) == 1, calls   # one derivation for the whole gang
+    assert METRICS.counters.get(
+        "grouper_vectorized_batches_total", 0) > before
+    groups = api.list("PodGroup")
+    assert [g["metadata"]["name"] for g in groups] == ["pg-tj-tj-uid"]
+    labels = {p["metadata"]["name"]:
+              p["metadata"]["labels"][POD_GROUP_LABEL]
+              for p in api.list("Pod")}
+    assert set(labels.values()) == {"pg-tj-tj-uid"}

@@ -662,6 +662,31 @@ class TestKAI008MetricsHygiene:
         findings = lint(("kai_scheduler_tpu/utils/fix.py", src))
         assert [f for f in findings if f.rule == "KAI008"] == []
 
+    def test_columnar_families_consistent_usage_is_clean(self):
+        # PR 12's columnar host-state families (cache_builder /
+        # podgrouper): one instrument per name, label-free.
+        src = ("from ..utils.metrics import METRICS\n"
+               "def f(v):\n"
+               "    METRICS.inc('columnar_fallback_total')\n"
+               "    METRICS.set_gauge('snapshot_columnar_rows', v)\n"
+               "    METRICS.inc('grouper_vectorized_batches_total')\n"
+               "    METRICS.observe('snapshot_build_latency_ms', v)\n")
+        findings = lint(("kai_scheduler_tpu/controllers/fix.py", src))
+        assert [f for f in findings if f.rule == "KAI008"] == []
+
+    def test_columnar_cross_instrument_collision_fires(self):
+        a = ("from ..utils.metrics import METRICS\n"
+             "def f(v):\n"
+             "    METRICS.set_gauge('snapshot_columnar_rows', v)\n")
+        b = ("from ..utils.metrics import METRICS\n"
+             "def g():\n"
+             "    METRICS.inc('snapshot_columnar_rows')\n")
+        findings = lint(("kai_scheduler_tpu/controllers/a.py", a),
+                        ("kai_scheduler_tpu/framework/b.py", b))
+        assert any(f.rule == "KAI008" and "one instrument" in f.message
+                   and "snapshot_columnar_rows" in f.message
+                   for f in findings)
+
     def test_cycle_span_cross_instrument_collision_fires(self):
         # A counter reusing a cycle_span_* histogram name would double-
         # register the family in the exposition: the whole-tree pass

@@ -319,6 +319,33 @@ class TestChaosMatrixDryRun:
         assert "tests/test_pipeline_cycle.py" in out
         assert "tests/test_snapshot_delta.py" in out
 
+    def test_dry_run_columnar_mode_selects_parity_ring(self, capsys,
+                                                       monkeypatch):
+        """--columnar sweeps the columnar host-state parity ring
+        (columnar-vs-object equivalence + pack bit-identity + identical
+        placements); composes with --arena/--incremental/--pipeline."""
+        from kai_scheduler_tpu.tools import chaos_matrix
+        monkeypatch.setattr(
+            chaos_matrix.subprocess, "run",
+            lambda *a, **kw: (_ for _ in ()).throw(AssertionError(
+                "dry run must not execute iterations")))
+        rc = chaos_matrix.main(["--dry-run", "--columnar",
+                                "--seeds", "3,5"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert out.count("seed ") == 2
+        assert "tests/test_columnar_store.py" in out
+        assert "tests/test_reconciler.py" not in out
+        rc = chaos_matrix.main(["--dry-run", "--columnar", "--arena",
+                                "--incremental", "--pipeline",
+                                "--seeds", "3"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "tests/test_columnar_store.py" in out
+        assert "tests/test_snapshot_delta.py" in out
+        assert "tests/test_incremental_cache.py" in out
+        assert "tests/test_pipeline_cycle.py" in out
+
     def test_dry_run_races_mode_arms_locktrace(self, capsys, monkeypatch):
         """--races: the grid shows races=on per seed plus the
         KAI_LOCKTRACE banner, without building the static lock graph or
