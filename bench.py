@@ -225,6 +225,7 @@ def fleet_phase(n_nodes=2000, n_jobs=8, gang=100, waves=2,
 
     def submit_wave(wave):
         api = system.api
+        create_many = getattr(api, "create_many", None)
         for j in range(n_jobs):
             name = f"fleet-w{wave}-j{j}"
             api.create({
@@ -236,12 +237,19 @@ def fleet_phase(n_nodes=2000, n_jobs=8, gang=100, waves=2,
                     "Worker": {"replicas": gang}}}})
             ref = owner_ref("PyTorchJob", name, uid=f"{name}-uid",
                             api_version="kubeflow.org/v1")
-            for k in range(gang):
-                api.create(make_pod(
-                    f"{name}-worker-{k:04d}", owner=ref,
-                    gpu=1 if j % 2 == 0 else 0,
-                    labels={"training.kubeflow.org/replica-type":
-                            "worker"}))
+            pods = [make_pod(
+                f"{name}-worker-{k:04d}", owner=ref,
+                gpu=1 if j % 2 == 0 else 0,
+                labels={"training.kubeflow.org/replica-type":
+                        "worker"}) for k in range(gang)]
+            if create_many is not None:
+                # Submission batches like production clients do: one
+                # bulk round trip per 500-pod chunk over the wire.
+                for lo in range(0, len(pods), 500):
+                    create_many(pods[lo:lo + 500])
+            else:
+                for pod in pods:
+                    api.create(pod)
 
     def run_until_bound(expect, max_cycles=6):
         ts = []
@@ -271,12 +279,19 @@ def fleet_phase(n_nodes=2000, n_jobs=8, gang=100, waves=2,
         else:
             system = System(SystemConfig(pipelined_cycles=pipelined))
         api = system.api
-        for i in range(n_nodes):
-            api.create({"kind": "Node",
+        fleet_nodes = [{"kind": "Node",
                         "metadata": {"name": f"fn{i:05d}"}, "spec": {},
                         "status": {"allocatable": {
                             "cpu": "32", "memory": "256Gi",
-                            "nvidia.com/gpu": 8, "pods": 110}}})
+                            "nvidia.com/gpu": 8, "pods": 110}}}
+                       for i in range(n_nodes)]
+        node_many = getattr(api, "create_many", None)
+        if node_many is not None:
+            for lo in range(0, len(fleet_nodes), 500):
+                node_many(fleet_nodes[lo:lo + 500])
+        else:
+            for node in fleet_nodes:
+                api.create(node)
         for q in range(8):
             api.create({"kind": "Queue", "metadata": {"name": f"fq{q}"},
                         "spec": {}})
@@ -571,11 +586,16 @@ def pipeline_ab_main() -> int:
     # pure-Python microseconds there, so the interpreter lock bounds
     # what the commit thread can overlap.  "http" is the daemon's
     # production regime — commit I/O is real network round trips the
-    # executor thread genuinely overlaps with host prep — but the
-    # loopback apiserver is itself minutes-per-cycle at 2000n, so the
-    # http pair runs the 400n daemon scale instead.  Both pairs commit.
+    # executor thread genuinely overlaps with host prep.  The http leg
+    # runs BOTH the historical 400n/800p daemon shape (the @d78375f
+    # 11.6s-pipelined baseline this PR's transport work is measured
+    # against) and the full 2000n/4000p fleet shape — previously
+    # infeasible over the wire (410s serial cycles before the pooled
+    # dispatcher + preserialized frames + watch-mode cache + bulk
+    # endpoints).  All pairs commit.
     for substrate, shape in (("memory", (2000, 8, 500)),
-                             ("http", (400, 4, 200))):
+                             ("http", (400, 4, 200)),
+                             ("http", (2000, 8, 500))):
         fleet = {}
         for pipelined in (False, True):
             r = fleet_phase(*shape, pipelined=pipelined,
@@ -956,7 +976,7 @@ def fairshare_microbench(n_queues=10000, roots=16,
 
 def churn_phase(n_nodes=256, n_queues=10000, cycles=8,
                 submit_per_cycle=400, mode="forest", seed=0,
-                gpu_per_node=8, pipelined=False):
+                gpu_per_node=8, pipelined=False, substrate="memory"):
     """The heavy-traffic multi-tenant churn ring (ROADMAP item 3).
 
     A full ``System`` over one in-memory apiserver with an O(10k)-queue
@@ -981,19 +1001,43 @@ def churn_phase(n_nodes=256, n_queues=10000, cycles=8,
 
     rng = np.random.default_rng(seed)
     cfg = SchedulerConfig(actions=["allocate"], fused_fairshare=mode)
-    system = System(SystemConfig(shards=[ShardSpec(config=cfg)],
-                                 pipelined_cycles=pipelined))
+    server = client = None
+    if substrate == "http":
+        # The wire ring: the whole churn stream (submits, completes,
+        # evictions, kubelet finalization) and the fleet itself run over
+        # a real loopback apiserver — the daemon's production regime.
+        from kai_scheduler_tpu.controllers.apiserver import KubeAPIServer
+        from kai_scheduler_tpu.controllers.httpclient import HTTPKubeAPI
+        server = KubeAPIServer().start()
+        client = HTTPKubeAPI(server.url)
+        system = System(SystemConfig(shards=[ShardSpec(config=cfg)],
+                                     pipelined_cycles=pipelined),
+                        api=client)
+    else:
+        system = System(SystemConfig(shards=[ShardSpec(config=cfg)],
+                                     pipelined_cycles=pipelined))
     api = system.api
+    # Selector pushdown for the driver's own queries: "bound and not
+    # terminating" / "terminating" ship as field selectors (server-side
+    # on the wire) instead of whole-kind lists per cycle.
+    SEL_BOUND = "spec.nodeName!=,metadata.deletionTimestamp="
+    SEL_TERMINATING = "metadata.deletionTimestamp!="
     t_setup = time.perf_counter()
-    for i in range(n_nodes):
-        api.create({"kind": "Node",
-                    "metadata": {"name": f"cn{i:05d}"}, "spec": {},
-                    "status": {"allocatable": {
-                        "cpu": "64", "memory": "512Gi",
-                        "nvidia.com/gpu": gpu_per_node, "pods": 110}}})
+    nodes = [{"kind": "Node",
+              "metadata": {"name": f"cn{i:05d}"}, "spec": {},
+              "status": {"allocatable": {
+                  "cpu": "64", "memory": "512Gi",
+                  "nvidia.com/gpu": gpu_per_node, "pods": 110}}}
+             for i in range(n_nodes)]
     queue_objs, leaves = build_queue_forest(n_queues)
-    for obj in queue_objs:
-        api.create(obj)
+    setup_many = getattr(api, "create_many", None)
+    if setup_many is not None:
+        for objs in (nodes, queue_objs):
+            for lo in range(0, len(objs), 500):
+                setup_many(objs[lo:lo + 500])
+    else:
+        for obj in nodes + queue_objs:
+            api.create(obj)
     setup_s = time.perf_counter() - t_setup
     _log(f"churn setup: {n_nodes} nodes, {len(queue_objs)} queues "
          f"({len(leaves)} leaves) in {setup_s:.1f}s")
@@ -1025,13 +1069,22 @@ def churn_phase(n_nodes=256, n_queues=10000, cycles=8,
         _log("churn warmup done; measuring stream")
         LIFECYCLE.reset()
         reuse0 = METRICS.counters.get("fairshare_prep_reuse_total", 0)
+        create_many = getattr(api, "create_many", None)
         for _ in range(cycles):
             leaf_idx = rng.integers(0, len(leaves), submit_per_cycle)
+            burst = []
             for li in leaf_idx:
-                api.create(make_pod(f"churn-{serial:06d}",
-                                    queue=leaves[int(li)], gpu=1))
+                burst.append(make_pod(f"churn-{serial:06d}",
+                                      queue=leaves[int(li)], gpu=1))
                 serial += 1
-            bound = [p for p in api.list("Pod")
+            if create_many is not None:
+                for lo in range(0, len(burst), 500):
+                    create_many(burst[lo:lo + 500])
+            else:
+                for pod in burst:
+                    api.create(pod)
+            bound = [p for p in api.list("Pod",
+                                         field_selector=SEL_BOUND)
                      if p["spec"].get("nodeName")
                      and not p["metadata"].get("deletionTimestamp")]
             rng.shuffle(bound)
@@ -1054,7 +1107,7 @@ def churn_phase(n_nodes=256, n_queues=10000, cycles=8,
             if ssn is not None and "fairshare" in ssn.phase_timings:
                 fairshare_ts.append(ssn.phase_timings["fairshare"])
             # Kubelet analog: terminations complete.
-            for p in api.list("Pod"):
+            for p in api.list("Pod", field_selector=SEL_TERMINATING):
                 if p["metadata"].get("deletionTimestamp"):
                     api.delete("Pod", p["metadata"]["name"],
                                p["metadata"].get("namespace", "default"))
@@ -1066,6 +1119,10 @@ def churn_phase(n_nodes=256, n_queues=10000, cycles=8,
         pod_latency = LIFECYCLE.summary()
     finally:
         LIFECYCLE.configure_bounds(**old_bounds)
+        if server is not None:
+            system.stop_pipeline()
+            client.close()
+            server.stop()
 
     slots = n_nodes * gpu_per_node
     expected_bound = min(total_pods, slots + completed + evicted)
@@ -1073,6 +1130,7 @@ def churn_phase(n_nodes=256, n_queues=10000, cycles=8,
         "config": f"{n_nodes}nodes_{n_queues}queues_"
                   f"{submit_per_cycle}per_cycle",
         "pipelined": bool(pipelined),
+        "substrate": substrate,
         "fairshare_mode": mode,
         "queues": n_queues,
         "leaves": len(leaves),
@@ -1131,6 +1189,17 @@ def churn_main(iters: int = 7) -> int:
     _append_result_row({"scenario": "churn-ring", "backend": backend,
                         "fairshare_speedup_vs_looped": round(speedup, 2),
                         **row})
+
+    # The churn ring OVER THE WIRE (DESIGN §12): the same continuous
+    # stream driven through a real loopback apiserver — submits,
+    # completions, evictions, and the kubelet analog all pay transport,
+    # with the driver's per-cycle queries pushed down as field
+    # selectors.  Committed next to the in-memory row as the A/B.
+    wrow = churn_phase(pipelined=True, substrate="http")
+    _append_result_row({"scenario": "churn-ring", "backend": backend,
+                        **wrow})
+    _log(f"wire churn ring: cycle {wrow['cycle_s']}s, p99 submit→bound "
+         f"{wrow['pod_latency'].get('submit_to_bound_p99_ms')}ms")
     return 0
 
 

@@ -142,6 +142,8 @@ class Binder:
         self._local_phase: dict = {}
         # (ns, pod name) tombstones for vanished pods.
         self._gone_pods: set = set()
+        # Per-drain-batch node-existence memo (None outside a batch).
+        self._batch_nodes: dict | None = None
         api.watch("BindRequest", self._on_bind_request)
         api.watch("Pod", self._on_pod_event)
         idle = getattr(api, "on_drain_idle", None)
@@ -186,17 +188,80 @@ class Binder:
     def drain_pending(self) -> int:
         """Process the queued BindRequests once per delivery batch: a
         request touched by N watch events reconciles once, and requests
-        whose pod already vanished are skipped outright."""
+        whose pod already vanished are skipped outright.  The batch's
+        final pod-bind patches land as ONE bulk wave
+        (``api.patch_many`` → ``POST /bulk/patch`` on the wire) with
+        per-item outcomes — a failed item feeds that request's backoff
+        path only, the rest of the wave binds."""
         if not self._pending_brs:
             return 0
         pending, self._pending_brs = self._pending_brs, {}
         processed = 0
-        for key, br in pending.items():
-            if self._skip_stale(key, br):
-                continue
-            self._process(br)
-            processed += 1
+        # The wave only batches the DEFAULT bind path: an overridden
+        # ``_bind`` (subclass or instance injection — the chaos tests'
+        # crash seam) keeps full per-request control, synchronously.
+        default_bind = ("_bind" not in self.__dict__
+                        and type(self)._bind is Binder._bind)
+        wave: list = ([] if default_bind
+                      and hasattr(self.api, "patch_many") else None)
+        # Node-existence memo for THIS batch: a 4000-bind wave targets
+        # at most one node per bind and the existence check is
+        # advisory — one GET per distinct node per batch instead of one
+        # per request (over the wire that halves the wave's round
+        # trips).  Bounded staleness of a single drain batch.
+        self._batch_nodes = {}
+        try:
+            for key, br in pending.items():
+                if self._skip_stale(key, br):
+                    continue
+                self._process(br, wave=wave)
+                processed += 1
+            self._flush_wave(wave)
+        finally:
+            self._batch_nodes = None
         return processed
+
+    def _flush_wave(self, wave: list | None) -> None:
+        """Apply the batch's deferred pod-bind patches in one bulk round
+        trip, then finish each request from its per-item outcome —
+        success and failure bookkeeping identical to the synchronous
+        path."""
+        if not wave:
+            return
+        items = [{"kind": "Pod", "name": prep["pod"]["metadata"]["name"],
+                  "namespace": prep["ns"], "patch": prep["patch"]}
+                 for _br, _status, _uid, prep in wave]
+        METRICS.inc("bulk_write_batches_total", path="binder")
+        METRICS.inc("bulk_write_items_total", len(items), path="binder")
+        METRICS.inc("binder_bulk_binds_total", len(items))
+        try:
+            outcomes = self.api.patch_many(items)
+        except Exception as exc:
+            # Whole-batch transport failure (e.g. the ambiguous
+            # died-awaiting-response URLError): every request keeps its
+            # backoff/attempt bookkeeping, exactly like a per-item
+            # failure — the wave must never escape drain_pending and
+            # strand the batch without retry state.  The bind patch is
+            # idempotent, so a write that secretly landed is re-asserted
+            # harmlessly on retry.
+            METRICS.inc("bulk_write_errors_total", len(items),
+                        path="binder")
+            for br, status, pod_uid, _prep in wave:
+                self._bind_failed(br, status, pod_uid, exc)
+                self._write_status(br, status)
+            return
+        for (br, status, pod_uid, prep), out in zip(wave, outcomes):
+            if out.get("ok"):
+                try:
+                    self._bind_complete(prep)
+                except Exception as exc:
+                    self._bind_failed(br, status, pod_uid, exc)
+                else:
+                    self._bind_succeeded(br, status, pod_uid)
+            else:
+                METRICS.inc("bulk_write_errors_total", path="binder")
+                self._bind_failed(br, status, pod_uid, out.get("error"))
+            self._write_status(br, status)
 
     def _skip_stale(self, key, br: dict) -> bool:
         if key in self._local_phase:
@@ -237,7 +302,7 @@ class Binder:
         else:
             self.api.patch("BindRequest", name, {"status": status}, ns)
 
-    def _process(self, br: dict) -> None:
+    def _process(self, br: dict, wave: list | None = None) -> None:
         status = br.setdefault("status", {})
         if status.get("phase") in ("Succeeded", "Failed"):
             return
@@ -246,33 +311,55 @@ class Binder:
             return  # backing off; tick() retries once the delay elapses
         pod_uid = br.get("spec", {}).get("podUid", "")
         try:
-            self._bind(br)
-            status["phase"] = "Succeeded"
-            status.pop("backoffUntil", None)
-            # Lifecycle: terminal success — the timeline closes and the
-            # submit→bound latency publishes.
-            LIFECYCLE.note_bound(pod_uid,
-                                 node=br["spec"].get("selectedNode", ""))
-        except Exception as exc:  # retry with backoff limit
-            attempts = status.get("attempts", 0) + 1
-            status["attempts"] = attempts
-            LIFECYCLE.note_bind_attempt(pod_uid)
-            if attempts >= br.get("spec", {}).get("backoffLimit",
-                                                  self.backoff_limit):
-                status["phase"] = "Failed"
-                status["reason"] = str(exc)
-                self._rollback(br)
-                METRICS.inc("bind_backoff_exceeded")
-                LIFECYCLE.note_bind_failed(pod_uid)
-                self._record_event(
-                    "bind_backoff_exceeded",
-                    f"BindRequest {br['metadata']['name']}: "
-                    f"{attempts} attempts exhausted: {exc}")
+            if wave is not None:
+                prep = self._bind_prepare(br)
+                if prep.get("patch") is not None:
+                    # Defer the final pod-bind write into the batch
+                    # wave — status settles from the bulk outcome in
+                    # _flush_wave.
+                    wave.append((br, status, pod_uid, prep))
+                    return
+                # bind_pod substrates cannot batch: finish synchronously.
+                self._bind_apply(prep)
+                self._bind_complete(prep)
             else:
-                status["phase"] = "Pending"
-                status["backoffUntil"] = \
-                    self.now_fn() + self._backoff_delay(attempts)
+                self._bind(br)
+        except Exception as exc:  # retry with backoff limit
+            self._bind_failed(br, status, pod_uid, exc)
+            self._write_status(br, status)
+            return
+        self._bind_succeeded(br, status, pod_uid)
         self._write_status(br, status)
+
+    def _bind_succeeded(self, br: dict, status: dict,
+                        pod_uid: str) -> None:
+        status["phase"] = "Succeeded"
+        status.pop("backoffUntil", None)
+        # Lifecycle: terminal success — the timeline closes and the
+        # submit→bound latency publishes.
+        LIFECYCLE.note_bound(pod_uid,
+                             node=br["spec"].get("selectedNode", ""))
+
+    def _bind_failed(self, br: dict, status: dict, pod_uid: str,
+                     exc: Exception) -> None:
+        attempts = status.get("attempts", 0) + 1
+        status["attempts"] = attempts
+        LIFECYCLE.note_bind_attempt(pod_uid)
+        if attempts >= br.get("spec", {}).get("backoffLimit",
+                                              self.backoff_limit):
+            status["phase"] = "Failed"
+            status["reason"] = str(exc)
+            self._rollback(br)
+            METRICS.inc("bind_backoff_exceeded")
+            LIFECYCLE.note_bind_failed(pod_uid)
+            self._record_event(
+                "bind_backoff_exceeded",
+                f"BindRequest {br['metadata']['name']}: "
+                f"{attempts} attempts exhausted: {exc}")
+        else:
+            status["phase"] = "Pending"
+            status["backoffUntil"] = \
+                self.now_fn() + self._backoff_delay(attempts)
 
     def tick(self) -> int:
         """Re-reconcile Pending BindRequests whose backoff has elapsed
@@ -281,7 +368,16 @@ class Binder:
         reconciler directly).  Returns how many were retried."""
         retried = 0
         now = self.now_fn()
-        for br in self.api.list("BindRequest"):
+        # Selector pushdown: only Pending requests matter here — the
+        # store (server-side on the wire) filters, so a steady-state
+        # tick never ships the whole kind.
+        try:
+            pending_brs = self.api.list(
+                "BindRequest",
+                field_selector={"status.phase": "Pending"})
+        except TypeError:  # substrate without selector support
+            pending_brs = self.api.list("BindRequest")
+        for br in pending_brs:
             status = br.get("status", {})
             if status.get("phase") != "Pending":
                 continue
@@ -322,11 +418,28 @@ class Binder:
                           type(exc).__name__, exc)
 
     def _bind(self, br: dict) -> None:
+        """Synchronous full bind (tick()/tests): prepare + apply +
+        post-bind in one call."""
+        prep = self._bind_prepare(br)
+        self._bind_apply(prep)
+        self._bind_complete(prep)
+
+    def _bind_prepare(self, br: dict) -> dict:
+        """Everything up to (but excluding) the final pod-bind write:
+        pod/node reads, pre-bind plugins, fractional-GPU reservations.
+        Returns the prep record carrying the deferred ``patch`` document
+        — None when the client exposes the real pods/binding subresource
+        (``bind_pod``), which cannot batch."""
         spec = br["spec"]
         ns = br["metadata"].get("namespace", "default")
         pod = self.api.get("Pod", spec["podName"], ns)
         node_name = spec["selectedNode"]
-        node = self.api.get("Node", node_name, "default")
+        batch_nodes = getattr(self, "_batch_nodes", None)
+        if batch_nodes is None:
+            self.api.get("Node", node_name, "default")  # node must exist
+        elif node_name not in batch_nodes:
+            self.api.get("Node", node_name, "default")
+            batch_nodes[node_name] = True
 
         for plugin in self.plugins:
             plugin.pre_bind(self.api, pod, node_name, br)
@@ -340,29 +453,46 @@ class Binder:
         # subresource sets it (binding/binder.go:42-128's clientset call)
         # — so clients exposing bind_pod take that path (and kubelet,
         # not the binder, then owns status.phase).  The embedded
-        # substrates keep the patch form, which also simulates the
-        # kubelet's phase transition.
-        pod["spec"]["nodeName"] = node_name
-        pod.setdefault("status", {})["phase"] = "Running"
-        bind_pod = getattr(self.api, "bind_pod", None)
-        if bind_pod is not None:
-            try:
-                bind_pod(pod["metadata"]["name"], node_name, ns)
-            except Conflict:
-                # Retry idempotency: a re-reconcile after a partial bind
-                # (binder died between binding and the status patch) gets
-                # 409 from the real apiserver; already-on-target is
-                # success, anything else is a genuine conflict.
-                current = self.api.get("Pod", pod["metadata"]["name"], ns)
-                if current.get("spec", {}).get("nodeName") != node_name:
-                    raise
-        else:
-            self.api.patch("Pod", pod["metadata"]["name"],
-                           {"spec": {"nodeName": node_name},
-                            "status": {"phase": "Running"}}, ns)
+        # substrates keep the patch form — which also simulates the
+        # kubelet's phase transition AND batches into the bind wave.
+        # The in-place pod mutation happens at APPLY time, not here: a
+        # wave item whose bulk write fails must leave the (live, on the
+        # in-memory dialect) pod dict untouched.
+        patch = None
+        if getattr(self.api, "bind_pod", None) is None:
+            patch = {"spec": {"nodeName": node_name},
+                     "status": {"phase": "Running"}}
+        return {"br": br, "pod": pod, "ns": ns, "node_name": node_name,
+                "patch": patch}
 
+    def _bind_apply(self, prep: dict) -> None:
+        """The final pod-bind write, synchronously (the bulk wave lands
+        the same ``patch`` document through ``patch_many`` instead)."""
+        pod, ns, node_name = prep["pod"], prep["ns"], prep["node_name"]
+        if prep["patch"] is not None:
+            self.api.patch("Pod", pod["metadata"]["name"], prep["patch"],
+                           ns)
+            return
+        try:
+            self.api.bind_pod(pod["metadata"]["name"], node_name, ns)
+        except Conflict:
+            # Retry idempotency: a re-reconcile after a partial bind
+            # (binder died between binding and the status patch) gets
+            # 409 from the real apiserver; already-on-target is
+            # success, anything else is a genuine conflict.
+            current = self.api.get("Pod", pod["metadata"]["name"], ns)
+            if current.get("spec", {}).get("nodeName") != node_name:
+                raise
+
+    def _bind_complete(self, prep: dict) -> None:
+        # Mirror the landed write onto the in-hand pod object (detached
+        # copy on the wire dialects; post_bind plugins read it).
+        pod = prep["pod"]
+        pod["spec"]["nodeName"] = prep["node_name"]
+        pod.setdefault("status", {})["phase"] = "Running"
         for plugin in self.plugins:
-            plugin.post_bind(self.api, pod, node_name, br)
+            plugin.post_bind(self.api, pod, prep["node_name"],
+                             prep["br"])
 
     def _reserve_gpus(self, pod: dict, node_name: str, gpu_groups: list,
                       spec: dict) -> None:

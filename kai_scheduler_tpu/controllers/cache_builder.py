@@ -469,6 +469,16 @@ class ClusterCache:
         import threading
         self._changes_lock = threading.Lock()
         self._changed_keys: set = set()
+        # Latest watch payload per dirty key (None = DELETED), kept only
+        # on substrates whose watch events are DETACHED server-side
+        # snapshots (HTTPKubeAPI sets watch_payloads_detached): the
+        # snapshot then folds the payload directly — the informer-store
+        # pattern — instead of paying one GET round trip per dirty key.
+        # On the in-memory store the emitted dict is the LIVE object, so
+        # re-reading via get_opt stays authoritative there.
+        self._changed_objs: dict = {}
+        self._payload_auth = bool(getattr(api, "watch_payloads_detached",
+                                          False))
         self._primed = False
         self._watch_mode = False
         self.last_snapshot_stats: dict = {}
@@ -699,9 +709,13 @@ class ClusterCache:
         if kind not in _CONSUMED_KINDS:
             return
         md = obj.get("metadata", {})
+        key = (kind, md.get("namespace", "default"), md.get("name"))
         with self._changes_lock:
-            self._changed_keys.add(
-                (kind, md.get("namespace", "default"), md.get("name")))
+            self._changed_keys.add(key)
+            if self._payload_auth:
+                # Latest event wins per key; DELETED folds as None.
+                self._changed_objs[key] = (None if event_type == "DELETED"
+                                           else obj)
 
     def _wholesale_invalidate(self) -> None:
         """Watch resync: an unknown stretch of events was missed — every
@@ -728,12 +742,14 @@ class ClusterCache:
                                     "groups": set()}
         with self._changes_lock:
             self._changed_keys = set()
+            self._changed_objs = {}
         self._primed = False
 
-    def _take_changes(self) -> set:
+    def _take_changes(self) -> tuple:
         with self._changes_lock:
             changes, self._changed_keys = self._changed_keys, set()
-        return changes
+            objs, self._changed_objs = self._changed_objs, {}
+        return changes, objs
 
     def _col_upsert(self, key: tuple, obj: dict,
                     events: dict) -> str | None:
@@ -763,7 +779,8 @@ class ClusterCache:
         if uid is not None:
             events["pods_removed"].add(uid)
 
-    def _apply_changes(self, changes: set) -> dict:
+    def _apply_changes(self, changes: set, payloads: dict | None = None
+                       ) -> dict:
         """Fold accumulated dirty keys into the mirrors (watch mode) and
         the columnar store; delta events (changed/removed pod uids +
         touched PodGroup names — the columnar snapshot's O(delta) dirty
@@ -773,14 +790,26 @@ class ClusterCache:
         stay invisible to scheduling until the next resync.  Within one
         key the columnar fold + event record happen BEFORE the
         mirror/sig write, so a retry's sig-match skip can only ever skip
-        keys whose columnar state and events already landed."""
+        keys whose columnar state and events already landed.
+
+        ``payloads`` (detached-payload substrates, i.e. the wire): the
+        latest watch event object per key — folded directly instead of
+        re-reading via get_opt, so a churn burst costs ZERO list/get
+        round trips.  A key dirtied without a payload (or on the
+        in-memory store, whose events reference live dicts) still
+        re-reads authoritative state."""
         changed = {k: 0 for k in _HOT_KINDS}
         events = self._pending_col_events
+        use_payloads = self._payload_auth and payloads is not None
         try:
             for kind, ns, name in changes:
                 key = (ns, name)
                 mirror = self._mirror[kind]
-                obj = self.api.get_opt(kind, name, ns)
+                full_key = (kind, ns, name)
+                if use_payloads and full_key in payloads:
+                    obj = payloads[full_key]
+                else:
+                    obj = self.api.get_opt(kind, name, ns)
                 if obj is None:
                     if key not in mirror:
                         continue  # created+deleted between snapshots
@@ -818,6 +847,10 @@ class ClusterCache:
         except BaseException:
             with self._changes_lock:
                 self._changed_keys |= changes
+                if use_payloads:
+                    for k, v in payloads.items():
+                        # A newer payload recorded since the take wins.
+                        self._changed_objs.setdefault(k, v)
             raise
         return changed
 
@@ -1136,7 +1169,7 @@ class ClusterCache:
             resync_fired = True
         was_primed = self._primed
         if self._watch_mode and self._primed:
-            changed = self._apply_changes(self._take_changes())
+            changed = self._apply_changes(*self._take_changes())
         else:
             # The full refresh subsumes every change marked so far:
             # discard the backlog FIRST (keys marked while the listing
@@ -1809,13 +1842,11 @@ class ClusterCache:
         return aux
 
     # -- side-effect executor (framework Session cache interface) ------------
-    def bind(self, task, node_name: str, bind_request) -> None:
-        """Create (or supersede) the BindRequest object the binder
-        consumes (cache/cache.go:267-290).  A leftover request from a
-        previous failed attempt is replaced: the fresh scheduling decision
-        resets the phase and retry budget."""
-        fk = self._fence_kwargs()
-        obj = {
+    def _bind_manifest(self, task, node_name: str, bind_request,
+                       fk: dict) -> dict:
+        """The BindRequest object for one placement decision — shared by
+        the single write and the bulk bind wave."""
+        return {
             "kind": "BindRequest",
             "metadata": {"name": f"bind-{task.uid}",
                          "namespace": task.namespace},
@@ -1836,6 +1867,14 @@ class ClusterCache:
                          getattr(bind_request, "claim_allocations", []))},
             "status": {"phase": "Pending"},
         }
+
+    def bind(self, task, node_name: str, bind_request) -> None:
+        """Create (or supersede) the BindRequest object the binder
+        consumes (cache/cache.go:267-290).  A leftover request from a
+        previous failed attempt is replaced: the fresh scheduling decision
+        resets the phase and retry budget."""
+        fk = self._fence_kwargs()
+        obj = self._bind_manifest(task, node_name, bind_request, fk)
         with TRACER.span(f"bind:{task.name}", kind="kubeapi",
                          op="bindrequest_create", node=node_name,
                          epoch=fk.get("epoch")) as sp:
@@ -1854,6 +1893,61 @@ class ClusterCache:
         # only after the write survived the fence).
         LIFECYCLE.note(task.uid, "bind_requested", node=node_name,
                        trace_id=getattr(bind_request, "trace_id", None))
+
+    def bind_many(self, entries) -> list:
+        """Bulk bind wave: ``entries`` is [(task, node_name,
+        bind_request)]; the whole wave lands through ONE
+        ``create_many`` round trip (``POST /bulk/create`` on the wire,
+        supersede-on-conflict matching ``bind``'s semantics), with
+        per-item outcomes — one fenced or failed item never poisons the
+        wave.  Returns the outcome list aligned with ``entries``
+        (``{"ok": True, ...}`` / ``{"ok": False, "error": exc}``);
+        lifecycle stamps land only for requests that reached the store.
+        Falls back to per-item ``bind`` on substrates without
+        ``create_many`` (every failure raises immediately there, the
+        historical contract)."""
+        entries = list(entries)
+        if not entries:
+            return []
+        create_many = getattr(self.api, "create_many", None)
+        if create_many is None:
+            # Per-item fallback with per-item OUTCOMES: a mid-wave
+            # failure stops the wave (the historical abort-on-raise
+            # order) but already-landed binds keep their ok outcomes, so
+            # the caller's journal/landed bookkeeping stays truthful.
+            outcomes = []
+            for i, (task, node_name, bind_request) in enumerate(entries):
+                try:
+                    self.bind(task, node_name, bind_request)
+                    outcomes.append({"ok": True})
+                except Exception as exc:
+                    outcomes.extend(
+                        {"ok": False, "error": exc}
+                        for _ in range(len(entries) - i))
+                    break
+            return outcomes
+        fk = self._fence_kwargs()
+        objs = [self._bind_manifest(task, node, br, fk)
+                for task, node, br in entries]
+        with TRACER.span("bind_wave", kind="kubeapi",
+                         op="bindrequest_create_bulk", binds=len(objs),
+                         epoch=fk.get("epoch")) as sp:
+            outcomes = create_many(objs, supersede=True, **fk)
+            failed = sum(1 for out in outcomes if not out.get("ok"))
+            if failed:
+                sp.set(failed_items=failed)
+        METRICS.inc("bulk_write_batches_total", path="bind_wave")
+        METRICS.inc("bulk_write_items_total", len(entries),
+                    path="bind_wave")
+        if failed:
+            METRICS.inc("bulk_write_errors_total", failed,
+                        path="bind_wave")
+        for (task, node_name, bind_request), out in zip(entries, outcomes):
+            if out.get("ok"):
+                LIFECYCLE.note(task.uid, "bind_requested", node=node_name,
+                               trace_id=getattr(bind_request, "trace_id",
+                                                None))
+        return outcomes
 
     def task_pipelined(self, task, node_name: str,
                        gpu_group: str = "") -> None:

@@ -43,21 +43,31 @@ from typing import Callable
 from ..utils import backoff_delay
 from ..utils.deviceguard import control_fault
 from ..utils.metrics import METRICS
-from .kubeapi import Conflict, Fenced, NotFound, coalesce_events, obj_key
+from .kubeapi import (Conflict, Fenced, NotFound, coalesce_events,
+                      encode_field_selector, obj_key)
 
 RECONNECT_BASE_S = 0.2
 RECONNECT_CAP_S = 5.0
+LIST_PAGE_SIZE = 500
+THROTTLE_RETRIES = 5
 
 
 class HTTPKubeAPI:
+    # Watch payloads are detached server-side snapshots (the apiserver
+    # deep-copies at emit), so a consumer's change hook may keep the
+    # event object as its authoritative view of that key instead of
+    # paying a GET per dirty key (ClusterCache's watch-mode dirty path
+    # reads this flag — the informer store pattern).
+    watch_payloads_detached = True
+
     def __init__(self, base_url: str, timeout: float = 10.0):
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
         # Persistent keep-alive transport: one HTTP/1.1 connection per
         # calling thread, reused across requests.  A fresh TCP connect
-        # per request costs the handshake PLUS a new handler thread on
-        # the ThreadingHTTPServer side — at fleet scale that overhead
-        # alone dominated commit I/O (~10ms/op vs ~0.2ms reused).
+        # per request costs the handshake PLUS a dispatcher round trip
+        # server-side — at fleet scale that overhead alone dominated
+        # commit I/O (~10ms/op vs ~0.2ms reused).
         parsed = urllib.parse.urlsplit(self.base_url)
         self._conn_host = parsed.hostname or "127.0.0.1"
         self._conn_port = parsed.port or (443 if parsed.scheme == "https"
@@ -84,6 +94,18 @@ class HTTPKubeAPI:
         # informer diffs its store the same way).
         self._known: dict[tuple, dict] = {}
         self._watch_thread: threading.Thread | None = None
+        # Emit-time change hooks (InMemoryKubeAPI.watch_sync parity),
+        # invoked ON THE WATCH THREAD as events arrive: handlers must be
+        # cheap (mark-dirty only) and may return False to deregister.
+        # Guarded by _pending_lock against the watch thread's prune.
+        self._sync_watchers: list[Callable] = []
+        # Highest event seq any of this client's own mutations produced
+        # (the X-Kai-Seq response header): sync_watch() waits until the
+        # watch cursor reaches it — read-your-writes without a re-list.
+        # Monotone max watermark; a lost store from two racing writers
+        # only shortens the barrier by one event, never corrupts it.
+        # kairace: disable=KRC001
+        self._last_write_seq = 0
         # Serializes the watch thread's exit decision against
         # _ensure_watch_thread's liveness check: without it, a
         # stop/clear/restart sequence can observe a thread that is alive
@@ -185,7 +207,12 @@ class HTTPKubeAPI:
         # landed; replaying it would turn success into a spurious
         # Conflict/NotFound, so that ambiguity is surfaced as URLError
         # exactly like the old one-connection-per-request transport did.
-        for attempt in (0, 1):
+        # 429 (pool saturation) is different: the server REJECTED the
+        # request before touching the store, so replaying any method is
+        # safe — back off briefly and retry a bounded number of times.
+        stale_retried = False
+        throttles = 0
+        while True:
             conn = self._connection()
             sent = False
             try:
@@ -204,16 +231,39 @@ class HTTPKubeAPI:
                     if status < 400:
                         raise urllib.error.URLError(exc) from exc
                     raw = b""
-                break
             except (http.client.HTTPException, ConnectionError) as exc:
                 self._drop_connection()
-                if attempt or (sent and method != "GET"):
+                if stale_retried or (sent and method != "GET"):
                     raise urllib.error.URLError(exc) from exc
+                stale_retried = True
+                continue
             except OSError:
                 # Timeouts / unreachable: the conn state is unknown —
                 # never reuse it for the next request.
                 self._drop_connection()
                 raise
+            if status == 429 and throttles < THROTTLE_RETRIES:
+                # Backpressure: the dispatcher refused the request (and
+                # closed the connection) — never processed, safe to
+                # replay after a short jittered pause.
+                throttles += 1
+                METRICS.inc("http_throttled_retries_total")
+                self._drop_connection()
+                time.sleep(0.005 * (2 ** throttles)
+                           + self._reconnect_rng.random() * 0.005)
+                continue
+            break
+        if status < 300 and method != "GET":
+            seq_h = resp.getheader("X-Kai-Seq")
+            if seq_h:
+                try:
+                    seq = int(seq_h)
+                except ValueError:
+                    seq = 0
+                if seq > self._last_write_seq:
+                    # Monotone watermark (see the field comment).
+                    # kairace: disable=KRC001
+                    self._last_write_seq = seq
         # 3xx is NOT success: this transport does not follow redirects
         # (the old urllib one did), so a proxy's redirect must surface
         # as a mapped HTTPError below, not as its HTML body being fed
@@ -262,15 +312,90 @@ class HTTPKubeAPI:
             return None
 
     def list(self, kind: str, namespace: str | None = None,
-             label_selector: dict | None = None) -> list[dict]:
-        query = []
+             label_selector: dict | None = None,
+             field_selector=None, limit: int | None = None) -> list[dict]:
+        """Selector-filtered list with TRANSPARENT server-side
+        pagination: pages of ``limit`` (default 500) are fetched with
+        ``continue`` cursor tokens and reassembled — the caller sees one
+        list, the wire never ships an unbounded whole-kind response.  A
+        410 Gone on a continue token (event ring compacted past it, or
+        a server reboot) restarts the listing from scratch, exactly like
+        an informer's expired-continue re-list."""
+        base = {}
         if namespace is not None:
-            query.append(f"namespace={namespace}")
+            base["namespace"] = namespace
         if label_selector:
-            sel = ",".join(f"{k}={v}" for k, v in label_selector.items())
-            query.append(f"labelSelector={sel}")
-        qs = ("?" + "&".join(query)) if query else ""
-        return self._request("GET", f"/apis/{kind}{qs}")["items"]
+            base["labelSelector"] = ",".join(
+                f"{k}={v}" for k, v in label_selector.items())
+        fsel = encode_field_selector(field_selector)
+        if fsel:
+            base["fieldSelector"] = fsel
+        page = int(limit) if limit else LIST_PAGE_SIZE
+        items: list[dict] = []
+        token = None
+        restarts = 0
+        while True:
+            params = dict(base, limit=page)
+            if token:
+                params["continue"] = token
+            qs = urllib.parse.urlencode(params)
+            try:
+                out = self._request("GET", f"/apis/{kind}?{qs}")
+            except urllib.error.HTTPError as exc:
+                if exc.code == 410 and token and restarts < 3:
+                    # Expired continue token: transparent full re-list.
+                    METRICS.inc("http_list_continue_gone_total")
+                    items, token = [], None
+                    restarts += 1
+                    continue
+                raise
+            items.extend(out.get("items", []))
+            METRICS.inc("http_list_pages_total")
+            token = out.get("continue")
+            if not token:
+                return items
+
+    # -- bulk writes ---------------------------------------------------------
+    def _decode_outcomes(self, payload: dict) -> list[dict]:
+        outcomes = []
+        for out in payload.get("outcomes", []):
+            if out.get("ok"):
+                outcomes.append({"ok": True,
+                                 "object": out.get("object")})
+            else:
+                code = out.get("code")
+                msg = out.get("error", f"bulk item failed ({code})")
+                exc: Exception
+                if code == 404:
+                    exc = NotFound(msg)
+                elif code == 409:
+                    exc = Conflict(msg)
+                elif code == 412:
+                    exc = Fenced(msg)
+                else:
+                    exc = urllib.error.URLError(msg)
+                outcomes.append({"ok": False, "error": exc})
+        return outcomes
+
+    def create_many(self, objs: list, epoch: int | None = None,
+                    fence: str | None = None,
+                    supersede: bool = False) -> list[dict]:
+        """Batched create through ``POST /bulk/create`` — the bind-wave
+        write: one round trip for the whole wave, per-item outcomes
+        (InMemoryKubeAPI.create_many parity)."""
+        out = self._request("POST", "/bulk/create",
+                            {"items": objs, "supersede": supersede},
+                            epoch=epoch, fence=fence)
+        return self._decode_outcomes(out)
+
+    def patch_many(self, items: list, epoch: int | None = None,
+                   fence: str | None = None) -> list[dict]:
+        """Batched merge patch through ``POST /bulk/patch`` (status
+        waves, binder pod-bind waves): one round trip, per-item
+        outcomes."""
+        out = self._request("POST", "/bulk/patch", {"items": items},
+                            epoch=epoch, fence=fence)
+        return self._decode_outcomes(out)
 
     def update(self, obj: dict, epoch: int | None = None,
                fence: str | None = None) -> dict:
@@ -304,6 +429,51 @@ class HTTPKubeAPI:
     def watch_any(self, handler: Callable) -> None:
         self._watchers["*"].append(handler)
         self._ensure_watch_thread()
+
+    def watch_sync(self, handler: Callable) -> None:
+        """Emit-time change hook (InMemoryKubeAPI.watch_sync parity):
+        ``handler(event_type, obj)`` runs ON THE WATCH THREAD the moment
+        an event arrives off the wire — before any drain().  Handlers
+        MUST be cheap (mark-dirty only) and may return False to
+        deregister.  This is what lets ClusterCache run its O(delta)
+        watch-mode maintenance over the wire instead of re-listing every
+        kind per snapshot."""
+        with self._pending_lock:
+            self._sync_watchers.append(handler)
+        self._ensure_watch_thread()
+
+    def _fire_sync(self, event_type: str, obj: dict) -> None:
+        with self._pending_lock:
+            handlers = list(self._sync_watchers)
+        if not handlers:
+            return
+        dead = [h for h in handlers if h(event_type, obj) is False]
+        if dead:
+            with self._pending_lock:
+                self._sync_watchers = [h for h in self._sync_watchers
+                                       if h not in dead]
+
+    def sync_watch(self, timeout: float = 1.0) -> bool:
+        """Read-your-writes barrier: wait until the watch cursor has
+        reached the newest event seq one of OUR mutations produced
+        (X-Kai-Seq).  The fleet's cycle epilogue calls this so the next
+        snapshot's dirty marks already include the cycle's own writes —
+        incremental state exchange instead of a defensive re-list.
+        Returns False on timeout / dead watch (the caller proceeds; the
+        echo lands next cycle)."""
+        target = self._last_write_seq
+        if target <= self._watch_seq:
+            return True
+        thread = self._watch_thread
+        if thread is None or not thread.is_alive():
+            return False
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self._watch_seq >= target or self._stop.is_set():
+                return True
+            time.sleep(0.001)
+        METRICS.inc("watch_barrier_timeouts_total")
+        return False
 
     def on_resync(self, callback: Callable) -> None:
         """Register a no-arg callback fired after a watch-gap re-list
@@ -384,6 +554,7 @@ class HTTPKubeAPI:
                             self._known.pop(key, None)
                         else:
                             self._known[key] = obj
+                        self._fire_sync(etype, obj)
                         with self._pending_lock:
                             self._pending.append((etype, obj))
             except (urllib.error.URLError, OSError,
@@ -405,12 +576,18 @@ class HTTPKubeAPI:
         for obj in snap["items"]:
             current[obj_key(obj)] = obj
         vanished = [key for key in self._known if key not in current]
+        sync_events = []
         with self._pending_lock:
             for key in vanished:
-                self._pending.append(("DELETED", self._known.pop(key)))
+                obj = self._known.pop(key)
+                self._pending.append(("DELETED", obj))
+                sync_events.append(("DELETED", obj))
             for key, obj in current.items():
                 self._known[key] = obj
                 self._pending.append(("MODIFIED", obj))
+                sync_events.append(("MODIFIED", obj))
+        for etype, obj in sync_events:
+            self._fire_sync(etype, obj)
         self._watch_seq = int(snap["seq"])
         self._server_boot = snap.get("boot")
         # A callback returning False asks to be deregistered (the

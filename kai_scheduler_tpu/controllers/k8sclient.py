@@ -32,7 +32,8 @@ import urllib.request
 from collections import defaultdict
 from typing import Callable
 
-from .kubeapi import Conflict, NotFound, obj_key
+from .kubeapi import (Conflict, Fenced, NotFound, field_match, obj_key,
+                      parse_field_selector)
 
 # kind -> (api prefix, plural, namespaced)
 KIND_ROUTES = {
@@ -284,14 +285,62 @@ class KubernetesKubeAPI:
             return None
 
     def list(self, kind: str, namespace: str | None = None,
-             label_selector: dict | None = None) -> list[dict]:
+             label_selector: dict | None = None,
+             field_selector=None) -> list[dict]:
         prefix, plural, namespaced = KIND_ROUTES[kind]
         url = self._path(kind, namespace if namespaced else None)
         if label_selector:
             sel = ",".join(f"{k}={v}" for k, v in label_selector.items())
             url += "?" + urllib.parse.urlencode({"labelSelector": sel})
         items = self._json("GET", url).get("items", [])
-        return [self._normalize(o, kind) for o in items]
+        out = [self._normalize(o, kind) for o in items]
+        # Field selectors filter CLIENT-side here: a genuine apiserver
+        # supports fieldSelector only for a small built-in field set
+        # (and not at all for CRDs like BindRequest), so pushing ours
+        # down would silently change semantics per kind.  Same predicate
+        # as the embedded dialects — results stay bit-identical.
+        terms = parse_field_selector(field_selector)
+        if terms is not None:
+            out = [o for o in out if field_match(o, terms)]
+        return out
+
+    # -- bulk writes (dialect parity; a real apiserver has no bulk
+    # endpoint, so the wave degrades to per-item requests with the same
+    # per-item outcome shape the embedded dialects return) --------------
+    def create_many(self, objs: list, epoch: int | None = None,
+                    fence: str | None = None,
+                    supersede: bool = False) -> list[dict]:
+        outcomes = []
+        for obj in objs:
+            try:
+                try:
+                    outcomes.append({"ok": True,
+                                     "object": self.create(obj)})
+                except Conflict:
+                    if not supersede:
+                        raise
+                    kind, ns, name = obj_key(obj)
+                    self.delete(kind, name, ns)
+                    obj.get("metadata", {}).pop("resourceVersion", None)
+                    obj.get("metadata", {}).pop("uid", None)
+                    outcomes.append({"ok": True,
+                                     "object": self.create(obj)})
+            except (Conflict, NotFound, Fenced) as exc:
+                outcomes.append({"ok": False, "error": exc})
+        return outcomes
+
+    def patch_many(self, items: list, epoch: int | None = None,
+                   fence: str | None = None) -> list[dict]:
+        outcomes = []
+        for item in items:
+            try:
+                out = self.patch(item["kind"], item["name"],
+                                 item.get("patch") or {},
+                                 item.get("namespace", "default"))
+                outcomes.append({"ok": True, "object": out})
+            except (Conflict, NotFound, Fenced) as exc:
+                outcomes.append({"ok": False, "error": exc})
+        return outcomes
 
     def update(self, obj: dict, epoch: int | None = None,
                fence: str | None = None) -> dict:

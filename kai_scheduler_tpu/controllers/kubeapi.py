@@ -47,6 +47,75 @@ def obj_key(obj: dict) -> tuple:
     return (obj["kind"], md.get("namespace", "default"), md["name"])
 
 
+# -- field selectors ---------------------------------------------------------
+# Server-side list filtering on dotted manifest paths (the K8s
+# fieldSelector analog, generalized to any path):  ``spec.nodeName=n1``,
+# ``status.phase!=Running``, comma-joined conjunctions.  Both dialects
+# evaluate the SAME predicate (parse_field_selector + field_match), so a
+# selector pushed down over the wire is bit-identical to filtering the
+# full list client-side — the parity property tests/test_wire_protocol.py
+# asserts.
+
+def parse_field_selector(selector) -> list | None:
+    """Normalize a field selector into [(path, op, value)] terms.
+
+    Accepts a dict ({path: value}, equality only) or a selector string
+    (``a.b=x,c.d!=y``; ``==`` is accepted for ``=``).  None/empty means
+    no filtering."""
+    if not selector:
+        return None
+    if isinstance(selector, dict):
+        return [(k, "=", str(v)) for k, v in selector.items()]
+    terms = []
+    for part in str(selector).split(","):
+        if not part:
+            continue
+        if "!=" in part:
+            path, value = part.split("!=", 1)
+            terms.append((path.strip(), "!=", value))
+        elif "==" in part:
+            path, value = part.split("==", 1)
+            terms.append((path.strip(), "=", value))
+        elif "=" in part:
+            path, value = part.split("=", 1)
+            terms.append((path.strip(), "=", value))
+    return terms or None
+
+
+def field_get(obj: dict, path: str) -> str:
+    """Dotted-path lookup, coerced to str ('' for missing/None) so
+    selector values compare the way they serialize on the wire."""
+    cur = obj
+    for seg in path.split("."):
+        if not isinstance(cur, dict):
+            return ""
+        cur = cur.get(seg)
+        if cur is None:
+            return ""
+    return str(cur)
+
+
+def field_match(obj: dict, terms: list | None) -> bool:
+    if not terms:
+        return True
+    for path, op, value in terms:
+        got = field_get(obj, path)
+        if op == "=" and got != value:
+            return False
+        if op == "!=" and got == value:
+            return False
+    return True
+
+
+def encode_field_selector(selector) -> str | None:
+    """Wire form of a field selector (dict or string) for query strings."""
+    if not selector:
+        return None
+    if isinstance(selector, dict):
+        return ",".join(f"{k}={v}" for k, v in selector.items())
+    return str(selector)
+
+
 # Auto-assigned uids: one urandom read per PROCESS (the random prefix),
 # then a scrambled counter.  uuid.uuid4() pays a urandom syscall per
 # object — at fleet scale (every pod, BindRequest, and PodGroup create)
@@ -140,7 +209,9 @@ class InMemoryKubeAPI:
             return self.objects.get((kind, namespace, name))
 
     def list(self, kind: str, namespace: str | None = None,
-             label_selector: dict | None = None) -> list[dict]:
+             label_selector: dict | None = None,
+             field_selector=None) -> list[dict]:
+        terms = parse_field_selector(field_selector)
         out = []
         with self._store_lock:
             items = list(self.objects.items())
@@ -154,6 +225,8 @@ class InMemoryKubeAPI:
                 if any(labels.get(lk) != lv
                        for lk, lv in label_selector.items()):
                     continue
+            if terms is not None and not field_match(obj, terms):
+                continue
             out.append(obj)
         return sorted(out, key=lambda o: o["metadata"]["name"])
 
@@ -196,6 +269,74 @@ class InMemoryKubeAPI:
             obj = self.objects.pop(key, None)
             if obj is not None:
                 self._emit("DELETED", obj)
+
+    # -- bulk writes ---------------------------------------------------------
+    # One call, many mutations, per-item outcomes: the bind-wave/status
+    # batch contract both dialects share (the HTTP dialect ships these as
+    # single POST /bulk/* round trips).  Each item is fence-checked
+    # INDIVIDUALLY — one fenced or conflicting item fails that item's
+    # outcome only, the rest of the wave lands.  Outcome shape:
+    # ``{"ok": True, "object": obj}`` or ``{"ok": False, "error": exc}``.
+
+    @staticmethod
+    def _unwrap_bulk_item(item: dict, epoch, fence):
+        """Items may be raw manifests/patch docs or ``{"object": ...,
+        "epoch": ..., "fence": ...}`` wrappers carrying per-item fencing
+        (a wave is normally uniformly fenced; tests exercise the
+        per-item contract)."""
+        if "object" in item and "kind" not in item:
+            return (item["object"], item.get("epoch", epoch),
+                    item.get("fence", fence))
+        return item, epoch, fence
+
+    def create_many(self, objs: list, epoch: int | None = None,
+                    fence: str | None = None,
+                    supersede: bool = False) -> list[dict]:
+        """Batched create (the bind-wave write).  ``supersede=True``
+        replaces an existing object on Conflict (delete + recreate, the
+        scheduler's fresh-decision-resets-the-request semantics) instead
+        of failing the item."""
+        outcomes = []
+        for item in objs:
+            obj, e, f = self._unwrap_bulk_item(item, epoch, fence)
+            try:
+                try:
+                    outcomes.append(
+                        {"ok": True,
+                         "object": self.create(obj, epoch=e, fence=f)})
+                except Conflict:
+                    if not supersede:
+                        raise
+                    kind, ns, name = obj_key(obj)
+                    self.delete(kind, name, ns, epoch=e, fence=f)
+                    obj.get("metadata", {}).pop("resourceVersion", None)
+                    obj.get("metadata", {}).pop("uid", None)
+                    outcomes.append(
+                        {"ok": True,
+                         "object": self.create(obj, epoch=e, fence=f)})
+            except (Conflict, NotFound, Fenced) as exc:
+                outcomes.append({"ok": False, "error": exc})
+        return outcomes
+
+    def patch_many(self, items: list, epoch: int | None = None,
+                   fence: str | None = None) -> list[dict]:
+        """Batched strategic-merge patch: items are
+        ``{"kind", "name", "namespace", "patch"}`` documents (optionally
+        wrapped with per-item ``epoch``/``fence``).  Per-item outcomes —
+        a vanished or fenced target fails that item only."""
+        outcomes = []
+        for item in items:
+            e = item.get("epoch", epoch)
+            f = item.get("fence", fence)
+            try:
+                out = self.patch(item["kind"], item["name"],
+                                 item.get("patch") or {},
+                                 item.get("namespace", "default"),
+                                 epoch=e, fence=f)
+                outcomes.append({"ok": True, "object": out})
+            except (Conflict, NotFound, Fenced) as exc:
+                outcomes.append({"ok": False, "error": exc})
+        return outcomes
 
     # -- watch -------------------------------------------------------------
     # Registration is locked against _emit's concurrent dead-handler

@@ -130,6 +130,28 @@ class System:
         if self.config.pipelined_cycles:
             self.enable_pipeline()
         self.schedulers = []
+        # SchedulingShard reconcile is event-driven: the EMIT-TIME hook
+        # below arms the latch the instant a shard object mutates (any
+        # thread — watch_sync contract), reconcile_shards lists only
+        # when it is set — a steady-state cycle ships zero
+        # SchedulingShard lists over the wire, and a direct
+        # reconcile_shards() call after a store write still observes it
+        # without an intervening drain.  GIL-atomic bool latch (consumer
+        # clears before listing, a concurrent re-arm re-reconciles next
+        # cycle).
+        self._shards_dirty = True
+
+        def _mark_shards_dirty(_et, obj):
+            if obj.get("kind") == "SchedulingShard":
+                # kairace: disable=KRC001
+                self._shards_dirty = True
+
+        watch_sync = getattr(self.api, "watch_sync", None)
+        if watch_sync is not None:
+            watch_sync(_mark_shards_dirty)
+        else:
+            self.api.watch("SchedulingShard",
+                           lambda et, obj: _mark_shards_dirty(et, obj))
         self._config_rv = None     # last reconciled Config resourceVersion
         self._global_sched_args = {}  # Config CRD spec.scheduler.args
         self._global_gates = {}       # Config CRD featureGates
@@ -288,6 +310,9 @@ class System:
         Returns True when the fleet changed."""
         if not self.config.scheduling_enabled:
             return False
+        if not self._shards_dirty:
+            return False
+        self._shards_dirty = False
         shard_objs = self.api.list("SchedulingShard")
         if not shard_objs:
             return False
@@ -447,6 +472,15 @@ class System:
             self.api.drain()
             self.binder.tick()
         self.status_updater.flush()
+        # Read-your-writes barrier (wire dialect only): wait for the
+        # watch cursor to reach the seq of this epilogue's own writes
+        # (X-Kai-Seq) so the NEXT snapshot's dirty marks already carry
+        # the binder's bind echoes — incremental state exchange instead
+        # of a defensive re-list.  Bounded wait; on timeout the echo
+        # simply lands next cycle.
+        sync = getattr(self.api, "sync_watch", None)
+        if sync is not None:
+            sync(timeout=1.0)
         with self._control_lock:
             self.queue_controller.reconcile_if_dirty()
             try:

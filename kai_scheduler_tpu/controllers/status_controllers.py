@@ -113,20 +113,47 @@ class PodGroupController:
 
 
 class QueueController:
+    """Queue status aggregation over an EVENT-SOURCED mirror: the
+    controller maintains its own Queue/PodGroup view from watch events
+    (primed by one list on first reconcile) instead of re-listing both
+    kinds per sweep — over the wire a steady-state reconcile ships zero
+    whole-kind lists (the informer-store pattern; DESIGN §12)."""
+
     def __init__(self, api: InMemoryKubeAPI):
         self.api = api
         self._dirty = False
+        self._primed = False
+        # name -> manifest mirrors, maintained from the same watch
+        # events that set the dirty latch.  Single-writer: events
+        # deliver on the control thread (drain), which also reconciles.
+        # kairace: single-writer=main
+        self._queues: dict = {}
+        # kairace: single-writer=main
+        self._podgroups: dict = {}
         api.watch("PodGroup", self._on_change)
         api.watch("Queue", self._on_change)
 
     def _on_change(self, event_type: str, obj: dict) -> None:
+        md = obj.get("metadata", {})
+        if obj.get("kind") == "Queue":
+            mirror, key = self._queues, md.get("name")
+        else:
+            # PodGroups are namespaced: same-named groups in two
+            # namespaces are distinct objects and BOTH count into their
+            # queue's aggregation.
+            mirror = self._podgroups
+            key = (md.get("namespace", "default"), md.get("name"))
+        if event_type == "DELETED":
+            mirror.pop(key, None)
+        else:
+            mirror[key] = obj
         # Debounced: queue aggregation scans every PodGroup, so running it
         # per event is quadratic during drains — mark dirty and let
         # reconcile_if_dirty() (called once per cycle) do the sweep.
         # GIL-atomic bool latch: the consumer clears BEFORE sweeping, so
         # an event landing mid-sweep re-arms the flag and the next cycle
-        # re-reconciles; an event landing before the sweep's list() is
-        # already included.  No ordering loses a reconcile.
+        # re-reconciles; an event landing before the sweep's mirror read
+        # is already included.  No ordering loses a reconcile.
         # kairace: disable=KRC001
         self._dirty = True
 
@@ -135,8 +162,23 @@ class QueueController:
             self._dirty = False
             self.reconcile_all()
 
+    def _prime(self) -> None:
+        """One-time mirror fill for objects that predate this
+        controller's watch registration (tests constructing it over a
+        populated store; a daemon joining a running cluster)."""
+        if self._primed:
+            return
+        self._primed = True
+        for q in self.api.list("Queue"):
+            self._queues.setdefault(q["metadata"]["name"], q)
+        for pg in self.api.list("PodGroup"):
+            md = pg["metadata"]
+            self._podgroups.setdefault(
+                (md.get("namespace", "default"), md["name"]), pg)
+
     def reconcile_all(self) -> None:
-        queues = {q["metadata"]["name"]: q for q in self.api.list("Queue")}
+        self._prime()
+        queues = dict(self._queues)
         # childQueues back-references (childqueues_updater/).
         children = defaultdict(list)
         for name, q in queues.items():
@@ -146,7 +188,7 @@ class QueueController:
         # Aggregated allocation from PodGroups (resource_updater/).
         allocated = defaultdict(lambda: defaultdict(float))
         requested = defaultdict(lambda: defaultdict(float))
-        for pg in self.api.list("PodGroup"):
+        for pg in self._podgroups.values():
             queue = pg.get("spec", {}).get("queue")
             if queue not in queues:
                 continue

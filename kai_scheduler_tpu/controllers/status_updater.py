@@ -159,72 +159,149 @@ class AsyncStatusUpdater:
         self._shard(key).put(key)
 
     # -- workers -----------------------------------------------------------
+    # Max keys drained per wake-up into one bulk wave: bounds the batch
+    # round trip (and one key's latency behind a long wave).
+    BULK_DRAIN = 32
+
     def _worker(self, idx: int) -> None:
+        """Worker loop: drain a BATCH of queued keys per wake-up and
+        land every resolved patch in ONE ``patch_many`` round trip
+        (``POST /bulk/patch`` on the wire) with per-item outcomes —
+        batched status PATCH.  Event creates and substrates without
+        ``patch_many`` apply per item, as before.  Failure semantics
+        are per item either way: ``status_update_errors`` + on_error
+        callback, never a dead worker."""
         my_queue = self._queues[idx]
+        patch_many = getattr(self.api, "patch_many", None)
         while not self._stop.is_set():
             try:
-                key = my_queue.get(timeout=0.1)
+                keys = [my_queue.get(timeout=0.1)]
             except queue.Empty:
                 continue
+            while len(keys) < self.BULK_DRAIN:
+                try:
+                    keys.append(my_queue.get_nowait())
+                except queue.Empty:
+                    break
+            batch: list = []   # (key, payload, patch_item) bulk-able
             try:
-                with self._lock:
-                    payload = self._inflight.pop(key, None)
-                    gone = key in self._gone
-                if payload is None:
-                    continue
-                if gone:
-                    # The object vanished while this patch was queued:
-                    # the write is doomed — drop it, loudly counted.
-                    METRICS.inc("stale_write_skipped_total")
-                    continue
-                if key[0] == "Event":
-                    self.api.create({
-                        "kind": "Event",
-                        "metadata": {"name": f"evt-{id(payload):x}-"
-                                             f"{abs(hash(key)) % 10**8}"},
-                        "spec": {"reason": payload["reason"],
-                                 "message": payload["message"],
-                                 "traceId": payload.get("trace_id")},
-                    })
-                elif key[0] == "ObjPatch":
-                    # Generalized fenced object patch (submit_patch):
-                    # the eviction batch path.  The fence kwargs were
-                    # captured at enqueue — a deposed leader's write is
-                    # rejected here by the store, exactly like the
-                    # synchronous path.
-                    patch = payload["patch"]
-                    if payload.get("build") is not None:
-                        patch = payload["build"]()
-                    if patch is not None:
-                        self.api.patch(payload["kind"], payload["name"],
-                                       patch, payload["namespace"],
-                                       **payload["fence"])
-                else:
-                    kind, namespace, name = key
-                    self.api.patch(kind, name, {"status": payload},
-                                   namespace)
-            except Exception as exc:
-                # Usually the object vanished mid-flight (the next cycle
-                # re-derives status), but a store that rejects EVERY
-                # write must be visible, not silent (KAI007).
-                METRICS.inc("status_update_errors")
-                log.v(2).info("status write for %s dropped (%s: %s)",
-                              key, type(exc).__name__, exc)
-                on_error = (payload.get("on_error")
-                            if isinstance(payload, dict) else None)
-                if on_error is not None:
+                for key in keys:
+                    with self._lock:
+                        payload = self._inflight.pop(key, None)
+                        gone = key in self._gone
+                    if payload is None:
+                        continue
+                    if gone:
+                        # The object vanished while this patch was
+                        # queued: the write is doomed — drop it, loudly
+                        # counted.
+                        METRICS.inc("stale_write_skipped_total")
+                        continue
                     try:
-                        on_error(exc)
-                    except Exception as cb_exc:
-                        # The error channel must never kill a worker, but
-                        # a broken callback must be visible (KAI007).
-                        METRICS.inc("status_update_errors")
-                        log.v(1).info(
-                            "status on_error callback for %s failed "
-                            "(%s: %s)", key, type(cb_exc).__name__,
-                            cb_exc)
+                        item = self._resolve_item(key, payload)
+                    except Exception as exc:
+                        self._note_failure(key, payload, exc)
+                        continue
+                    if item is None:
+                        continue  # applied inline (Event) or skipped
+                    if patch_many is None:
+                        try:
+                            self.api.patch(item["kind"], item["name"],
+                                           item["patch"],
+                                           item["namespace"],
+                                           **item.get("fence", {}))
+                        except Exception as exc:
+                            self._note_failure(key, payload, exc)
+                        continue
+                    batch.append((key, payload, item))
+                if batch:
+                    METRICS.inc("bulk_write_batches_total", path="status")
+                    METRICS.inc("bulk_write_items_total", len(batch),
+                                path="status")
+                    try:
+                        outcomes = patch_many(
+                            [self._wire_item(item)
+                             for _k, _p, item in batch])
+                    except Exception as exc:
+                        # Whole-batch transport failure: every item
+                        # failed.
+                        for key, payload, _item in batch:
+                            self._note_failure(key, payload, exc)
+                    else:
+                        for (key, payload, _item), out in zip(batch,
+                                                              outcomes):
+                            if not out.get("ok"):
+                                METRICS.inc("bulk_write_errors_total",
+                                            path="status")
+                                self._note_failure(key, payload,
+                                                   out.get("error"))
             finally:
-                my_queue.task_done()
+                for _ in keys:
+                    my_queue.task_done()
+
+    @staticmethod
+    def _wire_item(item: dict) -> dict:
+        """Bulk patch document for one resolved item; per-item fence
+        kwargs ride inline (``epoch``/``fence`` keys — the bulk
+        endpoints fence-check each item individually)."""
+        out = {"kind": item["kind"], "name": item["name"],
+               "namespace": item["namespace"], "patch": item["patch"]}
+        fk = item.get("fence") or {}
+        if fk.get("fence") is not None and fk.get("epoch") is not None:
+            out["fence"] = fk["fence"]
+            out["epoch"] = fk["epoch"]
+        return out
+
+    def _resolve_item(self, key, payload) -> dict | None:
+        """Turn one queued key into its bulk patch item — or apply it
+        inline (Event creates) and return None."""
+        if key[0] == "Event":
+            self.api.create({
+                "kind": "Event",
+                "metadata": {"name": f"evt-{id(payload):x}-"
+                                     f"{abs(hash(key)) % 10**8}"},
+                "spec": {"reason": payload["reason"],
+                         "message": payload["message"],
+                         "traceId": payload.get("trace_id")},
+            })
+            return None
+        if key[0] == "ObjPatch":
+            # Generalized fenced object patch (submit_patch): the
+            # eviction batch path.  The fence kwargs were captured at
+            # enqueue — a deposed leader's write is rejected at apply
+            # time by the store, exactly like the synchronous path.
+            patch = payload["patch"]
+            if payload.get("build") is not None:
+                patch = payload["build"]()
+            if patch is None:
+                return None
+            return {"kind": payload["kind"], "name": payload["name"],
+                    "namespace": payload["namespace"], "patch": patch,
+                    "fence": dict(payload.get("fence") or {})}
+        kind, namespace, name = key
+        return {"kind": kind, "name": name, "namespace": namespace,
+                "patch": {"status": payload}, "fence": {}}
+
+    def _note_failure(self, key, payload, exc) -> None:
+        """Per-item failure bookkeeping shared by the bulk and per-item
+        apply paths: usually the object vanished mid-flight (the next
+        cycle re-derives status), but a store that rejects EVERY write
+        must be visible, not silent (KAI007)."""
+        METRICS.inc("status_update_errors")
+        log.v(2).info("status write for %s dropped (%s: %s)",
+                      key, type(exc).__name__, exc)
+        on_error = (payload.get("on_error")
+                    if isinstance(payload, dict) else None)
+        if on_error is not None:
+            try:
+                on_error(exc)
+            except Exception as cb_exc:
+                # The error channel must never kill a worker, but a
+                # broken callback must be visible (KAI007).
+                METRICS.inc("status_update_errors")
+                log.v(1).info(
+                    "status on_error callback for %s failed "
+                    "(%s: %s)", key, type(cb_exc).__name__, cb_exc)
 
     def flush(self, timeout: float = 5.0) -> None:
         """Wait for queued work to drain (tests / shutdown)."""

@@ -420,15 +420,24 @@ class Statement:
                       landed=None) -> None:
         """The ONE durable-write loop both commit paths share: apply
         every side effect in op order — evictions batch through
-        ``cache.evict_many`` (one flush per gang batch) when the cache
-        supports it; a bind flushes the pending evict batch first, so
-        writes land in op order ACROSS kinds (a crash between them must
-        never leave a bind durable against capacity whose victim was
-        not evicted).  ``landed`` (overlapped mode) collects the uid of
-        every write that reached the store — the fenced-rollback path
-        rolls back exactly the rest."""
+        ``cache.evict_many`` and binds through ``cache.bind_many`` (one
+        flush per gang batch each) when the cache supports them; a bind
+        wave flushes the pending evict batch first and an evict flushes
+        the pending bind wave, so writes land in op order ACROSS kinds
+        (a crash between them must never leave a bind durable against
+        capacity whose victim was not evicted).  ``landed`` (overlapped
+        mode) collects the uid of every write that reached the store —
+        the fenced-rollback path rolls back exactly the rest.  Per-item
+        bulk outcomes: a failed item fails that item only — the rest of
+        the wave lands, its journal entries mark done — and the first
+        failure (Fenced first) re-raises after the wave settles, exactly
+        like ``evict_many``."""
+        from ..controllers.kubeapi import Fenced
+
         evict_batch: list[tuple[int, object]] = []
+        bind_batch: list[tuple[int, object]] = []
         evict_many = getattr(cache, "evict_many", None)
+        bind_many = getattr(cache, "bind_many", None)
 
         def note_landed(uid) -> None:
             if landed is not None:
@@ -444,14 +453,38 @@ class Statement:
                     log.mark_done(txid_of[i])
             evict_batch.clear()
 
+        def flush_binds() -> None:
+            if not bind_batch:
+                return
+            outcomes = bind_many([(op.task, op.node_name, by_op[i])
+                                  for i, op in bind_batch])
+            failures: list = []
+            for (i, op), out in zip(bind_batch, outcomes):
+                if out.get("ok"):
+                    note_landed(op.task.uid)
+                    if i in txid_of:
+                        log.mark_done(txid_of[i])
+                else:
+                    failures.append(out.get("error"))
+            bind_batch.clear()
+            for exc in failures:
+                if isinstance(exc, Fenced):
+                    raise exc
+            if failures:
+                raise failures[0]
+
         for i, op in enumerate(ops):
             if op.kind == "allocate":
                 flush_evicts()
-                cache.bind(op.task, op.node_name, by_op[i])
-                note_landed(op.task.uid)
-                if i in txid_of:
-                    log.mark_done(txid_of[i])
+                if bind_many is not None:
+                    bind_batch.append((i, op))
+                else:
+                    cache.bind(op.task, op.node_name, by_op[i])
+                    note_landed(op.task.uid)
+                    if i in txid_of:
+                        log.mark_done(txid_of[i])
             elif op.kind == "evict":
+                flush_binds()
                 if evict_many is not None:
                     evict_batch.append((i, op.task))
                 else:
@@ -459,6 +492,7 @@ class Statement:
                     note_landed(op.task.uid)
                     if i in txid_of:
                         log.mark_done(txid_of[i])
+        flush_binds()
         flush_evicts()
         if log is not None and intents:
             log.flush_buffered()

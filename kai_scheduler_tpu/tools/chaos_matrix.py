@@ -69,6 +69,13 @@ PIPELINE_TESTS = ["tests/test_pipeline_cycle.py"]
 # ClusterInfo equivalence, pack bit-identity, and identical allocate
 # placements are asserted at every step.
 COLUMNAR_TESTS = ["tests/test_columnar_store.py"]
+# --wire: the daemon-scale apiserver transport ring — pagination
+# cursors under concurrent mutation, 410-GONE continue recovery,
+# field-selector parity across dialects, per-item bulk outcomes (fenced
+# items, torn batch items, crash-after-journal replay through the batch
+# path), pool-saturation backpressure, and the watch-mode cache's
+# zero-whole-kind-list steady state over a real loopback wire.
+WIRE_TESTS = ["tests/test_wire_protocol.py"]
 
 
 def run_iteration(seed: int, tests: list[str], marker: str,
@@ -167,6 +174,13 @@ def main(argv=None) -> int:
                          "columnar-vs-object equivalence, pack "
                          "bit-identity, and identical allocate "
                          "placements are asserted")
+    ap.add_argument("--wire", action="store_true",
+                    help="wire mode: sweep the apiserver transport ring "
+                         f"({WIRE_TESTS}) — pagination under mutation, "
+                         "GONE-continue recovery, field-selector "
+                         "dialect parity, per-item bulk outcomes, pool "
+                         "backpressure, and the zero-whole-kind-list "
+                         "steady state over a real loopback wire")
     ap.add_argument("--races", action="store_true",
                     help="runtime lock-order validation: every iteration "
                          "runs with KAI_LOCKTRACE=1 (threading factories "
@@ -200,15 +214,16 @@ def main(argv=None) -> int:
         tests = args.tests
     else:
         # Modes compose: --arena --latency --incremental --fused
-        # --shards --pipeline --columnar sweeps every selected suite
-        # per seed.
+        # --shards --pipeline --columnar --wire sweeps every selected
+        # suite per seed.
         tests = (ARENA_TESTS if args.arena else []) + \
             (LATENCY_TESTS if args.latency else []) + \
             (INCREMENTAL_TESTS if args.incremental else []) + \
             (FUSED_TESTS if args.fused else []) + \
             (SHARDS_TESTS if args.shards else []) + \
             (PIPELINE_TESTS if args.pipeline else []) + \
-            (COLUMNAR_TESTS if args.columnar else [])
+            (COLUMNAR_TESTS if args.columnar else []) + \
+            (WIRE_TESTS if args.wire else [])
         if not tests:
             tests = DEFAULT_TESTS
     repo_root = os.path.dirname(os.path.dirname(os.path.dirname(

@@ -27,9 +27,12 @@
 #                 kernel ceiling, the 10k-queue fair-share step
 #                 ceiling + single-dispatch/prep-reuse structural gates,
 #                 the overlapped-pipeline re-run (identical bound
-#                 pods, overlap-ratio floor), and the columnar
+#                 pods, overlap-ratio floor), the columnar
 #                 host-state gates (zero fallbacks warm, columnar rows
-#                 served, snapshot-build ceiling) must stay in budget
+#                 served, snapshot-build ceiling), and the http
+#                 daemon-regime gates (zero steady-state whole-kind
+#                 lists, bulk-endpoint hit floors, preserialized
+#                 frame-cache hit ratio) must stay in budget
 #   tier-1 tests  pytest -m 'not slow' on CPU
 #
 # Usage: kai_scheduler_tpu/tools/ci_check.sh [--no-tests]
@@ -60,6 +63,8 @@ python -m kai_scheduler_tpu.tools.chaos_matrix --dry-run || fail=1
 python -m kai_scheduler_tpu.tools.chaos_matrix --pipeline --dry-run \
     || fail=1
 python -m kai_scheduler_tpu.tools.chaos_matrix --columnar --dry-run \
+    || fail=1
+python -m kai_scheduler_tpu.tools.chaos_matrix --wire --dry-run \
     || fail=1
 python -m kai_scheduler_tpu.tools.chaos_matrix --races --dry-run \
     || fail=1

@@ -121,6 +121,45 @@ def main(argv=None) -> int:
     p_bound = pres.get("pod_latency", {}).get("bound_pods", 0)
     p_overlap = pres.get("pipeline", {}).get("overlap_ratio_mean")
 
+    # HTTP daemon-regime smoke (DESIGN §12): the SAME fleet over a real
+    # loopback apiserver + HTTPKubeAPI, pipelined.  The structural gates
+    # are the transport-rot detectors: hot-kind list requests bounded to
+    # the priming pass (steady-state cycles ship zero whole-kind lists),
+    # the watch-mode cache never falls back to re-lists, bind waves land
+    # through the bulk endpoints, and the preserialized frame cache
+    # actually reuses its encodes.
+    from kai_scheduler_tpu.utils.metrics import _key as _metric_key
+
+    def _labeled(name, **labels):
+        return METRICS.counters.get(_metric_key(name, labels), 0)
+
+    hshape = budget.get("http_shape", {"nodes": 200, "jobs": 2,
+                                       "gang": 50})
+    hot_kinds = ("Pod", "Node", "Queue", "PodGroup")
+
+    def hot_lists():
+        return sum(_labeled("apiserver_list_requests_total", kind=k)
+                   for k in hot_kinds)
+
+    h_lists0 = hot_lists()
+    h_refresh0 = METRICS.counters.get("cluster_cache_full_refresh_total",
+                                      0)
+    h_waves0 = _labeled("bulk_write_batches_total", path="bind_wave")
+    h_bulk0 = (_labeled("apiserver_bulk_requests_total", op="create")
+               + _labeled("apiserver_bulk_requests_total", op="patch"))
+    h_hits0 = METRICS.counters.get("watch_frame_cache_hits_total", 0)
+    h_miss0 = METRICS.counters.get("watch_frame_cache_misses_total", 0)
+    hres = bench.fleet_phase(hshape["nodes"], hshape["jobs"],
+                             hshape["gang"], pipelined=True,
+                             substrate="http")
+    h_bound = hres.get("pod_latency", {}).get("bound_pods", 0)
+    h_expect = hshape["jobs"] * hshape["gang"]
+    h_hits = METRICS.counters.get("watch_frame_cache_hits_total",
+                                  0) - h_hits0
+    h_miss = METRICS.counters.get("watch_frame_cache_misses_total",
+                                  0) - h_miss0
+    h_ratio = round(h_hits / max(h_hits + h_miss, 1), 3)
+
     # Columnar host-state gates (DESIGN §11): the warm fleet shape must
     # stay on the array-native snapshot path end to end — a single
     # fallback (resync aside, none should fire here) or a zero
@@ -175,6 +214,26 @@ def main(argv=None) -> int:
                           budget["max_warm_cycle_s"])),
         ("pipeline_overlap_ratio", p_overlap,
          ">=", budget.get("min_overlap_ratio", 0.08)),
+        ("http_bound_pods", h_bound, ">=", h_expect),
+        ("http_warm_cycle_s", hres.get("warm_cycle_s"),
+         "<=", budget.get("max_http_warm_cycle_s", 3.0)),
+        ("http_hot_kind_lists", hot_lists() - h_lists0,
+         "<=", budget.get("max_http_hot_kind_lists", 10)),
+        ("http_full_refreshes",
+         METRICS.counters.get("cluster_cache_full_refresh_total", 0)
+         - h_refresh0,
+         "<=", budget.get("max_http_full_refreshes", 1)),
+        ("http_bulk_bind_waves",
+         _labeled("bulk_write_batches_total", path="bind_wave")
+         - h_waves0,
+         ">=", budget.get("min_http_bulk_bind_waves", 1)),
+        ("http_bulk_requests",
+         _labeled("apiserver_bulk_requests_total", op="create")
+         + _labeled("apiserver_bulk_requests_total", op="patch")
+         - h_bulk0,
+         ">=", budget.get("min_http_bulk_requests", 2)),
+        ("frame_cache_hit_ratio", h_ratio,
+         ">=", budget.get("min_frame_cache_hit_ratio", 0.3)),
     ]
 
     failed = []

@@ -687,6 +687,53 @@ class TestKAI008MetricsHygiene:
                    and "snapshot_columnar_rows" in f.message
                    for f in findings)
 
+    def test_wire_families_consistent_usage_is_clean(self):
+        # PR 13's daemon-scale apiserver families (apiserver /
+        # httpclient / binder / status_updater / cache_builder): the
+        # labeled counters keep ONE label-key set per family.
+        src = ("from ..utils.metrics import METRICS\n"
+               "def f(v):\n"
+               "    METRICS.inc('watch_frame_cache_hits_total')\n"
+               "    METRICS.inc('watch_frame_cache_misses_total')\n"
+               "    METRICS.inc('apiserver_pool_saturated_total')\n"
+               "    METRICS.inc('apiserver_pool_dispatch_total')\n"
+               "    METRICS.inc('apiserver_list_requests_total',"
+               " kind='Pod')\n"
+               "    METRICS.inc('apiserver_whole_kind_lists_total',"
+               " kind='Pod')\n"
+               "    METRICS.inc('apiserver_bulk_requests_total',"
+               " op='create')\n"
+               "    METRICS.inc('apiserver_bulk_items_total', v,"
+               " op='create')\n"
+               "    METRICS.inc('bulk_write_batches_total',"
+               " path='bind_wave')\n"
+               "    METRICS.inc('bulk_write_items_total', v,"
+               " path='status')\n"
+               "    METRICS.inc('bulk_write_errors_total',"
+               " path='binder')\n"
+               "    METRICS.inc('http_list_pages_total')\n"
+               "    METRICS.inc('http_list_continue_gone_total')\n"
+               "    METRICS.inc('http_throttled_retries_total')\n"
+               "    METRICS.inc('watch_barrier_timeouts_total')\n")
+        findings = lint(("kai_scheduler_tpu/controllers/fix.py", src))
+        assert [f for f in findings if f.rule == "KAI008"] == []
+
+    def test_wire_family_label_drift_fires(self):
+        # A bulk_write_* call dropping its `path` label would fork the
+        # family's label-key set across the tree.
+        a = ("from ..utils.metrics import METRICS\n"
+             "def f(v):\n"
+             "    METRICS.inc('bulk_write_batches_total',"
+             " path='status')\n")
+        b = ("from ..utils.metrics import METRICS\n"
+             "def g():\n"
+             "    METRICS.inc('bulk_write_batches_total')\n")
+        findings = lint(("kai_scheduler_tpu/controllers/a.py", a),
+                        ("kai_scheduler_tpu/controllers/b.py", b))
+        assert any(f.rule == "KAI008" and "label keys" in f.message
+                   and "bulk_write_batches_total" in f.message
+                   for f in findings)
+
     def test_cycle_span_cross_instrument_collision_fires(self):
         # A counter reusing a cycle_span_* histogram name would double-
         # register the family in the exposition: the whole-tree pass

@@ -79,6 +79,11 @@ class TestOperands:
         assert der
 
     def test_cert_inprocess_matches_service_dns(self):
+        pytest.importorskip(
+            "cryptography",
+            reason="in-process cert minting needs the 'cryptography' "
+                   "package; generate_webhook_cert's openssl fallback "
+                   "is covered by test_cert_generation_standalone")
         from kai_scheduler_tpu.controllers.operands import (
             _mint_cert_inprocess)
         crt, key = _mint_cert_inprocess("kai-admission.kai-scheduler.svc")

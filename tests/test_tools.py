@@ -346,6 +346,29 @@ class TestChaosMatrixDryRun:
         assert "tests/test_incremental_cache.py" in out
         assert "tests/test_pipeline_cycle.py" in out
 
+    def test_dry_run_wire_mode_selects_transport_ring(self, capsys,
+                                                      monkeypatch):
+        """--wire sweeps the apiserver transport ring (pagination,
+        bulk-outcome, backpressure, watch-mode cache tests); composes
+        with --pipeline/--columnar."""
+        from kai_scheduler_tpu.tools import chaos_matrix
+        monkeypatch.setattr(
+            chaos_matrix.subprocess, "run",
+            lambda *a, **kw: (_ for _ in ()).throw(AssertionError(
+                "dry run must not execute iterations")))
+        rc = chaos_matrix.main(["--dry-run", "--wire", "--seeds", "3"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "tests/test_wire_protocol.py" in out
+        assert "tests/test_reconciler.py" not in out
+        rc = chaos_matrix.main(["--dry-run", "--wire", "--pipeline",
+                                "--columnar", "--seeds", "3"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "tests/test_wire_protocol.py" in out
+        assert "tests/test_pipeline_cycle.py" in out
+        assert "tests/test_columnar_store.py" in out
+
     def test_dry_run_races_mode_arms_locktrace(self, capsys, monkeypatch):
         """--races: the grid shows races=on per seed plus the
         KAI_LOCKTRACE banner, without building the static lock graph or

@@ -330,7 +330,8 @@ class TestFleetCorrelation:
         # kubeapi spans recorded the fenced write path (epoch None when
         # un-fenced, but the span itself must exist).
         assert any(sp.kind == "kubeapi"
-                   and sp.attrs.get("op") == "bindrequest_create"
+                   and sp.attrs.get("op") in ("bindrequest_create",
+                                              "bindrequest_create_bulk")
                    for sp in trace.spans)
         # The unschedulable gang's event correlates to a cycle trace.
         events = [e for e in system.api.list("Event")

@@ -1,0 +1,463 @@
+"""Wire-protocol ring: pagination, field selectors, bulk endpoints, and
+pooled dispatch on the daemon-scale apiserver (DESIGN §12).
+
+Covers the transport contracts the http fleet depends on:
+
+- continue-token pagination stays stable under concurrent mutation (no
+  duplicates; everything that existed throughout the listing appears
+  exactly once), and a token compacted past the event ring answers
+  410 Gone which the client resolves by transparently re-listing;
+- field-selector pushdown is BIT-IDENTICAL to client-side filtering on
+  both dialects (the predicate is shared — parse_field_selector +
+  field_match);
+- bulk endpoints apply per item: one fenced or vanished item fails that
+  item only, crash-after-journal replay produces no duplicate binds
+  through the batch path, and partial-batch failures surface loudly
+  (``bulk_write_errors_total`` + the binder's event/error counters);
+- the pooled dispatcher answers 429 at saturation (bounded threads,
+  never a herd) and the client retries through it.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from kai_scheduler_tpu.controllers import (HTTPKubeAPI, KubeAPIServer,
+                                           System, SystemConfig, make_pod)
+from kai_scheduler_tpu.controllers.kubeapi import (Fenced, InMemoryKubeAPI,
+                                                   field_match,
+                                                   parse_field_selector)
+from kai_scheduler_tpu.utils.commitlog import CommitLog, SimulatedCrash
+from kai_scheduler_tpu.utils.metrics import METRICS
+
+pytestmark = pytest.mark.chaos
+
+
+def make_node(api, name, gpu=8):
+    api.create({"kind": "Node", "metadata": {"name": name}, "spec": {},
+                "status": {"allocatable": {"cpu": "32", "memory": "256Gi",
+                                           "nvidia.com/gpu": gpu,
+                                           "pods": 110}}})
+
+
+def make_queue(api, name="q"):
+    api.create({"kind": "Queue", "metadata": {"name": name}, "spec": {}})
+
+
+@pytest.fixture()
+def server():
+    srv = KubeAPIServer().start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture()
+def client(server):
+    c = HTTPKubeAPI(server.url)
+    yield c
+    c.close()
+
+
+def _counter(name, **labels):
+    if labels:
+        inner = ",".join(f'{k}="{v}"'
+                         for k, v in sorted(labels.items()))
+        return METRICS.counters.get(f"{name}{{{inner}}}", 0)
+    return METRICS.counters.get(name, 0)
+
+
+class TestPaginationSemantics:
+    def test_continue_token_walk_is_duplicate_free_under_mutation(
+            self, server, client):
+        """Objects present for the WHOLE listing appear exactly once even
+        when churn lands between pages (the name-ordered cursor never
+        revisits)."""
+        stable = {f"s{i:03d}" for i in range(40)}
+        for name in sorted(stable):
+            client.create(make_pod(name))
+        seen = []
+        token = None
+        page_no = 0
+        while True:
+            qs = "limit=7" + (f"&continue={token}" if token else "")
+            out = client._request("GET", f"/apis/Pod?{qs}")
+            seen.extend(o["metadata"]["name"] for o in out["items"])
+            token = out.get("continue")
+            page_no += 1
+            # Concurrent mutation between pages: deletes behind the
+            # cursor, creates ahead of and behind it.
+            if page_no == 2:
+                server.api.delete("Pod", "s000")   # already emitted
+                stable.discard("s000")
+                server.api.create(make_pod("zz-late"))   # after cursor
+                server.api.create(make_pod("aa-early"))  # before cursor
+            if not token:
+                break
+        assert len(seen) == len(set(seen)), "cursor revisited an object"
+        assert stable <= set(seen), "a stable object vanished mid-walk"
+        assert "zz-late" in seen  # created ahead of the cursor: visible
+
+    def test_gone_on_compacted_token_client_transparently_relists(self):
+        """A continue token older than the event ring's horizon answers
+        410; ``HTTPKubeAPI.list`` restarts the listing transparently and
+        still returns the complete result."""
+        srv = KubeAPIServer(event_log_capacity=16).start()
+        try:
+            churn_api = srv.api
+
+            class ChurnyClient(HTTPKubeAPI):
+                churn_once = True
+
+                def _request(self, method, path, *a, **kw):
+                    out = super()._request(method, path, *a, **kw)
+                    if ("continue=" in path and self.churn_once):
+                        # Between two pages: push the event ring past
+                        # the token's seq horizon.
+                        ChurnyClient.churn_once = False
+                        for i in range(40):
+                            churn_api.create(make_pod(f"churn{i:03d}"))
+                            churn_api.delete("Pod", f"churn{i:03d}")
+                        churn_api.drain()
+                    return out
+
+            c = ChurnyClient(srv.url)
+            for i in range(30):
+                c.create(make_pod(f"p{i:03d}"))
+            gone0 = METRICS.counters.get("http_list_continue_gone_total",
+                                         0)
+            names = {o["metadata"]["name"]
+                     for o in c.list("Pod", limit=10)}
+            assert {f"p{i:03d}" for i in range(30)} <= names
+            assert METRICS.counters.get(
+                "http_list_continue_gone_total", 0) > gone0, \
+                "the compacted token never triggered the re-list path"
+            c.close()
+        finally:
+            srv.stop()
+
+    def test_field_selector_parity_both_dialects(self, server, client):
+        """Server-filtered results are bit-identical to client-side
+        filtering of the full listing, on the wire AND in memory."""
+        mem = InMemoryKubeAPI()
+        for api in (client, mem):
+            for i in range(12):
+                pod = make_pod(f"p{i:02d}",
+                               namespace="nsa" if i % 3 else "nsb",
+                               node_name="n1" if i % 2 else "",
+                               phase="Running" if i % 4 == 0
+                               else "Pending")
+                api.create(pod)
+        selectors = [
+            {"spec.nodeName": "n1"},
+            "status.phase!=Running",
+            "metadata.namespace=nsb",
+            "spec.nodeName=n1,status.phase=Pending",
+            {"spec.nodeName": ""},
+        ]
+        for sel in selectors:
+            terms = parse_field_selector(sel)
+            for api in (client, mem):
+                full = api.list("Pod")
+                expected = sorted(o["metadata"]["name"] for o in full
+                                  if field_match(o, terms))
+                got = sorted(o["metadata"]["name"]
+                             for o in api.list("Pod", field_selector=sel))
+                assert got == expected, (sel, type(api).__name__)
+
+
+class TestBulkEndpoints:
+    def test_fenced_item_fails_that_item_only(self, server, client):
+        """Per-item fencing: a wave carrying one stale-epoch item lands
+        every other item and reports the fenced one's outcome."""
+        client.create({"kind": "Lease",
+                       "metadata": {"name": "sched",
+                                    "namespace": "kai-system"},
+                       "spec": {"epoch": 5}})
+        items = [
+            {"object": {"kind": "Queue", "metadata": {"name": "ok1"},
+                        "spec": {}}, "epoch": 5, "fence": "sched"},
+            {"object": {"kind": "Queue", "metadata": {"name": "stale"},
+                        "spec": {}}, "epoch": 3, "fence": "sched"},
+            {"object": {"kind": "Queue", "metadata": {"name": "ok2"},
+                        "spec": {}}, "epoch": 5, "fence": "sched"},
+        ]
+        outcomes = client.create_many(items)
+        assert [o["ok"] for o in outcomes] == [True, False, True]
+        assert isinstance(outcomes[1]["error"], Fenced)
+        assert client.get_opt("Queue", "ok1") is not None
+        assert client.get_opt("Queue", "stale") is None
+        assert client.get_opt("Queue", "ok2") is not None
+
+    def test_bulk_patch_partial_outcomes(self, client):
+        client.create(make_pod("alive"))
+        outcomes = client.patch_many([
+            {"kind": "Pod", "name": "alive", "namespace": "default",
+             "patch": {"status": {"phase": "Running"}}},
+            {"kind": "Pod", "name": "ghost", "namespace": "default",
+             "patch": {"status": {"phase": "Running"}}},
+        ])
+        assert outcomes[0]["ok"] and not outcomes[1]["ok"]
+        assert client.get("Pod", "alive")["status"]["phase"] == "Running"
+
+    def test_crash_after_journal_no_duplicate_binds_batch_path(
+            self, tmp_path, monkeypatch):
+        """The bind WAVE journals intents before its bulk write; a crash
+        after the fsync replays to zero duplicate binds — exactly one
+        BindRequest per pod ever reaches the store."""
+        from kai_scheduler_tpu.controllers import owner_ref
+        log_path = str(tmp_path / "bind.journal")
+        system = System(SystemConfig(commitlog_path=log_path))
+        api = system.api
+        make_node(api, "n1")
+        make_queue(api)
+        # One GANG of 3: a single statement commit journals the whole
+        # wave's intents in one fsync, then the crash fires.
+        ref = owner_ref("Job", "wavejob", uid="wavejob-u")
+        for i in range(3):
+            api.create(make_pod(f"wave{i}", queue="q", gpu=1, owner=ref))
+        api.drain()
+        waves0 = _counter("bulk_write_batches_total", path="bind_wave")
+        monkeypatch.setenv("KAI_FAULT_INJECT", "crash-after-journal")
+        with pytest.raises(SimulatedCrash):
+            system.run_cycle()
+        monkeypatch.delenv("KAI_FAULT_INJECT")
+        assert api.list("BindRequest") == []
+        assert CommitLog(log_path).pending_intents()
+        # Restart: reconcile + re-schedule THROUGH the bulk path.
+        system2 = System(SystemConfig(commitlog_path=log_path), api=api)
+        summary = system2.startup_reconcile()
+        # At least the first journaled wave died pre-commit; however the
+        # grouper batched the gang, every journaled intent must resolve
+        # as lost (nothing reached the store before the crash).
+        assert summary["lost_commits"] >= 1
+        assert summary["recovered_commits"] == 0
+        for _ in range(3):
+            system2.run_cycle()
+        for i in range(3):
+            assert api.get("Pod", f"wave{i}")["spec"].get("nodeName") \
+                == "n1"
+        # No duplicates: at most one (GC-able) request per pod ever.
+        names = [br["spec"]["podName"]
+                 for br in api.list("BindRequest")]
+        assert len(names) == len(set(names))
+        assert _counter("bulk_write_batches_total",
+                        path="bind_wave") > waves0, \
+            "the re-scheduled wave bypassed the bulk bind path"
+
+    def test_partial_batch_outcome_surfaces_in_binder_counters(self):
+        """One failed item in a binder wave fails that request only —
+        and the failure is LOUD: bulk_write_errors_total{path=binder}
+        counts it, and when the exhausted-backoff event write fails too,
+        binder_event_write_errors records that (KAI007: never silent)."""
+        from kai_scheduler_tpu.controllers.binder import Binder
+
+        class FaultyBulkAPI(InMemoryKubeAPI):
+            def patch_many(self, items, **kw):
+                healthy = super().patch_many(
+                    [i for i in items if i.get("name") != "doomed"],
+                    **kw)
+                out = []
+                for item in items:
+                    if item.get("name") == "doomed":
+                        out.append({"ok": False,
+                                    "error": RuntimeError("torn write")})
+                    else:
+                        out.append(healthy.pop(0))
+                return out
+
+            def patch(self, kind, name, patch, namespace="default",
+                      **kw):
+                if kind == "Pod" and name == "doomed":
+                    raise RuntimeError("torn write")  # retries too
+                return super().patch(kind, name, patch, namespace, **kw)
+
+            def create(self, obj, **kw):
+                if obj.get("kind") == "Event":
+                    raise RuntimeError("event store down")
+                return super().create(obj, **kw)
+
+        api = FaultyBulkAPI()
+        clock = {"t": 1000.0}
+        binder = Binder(api, backoff_limit=2,
+                        now_fn=lambda: clock["t"])
+        make_node(api, "n1")
+        for name in ("doomed", "fine"):
+            api.create(make_pod(name))
+            api.create({"kind": "BindRequest",
+                        "metadata": {"name": f"bind-{name}"},
+                        "spec": {"podName": name, "podUid": f"u-{name}",
+                                 "selectedNode": "n1"},
+                        "status": {"phase": "Pending"}})
+        err0 = _counter("bulk_write_errors_total", path="binder")
+        evt0 = METRICS.counters.get("binder_event_write_errors", 0)
+        api.drain()  # delivers both BRs -> ONE wave with one torn item
+        assert api.get("Pod", "fine")["spec"].get("nodeName") == "n1", \
+            "the healthy wave item must land despite the torn one"
+        assert not api.get("Pod", "doomed")["spec"].get("nodeName")
+        assert _counter("bulk_write_errors_total", path="binder") > err0
+        # Exhaust the doomed request's backoff: the event write path
+        # fails too, and that failure is counted, never swallowed.
+        for _ in range(3):
+            clock["t"] += 120.0
+            binder.tick()
+        br = api.get("BindRequest", "bind-doomed")
+        assert br["status"]["phase"] == "Failed"
+        assert METRICS.counters.get("binder_event_write_errors", 0) \
+            > evt0, "the exhausted-backoff event failure was silent"
+
+
+class TestPooledDispatch:
+    def test_saturation_answers_429_and_client_retries_through(self):
+        """With a 1-worker pool wedged on a slow request, excess load is
+        answered 429 (bounded, counted) — and the client's throttle
+        retry loop still completes its call once the pool frees up."""
+        srv = KubeAPIServer(pool_size=1, pool_backlog=1).start()
+        real_handle = srv.handle
+
+        def slow_handle(*a, **kw):
+            time.sleep(0.25)
+            return real_handle(*a, **kw)
+
+        srv.handle = slow_handle
+        try:
+            sat0 = METRICS.counters.get("apiserver_pool_saturated_total",
+                                        0)
+            results = []
+
+            def hammer():
+                c = HTTPKubeAPI(srv.url, timeout=10.0)
+                try:
+                    results.append(c.list("Pod"))
+                finally:
+                    c.close()
+
+            threads = [threading.Thread(target=hammer)
+                       for _ in range(6)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=20.0)
+            assert len(results) == 6, "a throttled client never recovered"
+            assert METRICS.counters.get(
+                "apiserver_pool_saturated_total", 0) > sat0, \
+                "six concurrent calls on a wedged 1-worker pool never " \
+                "tripped backpressure"
+            assert METRICS.counters.get("http_throttled_retries_total",
+                                        0) > 0
+        finally:
+            srv.handle = real_handle
+            srv.stop()
+
+    def test_watch_stream_cap(self, server):
+        server.max_watch_streams = 0
+        import urllib.error
+        import urllib.request
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(server.url + "/watch?since=0",
+                                   timeout=5)
+        assert ei.value.code == 429
+
+    def test_preserialized_frames_fan_out_verbatim(self, server):
+        """Two watchers of one mutation stream receive byte-identical
+        frames, and the frame cache records one encode (miss) fanned out
+        as multiple hits."""
+        import urllib.request
+        streams = [urllib.request.urlopen(
+            server.url + "/watch?since=0", timeout=10)
+            for _ in range(2)]
+        hits0 = METRICS.counters.get("watch_frame_cache_hits_total", 0)
+        for i in range(5):
+            server.api.create(make_pod(f"fan{i}"))
+        server.api.drain()
+        got = []
+        for resp in streams:
+            lines = []
+            while len(lines) < 5:
+                line = resp.readline()
+                evt = json.loads(line)
+                if evt.get("type") == "ADDED":
+                    lines.append(line)
+            got.append(lines)
+            resp.close()
+        assert got[0] == got[1], "watchers saw different bytes"
+        assert METRICS.counters.get(
+            "watch_frame_cache_hits_total", 0) >= hits0 + 10
+
+
+class TestWireCacheMode:
+    def test_watch_sync_and_barrier_over_wire(self, server, client):
+        """watch_sync handlers fire on the watch thread as events land,
+        and sync_watch() blocks until the client has read its own
+        writes."""
+        seen = []
+        client.watch_sync(lambda et, obj: seen.append(
+            (et, obj["metadata"]["name"])))
+        client.create(make_pod("rw1"))
+        assert client.sync_watch(timeout=5.0), \
+            "read-your-writes barrier timed out"
+        assert ("ADDED", "rw1") in seen
+
+    def test_http_fleet_steady_state_ships_no_hot_kind_lists(self):
+        """The structural gate in test form: after priming, warm http
+        cycles issue ZERO list requests for the hot kinds — the watch-
+        mode cache (O(delta), payload-authoritative) carries the state."""
+        from kai_scheduler_tpu.controllers import owner_ref
+        srv = KubeAPIServer().start()
+        c = HTTPKubeAPI(srv.url)
+        system = System(SystemConfig(), api=c)
+        try:
+            for i in range(10):
+                make_node(c, f"n{i}")
+            make_queue(c, "fq0")
+
+            def submit(wave):
+                name = f"w{wave}"
+                c.create({"kind": "PyTorchJob",
+                          "apiVersion": "kubeflow.org/v1",
+                          "metadata": {"name": name, "uid": f"{name}-u",
+                                       "labels": {"kai.scheduler/queue":
+                                                  "fq0"}},
+                          "spec": {"pytorchReplicaSpecs": {
+                              "Worker": {"replicas": 8}}}})
+                ref = owner_ref("PyTorchJob", name, uid=f"{name}-u",
+                                api_version="kubeflow.org/v1")
+                for k in range(8):
+                    c.create(make_pod(
+                        f"{name}-{k}", owner=ref, gpu=1,
+                        labels={"training.kubeflow.org/replica-type":
+                                "worker"}))
+
+            def hot_lists():
+                return sum(_counter("apiserver_list_requests_total",
+                                    kind=k)
+                           for k in ("Pod", "Node", "Queue", "PodGroup"))
+
+            def bound():
+                return len([p for p in srv.api.list(
+                    "Pod", field_selector={"status.phase": "Running"})])
+
+            submit(1)
+            for _ in range(6):
+                system.run_cycle()
+                if bound() >= 8:
+                    break
+            assert bound() >= 8
+            # Warm window: another wave, zero hot-kind lists allowed.
+            lists0 = hot_lists()
+            refresh0 = METRICS.counters.get(
+                "cluster_cache_full_refresh_total", 0)
+            submit(2)
+            for _ in range(6):
+                system.run_cycle()
+                if bound() >= 16:
+                    break
+            assert bound() >= 16
+            assert hot_lists() == lists0, \
+                "a warm http cycle re-listed a hot kind"
+            assert METRICS.counters.get(
+                "cluster_cache_full_refresh_total", 0) == refresh0
+        finally:
+            c.close()
+            srv.stop()
