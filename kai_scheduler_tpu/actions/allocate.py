@@ -290,10 +290,14 @@ def _execute_bulk(ssn, jobs):
             n = len(tasks)
             if success[j]:
                 stmt = ssn.statement()
-                stmt.apply_bulk(
+                pairs = [
                     (task, ssn.snapshot.node_names[int(placements[ti + i])],
                      bool(pipelined[ti + i]))
-                    for i, task in enumerate(tasks))
+                    for i, task in enumerate(tasks)]
+                # Rank-aware reorder (ops/rankplace.py): the registered
+                # fn re-verifies interchangeability before permuting, so
+                # heterogeneous bulk chunks pass through untouched.
+                stmt.apply_bulk(ssn.apply_rank_placement(tasks, pairs))
                 if ordered[j].should_pipeline():
                     stmt.convert_all_allocated_to_pipelined(ordered[j].uid)
                 stmt.commit()

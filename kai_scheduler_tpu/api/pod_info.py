@@ -136,6 +136,11 @@ class PodInfo:
     status: PodStatus = PodStatus.PENDING
     node_name: str = ""
     priority: int = 0
+    # MPI-style gang rank (rank-aware placement, ops/rankplace.py):
+    # parsed from the ``kai.scheduler/rank`` annotation or the
+    # reference ecosystem's index-label/pod-name conventions
+    # (cache_builder._parse_rank); -1 = unranked.
+    rank: int = -1
     # Scheduling constraints (encoded, see cluster_info.LabelCodec):
     node_selector: dict = field(default_factory=dict)   # label -> required value
     tolerations: set = field(default_factory=set)       # taint keys tolerated
@@ -295,6 +300,7 @@ class PodInfo:
             job_id=self.job_id, subgroup=self.subgroup,
             res_req=self.res_req.clone(), status=self.status,
             node_name=self.node_name, priority=self.priority,
+            rank=self.rank,
             node_selector=dict(self.node_selector),
             tolerations=set(self.tolerations),
             accepted_resource_types=(set(self.accepted_resource_types)

@@ -75,6 +75,7 @@ class SnapshotTensors:
     task_job: np.ndarray           # [T] int32 job index
     task_selector: np.ndarray      # [T,L] int32, NO_LABEL = unconstrained
     task_tolerations: np.ndarray   # [T,Tl] int32, NO_TAINT padding
+    task_rank: np.ndarray          # [T] int32 MPI gang rank, -1 unranked
     # --- jobs [J, ...] ---
     job_queue: np.ndarray          # [J] int32 queue index
     job_min_available: np.ndarray  # [J] int32
@@ -189,6 +190,7 @@ def _pack_task_arrays(tasks: list[PodInfo], jobs: list[PodGroupInfo],
     task_job = np.zeros(max(t_count, 1), np.int32)
     task_sel = np.full((max(t_count, 1), L), NO_LABEL, np.int32)
     task_tol = np.full((max(t_count, 1), max_tols), NO_TAINT, np.int32)
+    task_rank = np.full(max(t_count, 1), -1, np.int32)
     job_index = {pg.uid: j for j, pg in enumerate(jobs)}
     key_cols = codec.key_cols
     taint_codes = codec.taint_codes
@@ -201,6 +203,8 @@ def _pack_task_arrays(tasks: list[PodInfo], jobs: list[PodGroupInfo],
             [t.res_req.to_vec(mig_as_gpu=False) for t in tasks])
         task_job[:t_count] = np.fromiter(
             (job_index[t.job_id] for t in tasks), np.int32, count=t_count)
+        task_rank[:t_count] = np.fromiter(
+            (t.rank for t in tasks), np.int32, count=t_count)
     for i, t in enumerate(tasks):
         if t.node_selector:
             for k, v in t.node_selector.items():
@@ -209,7 +213,7 @@ def _pack_task_arrays(tasks: list[PodInfo], jobs: list[PodGroupInfo],
             for j, tol in enumerate(sorted(t.tolerations)):
                 if tol in taint_codes:
                     task_tol[i, j] = taint_codes[tol]
-    return task_req, task_job, task_sel, task_tol
+    return task_req, task_job, task_sel, task_tol, task_rank
 
 
 def _pack_queue_arrays(cluster: ClusterInfo,
@@ -315,7 +319,7 @@ def pack(cluster: ClusterInfo,
             for j, taint in enumerate(sorted(node.taints)):
                 node_taints[i, j] = taint_codes[taint]
 
-    task_req, task_job, task_sel, task_tol = _pack_task_arrays(
+    task_req, task_job, task_sel, task_tol, task_rank = _pack_task_arrays(
         tasks, jobs, codec, L, max_tols)
 
     (queue_uids, q_index, q_deserved, q_limit, q_oqw, q_prio, q_parent,
@@ -329,7 +333,7 @@ def pack(cluster: ClusterInfo,
         node_releasing=node_rel, node_labels=node_labels,
         node_taints=node_taints, node_pod_room=node_room,
         task_req=task_req, task_job=task_job, task_selector=task_sel,
-        task_tolerations=task_tol,
+        task_tolerations=task_tol, task_rank=task_rank,
         job_queue=job_q, job_min_available=job_min,
         job_task_start=np.array(job_start or [0], np.int32),
         job_task_count=np.array(job_count or [0], np.int32),
@@ -408,6 +412,7 @@ def pack_incremental(cluster: ClusterInfo, prev: SnapshotTensors,
         # defensive proof the candidate sets really match).
         task_req, task_job = prev.task_req, prev.task_job
         task_sel, task_tol = prev.task_selector, prev.task_tolerations
+        task_rank = prev.task_rank
         queue_uids = prev.queue_uids
         q_deserved, q_limit = prev.queue_deserved, prev.queue_limit
         q_oqw, q_prio = prev.queue_over_quota_weight, prev.queue_priority
@@ -419,8 +424,8 @@ def pack_incremental(cluster: ClusterInfo, prev: SnapshotTensors,
         job_count_arr = prev.job_task_count
         task_uids, job_uids = prev.task_uids, prev.job_uids
     else:
-        task_req, task_job, task_sel, task_tol = _pack_task_arrays(
-            tasks, jobs, codec, L, max_tols)
+        (task_req, task_job, task_sel, task_tol,
+         task_rank) = _pack_task_arrays(tasks, jobs, codec, L, max_tols)
         (queue_uids, q_index, q_deserved, q_limit, q_oqw, q_prio, q_parent,
          q_creation, q_alloc, q_req, q_usage) = _pack_queue_arrays(
             cluster, queue_usage)
@@ -435,7 +440,7 @@ def pack_incremental(cluster: ClusterInfo, prev: SnapshotTensors,
         node_releasing=node_rel, node_labels=prev.node_labels,
         node_taints=prev.node_taints, node_pod_room=node_room,
         task_req=task_req, task_job=task_job, task_selector=task_sel,
-        task_tolerations=task_tol,
+        task_tolerations=task_tol, task_rank=task_rank,
         job_queue=job_q, job_min_available=job_min,
         job_task_start=job_start_arr, job_task_count=job_count_arr,
         queue_deserved=q_deserved, queue_limit=q_limit,

@@ -33,6 +33,38 @@ PHASE_TO_STATUS = {
     "Failed": PodStatus.FAILED,
 }
 
+# Rank-aware gang placement (ops/rankplace.py, arxiv 2603.22691): the MPI
+# rank index of a gang member, resolved in priority order from the
+# explicit annotation, the workload controllers' index labels/annotations
+# (indexed Jobs, StatefulSets, kubeflow replicas, LeaderWorkerSet), and
+# finally the trailing ``-<int>`` pod-name convention every one of those
+# controllers also follows.  -1 = unranked (rank placement skips the pod).
+RANK_ANNOTATION = "kai.scheduler/rank"
+_RANK_LABEL_KEYS = (
+    "batch.kubernetes.io/job-completion-index",     # indexed batch Job
+    "apps.kubernetes.io/pod-index",                 # StatefulSet
+    "training.kubeflow.org/replica-index",          # kubeflow operators
+    "leaderworkerset.sigs.k8s.io/worker-index",     # LWS
+)
+_RANK_NAME_RE = re.compile(r"-(\d+)$")
+
+
+def _parse_rank(md: dict) -> int:
+    ann = md.get("annotations") or {}
+    labels = md.get("labels") or {}
+    for source in (ann.get(RANK_ANNOTATION),
+                   ann.get(_RANK_LABEL_KEYS[0]),
+                   *(labels.get(k) for k in _RANK_LABEL_KEYS)):
+        if source is None:
+            continue
+        try:
+            rank = int(source)
+        except (TypeError, ValueError):
+            continue
+        return rank if rank >= 0 else -1
+    m = _RANK_NAME_RE.search(md.get("name", ""))
+    return int(m.group(1)) if m else -1
+
 
 def _requests_to_reqreq(pod: dict) -> ResourceRequirements:
     cpu_milli = mem = gpu = 0.0
@@ -674,6 +706,7 @@ class ClusterCache:
             node_selector=pod.get("spec", {}).get("nodeSelector", {}),
             tolerations={t["key"] for t in pod.get("spec", {}).get(
                 "tolerations", [])},
+            rank=_parse_rank(md),
             labels=dict(md.get("labels", {})))
         _parse_pod_affinity(task, pod.get("spec", {}).get("affinity", {}))
         _parse_pod_predicates(task, pod)

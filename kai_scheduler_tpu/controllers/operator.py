@@ -55,6 +55,11 @@ class SystemConfig:
     # and its window/decay parameters (cache/usagedb params analog).
     usage_db: str | None = None
     usage_params: object = None
+    # Usage-tensor persistence (the commit-log pattern, DESIGN §13):
+    # checkpoint the decayed usage state here each fold and restore it
+    # on startup, so the fairness penalty survives a scheduler restart.
+    # None = in-memory only.
+    usage_log_path: str | None = None
     # Feature gates (pkg/common/feature_gates analog): overrides applied
     # on top of KNOWN_GATES defaults, shared with every shard's
     # SchedulerConfig by _build_schedulers.
@@ -96,6 +101,9 @@ class System:
         from ..utils.usagedb import resolve_usage_client
         self.usage_db = resolve_usage_client(self.config.usage_db,
                                              self.config.usage_params)
+        if (self.usage_db is not None and self.config.usage_log_path
+                and hasattr(self.usage_db, "attach_log")):
+            self.usage_db.attach_log(self.config.usage_log_path)
         self.commitlog = None
         if self.config.commitlog_path:
             from ..utils.commitlog import CommitLog
@@ -495,9 +503,19 @@ class System:
     def _record_decisions(self, ssn) -> None:
         if self.usage_db is not None \
                 and getattr(ssn, "proportion", None) is not None:
-            for qid, attrs in ssn.proportion.queues.items():
-                self.usage_db.record(self._now_fn(), qid,
-                                     attrs.allocated)
+            # The division algorithm expects U' in capacity units
+            # (resource_division.go:242): keep the store's normalizer
+            # at the live cluster total — raw usage (16 GPUs against
+            # weights ~1.0) would zero EVERY queue's over-quota share
+            # and silently turn the penalty off.
+            if hasattr(self.usage_db, "cluster_capacity"):
+                self.usage_db.cluster_capacity = ssn.proportion.total
+            # One whole-cycle sample, folded by ONE jitted decay
+            # dispatch (ops/usage.py; fleet_budget pins the count).
+            self.usage_db.record_cycle(
+                self._now_fn(),
+                {qid: attrs.allocated
+                 for qid, attrs in ssn.proportion.queues.items()})
 
     def run_cycle(self) -> None:
         """One end-to-end tick: drain controller events, run every shard's

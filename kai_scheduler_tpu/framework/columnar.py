@@ -122,6 +122,7 @@ class ColumnarPods:
         self.req = np.zeros((cap, rs.NUM_RES))    # to_vec(mig_as_gpu=False)
         self.flags = np.zeros(cap, np.int32)
         self.tol_len = np.zeros(cap, np.int32)    # len(tolerations)
+        self.rank = np.full(cap, -1, np.int32)    # MPI gang rank, -1 none
         self.uid = np.empty(cap, object)
         self.rv = np.empty(cap, object)           # _sig_rv change signature
         self.tmpl = np.empty(cap, object)         # parsed PodInfo template
@@ -138,9 +139,10 @@ class ColumnarPods:
     def _grow(self) -> None:
         cap = self.status.shape[0] * 2
         for name in ("status", "node_id", "group_id", "subgroup_id",
-                     "flags", "tol_len"):
+                     "flags", "tol_len", "rank"):
             old = getattr(self, name)
-            fresh = np.full(cap, -1, np.int32) if name.endswith("_id") \
+            fresh = np.full(cap, -1, np.int32) \
+                if name.endswith("_id") or name == "rank" \
                 else np.zeros(cap, np.int32)
             fresh[:old.shape[0]] = old
             setattr(self, name, fresh)
@@ -201,6 +203,7 @@ class ColumnarPods:
         self.req[row] = tmpl.res_req.to_vec(mig_as_gpu=False)
         self.flags[row] = self._flags_of(tmpl)
         self.tol_len[row] = len(tmpl.tolerations)
+        self.rank[row] = tmpl.rank
         self.uid[row] = tmpl.uid
         self.rv[row] = rv_sig
         self.tmpl[row] = tmpl
@@ -221,6 +224,7 @@ class ColumnarPods:
         self.node_id[row] = -1
         self.status[row] = 0
         self.flags[row] = 0
+        self.rank[row] = -1
         self.free.append(row)
         self.version += 1
         return uid

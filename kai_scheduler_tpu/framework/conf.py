@@ -83,6 +83,12 @@ class SchedulerConfig:
     # dispatch loop (the pre-forest baseline, kept for A/B benches and
     # as the parity reference).
     fused_fairshare: str = "forest"
+    # Rank-aware gang placement (ops/rankplace.py): permute
+    # interchangeable gang members so consecutive MPI ranks land
+    # topology-adjacent.  Pure post-fill permutation — placements'
+    # node multiset is untouched; False keeps the rank-oblivious
+    # assignment (the scale ring's A/B baseline).
+    rank_aware_placement: bool = True
     # Whole-cycle deadline in seconds (0 disables).  Enforced by the
     # cycle driver between actions AND inside them at kernel-dispatch
     # granularity (Session.dispatch_kernel): past the deadline the cycle
@@ -149,7 +155,7 @@ class SchedulerConfig:
                     "max_scenarios_per_job", "max_victims_considered",
                     "scenario_prescreen_max", "scenario_prescreen_after",
                     "batched_scenario_confirm", "cycle_deadline_s",
-                    "fused_fairshare"):
+                    "fused_fairshare", "rank_aware_placement"):
             if key in d:
                 setattr(config, key, d[key])
         if config.fused_fairshare not in ("forest", "levels"):

@@ -128,7 +128,11 @@ class Session:
         self.cluster = cluster
         self.config = config or SchedulerConfig()
         self.cache = cache or InMemoryCache()
-        self.queue_usage = queue_usage or {}
+        # NOT `queue_usage or {}`: an EMPTY usage snapshot can still
+        # carry the stale verdict (total scrape outage — the most
+        # degraded case), and `or` would replace it with a plain dict,
+        # silently dropping the flag the degraded mode keys on.
+        self.queue_usage = {} if queue_usage is None else queue_usage
         # --- extension points (session.go:51-95 function slices) ---
         self.queue_order_fns: list[Callable] = []
         self.job_order_fns: list[Callable] = []
@@ -161,6 +165,12 @@ class Session:
         self.deallocate_handlers: list[Callable] = []
         self.subset_nodes_fns: list[Callable] = []
         self.extra_score_fns: list[Callable] = []
+        # Rank-aware placement (ops/rankplace.py): post-fill permutation
+        # of an interchangeable gang chunk's (task, node, piped) pairs so
+        # consecutive MPI ranks land topology-adjacent.  Registered by
+        # the topology plugin; consulted only on paths that proved the
+        # chunk homogeneous (grouped fast path, bulk action).
+        self.rank_assign_fns: list[Callable] = []
         # Hard [T,N] feasibility contributions (podaffinity terms,
         # upstream predicates) and self-anti-affinity domain rows.
         self.hard_node_mask_fns: list[Callable] = []
@@ -207,12 +217,18 @@ class Session:
         # replay) pack from scratch exactly as before.
         self._arena = getattr(self.cache, "arena", None)
         self.pack_stats: dict | None = None
+        # Stale usage never reaches the packed tensors: the degraded
+        # mode (docs/DEGRADATION.md) is "ignore usage", enforced here
+        # for every tensor consumer and by the proportion plugin for
+        # the host-side attributes (which also counts the cycle).
+        pack_usage = {} if getattr(queue_usage, "stale", False) \
+            else queue_usage
         if self._arena is not None:
             self.snapshot, self.pack_stats = self._arena.pack(
-                cluster, queue_usage=queue_usage, pad_nodes_to=pad)
+                cluster, queue_usage=pack_usage, pad_nodes_to=pad)
         else:
             self.snapshot: SnapshotTensors = pack(
-                cluster, queue_usage=queue_usage, pad_nodes_to=pad)
+                cluster, queue_usage=pack_usage, pad_nodes_to=pad)
         self.phase_timings["snapshot_pack"] = _time.perf_counter() - _t
         # Dense mutable mirrors: backed by the native C++ state store when
         # available (contiguous C-owned tables, zero-copy views), else
@@ -607,6 +623,20 @@ class Session:
                 return sets
         return [None]
 
+    def apply_rank_placement(self, tasks, placements):
+        """Rank-aware reorder of one gang chunk's placements: the first
+        registered fn that returns a permuted list wins; None keeps the
+        rank-oblivious assignment.  Callers must only pass chunks whose
+        tasks are interchangeable under the placement (the registered
+        fns re-verify before permuting)."""
+        if not getattr(self.config, "rank_aware_placement", True):
+            return placements
+        for fn in self.rank_assign_fns:
+            out = fn(tasks, placements)
+            if out is not None:
+                return out
+        return placements
+
     # -- device-kernel placement proposals ---------------------------------
     def propose_placements_multi(self, job_chunks,
                                  pipeline_only: bool = True):
@@ -808,7 +838,10 @@ class Session:
                     return Proposal(False, [])
                 placements.append((task, snap.node_names[node_idx],
                                    bool(piped[i])))
-            return Proposal(True, placements)
+            # The homogeneous check above proved the chunk's tasks
+            # interchangeable — the one precondition rank reorder needs.
+            return Proposal(True,
+                            self.apply_rank_placement(tasks, placements))
         mask_pad = None
         if mask is not None:
             mask_pad = np.ones((t_pad, n_nodes), bool)

@@ -153,6 +153,7 @@ def _replica_specs_min_member(owner, specs_key: str = "replicaSpecs"):
     specs = (spec.get(specs_key) or spec.get("tfReplicaSpecs")
              or spec.get("pytorchReplicaSpecs") or spec.get("xgbReplicaSpecs")
              or spec.get("jaxReplicaSpecs") or spec.get("mpiReplicaSpecs")
+             or spec.get("mxReplicaSpecs") or spec.get("paddleReplicaSpecs")
              or {})
     total = 0
     pod_sets = []
@@ -290,6 +291,60 @@ def pod_grouper(owner, pod, api=None):
     return meta
 
 
+def volcano_job_grouper(owner, pod, api=None):
+    """batch.volcano.sh Job: explicit spec.minAvailable wins, else gang
+    over every task's replicas; each task becomes a pod set."""
+    meta = _base(owner, pod)
+    spec = _spec(owner)
+    total, pod_sets = 0, []
+    for task in spec.get("tasks", []) or []:
+        replicas = int(task.get("replicas", 1))
+        total += replicas
+        pod_sets.append(PodSetSpec(task.get("name",
+                                            f"task{len(pod_sets)}"),
+                                   replicas))
+    min_available = spec.get("minAvailable")
+    if min_available is not None:
+        meta.min_member = int(min_available)
+        meta.pod_sets = []
+    else:
+        meta.min_member = max(total, 1)
+        meta.pod_sets = pod_sets
+    return meta
+
+
+def flink_grouper(owner, pod, api=None):
+    """flink.apache.org FlinkDeployment: long-running streaming gang —
+    jobManager + taskManager replicas, inference-class (a streaming
+    pipeline must not be preempted by training backfill)."""
+    meta = _base(owner, pod, defaults=INFERENCE)
+    spec = _spec(owner)
+    jm = int((spec.get("jobManager") or {}).get("replicas", 1))
+    tm = int((spec.get("taskManager") or {}).get("replicas", 1))
+    meta.min_member = max(jm + tm, 1)
+    meta.pod_sets = [PodSetSpec("jobmanager", jm),
+                     PodSetSpec("taskmanager", tm)]
+    return meta
+
+
+def appwrapper_grouper(owner, pod, api=None):
+    """workload.codeflare.dev AppWrapper (v1beta2): gang across every
+    wrapped component's podSets (replicas per set; a component without
+    podSets contributes one pod)."""
+    meta = _base(owner, pod)
+    total, pod_sets = 0, []
+    for ci, comp in enumerate(_spec(owner).get("components", []) or []):
+        pod_set_list = comp.get("podSets") or [{"replicas": 1}]
+        for si, ps in enumerate(pod_set_list):
+            replicas = int(ps.get("replicas", 1))
+            total += replicas
+            pod_sets.append(PodSetSpec(
+                ps.get("name", f"component{ci}-{si}"), replicas))
+    meta.min_member = max(total, 1)
+    meta.pod_sets = pod_sets
+    return meta
+
+
 def knative_grouper(owner, pod, api=None):
     """serving.knative.dev Service (plugins/knative): inference service;
     optional gang per revision."""
@@ -356,6 +411,8 @@ GROUPER_TABLE = {
     ("kubeflow.org", "XGBoostJob"): kubeflow_grouper,
     ("kubeflow.org", "JAXJob"): kubeflow_grouper,
     ("kubeflow.org", "MPIJob"): mpi_grouper,
+    ("kubeflow.org", "MXJob"): kubeflow_grouper,
+    ("kubeflow.org", "PaddleJob"): kubeflow_grouper,
     ("kubeflow.org", "Notebook"): notebook_grouper,
     ("kubeflow.org", "ScheduledWorkflow"): default_grouper,
     ("trainer.kubeflow.org", "TrainJob"): skip_top_owner_grouper,
@@ -369,6 +426,10 @@ GROUPER_TABLE = {
     ("nvidia.com", "DynamoGraphDeployment"): skip_top_owner_grouper,
     ("argoproj.io", "Workflow"): skip_top_owner_grouper,
     ("serving.knative.dev", "Service"): knative_grouper,
+    ("serving.kserve.io", "InferenceService"): knative_grouper,
+    ("batch.volcano.sh", "Job"): volcano_job_grouper,
+    ("flink.apache.org", "FlinkDeployment"): flink_grouper,
+    ("workload.codeflare.dev", "AppWrapper"): appwrapper_grouper,
     ("sparkoperator.k8s.io", "SparkApplication"): spark_grouper,
     ("amlarc.azureml.com", "AmlJob"): aml_grouper,
     ("workspace.devfile.io", "DevWorkspace"): default_grouper,
@@ -395,7 +456,8 @@ GROUPER_TABLE = {
 for _g in (default_grouper, k8s_job_grouper, kubeflow_grouper,
            mpi_grouper, notebook_grouper, ray_grouper, jobset_grouper,
            knative_grouper, kubevirt_grouper, aml_grouper,
-           spotrequest_grouper):
+           spotrequest_grouper, volcano_job_grouper, flink_grouper,
+           appwrapper_grouper):
     _g.pod_inputs = "base"
 
 

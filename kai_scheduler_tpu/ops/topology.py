@@ -126,6 +126,10 @@ class TopologySession:
         # job uid -> [N] preferred-level score boosts (set by subset_nodes).
         # kairace: single-writer=main
         self._job_node_scores: dict[str, np.ndarray] = {}
+        # tree name -> rankplace.TopoOrder (built lazily, once per
+        # session: pure function of the tree + packed node order).
+        # kairace: single-writer=main
+        self._topo_orders: dict[str, object] = {}
 
     # -- constraint resolution ---------------------------------------------
     def _job_constraint(self, job, podset=None):
@@ -251,6 +255,88 @@ class TopologySession:
             self._job_node_scores[job.uid] = boosts
 
         return [mask for _, _, _, mask in candidates]
+
+    # -- rank-aware placement (ops/rankplace.py) ---------------------------
+    def _topo_order_for(self, tree):
+        from . import rankplace as rp
+        order = self._topo_orders.get(tree.name)
+        if order is None:
+            order = rp.build_topo_order(tree, self.ssn.node_idle.shape[0])
+            self._topo_orders[tree.name] = order
+        return order
+
+    def assign_ranks(self, tasks, placements):
+        """Rank-aware reorder of one placed gang chunk
+        (ssn.rank_assign_fns contract): returns the permuted
+        [(task, node, piped)] list, or None to keep the rank-oblivious
+        assignment.
+
+        Preconditions verified here (cheap, O(gang)):
+        - every task carries a distinct non-negative rank;
+        - the tasks are interchangeable (identical request vector,
+          node selector, and toleration set) — permuting them across
+          the fill plan's slots then changes nothing but which rank
+          runs where.
+        The (node, piped) pairs permute as units: pipelined-ness
+        belongs to the slot's capacity phase, not the task.
+        """
+        from ..utils.metrics import METRICS
+        from ..utils.tracing import TRACER
+        from . import rankplace as rp
+        if len(placements) < 2 or not self.trees:
+            return None
+        chunk = [t for t, _n, _p in placements]
+        ranks = [t.rank for t in chunk]
+        if min(ranks) < 0 or len(set(ranks)) != len(ranks):
+            return None
+        t0 = chunk[0]
+        req0 = t0.res_req.to_vec(mig_as_gpu=False)
+        for t in chunk[1:]:
+            if (t.node_selector != t0.node_selector
+                    or t.tolerations != t0.tolerations
+                    or not np.array_equal(
+                        t.res_req.to_vec(mig_as_gpu=False), req0)):
+                return None
+        job = self.ssn.cluster.podgroups.get(t0.job_id)
+        topo_name = getattr(job, "topology_name", None) if job else None
+        tree = self.trees.get(topo_name) if topo_name else None
+        if tree is None:
+            tree = next(iter(self.trees.values()))
+        order = self._topo_order_for(tree)
+        ssn = self.ssn
+        slot_nodes = np.empty(len(placements), np.int32)
+        for i, (_t, node_name, _p) in enumerate(placements):
+            idx = ssn.node_index(node_name)
+            if idx < 0:
+                return None
+            slot_nodes[i] = idx
+        mode = rp.resolve_mode(None, len(placements))
+        with TRACER.span("rankplace", kind="rankplace",
+                         gang=len(placements), tree=tree.name,
+                         mode=mode) as sp:
+            if mode == "kernel":
+                t_len = len(placements)
+                # rank_place_padded buckets the gang axis to pow2 so
+                # fleets of varied gang sizes share one compilation.
+                perm, hops = ssn.dispatch_kernel(
+                    lambda: rp.rank_place_padded(
+                        slot_nodes, order.topo_rank, order.level_segs),
+                    label="rank_place",
+                    validate=lambda r: getattr(
+                        r[0], "shape", (0,))[0] == t_len)
+                perm = np.asarray(perm)
+                hops = np.asarray(hops)
+            else:
+                perm, hops = rp.rank_place_np(
+                    slot_nodes, order.topo_rank, order.level_segs)
+            mean = float(hops.mean()) if hops.size else 0.0
+            sp.set(mean_hop=round(mean, 3))
+        METRICS.inc("rank_place_assignments_total", mode=mode)
+        METRICS.set_gauge("rank_place_mean_hop", mean)
+        by_rank = sorted(range(len(chunk)), key=lambda i: chunk[i].rank)
+        return [(chunk[by_rank[k]], placements[int(perm[k])][1],
+                 placements[int(perm[k])][2])
+                for k in range(len(placements))]
 
     # -- the extra-score extension point -----------------------------------
     def extra_scores(self, tasks):

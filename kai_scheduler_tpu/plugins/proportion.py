@@ -130,20 +130,101 @@ class ProportionPlugin(Plugin):
         reads pre-eviction attributes (proportion.go:131-136)."""
         self.sim_queues = {qid: q.clone() for qid, q in self.queues.items()}
 
+    @staticmethod
+    def _qattr_store(cache) -> dict | None:
+        """Persistent per-cache QueueAttributes store (the churn-ring
+        queue-axis trim): attribute objects and gauge last-writes
+        survive across cycles so a 10k-queue fleet rebuilds only DIRTY
+        queues and re-emits only CHANGED gauges.  Single-writer: the
+        scheduler thread inside on_session_open (same contract as the
+        cache's mirrors)."""
+        store = getattr(cache, "_proportion_store", None)
+        if store is None:
+            store = {"attrs": {}, "sig": {}, "usage_sig": {},
+                     "gauges": {}}
+            try:
+                cache._proportion_store = store
+            except Exception:
+                return None
+        return store
+
+    @staticmethod
+    def _queue_sig(q) -> tuple:
+        """Value signature of everything a QueueAttributes derives from
+        the QueueInfo: any change (spec edit, re-parent, children drift,
+        even an in-place quota tweak the per-cycle copy would hide from
+        identity checks) rebuilds the entry."""
+        return (q.parent, q.priority, q.creation_ts, tuple(q.children),
+                q.quota.deserved.tobytes(), q.quota.limit.tobytes(),
+                q.quota.over_quota_weight.tobytes())
+
     def _build_queue_attributes(self, ssn) -> None:
+        from ..utils.metrics import METRICS
         cluster = ssn.cluster
+        # Usage staleness (docs/DEGRADATION.md): a stale snapshot means
+        # the recorder/scraper stopped feeding data — the documented
+        # degraded mode IGNORES usage (zeros, the no-penalty division)
+        # and counts the cycle, instead of trusting decayed-to-zero
+        # values as authoritative history.
+        usage_stale = bool(getattr(ssn.queue_usage, "stale", False))
+        if usage_stale:
+            METRICS.inc("usage_stale_cycles_total")
+        store = self._qattr_store(ssn.cache)
+        attrs = store["attrs"] if store is not None else {}
+        sigs = store["sig"] if store is not None else {}
+        usage_sigs = store["usage_sig"] if store is not None else {}
+        reused = rebuilt = 0
         self.queues = {}
         for qid, q in cluster.queues.items():
-            self.queues[qid] = QueueAttributes(
-                uid=qid, name=q.name, parent=q.parent,
-                children=list(q.children), priority=q.priority,
-                creation_ts=q.creation_ts,
-                deserved=np.asarray(q.quota.deserved, float),
-                limit=np.asarray(q.quota.limit, float),
-                over_quota_weight=np.asarray(q.quota.over_quota_weight,
-                                             float),
-                usage=np.asarray(ssn.queue_usage.get(qid, rs.zeros()),
-                                 float))
+            usage_row = None if usage_stale \
+                else ssn.queue_usage.get(qid)
+            sig = self._queue_sig(q)
+            at = attrs.get(qid)
+            if at is not None and sigs.get(qid) == sig:
+                # Clean queue: reset the per-cycle accumulators in
+                # place instead of re-deriving the whole object (the
+                # 10k-queue churn ring re-paid construction + three
+                # array conversions per queue per cycle).
+                at.allocated[:] = 0.0
+                at.allocated_non_preemptible[:] = 0.0
+                at.request[:] = 0.0
+                usage_sig = None if usage_row is None \
+                    else usage_row.tobytes()
+                if usage_sigs.get(qid) != usage_sig:
+                    at.usage = (rs.zeros() if usage_row is None
+                                else np.asarray(usage_row, float))
+                    usage_sigs[qid] = usage_sig
+                # The reset is a state change: stale DRF sort keys must
+                # not survive it.
+                at.version += 1
+                reused += 1
+            else:
+                at = QueueAttributes(
+                    uid=qid, name=q.name, parent=q.parent,
+                    children=list(q.children), priority=q.priority,
+                    creation_ts=q.creation_ts,
+                    deserved=np.asarray(q.quota.deserved, float).copy(),
+                    limit=np.asarray(q.quota.limit, float).copy(),
+                    over_quota_weight=np.asarray(
+                        q.quota.over_quota_weight, float).copy(),
+                    usage=(rs.zeros() if usage_row is None
+                           else np.asarray(usage_row, float)))
+                attrs[qid] = at
+                sigs[qid] = sig
+                usage_sigs[qid] = None if usage_row is None \
+                    else usage_row.tobytes()
+                rebuilt += 1
+            self.queues[qid] = at
+        if store is not None and len(attrs) > len(self.queues):
+            for gone in set(attrs) - set(self.queues):
+                attrs.pop(gone, None)
+                sigs.pop(gone, None)
+                usage_sigs.pop(gone, None)
+                store["gauges"].pop(gone, None)
+        if reused:
+            METRICS.inc("queue_attrs_reused_total", reused)
+        if rebuilt:
+            METRICS.inc("queue_attrs_rebuilt_total", rebuilt)
         # Roll allocated/non-preemptible/request up the parent chain
         # (proportion.go:347-401).  Pending gpu-memory requests are charged
         # gpu_memory / MinNodeGPUMemory devices rather than a whole GPU.
@@ -309,19 +390,32 @@ class ProportionPlugin(Plugin):
         # attribute stacking above): the number the churn bench's A/B
         # rows and the fleet-budget ceiling gate on.
         ssn.phase_timings["fairshare"] = _time.perf_counter() - t_step
+        store = self._qattr_store(ssn.cache)
+        gauges = store["gauges"] if store is not None else {}
+        deduped = 0
         for qid, i in index.items():
             self.queues[qid].fair_share = fair[i]
             # Queue fair-share/usage gauges (metrics.UpdateQueueFairShare,
-            # resource_division.go:44-90).
+            # resource_division.go:44-90).  Deduped against the per-cache
+            # last-written values: at 10k queues the three unconditional
+            # writes per queue per cycle (label formatting included) were
+            # a named churn-ring bottleneck, while steady-state values
+            # barely move.
             q = self.queues[qid]
-            METRICS.set_gauge("queue_fair_share_gpu",
-                              float(q.fair_share[rs.RES_GPU]), queue=qid)
-            METRICS.set_gauge(
-                "queue_fair_share_cpu_cores",
-                float(q.fair_share[rs.RES_CPU]) / rs.MILLI_CPU_TO_CORES,
-                queue=qid)
-            METRICS.set_gauge("queue_allocated_gpus",
-                              float(q.allocated[rs.RES_GPU]), queue=qid)
+            vals = (float(q.fair_share[rs.RES_GPU]),
+                    float(q.fair_share[rs.RES_CPU])
+                    / rs.MILLI_CPU_TO_CORES,
+                    float(q.allocated[rs.RES_GPU]))
+            if gauges.get(qid) == vals:
+                deduped += 1
+                continue
+            gauges[qid] = vals
+            METRICS.set_gauge("queue_fair_share_gpu", vals[0], queue=qid)
+            METRICS.set_gauge("queue_fair_share_cpu_cores", vals[1],
+                              queue=qid)
+            METRICS.set_gauge("queue_allocated_gpus", vals[2], queue=qid)
+        if deduped:
+            METRICS.inc("queue_gauge_writes_deduped_total", deduped)
 
     # -- event handlers (proportion.go:446-476) ----------------------------
     def on_allocate(self, task) -> None:

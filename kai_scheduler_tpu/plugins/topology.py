@@ -19,3 +19,9 @@ class TopologyPlugin(Plugin):
         self._topo = TopologySession(ssn)
         ssn.subset_nodes_fns.append(self._topo.subset_nodes)
         ssn.extra_score_fns.append(self._topo.extra_scores)
+        # Rank-aware gang placement (ops/rankplace.py): reorder an
+        # interchangeable chunk's placements so consecutive MPI ranks
+        # land topology-adjacent.  A pure post-fill permutation — the
+        # fill plan's node multiset (and thus every capacity/feasibility
+        # verdict) is untouched.
+        ssn.rank_assign_fns.append(self._topo.assign_ranks)

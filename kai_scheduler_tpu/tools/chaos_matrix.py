@@ -69,6 +69,15 @@ PIPELINE_TESTS = ["tests/test_pipeline_cycle.py"]
 # ClusterInfo equivalence, pack bit-identity, and identical allocate
 # placements are asserted at every step.
 COLUMNAR_TESTS = ["tests/test_columnar_store.py"]
+# --timeaware: the rank & time subsystem rings — each seed regenerates
+# the randomized topologies/gangs of the rank-placement parity ring
+# (kernel-vs-host bit-identity, hop optimality, parse conventions) and
+# re-runs the usage-tensor decay properties (kernel/numpy parity,
+# half-life exactness, window cap, restart restore, stale->degraded)
+# plus the full-System timeaware trace (over-user yields on bound-pod
+# counts, single-dispatch pin, restart survival).
+TIMEAWARE_TESTS = ["tests/test_rankplace.py", "tests/test_usagedb.py",
+                   "tests/test_timeaware.py"]
 # --wire: the daemon-scale apiserver transport ring — pagination
 # cursors under concurrent mutation, 410-GONE continue recovery,
 # field-selector parity across dialects, per-item bulk outcomes (fenced
@@ -174,6 +183,13 @@ def main(argv=None) -> int:
                          "columnar-vs-object equivalence, pack "
                          "bit-identity, and identical allocate "
                          "placements are asserted")
+    ap.add_argument("--timeaware", action="store_true",
+                    help="timeaware mode: sweep the rank & time "
+                         f"subsystem rings ({TIMEAWARE_TESTS}) — each "
+                         "seed regenerates the randomized rank-"
+                         "placement instances and re-proves kernel/"
+                         "host bit-identity, decay-math parity, and "
+                         "the over-user-yields trace")
     ap.add_argument("--wire", action="store_true",
                     help="wire mode: sweep the apiserver transport ring "
                          f"({WIRE_TESTS}) — pagination under mutation, "
@@ -214,8 +230,8 @@ def main(argv=None) -> int:
         tests = args.tests
     else:
         # Modes compose: --arena --latency --incremental --fused
-        # --shards --pipeline --columnar --wire sweeps every selected
-        # suite per seed.
+        # --shards --pipeline --columnar --timeaware --wire sweeps
+        # every selected suite per seed.
         tests = (ARENA_TESTS if args.arena else []) + \
             (LATENCY_TESTS if args.latency else []) + \
             (INCREMENTAL_TESTS if args.incremental else []) + \
@@ -223,6 +239,7 @@ def main(argv=None) -> int:
             (SHARDS_TESTS if args.shards else []) + \
             (PIPELINE_TESTS if args.pipeline else []) + \
             (COLUMNAR_TESTS if args.columnar else []) + \
+            (TIMEAWARE_TESTS if args.timeaware else []) + \
             (WIRE_TESTS if args.wire else [])
         if not tests:
             tests = DEFAULT_TESTS

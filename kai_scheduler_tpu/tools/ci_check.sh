@@ -66,6 +66,8 @@ python -m kai_scheduler_tpu.tools.chaos_matrix --columnar --dry-run \
     || fail=1
 python -m kai_scheduler_tpu.tools.chaos_matrix --wire --dry-run \
     || fail=1
+python -m kai_scheduler_tpu.tools.chaos_matrix --timeaware --dry-run \
+    || fail=1
 python -m kai_scheduler_tpu.tools.chaos_matrix --races --dry-run \
     || fail=1
 

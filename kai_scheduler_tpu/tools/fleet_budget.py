@@ -28,7 +28,14 @@ result against ``docs/scale-tests/fleet_budget.json``:
   trips this even on a fast machine), the prep cache must actually
   reuse (``min_prep_reuse`` hits of ``fairshare_prep_reuse_total``),
   and ``fairshare_dispatch_total`` must show exactly ONE dispatch per
-  division — the structural single-dispatch guarantee of DESIGN §2b.
+  division — the structural single-dispatch guarantee of DESIGN §2b;
+- **rank & time gates (DESIGN §13)**: the rank-assignment kernel is
+  re-measured at ``rankplace_shape`` (median under
+  ``max_rankplace_ms``, host-fallback parity asserted), and the
+  usage-decay fold at ``usage_shape`` must count EXACTLY one
+  ``usage_decay_dispatch_total`` per recorded cycle — a silent
+  per-queue host loop multiplies it by Q while every wall clock still
+  passes — with a fold-median ceiling on top.
 
 Usage (ci_check.sh runs it):
 
@@ -109,6 +116,53 @@ def main(argv=None) -> int:
     fsres = bench.fairshare_microbench(n_queues=fshape["queues"],
                                        bands=fshape.get("bands", 1),
                                        iters=fs_iters)
+
+    # Rank-placement micro-measurement (ops/rankplace.py): the
+    # assignment kernel alone at the committed gang/topology shape,
+    # warm median over 5 runs.
+    from kai_scheduler_tpu.ops import rankplace as rp
+    rshape = budget.get("rankplace_shape",
+                        {"nodes": 4096, "gang": 512, "levels": 3})
+    rng = np.random.default_rng(0)
+    r_nodes, r_gang = rshape["nodes"], rshape["gang"]
+    r_levels = rshape.get("levels", 3)
+    topo_rank = rng.permutation(r_nodes).astype(np.int32)
+    level_segs = rng.integers(
+        0, max(2, r_nodes // 8), (r_levels, r_nodes)).astype(np.int32)
+    slots = rng.integers(0, r_nodes, r_gang).astype(np.int32)
+    # kailint: disable=KAI004 — budget micro-bench, no Session to dispatch through
+    rp.rank_place_padded(slots, topo_rank, level_segs)  # warm/compile
+    ts = []
+    for _ in range(5):
+        t0 = _time.perf_counter()
+        # kailint: disable=KAI004 — budget micro-bench, no Session to dispatch through
+        perm, _hops = rp.rank_place_padded(slots, topo_rank, level_segs)
+        np.asarray(perm)
+        ts.append((_time.perf_counter() - t0) * 1000.0)
+    rankplace_ms = float(np.median(ts))
+    # Host-fallback parity doubles as the budget's sanity check.
+    p_np, _h = rp.rank_place_np(slots, topo_rank, level_segs)
+    rank_parity = bool(np.array_equal(p_np, np.asarray(perm)))
+
+    # Usage-decay structural gate (ops/usage.py + utils/usagedb.py):
+    # fold N cycles of Q-queue samples and PIN the dispatch count to
+    # one per cycle — a silent per-queue host loop multiplies it by Q.
+    from kai_scheduler_tpu.utils.usagedb import (InMemoryUsageDB,
+                                                 UsageParams)
+    ushape = budget.get("usage_shape", {"queues": 2048, "cycles": 5})
+    udb = InMemoryUsageDB(UsageParams(half_life_period_seconds=600.0))
+    u_alloc = {f"q{i}": rng.uniform(0, 8, 3)
+               for i in range(ushape["queues"])}
+    udb.record_cycle(0.0, u_alloc)  # warm/compile + row growth
+    u0 = METRICS.counters.get("usage_decay_dispatch_total", 0)
+    ts = []
+    for cycle in range(ushape["cycles"]):
+        t0 = _time.perf_counter()
+        udb.record_cycle(60.0 * (cycle + 1), u_alloc)
+        ts.append((_time.perf_counter() - t0) * 1000.0)
+    usage_folds = METRICS.counters.get("usage_decay_dispatch_total",
+                                       0) - u0
+    usage_decay_ms = float(np.median(ts))
 
     # Overlapped-pipeline smoke (DESIGN §10): the SAME fleet shape with
     # the commit executor armed.  min_overlap_ratio is the structural
@@ -202,6 +256,17 @@ def main(argv=None) -> int:
         # by the hierarchy depth.
         ("fairshare_dispatches", fsres["dispatches"],
          "<=", fs_iters + 1),
+        ("rankplace_kernel_median_ms", round(rankplace_ms, 2),
+         "<=", budget.get("max_rankplace_ms", 80)),
+        ("rankplace_kernel_host_parity", int(rank_parity), ">=", 1),
+        # Structural: EXACTLY one jitted decay fold per recorded cycle
+        # (never a per-queue host loop) — pinned from both sides.
+        ("usage_decay_dispatches", usage_folds,
+         "<=", ushape["cycles"]),
+        ("usage_decay_dispatches_floor", usage_folds,
+         ">=", ushape["cycles"]),
+        ("usage_decay_median_ms", round(usage_decay_ms, 2),
+         "<=", budget.get("max_usage_decay_ms", 80)),
         ("columnar_fallbacks", col_fallbacks,
          "<=", budget.get("max_columnar_fallbacks", 0)),
         ("columnar_rows", col_rows,

@@ -80,6 +80,29 @@ def run_scenario(scenario: str, n_nodes: int, seed: int = 0) -> dict:
         for j in spec["jobs"].values():
             j["topology"] = "dc"
             j[level_key] = "rack"
+    elif scenario == "rank-mpi":
+        # Rank-aware MPI gangs (arxiv 2603.22691 / ROADMAP item 4).
+        # Topology interleaves node-name order at MIXED distances
+        # (block alternates per index, racks stride) so the fill plan's
+        # index-ordered node choice hands each gang a set of slots whose
+        # ORDER matters: rank placement must measurably tighten mean
+        # consecutive-rank hop distance vs the rank-oblivious baseline
+        # on the same seed.  Demand is half the cluster so every gang
+        # binds in both variants.
+        for i, n in enumerate(spec["nodes"].values()):
+            n["labels"] = {"block": f"b{i % 2}", "rack": f"r{i % 8}"}
+        spec["topologies"] = {"dc": {"levels": ["block", "rack"]}}
+        gang = 16
+        count = max(1, gpu_capacity // (2 * 2 * gang))
+        rng = np.random.default_rng(seed)
+        queues = list(spec["queues"])
+        for i in range(count):
+            spec["jobs"][f"mpi-{i:05d}"] = {
+                "queue": queues[int(rng.integers(len(queues)))],
+                "min_available": gang,
+                "tasks": [{"gpu": 2, "cpu": "1", "mem": "1Gi",
+                           "rank": r} for r in range(gang)],
+            }
     elif scenario == "reclaim":
         # Fill from one queue, then measure a starved queue reclaiming.
         add_job_wave(spec, gpu_capacity, gpus=1, prefix="hog", seed=seed)
@@ -139,6 +162,23 @@ def run_scenario(scenario: str, n_nodes: int, seed: int = 0) -> dict:
                 single_rack += 1
         result["gangs_placed"] = placed
         result["gangs_single_rack"] = single_rack
+
+    if scenario == "rank-mpi":
+        # Measured rank adjacency, A/B on the same seed: the default run
+        # above is rank-aware; re-run the identical spec rank-oblivious
+        # and compare mean consecutive-rank hop distance.
+        aware_hop, aware_gangs = _gang_mean_hop(cluster, spec)
+        base_cluster = build_cluster(spec)
+        base_ssn = Scheduler(
+            lambda: base_cluster,
+            SchedulerConfig(rank_aware_placement=False)).run_once()
+        base_hop, base_gangs = _gang_mean_hop(base_cluster, spec)
+        result.update({
+            "gangs_placed": aware_gangs,
+            "mean_hop_rank_aware": round(aware_hop, 4),
+            "mean_hop_oblivious": round(base_hop, 4),
+            "pods_bound_oblivious": len(base_ssn.cache.bound),
+        })
 
     if scenario == "reclaim":
         # The fill wave (all in q0) is now allocated; inject a starved
@@ -238,6 +278,30 @@ def run_scenario(scenario: str, n_nodes: int, seed: int = 0) -> dict:
     return result
 
 
+def _gang_mean_hop(cluster, spec: dict) -> tuple[float, int]:
+    """(mean over gangs of mean consecutive-rank hop distance, number
+    of placed ranked gangs) — the scale ring's adjacency metric."""
+    from ..ops import rankplace as rp
+    from ..ops.topology import build_tree
+    node_names = list(cluster.node_order)
+    labels = {n: spec["nodes"][n].get("labels", {}) for n in node_names}
+    levels = list(next(iter(spec["topologies"].values()))["levels"])
+    tree = build_tree("dc", levels, node_names, labels)
+    order = rp.build_topo_order(tree, len(node_names))
+    idx = {n: i for i, n in enumerate(node_names)}
+    hops, gangs = [], 0
+    for pg in cluster.podgroups.values():
+        tasks = [t for t in pg.pods.values()
+                 if t.node_name and t.rank >= 0]
+        if len(tasks) < 2:
+            continue
+        gangs += 1
+        tasks.sort(key=lambda t: t.rank)
+        arr = np.array([idx[t.node_name] for t in tasks], np.int32)
+        hops.append(rp.mean_hop(arr, order))
+    return (float(np.mean(hops)) if hops else 0.0), gangs
+
+
 def run_system_scenario(n_nodes: int, n_pods: int) -> dict:
     """Full-fleet variant: pods flow through admission, grouping,
     scheduling, and binding over the in-memory API (the KWOK ring's
@@ -275,7 +339,7 @@ def main(argv=None):
                     choices=("fill", "whole-gpu", "distributed", "burst",
                              "reclaim", "reclaim-contention",
                              "topology-required", "topology-preferred",
-                             "system-fill"))
+                             "rank-mpi", "system-fill"))
     ap.add_argument("--pods", type=int, default=0,
                     help="pod count for system-fill (default 2x nodes)")
     ap.add_argument("--seed", type=int, default=0)

@@ -5,9 +5,25 @@ cluster over many cycles, recording per-queue allocations into the usage
 DB so the k-value penalty shifts shares over time; emit per-cycle CSV of
 each queue's fair share and allocation.
 
+Two harnesses:
+
+- ``run`` — the original offline loop: one Scheduler over a static
+  ClusterInfo, per-cycle CSV of shares/allocations;
+- ``run_system_trace`` — the e2e ring (the reference's ``timeaware``
+  e2e family): a FULL ``System`` (apiserver, admission, podgrouper,
+  binder, usage tensor) driven over a simulated multi-hour trace with
+  an injected clock.  Phase 1 lets the ``hog`` queue monopolize the
+  cluster for at least one half-life; phase 2 has ``hog`` and
+  ``victim`` contend for every freed slot, counting BOUND PODS per
+  queue — the assertion is on real placements, not on share numbers.
+  Optionally restarts the scheduler mid-trace against the usage
+  checkpoint log (the commit-log pattern, DESIGN §13) to prove the
+  penalty survives the process.
+
 Usage:
   python -m kai_scheduler_tpu.tools.time_fairshare_simulator \
       --cycles 50 --out shares.csv
+  python -m kai_scheduler_tpu.tools.time_fairshare_simulator --e2e
 """
 
 from __future__ import annotations
@@ -80,6 +96,130 @@ def run(cycles: int, period: float = 60.0, k_value: float = 1.0,
     return rows
 
 
+def run_system_trace(phase1_cycles: int = 15, phase2_cycles: int = 20,
+                     period: float = 60.0, half_life: float = 600.0,
+                     nodes: int = 2, gpus_per_node: int = 8,
+                     job_gpus: int = 2, job_lifetime_cycles: int = 2,
+                     usage_log_path: str | None = None,
+                     restart_at: int | None = None,
+                     usage_db: str | None = "memory://") -> dict:
+    """The e2e ``timeaware`` ring: a full System over a simulated trace.
+
+    Phase 1 (``phase1_cycles`` x ``period`` seconds — size it to cover
+    at least one half-life): only ``hog`` submits, saturating the
+    cluster; every job completes (its pod is deleted) after
+    ``job_lifetime_cycles``, so hog keeps re-binding and accrues usage.
+    Phase 2: both queues submit one wave per cycle, demand exceeding
+    the freed capacity; the usage penalty must make the over-user YIELD
+    — counted on bound pods per queue.  ``restart_at`` (a phase-2 cycle
+    index) tears the System down and rebuilds it against
+    ``usage_log_path``, proving the usage tensor survives a restart.
+    ``usage_db=None`` runs the same trace usage-blind (the A/B
+    baseline: both queues then bind roughly equally)."""
+    from ..controllers import System, SystemConfig, make_pod
+    from ..utils.usagedb import UsageParams
+
+    clock = {"now": 0.0}
+    params = UsageParams(half_life_period_seconds=half_life,
+                         window_size_seconds=period
+                         * (phase1_cycles + phase2_cycles) * 4,
+                         staleness_period_seconds=period * 1000)
+
+    def build_system():
+        system = System(SystemConfig(
+            usage_db=usage_db, usage_params=params,
+            usage_log_path=usage_log_path,
+            now_fn=lambda: clock["now"]))
+        for i in range(nodes):
+            system.api.create({
+                "kind": "Node", "metadata": {"name": f"n{i}"},
+                "spec": {},
+                "status": {"allocatable": {
+                    "cpu": "64", "memory": "512Gi",
+                    "nvidia.com/gpu": gpus_per_node, "pods": 110}}})
+        for q in ("hog", "victim"):
+            system.api.create({"kind": "Queue", "metadata": {"name": q},
+                               "spec": {"deserved": {"gpu": 2}}})
+        return system
+
+    system = build_system()
+    capacity_jobs = nodes * gpus_per_node // job_gpus
+    seq = {"n": 0}
+    live: list[tuple[int, str, str]] = []   # (bound_cycle, name, queue)
+    bound_seen: set[str] = set()
+    counts = {"hog": 0, "victim": 0}
+    rows = []
+
+    def submit(queue: str, n: int) -> None:
+        for _ in range(n):
+            seq["n"] += 1
+            system.api.create(make_pod(f"job-{seq['n']:06d}",
+                                       queue=queue, gpu=job_gpus))
+
+    def reap_and_count(cycle: int, phase: str) -> None:
+        for pod in system.api.list("Pod"):
+            name = pod["metadata"]["name"]
+            node = pod["spec"].get("nodeName")
+            if node and name not in bound_seen:
+                bound_seen.add(name)
+                queue = pod["metadata"]["labels"].get(
+                    "kai.scheduler/queue", "")
+                live.append((cycle, name, queue))
+                if phase == "contend":
+                    counts[queue] += 1
+        done = [(c, n, q) for (c, n, q) in live
+                if cycle - c >= job_lifetime_cycles]
+        for c, name, q in done:
+            live.remove((c, name, q))
+            try:
+                system.api.delete("Pod", name)
+            except Exception:
+                pass
+
+    cycle = 0
+    for _ in range(phase1_cycles):
+        submit("hog", max(0, capacity_jobs + 2 - sum(
+            1 for p in system.api.list("Pod")
+            if not p["spec"].get("nodeName"))))
+        system.run_cycle()
+        reap_and_count(cycle, "hog")
+        clock["now"] += period
+        cycle += 1
+
+    usage_mid = dict(system.usage_db.queue_usage(clock["now"])) \
+        if system.usage_db else {}
+    restarted = False
+    wave = max(2, capacity_jobs // 2)
+    for i in range(phase2_cycles):
+        if restart_at is not None and i == restart_at:
+            # Scheduler restart mid-trace: the usage checkpoint log is
+            # the ONLY state carried over.
+            system.stop_pipeline()
+            system = build_system()
+            live.clear()
+            restarted = True
+        submit("hog", wave)
+        submit("victim", wave)
+        system.run_cycle()
+        reap_and_count(cycle, "contend")
+        rows.append({"cycle": cycle, "hog_bound": counts["hog"],
+                     "victim_bound": counts["victim"]})
+        clock["now"] += period
+        cycle += 1
+
+    usage_end = dict(system.usage_db.queue_usage(clock["now"])) \
+        if system.usage_db else {}
+    return {
+        "hog_bound": counts["hog"], "victim_bound": counts["victim"],
+        "usage_mid": {q: v.tolist() for q, v in usage_mid.items()},
+        "usage_end": {q: v.tolist() for q, v in usage_end.items()},
+        "restarted": restarted,
+        "capacity_jobs": capacity_jobs,
+        "rows": rows,
+        "system": system,
+    }
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--cycles", type=int, default=20)
@@ -87,7 +227,18 @@ def main(argv=None):
     ap.add_argument("--k-value", type=float, default=1.0)
     ap.add_argument("--half-life", type=float, default=600.0)
     ap.add_argument("--out", default="-")
+    ap.add_argument("--e2e", action="store_true",
+                    help="run the full-System timeaware trace ring "
+                         "instead of the offline share loop")
     args = ap.parse_args(argv)
+
+    if args.e2e:
+        import json
+        res = run_system_trace(period=args.period,
+                               half_life=args.half_life)
+        res.pop("system", None)
+        print(json.dumps(res, indent=2))
+        return
 
     out = sys.stdout if args.out == "-" else open(args.out, "w", newline="")
     writer = csv.DictWriter(out, fieldnames=[

@@ -62,6 +62,7 @@ def build_cluster(spec: dict) -> ClusterInfo:
                  "preferred_topology_level", "pod_sets", "tasks",
                  "last_start_ts", "staleness_grace_seconds"}
     _TASK_KEYS = {"uid", "name", "subgroup", "status", "node", "selector",
+                  "rank",
                   "tolerations", "cpu", "mem", "gpu", "gpu_fraction",
                   "gpu_memory", "mig", "gpu_group", "nominated",
                   "resource_claims", "affinity", "anti_affinity",
@@ -113,6 +114,7 @@ def build_cluster(spec: dict) -> ClusterInfo:
                 subgroup=t.get("subgroup", "default"),
                 status=PodStatus[t.get("status", "PENDING").upper()],
                 node_name=t.get("node", ""),
+                rank=int(t.get("rank", -1)),
                 node_selector=t.get("selector", {}),
                 tolerations=set(t.get("tolerations", ())),
                 res_req=ResourceRequirements.from_spec(

@@ -138,18 +138,29 @@ class PrometheusUsageClient(UsageLister):
         return usage
 
     # -- UsageLister surface ----------------------------------------------
-    def queue_usage(self, now: float) -> dict:
+    def queue_usage(self, now: float):
+        from .usagedb import UsageSnapshot
+        data = None
         if (self._cached is not None and self.last_fetch_ts is not None
                 and now - self.last_fetch_ts < self.fetch_interval):
-            return self._cached
-        try:
-            self._cached = self.fetch()
-            self.last_fetch_ts = now
-        except Exception as exc:  # keep serving the cache until stale
-            LOG.warning("prometheus usage fetch failed: %s", exc)
-            if self._cached is None or self.is_stale(now):
-                return {}
-        return self._cached or {}
+            data = self._cached
+        else:
+            try:
+                self._cached = self.fetch()
+                self.last_fetch_ts = now
+            except Exception as exc:  # keep serving the cache until stale
+                LOG.warning("prometheus usage fetch failed: %s", exc)
+                if self._cached is None or self.is_stale(now):
+                    data = {}
+            if data is None:
+                data = self._cached or {}
+        # Staleness rides the snapshot: the proportion plugin must see a
+        # scrape outage as "ignore usage" (degraded mode,
+        # docs/DEGRADATION.md), never as authoritative zeros.
+        snap = UsageSnapshot(data)
+        snap.ts = now
+        snap.stale = self.is_stale(now)
+        return snap
 
     def is_stale(self, now: float) -> bool:
         return (self.last_fetch_ts is None

@@ -7,20 +7,39 @@ api/interface.go:44-49): the scheduler fetches per-queue normalized
 historical usage each cycle and feeds it into the fair-share usage penalty
 ``w' = max(0, W' + k(W' - U'))``.
 
-The in-memory implementation doubles as the "fake" client and as the
-record-keeping engine for the time-based simulator; a metrics-backed
-client can plug in through the same resolver.
+The in-memory implementation is TENSOR-BACKED (DESIGN §13): the whole
+fleet's history lives as one ``[Q, R]`` decayed integral plus a decayed
+weight scalar, folded once per cycle by the jitted
+``ops/usage.usage_decay_kernel`` (single dispatch — the per-cycle cost
+the queue-forest kernel's argument demands, structurally pinned by
+tools/fleet_budget.py).  ``queue_usage`` then serves the
+exponentially-weighted average allocation per queue, normalized by
+cluster capacity when known — no per-sample host loop anywhere.
+
+Persistence follows the commit-log pattern (utils/commitlog.py wire
+format): ``UsageLog`` appends one CRC-guarded checkpoint line per fold
+and compacts atomically, so a scheduler restart replays the last valid
+checkpoint and the usage penalty survives the process
+(``attach_log``/``restore`` — asserted by tests/test_timeaware.py).
+
+Staleness: ``is_stale`` tracks the last RECORD (data ingest), not the
+last fetch — a wedged recorder must trip the proportion plugin's
+degraded mode (ignore usage, count ``usage_stale_cycles_total``,
+docs/DEGRADATION.md) instead of silently serving decayed-to-zero
+values forever.
 """
 
 from __future__ import annotations
 
 import math
-from collections import defaultdict, deque
+import os
 from dataclasses import dataclass
 
 import numpy as np
 
 from ..api import resources as rs
+from .logging import LOG
+from .metrics import METRICS
 
 
 @dataclass
@@ -32,10 +51,18 @@ class UsageParams:
     staleness_period_seconds: float = 300.0
 
 
-class UsageLister:
-    """Interface: queue_usage(now) -> {queue: [NUM_RES] normalized}."""
+class UsageSnapshot(dict):
+    """``queue_usage`` result: {queue: [R] normalized usage} plus the
+    staleness verdict the proportion plugin keys its degraded mode on."""
 
-    def queue_usage(self, now: float) -> dict:
+    stale: bool = False
+    ts: float = 0.0
+
+
+class UsageLister:
+    """Interface: queue_usage(now) -> UsageSnapshot."""
+
+    def queue_usage(self, now: float) -> UsageSnapshot:
         raise NotImplementedError
 
     def record(self, now: float, queue: str, allocated: np.ndarray,
@@ -43,62 +70,280 @@ class UsageLister:
         """Ingest one cycle's allocation sample.  No-op for clients whose
         history lives elsewhere (Prometheus scrapes the gauges itself)."""
 
+    def record_cycle(self, now: float, allocations: dict,
+                     duration: float = 1.0) -> None:
+        """Ingest one WHOLE cycle's {queue: [R] allocated} and fold it —
+        the one-dispatch fast path ``System._record_decisions`` uses."""
+        for queue, vec in allocations.items():
+            self.record(now, queue, vec, duration)
+
+
+class UsageLog:
+    """Checkpoint journal for the usage tensor — the commit-log pattern
+    (utils/commitlog.py wire format: ``<crc32 hex> <canonical JSON>``
+    per line, torn-tail safe, atomic compaction).
+
+    Each fold appends one full-state checkpoint; ``load`` trusts the
+    LAST valid line (a torn tail from a crash mid-append falls back to
+    the previous checkpoint).  The file compacts — rewrite with only
+    the latest state via tmp+fsync+rename — every ``compact_every``
+    appends, bounding it at O(one checkpoint)."""
+
+    def __init__(self, path: str, compact_every: int = 64,
+                 fsync: bool = True):
+        self.path = path
+        self.compact_every = compact_every
+        self.fsync = fsync
+        self._appends = 0
+
+    def append(self, state: dict) -> None:
+        from .commitlog import _encode
+        self._appends += 1
+        if self._appends >= self.compact_every:
+            self.compact(state)
+            return
+        with open(self.path, "ab") as f:
+            f.write(_encode(state))
+            f.flush()
+            if self.fsync:
+                os.fsync(f.fileno())
+
+    def compact(self, state: dict) -> None:
+        from .commitlog import _encode
+        tmp = self.path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(_encode(state))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+        self._appends = 0
+
+    def load(self) -> dict | None:
+        from .commitlog import _decode
+        try:
+            with open(self.path, "rb") as f:
+                lines = f.readlines()
+        except OSError:
+            return None
+        state = None
+        for line in lines:
+            rec = _decode(line)
+            if rec is None:
+                break  # torn tail: trust everything before it
+            state = rec
+        return state
+
 
 class InMemoryUsageDB(UsageLister):
-    """Sliding/tumbling-window usage with half-life decay.
+    """Tensor-backed sliding-window usage with half-life decay.
 
-    record(now, queue, allocated_vec) each cycle; queue_usage(now) returns
-    usage normalized by cluster capacity (the division algorithm expects
-    U' in capacity units — resource_division.go:242).
+    ``record``/``record_cycle`` buffer one cycle's allocation samples;
+    the fold (lazy, at the next fetch or explicit ``record_cycle``)
+    applies the half-life factor to the standing integral and adds the
+    sample — ONE jitted dispatch over a pow2-padded ``[Q, R]`` tensor
+    (``usage_decay_dispatch_total`` counts folds; shape buckets keep
+    recompiles to queue-set growth only).  ``queue_usage(now)`` returns
+    usage normalized by the decayed weight (the exponentially-weighted
+    average allocation — decay-invariant between samples, exactly like
+    the per-sample weighted average it replaces) and by cluster
+    capacity (the division algorithm expects U' in capacity units —
+    resource_division.go:242).
     """
 
     def __init__(self, params: UsageParams | None = None,
                  cluster_capacity: np.ndarray | None = None):
         self.params = params or UsageParams()
         self.cluster_capacity = cluster_capacity
-        self._samples: dict[str, deque] = defaultdict(deque)  # (t, vec)
+        self._qids: list[str] = []
+        self._qindex: dict[str, int] = {}
+        cap = 8
+        self._usage = np.zeros((cap, rs.NUM_RES))  # decayed integral
+        self._seen = np.full(cap, -np.inf)         # per-queue last sample ts
+        self._weight = 0.0                         # decayed duration sum
+        self._state_ts: float | None = None        # decay reference time
+        self.last_record_ts: float | None = None
         self.last_fetch_ts: float | None = None
+        self._pending: dict[str, np.ndarray] = {}
+        self._pending_ts: float | None = None
+        self._pending_duration = 1.0
+        self._log: UsageLog | None = None
+
+    # -- maintenance -------------------------------------------------------
+    def _row(self, queue: str) -> int:
+        i = self._qindex.get(queue)
+        if i is None:
+            i = len(self._qids)
+            if i >= self._usage.shape[0]:
+                cap = self._usage.shape[0] * 2
+                usage = np.zeros((cap, self._usage.shape[1]))
+                usage[:i] = self._usage
+                seen = np.full(cap, -np.inf)
+                seen[:i] = self._seen
+                self._usage, self._seen = usage, seen
+            self._qindex[queue] = i
+            self._qids.append(queue)
+        return i
 
     def record(self, now: float, queue: str, allocated: np.ndarray,
                duration: float = 1.0) -> None:
-        self._samples[queue].append((now, allocated.copy() * duration))
+        if self._pending and self._pending_ts is not None \
+                and now != self._pending_ts:
+            # A new timestamp closes the buffered cycle: fold it so the
+            # decay sees each cycle's samples at their own age.
+            self._flush()
+        vec = np.asarray(allocated, float) * duration
+        prev = self._pending.get(queue)
+        self._pending[queue] = vec if prev is None else prev + vec
+        self._pending_ts = now
+        self._pending_duration = duration
 
-    def _decay(self, age: float) -> float:
+    def record_cycle(self, now: float, allocations: dict,
+                     duration: float = 1.0) -> None:
+        for queue, vec in allocations.items():
+            self.record(now, queue, vec, duration)
+        self._flush()
+
+    def _decay_factor(self, dt: float) -> float:
         hl = self.params.half_life_period_seconds
-        if not hl:
+        if not hl or dt <= 0:
             return 1.0
-        return 0.5 ** (age / hl)
+        return 0.5 ** (dt / hl)
 
-    def queue_usage(self, now: float) -> dict:
-        self.last_fetch_ts = now
-        out = {}
+    def _window_start(self, now: float) -> float:
         window = self.params.window_size_seconds
         if self.params.window_type == "tumbling":
-            window_start = math.floor(now / window) * window
-        else:
-            window_start = now - window
-        for queue, samples in self._samples.items():
-            while samples and samples[0][0] < window_start:
-                samples.popleft()
-            total = rs.zeros()
-            weight_total = 0.0
-            for t, vec in samples:
-                w = self._decay(now - t)
-                total += vec * w
-                weight_total += w
-            if weight_total > 0:
-                total = total / weight_total
-            if self.cluster_capacity is not None:
-                cap = np.where(self.cluster_capacity > 0,
-                               self.cluster_capacity, 1.0)
-                total = total / cap
-            out[queue] = total
+            return math.floor(now / window) * window
+        return now - window
+
+    def _flush(self) -> None:
+        """Fold the buffered cycle sample into the standing tensor —
+        the subsystem's ONE device dispatch per cycle."""
+        if not self._pending:
+            return
+        now = self._pending_ts
+        for queue in self._pending:
+            self._row(queue)
+        alloc = np.zeros_like(self._usage)
+        for queue, vec in self._pending.items():
+            alloc[self._qindex[queue], :vec.shape[0]] = vec
+        d = self._decay_factor(now - self._state_ts
+                               if self._state_ts is not None else 0.0)
+        # Queues whose last sample already fell out of the window restart
+        # from zero (the tensor analog of the sample-deque popleft).
+        window_start = self._window_start(now)
+        keep = self._seen >= window_start
+        from ..ops.usage import usage_decay_kernel
+        from .deviceguard import device_guard
+        import jax.numpy as jnp
+        usage = self._usage
+
+        METRICS.inc("usage_decay_dispatch_total")
+        # Guarded like every device dispatch (watchdog/breaker/CPU
+        # fallback); no Session exists at the operator layer, so the
+        # thunk goes straight to the guard.
+        self._usage = np.asarray(device_guard().call(
+            lambda: usage_decay_kernel(
+                jnp.asarray(usage), jnp.asarray(alloc),
+                jnp.asarray(keep), float(d)),
+            label="usage_decay"))
+        self._weight = self._weight * d + self._pending_duration
+        for queue in self._pending:
+            self._seen[self._qindex[queue]] = now
+        self._state_ts = now
+        self.last_record_ts = now
+        self._pending = {}
+        self._pending_ts = None
+        if self._log is not None:
+            try:
+                self._log.append(self._state_dict())
+            except OSError as exc:
+                LOG.warning("usage log append failed: %s", exc)
+
+    # -- persistence (the commit-log pattern) ------------------------------
+    def _state_dict(self) -> dict:
+        q = len(self._qids)
+        return {
+            "kind": "usage-checkpoint",
+            "state_ts": self._state_ts,
+            "last_record_ts": self.last_record_ts,
+            "weight": self._weight,
+            # The normalizer persists WITH the integral: a restart
+            # within the staleness budget serves the restored usage on
+            # its first fetch, before any cycle refreshes capacity —
+            # un-normalized raw units there would zero every queue's
+            # over-quota share for that cycle.
+            "capacity": (None if self.cluster_capacity is None
+                         else np.asarray(self.cluster_capacity,
+                                         float).tolist()),
+            "queues": {qid: {"u": self._usage[i].tolist(),
+                             "seen": (None if np.isinf(self._seen[i])
+                                      else float(self._seen[i]))}
+                       for qid, i in self._qindex.items() if i < q},
+        }
+
+    def _restore(self, state: dict) -> None:
+        queues = state.get("queues") or {}
+        for qid, ent in queues.items():
+            i = self._row(qid)
+            u = np.asarray(ent.get("u", ()), float)
+            self._usage[i, :u.shape[0]] = u
+            seen = ent.get("seen")
+            self._seen[i] = -np.inf if seen is None else float(seen)
+        self._weight = float(state.get("weight") or 0.0)
+        self._state_ts = state.get("state_ts")
+        self.last_record_ts = state.get("last_record_ts")
+        cap = state.get("capacity")
+        if cap is not None and self.cluster_capacity is None:
+            self.cluster_capacity = np.asarray(cap, float)
+
+    def attach_log(self, path: str, fsync: bool = True) -> bool:
+        """Arm checkpoint persistence at ``path``; restores the last
+        valid checkpoint first.  Returns True when state was restored."""
+        self._log = UsageLog(path, fsync=fsync)
+        state = self._log.load()
+        if state:
+            self._restore(state)
+            METRICS.inc("usage_restore_total")
+            return True
+        return False
+
+    # -- UsageLister surface ----------------------------------------------
+    def queue_usage(self, now: float) -> UsageSnapshot:
+        self._flush()
+        self.last_fetch_ts = now
+        out = UsageSnapshot()
+        out.ts = now
+        out.stale = self.is_stale(now)
+        q = len(self._qids)
+        if q == 0:
+            return out
+        # The exponentially-weighted average is decay-invariant between
+        # samples ((u*d)/(w*d) == u/w), so no fetch-time dispatch is
+        # needed — only the window mask re-evaluates against ``now``.
+        window_start = self._window_start(now)
+        inside = self._seen[:q] >= window_start
+        w = self._weight if self._weight > 0 else 1.0
+        vals = self._usage[:q] / w
+        if self.cluster_capacity is not None:
+            cap = np.where(self.cluster_capacity > 0,
+                           self.cluster_capacity, 1.0)
+            vals = vals / cap
+        for qid, i in self._qindex.items():
+            if i >= q:
+                continue
+            out[qid] = vals[i] if inside[i] else np.zeros_like(vals[i])
         return out
 
     def is_stale(self, now: float) -> bool:
-        return (self.last_fetch_ts is not None
-                and now - self.last_fetch_ts
-                > self.params.staleness_period_seconds)
+        """Data-ingest staleness: the recorder stopped feeding samples.
+        (The old fetch-based check could never trip for the in-memory
+        store — queue_usage itself refreshed the timestamp it compared
+        against, silently serving decayed-to-zero values instead of
+        tripping the documented degraded mode.)"""
+        last = self.last_record_ts if self._pending_ts is None \
+            else self._pending_ts
+        return (last is not None
+                and now - last > self.params.staleness_period_seconds)
 
 
 def resolve_usage_client(spec: str | None,

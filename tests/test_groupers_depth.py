@@ -120,6 +120,108 @@ class TestGrove:
         assert meta.min_member == 5
 
 
+class TestKubeflowExtendedFamily:
+    def test_mxjob_all_roles_gang(self):
+        meta = group_workload(owner("kubeflow.org", "MXJob", {
+            "mxReplicaSpecs": {"Scheduler": {"replicas": 1},
+                               "Server": {"replicas": 2},
+                               "Worker": {"replicas": 4}}}))
+        assert meta.min_member == 7
+        assert {(ps.name, ps.min_available) for ps in meta.pod_sets} == {
+            ("scheduler", 1), ("server", 2), ("worker", 4)}
+
+    def test_paddlejob_min_available_override(self):
+        meta = group_workload(owner("kubeflow.org", "PaddleJob", {
+            "paddleReplicaSpecs": {"Worker": {"replicas": 8}},
+            "runPolicy": {"schedulingPolicy": {"minAvailable": 4}}}))
+        assert meta.min_member == 4
+        assert meta.pod_sets == []
+
+
+class TestVolcanoJob:
+    def test_tasks_gang_with_podsets(self):
+        meta = group_workload(owner("batch.volcano.sh", "Job", {
+            "tasks": [{"name": "master", "replicas": 1},
+                      {"name": "worker", "replicas": 7}]}))
+        assert meta.min_member == 8
+        assert {(ps.name, ps.min_available) for ps in meta.pod_sets} == {
+            ("master", 1), ("worker", 7)}
+
+    def test_explicit_min_available_wins(self):
+        meta = group_workload(owner("batch.volcano.sh", "Job", {
+            "minAvailable": 3,
+            "tasks": [{"name": "worker", "replicas": 7}]}))
+        assert meta.min_member == 3
+        assert meta.pod_sets == []
+
+
+class TestFlinkDeployment:
+    def test_jobmanager_plus_taskmanagers_gang(self):
+        meta = group_workload(owner("flink.apache.org",
+                                    "FlinkDeployment", {
+                                        "jobManager": {"replicas": 1},
+                                        "taskManager": {"replicas": 5}}))
+        assert meta.min_member == 6
+        assert {(ps.name, ps.min_available) for ps in meta.pod_sets} == {
+            ("jobmanager", 1), ("taskmanager", 5)}
+        # Streaming pipeline: inference class, never preempted by train.
+        assert meta.priority_class == "inference"
+        assert not meta.preemptible
+
+    def test_defaults_single_of_each(self):
+        meta = group_workload(owner("flink.apache.org",
+                                    "FlinkDeployment", {}))
+        assert meta.min_member == 2
+
+
+class TestAppWrapper:
+    def test_components_pod_sets_gang(self):
+        meta = group_workload(owner("workload.codeflare.dev",
+                                    "AppWrapper", {
+            "components": [
+                {"podSets": [{"name": "head", "replicas": 1},
+                             {"name": "workers", "replicas": 4}]},
+                {"podSets": [{"replicas": 2}]},
+            ]}))
+        assert meta.min_member == 7
+        names = {(ps.name, ps.min_available) for ps in meta.pod_sets}
+        assert ("head", 1) in names and ("workers", 4) in names
+
+    def test_component_without_podsets_counts_one(self):
+        meta = group_workload(owner("workload.codeflare.dev",
+                                    "AppWrapper",
+                                    {"components": [{}, {}]}))
+        assert meta.min_member == 2
+
+
+class TestKServe:
+    def test_inference_service_class(self):
+        meta = group_workload(owner("serving.kserve.io",
+                                    "InferenceService"))
+        assert meta.priority_class == "inference"
+        assert not meta.preemptible
+        assert meta.min_member == 1
+
+
+class TestBatchableSignatures:
+    def test_new_kinds_are_owner_batchable(self):
+        """The new kinds derive metadata from _base's pod pair only, so
+        the owner-coalesced drain derives one PodGroup per owner batch
+        (grouper_pod_signature contract)."""
+        from kai_scheduler_tpu.models.groupers import (
+            grouper_pod_signature, resolve_grouper)
+        pod = make_pod("w-0", queue="team-a")
+        for gvk in (("batch.volcano.sh/v1alpha1", "Job"),
+                    ("flink.apache.org/v1beta1", "FlinkDeployment"),
+                    ("workload.codeflare.dev/v1beta2", "AppWrapper"),
+                    ("kubeflow.org/v1", "MXJob"),
+                    ("kubeflow.org/v1", "PaddleJob"),
+                    ("serving.kserve.io/v1beta1", "InferenceService")):
+            grouper = resolve_grouper(*gvk)
+            sig = grouper_pod_signature(grouper, pod)
+            assert sig == ("team-a", None), gvk
+
+
 class TestWorkloadControllers:
     def test_deployment_group_per_pod(self):
         pod = make_pod("web-abc", owner=owner_ref("Deployment", "web"))

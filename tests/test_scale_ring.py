@@ -35,10 +35,16 @@ N_NODES = 400
 # 0.9-1.9s at the SEED commit (results.jsonl rows + a seed re-measure of
 # 1.726s), so the old 1.0 ceiling tripped on machine speed, not
 # regressions; 3.0 still fails a >~2.5x slowdown of this host's median.
+# reclaim recalibrated @PR14: this host measures 2.9-5.5s for the SAME
+# code depending on co-located load (an A/B bisect against the previous
+# commit read 2.92 vs 3.14s — parity), and the sandboxed kernel reports
+# loadavg 0.00 regardless, so the load-aware scaling below can never
+# absorb contention here; 9.0 still fails a ~3x regression of the
+# quiet-host ~3s median.
 CEILINGS_S = {"fill": 10.0, "whole-gpu": 8.0, "distributed": 9.0,
-              "burst": 18.0, "burst-steady": 3.0, "reclaim": 4.0,
+              "burst": 18.0, "burst-steady": 3.0, "reclaim": 9.0,
               "reclaim-contention": 15.0, "system-fill": 8.0,
-              "topology": 15.0}
+              "topology": 15.0, "rank-mpi": 15.0}
 
 
 def _ceiling(key: str) -> float:
@@ -153,6 +159,19 @@ class TestScaleRing:
         # Preferred is advisory: most gangs should still pack one rack.
         assert r["gangs_single_rack"] >= r["gangs_placed"] * 0.5
         assert r["first_cycle_s"] < _ceiling("topology")
+
+    def test_rank_mpi_adjacency(self):
+        """Rank-aware MPI gangs (ROADMAP item 4 / arxiv 2603.22691):
+        measured mean consecutive-rank hop distance must beat the
+        rank-oblivious baseline on the same seed, with identical bound
+        counts (the reorder is a pure permutation)."""
+        r = scale_gen.run_scenario("rank-mpi", N_NODES)
+        _record(r)
+        assert r["pods_bound"] == r["jobs"] * 16
+        assert r["pods_bound_oblivious"] == r["pods_bound"]
+        assert r["gangs_placed"] == r["jobs"]
+        assert r["mean_hop_rank_aware"] < r["mean_hop_oblivious"]
+        assert r["first_cycle_s"] < _ceiling("rank-mpi")
 
     def test_system_fill_fleet(self):
         r = scale_gen.run_system_scenario(200, 400)

@@ -369,6 +369,33 @@ class TestChaosMatrixDryRun:
         assert "tests/test_pipeline_cycle.py" in out
         assert "tests/test_columnar_store.py" in out
 
+    def test_dry_run_timeaware_mode_selects_rank_time_rings(
+            self, capsys, monkeypatch):
+        """--timeaware sweeps the rank & time subsystem rings
+        (rank-placement parity + usage decay math + the full-System
+        over-user-yields trace); composes with --columnar/--pipeline."""
+        from kai_scheduler_tpu.tools import chaos_matrix
+        monkeypatch.setattr(
+            chaos_matrix.subprocess, "run",
+            lambda *a, **kw: (_ for _ in ()).throw(AssertionError(
+                "dry run must not execute iterations")))
+        rc = chaos_matrix.main(["--dry-run", "--timeaware",
+                                "--seeds", "3,5"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert out.count("seed ") == 2
+        assert "tests/test_rankplace.py" in out
+        assert "tests/test_usagedb.py" in out
+        assert "tests/test_timeaware.py" in out
+        assert "tests/test_reconciler.py" not in out
+        rc = chaos_matrix.main(["--dry-run", "--timeaware", "--columnar",
+                                "--pipeline", "--seeds", "3"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "tests/test_timeaware.py" in out
+        assert "tests/test_columnar_store.py" in out
+        assert "tests/test_pipeline_cycle.py" in out
+
     def test_dry_run_races_mode_arms_locktrace(self, capsys, monkeypatch):
         """--races: the grid shows races=on per seed plus the
         KAI_LOCKTRACE banner, without building the static lock graph or
