@@ -1203,6 +1203,86 @@ def churn_main(iters: int = 7) -> int:
     return 0
 
 
+def churn_wire_faults_main() -> int:
+    """The churn ring OVER A LYING WIRE (PR 15): the wire churn stream
+    at a reduced shape with the composite ``wire-*`` fault spec armed
+    for the WHOLE run — corrupted watch frames, stalled streams,
+    dropped responses, a throttle storm — measuring what fault
+    tolerance costs in p99 submit→bound.  The row is annotated
+    ``@wire-faults`` (the ``@guard-degraded`` convention): its numbers
+    are the DEGRADED regime's, never comparable to clean churn rows.
+    (The zero-double-bind invariant itself is the chaos ring's job —
+    ``chaos_matrix --wire-faults``; this row records what the
+    self-healing costs.)"""
+    _enable_compile_cache()
+    import jax
+
+    from kai_scheduler_tpu.utils.metrics import METRICS
+
+    backend = jax.default_backend()
+    # The watch-stream + throttle faults: survivable by the CLIENT's
+    # own machinery (reconnect, retry-through-429/503), so the bench
+    # driver needs no fault handling of its own.  The ambiguous-
+    # mutation modes (wire-drop/wire-reset) stay the chaos ring's job —
+    # they require the submitter itself to replay, which the ring's
+    # driver does and this one deliberately does not.
+    # Densities tuned so the stream still makes progress: the churn
+    # shape ships thousands of watch frames per cycle, and a corrupt
+    # frame costs the whole stream a reconnect + replay — every-6th
+    # (the chaos ring's unit density) starves the watch entirely at
+    # this volume.
+    spec = "wire-corrupt:400,wire-stall:5,wire-storm:4"
+    faults0 = {k: v for k, v in METRICS.counters.items()
+               if k.startswith("wire_faults_injected_total")}
+    # Run DELTAS, not process-lifetime absolutes: an earlier phase run
+    # in the same process must not inflate this row's record.
+    base = {name: METRICS.counters.get(name, 0)
+            for name in ("watch_reconnect_total",
+                         "bind_wave_replays_total",
+                         "podgrouper_requeued_owners_total")}
+    divergence0 = sum(v for k, v in METRICS.counters.items()
+                      if k.startswith("cache_divergence_total"))
+    prev = os.environ.get("KAI_FAULT_INJECT")
+    os.environ["KAI_FAULT_INJECT"] = spec
+    try:
+        row = churn_phase(n_nodes=128, n_queues=512, cycles=6,
+                          submit_per_cycle=200, pipelined=True,
+                          substrate="http")
+    finally:
+        if prev is None:
+            os.environ.pop("KAI_FAULT_INJECT", None)
+        else:
+            os.environ["KAI_FAULT_INJECT"] = prev
+    injected = {
+        k.split('mode="')[1].rstrip('"}'): int(v - faults0.get(k, 0))
+        for k, v in METRICS.counters.items()
+        if k.startswith("wire_faults_injected_total")}
+    row.update({
+        "annotation": "@wire-faults",
+        "fault_inject": spec,
+        "faults_injected": injected,
+        "watch_reconnects": int(METRICS.counters.get(
+            "watch_reconnect_total", 0)
+            - base["watch_reconnect_total"]),
+        "bind_wave_replays": int(METRICS.counters.get(
+            "bind_wave_replays_total", 0)
+            - base["bind_wave_replays_total"]),
+        "grouper_requeues": int(METRICS.counters.get(
+            "podgrouper_requeued_owners_total", 0)
+            - base["podgrouper_requeued_owners_total"]),
+        "cache_divergence": int(sum(
+            v for k, v in METRICS.counters.items()
+            if k.startswith("cache_divergence_total")) - divergence0),
+    })
+    _append_result_row({"scenario": "churn-ring-wire-faults",
+                        "backend": backend, **row})
+    _log(f"wire-fault churn ring: cycle {row['cycle_s']}s, p99 "
+         f"submit→bound "
+         f"{row['pod_latency'].get('submit_to_bound_p99_ms')}ms "
+         f"under {spec}")
+    return 0
+
+
 def tas_phase(dims, gang, iters: int = 5):
     """TAS measurement at one mesh shape: per-level domain aggregation
     (segment sums over the node axis) for a 3-level mesh, then one gang
@@ -2165,6 +2245,11 @@ if __name__ == "__main__":
         # and the churn ring, identical pods_bound asserted, appended
         # to results.jsonl.
         sys.exit(columnar_ab_main())
+    elif "--churn-wire-faults" in sys.argv:
+        # The churn ring under the composite wire-fault spec (PR 15):
+        # p99 submit→bound with the wire lying the whole run, annotated
+        # @wire-faults, appended to results.jsonl.
+        sys.exit(churn_wire_faults_main())
     elif "--reclaim-ab" in sys.argv:
         # Same-commit reclaim eviction-write A/B: per-victim synchronous
         # writes vs the batched evict_many path, appended to

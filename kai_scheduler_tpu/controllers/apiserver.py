@@ -94,6 +94,7 @@ import queue
 import selectors
 import socket
 import threading
+import time
 import uuid
 from collections import deque
 from http.server import BaseHTTPRequestHandler
@@ -124,6 +125,19 @@ def _dumps(payload) -> bytes:
 def _chunk(line: bytes) -> bytes:
     """HTTP/1.1 chunked-transfer framing for one ndjson line."""
     return f"{len(line):x}\r\n".encode() + line + b"\r\n"
+
+
+def _corrupt_chunk(chunk: bytes) -> bytes:
+    """wire-corrupt fault: overwrite a run of payload bytes with 0xFE
+    (not valid UTF-8, not valid JSON) while PRESERVING the chunk's
+    length framing — the transfer coding stays intact, so the lie
+    reaches the client's JSON layer, the worst place to be lied to."""
+    head = chunk.index(b"\r\n") + 2
+    body = bytearray(chunk)
+    mid = head + max(1, (len(chunk) - head - 3) // 3)
+    for i in range(mid, min(len(chunk) - 3, mid + 8)):
+        body[i] = 0xFE
+    return bytes(body)
 
 
 class _FrameCache:
@@ -301,6 +315,12 @@ class KubeAPIServer:
         # Live watch streamer count (bounded by max_watch_streams).
         self._watch_streams = 0
         self._watch_lock = threading.Lock()
+        # Wire-fault bookkeeping (KAI_FAULT_INJECT wire-* modes): one
+        # deterministic counter per mode, server-wide — "first n" and
+        # "every nth" semantics must hold across connections and pool
+        # workers, so per-stream locals are not enough.
+        self._wire_lock = threading.Lock()
+        self._wire_counts: dict = {}
         self.httpd = _PooledHTTPServer((host, port), self,
                                        pool_size=pool_size,
                                        backlog=pool_backlog)
@@ -458,7 +478,10 @@ class KubeAPIServer:
         outcomes = []
         for out in raw:
             if out.get("ok"):
-                outcomes.append({"ok": True, "object": out["object"]})
+                ok = {"ok": True, "object": out["object"]}
+                if out.get("noop"):
+                    ok["noop"] = True  # replayed item: fence-checked no-op
+                outcomes.append(ok)
             else:
                 exc = out.get("error")
                 code = (404 if isinstance(exc, NotFound)
@@ -467,6 +490,51 @@ class KubeAPIServer:
                 outcomes.append({"ok": False, "code": code,
                                  "error": str(exc)})
         return 200, {"outcomes": outcomes}, seq
+
+    # -- wire-fault injection (KAI_FAULT_INJECT wire-* modes) ----------------
+    def wire_fault_fires(self, mode: str, default_n: int,
+                         every: bool = False) -> bool:
+        """Count one qualifying event for ``mode`` and report whether
+        THIS one faults.  ``every=False`` = the first N events fault
+        (storms); ``every=True`` = every Nth event faults (resets).
+        Deterministic by construction — the same request sequence
+        faults at the same points on every run, which is what lets the
+        chaos matrix replay a flaking seed."""
+        spec = control_fault(mode)
+        if spec is None:
+            return False
+        try:
+            n = int(spec) if spec else default_n
+        except ValueError:
+            n = default_n
+        if n <= 0:
+            return False
+        with self._wire_lock:
+            count = self._wire_counts.get(mode, 0) + 1
+            self._wire_counts[mode] = count
+        fires = (count % n == 0) if every else (count <= n)
+        if fires:
+            METRICS.inc("wire_faults_injected_total", mode=mode)
+        return fires
+
+    # -- anti-entropy digest -------------------------------------------------
+    def digest_snapshot(self) -> dict:
+        """Per-kind store digest at one event seq (``GET /digest``) —
+        the server half of the anti-entropy exchange
+        (utils/antientropy.py).  Atomic under the server lock (no HTTP
+        mutation can land between the fold and the seq read), with the
+        fold itself delegated to ``api.digest()`` so the STORE lock
+        guards the hashing — in-process embedders patch objects in
+        place under that lock only, and a half-merged manifest must
+        never tear a hash.  The O(store) fold per call is the accepted
+        cost of a periodic, per-interval exchange (fleet-budget-green
+        at the 2000n/4000p shape); an incrementally maintained XOR in
+        ``EventLog.append`` is the known next rung, at the price of a
+        second (canonical) encode on every mutation's hot path."""
+        with self.lock:
+            kinds = self.api.digest()["kinds"]
+            return {"seq": self.log.seq, "boot": self.boot_id,
+                    "kinds": kinds}
 
     def relist_snapshot(self) -> dict:
         """Atomic full-store snapshot + the event seq it corresponds to —
@@ -802,6 +870,7 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile = conn.wfile
         self.close_connection = True
         self.detached = False
+        self.suppress_response = False
 
     def _send_json(self, code: int, payload: dict,
                    headers: dict | None = None) -> None:
@@ -809,6 +878,18 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _send_bytes(self, code: int, body: bytes,
                     headers: dict | None = None) -> None:
+        if getattr(self, "suppress_response", False):
+            # wire-reset fault: the mutation LANDED but the connection
+            # dies before a single response byte — the client faces the
+            # ambiguous "did my wave land?" outcome and must resolve it
+            # by idempotent replay, never by assuming failure.
+            self.suppress_response = False
+            self.close_connection = True
+            try:
+                self.conn.sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            return
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
@@ -836,9 +917,29 @@ class _Handler(BaseHTTPRequestHandler):
             self._start_watch_stream(int(query.get("since", 0)),
                                      query.get("boot"))
             return
+        if parsed.path != "/relist" \
+                and server.wire_fault_fires("wire-storm", 4):
+            # Throttle storm: refuse before touching the store (safe to
+            # replay any method), alternating 429/503 so the client's
+            # backoff handles both throttle dialects.
+            with server._wire_lock:
+                odd = server._wire_counts.get("wire-storm", 0) % 2
+            self._send_json(429 if odd else 503,
+                            {"error": "injected throttle storm"},
+                            {"Retry-After": 0, "Connection": "close"})
+            self.close_connection = True
+            return
         if parsed.path == "/relist":
             self._send_json(200, server.relist_snapshot())
             return
+        if parsed.path == "/digest":
+            self._send_json(200, server.digest_snapshot())
+            return
+        if method != "GET" \
+                and server.wire_fault_fires("wire-reset", 3, every=True):
+            # Apply the mutation, then reset the connection before the
+            # response (see _send_bytes) — mid-bulk-POST included.
+            self.suppress_response = True
         epoch = self.headers.get("X-Kai-Epoch")
         epoch = int(epoch) if epoch is not None else None
         fence = self.headers.get("X-Kai-Fence")
@@ -905,6 +1006,19 @@ class _Handler(BaseHTTPRequestHandler):
         drop_spec = control_fault("watchdrop")
         drop_after = (int(drop_spec) if drop_spec else 5) \
             if drop_spec is not None else None
+        # Wire faults (CONTROL_FAULT_MODES): truncate a frame mid-chunk
+        # after N, corrupt every Nth frame's payload (framing intact),
+        # stall before every batch write.  All per-stream counters —
+        # each reconnect faces the fault again, which is the point.
+        trunc_spec = control_fault("wire-truncate")
+        trunc_after = (int(trunc_spec) if trunc_spec else 5) \
+            if trunc_spec is not None else None
+        corrupt_spec = control_fault("wire-corrupt")
+        corrupt_every = (int(corrupt_spec) if corrupt_spec else 7) \
+            if corrupt_spec is not None else None
+        stall_spec = control_fault("wire-stall")
+        stall_s = (float(stall_spec or 50) / 1000.0) \
+            if stall_spec is not None else None
         sent = 0
         seq = since
         try:
@@ -917,6 +1031,16 @@ class _Handler(BaseHTTPRequestHandler):
             # answers 410 Gone and the informer re-lists; we send
             # one explicit GONE line and close.  Never silently
             # replay a truncated history.
+            if server.wire_fault_fires("wire-gone", 3):
+                # Compaction storm: answer GONE regardless of cursor —
+                # every affected client pays a full re-list, and the
+                # reconnect backoff must keep the herd from arriving in
+                # lockstep (tests/test_wire_protocol.py).
+                send_line({"type": "GONE", "code": 410,
+                           "seq": server.log.seq,
+                           "boot": server.boot_id,
+                           "oldest": server.log.oldest()})
+                return
             restarted = boot is not None and boot != server.boot_id
             if restarted or seq < server.log.oldest() \
                     or seq > server.log.seq:
@@ -947,19 +1071,40 @@ class _Handler(BaseHTTPRequestHandler):
                 # unbuffered, so the burst leaves in one sendall).
                 buf = bytearray()
                 dropped = False
+                truncated = False
                 n_frames = 0
                 for eseq, _etype, _obj, chunk in events:
+                    sent += 1
+                    if truncated is False and trunc_after is not None \
+                            and sent > trunc_after:
+                        # Truncation: HALF of this frame's bytes, then
+                        # the connection dies — the client must treat
+                        # the torn tail as stream death and resume from
+                        # its last DELIVERED seq (never this one).
+                        METRICS.inc("wire_faults_injected_total",
+                                    mode="wire-truncate")
+                        buf += chunk[:max(1, len(chunk) // 2)]
+                        truncated = True
+                        break
+                    if corrupt_every is not None \
+                            and sent % corrupt_every == 0:
+                        METRICS.inc("wire_faults_injected_total",
+                                    mode="wire-corrupt")
+                        chunk = _corrupt_chunk(chunk)
                     buf += chunk
                     seq = eseq
-                    sent += 1
                     n_frames += 1
                     if drop_after is not None and sent >= drop_after:
                         dropped = True  # injected mid-stream drop
                         break
                 if buf:
+                    if stall_s is not None:
+                        METRICS.inc("wire_faults_injected_total",
+                                    mode="wire-stall")
+                        time.sleep(stall_s)
                     self.wfile.write(buf)
                     METRICS.inc("watch_frame_cache_hits_total", n_frames)
-                if dropped:
+                if dropped or truncated:
                     return
                 with server.log.cond:
                     if server.log.seq == seq \

@@ -23,6 +23,7 @@ from .binder import GPU_GROUP_ANNOTATION
 from .kubeapi import Conflict, InMemoryKubeAPI
 from .podgrouper import POD_GROUP_LABEL, SUBGROUP_LABEL
 from ..utils.lifecycle import LIFECYCLE
+from ..utils.logging import LOG
 from ..utils.metrics import METRICS
 from ..utils.tracing import TRACER
 
@@ -606,6 +607,19 @@ class ClusterCache:
         self._queue_cols: dict | None = None
         # Last columnar-path verdict for /debug/cycles + stats.
         self.last_columnar_stats: dict = {}
+        # -- anti-entropy (utils/antientropy.py, DEGRADATION) -------------
+        # Divergence between the columnar projection and the Pod mirror
+        # quarantines the fast path: snapshots take the object path
+        # (columnar_fallback_total, reason "anti-entropy") until TWO
+        # consecutive clean digests re-promote it — one clean check
+        # could be the same transient that diverged it.  All mutated on
+        # the scheduler thread (anti_entropy_check runs there, with
+        # snapshot()).
+        # kairace: single-writer=main
+        self._columnar_quarantined = False
+        # kairace: single-writer=main
+        self._col_clean_streak = 0
+        self.last_anti_entropy: dict = {}
         # (owner, expression) pairs already warned about: an unsupported
         # CEL selector is re-parsed every snapshot, but the user should
         # see ONE loud event per expression, not one per cycle.
@@ -956,6 +970,188 @@ class ClusterCache:
             self._order_stale[kind] = False
         return self._order[kind]
 
+    # -- anti-entropy (utils/antientropy.py, DEGRADATION "wire faults") ------
+    def content_digest(self) -> dict:
+        """Per-kind digest of the mirrors — the replica half of the
+        anti-entropy exchange, same shape as the store's ``digest()``."""
+        from ..utils.antientropy import obj_hash64
+        out = {}
+        for kind in sorted(_CONSUMED_KINDS):
+            mirror = self._mirror[kind]
+            if not mirror:
+                continue
+            h = 0
+            for obj in mirror.values():
+                h ^= obj_hash64(obj)
+            out[kind] = {"count": len(mirror), "hash": f"{h:016x}"}
+        return out
+
+    def _mirror_pod_projection(self) -> int:
+        """The Pod mirror's fold-identity projection (ns, name, uid,
+        rv-signature) — the comparand of
+        ``ColumnarPods.projection_digest``."""
+        from ..utils.antientropy import obj_hash64
+        h = 0
+        for (ns, name), obj in self._mirror["Pod"].items():
+            md = obj.get("metadata", {})
+            rv = md.get("resourceVersion")
+            h ^= obj_hash64([ns, name, md.get("uid"),
+                             rv if isinstance(rv, str) else None])
+        return h
+
+    def _rebuild_columnar_from_mirror(self) -> None:
+        """Targeted columnar repair: re-fold every mirrored pod into a
+        cleared store (templates memoize, so this re-parses nothing
+        whose manifest is unchanged).  Every live uid lands in the
+        pending delta events, so the next snapshot conservatively
+        treats the whole population as dirty — correct, and bounded by
+        one cycle."""
+        store = self._columnar
+        if store is None:
+            return
+        store.clear()
+        self._col_rows_cache = None
+        events = self._pending_col_events
+        for (ns, name), obj in self._mirror["Pod"].items():
+            uid = self._col_upsert((ns, name), obj, events)
+            if uid is not None:
+                events["pods_changed"].add(uid)
+
+    def _enqueue_repair(self, kind: str) -> int:
+        """Targeted repair re-list of ONE divergent kind: diff the live
+        listing against the mirror and enqueue every difference through
+        the normal dirty-key path (the next snapshot folds it with the
+        machinery the parity rings prove).  Signatures of enqueued keys
+        are dropped so content divergence at an UNCHANGED
+        resourceVersion — the corrupted-frame case — re-folds instead
+        of being skipped by the sig-match fast path.  Returns the
+        number of keys enqueued."""
+        listed = {}
+        for obj in self.api.list(kind):
+            md = obj.get("metadata", {})
+            listed[(md.get("namespace", "default"), md.get("name"))] = obj
+        stale = [key for key in self._mirror[kind] if key not in listed]
+        repaired = 0
+        with self._changes_lock:
+            # setdefault: a watch payload recorded since our list()
+            # returned is NEWER than the listing — it wins (the
+            # _apply_changes re-queue pattern); clobbering it would
+            # regress the mirror to the older listed content with no
+            # event left to re-deliver it.
+            for (ns, name), obj in listed.items():
+                self._changed_keys.add((kind, ns, name))
+                if self._payload_auth:
+                    self._changed_objs.setdefault((kind, ns, name), obj)
+                repaired += 1
+            for ns, name in stale:
+                self._changed_keys.add((kind, ns, name))
+                if self._payload_auth:
+                    self._changed_objs.setdefault((kind, ns, name), None)
+                repaired += 1
+        self._kind_sigs[kind].clear()
+        METRICS.inc("anti_entropy_repairs_total", kind=kind)
+        return repaired
+
+    def anti_entropy_check(self) -> dict:
+        """Periodic anti-entropy pass: compare the mirrors (and the
+        columnar projection) against the store's authoritative digest.
+
+        Runs on the scheduler thread — the mirrors' single writer — so
+        the local state is frozen for the duration.  The comparison is
+        made exact by ordering: local digest first, THEN the store's
+        (which can only be newer), then a dirty-queue re-check — any
+        event that could make the two legitimately unequal has either
+        marked a key dirty (skip, reason "dirty") or not yet been
+        delivered by the watch (skip, reason "lagging", wire dialect).
+        What remains unequal after that is real divergence: the wire
+        lied, or a fold bug dropped state.  Divergent kinds count
+        ``cache_divergence_total{kind=}`` and are repaired by a
+        targeted re-list; a diverged columnar projection quarantines
+        the array fast path until two consecutive clean digests
+        re-promote it (``columnar_repromote_total``)."""
+        from ..utils.antientropy import diverged_kinds
+        out: dict = {"checked": False, "diverged": [], "columnar_ok": True,
+                     "repaired_keys": 0, "skipped": None,
+                     "quarantined": self._columnar_quarantined}
+        digest_fn = getattr(self.api, "digest", None)
+        if digest_fn is None or not self._primed:
+            out["skipped"] = ("unsupported" if digest_fn is None
+                              else "unprimed")
+            self.last_anti_entropy = out
+            return out
+        with self._changes_lock:
+            dirty = bool(self._changed_keys)
+        if dirty or self._resync_pending:
+            METRICS.inc("anti_entropy_skipped_total", reason="dirty")
+            out["skipped"] = "dirty"
+            self.last_anti_entropy = out
+            return out
+        local = self.content_digest()
+        col_ok = True
+        if self._columnar is not None:
+            col_ok = (self._columnar.projection_digest()
+                      == self._mirror_pod_projection())
+        remote = digest_fn()
+        remote_seq = remote.get("seq")
+        cursor = getattr(self.api, "watch_cursor", None)
+        if remote_seq is not None and cursor is not None \
+                and cursor < remote_seq:
+            # Events between our cursor and the digest's seq are in
+            # flight, not lost — compare at the next quiescent point.
+            METRICS.inc("anti_entropy_skipped_total", reason="lagging")
+            out["skipped"] = "lagging"
+            self.last_anti_entropy = out
+            return out
+        with self._changes_lock:
+            dirty = bool(self._changed_keys)
+        if dirty or self._resync_pending:
+            # A delta landed while we were digesting: the store moved
+            # under us, legitimately.
+            METRICS.inc("anti_entropy_skipped_total", reason="dirty")
+            out["skipped"] = "dirty"
+            self.last_anti_entropy = out
+            return out
+        METRICS.inc("anti_entropy_checks_total")
+        out["checked"] = True
+        diverged = diverged_kinds(local, remote.get("kinds", {}),
+                                  _CONSUMED_KINDS)
+        out["diverged"] = diverged
+        out["columnar_ok"] = col_ok
+        for kind in diverged:
+            METRICS.inc("cache_divergence_total", kind=kind)
+            LOG.warning("anti-entropy: cache digest diverged from the "
+                        "store for kind %s — repairing with a targeted "
+                        "re-list", kind)
+            out["repaired_keys"] += self._enqueue_repair(kind)
+        if diverged and self._columnar is not None:
+            # The columns fold from the mirrors: a poisoned mirror may
+            # have poisoned them identically (projection digests agree
+            # on the lie), so a mirror repair always rebuilds the
+            # columns from the repaired truth too.
+            col_ok = False
+        if not col_ok:
+            METRICS.inc("cache_divergence_total", kind="_columnar")
+            self._col_clean_streak = 0
+            if not self._columnar_quarantined:
+                LOG.warning("anti-entropy: columnar projection diverged "
+                            "from the Pod mirror — quarantining the "
+                            "fast path (object path authoritative)")
+            self._columnar_quarantined = True
+            self._rebuild_columnar_from_mirror()
+        elif self._columnar_quarantined:
+            self._col_clean_streak += 1
+            if self._col_clean_streak >= 2:
+                self._columnar_quarantined = False
+                self._col_clean_streak = 0
+                METRICS.inc("columnar_repromote_total")
+                LOG.info("anti-entropy: two consecutive clean digests — "
+                         "columnar fast path re-promoted")
+        METRICS.set_gauge("columnar_quarantined",
+                          1.0 if self._columnar_quarantined else 0.0)
+        out["quarantined"] = self._columnar_quarantined
+        self.last_anti_entropy = out
+        return out
+
     # -- parse layers (template-memoized) ------------------------------------
     def _parse_node(self, n: dict) -> NodeInfo:
         spec = n.get("status", {}).get("allocatable", {})
@@ -1271,6 +1467,11 @@ class ClusterCache:
             return "resync"
         if not was_primed:
             return "priming"
+        if self._columnar_quarantined:
+            # Anti-entropy found the columns disagreeing with the
+            # mirrors: the object path is authoritative until two
+            # consecutive clean digests re-promote the fast path.
+            return "anti-entropy"
         store = self._columnar
         if store.overflowed:
             return "vocab-overflow"
@@ -1965,7 +2166,20 @@ class ClusterCache:
         with TRACER.span("bind_wave", kind="kubeapi",
                          op="bindrequest_create_bulk", binds=len(objs),
                          epoch=fk.get("epoch")) as sp:
-            outcomes = create_many(objs, supersede=True, **fk)
+            try:
+                outcomes = create_many(objs, supersede=True, **fk)
+            except OSError:
+                # Ambiguous wave death (connection reset or response
+                # dropped mid-bulk-POST): the store may hold ANY prefix
+                # of the wave.  One idempotent replay resolves it —
+                # create_many answers identical-spec items with
+                # fence-checked no-ops, so a landed prefix can never
+                # double-bind and an unlanded suffix lands now.  A
+                # second transport death propagates: the journal replay
+                # at restart is the backstop then.
+                METRICS.inc("bind_wave_replays_total")
+                sp.set(replayed=True)
+                outcomes = create_many(objs, supersede=True, **fk)
             failed = sum(1 for out in outcomes if not out.get("ok"))
             if failed:
                 sp.set(failed_items=failed)

@@ -124,6 +124,14 @@ class HTTPKubeAPI:
         self._resync_callbacks: list[Callable] = []
         self._reconnect_rng = random.Random(0xC0FFEE)
         self._partition_started: float | None = None
+        # Consecutive GONE answers on the watch: a compaction storm must
+        # back the re-list train off (capped, FULL jitter) instead of
+        # stampeding the apiserver with synchronized re-lists — reset by
+        # the first stream that survives past its resume.
+        self._gone_streak = 0
+        # wire-drop fault counter (mutating requests); deterministic so
+        # the chaos matrix can replay a seed.
+        self._wire_drop_count = 0
         # Default fence for mutating writes (set_fence); per-call epoch=
         # kwargs override.
         self._fence: str | None = None
@@ -161,6 +169,38 @@ class HTTPKubeAPI:
             self._partition_started = now
         if now - self._partition_started < window_s:
             raise urllib.error.URLError("injected network partition")
+
+    def _maybe_wire_drop(self, method: str, sent: bool) -> None:
+        """``wire-drop:<n>`` chaos (the client-side wire shim): every
+        Nth MUTATING request is fully written, then the response is
+        discarded and the connection dropped — the server MAY have
+        processed it (a race, exactly like a real dying wire), and the
+        caller gets the ambiguous URLError.  Callers that replay must
+        rely on idempotent per-item outcomes, never on "an error means
+        it didn't land"."""
+        if method == "GET" or not sent:
+            return
+        spec = control_fault("wire-drop")
+        if spec is None:
+            return
+        try:
+            n = int(spec) if spec else 3
+        except ValueError:
+            n = 3
+        if n <= 0:
+            return
+        # Chaos-injection bookkeeping only (the _partition_started
+        # pattern): a racing increment from the watch thread's re-list
+        # GETs shifts the injected drop by one request, which no
+        # assertion depends on — and GETs return before reaching the
+        # counter anyway.
+        # kairace: disable=KRC001
+        self._wire_drop_count += 1
+        if self._wire_drop_count % n == 0:
+            METRICS.inc("wire_faults_injected_total", mode="wire-drop")
+            self._drop_connection()
+            raise urllib.error.URLError(
+                "injected wire drop (response discarded after send)")
 
     def _connection(self) -> http.client.HTTPConnection:
         conn = getattr(self._local, "conn", None)
@@ -219,6 +259,7 @@ class HTTPKubeAPI:
                 conn.request(method, self._conn_path_prefix + path,
                              body=data, headers=headers)
                 sent = True
+                self._maybe_wire_drop(method, sent)
                 resp = conn.getresponse()
                 status = resp.status
                 try:
@@ -242,10 +283,16 @@ class HTTPKubeAPI:
                 # never reuse it for the next request.
                 self._drop_connection()
                 raise
-            if status == 429 and throttles < THROTTLE_RETRIES:
+            retryable_503 = (status == 503
+                             and resp.getheader("Retry-After") is not None)
+            if (status == 429 or retryable_503) \
+                    and throttles < THROTTLE_RETRIES:
                 # Backpressure: the dispatcher refused the request (and
                 # closed the connection) — never processed, safe to
-                # replay after a short jittered pause.
+                # replay after a short jittered pause.  503 counts only
+                # when the server stamped Retry-After (its promise the
+                # store was never touched — the wire-storm contract);
+                # a bare 503 from a proxy stays an error.
                 throttles += 1
                 METRICS.inc("http_throttled_retries_total")
                 self._drop_connection()
@@ -355,13 +402,29 @@ class HTTPKubeAPI:
             if not token:
                 return items
 
+    # -- anti-entropy --------------------------------------------------------
+    @property
+    def watch_cursor(self) -> int:
+        """Highest event seq the watch thread has fully DELIVERED
+        (dirty marks recorded) — the anti-entropy check compares it
+        against the digest's seq to tell "lagging" from "diverged"."""
+        return self._watch_seq
+
+    def digest(self) -> dict:
+        """Per-kind store digest at one event seq (``GET /digest``) —
+        the server half of the anti-entropy exchange; see
+        utils/antientropy.py and ``ClusterCache.anti_entropy_check``."""
+        return self._request("GET", "/digest")
+
     # -- bulk writes ---------------------------------------------------------
     def _decode_outcomes(self, payload: dict) -> list[dict]:
         outcomes = []
         for out in payload.get("outcomes", []):
             if out.get("ok"):
-                outcomes.append({"ok": True,
-                                 "object": out.get("object")})
+                ok = {"ok": True, "object": out.get("object")}
+                if out.get("noop"):
+                    ok["noop"] = True
+                outcomes.append(ok)
             else:
                 code = out.get("code")
                 msg = out.get("error", f"bulk item failed ({code})")
@@ -533,19 +596,37 @@ class HTTPKubeAPI:
                         event = json.loads(raw)
                         etype = event.get("type")
                         if etype == "BOOT":
+                            # The server accepted our resume point: a
+                            # GONE storm (if any) has broken.
                             self._server_boot = event.get("boot")
+                            self._gone_streak = 0
                             continue
                         if etype == "GONE":
                             # Watch gap: our resume point fell outside
                             # the ring (evicted history or a server
                             # restart reset the sequence).  Re-list,
                             # diff, resume from the re-list's seq.
+                            # REPEATED GONEs are a compaction storm:
+                            # pace the re-list train with capped,
+                            # FULL-jitter backoff so a fleet of
+                            # watchers cannot stampede the apiserver
+                            # in lockstep (the re-list is the single
+                            # most expensive request we can make).
                             METRICS.inc("watch_gap_total")
+                            self._gone_streak += 1
+                            if self._gone_streak > 1:
+                                METRICS.inc("watch_gone_backoffs_total")
+                                exp = min(self._gone_streak - 2, 16)
+                                cap = min(RECONNECT_CAP_S,
+                                          RECONNECT_BASE_S * (2 ** exp))
+                                self._stop.wait(
+                                    self._reconnect_rng.random() * cap)
                             self._relist()
                             break  # reconnect at the new seq
-                        self._watch_seq = max(self._watch_seq,
-                                              int(event.get("seq", 0)))
                         if etype == "HEARTBEAT":
+                            self._watch_seq = max(self._watch_seq,
+                                                  int(event.get("seq",
+                                                                0)))
                             self._synced.set()
                             continue
                         obj = event["object"]
@@ -557,8 +638,20 @@ class HTTPKubeAPI:
                         self._fire_sync(etype, obj)
                         with self._pending_lock:
                             self._pending.append((etype, obj))
+                        # Cursor advances LAST: a seq the barrier (or
+                        # the anti-entropy digest check) observes is a
+                        # promise the event's dirty marks are already
+                        # recorded.
+                        self._watch_seq = max(self._watch_seq,
+                                              int(event.get("seq", 0)))
             except (urllib.error.URLError, OSError,
-                    json.JSONDecodeError):
+                    http.client.HTTPException, ValueError):
+                # ValueError covers JSONDecodeError AND the
+                # UnicodeDecodeError a corrupted frame's non-UTF-8
+                # bytes raise; HTTPException covers the IncompleteRead
+                # a frame truncated mid-chunk raises.  All of them are
+                # stream death: resume from the last DELIVERED seq —
+                # a lying frame must never be half-applied.
                 if self._stop.is_set():
                     continue  # exit via the locked loop-top check
                 failures = 0 if got_line else failures + 1

@@ -320,6 +320,21 @@ class KubernetesKubeAPI:
                     if not supersede:
                         raise
                     kind, ns, name = obj_key(obj)
+                    # Identical-spec conflict = a REPLAY of a wave whose
+                    # first attempt landed before the connection died
+                    # (dialect parity with InMemoryKubeAPI.create_many):
+                    # answer a no-op returning the live object instead
+                    # of superseding — resetting a landed request's
+                    # status here would re-trigger the binder against
+                    # an already-bound pod.
+                    existing = self.get_opt(kind, name, ns)
+                    if existing is not None \
+                            and existing.get("spec") == obj.get("spec"):
+                        from ..utils.metrics import METRICS
+                        METRICS.inc("bulk_replay_noops_total")
+                        outcomes.append({"ok": True, "object": existing,
+                                         "noop": True})
+                        continue
                     self.delete(kind, name, ns)
                     obj.get("metadata", {}).pop("resourceVersion", None)
                     obj.get("metadata", {}).pop("uid", None)

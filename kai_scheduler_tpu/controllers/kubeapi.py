@@ -230,6 +230,17 @@ class InMemoryKubeAPI:
             out.append(obj)
         return sorted(out, key=lambda o: o["metadata"]["name"])
 
+    def digest(self) -> dict:
+        """Per-kind anti-entropy digest of the store (count + order-
+        insensitive content hash; utils/antientropy.py).  ``seq`` is
+        None on the in-memory dialect — there is no event log to anchor
+        to, and the emit-time change hooks make the consumer's dirty
+        queue the only lag there is."""
+        from ..utils.antientropy import digest_objects
+        with self._store_lock:
+            kinds = digest_objects(self.objects.values())
+        return {"seq": None, "kinds": kinds}
+
     def update(self, obj: dict, epoch: int | None = None,
                fence: str | None = None) -> dict:
         self.check_fence(epoch, fence)
@@ -295,7 +306,15 @@ class InMemoryKubeAPI:
         """Batched create (the bind-wave write).  ``supersede=True``
         replaces an existing object on Conflict (delete + recreate, the
         scheduler's fresh-decision-resets-the-request semantics) instead
-        of failing the item."""
+        of failing the item — UNLESS the existing object carries the
+        identical spec: that is a REPLAY of a wave whose first attempt
+        (partially) landed before the connection died, and the item
+        answers a fence-checked no-op returning the live object
+        (``bulk_replay_noops_total``).  Superseding there would reset
+        the landed request's status/retry budget and re-trigger the
+        binder against an already-bound pod; replay must converge, not
+        re-decide (docs/DEGRADATION.md, "bulk replay")."""
+        from ..utils.metrics import METRICS
         outcomes = []
         for item in objs:
             obj, e, f = self._unwrap_bulk_item(item, epoch, fence)
@@ -308,6 +327,17 @@ class InMemoryKubeAPI:
                     if not supersede:
                         raise
                     kind, ns, name = obj_key(obj)
+                    with self._store_lock:
+                        existing = self.objects.get((kind, ns, name))
+                    if existing is not None \
+                            and existing.get("spec") == obj.get("spec"):
+                        # create() fence-checked before raising
+                        # Conflict, so a deposed replayer still gets
+                        # Fenced, never a forged no-op.
+                        METRICS.inc("bulk_replay_noops_total")
+                        outcomes.append({"ok": True, "object": existing,
+                                         "noop": True})
+                        continue
                     self.delete(kind, name, ns, epoch=e, fence=f)
                     obj.get("metadata", {}).pop("resourceVersion", None)
                     obj.get("metadata", {}).pop("uid", None)

@@ -68,6 +68,11 @@ class SystemConfig:
     # journal intents here and the startup reconcile pass replays it.
     # None = journaling off (embedded/test deployments).
     commitlog_path: str | None = None
+    # Anti-entropy cadence (utils/antientropy.py): every N cycles the
+    # primary cache's digest is compared against the store's and any
+    # divergence repaired (DEGRADATION "wire faults" rows).  None =
+    # KAI_ANTIENTROPY_INTERVAL (default 16); 0 disables.
+    anti_entropy_interval: int | None = None
 
     def gate(self, name: str, default: bool = True) -> bool:
         from ..utils.feature_gates import FeatureGates
@@ -104,11 +109,32 @@ class System:
         if (self.usage_db is not None and self.config.usage_log_path
                 and hasattr(self.usage_db, "attach_log")):
             self.usage_db.attach_log(self.config.usage_log_path)
+        if getattr(self.usage_db, "restored_corrupt", False):
+            # A torn/CRC-mismatched usage checkpoint restored into the
+            # documented stale->degraded mode: the metric fired in
+            # attach_log; the event makes it visible in the store too.
+            self.cache.record_event(
+                "UsageLogCorrupt",
+                "usage checkpoint log was corrupt; usage fairness "
+                "degraded (usage ignored) until fresh samples land")
         self.commitlog = None
         if self.config.commitlog_path:
             from ..utils.commitlog import CommitLog
             self.commitlog = CommitLog(self.config.commitlog_path)
             self.cache.commitlog = self.commitlog
+        # Anti-entropy cadence: compare the cache digest against the
+        # store's every N cycles, on the CYCLE thread (the mirrors'
+        # single writer) — never on the commit executor.
+        import os as _os
+        interval = self.config.anti_entropy_interval
+        if interval is None:
+            try:
+                interval = int(_os.environ.get(
+                    "KAI_ANTIENTROPY_INTERVAL", "16"))
+            except ValueError:
+                interval = 16
+        self._anti_entropy_every = max(0, interval)
+        self._anti_entropy_cycles = 0
         # Fencing state, armed by set_fence() once a Lease is held.
         self._fence_name: str | None = None
         self._epoch_provider = None
@@ -517,12 +543,33 @@ class System:
                 {qid: attrs.allocated
                  for qid, attrs in ssn.proportion.queues.items()})
 
+    def _maybe_anti_entropy(self) -> None:
+        """Every Nth cycle, run the cache's anti-entropy digest check —
+        at the TOP of the cycle, on the cycle thread: the mirrors'
+        single writer, before any new fold, after the previous
+        epilogue's barrier.  In-flight deltas make the check skip
+        itself (reason "dirty"/"lagging"), so an overlapped pipeline's
+        busy cycles self-limit to quiescent points."""
+        if not self._anti_entropy_every:
+            return
+        self._anti_entropy_cycles += 1
+        if self._anti_entropy_cycles < self._anti_entropy_every:
+            return
+        self._anti_entropy_cycles = 0
+        # The SCHEDULERS' caches are the primed replicas (each shard
+        # builds its own); System.cache only executes side effects and
+        # never snapshots.  Companion mode (no schedulers) has no
+        # replica to verify.
+        for scheduler in self.schedulers:
+            scheduler.cache.anti_entropy_check()
+
     def run_cycle(self) -> None:
         """One end-to-end tick: drain controller events, run every shard's
         scheduling cycle, drain the binder's work.  With the pipeline
         armed (SystemConfig.pipelined_cycles / enable_pipeline) the
         commit/binder stage runs on the executor thread and this call
         returns after the decision phase — see DESIGN §10."""
+        self._maybe_anti_entropy()
         if self.commit_executor is not None and not self._pipeline_ready():
             self._drain_pipeline_to_serial()
         if self.commit_executor is not None and self._pipeline_ready():

@@ -145,56 +145,31 @@ class PodGrouper:
         self._apply_owner_evictions()
         if not self._pending:
             return 0
-        from ..models.groupers import grouper_pod_signature, resolve_grouper
         pending, self._pending = self._pending, {}
         ensured: set = set()
         batched_owners = 0
         for okey, pods in pending.items():
-            rep = next(iter(pods.values()))
-            top_owner, _chain = self.resolve_top_owner(rep)
-            shared_top = not self._last_walk_synthesized
-            grouper = owner_rv = top_id = None
-            if shared_top:
-                grouper = resolve_grouper(
-                    top_owner.get("apiVersion", "v1"),
-                    top_owner.get("kind", "Pod"))
-                t_md = top_owner.get("metadata", {})
-                owner_rv = t_md.get("resourceVersion")
-                top_id = (t_md.get("namespace", "default"),
-                          top_owner.get("kind"), t_md.get("name"))
-            owner_batched = False
-            for pod in pods.values():
-                if not shared_top and pod is not rep:
-                    # A synthesized owner embeds the resolving pod's own
-                    # labels: the representative's result must not leak
-                    # onto its batch-mates — re-resolve per pod.
-                    top_owner, _chain = self.resolve_top_owner(pod)
-                meta = None
-                if shared_top and owner_rv is not None:
-                    psig = grouper_pod_signature(grouper, pod)
-                    if psig is not None:
-                        mkey = (okey, top_id, owner_rv, psig)
-                        meta = self._meta_cache.get(mkey)
-                        if meta is None:
-                            meta = group_workload(top_owner, pod,
-                                                  self.api)
-                            if len(self._meta_cache) >= OWNER_CACHE_CAP:
-                                self._meta_cache.pop(
-                                    next(iter(self._meta_cache)))
-                            self._meta_cache[mkey] = meta
-                        owner_batched = True
-                if meta is None:
-                    meta = group_workload(top_owner, pod, self.api)
-                key = (meta.namespace, meta.name)
-                if key not in ensured:
-                    ensured.add(key)
-                    self._ensure_podgroup(meta, pod)
-                self._label_pod(meta, pod)
-                if not pod.get("spec", {}).get("nodeName"):
-                    md = pod["metadata"]
-                    LIFECYCLE.note(md.get("uid", md["name"]), "grouped",
-                                   podgroup=meta.name,
-                                   queue=meta.queue or "")
+            try:
+                owner_batched = self._drain_owner(okey, pods, ensured)
+            except OSError as exc:
+                # Transport death mid-batch (a lying wire, a store
+                # briefly unreachable): the owner's pods must NOT fall
+                # out of the queue — before this requeue, a single
+                # failed label patch left its pod ungrouped FOREVER
+                # (unschedulable = a lost pod; found by the wire-fault
+                # ring).  Re-enqueue behind any NEWER event already
+                # recorded and keep draining the other owners; every
+                # write in the batch is idempotent, so the retry
+                # converges.
+                METRICS.inc("podgrouper_requeued_owners_total")
+                from ..utils.logging import LOG
+                LOG.warning("podgrouper: transport error grouping %s "
+                            "(%s); re-enqueued for the next drain",
+                            okey, exc)
+                bucket = self._pending.setdefault(okey, {})
+                for pkey, pod in pods.items():
+                    bucket.setdefault(pkey, pod)
+                continue
             if owner_batched:
                 batched_owners += 1
         METRICS.inc("podgrouper_owner_batches_total", len(pending))
@@ -202,6 +177,59 @@ class PodGrouper:
             METRICS.inc("grouper_vectorized_batches_total",
                         batched_owners)
         return len(pending)
+
+    def _drain_owner(self, okey, pods: dict, ensured: set) -> bool:
+        """Group one owner's batch (the body of ``drain_pending``'s
+        loop, split out so a transport failure can requeue exactly this
+        owner).  Returns True when the owner's metadata derivation was
+        batch-memoized."""
+        from ..models.groupers import grouper_pod_signature, resolve_grouper
+        rep = next(iter(pods.values()))
+        top_owner, _chain = self.resolve_top_owner(rep)
+        shared_top = not self._last_walk_synthesized
+        grouper = owner_rv = top_id = None
+        if shared_top:
+            grouper = resolve_grouper(
+                top_owner.get("apiVersion", "v1"),
+                top_owner.get("kind", "Pod"))
+            t_md = top_owner.get("metadata", {})
+            owner_rv = t_md.get("resourceVersion")
+            top_id = (t_md.get("namespace", "default"),
+                      top_owner.get("kind"), t_md.get("name"))
+        owner_batched = False
+        for pod in pods.values():
+            if not shared_top and pod is not rep:
+                # A synthesized owner embeds the resolving pod's own
+                # labels: the representative's result must not leak
+                # onto its batch-mates — re-resolve per pod.
+                top_owner, _chain = self.resolve_top_owner(pod)
+            meta = None
+            if shared_top and owner_rv is not None:
+                psig = grouper_pod_signature(grouper, pod)
+                if psig is not None:
+                    mkey = (okey, top_id, owner_rv, psig)
+                    meta = self._meta_cache.get(mkey)
+                    if meta is None:
+                        meta = group_workload(top_owner, pod,
+                                              self.api)
+                        if len(self._meta_cache) >= OWNER_CACHE_CAP:
+                            self._meta_cache.pop(
+                                next(iter(self._meta_cache)))
+                        self._meta_cache[mkey] = meta
+                    owner_batched = True
+            if meta is None:
+                meta = group_workload(top_owner, pod, self.api)
+            key = (meta.namespace, meta.name)
+            if key not in ensured:
+                ensured.add(key)
+                self._ensure_podgroup(meta, pod)
+            self._label_pod(meta, pod)
+            if not pod.get("spec", {}).get("nodeName"):
+                md = pod["metadata"]
+                LIFECYCLE.note(md.get("uid", md["name"]), "grouped",
+                               podgroup=meta.name,
+                               queue=meta.queue or "")
+        return owner_batched
 
     def resolve_top_owner(self, pod: dict):
         """Walk ownerReferences to the root (pkg/podgrouper/topowner/).

@@ -254,6 +254,26 @@ class ColumnarPods:
         return int(np.count_nonzero(
             self.flags[rows] & FLAG_COMPLEX)) if rows.size else 0
 
+    def projection_digest(self) -> int:
+        """Order-insensitive 64-bit digest of the fold-identity
+        projection — one (ns, name, uid, rv-signature) tuple per live
+        row, XOR-folded (utils/antientropy.py).  The anti-entropy check
+        compares this against the SAME projection of the Pod mirror: a
+        row the O(delta) fold missed, kept past its delete, or left at
+        a stale signature disagrees here, and the snapshot gate
+        quarantines the columnar fast path until the store is rebuilt
+        and two consecutive digests come back clean.  Non-string
+        signatures (stores that stamp no resourceVersion) project as
+        None on both sides — they are sentinels unequal by identity,
+        not content."""
+        from ..utils.antientropy import obj_hash64
+        h = 0
+        for (ns, name), row in self.rows.items():
+            rv = self.rv[row]
+            h ^= obj_hash64([ns, name, self.uid[row],
+                             rv if isinstance(rv, str) else None])
+        return h
+
     def stats(self) -> dict:
         return {
             "rows": len(self.rows),

@@ -105,6 +105,30 @@ def healthz_payload(state: dict | None = None) -> dict:
         # Overlapped pipeline: queue depth / poison state — a poisoned
         # executor means the fleet fell back to the serial cycle path.
         payload["pipeline"] = executor.stats()
+    anti_entropy: dict = {}
+    checks = METRICS.counters.get("anti_entropy_checks_total")
+    if checks:
+        anti_entropy["checks"] = checks
+    divergence = sum(v for name, v in METRICS.counters.items()
+                     if name.startswith("cache_divergence_total"))
+    if divergence:
+        # Any non-zero here means the wire lied at least once and the
+        # self-healing path ran — the DEGRADATION table's
+        # "anti-entropy" rows.
+        anti_entropy["divergence"] = divergence
+    # The SCHEDULERS' caches are the verified replicas (each shard
+    # builds its own; System.cache never snapshots, so its verdict is
+    # forever empty).
+    system = state.get("system")
+    caches = [s.cache for s in getattr(system, "schedulers", None) or ()]
+    last = next((c.last_anti_entropy for c in caches
+                 if getattr(c, "last_anti_entropy", None)), None)
+    if last is not None:
+        anti_entropy["last"] = last
+        anti_entropy["columnar_quarantined"] = any(
+            getattr(c, "_columnar_quarantined", False) for c in caches)
+    if anti_entropy:
+        payload["anti_entropy"] = anti_entropy
     return payload
 
 

@@ -85,6 +85,15 @@ TIMEAWARE_TESTS = ["tests/test_rankplace.py", "tests/test_usagedb.py",
 # path), pool-saturation backpressure, and the watch-mode cache's
 # zero-whole-kind-list steady state over a real loopback wire.
 WIRE_TESTS = ["tests/test_wire_protocol.py"]
+# --wire-faults: the lying-wire ring — each seed reshuffles the churn
+# stream while the wire-* fault family (truncated/corrupted watch
+# frames, stalled streams, connection reset mid-bulk-POST, 429/503
+# storms, 410-GONE compaction storms, response drops) is injected under
+# a full System over loopback HTTP, asserting zero double-binds, zero
+# lost pods, anti-entropy digest convergence, and bounded cycles —
+# including scheduler crash-replay and apiserver restart (seq
+# regression) mid bulk-bind-wave.
+WIRE_FAULT_TESTS = ["tests/test_wire_faults.py"]
 
 
 def run_iteration(seed: int, tests: list[str], marker: str,
@@ -197,6 +206,15 @@ def main(argv=None) -> int:
                          "dialect parity, per-item bulk outcomes, pool "
                          "backpressure, and the zero-whole-kind-list "
                          "steady state over a real loopback wire")
+    ap.add_argument("--wire-faults", action="store_true",
+                    help="wire-faults mode: sweep the lying-wire ring "
+                         f"({WIRE_FAULT_TESTS}) — each seed reshuffles "
+                         "the churn stream under injected wire faults "
+                         "(truncate/corrupt/stall/reset/storm/GONE/"
+                         "drop) while zero-double-bind, zero-lost-pod, "
+                         "and anti-entropy digest convergence are "
+                         "asserted, incl. crash-replay and apiserver "
+                         "restart mid bulk-bind-wave")
     ap.add_argument("--races", action="store_true",
                     help="runtime lock-order validation: every iteration "
                          "runs with KAI_LOCKTRACE=1 (threading factories "
@@ -230,8 +248,8 @@ def main(argv=None) -> int:
         tests = args.tests
     else:
         # Modes compose: --arena --latency --incremental --fused
-        # --shards --pipeline --columnar --timeaware --wire sweeps
-        # every selected suite per seed.
+        # --shards --pipeline --columnar --timeaware --wire
+        # --wire-faults sweeps every selected suite per seed.
         tests = (ARENA_TESTS if args.arena else []) + \
             (LATENCY_TESTS if args.latency else []) + \
             (INCREMENTAL_TESTS if args.incremental else []) + \
@@ -240,7 +258,8 @@ def main(argv=None) -> int:
             (PIPELINE_TESTS if args.pipeline else []) + \
             (COLUMNAR_TESTS if args.columnar else []) + \
             (TIMEAWARE_TESTS if args.timeaware else []) + \
-            (WIRE_TESTS if args.wire else [])
+            (WIRE_TESTS if args.wire else []) + \
+            (WIRE_FAULT_TESTS if args.wire_faults else [])
         if not tests:
             tests = DEFAULT_TESTS
     repo_root = os.path.dirname(os.path.dirname(os.path.dirname(

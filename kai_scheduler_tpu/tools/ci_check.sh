@@ -14,7 +14,10 @@
 #                 by contract, so any finding is a new race to fix
 #   chaos matrix  --dry-run validation of the fault-grid definition
 #                 (including the --races KAI_LOCKTRACE lock-order
-#                 validation mode)
+#                 validation mode and the --wire-faults lying-wire ring)
+#   conformance   tools/conformance.py --smoke: every proof in one
+#                 command — both analyzers, every chaos-matrix mode
+#                 definition, and a real 1-seed wire-faults sweep
 #   kernel parity fused-allocation ladder (Pallas/jnp/legacy) vs the
 #                 exact kernel: placements must be bit-identical
 #                 (tools/kernel_parity.py --smoke)
@@ -68,7 +71,15 @@ python -m kai_scheduler_tpu.tools.chaos_matrix --wire --dry-run \
     || fail=1
 python -m kai_scheduler_tpu.tools.chaos_matrix --timeaware --dry-run \
     || fail=1
+python -m kai_scheduler_tpu.tools.chaos_matrix --wire-faults --dry-run \
+    || fail=1
 python -m kai_scheduler_tpu.tools.chaos_matrix --races --dry-run \
+    || fail=1
+
+echo
+echo "== conformance ring (--smoke: analyzers + matrix defs + 1-seed"
+echo "   wire-faults sweep in one command — tools/conformance.py) =="
+JAX_PLATFORMS=cpu python -m kai_scheduler_tpu.tools.conformance --smoke \
     || fail=1
 
 echo

@@ -1,0 +1,150 @@
+"""Conformance ring: every proof this repo makes, in ONE command.
+
+ROADMAP item 5 ("make the proofs run where we run"), folded into one
+gate: the static analyzers (kailint, kairace), the FULL chaos-matrix
+mode set — default reconciler rings plus --arena --incremental --fused
+--shards --pipeline --latency --columnar --wire --timeaware and the
+PR 15 --wire-faults lying-wire ring — and the fleet budget
+(tools/fleet_budget.py), swept per fault seed and reported as one
+pass/fail table.  A future PR that breaks any invariant the previous
+fifteen proved fails HERE, in one command, with the failing mode and a
+replay seed named.
+
+Tiers:
+
+  python -m kai_scheduler_tpu.tools.conformance            # full sweep
+  python -m kai_scheduler_tpu.tools.conformance --smoke    # the CI gate
+
+``--smoke`` (run by tools/ci_check.sh) keeps the wall time CI-sized:
+both analyzers for real, a --dry-run validation of EVERY chaos-matrix
+mode definition, and one real single-seed sweep of the wire-faults ring
+(the newest, least-soaked invariant).  The fleet budget is part of the
+full tier (and of ci_check.sh directly); ``--with-budget`` pulls it
+into smoke too.
+
+``--dry-run`` prints the step plan without executing anything — the
+self-validation the chaos matrix pioneered, one level up.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+# Every chaos-matrix mode flag; "" is the default reconciler/device ring.
+MATRIX_MODES = ["", "--arena", "--incremental", "--fused", "--shards",
+                "--pipeline", "--latency", "--columnar", "--wire",
+                "--timeaware", "--wire-faults"]
+
+# The smoke tier's one REAL sweep: the wire-faults ring, one seed, the
+# fast subset (the same -k the tier-1 smoke uses).
+SMOKE_REAL_SWEEP = ["--wire-faults", "--seeds", "1",
+                    "-k", "converge or replays or lagging",
+                    "--timeout", "300"]
+
+
+def _mode_label(mode: str) -> str:
+    return mode.lstrip("-") or "default"
+
+
+def build_plan(smoke: bool, seeds: str, with_budget: bool,
+               races: bool) -> list:
+    """The ordered (name, argv) step list; argv is run as
+    ``sys.executable -m <module> ...``."""
+    plan: list = [
+        ("kailint", ["kai_scheduler_tpu.tools.kailint",
+                     "kai_scheduler_tpu/"]),
+        ("kairace", ["kai_scheduler_tpu.tools.kairace",
+                     "kai_scheduler_tpu/"]),
+    ]
+    matrix = "kai_scheduler_tpu.tools.chaos_matrix"
+    if smoke:
+        for mode in MATRIX_MODES:
+            argv = [matrix, "--dry-run"] + ([mode] if mode else [])
+            plan.append((f"matrix-def:{_mode_label(mode)}", argv))
+        if races:
+            plan.append(("matrix-def:races", [matrix, "--races",
+                                              "--dry-run"]))
+        plan.append(("matrix:wire-faults(1 seed)",
+                     [matrix] + SMOKE_REAL_SWEEP))
+    else:
+        for mode in MATRIX_MODES:
+            argv = [matrix, "--seeds", seeds, "--timeout", "600"] \
+                + ([mode] if mode else [])
+            if races:
+                argv.append("--races")
+            plan.append((f"matrix:{_mode_label(mode)}", argv))
+    if with_budget or not smoke:
+        plan.append(("fleet-budget",
+                     ["kai_scheduler_tpu.tools.fleet_budget"]))
+    return plan
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser("kai-conformance")
+    ap.add_argument("--smoke", action="store_true",
+                    help="the CI tier: analyzers + every matrix mode "
+                         "definition (dry run) + one real 1-seed "
+                         "wire-faults sweep")
+    ap.add_argument("--seeds", default="1,2,3",
+                    help="fault-seed sweep for the full tier "
+                         "(default: 1,2,3)")
+    ap.add_argument("--with-budget", action="store_true",
+                    help="run tools/fleet_budget.py in the smoke tier "
+                         "too (always part of the full tier)")
+    ap.add_argument("--races", action="store_true",
+                    help="arm KAI_LOCKTRACE lock-order validation on "
+                         "every matrix sweep (full tier) / validate "
+                         "the races mode definition (smoke)")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="print the step plan without executing")
+    args = ap.parse_args(argv)
+
+    plan = build_plan(args.smoke, args.seeds, args.with_budget,
+                      args.races)
+    tier = "smoke" if args.smoke else "full"
+    if args.dry_run:
+        for name, step_argv in plan:
+            print(f"step {name:<28} python -m {' '.join(step_argv)}",
+                  flush=True)
+        print(f"\nconformance (dry run): {len(plan)} step(s) planned "
+              f"[{tier} tier], nothing executed", flush=True)
+        return 0
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    # Steps control their own fault/locktrace arming; an inherited spec
+    # would skew every sweep the same way.
+    for var in ("KAI_FAULT_INJECT", "KAI_LOCKTRACE"):
+        env.pop(var, None)
+    rows, failed = [], []
+    for name, step_argv in plan:
+        print(f"\n== conformance [{tier}]: {name} ==", flush=True)
+        t0 = time.monotonic()
+        proc = subprocess.run([sys.executable, "-m", *step_argv],
+                              cwd=repo_root, env=env)
+        secs = time.monotonic() - t0
+        ok = proc.returncode == 0
+        rows.append((name, ok, secs))
+        if not ok:
+            failed.append(name)
+
+    print("\nconformance summary:", flush=True)
+    for name, ok, secs in rows:
+        print(f"  {name:<28} {'ok' if ok else 'FAIL':<5} {secs:7.1f}s",
+              flush=True)
+    print(f"conformance [{tier}]: "
+          f"{len(rows) - len(failed)}/{len(rows)} green", flush=True)
+    if failed:
+        print(f"conformance: FAILED steps: {', '.join(failed)}",
+              flush=True)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

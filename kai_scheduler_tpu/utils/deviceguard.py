@@ -186,7 +186,38 @@ def run_with_deadline(fn, deadline_s: float | None, label: str = "device"):
 # device-path FaultInjector skips these; components query them with
 # control_fault() below.  Specs compose comma-separated:
 #   KAI_FAULT_INJECT="flaky:0.2,watchdrop:3"
-CONTROL_FAULT_MODES = ("watchdrop", "partition", "crash-after-journal")
+#
+# Wire modes (PR 15, docs/DEGRADATION.md "wire faults"): the lying-wire
+# family, injected at the transport seams —
+#   wire-truncate:<n>   apiserver watch stream: after <n> frames, write
+#                       HALF of the next frame's bytes and close — the
+#                       client must reconnect from its cursor, losing
+#                       nothing.
+#   wire-corrupt:<n>    apiserver watch stream: corrupt every <n>th
+#                       frame's payload bytes (framing stays valid) —
+#                       an unparseable line must drop the stream, never
+#                       poison the store mirror.
+#   wire-stall:<ms>     apiserver watch stream: sleep <ms> before every
+#                       batch write — a stalled watcher may overrun the
+#                       ring and must get an explicit GONE.
+#   wire-reset:<n>      apiserver request path: every <n>th mutating
+#                       request is APPLIED, then the connection is
+#                       closed before any response bytes — the
+#                       mid-bulk-POST reset (ambiguous outcome).
+#   wire-storm:<n>      apiserver request path: answer the first <n>
+#                       requests 429/503 (alternating, Retry-After set,
+#                       store untouched) — the throttle storm.
+#   wire-gone:<n>       apiserver watch connects: the first <n> streams
+#                       answer 410 GONE regardless of cursor — the
+#                       compaction storm (client re-list backoff test).
+#   wire-drop:<n>       HTTP client shim: every <n>th mutating request
+#                       is sent, then the response is discarded and the
+#                       connection dropped (URLError) — "did my wave
+#                       land?" without killing the server.
+CONTROL_FAULT_MODES = ("watchdrop", "partition", "crash-after-journal",
+                       "wire-truncate", "wire-corrupt", "wire-stall",
+                       "wire-reset", "wire-storm", "wire-gone",
+                       "wire-drop")
 
 
 def control_fault(mode: str, env=None) -> str | None:

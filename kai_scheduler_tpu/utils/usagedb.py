@@ -95,6 +95,10 @@ class UsageLog:
         self.compact_every = compact_every
         self.fsync = fsync
         self._appends = 0
+        # True after a load() that hit a torn tail or CRC mismatch:
+        # the restore fell back to an OLDER checkpoint (or none), and
+        # the owner must degrade LOUDLY, not serve it as current.
+        self.last_load_corrupt = False
 
     def append(self, state: dict) -> None:
         from .commitlog import _encode
@@ -120,6 +124,7 @@ class UsageLog:
 
     def load(self) -> dict | None:
         from .commitlog import _decode
+        self.last_load_corrupt = False
         try:
             with open(self.path, "rb") as f:
                 lines = f.readlines()
@@ -129,7 +134,12 @@ class UsageLog:
         for line in lines:
             rec = _decode(line)
             if rec is None:
-                break  # torn tail: trust everything before it
+                # Torn tail (crash mid-append) or bit rot: trust
+                # everything before it — but REPORT it, because the
+                # restored state is older than the history claims and
+                # the fairness penalty computed from it is, too.
+                self.last_load_corrupt = True
+                break
             state = rec
         return state
 
@@ -167,6 +177,11 @@ class InMemoryUsageDB(UsageLister):
         self._pending_ts: float | None = None
         self._pending_duration = 1.0
         self._log: UsageLog | None = None
+        # True after a restore from a corrupt checkpoint log: the
+        # snapshot reports stale (degraded mode) until a fresh sample
+        # folds, regardless of how recent the salvaged state claims
+        # to be.
+        self.restored_corrupt = False
 
     # -- maintenance -------------------------------------------------------
     def _row(self, queue: str) -> int:
@@ -251,6 +266,9 @@ class InMemoryUsageDB(UsageLister):
             self._seen[self._qindex[queue]] = now
         self._state_ts = now
         self.last_record_ts = now
+        # Fresh data folded: a corrupt-restore degradation ends here —
+        # the tensor now carries at least one trustworthy sample.
+        self.restored_corrupt = False
         self._pending = {}
         self._pending_ts = None
         if self._log is not None:
@@ -298,9 +316,24 @@ class InMemoryUsageDB(UsageLister):
 
     def attach_log(self, path: str, fsync: bool = True) -> bool:
         """Arm checkpoint persistence at ``path``; restores the last
-        valid checkpoint first.  Returns True when state was restored."""
+        valid checkpoint first.  Returns True when state was restored.
+
+        A corrupt log (torn tail, CRC mismatch) restores whatever
+        prefix is trustworthy but enters the documented stale->degraded
+        mode LOUDLY: ``usage_log_corrupt_total`` fires, the snapshot
+        reports stale (the proportion plugin then ignores usage and
+        counts ``usage_stale_cycles_total``), and the flag clears only
+        when a FRESH sample folds — decayed history of unknown age must
+        not silently drive the fairness penalty."""
         self._log = UsageLog(path, fsync=fsync)
         state = self._log.load()
+        if self._log.last_load_corrupt:
+            METRICS.inc("usage_log_corrupt_total")
+            LOG.warning("usage log %s: torn/corrupt checkpoint tail — "
+                        "restoring the last valid prefix and entering "
+                        "degraded (usage-ignored) mode until fresh "
+                        "samples land", path)
+            self.restored_corrupt = True
         if state:
             self._restore(state)
             METRICS.inc("usage_restore_total")
@@ -339,7 +372,12 @@ class InMemoryUsageDB(UsageLister):
         (The old fetch-based check could never trip for the in-memory
         store — queue_usage itself refreshed the timestamp it compared
         against, silently serving decayed-to-zero values instead of
-        tripping the documented degraded mode.)"""
+        tripping the documented degraded mode.)  A restore from a
+        corrupt checkpoint log is stale BY FIAT until fresh data folds:
+        the salvaged state's own timestamps are exactly what the
+        corruption makes untrustworthy."""
+        if self.restored_corrupt:
+            return True
         last = self.last_record_ts if self._pending_ts is None \
             else self._pending_ts
         return (last is not None

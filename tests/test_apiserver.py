@@ -496,3 +496,79 @@ class TestSyncDeletions:
             time.sleep(0.02)
         assert ("DELETED", "doomed") in events
         c.close()
+
+
+class TestBulkReplayIdempotency:
+    """Satellite (PR 15): re-POSTing a half-applied bind wave after a
+    crash must return per-item fence-checked no-ops — NEVER double
+    binds, never a supersede that resets a landed request's status."""
+
+    def _wave(self, i_range):
+        return [{"kind": "BindRequest",
+                 "metadata": {"name": f"bind-u{i}",
+                              "namespace": "default"},
+                 "spec": {"podName": f"p{i}", "podUid": f"u{i}",
+                          "selectedNode": "n1"},
+                 "status": {"phase": "Pending"}} for i in i_range]
+
+    def test_replay_returns_per_item_noops_over_wire(self, client):
+        from kai_scheduler_tpu.utils.metrics import METRICS
+        first = client.create_many(self._wave(range(3)), supersede=True)
+        assert all(o["ok"] and not o.get("noop") for o in first)
+        uids = {o["object"]["spec"]["podUid"]:
+                o["object"]["metadata"]["uid"] for o in first}
+        rvs = {o["object"]["spec"]["podUid"]:
+               o["object"]["metadata"]["resourceVersion"] for o in first}
+        # Binder progress on one item: the replay must not reset it.
+        client.patch("BindRequest", "bind-u1", {"status":
+                                                {"phase": "Succeeded"}})
+        noops0 = METRICS.counters.get("bulk_replay_noops_total", 0)
+        # The crash-replay: identical wave (possibly extended), re-POSTed.
+        replay = client.create_many(self._wave(range(4)), supersede=True)
+        assert all(o["ok"] for o in replay)
+        assert [bool(o.get("noop")) for o in replay] == \
+            [True, True, True, False]
+        assert METRICS.counters.get("bulk_replay_noops_total", 0) \
+            == noops0 + 3
+        for o in replay[:3]:
+            uid = o["object"]["spec"]["podUid"]
+            assert o["object"]["metadata"]["uid"] == uids[uid], \
+                "replay recreated a landed request (uid changed)"
+        # The landed items kept their object identity and progress:
+        # no rv churn on untouched ones, status preserved on u1.
+        assert client.get("BindRequest", "bind-u0")["metadata"][
+            "resourceVersion"] == rvs["u0"]
+        assert client.get("BindRequest", "bind-u1")["status"][
+            "phase"] == "Succeeded"
+        # One live request per pod, exactly.
+        names = [br["spec"]["podName"]
+                 for br in client.list("BindRequest")]
+        assert sorted(names) == ["p0", "p1", "p2", "p3"]
+
+    def test_replay_noop_is_fence_checked(self, client):
+        """A deposed leader replaying its old wave gets 412 per item —
+        the no-op path must not become a fencing bypass."""
+        from kai_scheduler_tpu.controllers.kubeapi import Fenced
+        client.create({"kind": "Lease",
+                       "metadata": {"name": "sched",
+                                    "namespace": "kai-system"},
+                       "spec": {"epoch": 2}})
+        wave = self._wave(range(2))
+        first = client.create_many(wave, supersede=True,
+                                   epoch=2, fence="sched")
+        assert all(o["ok"] for o in first)
+        replay = client.create_many(self._wave(range(2)), supersede=True,
+                                    epoch=1, fence="sched")
+        assert all(not o["ok"] for o in replay)
+        assert all(isinstance(o["error"], Fenced) for o in replay)
+
+    def test_fresh_decision_still_supersedes(self, client):
+        """A DIFFERENT spec for the same name is a fresh scheduling
+        decision, not a replay: supersede semantics stay intact."""
+        client.create_many(self._wave(range(1)), supersede=True)
+        changed = self._wave(range(1))
+        changed[0]["spec"]["selectedNode"] = "n2"
+        out = client.create_many(changed, supersede=True)
+        assert out[0]["ok"] and not out[0].get("noop")
+        assert client.get("BindRequest", "bind-u0")["spec"][
+            "selectedNode"] == "n2"

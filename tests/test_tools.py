@@ -481,3 +481,81 @@ class TestChaosMatrixDryRun:
         chaos_matrix.run_iteration(3, ["tests/x.py"], "chaos", None,
                                    str(tmp_path), 5.0)
         assert "KAI_TRACE_DIR" not in captured["env"]
+
+
+class TestWireFaultsDryRun:
+    def test_dry_run_wire_faults_mode_selects_lying_wire_ring(
+            self, capsys, monkeypatch):
+        """--wire-faults sweeps the lying-wire ring (truncate/corrupt/
+        stall/reset/storm/GONE/drop + crash matrix over the wire +
+        anti-entropy convergence); composes with --wire/--pipeline."""
+        from kai_scheduler_tpu.tools import chaos_matrix
+        monkeypatch.setattr(
+            chaos_matrix.subprocess, "run",
+            lambda *a, **kw: (_ for _ in ()).throw(AssertionError(
+                "dry run must not execute iterations")))
+        rc = chaos_matrix.main(["--dry-run", "--wire-faults",
+                                "--seeds", "3,5"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert out.count("seed ") == 2
+        assert "tests/test_wire_faults.py" in out
+        assert "tests/test_reconciler.py" not in out
+        rc = chaos_matrix.main(["--dry-run", "--wire-faults", "--wire",
+                                "--seeds", "3"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "tests/test_wire_faults.py" in out
+        assert "tests/test_wire_protocol.py" in out
+
+
+class TestConformanceDryRun:
+    """tools/conformance.py: one command for every proof; the dry run
+    validates the step plan without spawning anything."""
+
+    def _no_spawn(self, monkeypatch):
+        from kai_scheduler_tpu.tools import conformance
+        monkeypatch.setattr(
+            conformance.subprocess, "run",
+            lambda *a, **kw: (_ for _ in ()).throw(AssertionError(
+                "dry run must not execute steps")))
+
+    def test_smoke_tier_plan(self, capsys, monkeypatch):
+        from kai_scheduler_tpu.tools import conformance
+        self._no_spawn(monkeypatch)
+        rc = conformance.main(["--smoke", "--dry-run"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "kailint" in out and "kairace" in out
+        # Every matrix mode's definition is validated...
+        for mode in ("arena", "incremental", "fused", "shards",
+                     "pipeline", "latency", "columnar", "wire",
+                     "timeaware", "wire-faults"):
+            assert f"matrix-def:{mode}" in out
+        # ...plus ONE real sweep of the newest ring.
+        assert "matrix:wire-faults(1 seed)" in out
+        # The budget is the full tier's (and ci_check's own) job.
+        assert "fleet-budget" not in out
+        assert "[smoke tier]" in out
+
+    def test_full_tier_plan_sweeps_everything_plus_budget(
+            self, capsys, monkeypatch):
+        from kai_scheduler_tpu.tools import conformance
+        self._no_spawn(monkeypatch)
+        rc = conformance.main(["--dry-run", "--seeds", "7,11"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        for mode in ("default", "arena", "incremental", "fused",
+                     "shards", "pipeline", "latency", "columnar",
+                     "wire", "timeaware", "wire-faults"):
+            assert f"matrix:{mode}" in out
+        assert "fleet-budget" in out
+        assert "--seeds 7,11" in out
+
+    def test_smoke_with_budget_pulls_the_gate_in(self, capsys,
+                                                 monkeypatch):
+        from kai_scheduler_tpu.tools import conformance
+        self._no_spawn(monkeypatch)
+        rc = conformance.main(["--smoke", "--with-budget", "--dry-run"])
+        assert rc == 0
+        assert "fleet-budget" in capsys.readouterr().out

@@ -272,3 +272,96 @@ class TestResolver:
 
     def test_unknown_scheme_disables(self):
         assert resolve_usage_client("bogus://x") is None
+
+
+class TestCorruptRestore:
+    """Satellite (PR 15): torn-tail and CRC-mismatch restores enter the
+    documented stale->degraded mode LOUDLY — ``usage_log_corrupt_total``
+    fires and every fetch reads stale (the proportion plugin then
+    ignores usage + counts ``usage_stale_cycles_total``) until a FRESH
+    sample folds.  Salvaged history of unknown age must never silently
+    drive the fairness penalty."""
+
+    def _metric(self, name):
+        from kai_scheduler_tpu.utils.metrics import METRICS
+        return METRICS.counters.get(name, 0)
+
+    def test_torn_tail_restore_is_loud_and_degraded(self, tmp_path):
+        path = str(tmp_path / "usage.log")
+        db = InMemoryUsageDB(UsageParams())
+        db.attach_log(path, fsync=False)
+        db.record_cycle(0.0, {"a": vec(gpu=2.0)})
+        with open(path, "ab") as f:
+            f.write(b"deadbeef {torn-json\n")
+        corrupt0 = self._metric("usage_log_corrupt_total")
+        db2 = InMemoryUsageDB(UsageParams())
+        assert db2.attach_log(path, fsync=False)  # prefix restored...
+        assert self._metric("usage_log_corrupt_total") == corrupt0 + 1
+        snap = db2.queue_usage(1.0)   # ...well inside the staleness
+        assert snap.stale, \
+            "corrupt restore served as fresh (degraded mode not taken)"
+
+    def test_crc_mismatch_mid_file_falls_back_loud(self, tmp_path):
+        """Bit rot INSIDE the file (CRC mismatch on a fully-formed
+        line): everything after it is untrusted — restore the prefix,
+        fire the metric, read stale."""
+        path = str(tmp_path / "usage.log")
+        db = InMemoryUsageDB(UsageParams())
+        db.attach_log(path, fsync=False)
+        db.record_cycle(0.0, {"a": vec(gpu=2.0)})
+        db.record_cycle(60.0, {"a": vec(gpu=6.0)})
+        with open(path, "rb") as f:
+            lines = f.readlines()
+        assert len(lines) == 2
+        rotted = bytearray(lines[1])
+        rotted[len(rotted) // 2] ^= 0xFF   # flip one payload bit
+        with open(path, "wb") as f:
+            f.write(lines[0] + bytes(rotted))
+        corrupt0 = self._metric("usage_log_corrupt_total")
+        db2 = InMemoryUsageDB(UsageParams())
+        assert db2.attach_log(path, fsync=False)
+        assert self._metric("usage_log_corrupt_total") == corrupt0 + 1
+        # The prefix (first checkpoint) is what survived.
+        assert db2.queue_usage(30.0)["a"][2] == 2.0
+        assert db2.queue_usage(30.0).stale
+
+    def test_fully_corrupt_log_restores_nothing_but_is_loud(
+            self, tmp_path):
+        path = str(tmp_path / "usage.log")
+        with open(path, "wb") as f:
+            f.write(b"not a checkpoint at all\n")
+        corrupt0 = self._metric("usage_log_corrupt_total")
+        db = InMemoryUsageDB(UsageParams())
+        assert not db.attach_log(path, fsync=False)
+        assert self._metric("usage_log_corrupt_total") == corrupt0 + 1
+        assert db.is_stale(0.0), "untrusted restore must read degraded"
+
+    def test_fresh_sample_ends_the_degradation(self, tmp_path):
+        path = str(tmp_path / "usage.log")
+        db = InMemoryUsageDB(UsageParams())
+        db.attach_log(path, fsync=False)
+        db.record_cycle(0.0, {"a": vec(gpu=2.0)})
+        with open(path, "ab") as f:
+            f.write(b"deadbeef {torn\n")
+        db2 = InMemoryUsageDB(UsageParams())
+        db2.attach_log(path, fsync=False)
+        assert db2.queue_usage(1.0).stale
+        db2.record_cycle(2.0, {"a": vec(gpu=1.0)})   # trustworthy data
+        assert not db2.queue_usage(3.0).stale, \
+            "degradation must end when fresh samples fold"
+
+    def test_proportion_degraded_mode_via_stale_snapshot(self, tmp_path):
+        """End to end into the plugin contract: the corrupt-restore
+        snapshot drives the proportion plugin's degraded path (usage
+        zeroed + usage_stale_cycles_total) exactly like outage
+        staleness does."""
+        path = str(tmp_path / "usage.log")
+        db = InMemoryUsageDB(UsageParams())
+        db.attach_log(path, fsync=False)
+        db.record_cycle(0.0, {"a": vec(gpu=8.0)})
+        with open(path, "ab") as f:
+            f.write(b"deadbeef {torn\n")
+        db2 = InMemoryUsageDB(UsageParams())
+        db2.attach_log(path, fsync=False)
+        snap = db2.queue_usage(1.0)
+        assert snap.stale and snap  # stale AND non-empty: the worst mix

@@ -1,0 +1,541 @@
+"""Lying-wire ring: fault-harden the daemon-scale substrate (PR 15).
+
+The hot paths PRs 12-14 rebuilt — columnar host state maintained
+O(delta) from watch payloads, bulk ``/bulk/*`` bind waves, the pooled
+apiserver — were written AFTER the chaos infrastructure of PRs 1-2, so
+until now they had never seen an injected fault.  This ring points the
+``wire-*`` fault family (utils/deviceguard.CONTROL_FAULT_MODES) at
+them and asserts the three invariants production cares about:
+
+- **zero double-binds / zero lost pods** under truncated and corrupted
+  watch frames, stalled streams, connection resets mid-bulk-POST,
+  429/503 storms, dropped responses, scheduler crash-replay, and an
+  apiserver restart (seq regression + boot-id change) mid-stream;
+- **anti-entropy convergence**: the cache digest reaches the apiserver
+  digest within a bounded number of cycles, divergence is repaired by
+  a targeted re-list, and a diverged columnar projection degrades the
+  fast path until two consecutive clean digests re-promote it
+  (utils/antientropy.py, ``ClusterCache.anti_entropy_check``);
+- **the scheduler never wedges**: every cycle completes within its
+  (generous) wall bound even while the wire lies.
+
+Seeded in the chaos-matrix style: ``KAI_FAULT_SEED`` reshuffles the
+churn stream per iteration (``chaos_matrix --wire-faults`` sweeps it).
+"""
+
+import os
+import time
+import urllib.error
+
+import numpy as np
+import pytest
+
+from kai_scheduler_tpu.controllers import (HTTPKubeAPI, KubeAPIServer,
+                                           System, SystemConfig, make_pod,
+                                           owner_ref)
+from kai_scheduler_tpu.controllers.cache_builder import ClusterCache
+from kai_scheduler_tpu.controllers.kubeapi import Conflict
+from kai_scheduler_tpu.utils.commitlog import CommitLog, SimulatedCrash
+from kai_scheduler_tpu.utils.metrics import METRICS
+
+pytestmark = pytest.mark.chaos
+
+SWEEP_SEED = int(os.environ.get("KAI_FAULT_SEED", "0") or 0)
+
+# Generous per-cycle wall bound: the "scheduler never wedges" invariant.
+# Orders of magnitude above a healthy loopback cycle; a cycle blocked on
+# an unbounded retry or a dead watch would blow through it.
+CYCLE_WALL_S = 30.0
+
+
+def make_node(api, name, gpu=8):
+    api.create({"kind": "Node", "metadata": {"name": name}, "spec": {},
+                "status": {"allocatable": {"cpu": "32", "memory": "256Gi",
+                                           "nvidia.com/gpu": gpu,
+                                           "pods": 110}}})
+
+
+def make_queue(api, name="q"):
+    api.create({"kind": "Queue", "metadata": {"name": name}, "spec": {}})
+
+
+def _counter(name, **labels):
+    if labels:
+        inner = ",".join(f'{k}="{v}"'
+                         for k, v in sorted(labels.items()))
+        return METRICS.counters.get(f"{name}{{{inner}}}", 0)
+    return METRICS.counters.get(name, 0)
+
+
+def _bound_pods(store_api):
+    return [p for p in store_api.list("Pod")
+            if p["spec"].get("nodeName")
+            and not p["metadata"].get("deletionTimestamp")]
+
+
+def _assert_no_double_binds(store_api):
+    """One live BindRequest per pod, one node per pod, never more GPU
+    demand on a node than it has."""
+    brs = store_api.list("BindRequest")
+    names = [br["spec"]["podName"] for br in brs]
+    assert len(names) == len(set(names)), \
+        f"duplicate BindRequests: {sorted(names)}"
+    per_node: dict = {}
+    for p in _bound_pods(store_api):
+        reqs = p["spec"]["containers"][0]["resources"]["requests"]
+        per_node[p["spec"]["nodeName"]] = \
+            per_node.get(p["spec"]["nodeName"], 0) \
+            + int(reqs.get("nvidia.com/gpu", 0) or 0)
+    for node, used in per_node.items():
+        alloc = int(store_api.get("Node", node)["status"]
+                    ["allocatable"]["nvidia.com/gpu"])
+        assert used <= alloc, f"{node} oversubscribed: {used}/{alloc}"
+
+
+def _drive_to_convergence(system, store_api, want_bound, max_cycles=40):
+    """Run cycles until ``want_bound`` pods are bound, tolerating
+    transient cycle failures while faults are armed (the daemon's run
+    loop retries; what must NEVER happen is a wedge or a double-bind).
+    A short inter-cycle pause models the daemon's cycle period — and
+    gives the watch thread's jittered reconnect backoff (the
+    anti-stampede contract) wall time to land its re-list.  Returns
+    the number of cycles it took."""
+    for cycle in range(1, max_cycles + 1):
+        t0 = time.monotonic()
+        try:
+            system.run_cycle()
+        except (urllib.error.URLError, OSError):
+            pass  # transient wire death: the next cycle retries
+        took = time.monotonic() - t0
+        assert took < CYCLE_WALL_S, \
+            f"cycle {cycle} wedged ({took:.1f}s) — deadline invariant"
+        if len(_bound_pods(store_api)) >= want_bound:
+            return cycle
+        time.sleep(0.1)
+    raise AssertionError(
+        f"not converged after {max_cycles} cycles: "
+        f"{len(_bound_pods(store_api))}/{want_bound} bound")
+
+
+class TestWatchFaultConvergence:
+    """Raw client vs a lying watch stream: every fault family must end
+    in convergence to the store, never in silent loss."""
+
+    def test_truncated_and_corrupted_frames_converge_no_loss(
+            self, monkeypatch):
+        rng = np.random.default_rng(1000 + SWEEP_SEED)
+        monkeypatch.setenv("KAI_FAULT_INJECT",
+                           "wire-corrupt:3,wire-truncate:7")
+        srv = KubeAPIServer().start()
+        client = HTTPKubeAPI(srv.url)
+        try:
+            client.watch("Pod", lambda et, obj: None)
+            reconnects0 = _counter("watch_reconnect_total")
+            live = set()
+            for i in range(40):
+                name = f"wf{i:03d}"
+                client.create(make_pod(name))
+                live.add(name)
+                if live and rng.random() < 0.25:
+                    victim = sorted(live)[int(rng.integers(len(live)))]
+                    client.delete("Pod", victim)
+                    live.discard(victim)
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                known = {k[2] for k in client._known if k[0] == "Pod"}
+                if known == live:
+                    break
+                time.sleep(0.05)
+            known = {k[2] for k in client._known if k[0] == "Pod"}
+            assert known == live, \
+                f"lost={sorted(live - known)} ghosts={sorted(known - live)}"
+            # The faults actually fired and the client actually paid
+            # reconnects — a sweep that injected nothing proves nothing.
+            assert _counter("wire_faults_injected_total",
+                            mode="wire-corrupt") > 0
+            assert _counter("wire_faults_injected_total",
+                            mode="wire-truncate") > 0
+            assert _counter("watch_reconnect_total") > reconnects0
+        finally:
+            client.close()
+            srv.stop()
+
+    def test_stalled_stream_overruns_ring_gets_gone_and_relists(
+            self, monkeypatch):
+        """A stalled watcher that falls behind a small event ring must
+        get an explicit GONE (never silently skipped history) and
+        converge through the re-list."""
+        monkeypatch.setenv("KAI_FAULT_INJECT", "wire-stall:200")
+        srv = KubeAPIServer(event_log_capacity=32).start()
+        client = HTTPKubeAPI(srv.url)
+        try:
+            client.watch("Pod", lambda et, obj: None)
+            time.sleep(0.2)
+            gaps0 = _counter("watch_gap_total")
+            for i in range(150):   # >> ring capacity, pumped fast
+                client.create(make_pod(f"st{i:03d}"))
+            monkeypatch.setenv("KAI_FAULT_INJECT", "")  # heal the wire
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                if len([k for k in client._known
+                        if k[0] == "Pod"]) == 150:
+                    break
+                time.sleep(0.05)
+            assert len([k for k in client._known if k[0] == "Pod"]) \
+                == 150
+            assert _counter("watch_gap_total") > gaps0, \
+                "the overrun never surfaced as a GONE re-list"
+        finally:
+            client.close()
+            srv.stop()
+
+
+class TestFleetUnderWireFaults:
+    """The flagship: a full System over loopback HTTP, churned while a
+    composite wire-fault spec is armed, then healed — zero double
+    binds, zero lost pods, digests converge, no cycle wedges."""
+
+    def test_fleet_converges_zero_double_binds_under_wire_faults(
+            self, monkeypatch):
+        rng = np.random.default_rng(2000 + SWEEP_SEED)
+        srv = KubeAPIServer().start()
+        client = HTTPKubeAPI(srv.url)
+        system = System(SystemConfig(anti_entropy_interval=3),
+                        api=client)
+        try:
+            for i in range(6):
+                make_node(client, f"n{i}")
+            make_queue(client, "fq0")
+            # Prime clean, then lie on the wire for the whole churn.
+            system.run_cycle()
+            monkeypatch.setenv(
+                "KAI_FAULT_INJECT",
+                "wire-corrupt:5,wire-drop:9,wire-storm:3,wire-stall:20")
+            submitted = 0
+            for wave in range(3):
+                name = f"g{wave}"
+                gang = int(rng.integers(4, 9))
+                ref = owner_ref("Job", name, uid=f"{name}-u",
+                                api_version="batch/v1")
+                for k in range(gang):
+                    # Setup writes may die on the lying wire: retry —
+                    # exactly what a real submitter does.  A Conflict
+                    # on the retry means the AMBIGUOUS earlier attempt
+                    # landed (the wire-drop contract): done.
+                    for _ in range(5):
+                        try:
+                            client.create(make_pod(
+                                f"{name}-{k}", owner=ref, gpu=1,
+                                queue="fq0"))
+                            break
+                        except Conflict:
+                            break
+                        except (urllib.error.URLError, OSError):
+                            time.sleep(0.05)
+                    else:
+                        raise AssertionError("submit never landed")
+                submitted += gang
+                _drive_to_convergence(system, srv.api, submitted)
+            assert _counter("wire_faults_injected_total",
+                            mode="wire-corrupt") > 0
+            # Heal, then drive the anti-entropy exchange to a clean
+            # verdict: the digest must CONVERGE within a bounded number
+            # of cycles, with any divergence repaired along the way.
+            monkeypatch.setenv("KAI_FAULT_INJECT", "")
+            cache = system.schedulers[0].cache
+            verdict = None
+            for _ in range(10):
+                system.run_cycle()
+                verdict = cache.anti_entropy_check()
+                if verdict["checked"] and not verdict["diverged"] \
+                        and verdict["columnar_ok"]:
+                    break
+            assert verdict["checked"] and not verdict["diverged"], \
+                f"digest never converged: {verdict}"
+            _assert_no_double_binds(srv.api)
+            assert len(_bound_pods(srv.api)) == submitted, "lost pods"
+        finally:
+            client.close()
+            system.stop_pipeline()
+            srv.stop()
+
+
+class TestCrashMatrixOverWire:
+    """kill -9 analogs mid bulk-bind-wave, OVER HTTP: the commit-log
+    replay + fencing epochs must yield zero double-binds and zero lost
+    pods on the wire dialect too (PR 2 proved it in-process only)."""
+
+    def test_scheduler_crash_mid_wave_over_wire_replays_clean(
+            self, tmp_path, monkeypatch):
+        log_path = str(tmp_path / "wire-bind.journal")
+        srv = KubeAPIServer().start()
+        client = HTTPKubeAPI(srv.url)
+        system = System(SystemConfig(commitlog_path=log_path), api=client)
+        try:
+            make_node(client, "n1")
+            make_queue(client)
+            ref = owner_ref("Job", "wirejob", uid="wirejob-u",
+                            api_version="batch/v1")
+            for i in range(3):
+                client.create(make_pod(f"wv{i}", queue="q", gpu=1,
+                                       owner=ref))
+            # Deliver + group WITHOUT scheduling, so the first cycle's
+            # statement commit is the gang's whole bind wave.
+            client.sync_watch(timeout=5.0)
+            system.drain()
+            monkeypatch.setenv("KAI_FAULT_INJECT", "crash-after-journal")
+            crashed = False
+            for _ in range(4):
+                try:
+                    system.run_cycle()
+                except SimulatedCrash:
+                    crashed = True
+                    break
+            assert crashed, "the wave never reached the journal point"
+            monkeypatch.delenv("KAI_FAULT_INJECT")
+            assert CommitLog(log_path).pending_intents()
+            client.close()
+
+            # "Restart": a fresh client + fleet over the SAME wire and
+            # journal, reconciling before the first cycle.
+            client2 = HTTPKubeAPI(srv.url)
+            system2 = System(SystemConfig(commitlog_path=log_path),
+                             api=client2)
+            try:
+                system2.startup_reconcile()
+                _drive_to_convergence(system2, srv.api, 3)
+                _assert_no_double_binds(srv.api)
+                for i in range(3):
+                    assert srv.api.get("Pod", f"wv{i}")["spec"] \
+                        .get("nodeName") == "n1"
+            finally:
+                client2.close()
+                system2.stop_pipeline()
+        finally:
+            system.stop_pipeline()
+            srv.stop()
+
+    def test_apiserver_restart_seq_regression_converges(self):
+        """Stop the apiserver mid-churn and boot a NEW one on the same
+        port and store: the event seq regresses and the boot id
+        changes — the client must take the GONE + re-list path (never
+        trust regressed sequence numbers) and the fleet must converge
+        with zero double-binds and a clean digest."""
+        store_holder = KubeAPIServer()   # owns the InMemoryKubeAPI store
+        store = store_holder.api
+        srv = store_holder.start()
+        port = srv.port
+        client = HTTPKubeAPI(srv.url)
+        system = System(SystemConfig(), api=client)
+        try:
+            for i in range(4):
+                make_node(client, f"rn{i}")
+            make_queue(client, "rq")
+            ref = owner_ref("Job", "rjob", uid="rjob-u",
+                            api_version="batch/v1")
+            for k in range(6):
+                client.create(make_pod(f"rp{k}", owner=ref, gpu=1,
+                                       queue="rq"))
+            _drive_to_convergence(system, store, 6)
+            gaps0 = _counter("watch_gap_total")
+
+            # Restart: same store, same port, NEW server lifetime (seq
+            # resets to 0, boot id changes) — plus more work submitted
+            # through the gap.
+            srv.stop()
+            time.sleep(0.1)
+            srv2 = KubeAPIServer(api=store, port=port).start()
+            try:
+                for k in range(6, 10):
+                    for _ in range(20):
+                        try:
+                            client.create(make_pod(
+                                f"rp{k}", owner=ref, gpu=1, queue="rq"))
+                            break
+                        except Conflict:
+                            break  # the ambiguous earlier try landed
+                        except (urllib.error.URLError, OSError):
+                            time.sleep(0.1)
+                    else:
+                        raise AssertionError("post-restart submit lost")
+                _drive_to_convergence(system, store, 10)
+                assert _counter("watch_gap_total") > gaps0, \
+                    "the restart never surfaced as a watch gap"
+                _assert_no_double_binds(store)
+                # Digest convergence across the restart: bounded cycles.
+                cache = system.schedulers[0].cache
+                verdict = None
+                for _ in range(10):
+                    system.run_cycle()
+                    verdict = cache.anti_entropy_check()
+                    if verdict["checked"] and not verdict["diverged"]:
+                        break
+                assert verdict["checked"] and not verdict["diverged"], \
+                    f"digest never converged after restart: {verdict}"
+            finally:
+                srv2.stop()
+        finally:
+            client.close()
+            system.stop_pipeline()
+
+    def test_bind_wave_ambiguous_death_replays_idempotently(self):
+        """The cache's bind wave survives an ambiguous transport death
+        (response lost AFTER the wave landed): one idempotent replay,
+        per-item fence-checked no-ops, exactly one BindRequest per pod
+        (``bind_wave_replays_total``)."""
+        from kai_scheduler_tpu.controllers.kubeapi import InMemoryKubeAPI
+
+        class AmbiguousOnceAPI(InMemoryKubeAPI):
+            """First create_many LANDS, then reports transport death —
+            the wire-reset/wire-drop outcome, deterministically."""
+
+            def __init__(self):
+                super().__init__()
+                self.dropped = False
+
+            def create_many(self, objs, **kw):
+                out = super().create_many(objs, **kw)
+                if not self.dropped:
+                    self.dropped = True
+                    raise urllib.error.URLError(
+                        "injected: response lost after the wave landed")
+                return out
+
+        api = AmbiguousOnceAPI()
+        cache = ClusterCache(api)
+
+        class BR:
+            gpu_groups, backoff_limit = [], 3
+            resource_claims, claim_allocations = [], []
+            trace_id = None
+
+        def task(i):
+            class T:
+                uid, name, namespace = f"u{i}", f"p{i}", "default"
+
+                class res_req:
+                    gpu_fraction = 0
+            return T()
+
+        replays0 = _counter("bind_wave_replays_total")
+        noops0 = _counter("bulk_replay_noops_total")
+        outcomes = cache.bind_many([(task(i), "n1", BR()) for i in
+                                    range(3)])
+        assert all(out.get("ok") for out in outcomes)
+        assert _counter("bind_wave_replays_total") == replays0 + 1
+        assert _counter("bulk_replay_noops_total") == noops0 + 3
+        names = [br["spec"]["podName"] for br in api.list("BindRequest")]
+        assert sorted(names) == ["p0", "p1", "p2"], \
+            "replay duplicated or lost binds"
+
+
+class TestAntiEntropyRepair:
+    """The digest exchange itself: a parsed-but-wrong frame (the lie
+    anti-entropy exists for — corruption that still parses) diverges,
+    repairs via targeted re-list, quarantines the columnar path, and
+    re-promotes after two clean digests."""
+
+    def _primed_cache_over_wire(self):
+        srv = KubeAPIServer().start()
+        client = HTTPKubeAPI(srv.url)
+        for i in range(3):
+            make_node(client, f"an{i}")
+        make_queue(client, "aq")
+        for k in range(5):
+            client.create(make_pod(f"ap{k}", gpu=1, queue="aq",
+                                   labels={"kai.scheduler/pod-group":
+                                           "ag"}))
+        cache = ClusterCache(client)
+        client.sync_watch(timeout=5.0)
+        cache.snapshot()   # priming re-list
+        cache.snapshot()   # first watch-mode fold
+        return srv, client, cache
+
+    def test_parsed_but_wrong_frame_diverges_repairs_repromotes(self):
+        srv, client, cache = self._primed_cache_over_wire()
+        try:
+            verdict = cache.anti_entropy_check()
+            assert verdict["checked"] and not verdict["diverged"], \
+                f"clean cache read diverged: {verdict}"
+            # The lie: a frame whose JSON parsed but whose content is
+            # wrong, at an UNCHANGED resourceVersion — undetectable by
+            # any rv/sig comparison, exactly what the content digest
+            # is for.
+            import copy as _copy
+            key = ("default", "ap3")
+            poisoned = _copy.deepcopy(cache._mirror["Pod"][key])
+            poisoned["spec"]["nodeName"] = "liar-node"
+            cache._mirror["Pod"][key] = poisoned
+            div0 = _counter("cache_divergence_total", kind="Pod")
+            verdict = cache.anti_entropy_check()
+            assert verdict["diverged"] == ["Pod"]
+            assert verdict["quarantined"] is True
+            assert _counter("cache_divergence_total", kind="Pod") \
+                == div0 + 1
+            # The repair re-list was enqueued: one snapshot folds truth
+            # back in; the NEXT check is clean (bounded convergence).
+            cache.snapshot()
+            assert cache.last_columnar_stats.get("reason") \
+                == "anti-entropy", "quarantine did not gate the snapshot"
+            assert cache._mirror["Pod"][key]["spec"].get("nodeName") \
+                != "liar-node"
+            v1 = cache.anti_entropy_check()
+            assert v1["checked"] and not v1["diverged"] \
+                and v1["columnar_ok"]
+            assert v1["quarantined"] is True, "re-promoted after ONE"
+            v2 = cache.anti_entropy_check()
+            assert v2["quarantined"] is False, \
+                "two clean digests must re-promote the columnar path"
+            cache.snapshot()
+            assert cache.last_columnar_stats.get("path") == "columnar" \
+                or cache.last_columnar_stats.get("reason") \
+                not in ("anti-entropy",)
+        finally:
+            client.close()
+            srv.stop()
+
+    def test_check_skips_while_lagging_never_false_alarms(
+            self, monkeypatch):
+        """An event still in flight on the wire is lag, not loss: the
+        check must answer "lagging"/"dirty", never divergence."""
+        srv, client, cache = self._primed_cache_over_wire()
+        try:
+            # Stall the stream so the next mutation's echo is in
+            # flight while we digest.
+            monkeypatch.setenv("KAI_FAULT_INJECT", "wire-stall:400")
+            writer = HTTPKubeAPI(srv.url)   # a SECOND writer's mutation
+            writer.create(make_pod("lagged", queue="aq"))
+            writer.close()
+            div0 = sum(v for k, v in METRICS.counters.items()
+                       if k.startswith("cache_divergence_total"))
+            verdict = cache.anti_entropy_check()
+            assert verdict["skipped"] in ("lagging", "dirty"), verdict
+            assert sum(v for k, v in METRICS.counters.items()
+                       if k.startswith("cache_divergence_total")) \
+                == div0, "in-flight lag counted as divergence"
+        finally:
+            client.close()
+            srv.stop()
+
+
+class TestChaosMatrixWireFaults:
+    def test_chaos_matrix_wire_faults_smoke(self):
+        """3 seeds of the fast subset of this ring through the matrix
+        harness — the tier-1 guard that the ``--wire-faults`` mode is
+        wired and the ring is seed-stable (the full sweep is the
+        stress marker's job)."""
+        from kai_scheduler_tpu.tools.chaos_matrix import main
+        rc = main(["--iterations", "3", "--wire-faults",
+                   "-k", "converge or replays or lagging",
+                   "--timeout", "300"])
+        assert rc == 0
+
+
+@pytest.mark.stress
+@pytest.mark.slow
+class TestChaosMatrixWireFaultsStress:
+    def test_chaos_matrix_wire_faults_full_sweep(self):
+        from kai_scheduler_tpu.tools.chaos_matrix import main
+        rc = main(["--iterations", "10", "--wire-faults",
+                   "--timeout", "600"])
+        assert rc == 0

@@ -461,3 +461,58 @@ class TestWireCacheMode:
         finally:
             c.close()
             srv.stop()
+
+
+class TestGoneStormBackoff:
+    """Satellite (PR 15): a 410-GONE compaction storm must not turn
+    the watcher into a synchronized re-list stampede — repeated GONEs
+    back off with a cap and FULL jitter before each re-list."""
+
+    def test_gone_storm_relists_are_paced_not_stampeding(
+            self, monkeypatch):
+        monkeypatch.setenv("KAI_FAULT_INJECT", "wire-gone:50")
+        srv = KubeAPIServer().start()
+        c = HTTPKubeAPI(srv.url)
+        try:
+            c.create(make_pod("storm-seed"))
+            gaps0 = _counter("watch_gap_total")
+            backoffs0 = _counter("watch_gone_backoffs_total")
+            c.watch("Pod", lambda et, obj: None)
+            window_s = 2.0
+            time.sleep(window_s)
+            gaps = _counter("watch_gap_total") - gaps0
+            # Every GONE re-listed (the storm was real)...
+            assert gaps >= 2, f"storm never engaged ({gaps} gaps)"
+            # ...but the train is paced: an unpaced loop turns one
+            # GONE+relist round trip (~ms on loopback) into hundreds
+            # of re-lists in this window.  With capped exponential
+            # full-jitter backoff the expected count is single-digit.
+            assert gaps <= 15, \
+                f"{gaps} re-lists in {window_s}s — the storm stampeded"
+            assert _counter("watch_gone_backoffs_total") > backoffs0, \
+                "repeated GONEs never took the backoff path"
+        finally:
+            c.close()
+            srv.stop()
+
+    def test_storm_breaks_cleanly_when_wire_heals(self, monkeypatch):
+        """After the storm, one healthy stream resets the streak and
+        event flow resumes with no residual backoff penalty."""
+        monkeypatch.setenv("KAI_FAULT_INJECT", "wire-gone:2")
+        srv = KubeAPIServer().start()
+        c = HTTPKubeAPI(srv.url)
+        try:
+            c.watch("Pod", lambda et, obj: None)
+            time.sleep(0.5)   # storm (2 GONEs) passes
+            monkeypatch.setenv("KAI_FAULT_INJECT", "")
+            c.create(make_pod("healed"))
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if ("Pod", "default", "healed") in c._known:
+                    break
+                time.sleep(0.05)
+            assert ("Pod", "default", "healed") in c._known
+            assert c._gone_streak == 0, "healthy stream kept the streak"
+        finally:
+            c.close()
+            srv.stop()
