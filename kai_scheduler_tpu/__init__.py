@@ -35,3 +35,13 @@ if _os.environ.get("KAI_LOCKTRACE", "") not in ("", "0", "false"):
     from .utils.locktrace import install_from_env as _locktrace_install
 
     _locktrace_install()
+
+# KAI_JITTRACE=1 (runtime compile-budget audit, utils/jittrace.py):
+# wrap the jitted kernel surface before any caller binds a kernel
+# reference — `from ..ops.x import k` at a host module's import would
+# otherwise capture the unwrapped function and its compiles would never
+# reach the journal.
+if _os.environ.get("KAI_JITTRACE", "") not in ("", "0", "false"):
+    from .utils.jittrace import install_from_env as _jittrace_install
+
+    _jittrace_install()

@@ -1,9 +1,18 @@
-"""Admission: mutating + validating webhooks for incoming pods.
+"""Admission: mutating + validating webhooks for incoming objects.
 
 Mirrors pkg/admission/ (plugin interface plugins/plugins.go:13-17; webhooks
 webhook/v1alpha2/{gpusharing,podhooks,runtimeenforcement}): normalize
 fractional-GPU requests expressed as annotations into scheduler-readable
 form, enforce the scheduler runtime class, and validate queue labels.
+
+DRA selector validation: DeviceClass / ResourceClaim /
+ResourceClaimTemplate CEL device selectors are checked against the
+SAME conservative subset the snapshot parser evaluates
+(cache_builder._parse_device_selectors).  An expression outside the
+subset matches NOTHING at schedule time (never too-wide), which
+surfaces as an inscrutable "doesn't fit" — so admission rejects it
+LOUDLY up front, naming the unsupported expression, instead of
+silently accepting an object the scheduler can never satisfy.
 """
 
 from __future__ import annotations
@@ -30,8 +39,12 @@ class Admission:
         self.enforced_runtime_class = enforced_runtime_class
         if api is not None:
             api.watch("Pod", self._on_pod)
+            for kind in self.DRA_SELECTOR_KINDS:
+                api.watch(kind, self._on_dra_object)
 
     UTILITY_NAMESPACES = ("kai-resource-reservation", "kai-scale-adjust")
+    DRA_SELECTOR_KINDS = ("DeviceClass", "ResourceClaim",
+                          "ResourceClaimTemplate")
 
     def _on_pod(self, event_type: str, pod: dict) -> None:
         if event_type != "ADDED":
@@ -82,3 +95,47 @@ class Admission:
                     and self.require_queue_label:
                 raise AdmissionError(
                     f"queue {labels[QUEUE_LABEL]!r} does not exist")
+
+    # -- DRA device-selector validating webhook ------------------------------
+    def _on_dra_object(self, event_type: str, obj: dict) -> None:
+        if event_type in ("ADDED", "MODIFIED"):
+            self.validate_device_selectors(obj)
+
+    @staticmethod
+    def _selector_lists(obj: dict):
+        """Every (location, raw selector list) the scheduler will later
+        evaluate: DeviceClass carries spec.selectors; claims (and the
+        template's inner claim spec) carry per-request selectors."""
+        kind = obj.get("kind")
+        spec = obj.get("spec") or {}
+        if kind == "DeviceClass":
+            yield "spec.selectors", spec.get("selectors")
+            return
+        if kind == "ResourceClaimTemplate":
+            spec = spec.get("spec") or {}
+        requests = (spec.get("devices") or {}).get("requests") or []
+        for i, req in enumerate(requests):
+            yield f"devices.requests[{i}].selectors", req.get("selectors")
+
+    def validate_device_selectors(self, obj: dict) -> None:
+        """Reject selectors the snapshot's CEL subset cannot evaluate.
+
+        Uses the SAME parser the cache builder runs per snapshot, so
+        admission and scheduling can never disagree about what is
+        supported."""
+        from .cache_builder import _parse_device_selectors
+        kind = obj.get("kind", "?")
+        name = obj.get("metadata", {}).get("name", "?")
+        for where, raw in self._selector_lists(obj):
+            for entry in _parse_device_selectors(raw):
+                if not entry.get("unsupported"):
+                    continue
+                expr = entry.get("cel", "<non-CEL selector shape>")
+                raise AdmissionError(
+                    f"{kind}/{name} {where}: device selector outside "
+                    f"the supported CEL subset would match NOTHING at "
+                    f"schedule time: {expr!r}; supported: "
+                    f'device.attributes["<domain>"].<name> == <literal> '
+                    f"/ in [<literals>], device.capacity >= "
+                    f'quantity("<q>"), device.driver == "<driver>", '
+                    f"and && conjunctions of those")

@@ -676,8 +676,14 @@ class Session:
                 task_tol[row, :len(tol)] = tol
                 task_job[row] = j
                 row += 1
-        job_allowed = np.ones(len(job_chunks) + 1, bool)
-        job_allowed[-1] = False
+        # Bucket the job axis too (KJT001): [J+1] exact would retrace
+        # the allocate kernel per distinct live gang count.  Padding
+        # jobs are gated out (allowed=False) and own no tasks, so the
+        # kernel never reads them; consumers index success[j] for real
+        # jobs only.
+        j_pad = _next_pow2(len(job_chunks) + 1)
+        job_allowed = np.ones(j_pad, bool)
+        job_allowed[len(job_chunks):] = False
 
         n_nodes = self.node_idle.shape[0]
         extra = np.zeros((t_pad, n_nodes))

@@ -272,8 +272,46 @@ def grove_grouper(owner, pod, api=None):
 
 def spark_grouper(owner, pod, api=None):
     """Spark driver/executor pods (plugins/spark): driver first, one group
-    per application id."""
+    per application id.  Label-keyed — the fallback for BARE spark-submit
+    pods with no operator CR; operator-managed apps route to the
+    spec-derived ``sparkapplication_grouper``."""
     meta = _base(owner, pod, defaults=TRAIN)
+    if pod is not None:
+        app = _labels(pod).get("spark-app-selector")
+        if app:
+            meta.name = f"pg-spark-{app}"
+    return meta
+
+
+def sparkapplication_grouper(owner, pod, api=None):
+    """sparkoperator.k8s.io SparkApplication (plugins/spark): gang =
+    driver + executors, derived from the CR spec rather than waiting for
+    executor pods to carry labels.  With dynamicAllocation enabled the
+    floor drops to minExecutors — the app is functional once the driver
+    and the minimum executor set run; extra executors arrive as
+    non-gang elastic pods."""
+    meta = _base(owner, pod, defaults=TRAIN)
+    spec = _spec(owner)
+    dyn = spec.get("dynamicAllocation") or {}
+    if dyn.get("enabled"):
+        executors = int(dyn.get("minExecutors", 0))
+    else:
+        executors = int((spec.get("executor") or {}).get("instances", 1))
+    meta.min_member = 1 + executors
+    meta.pod_sets = [PodSetSpec("driver", 1)] + (
+        [PodSetSpec("executor", executors)] if executors else [])
+    return meta
+
+
+def scheduledspark_grouper(owner, pod, api=None):
+    """sparkoperator.k8s.io ScheduledSparkApplication: the CR's template
+    wraps a SparkApplication spec; the gang math comes from that inner
+    spec, and each spawned run groups by its application id (the
+    operator stamps spark-app-selector per run)."""
+    tmpl = _spec(owner).get("template") or {}
+    shim = dict(owner)
+    shim["spec"] = tmpl.get("spec", tmpl)
+    meta = sparkapplication_grouper(shim, pod, api)
     if pod is not None:
         app = _labels(pod).get("spark-app-selector")
         if app:
@@ -430,7 +468,9 @@ GROUPER_TABLE = {
     ("batch.volcano.sh", "Job"): volcano_job_grouper,
     ("flink.apache.org", "FlinkDeployment"): flink_grouper,
     ("workload.codeflare.dev", "AppWrapper"): appwrapper_grouper,
-    ("sparkoperator.k8s.io", "SparkApplication"): spark_grouper,
+    ("sparkoperator.k8s.io", "SparkApplication"): sparkapplication_grouper,
+    ("sparkoperator.k8s.io", "ScheduledSparkApplication"):
+        scheduledspark_grouper,
     ("amlarc.azureml.com", "AmlJob"): aml_grouper,
     ("workspace.devfile.io", "DevWorkspace"): default_grouper,
     ("tekton.dev", "PipelineRun"): default_grouper,
@@ -457,7 +497,7 @@ for _g in (default_grouper, k8s_job_grouper, kubeflow_grouper,
            mpi_grouper, notebook_grouper, ray_grouper, jobset_grouper,
            knative_grouper, kubevirt_grouper, aml_grouper,
            spotrequest_grouper, volcano_job_grouper, flink_grouper,
-           appwrapper_grouper):
+           appwrapper_grouper, sparkapplication_grouper):
     _g.pod_inputs = "base"
 
 

@@ -707,8 +707,11 @@ def _allocate_groups_packed(node_allocatable, node_idle, node_releasing,
     G = group_req.shape[0]
     if group_indep is None:
         group_indep = jnp.zeros(G, bool)
+    # G mirrors group_req's leading axis, an operand of this very call:
+    # the caller already bucketed it, and the default group_indep can
+    # mint no signature the kernel doesn't already key on.
     (seg_nodes, seg_counts, seg_pipe, _group_placed, job_success,
-     idle, rel) = allocate_groups_kernel(
+     idle, rel) = allocate_groups_kernel(  # kaijit: disable=KJT001
         node_allocatable, node_idle, node_releasing, node_labels,
         node_taints, node_pod_room, group_req, group_sel, group_tol,
         group_count, group_job, job_allowed, max_group,

@@ -15,11 +15,21 @@ shapes, not one per delta size.  Dispatch is host-side via
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
 
-@jax.jit
+# kaijit: resident-state=idle,releasing,room
+# The value buffers are donated (KJT006): they are rebuilt host-side via
+# jnp.asarray on EVERY dispatch (framework/arena.py), so a deviceguard
+# retry re-creates them and donation is retry-safe; the resident arrays
+# must NOT be donated — the functional old-state-on-failure contract
+# and the retry both re-read them.
+@functools.partial(jax.jit, donate_argnames=("idle_vals",
+                                             "releasing_vals",
+                                             "room_vals"))
 def apply_deltas_kernel(idle, releasing, room, rows, idle_vals,
                         releasing_vals, room_vals):
     """Scatter row updates into the resident state arrays.

@@ -351,6 +351,11 @@ def fair_share_levels(total: np.ndarray, k_value: float,
             group_totals = fair[uniq_parents]
         spec = LevelSpec(num_groups=group_totals.shape[0],
                          num_bands=hierarchy.num_bands)
+        # kaijit: disable=KJT001 — level widths follow the QUEUE
+        # hierarchy (control-plane config: reconfig events, not
+        # per-cycle live pod counts), so exact shapes here trade a
+        # rare reconfig retrace for minimal per-level kernels; the
+        # per-cycle hot path uses the bucketed forest entry points.
         out = divide_groups_jax(
             spec, jnp.asarray(group_totals), jnp.asarray(group_of),
             jnp.asarray(hierarchy.band_of_queue[idxs]),

@@ -56,6 +56,8 @@ from .plugins.snapshot_plugin import dump_cluster
 from .utils import parse_bool as _parse_bool
 from .utils.deviceguard import configure_device_guard, device_guard
 from .utils.lifecycle import LIFECYCLE
+from .utils.jittrace import TRACER as JITTRACE
+from .utils.jittrace import sync_metrics as jittrace_sync_metrics
 from .utils.locktrace import TRACER as LOCKTRACE
 from .utils.locktrace import sync_metrics as locktrace_sync_metrics
 from .utils.logging import LOG, init_loggers
@@ -81,6 +83,14 @@ def healthz_payload(state: dict | None = None) -> dict:
         # kairace graph (docs/STATIC_ANALYSIS.md).
         locktrace_sync_metrics()
         payload["locktrace"] = LOCKTRACE.stats()
+    if JITTRACE.installed:
+        # Runtime compile-budget audit (KAI_JITTRACE=1): surface the
+        # compile-signature journal so a fleet run shows the tracer is
+        # recording — the offline half (fleet_budget / chaos_matrix
+        # --compile) merges the journals against the static kaijit
+        # model (docs/STATIC_ANALYSIS.md).
+        jittrace_sync_metrics()
+        payload["jittrace"] = JITTRACE.stats()
     state = state or {}
     elector = state.get("lease_elector")
     control: dict = {}
@@ -170,6 +180,8 @@ def _make_handler(server_state):
             if path == "/metrics":
                 if LOCKTRACE.installed:
                     locktrace_sync_metrics()
+                if JITTRACE.installed:
+                    jittrace_sync_metrics()
                 body = METRICS.to_prometheus_text().encode()
                 ctype = "text/plain"
             elif path == "/healthz":

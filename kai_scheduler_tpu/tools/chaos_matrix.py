@@ -94,6 +94,15 @@ WIRE_TESTS = ["tests/test_wire_protocol.py"]
 # including scheduler crash-replay and apiserver restart (seq
 # regression) mid bulk-bind-wave.
 WIRE_FAULT_TESTS = ["tests/test_wire_faults.py"]
+# --compile: the compile-contract ring — the kernel-heaviest suites
+# (fused-parity regenerates randomized workloads per seed; rankplace
+# and usagedb sweep the rank & time kernels) run with KAI_JITTRACE=1
+# (utils/jittrace.py journals each kernel's abstract call signatures =
+# XLA compilation keys) and the merged journals are validated against
+# the static kaijit surface: a kernel that compiled at runtime but was
+# never discovered statically is an analyzer gap and fails the sweep.
+COMPILE_TESTS = ["tests/test_fused_parity.py", "tests/test_rankplace.py",
+                 "tests/test_usagedb.py"]
 
 
 def run_iteration(seed: int, tests: list[str], marker: str,
@@ -118,6 +127,9 @@ def run_iteration(seed: int, tests: list[str], marker: str,
     # iterations overwrite each other's dumps.
     for var in ("KAI_LOCKTRACE", "KAI_LOCKTRACE_OUT",
                 "KAI_LOCKTRACE_GRAPH"):
+        env.pop(var, None)
+    # Same for the compile-signature journal: only --compile arms it.
+    for var in ("KAI_JITTRACE", "KAI_JITTRACE_OUT"):
         env.pop(var, None)
     env.update(extra_env or {})
     if trace_dir:
@@ -224,6 +236,18 @@ def main(argv=None) -> int:
                          "lock graph; any contradiction, uncovered "
                          "threaded subsystem, or empty journal fails "
                          "the sweep.  Composes with every mode flag")
+    ap.add_argument("--compile", action="store_true",
+                    help="compile-contract validation: sweep the kernel-"
+                         f"heaviest suites ({COMPILE_TESTS}) with "
+                         "KAI_JITTRACE=1 (every jitted kernel journals "
+                         "its abstract call signatures = XLA compile "
+                         "keys — utils/jittrace.py) and validate the "
+                         "merged journals against the static kaijit "
+                         "surface; a runtime compile from a kernel the "
+                         "static model never discovered, or an empty "
+                         "journal, fails the sweep.  Composes with "
+                         "every mode flag (adds its suites + arms the "
+                         "tracer for all of them)")
     ap.add_argument("-k", "--keyword", default=None,
                     help="pytest -k filter (narrow the smoke subset)")
     ap.add_argument("--marker", default="chaos",
@@ -259,7 +283,8 @@ def main(argv=None) -> int:
             (COLUMNAR_TESTS if args.columnar else []) + \
             (TIMEAWARE_TESTS if args.timeaware else []) + \
             (WIRE_TESTS if args.wire else []) + \
-            (WIRE_FAULT_TESTS if args.wire_faults else [])
+            (WIRE_FAULT_TESTS if args.wire_faults else []) + \
+            (COMPILE_TESTS if args.compile else [])
         if not tests:
             tests = DEFAULT_TESTS
     repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
@@ -282,12 +307,18 @@ def main(argv=None) -> int:
                   f"timeout={args.timeout:g}s  "
                   f"trace-dir={seed_trace_dir(seed) or '-'}  "
                   f"races={'on' if args.races else 'off'}  "
+                  f"compile={'on' if args.compile else 'off'}  "
                   f"tests={' '.join(tests)}",
                   flush=True)
         if args.races:
             print("races mode: each iteration runs with KAI_LOCKTRACE=1 "
                   "+ a per-seed journal; merged orders are validated "
                   "against the static kairace lock graph", flush=True)
+        if args.compile:
+            print("compile mode: each iteration runs with KAI_JITTRACE=1 "
+                  "+ a per-seed journal; merged compile signatures are "
+                  "validated against the static kaijit surface",
+                  flush=True)
         print(f"\nchaos matrix (dry run): {len(seeds)} iteration(s) "
               f"planned, nothing executed", flush=True)
         return 0
@@ -325,13 +356,43 @@ def main(argv=None) -> int:
                 "KAI_LOCKTRACE_GRAPH": os.path.join(races_dir,
                                                     "lock_graph.json")}
 
+    compile_dir, compile_surface = None, None
+    if args.compile:
+        # The static jit surface is computed ONCE per sweep — the SAME
+        # discovery kaijit runs (tools/kailint/jitsurface.py), so the
+        # journal and the static model cannot drift.
+        import tempfile
+
+        from ..utils.jittrace import discover_surface
+        compile_surface = discover_surface()
+        if compile_surface["errors"]:
+            for err in compile_surface["errors"]:
+                print(f"compile: static-surface parse error: {err}",
+                      flush=True)
+            return 1
+        compile_dir = tempfile.mkdtemp(prefix="kai-jittrace-")
+        n_jitted = sum(1 for d in compile_surface["kernels"].values()
+                       if d.get("jitted"))
+        print(f"compile: static jit surface: {n_jitted} jitted "
+              f"kernel(s) across "
+              f"{len(compile_surface['kernels'])} surface entries",
+              flush=True)
+
+    def compile_env(seed: int) -> dict:
+        if not args.compile:
+            return {}
+        return {"KAI_JITTRACE": "1",
+                "KAI_JITTRACE_OUT": os.path.join(compile_dir,
+                                                 f"seed{seed}.json")}
+
     rows, failed = [], []
     for seed in seeds:
         tdir = seed_trace_dir(seed)
         ok, secs, tail = run_iteration(seed, tests, args.marker,
                                        args.keyword, repo_root,
                                        args.timeout, trace_dir=tdir,
-                                       extra_env=races_env(seed))
+                                       extra_env={**races_env(seed),
+                                                  **compile_env(seed)})
         rows.append((seed, ok, secs))
         status = "ok" if ok else "FLAKE"
         print(f"seed {seed:>6}  {status:<5}  {secs:6.1f}s", flush=True)
@@ -360,12 +421,21 @@ def main(argv=None) -> int:
             # repeated CI/soak runs would accumulate them unbounded.
             shutil.rmtree(races_dir, ignore_errors=True)
 
+    compile_red = False
+    if args.compile:
+        compile_red = not _report_compile(compile_dir, compile_surface,
+                                          seeds)
+        if compile_red or failed:
+            print(f"compile: journals kept in {compile_dir}", flush=True)
+        else:
+            shutil.rmtree(compile_dir, ignore_errors=True)
+
     if failed:
         print("replay a flake with: "
               f"KAI_FAULT_SEED={failed[0]} python -m pytest -m "
               f"{args.marker} {' '.join(tests)}", flush=True)
         return 1
-    return 1 if races_red else 0
+    return 1 if (races_red or compile_red) else 0
 
 
 def _report_races(races_dir: str, graph: dict, seeds: list) -> bool:
@@ -409,6 +479,43 @@ def _report_races(races_dir: str, graph: dict, seeds: list) -> bool:
     if not report["orders"]:
         print("races: EMPTY journal — a validator that records nothing "
               "validates nothing", flush=True)
+    return report["ok"]
+
+
+def _report_compile(compile_dir: str, surface: dict,
+                    seeds: list) -> bool:
+    """Merge the per-seed jittrace journals, validate against the
+    static kaijit surface, print the signature table.  True = green."""
+    import json as _json
+
+    from ..utils.jittrace import validate_observed
+    dumps = []
+    for seed in seeds:
+        path = os.path.join(compile_dir, f"seed{seed}.json")
+        try:
+            with open(path, encoding="utf-8") as fh:
+                dumps.append(_json.load(fh))
+        except (OSError, ValueError):
+            print(f"compile: seed {seed}: no journal at {path} "
+                  f"(iteration died before the atexit dump?)",
+                  flush=True)
+    report = validate_observed(surface, dumps)
+
+    print("\ncompile: distinct signatures (XLA compile keys) per "
+          "kernel, max across seeds:", flush=True)
+    for kernel, n in report["kernels"].items():
+        short = kernel.replace("kai_scheduler_tpu.", "")
+        print(f"  {short:<44} sigs={n:>3}  "
+              f"calls={report['calls'].get(kernel, 0):>7}", flush=True)
+    print(f"compile: {len(report['kernels'])} kernel(s) journaled, "
+          f"{len(report['unexplained'])} unexplained", flush=True)
+    for kernel in report["unexplained"]:
+        print(f"compile: UNEXPLAINED: {kernel} compiled at runtime but "
+              f"the static kaijit surface never discovered it — the "
+              f"analyzer's discovery has a gap", flush=True)
+    if not report["kernels"]:
+        print("compile: EMPTY journal — a validator that records "
+              "nothing validates nothing", flush=True)
     return report["ok"]
 
 

@@ -12,12 +12,19 @@
 #                 KRC001-KRC005 (docs/STATIC_ANALYSIS.md) — the
 #                 committed baseline (.kairace-baseline.json) is EMPTY
 #                 by contract, so any finding is a new race to fix
+#   kaijit        the whole-program JAX compilation-contract rules
+#                 KJT001-KJT006 (docs/STATIC_ANALYSIS.md) — unbucketed
+#                 shapes feeding jit, retrace-prone static args, traced
+#                 host escapes, dtype-pin violations, mutable closure
+#                 captures, donation contract; the committed baseline
+#                 (.kaijit-baseline.json) is EMPTY by contract
 #   chaos matrix  --dry-run validation of the fault-grid definition
 #                 (including the --races KAI_LOCKTRACE lock-order
-#                 validation mode and the --wire-faults lying-wire ring)
+#                 validation mode, the --wire-faults lying-wire ring,
+#                 and the --compile KAI_JITTRACE compile-contract ring)
 #   conformance   tools/conformance.py --smoke: every proof in one
-#                 command — both analyzers, every chaos-matrix mode
-#                 definition, and a real 1-seed wire-faults sweep
+#                 command — all three analyzers, every chaos-matrix
+#                 mode definition, and a real 1-seed wire-faults sweep
 #   kernel parity fused-allocation ladder (Pallas/jnp/legacy) vs the
 #                 exact kernel: placements must be bit-identical
 #                 (tools/kernel_parity.py --smoke)
@@ -35,7 +42,10 @@
 #                 served, snapshot-build ceiling), and the http
 #                 daemon-regime gates (zero steady-state whole-kind
 #                 lists, bulk-endpoint hit floors, preserialized
-#                 frame-cache hit ratio) must stay in budget
+#                 frame-cache hit ratio) must stay in budget — the
+#                 whole run traces under KAI_JITTRACE, so the committed
+#                 per-kernel compile-signature ceilings
+#                 (docs/scale-tests/compile_budget.json) gate here too
 #   tier-1 tests  pytest -m 'not slow' on CPU
 #
 # Usage: kai_scheduler_tpu/tools/ci_check.sh [--no-tests]
@@ -61,6 +71,10 @@ echo "== kairace (thread-role & lock-contract analyzer) =="
 python -m kai_scheduler_tpu.tools.kairace kai_scheduler_tpu/ || fail=1
 
 echo
+echo "== kaijit (JAX compilation-contract analyzer) =="
+python -m kai_scheduler_tpu.tools.kaijit kai_scheduler_tpu/ || fail=1
+
+echo
 echo "== chaos matrix definition (dry run) =="
 python -m kai_scheduler_tpu.tools.chaos_matrix --dry-run || fail=1
 python -m kai_scheduler_tpu.tools.chaos_matrix --pipeline --dry-run \
@@ -74,6 +88,8 @@ python -m kai_scheduler_tpu.tools.chaos_matrix --timeaware --dry-run \
 python -m kai_scheduler_tpu.tools.chaos_matrix --wire-faults --dry-run \
     || fail=1
 python -m kai_scheduler_tpu.tools.chaos_matrix --races --dry-run \
+    || fail=1
+python -m kai_scheduler_tpu.tools.chaos_matrix --compile --dry-run \
     || fail=1
 
 echo

@@ -1,14 +1,16 @@
 """Conformance ring: every proof this repo makes, in ONE command.
 
 ROADMAP item 5 ("make the proofs run where we run"), folded into one
-gate: the static analyzers (kailint, kairace), the FULL chaos-matrix
-mode set — default reconciler rings plus --arena --incremental --fused
---shards --pipeline --latency --columnar --wire --timeaware and the
-PR 15 --wire-faults lying-wire ring — and the fleet budget
-(tools/fleet_budget.py), swept per fault seed and reported as one
-pass/fail table.  A future PR that breaks any invariant the previous
-fifteen proved fails HERE, in one command, with the failing mode and a
-replay seed named.
+gate: the static analyzers (kailint, kairace, kaijit), the FULL
+chaos-matrix mode set — default reconciler rings plus --arena
+--incremental --fused --shards --pipeline --latency --columnar --wire
+--timeaware, the PR 15 --wire-faults lying-wire ring, and the
+--compile compile-contract ring (KAI_JITTRACE journals vs the static
+kaijit surface) — and the fleet budget (tools/fleet_budget.py, which
+also enforces the committed per-kernel compile-signature ceilings),
+swept per fault seed and reported as one pass/fail table.  A future PR
+that breaks any invariant the previous sessions proved fails HERE, in
+one command, with the failing mode and a replay seed named.
 
 Tiers:
 
@@ -16,8 +18,9 @@ Tiers:
   python -m kai_scheduler_tpu.tools.conformance --smoke    # the CI gate
 
 ``--smoke`` (run by tools/ci_check.sh) keeps the wall time CI-sized:
-both analyzers for real, a --dry-run validation of EVERY chaos-matrix
-mode definition, and one real single-seed sweep of the wire-faults ring
+all three analyzers for real, a --dry-run validation of EVERY
+chaos-matrix mode definition, and one real single-seed sweep of the
+wire-faults ring
 (the newest, least-soaked invariant).  The fleet budget is part of the
 full tier (and of ci_check.sh directly); ``--with-budget`` pulls it
 into smoke too.
@@ -37,7 +40,7 @@ import time
 # Every chaos-matrix mode flag; "" is the default reconciler/device ring.
 MATRIX_MODES = ["", "--arena", "--incremental", "--fused", "--shards",
                 "--pipeline", "--latency", "--columnar", "--wire",
-                "--timeaware", "--wire-faults"]
+                "--timeaware", "--wire-faults", "--compile"]
 
 # The smoke tier's one REAL sweep: the wire-faults ring, one seed, the
 # fast subset (the same -k the tier-1 smoke uses).
@@ -59,6 +62,8 @@ def build_plan(smoke: bool, seeds: str, with_budget: bool,
                      "kai_scheduler_tpu/"]),
         ("kairace", ["kai_scheduler_tpu.tools.kairace",
                      "kai_scheduler_tpu/"]),
+        ("kaijit", ["kai_scheduler_tpu.tools.kaijit",
+                    "kai_scheduler_tpu/"]),
     ]
     matrix = "kai_scheduler_tpu.tools.chaos_matrix"
     if smoke:
@@ -119,7 +124,7 @@ def main(argv=None) -> int:
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     # Steps control their own fault/locktrace arming; an inherited spec
     # would skew every sweep the same way.
-    for var in ("KAI_FAULT_INJECT", "KAI_LOCKTRACE"):
+    for var in ("KAI_FAULT_INJECT", "KAI_LOCKTRACE", "KAI_JITTRACE"):
         env.pop(var, None)
     rows, failed = [], []
     for name, step_argv in plan:

@@ -35,7 +35,14 @@ result against ``docs/scale-tests/fleet_budget.json``:
   usage-decay fold at ``usage_shape`` must count EXACTLY one
   ``usage_decay_dispatch_total`` per recorded cycle — a silent
   per-queue host loop multiplies it by Q while every wall clock still
-  passes — with a fold-median ceiling on top.
+  passes — with a fold-median ceiling on top;
+- **compile budget (kaijit's runtime half)**: the whole run executes
+  under utils/jittrace.py, and the per-kernel distinct abstract
+  signatures (= XLA compilation keys) must stay within the committed
+  ``docs/scale-tests/compile_budget.json`` ceilings — dropping a pow2
+  bucket multiplies a kernel's signature count with every wall clock
+  still green on a fast machine; a journaled kernel the static
+  analyzer (tools/kaijit/) never discovered fails as an analyzer gap.
 
 Usage (ci_check.sh runs it):
 
@@ -58,6 +65,9 @@ def main(argv=None) -> int:
                          "docs/scale-tests/fleet_budget.json)")
     ap.add_argument("--json", action="store_true",
                     help="emit the measured result as JSON")
+    ap.add_argument("--compile-budget", default=None,
+                    help="compile-budget manifest (default: "
+                         "docs/scale-tests/compile_budget.json)")
     args = ap.parse_args(argv)
 
     repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
@@ -66,8 +76,14 @@ def main(argv=None) -> int:
         repo_root, "docs", "scale-tests", "fleet_budget.json")
     with open(budget_path) as f:
         budget = json.load(f)
+    compile_budget_path = args.compile_budget or os.path.join(
+        repo_root, "docs", "scale-tests", "compile_budget.json")
 
     sys.path.insert(0, repo_root)
+    # Arm the compile-signature journal BEFORE bench imports bind any
+    # kernel references — the whole budget run records under trace.
+    from kai_scheduler_tpu.utils import jittrace
+    jittrace.install()
     import bench
     from kai_scheduler_tpu.utils.metrics import METRICS
 
@@ -163,6 +179,38 @@ def main(argv=None) -> int:
     usage_folds = METRICS.counters.get("usage_decay_dispatch_total",
                                        0) - u0
     usage_decay_ms = float(np.median(ts))
+
+    # Arena scatter churn (compile-gate teeth): the fleet phase touches
+    # only a handful of distinct dirty-row widths, so an un-bucketed
+    # scatter pad would journal the SAME signature count as a bucketed
+    # one and slip past the ceiling.  Sweep K=1..12 dirty rows through
+    # the real DeviceStateCache scatter path: pow2 bucketing collapses
+    # them to {1,2,4,8,16} compile keys, while a raw pad journals all
+    # twelve — pushing ``compile_sigs:apply_deltas_kernel`` over its
+    # committed ceiling.
+    from kai_scheduler_tpu.framework.arena import DeviceStateCache
+
+    class _ChurnSession:
+        def __init__(self, n, r=3):
+            crng = np.random.default_rng(1)
+            self.node_idle = crng.uniform(0, 8, (n, r))
+            self.node_releasing = np.zeros((n, r))
+            self.node_room = crng.uniform(0, 110, n)
+            self._dirty_rows: set[int] = set()
+
+        def dispatch_kernel(self, thunk, label=None, validate=None):
+            return thunk()
+
+    cshape = budget.get("scatter_churn_shape",
+                        {"nodes": 512, "max_rows": 12})
+    churn = _ChurnSession(cshape["nodes"])
+    dcache = DeviceStateCache()
+    dcache.arrays(churn)  # cold upload; scatters follow
+    for k in range(1, cshape["max_rows"] + 1):
+        rows = rng.choice(cshape["nodes"], size=k, replace=False)
+        churn.node_idle[rows] += 0.5
+        churn._dirty_rows.update(int(x) for x in rows)
+        dcache.arrays(churn)
 
     # Overlapped-pipeline smoke (DESIGN §10): the SAME fleet shape with
     # the commit executor armed.  min_overlap_ratio is the structural
@@ -300,6 +348,29 @@ def main(argv=None) -> int:
         ("frame_cache_hit_ratio", h_ratio,
          ">=", budget.get("min_frame_cache_hit_ratio", 0.3)),
     ]
+
+    # Compile-budget gate (kaijit's runtime half): merge the journal
+    # the whole run accumulated against the static jit surface and the
+    # committed per-kernel signature ceilings.  A kernel the static
+    # analyzer never discovered is an ANALYZER GAP and fails loud; a
+    # ceiling breach means someone un-bucketed a shape axis (KJT001's
+    # runtime shadow) — both invisible to every wall-clock gate above.
+    surface = jittrace.discover_surface()
+    cb = jittrace.load_budget(compile_budget_path)
+    audit = jittrace.validate_observed(
+        surface, [jittrace.TRACER.dump()], budget=cb)
+    checks_compile = [
+        ("compile_unexplained_kernels", len(audit["unexplained"]),
+         "<=", 0),
+        ("compile_uncovered_kernels", len(audit["uncovered"]),
+         "<=", 0),
+    ]
+    for kern, n_sigs in audit["kernels"].items():
+        ceiling = cb["kernels"].get(kern, cb["default_max"])
+        checks_compile.append(
+            (f"compile_sigs:{kern.rpartition('.')[2]}", n_sigs,
+             "<=", ceiling))
+    checks.extend(checks_compile)
 
     failed = []
     for name, got, op, want in checks:

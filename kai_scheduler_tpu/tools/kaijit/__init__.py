@@ -1,0 +1,18 @@
+"""kaijit — whole-program JAX compilation-contract analyzer.
+
+Built on the kailint engine chassis (3-pass rules, fingerprint
+baseline, ``# kaijit: disable=`` suppressions, text/JSON CLI, exit
+codes 0/1/2) and the shared jit-surface collector
+(``tools/kailint/jitsurface.py``) — the same discovery KAI004 guards
+with, so the two tools cannot drift.  See docs/STATIC_ANALYSIS.md for
+the KJT rule catalog and the compile-key model; ``utils/jittrace.py``
++ ``chaos_matrix --compile`` + the ``tools/fleet_budget.py``
+compile-budget gate validate the static model against observed runtime
+compile events.
+"""
+
+from .cli import build_engine, jit_surface, main
+from .rules import RULE_CLASSES, default_rules
+
+__all__ = ["build_engine", "default_rules", "jit_surface", "main",
+           "RULE_CLASSES"]

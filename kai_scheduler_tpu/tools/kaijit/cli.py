@@ -1,0 +1,183 @@
+"""kaijit command line.
+
+Exit codes (kailint chassis): 0 = clean (every finding suppressed or
+baselined), 1 = new findings, 2 = usage/internal error (including a
+file the analyzer could not parse — an unchecked file is never a green
+one).  The committed baseline (.kaijit-baseline.json) is EMPTY by
+contract: any finding is a new compilation-contract break to fix.
+
+Beyond linting, one machine-readable export feeds the runtime auditor:
+
+  --surface   the whole jit surface (kernels, static/dynamic argument
+              split, donation, resident-state annotations) that
+              ``utils/jittrace.py`` joins observed KAI_JITTRACE compile
+              events against (``chaos_matrix --compile`` and the
+              fleet_budget compile-budget gate).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from ..kailint.engine import Engine, load_baseline, write_baseline
+from ..kailint.jitsurface import collect_module_surface, surface_payload
+from .rules import RULE_CLASSES, default_rules
+
+BASELINE_NAME = ".kaijit-baseline.json"
+
+
+def package_root() -> str:
+    """Default scan target: the kai_scheduler_tpu package itself."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def _default_baseline_path(paths: list[str]) -> str:
+    start = os.path.abspath(paths[0]) if paths else os.getcwd()
+    cur = start if os.path.isdir(start) else os.path.dirname(start)
+    while True:
+        cand = os.path.join(cur, BASELINE_NAME)
+        if os.path.isfile(cand):
+            return cand
+        parent = os.path.dirname(cur)
+        if parent == cur:
+            return os.path.join(os.getcwd(), BASELINE_NAME)
+        cur = parent
+
+
+def build_engine(select=None, ignore=None) -> Engine:
+    return Engine(default_rules(), select=select, ignore=ignore,
+                  tool="kaijit")
+
+
+def jit_surface(paths: list[str]) -> dict:
+    """Build the whole-program jit-surface payload directly (for
+    ``--surface`` and the chaos-matrix/fleet-budget validators)."""
+    import ast as _ast
+
+    from ..kailint.engine import iter_python_files, package_relative
+    surfaces, errors = {}, []
+    for fpath in iter_python_files(paths):
+        rel = package_relative(fpath)
+        try:
+            with open(fpath, encoding="utf-8") as fh:
+                src = fh.read()
+            tree = _ast.parse(src, filename=fpath)
+        except (OSError, UnicodeDecodeError, SyntaxError) as exc:
+            errors.append(f"{fpath}: {exc}")
+            continue
+        module = rel[:-3].replace("/", ".")
+        surface = collect_module_surface(tree, src.splitlines(),
+                                         module, rel)
+        if surface is not None:
+            surfaces[module] = surface
+    return surface_payload(surfaces, errors)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m kai_scheduler_tpu.tools.kaijit",
+        description="whole-program JAX compilation-contract analyzer "
+                    "for kai_scheduler_tpu (docs/STATIC_ANALYSIS.md); "
+                    "runs on the kailint engine chassis")
+    ap.add_argument("paths", nargs="*",
+                    help="files or directories (default: the whole "
+                         "kai_scheduler_tpu package)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline file (default: nearest {BASELINE_NAME})")
+    ap.add_argument("--no-baseline", action="store_true")
+    ap.add_argument("--write-baseline", action="store_true")
+    ap.add_argument("--select", default=None,
+                    help="comma-separated rule ids to run (e.g. KJT001)")
+    ap.add_argument("--ignore", default=None)
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--surface", action="store_true",
+                    help="print the discovered jit surface as JSON "
+                         "(kernels, static/dynamic split, donation, "
+                         "resident-state) and exit — the KAI_JITTRACE "
+                         "validator's contract")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for cls in RULE_CLASSES:
+            print(f"{cls.id}  {cls.name:<24} {cls.description}")
+        return 0
+    paths = args.paths or [package_root()]
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"error: no such path: {p}", file=sys.stderr)
+            return 2
+    if args.surface:
+        payload = jit_surface(paths)
+        print(json.dumps(payload, indent=2))
+        return 2 if payload["errors"] else 0
+
+    known = {cls.id.upper() for cls in RULE_CLASSES}
+    filters = {}
+    for flag, spec in (("--select", args.select),
+                       ("--ignore", args.ignore)):
+        if spec is None:
+            filters[flag] = None
+            continue
+        ids = {tok.strip().upper() for tok in spec.split(",")
+               if tok.strip()}
+        unknown = ids - known
+        if unknown:
+            print(f"error: unknown rule id(s) for {flag}: "
+                  f"{', '.join(sorted(unknown))} (see --list-rules)",
+                  file=sys.stderr)
+            return 2
+        filters[flag] = ids
+    select, ignore = filters["--select"], filters["--ignore"]
+    engine = build_engine(select=select, ignore=ignore)
+
+    baseline_path = args.baseline or _default_baseline_path(paths)
+    if args.write_baseline:
+        if select or ignore:
+            print("error: --write-baseline cannot be combined with "
+                  "--select/--ignore (it would overwrite the other "
+                  "rules' baseline entries)", file=sys.stderr)
+            return 2
+        report = engine.run(paths, baseline=None)
+        if report.errors:
+            for err in report.errors:
+                print(f"kaijit: parse error: {err}", file=sys.stderr)
+            print("error: refusing to write a baseline from a partial "
+                  "scan (fix the parse errors first)", file=sys.stderr)
+            return 2
+        n = write_baseline(baseline_path, report.findings,
+                           tool="kaijit")
+        print(f"kaijit: wrote {n} baseline entr"
+              f"{'y' if n == 1 else 'ies'} to {baseline_path}")
+        return 0
+
+    try:
+        baseline = {} if args.no_baseline else \
+            load_baseline(baseline_path, tool="kaijit")
+        report = engine.run(paths, baseline=baseline)
+    except (OSError, ValueError, KeyError) as exc:
+        print(f"kaijit: error: {exc}", file=sys.stderr)
+        return 2
+
+    if args.format == "json":
+        print(json.dumps(report.to_dict(), indent=2))
+        return report.exit_code
+
+    for f in report.findings:
+        print(f.render())
+    for err in report.errors:
+        print(f"kaijit: parse error: {err}", file=sys.stderr)
+    summary = (f"kaijit: {len(report.findings)} new finding(s), "
+               f"{len(report.baselined)} baselined, "
+               f"{report.suppressed} suppressed, "
+               f"{report.files} file(s)")
+    if report.stale_baseline:
+        summary += (f", {len(report.stale_baseline)} stale baseline "
+                    f"entr{'y' if len(report.stale_baseline) == 1 else 'ies'}"
+                    f" (fixed — prune with --write-baseline)")
+    print(summary)
+    return report.exit_code

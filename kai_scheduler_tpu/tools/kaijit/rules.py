@@ -1,0 +1,616 @@
+"""kaijit rules KJT001-KJT006: the JAX compilation contract.
+
+Pass 1 (collect) discovers the whole jit surface through the SHARED
+collector ``tools/kailint/jitsurface.py`` — direct ``jax.jit``/
+``pjit``/Pallas compile boundaries plus the transitive host wrappers
+KAI004 guards — and each kernel's static/dynamic argument split.
+
+Pass 2 builds per-function compile-key models (:class:`FunctionModel`):
+which locals are RAW live-cluster sizes (``len(...)``, ``.shape[i]``,
+``.size``) and which have been bucketed (a ``pow2``/``bucket`` helper
+or the ``while p < t: p *= 2`` doubling idiom).  The model is what the
+rules reason over: XLA's compilation key is (shapes, dtypes,
+static-arg values), so anything that feeds a jit boundary from an
+unbounded domain is a retrace waiting for a bigger cluster.
+
+Pass 3 (check) applies the contract:
+
+- KJT001  unbucketed dynamic shape feeding a jit boundary
+- KJT002  retrace-prone static arg (unbounded value domain)
+- KJT003  traced-value host escape outside a sanctioned materialize
+- KJT004  dtype-pin violation on a resident-state kernel operand
+- KJT005  mutable host state captured by a jit-reachable function
+- KJT006  missing/unsound donation on resident-buffer update kernels
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..kailint.astutil import dotted_name, in_path, local_calls, \
+    top_level_functions
+from ..kailint.engine import Finding, ModuleContext, Rule
+from ..kailint.jitsurface import (KernelDecl, ModuleSurface,
+                                  collect_module_surface, kernel_aliases,
+                                  resolve_kernel_call)
+from ..kailint.lockscope import walk_executed
+
+# Call-name leaf tokens that mark a bucketing helper: the value that
+# comes OUT is drawn from a bounded set of dims no matter how the
+# cluster grows.
+_BUCKET_TOKENS = ("pow2", "bucket", "pad_to")
+
+# Neutral transforms: the result is a size iff an argument is.
+_TRANSPARENT_CALLS = {"max", "min", "int", "abs", "sum"}
+
+# Array constructors whose first argument (or shape=) is a SHAPE.
+_SHAPE_CTORS = {"zeros", "ones", "empty", "full", "arange"}
+
+# numpy-ish constructors that accept a dtype, and where it lives
+# (positional index; dtype= keyword always counts).
+_DTYPE_CTORS = {"zeros": 1, "ones": 1, "empty": 1, "asarray": 1,
+                "ascontiguousarray": 1, "full": 2, "array": 1}
+
+_MUTABLE_FACTORIES = {"dict", "list", "set", "defaultdict",
+                      "OrderedDict", "Counter", "deque"}
+
+
+def _leaf(name: str | None) -> str:
+    return name.rsplit(".", 1)[-1] if name else ""
+
+
+def _is_bucket_call(name: str | None) -> bool:
+    leaf = _leaf(name).lower()
+    return any(tok in leaf for tok in _BUCKET_TOKENS)
+
+
+class FunctionModel:
+    """The compile-key model of one function body: classify each local
+    as a raw live-count size ("size") or a bounded bucketed dim
+    ("bucketed").  Two lexical passes reach the fixed point for the
+    assignment chains the tree actually uses (alias-of-alias)."""
+
+    def __init__(self, fn: ast.AST):
+        self.size_vars: set[str] = set()
+        self.bucketed_vars: set[str] = set()
+        for _ in (0, 1):
+            for node in ast.walk(fn):
+                if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    targets = (node.targets
+                               if isinstance(node, ast.Assign)
+                               else [node.target])
+                    if node.value is not None:
+                        self._record(targets, node.value)
+                elif isinstance(node, ast.AugAssign) and \
+                        isinstance(node.op, ast.Mult) and \
+                        isinstance(node.target, ast.Name):
+                    # `p *= 2` — the while-doubling bucketing idiom.
+                    self._set(node.target.id, "bucketed")
+
+    def _set(self, name: str, cls: str | None) -> None:
+        if cls == "size":
+            self.size_vars.add(name)
+            self.bucketed_vars.discard(name)
+        elif cls == "bucketed":
+            self.bucketed_vars.add(name)
+            self.size_vars.discard(name)
+
+    def _record(self, targets: list, value: ast.AST) -> None:
+        # `a, b = x.shape` — every element is a raw dim.
+        for target in targets:
+            if isinstance(target, ast.Tuple) and \
+                    isinstance(value, ast.Attribute) and \
+                    value.attr == "shape" and \
+                    isinstance(value.value, ast.Name):
+                for elt in target.elts:
+                    if isinstance(elt, ast.Name):
+                        self._set(elt.id, "size")
+            elif isinstance(target, ast.Name):
+                self._set(target.id, self.classify(value))
+
+    def classify(self, expr: ast.AST) -> str | None:
+        """"size" (raw live count), "bucketed", or None (unknown /
+        neither — params and attributes stay unclassified on purpose:
+        flagging them would turn every caller into a false positive)."""
+        if isinstance(expr, ast.Name):
+            if expr.id in self.size_vars:
+                return "size"
+            if expr.id in self.bucketed_vars:
+                return "bucketed"
+            return None
+        if isinstance(expr, ast.Call):
+            name = dotted_name(expr.func)
+            if _is_bucket_call(name):
+                return "bucketed"
+            if name == "len":
+                return "size"
+            if _leaf(name) in _TRANSPARENT_CALLS:
+                return self._combine(expr.args)
+            return None
+        if isinstance(expr, ast.Attribute) and expr.attr == "size" \
+                and isinstance(expr.value, ast.Name):
+            return "size"
+        if isinstance(expr, ast.Subscript):
+            # `x.shape[i]` of a LOCALLY-FLOWING array is a live count;
+            # `self.node_idle.shape[0]` / `snap.task_req.shape[1]` read
+            # resident/snapshot state whose shape is ALREADY a compiled
+            # key of the program — copying such a dim mints no new
+            # signature.
+            base = expr.value
+            if isinstance(base, ast.Attribute) and \
+                    base.attr == "shape" and \
+                    isinstance(base.value, ast.Name):
+                return "size"
+            return None
+        if isinstance(expr, ast.BinOp):
+            return self._combine([expr.left, expr.right])
+        if isinstance(expr, ast.IfExp):
+            return self._combine([expr.body, expr.orelse])
+        return None
+
+    def _combine(self, exprs: list) -> str | None:
+        classes = {self.classify(e) for e in exprs}
+        if "size" in classes:
+            return "size"
+        if "bucketed" in classes:
+            return "bucketed"
+        return None
+
+    def size_names_in(self, expr: ast.AST) -> set[str]:
+        """Raw-size Names referenced anywhere inside ``expr``."""
+        out = set()
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Name) and node.id in self.size_vars:
+                out.add(node.id)
+        return out
+
+
+def _iter_functions(tree: ast.Module) -> Iterator[ast.FunctionDef]:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+class SurfaceRule(Rule):
+    """Shared pass 1: every kaijit rule sees the same kernel surface."""
+
+    def __init__(self):
+        self.surfaces: dict[str, ModuleSurface] = {}
+
+    def collect(self, ctx: ModuleContext) -> None:
+        surface = collect_module_surface(ctx.tree, ctx.lines,
+                                         ctx.module_name, ctx.path)
+        if surface is not None:
+            self.surfaces[ctx.module_name] = surface
+
+    def _resolution(self, ctx: ModuleContext):
+        direct, mod_alias = kernel_aliases(ctx.tree, ctx.module_name,
+                                           self.surfaces)
+        local = self.surfaces.get(ctx.module_name)
+        return direct, mod_alias, local
+
+    def _kernel_for(self, call: ast.Call, direct, mod_alias,
+                    local) -> KernelDecl | None:
+        return resolve_kernel_call(call, direct, mod_alias, local,
+                                   self.surfaces)
+
+
+class UnbucketedShapeRule(SurfaceRule):
+    id = "KJT001"
+    name = "unbucketed-shape"
+    description = ("array dim derived from a live cluster count feeds a "
+                   "jit boundary without a pow2/bucket helper on the "
+                   "path — every new count is a retrace")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        direct, mod_alias, local = self._resolution(ctx)
+        if not direct and not mod_alias and local is None:
+            return
+        for fn in _iter_functions(ctx.tree):
+            model = FunctionModel(fn)
+            # Names bound to arrays whose shape came from a raw size.
+            tainted: dict[str, str] = {}
+            for node in ast.walk(fn):
+                if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    continue
+                value = node.value
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                sizes = self._ctor_sizes(value, model)
+                if not sizes:
+                    continue
+                for target in targets:
+                    if isinstance(target, ast.Name):
+                        tainted[target.id] = sizes[0]
+            for call in ast.walk(fn):
+                if not isinstance(call, ast.Call):
+                    continue
+                decl = self._kernel_for(call, direct, mod_alias, local)
+                if decl is None or not decl.jitted:
+                    continue
+                for arg in list(call.args) + \
+                        [kw.value for kw in call.keywords]:
+                    hit = self._arg_taint(arg, model, tainted)
+                    if hit:
+                        yield self.finding(
+                            ctx, call,
+                            f"array shaped by raw live count `{hit}` "
+                            f"feeds jit boundary `{decl.name}` — bucket "
+                            f"the dim (pow2 helper) before dispatch")
+                        break
+
+    @staticmethod
+    def _ctor_sizes(expr: ast.AST | None, model: FunctionModel) -> list:
+        """Raw-size names shaping an array-constructor expression."""
+        if not isinstance(expr, ast.Call):
+            return []
+        if _leaf(dotted_name(expr.func)) not in _SHAPE_CTORS:
+            return []
+        shape_args = expr.args[:1] + \
+            [kw.value for kw in expr.keywords if kw.arg == "shape"]
+        out: list = []
+        for sarg in shape_args:
+            elts = sarg.elts if isinstance(sarg, ast.Tuple) else [sarg]
+            for elt in elts:
+                if model.classify(elt) == "size":
+                    out.extend(sorted(model.size_names_in(elt)) or
+                               ["<derived>"])
+        return out
+
+    def _arg_taint(self, arg: ast.AST, model: FunctionModel,
+                   tainted: dict) -> str | None:
+        for node in ast.walk(arg):
+            if isinstance(node, ast.Name) and node.id in tainted:
+                return tainted[node.id]
+            if isinstance(node, ast.Call):
+                sizes = self._ctor_sizes(node, model)
+                if sizes:
+                    return sizes[0]
+        return None
+
+
+class RetraceStaticArgRule(SurfaceRule):
+    id = "KJT002"
+    name = "retrace-static-arg"
+    description = ("static_argnames value drawn from an unbounded "
+                   "domain (live count, float cast, formatted string) — "
+                   "every new value is a full retrace")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        direct, mod_alias, local = self._resolution(ctx)
+        if not direct and not mod_alias and local is None:
+            return
+        for fn in _iter_functions(ctx.tree):
+            model = FunctionModel(fn)
+            for call in ast.walk(fn):
+                if not isinstance(call, ast.Call):
+                    continue
+                decl = self._kernel_for(call, direct, mod_alias, local)
+                if decl is None or not decl.jitted or \
+                        not decl.static_argnames:
+                    continue
+                static = set(decl.static_argnames)
+                bound = list(zip(decl.params, call.args))
+                bound += [(kw.arg, kw.value) for kw in call.keywords
+                          if kw.arg]
+                for pname, value in bound:
+                    if pname not in static:
+                        continue
+                    why = self._unbounded(value, model)
+                    if why:
+                        yield self.finding(
+                            ctx, call,
+                            f"static arg `{pname}` of `{decl.name}` "
+                            f"fed from {why} — an unbounded static "
+                            f"domain retraces per value; bucket it or "
+                            f"make it a traced operand")
+
+    @staticmethod
+    def _unbounded(expr: ast.AST, model: FunctionModel) -> str | None:
+        if model.classify(expr) == "size":
+            return "a raw live count"
+        for node in ast.walk(expr):
+            if isinstance(node, ast.JoinedStr):
+                return "a formatted string"
+            if isinstance(node, ast.Call):
+                leaf = _leaf(dotted_name(node.func))
+                if leaf == "float":
+                    return "a float() cast"
+                if leaf == "str":
+                    return "a str() cast"
+                if leaf == "len" and \
+                        model.classify(node) == "size":
+                    return "a raw live count"
+        return None
+
+
+class TracedHostEscapeRule(SurfaceRule):
+    id = "KJT003"
+    name = "traced-host-escape"
+    description = ("np.*/float()/.item() on a pipelined kernel result "
+                   "in the cycle path — forces a blocking device sync "
+                   "outside the sanctioned materialize point")
+
+    _HOST_PREFIXES = ("np.", "numpy.", "jnp.")
+    _SCALAR_CASTS = {"float", "int", "bool"}
+
+    def applies_to(self, ctx: ModuleContext) -> bool:
+        return in_path(ctx.path, "framework", "actions", "plugins")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for fn in _iter_functions(ctx.tree):
+            lazy = self._lazy_names(fn)
+            if not lazy:
+                continue
+            # walk_executed skips nested defs/lambdas: a lambda handed
+            # to a later dispatch_kernel IS the sanctioned materialize
+            # point (`_dispatch_and_fetch`).  Walk the BODY statements —
+            # walk_executed(fn) itself would stop at the FunctionDef.
+            for node in (n for stmt in fn.body
+                         for n in walk_executed(stmt)):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func) or ""
+                is_host = name.startswith(self._HOST_PREFIXES) or \
+                    name in self._SCALAR_CASTS
+                is_item = isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "item"
+                target = node.func.value if is_item else None
+                args = list(node.args) + \
+                    [kw.value for kw in node.keywords]
+                if is_item and isinstance(target, ast.Name) and \
+                        target.id in lazy:
+                    hit = target.id
+                elif is_host:
+                    hit = next((a.id for a in args
+                                if isinstance(a, ast.Name)
+                                and a.id in lazy), None)
+                else:
+                    continue
+                if hit:
+                    yield self.finding(
+                        ctx, node,
+                        f"host materialization of pipelined kernel "
+                        f"result `{hit}` — fetch through a thunk on a "
+                        f"second dispatch_kernel (the "
+                        f"`_dispatch_and_fetch` idiom), not inline")
+
+    @staticmethod
+    def _lazy_names(fn: ast.AST) -> set[str]:
+        out: set[str] = set()
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Assign):
+                continue
+            value = node.value
+            if isinstance(value, ast.Call) and \
+                    isinstance(value.func, ast.Attribute) and \
+                    value.func.attr == "dispatch_kernel" and \
+                    any(kw.arg == "blocking" and
+                        isinstance(kw.value, ast.Constant) and
+                        kw.value.value is False
+                        for kw in value.keywords):
+                for target in node.targets:
+                    if isinstance(target, ast.Name):
+                        out.add(target.id)
+        return out
+
+
+class DtypePinRule(SurfaceRule):
+    id = "KJT004"
+    name = "dtype-pin"
+    description = ("operand to a resident-state kernel not pinned to "
+                   "the arena's resident dtype (the cast-at-host rule) "
+                   "— a mismatched width is a new compilation key AND "
+                   "an in-kernel upcast of resident state")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        direct, mod_alias, local = self._resolution(ctx)
+        # (a) the kernel's own body must fold value operands into the
+        # resident dtype (`vals.astype(resident.dtype)`).
+        if local is not None:
+            funcs = top_level_functions(ctx.tree)
+            for decl in local.kernels.values():
+                if not decl.resident or not decl.jitted:
+                    continue
+                fn = funcs.get(decl.name)
+                if fn is not None and not self._casts_to_resident(
+                        fn, set(decl.resident)):
+                    yield self.finding(
+                        ctx, fn,
+                        f"resident-state kernel `{decl.name}` never "
+                        f"casts value operands into a resident dtype "
+                        f"(`x.astype({decl.resident[0]}.dtype)`) — a "
+                        f"wider host value silently upcasts the arena")
+        # (b) call sites: host uploads into a resident kernel must pin
+        # the dtype at construction.
+        if not direct and not mod_alias and local is None:
+            return
+        for fn in _iter_functions(ctx.tree):
+            ctor_of = self._local_ctors(fn)
+            for call in ast.walk(fn):
+                if not isinstance(call, ast.Call):
+                    continue
+                decl = self._kernel_for(call, direct, mod_alias, local)
+                if decl is None or not decl.resident or \
+                        not decl.jitted:
+                    continue
+                for arg in list(call.args) + \
+                        [kw.value for kw in call.keywords]:
+                    bad = self._unpinned_upload(arg, ctor_of)
+                    if bad:
+                        yield self.finding(
+                            ctx, call,
+                            f"host operand `{bad}` uploaded to "
+                            f"resident-state kernel `{decl.name}` "
+                            f"without an explicit dtype — pin it at "
+                            f"construction (cast-at-host rule)")
+
+    @staticmethod
+    def _casts_to_resident(fn: ast.AST, resident: set[str]) -> bool:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "astype":
+                for arg in node.args:
+                    name = dotted_name(arg) or ""
+                    base, _, leaf = name.rpartition(".")
+                    if leaf == "dtype" and base in resident:
+                        return True
+        return False
+
+    @staticmethod
+    def _local_ctors(fn: ast.AST) -> dict[str, ast.Call]:
+        """name -> the constructor Call it was assigned from."""
+        out: dict[str, ast.Call] = {}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call):
+                for target in node.targets:
+                    if isinstance(target, ast.Name):
+                        out[target.id] = node.value
+        return out
+
+    def _unpinned_upload(self, arg: ast.AST,
+                         ctor_of: dict) -> str | None:
+        """`jnp.asarray(x)` where x's constructor names no dtype."""
+        if not (isinstance(arg, ast.Call) and
+                _leaf(dotted_name(arg.func)) in ("asarray", "array")):
+            return None
+        if self._has_dtype(arg):
+            return None
+        inner = arg.args[0] if arg.args else None
+        if isinstance(inner, ast.Call):
+            if self._has_dtype(inner):
+                return None
+            return dotted_name(inner.func) or "<expr>"
+        if isinstance(inner, ast.Name):
+            ctor = ctor_of.get(inner.id)
+            if ctor is None:
+                return None    # param/attribute: origin unknown
+            return None if self._has_dtype(ctor) else inner.id
+        return None
+
+    @staticmethod
+    def _has_dtype(call: ast.Call) -> bool:
+        if any(kw.arg == "dtype" for kw in call.keywords):
+            return True
+        leaf = _leaf(dotted_name(call.func))
+        if isinstance(call.func, ast.Attribute) and leaf == "astype":
+            return True
+        pos = _DTYPE_CTORS.get(leaf)
+        return pos is not None and len(call.args) > pos
+
+
+class MutableClosureCaptureRule(SurfaceRule):
+    id = "KJT005"
+    name = "mutable-closure-capture"
+    description = ("jit-reachable function reads mutable host state "
+                   "(module-level container / os.environ) — traced "
+                   "once at compile time, silently stale forever after")
+
+    def applies_to(self, ctx: ModuleContext) -> bool:
+        return in_path(ctx.path, "ops", "parallel")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        local = self.surfaces.get(ctx.module_name)
+        if local is None:
+            return
+        mutables = self._module_mutables(ctx.tree)
+        funcs = top_level_functions(ctx.tree)
+        # Reachability FROM the compile boundaries: anything a jitted
+        # body calls executes under trace.
+        reach = {n for n in local.jitted_names() if n in funcs}
+        changed = True
+        while changed:
+            changed = False
+            for name in sorted(reach):
+                for callee in local_calls(funcs[name], set(funcs)):
+                    if callee not in reach:
+                        reach.add(callee)
+                        changed = True
+        for name in sorted(reach):
+            fn = funcs[name]
+            params = {a.arg for a in fn.args.posonlyargs +
+                      fn.args.args + fn.args.kwonlyargs}
+            for node in ast.walk(fn):
+                dn = dotted_name(node) if \
+                    isinstance(node, ast.Attribute) else None
+                if dn == "os.environ":
+                    yield self.finding(
+                        ctx, node,
+                        f"jit-reachable `{name}` reads os.environ — "
+                        f"the value is baked into the trace; resolve "
+                        f"it at host level and pass it in")
+                elif isinstance(node, ast.Name) and \
+                        isinstance(node.ctx, ast.Load) and \
+                        node.id in mutables and node.id not in params:
+                    yield self.finding(
+                        ctx, node,
+                        f"jit-reachable `{name}` captures mutable "
+                        f"module state `{node.id}` — mutations after "
+                        f"the first trace are invisible to the "
+                        f"compiled kernel")
+
+    @staticmethod
+    def _module_mutables(tree: ast.Module) -> set[str]:
+        out: set[str] = set()
+        for node in tree.body:
+            if not isinstance(node, ast.Assign):
+                continue
+            value = node.value
+            mutable = isinstance(value, (ast.Dict, ast.List, ast.Set))
+            if isinstance(value, ast.Call) and \
+                    _leaf(dotted_name(value.func)) in _MUTABLE_FACTORIES:
+                mutable = True
+            if mutable:
+                for target in node.targets:
+                    if isinstance(target, ast.Name):
+                        out.add(target.id)
+        return out
+
+
+class DonationRule(SurfaceRule):
+    id = "KJT006"
+    name = "resident-donation"
+    description = ("resident-buffer update kernel with missing or "
+                   "unsound donation — value buffers re-upload every "
+                   "cycle, or a donated resident buffer breaks the "
+                   "deviceguard retry contract")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        local = self.surfaces.get(ctx.module_name)
+        if local is None:
+            return
+        funcs = top_level_functions(ctx.tree)
+        for decl in local.kernels.values():
+            if not decl.resident or not decl.jitted:
+                continue
+            fn = funcs.get(decl.name)
+            node = fn if fn is not None else ctx.tree
+            unsound = sorted(set(decl.donate) & set(decl.resident))
+            if unsound:
+                yield self.finding(
+                    ctx, node,
+                    f"resident-state kernel `{decl.name}` donates "
+                    f"resident buffer(s) {', '.join(unsound)} — the "
+                    f"deviceguard retry re-runs the thunk against a "
+                    f"donated (invalidated) buffer and the arena's "
+                    f"old-state-on-failure contract breaks")
+            elif not decl.donate:
+                yield self.finding(
+                    ctx, node,
+                    f"resident-state kernel `{decl.name}` declares no "
+                    f"donation — per-cycle value operands "
+                    f"(non-resident params) should be donated so XLA "
+                    f"reuses their buffers instead of re-allocating "
+                    f"every update")
+
+
+RULE_CLASSES = [UnbucketedShapeRule, RetraceStaticArgRule,
+                TracedHostEscapeRule, DtypePinRule,
+                MutableClosureCaptureRule, DonationRule]
+
+
+def default_rules() -> list[Rule]:
+    return [cls() for cls in RULE_CLASSES]

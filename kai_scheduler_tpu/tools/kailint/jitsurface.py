@@ -1,0 +1,260 @@
+"""Shared jit-kernel-surface discovery: the ONE place that knows what
+the device-kernel surface is.
+
+Both kailint's KAI004 (unguarded dispatch) and kaijit (the whole-program
+compilation-contract analyzer, ``tools/kaijit/``) need the same answer
+to "which functions dispatch to the device?":
+
+- functions directly compiled — ``@jax.jit`` / ``@pjit`` /
+  ``@functools.partial(jax.jit, ...)`` decorations, or a body that calls
+  ``pl.pallas_call`` (a Pallas launch IS a compile boundary);
+- host-facing wrappers that reach a compiled sibling transitively
+  (``allocate_grouped`` dispatches to the device even though the
+  ``@jit`` sits on an inner kernel) — computed to a fixed point;
+- each kernel's compilation-key split: params, ``static_argnames``,
+  donated params, and the ``# kaijit: resident-state=`` annotation that
+  marks which params are the arena's resident device buffers.
+
+Keeping this in one module means the two tools cannot drift (the
+lockscope.py pattern): a kernel KAI004 guards is a kernel kaijit
+budget-checks, and a new decoration idiom taught here is immediately
+visible to both.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+
+from .astutil import (dotted_name, in_path, is_jit_decorator,
+                      local_calls, resolve_relative_import,
+                      top_level_functions)
+
+# `# kaijit: resident-state=a,b,c` on (or in the comment block directly
+# above) a kernel's decorator/def lines: the named params are resident
+# device buffers (framework/arena.py keeps them alive across cycles).
+RESIDENT_RE = re.compile(
+    r"#\s*kaijit:\s*resident-state\s*=\s*"
+    r"(?P<params>\w+(?:\s*,\s*\w+)*)")
+
+
+@dataclass(frozen=True)
+class KernelDecl:
+    """One device-dispatching function in ops/ or parallel/."""
+    name: str
+    module: str                 # dotted module (kai_scheduler_tpu.ops.x)
+    path: str                   # package-relative posix path
+    line: int
+    jitted: bool                # directly compiled (jit/pjit/pallas)
+    pallas: bool = False        # body launches pl.pallas_call
+    params: tuple = ()          # positional parameter order
+    static_argnames: tuple = () # sorted
+    donate: tuple = ()          # donated PARAM NAMES (argnums resolved)
+    resident: tuple = ()        # kaijit: resident-state annotation
+    wraps: tuple = ()           # surface names this wrapper reaches
+
+    @property
+    def qualname(self) -> str:
+        return f"{self.module}.{self.name}"
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "module": self.module,
+                "path": self.path, "line": self.line,
+                "jitted": self.jitted, "pallas": self.pallas,
+                "params": list(self.params),
+                "static_argnames": list(self.static_argnames),
+                "donate": list(self.donate),
+                "resident": list(self.resident),
+                "wraps": list(self.wraps)}
+
+
+@dataclass
+class ModuleSurface:
+    """The kernel surface of one ops/parallel module."""
+    module: str
+    path: str
+    kernels: dict[str, KernelDecl] = field(default_factory=dict)
+
+    @property
+    def names(self) -> set[str]:
+        return set(self.kernels)
+
+    def jitted_names(self) -> set[str]:
+        return {n for n, k in self.kernels.items() if k.jitted}
+
+
+def _static_argnames(fn: ast.FunctionDef) -> tuple:
+    out: set[str] = set()
+    for dec in fn.decorator_list:
+        if not isinstance(dec, ast.Call):
+            continue
+        for kw in dec.keywords:
+            if kw.arg == "static_argnames":
+                for node in ast.walk(kw.value):
+                    if isinstance(node, ast.Constant) and \
+                            isinstance(node.value, str):
+                        out.add(node.value)
+    return tuple(sorted(out))
+
+
+def _donated_params(fn: ast.FunctionDef, params: tuple) -> tuple:
+    """``donate_argnames`` names plus ``donate_argnums`` indices
+    resolved against the positional parameter order."""
+    out: set[str] = set()
+    for dec in fn.decorator_list:
+        if not isinstance(dec, ast.Call):
+            continue
+        for kw in dec.keywords:
+            if kw.arg == "donate_argnames":
+                for node in ast.walk(kw.value):
+                    if isinstance(node, ast.Constant) and \
+                            isinstance(node.value, str):
+                        out.add(node.value)
+            elif kw.arg == "donate_argnums":
+                for node in ast.walk(kw.value):
+                    if isinstance(node, ast.Constant) and \
+                            isinstance(node.value, int) and \
+                            0 <= node.value < len(params):
+                        out.add(params[node.value])
+    return tuple(sorted(out))
+
+
+def _launches_pallas(fn: ast.FunctionDef) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func) or ""
+            if name == "pallas_call" or name.endswith(".pallas_call"):
+                return True
+    return False
+
+
+def _resident_annotation(fn: ast.FunctionDef,
+                         lines: list[str]) -> tuple:
+    """Parse ``# kaijit: resident-state=...`` from the decorator/def
+    lines or the contiguous comment block directly above them (the
+    kairace single-writer annotation placement)."""
+    first = min([d.lineno for d in fn.decorator_list] + [fn.lineno])
+    candidates: list[str] = []
+    j = first - 2                     # 0-based index of the line above
+    while j >= 0 and lines[j].lstrip().startswith("#"):
+        candidates.append(lines[j])
+        j -= 1
+    body_line = fn.body[0].lineno if fn.body else fn.lineno
+    candidates.extend(lines[first - 1:body_line - 1])
+    for raw in candidates:
+        m = RESIDENT_RE.search(raw)
+        if m:
+            return tuple(p.strip() for p in
+                         m.group("params").split(","))
+    return ()
+
+
+def collect_module_surface(tree: ast.Module, lines: list[str],
+                           module_name: str,
+                           path: str) -> ModuleSurface | None:
+    """The kernel surface of one module, or None outside ops/parallel
+    (host layers never DEFINE kernels; they only call them)."""
+    if not in_path(path, "ops", "parallel"):
+        return None
+    funcs = top_level_functions(tree)
+    jitted: dict[str, bool] = {}      # name -> launches pallas
+    for name, fn in funcs.items():
+        direct = any(is_jit_decorator(d) for d in fn.decorator_list)
+        pallas = _launches_pallas(fn)
+        if direct or pallas:
+            jitted[name] = pallas
+    # Host wrappers that call a kernel dispatch to the device too;
+    # iterate to a fixed point (wrapper-of-wrapper).
+    surface_names = set(jitted)
+    changed = True
+    while changed:
+        changed = False
+        for name, fn in funcs.items():
+            if name in surface_names:
+                continue
+            if local_calls(fn, surface_names):
+                surface_names.add(name)
+                changed = True
+    if not surface_names:
+        return None
+    out = ModuleSurface(module=module_name, path=path)
+    for name in sorted(surface_names):
+        fn = funcs[name]
+        params = tuple(a.arg for a in fn.args.posonlyargs + fn.args.args)
+        is_jit = name in jitted
+        wraps = () if is_jit else tuple(sorted(
+            local_calls(fn, surface_names - {name})))
+        out.kernels[name] = KernelDecl(
+            name=name, module=module_name, path=path, line=fn.lineno,
+            jitted=is_jit, pallas=jitted.get(name, False),
+            params=params, static_argnames=_static_argnames(fn),
+            donate=_donated_params(fn, params),
+            resident=_resident_annotation(fn, lines), wraps=wraps)
+    return out
+
+
+def kernel_aliases(tree: ast.Module, module_name: str,
+                   surfaces: dict[str, ModuleSurface]
+                   ) -> tuple[dict, dict]:
+    """Resolve a module's import aliases against the discovered surface:
+    ``direct`` maps a local alias to its (module, kernel) and
+    ``mod_alias`` maps an imported-module alias to its dotted module
+    (``from ..ops import rankplace as rp; rp.rank_place_kernel(...)``)."""
+    direct: dict[str, tuple[str, str]] = {}
+    mod_alias: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ImportFrom):
+            continue
+        resolved = resolve_relative_import(module_name, node)
+        if resolved is None:
+            continue
+        surf = surfaces.get(resolved)
+        for alias in node.names:
+            if surf is not None and alias.name in surf.kernels:
+                direct[alias.asname or alias.name] = \
+                    (resolved, alias.name)
+            if f"{resolved}.{alias.name}" in surfaces:
+                mod_alias[alias.asname or alias.name] = \
+                    f"{resolved}.{alias.name}"
+    return direct, mod_alias
+
+
+def resolve_kernel_call(call: ast.Call, direct: dict, mod_alias: dict,
+                        local_surface: ModuleSurface | None,
+                        surfaces: dict[str, ModuleSurface]
+                        ) -> KernelDecl | None:
+    """The KernelDecl a call site targets, through any alias form —
+    local name, ``from ..ops.x import k``, or ``m.k(...)`` module
+    alias — or None for a non-kernel call."""
+    name = dotted_name(call.func)
+    if name is None:
+        return None
+    if local_surface is not None and name in local_surface.kernels:
+        return local_surface.kernels[name]
+    if name in direct:
+        mod, kernel = direct[name]
+        return surfaces[mod].kernels.get(kernel)
+    if "." in name:
+        base, attr = name.split(".", 1)
+        mod = mod_alias.get(base)
+        if mod is not None:
+            return surfaces[mod].kernels.get(attr)
+    return None
+
+
+def surface_payload(surfaces: dict[str, ModuleSurface],
+                    errors: list[str] | None = None) -> dict:
+    """The machine-readable export (``kaijit --surface``) that
+    utils/jittrace.py's ``validate_observed`` merges runtime compile
+    events against."""
+    kernels = {}
+    for mod in sorted(surfaces):
+        for decl in surfaces[mod].kernels.values():
+            kernels[decl.qualname] = decl.to_dict()
+    return {"kernels": kernels, "errors": list(errors or [])}
+
+
+__all__ = ["KernelDecl", "ModuleSurface", "RESIDENT_RE",
+           "collect_module_surface", "kernel_aliases",
+           "resolve_kernel_call", "surface_payload"]

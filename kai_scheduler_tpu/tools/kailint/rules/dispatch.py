@@ -6,17 +6,20 @@ bounded retry, circuit breaker, and CPU degradation live (PR 1).  A
 direct call to a jitted kernel bypasses all of it: a hung device wedges
 the scheduling cycle with no deadline and no breaker trip.
 
-The rule discovers the kernel surface itself rather than keeping a
-hand-maintained list: pass 1 scans ``ops/`` and ``parallel/`` modules
-for top-level functions that are jit-decorated OR (transitively) call a
-jitted sibling — host-facing wrappers like ``allocate_grouped`` dispatch
-to the device even though the ``@jit`` sits on an inner kernel.  Pass 2
-then flags any call to one of those names from host layers, resolving
-``from ..ops.x import k`` aliases and ``from ..ops import x as m;
-m.k(...)`` module aliases.  Calls inside a ``lambda`` are exempt — that
-is precisely the thunk handed to ``dispatch_kernel`` — and so are calls
-inside a named nested function that is itself passed to a
-``dispatch_kernel(...)`` call (the multi-statement thunk idiom).
+The kernel surface itself comes from the SHARED discovery module
+``tools/kailint/jitsurface.py`` (the lockscope pattern): pass 1 scans
+``ops/`` and ``parallel/`` modules for top-level functions that are
+jit/pjit/Pallas-compiled OR (transitively) call a compiled sibling —
+host-facing wrappers like ``allocate_grouped`` dispatch to the device
+even though the ``@jit`` sits on an inner kernel.  kaijit (the
+compilation-contract analyzer) consumes the same surface, so the two
+tools cannot drift.  Pass 2 then flags any call to one of those names
+from host layers, resolving ``from ..ops.x import k`` aliases and
+``from ..ops import x as m; m.k(...)`` module aliases.  Calls inside a
+``lambda`` are exempt — that is precisely the thunk handed to
+``dispatch_kernel`` — and so are calls inside a named nested function
+that is itself passed to a ``dispatch_kernel(...)`` call (the
+multi-statement thunk idiom).
 """
 
 from __future__ import annotations
@@ -24,9 +27,10 @@ from __future__ import annotations
 import ast
 from typing import Iterator
 
-from ..astutil import (dotted_name, in_path, is_jit_decorator, local_calls,
-                       resolve_relative_import, top_level_functions)
+from ..astutil import dotted_name, in_path
 from ..engine import Finding, ModuleContext, Rule
+from ..jitsurface import (ModuleSurface, collect_module_surface,
+                          kernel_aliases)
 
 
 class UnguardedDispatchRule(Rule):
@@ -36,31 +40,17 @@ class UnguardedDispatchRule(Rule):
                    "(no watchdog, no breaker, no CPU fallback)")
 
     def __init__(self):
-        # module dotted name -> set of kernel (device-dispatching) names
-        self.kernels_by_module: dict[str, set[str]] = {}
+        # module dotted name -> its discovered kernel surface
+        self.surfaces: dict[str, ModuleSurface] = {}
 
     def applies_to(self, ctx: ModuleContext) -> bool:
         return True
 
     def collect(self, ctx: ModuleContext) -> None:
-        if not in_path(ctx.path, "ops", "parallel"):
-            return
-        funcs = top_level_functions(ctx.tree)
-        kernels = {name for name, fn in funcs.items()
-                   if any(is_jit_decorator(d) for d in fn.decorator_list)}
-        # Host wrappers that call a kernel dispatch to the device too;
-        # iterate to a fixed point (wrapper-of-wrapper).
-        changed = True
-        while changed:
-            changed = False
-            for name, fn in funcs.items():
-                if name in kernels:
-                    continue
-                if local_calls(fn, kernels):
-                    kernels.add(name)
-                    changed = True
-        if kernels:
-            self.kernels_by_module[ctx.module_name] = kernels
+        surface = collect_module_surface(ctx.tree, ctx.lines,
+                                         ctx.module_name, ctx.path)
+        if surface is not None:
+            self.surfaces[ctx.module_name] = surface
 
     def check(self, ctx: ModuleContext) -> Iterator[Finding]:
         # ops/parallel modules compose kernels freely (they ARE the
@@ -68,22 +58,8 @@ class UnguardedDispatchRule(Rule):
         if in_path(ctx.path, "ops", "parallel") or \
                 ctx.path.endswith("utils/deviceguard.py"):
             return
-        direct: dict[str, str] = {}    # local alias -> kernel name
-        mod_alias: dict[str, set[str]] = {}  # alias -> kernel names
-        for node in ast.walk(ctx.tree):
-            if not isinstance(node, ast.ImportFrom):
-                continue
-            resolved = resolve_relative_import(ctx.module_name, node)
-            if resolved is None:
-                continue
-            kernels = self.kernels_by_module.get(resolved)
-            for alias in node.names:
-                if kernels and alias.name in kernels:
-                    direct[alias.asname or alias.name] = alias.name
-                sub = self.kernels_by_module.get(
-                    f"{resolved}.{alias.name}")
-                if sub:
-                    mod_alias[alias.asname or alias.name] = sub
+        direct, mod_alias = kernel_aliases(ctx.tree, ctx.module_name,
+                                           self.surfaces)
         if not direct and not mod_alias:
             return
         thunks = self._dispatch_thunk_names(ctx.tree)
@@ -116,10 +92,12 @@ class UnguardedDispatchRule(Rule):
                 name = dotted_name(child.func)
                 flagged = None
                 if name in direct:
-                    flagged = direct[name]
+                    flagged = direct[name][1]
                 elif name and "." in name:
                     base, attr = name.split(".", 1)
-                    if attr in mod_alias.get(base, ()):
+                    mod = mod_alias.get(base)
+                    if mod is not None and \
+                            attr in self.surfaces[mod].kernels:
                         flagged = name
                 if flagged:
                     yield self.finding(

@@ -1,0 +1,319 @@
+"""KAI_JITTRACE runtime compile-budget auditor.
+
+kaijit (``tools/kaijit/``) proves the STATIC side of the compilation
+contract: every jit boundary's shape inputs are bucketed, every
+``static_argnames`` value domain is bounded.  This shim records the
+DYNAMIC side: with ``KAI_JITTRACE=1``, every jitted kernel in ``ops/``
+and ``parallel/`` is wrapped with a proxy that journals the **abstract
+signature** of each call — dtype and shape per array operand, the
+VALUE (capped repr) per static arg, a weak-type tag per python scalar.
+The set of distinct signatures per kernel is exactly XLA's compilation
+key set: each new signature is a retrace, and on TPU a retrace is
+seconds of silicon time in the middle of a scheduling cycle.
+
+``docs/scale-tests/compile_budget.json`` pins the per-kernel ceiling a
+fleet run may reach (``tools/fleet_budget.py`` enforces it);
+``chaos_matrix --compile`` arms the sweep and joins the journals
+against the static surface via :func:`validate_observed` — a runtime
+compile from a kernel the static model never discovered is an analyzer
+gap and fails loud, exactly like locktrace's contradiction check.
+
+Env contract (mirrors utils/locktrace.py):
+
+- ``KAI_JITTRACE=1``     wrap the kernel surface (the package
+                         ``__init__`` honors this at import)
+- ``KAI_JITTRACE_OUT``   dump the journal as JSON at process exit
+
+Metrics (``jittrace_signatures_recorded_total``,
+``jittrace_calls_total``) publish via :func:`sync_metrics`, called from
+the render path and never from inside a kernel call.
+"""
+
+from __future__ import annotations
+
+import _thread
+import atexit
+import functools
+import importlib
+import json
+import os
+
+_PKG = "kai_scheduler_tpu"
+
+# repr() of a static-arg value is the compile key; cap it so a
+# pathological object cannot bloat the journal.
+_REPR_CAP = 80
+
+
+def _abstract(value, static: bool) -> str:
+    """One operand's contribution to the compilation key."""
+    if static:
+        r = repr(value)
+        return "s:" + (r if len(r) <= _REPR_CAP else r[:_REPR_CAP] + "…")
+    shape = getattr(value, "shape", None)
+    dtype = getattr(value, "dtype", None)
+    if shape is not None and dtype is not None:
+        dims = ",".join(str(d) for d in shape)
+        return f"{dtype}[{dims}]"
+    if value is None:
+        return "None"
+    if isinstance(value, (bool, int, float, complex, str, bytes)):
+        # Non-static python scalars trace as weak-typed constants: the
+        # VALUE is not part of the compilation key, the type is.
+        return f"py:{type(value).__name__}"
+    if isinstance(value, (tuple, list)):
+        inner = ",".join(_abstract(v, False) for v in value[:8])
+        more = "…" if len(value) > 8 else ""
+        return f"({inner}{more})"
+    return f"obj:{type(value).__name__}"
+
+
+def signature_of(args: tuple, kwargs: dict, params: tuple,
+                 static_argnames: frozenset) -> str:
+    """The abstract call signature — the journal's unit of account."""
+    parts = []
+    for i, a in enumerate(args):
+        name = params[i] if i < len(params) else f"arg{i}"
+        parts.append(f"{name}={_abstract(a, name in static_argnames)}")
+    for name in sorted(kwargs):
+        parts.append(
+            f"{name}={_abstract(kwargs[name], name in static_argnames)}")
+    return ", ".join(parts)
+
+
+class JitTracer:
+    def __init__(self):
+        # Raw lock: journal mutation must not touch traced locks.
+        self._guard = _thread.allocate_lock()
+        self.signatures: dict[str, set] = {}   # kernel -> {signature}
+        self.calls: dict[str, int] = {}        # kernel -> call count
+        self.wrapped: list[str] = []           # kernels under trace
+        self._published = {"signatures": 0, "calls": 0}
+        self.installed = False
+
+    def note_call(self, kernel: str, sig: str) -> None:
+        with self._guard:
+            self.signatures.setdefault(kernel, set()).add(sig)
+            self.calls[kernel] = self.calls.get(kernel, 0) + 1
+
+    def dump(self) -> dict:
+        with self._guard:
+            return {
+                "version": 1,
+                "kernels": {k: sorted(v)
+                            for k, v in sorted(self.signatures.items())},
+                "calls": dict(sorted(self.calls.items())),
+                "wrapped": sorted(self.wrapped),
+            }
+
+    def reset(self) -> None:
+        with self._guard:
+            self.signatures.clear()
+            self.calls.clear()
+            self._published = {"signatures": 0, "calls": 0}
+
+    def stats(self) -> dict:
+        """Raw journal sizes for /healthz (mirrors LockTracer.stats)."""
+        with self._guard:
+            return {
+                "kernels_wrapped": len(self.wrapped),
+                "kernels_called": len(self.calls),
+                "signatures_recorded": sum(
+                    len(v) for v in self.signatures.values()),
+                "calls": sum(self.calls.values()),
+            }
+
+
+TRACER = JitTracer()
+
+
+def sync_metrics() -> None:
+    """Publish journal sizes as counters (delta since last sync)."""
+    from .metrics import METRICS
+    with TRACER._guard:
+        sigs = sum(len(v) for v in TRACER.signatures.values())
+        calls = sum(TRACER.calls.values())
+        d_sigs = sigs - TRACER._published["signatures"]
+        d_calls = calls - TRACER._published["calls"]
+        TRACER._published = {"signatures": sigs, "calls": calls}
+    if d_sigs > 0:
+        METRICS.inc("jittrace_signatures_recorded_total", d_sigs)
+    if d_calls > 0:
+        METRICS.inc("jittrace_calls_total", d_calls)
+
+
+# -- static surface (shared with kaijit) ------------------------------------
+
+def discover_surface(root: str | None = None) -> dict:
+    """The whole-package kernel surface as a ``kaijit --surface``
+    payload — the SAME discovery both analyzers run
+    (tools/kailint/jitsurface.py), so the runtime journal and the
+    static model cannot drift."""
+    import ast
+
+    from ..tools.kailint.engine import iter_python_files, package_relative
+    from ..tools.kailint.jitsurface import (collect_module_surface,
+                                            surface_payload)
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    surfaces, errors = {}, []
+    for fpath in iter_python_files([root]):
+        rel = package_relative(fpath)
+        try:
+            with open(fpath, encoding="utf-8") as fh:
+                src = fh.read()
+            tree = ast.parse(src, filename=fpath)
+        except (OSError, SyntaxError, UnicodeDecodeError) as exc:
+            errors.append(f"{fpath}: {exc}")
+            continue
+        module = rel[:-3].replace("/", ".")
+        surface = collect_module_surface(tree, src.splitlines(),
+                                         module, rel)
+        if surface is not None:
+            surfaces[module] = surface
+    return surface_payload(surfaces, errors)
+
+
+def _wrap(fn, kernel: str, params: tuple, static: frozenset):
+    @functools.wraps(fn)
+    def traced(*args, **kwargs):
+        TRACER.note_call(kernel,
+                         signature_of(args, kwargs, params, static))
+        return fn(*args, **kwargs)
+
+    traced.__kai_jittrace__ = kernel
+    traced.__wrapped__ = fn
+    return traced
+
+
+def install(surface: dict | None = None) -> int:
+    """Wrap every directly-compiled kernel the static surface names.
+
+    Imports each ops/parallel module and replaces the module attribute
+    with a journaling proxy; later ``from ..ops.x import k`` imports and
+    module-global lookups inside host wrappers both resolve through the
+    module attribute, so they call the proxy.  References captured into
+    containers at module-import time (before install) stay untraced —
+    the compile-budget manifest's ``require_observed`` floor is
+    calibrated against what the proxies actually see.
+
+    Returns the number of kernels wrapped.  Idempotent."""
+    if TRACER.installed:
+        return len(TRACER.wrapped)
+    surface = surface or discover_surface()
+    wrapped = []
+    for qualname, decl in sorted(surface.get("kernels", {}).items()):
+        if not decl.get("jitted"):
+            continue
+        module_name, _, fn_name = qualname.rpartition(".")
+        try:
+            mod = importlib.import_module(module_name)
+        except Exception:
+            continue  # an unimportable module can't compile anything
+        fn = getattr(mod, fn_name, None)
+        if fn is None or getattr(fn, "__kai_jittrace__", None):
+            continue
+        proxy = _wrap(fn, qualname, tuple(decl.get("params", ())),
+                      frozenset(decl.get("static_argnames", ())))
+        setattr(mod, fn_name, proxy)
+        wrapped.append(qualname)
+    TRACER.wrapped = wrapped
+    TRACER.installed = True
+    return len(wrapped)
+
+
+def uninstall() -> None:
+    """Restore the original module attributes (unit tests only)."""
+    for qualname in TRACER.wrapped:
+        module_name, _, fn_name = qualname.rpartition(".")
+        mod = importlib.import_module(module_name)
+        fn = getattr(mod, fn_name, None)
+        if fn is not None and getattr(fn, "__kai_jittrace__", None):
+            setattr(mod, fn_name, fn.__wrapped__)
+    TRACER.wrapped = []
+    TRACER.installed = False
+
+
+def _dump_to(path: str) -> None:
+    try:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump(TRACER.dump(), fh, indent=2, sort_keys=True)
+            fh.write("\n")
+    except OSError:
+        pass  # a failed dump must not fail the traced process
+
+
+def install_from_env() -> bool:
+    """Honor ``KAI_JITTRACE=1`` (the package ``__init__`` hook)."""
+    if os.environ.get("KAI_JITTRACE", "") in ("", "0", "false"):
+        return False
+    install()
+    out = os.environ.get("KAI_JITTRACE_OUT")
+    if out:
+        atexit.register(_dump_to, out)
+    return True
+
+
+# -- offline merge -----------------------------------------------------------
+
+def load_budget(path: str) -> dict:
+    """``docs/scale-tests/compile_budget.json``: ``{"default_max": N,
+    "kernels": {qualname: ceiling}, "require_observed": [qualname]}``.
+    Shape-corrupt files raise ValueError (a gate that cannot read its
+    contract must fail, not pass vacuously)."""
+    with open(path, encoding="utf-8") as fh:
+        data = json.load(fh)
+    if not isinstance(data, dict) or \
+            not isinstance(data.get("kernels"), dict) or \
+            not isinstance(data.get("default_max"), int):
+        raise ValueError(f"{path}: not a compile-budget manifest "
+                         f"(expected default_max + kernels mapping)")
+    return data
+
+
+def validate_observed(surface: dict, dumps: list,
+                      budget: dict | None = None) -> dict:
+    """Join merged ``KAI_JITTRACE_OUT`` journals against the static
+    surface (and optionally the compile-budget manifest).
+
+    - a journaled kernel ABSENT from the static surface is
+      **unexplained** — the analyzer's discovery has a gap, fail loud;
+    - per-kernel distinct-signature counts take the MAX across journals
+      (signature strings are process-local; a union across seeds would
+      double-count reprs that differ only by object identity);
+    - with a budget: counts above the kernel's ceiling are **breaches**,
+      and ``require_observed`` kernels missing from every journal mean
+      the sweep never exercised them (**uncovered** — a budget nobody
+      spends proves nothing)."""
+    static = {q for q, d in surface.get("kernels", {}).items()
+              if d.get("jitted")}
+    counts: dict[str, int] = {}
+    calls: dict[str, int] = {}
+    for dump in dumps:
+        for kernel, sigs in dump.get("kernels", {}).items():
+            counts[kernel] = max(counts.get(kernel, 0), len(sigs))
+        for kernel, n in dump.get("calls", {}).items():
+            calls[kernel] = calls.get(kernel, 0) + n
+    unexplained = sorted(k for k in counts if k not in static)
+    breaches, uncovered = [], []
+    if budget is not None:
+        default_max = budget.get("default_max", 0)
+        ceilings = budget.get("kernels", {})
+        for kernel in sorted(counts):
+            ceiling = ceilings.get(kernel, default_max)
+            if counts[kernel] > ceiling:
+                breaches.append({"kernel": kernel,
+                                 "signatures": counts[kernel],
+                                 "ceiling": ceiling})
+        uncovered = sorted(k for k in budget.get("require_observed", ())
+                           if k not in counts)
+    return {
+        "kernels": dict(sorted(counts.items())),
+        "calls": dict(sorted(calls.items())),
+        "unexplained": unexplained,
+        "breaches": breaches,
+        "uncovered": uncovered,
+        "ok": (bool(counts) and not unexplained and not breaches
+               and not uncovered),
+    }

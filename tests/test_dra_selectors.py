@@ -354,3 +354,95 @@ class TestManifestParsing:
                     if k == "DeviceSelectorUnsupported"]) == 2
         assert METRICS.counters["device_selector_unsupported"] \
             == count0 + 2
+
+
+class TestAdmissionCELValidation:
+    """The admission webhook rejects DRA objects whose CEL selectors
+    fall outside the evaluable subset — closing the silent-accept gap
+    where an unsupported expression was admitted, matched nothing at
+    snapshot time, and surfaced as an inscrutable "doesn't fit"."""
+
+    def _admission(self):
+        from kai_scheduler_tpu.controllers import (Admission,
+                                                   InMemoryKubeAPI)
+        api = InMemoryKubeAPI()
+        return api, Admission(api=api)
+
+    def test_supported_device_class_admitted(self):
+        api, _ = self._admission()
+        api.create({"kind": "DeviceClass", "apiVersion":
+                    "resource.k8s.io/v1", "metadata": {"name": "a80"},
+                    "spec": {"selectors": [{"cel": {"expression":
+                        'device.attributes["gpu.nvidia.com"].mem '
+                        '== "80"'}}]}})
+        api.drain()
+
+    def test_unsupported_device_class_rejected_loudly(self):
+        import pytest as _pytest
+
+        from kai_scheduler_tpu.controllers import AdmissionError
+        api, _ = self._admission()
+        expr = 'device.attributes["x"].y.matches("^a.*")'
+        api.create({"kind": "DeviceClass", "apiVersion":
+                    "resource.k8s.io/v1", "metadata": {"name": "bad"},
+                    "spec": {"selectors": [{"cel":
+                                            {"expression": expr}}]}})
+        with _pytest.raises(AdmissionError) as exc:
+            api.drain()
+        # The rejection NAMES the object and the offending expression.
+        assert "DeviceClass/bad" in str(exc.value)
+        assert expr in str(exc.value)
+
+    def test_claim_request_selectors_checked(self):
+        import pytest as _pytest
+
+        from kai_scheduler_tpu.controllers import AdmissionError
+        api, _ = self._admission()
+        api.create({"kind": "ResourceClaim", "apiVersion":
+                    "resource.k8s.io/v1", "metadata": {"name": "c1"},
+                    "spec": {"devices": {"requests": [
+                        {"name": "gpus", "selectors": [{"cel": {
+                            "expression": "size(device.x) > 0"}}]}]}}})
+        with _pytest.raises(AdmissionError) as exc:
+            api.drain()
+        assert "ResourceClaim/c1 devices.requests[0].selectors" \
+            in str(exc.value)
+
+    def test_claim_template_inner_spec_checked(self):
+        import pytest as _pytest
+
+        from kai_scheduler_tpu.controllers import AdmissionError
+        api, _ = self._admission()
+        api.create({"kind": "ResourceClaimTemplate", "apiVersion":
+                    "resource.k8s.io/v1", "metadata": {"name": "t1"},
+                    "spec": {"spec": {"devices": {"requests": [
+                        {"selectors": [{"bogus": "shape"}]}]}}}})
+        with _pytest.raises(AdmissionError) as exc:
+            api.drain()
+        assert "non-CEL selector shape" in str(exc.value)
+
+    def test_one_bad_conjunct_rejects_whole_expression(self):
+        import pytest as _pytest
+
+        from kai_scheduler_tpu.controllers import AdmissionError
+        api, _ = self._admission()
+        api.create({"kind": "DeviceClass", "apiVersion":
+                    "resource.k8s.io/v1", "metadata": {"name": "mix"},
+                    "spec": {"selectors": [{"cel": {"expression":
+                        'device.driver == "ok" && size(device.x) > 0'}}]}})
+        with _pytest.raises(AdmissionError):
+            api.drain()
+
+    def test_structured_dialect_and_empty_selectors_admitted(self):
+        api, _ = self._admission()
+        api.create({"kind": "DeviceClass", "apiVersion":
+                    "resource.k8s.io/v1", "metadata": {"name": "flat"},
+                    "spec": {"selectors": [
+                        {"attribute": "gpu.nvidia.com/mem",
+                         "value": "80"},
+                        {"capacity": "gpu.nvidia.com/memory",
+                         "min": "40Gi"}]}})
+        api.create({"kind": "ResourceClaim", "apiVersion":
+                    "resource.k8s.io/v1", "metadata": {"name": "plain"},
+                    "spec": {"devices": {"requests": [{"name": "g"}]}}})
+        api.drain()

@@ -203,6 +203,64 @@ class TestKServe:
         assert meta.min_member == 1
 
 
+class TestSparkFamily:
+    """Spec-derived SparkApplication gang math — the operator CR names
+    the executor count up front, so the gang no longer waits for
+    executor pods to materialize their app-selector labels."""
+
+    def test_sparkapplication_driver_plus_executors(self):
+        meta = group_workload(owner("sparkoperator.k8s.io",
+                                    "SparkApplication",
+                                    {"executor": {"instances": 8}}))
+        assert meta.min_member == 9   # driver + 8 executors
+        assert {(ps.name, ps.min_available) for ps in meta.pod_sets} == {
+            ("driver", 1), ("executor", 8)}
+
+    def test_sparkapplication_default_single_executor(self):
+        meta = group_workload(owner("sparkoperator.k8s.io",
+                                    "SparkApplication"))
+        assert meta.min_member == 2
+
+    def test_dynamic_allocation_min_executors_floor(self):
+        meta = group_workload(owner("sparkoperator.k8s.io",
+                                    "SparkApplication", {
+                                        "executor": {"instances": 100},
+                                        "dynamicAllocation": {
+                                            "enabled": True,
+                                            "minExecutors": 2,
+                                            "maxExecutors": 100}}))
+        # Functional at driver + minExecutors; the rest arrive elastic.
+        assert meta.min_member == 3
+        assert {(ps.name, ps.min_available) for ps in meta.pod_sets} == {
+            ("driver", 1), ("executor", 2)}
+
+    def test_dynamic_allocation_driver_only(self):
+        meta = group_workload(owner("sparkoperator.k8s.io",
+                                    "SparkApplication", {
+                                        "dynamicAllocation": {
+                                            "enabled": True}}))
+        assert meta.min_member == 1
+        assert [ps.name for ps in meta.pod_sets] == ["driver"]
+
+    def test_scheduled_spark_template_gang_and_per_run_group(self):
+        cr = owner("sparkoperator.k8s.io", "ScheduledSparkApplication",
+                   {"schedule": "@hourly",
+                    "template": {"spec": {"executor": {"instances": 4}}}})
+        pod = make_pod("run-exec-1",
+                       labels={"spark-app-selector": "run-77"})
+        meta = group_workload(cr, pod)
+        assert meta.min_member == 5
+        assert meta.name == "pg-spark-run-77"
+
+    def test_bare_spark_pods_still_label_keyed(self):
+        """No operator CR: bare spark-submit pods keep grouping by the
+        app-selector label through the pod grouper."""
+        pod = make_pod("exec-1",
+                       labels={"spark-app-selector": "app-42"})
+        meta = group_workload(owner("", "Pod"), pod)
+        assert meta.name == "pg-spark-app-42"
+
+
 class TestBatchableSignatures:
     def test_new_kinds_are_owner_batchable(self):
         """The new kinds derive metadata from _base's pod pair only, so
@@ -216,10 +274,20 @@ class TestBatchableSignatures:
                     ("workload.codeflare.dev/v1beta2", "AppWrapper"),
                     ("kubeflow.org/v1", "MXJob"),
                     ("kubeflow.org/v1", "PaddleJob"),
+                    ("sparkoperator.k8s.io/v1beta2", "SparkApplication"),
                     ("serving.kserve.io/v1beta1", "InferenceService")):
             grouper = resolve_grouper(*gvk)
             sig = grouper_pod_signature(grouper, pod)
             assert sig == ("team-a", None), gvk
+
+    def test_scheduled_spark_stays_per_pod(self):
+        """ScheduledSparkApplication names the group from the pod's
+        per-run app-selector label, so it must NOT be owner-batched."""
+        from kai_scheduler_tpu.models.groupers import (
+            grouper_pod_signature, resolve_grouper)
+        grouper = resolve_grouper("sparkoperator.k8s.io/v1beta2",
+                                  "ScheduledSparkApplication")
+        assert grouper_pod_signature(grouper, make_pod("p")) is None
 
 
 class TestWorkloadControllers:

@@ -1,0 +1,886 @@
+"""kaijit: the JAX compilation-contract analyzer, tested (tier-1).
+
+Mirrors ``test_kailint.py``/``test_kairace.py``'s three layers:
+
+1. per-rule fixtures — every KJT rule has a seeded violation that FIRES
+   and a clean case that stays silent;
+2. analysis mechanics — the SHARED jit-surface discovery (kailint's
+   KAI004 and kaijit must see the same kernels: the drift guard),
+   cross-module alias resolution, suppressions (tool-scoped: a kailint
+   marker never silences kaijit), the EMPTY-baseline drift gate, and
+   CLI exit codes including the ``--surface`` export;
+3. the package gate — the analyzer runs over the real
+   ``kai_scheduler_tpu/`` tree and must report ZERO findings against a
+   baseline that stays empty forever (fix-don't-baseline);
+
+plus the runtime side: ``utils/jittrace.py`` unit tests (abstract
+compile signatures, the journal, install/uninstall proxies, and the
+``validate_observed`` merge that joins KAI_JITTRACE journals against
+the static surface and the committed compile-budget manifest).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import jax.numpy as jnp
+import pytest
+
+from kai_scheduler_tpu.tools.kailint import default_rules as kailint_rules
+from kai_scheduler_tpu.tools.kailint.engine import (Engine, ModuleContext,
+                                                    load_baseline)
+from kai_scheduler_tpu.tools.kailint.rules.dispatch import \
+    UnguardedDispatchRule
+from kai_scheduler_tpu.tools.kaijit.cli import (jit_surface,
+                                                main as kaijit_main)
+from kai_scheduler_tpu.tools.kaijit.rules import SurfaceRule, default_rules
+from kai_scheduler_tpu.utils import jittrace
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = os.path.join(REPO_ROOT, "kai_scheduler_tpu")
+BASELINE = os.path.join(REPO_ROOT, ".kaijit-baseline.json")
+BUDGET = os.path.join(REPO_ROOT, "docs", "scale-tests",
+                      "compile_budget.json")
+
+# Fixture modules must live under an ops/ (or framework/ for KJT003)
+# path segment: surface discovery only looks where kernels are DEFINED.
+OPS = "kai_scheduler_tpu/ops/fix.py"
+FRAME = "kai_scheduler_tpu/framework/fix.py"
+
+
+def lint(*modules: tuple[str, str], select: set | None = None):
+    """Run the kaijit rule pack over inline fixture modules."""
+    report = Engine(default_rules(), select=select,
+                    tool="kaijit").run_modules(list(modules))
+    assert not report.errors, report.errors
+    return report.findings
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ---------------------------------------------------------------------------
+# KJT001 unbucketed-shape
+# ---------------------------------------------------------------------------
+
+class TestKJT001UnbucketedShape:
+    def test_fires_on_raw_count_shaping_a_kernel_operand(self):
+        src = ("import jax\n"
+               "import jax.numpy as jnp\n"
+               "@jax.jit\n"
+               "def pack_kernel(slots):\n"
+               "    return slots\n"
+               "def host(pods):\n"
+               "    n = len(pods)\n"
+               "    slots = jnp.zeros((n, 4))\n"
+               "    return pack_kernel(slots)\n")
+        findings = lint((OPS, src), select={"KJT001"})
+        assert any(f.rule == "KJT001" and "`n`" in f.message
+                   and "pack_kernel" in f.message for f in findings)
+
+    def test_fires_on_inline_constructor_argument(self):
+        src = ("import jax\n"
+               "import jax.numpy as jnp\n"
+               "@jax.jit\n"
+               "def pack_kernel(slots):\n"
+               "    return slots\n"
+               "def host(pods):\n"
+               "    return pack_kernel(jnp.zeros((len(pods), 4)))\n")
+        findings = lint((OPS, src), select={"KJT001"})
+        assert "KJT001" in rules_of(findings)
+
+    def test_clean_when_dim_is_bucketed(self):
+        src = ("import jax\n"
+               "import jax.numpy as jnp\n"
+               "@jax.jit\n"
+               "def pack_kernel(slots):\n"
+               "    return slots\n"
+               "def host(pods):\n"
+               "    n = next_pow2(len(pods))\n"
+               "    slots = jnp.zeros((n, 4))\n"
+               "    return pack_kernel(slots)\n")
+        assert lint((OPS, src), select={"KJT001"}) == []
+
+    def test_clean_on_while_doubling_idiom(self):
+        src = ("import jax\n"
+               "import jax.numpy as jnp\n"
+               "@jax.jit\n"
+               "def pack_kernel(slots):\n"
+               "    return slots\n"
+               "def host(pods):\n"
+               "    p = 1\n"
+               "    while p < len(pods):\n"
+               "        p *= 2\n"
+               "    return pack_kernel(jnp.zeros((p, 4)))\n")
+        assert lint((OPS, src), select={"KJT001"}) == []
+
+    def test_resident_shape_copies_are_not_raw_sizes(self):
+        # `snap.task_req.shape[0]` reads state whose shape is ALREADY a
+        # compiled key; copying that dim mints no new signature.
+        src = ("import jax\n"
+               "import jax.numpy as jnp\n"
+               "@jax.jit\n"
+               "def pack_kernel(slots):\n"
+               "    return slots\n"
+               "def host(snap):\n"
+               "    t = snap.task_req.shape[0]\n"
+               "    return pack_kernel(jnp.zeros((t, 4)))\n")
+        assert lint((OPS, src), select={"KJT001"}) == []
+
+    def test_cross_module_alias_resolution(self):
+        ops_src = ("import jax\n"
+                   "@jax.jit\n"
+                   "def pack_kernel(slots):\n"
+                   "    return slots\n")
+        host_src = ("import jax.numpy as jnp\n"
+                    "from ..ops.shared import pack_kernel\n"
+                    "def cycle(pods):\n"
+                    "    n = len(pods)\n"
+                    "    slots = jnp.zeros((n, 4))\n"
+                    "    return pack_kernel(slots)\n")
+        findings = lint(("kai_scheduler_tpu/ops/shared.py", ops_src),
+                        ("kai_scheduler_tpu/framework/cycle.py", host_src),
+                        select={"KJT001"})
+        assert any(f.rule == "KJT001" and
+                   f.path == "kai_scheduler_tpu/framework/cycle.py"
+                   for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# KJT002 retrace-static-arg
+# ---------------------------------------------------------------------------
+
+KJT002_KERNEL = ("import functools\n"
+                 "import jax\n"
+                 "@functools.partial(jax.jit, static_argnames=('k',))\n"
+                 "def topk_kernel(x, k):\n"
+                 "    return x\n")
+
+
+class TestKJT002RetraceStaticArg:
+    def test_fires_on_raw_count_static_arg(self):
+        src = KJT002_KERNEL + \
+            ("def host(x, pods):\n"
+             "    return topk_kernel(x, k=len(pods))\n")
+        findings = lint((OPS, src), select={"KJT002"})
+        assert any(f.rule == "KJT002" and "`k`" in f.message
+                   and "raw live count" in f.message for f in findings)
+
+    def test_fires_on_formatted_string_static_arg(self):
+        src = KJT002_KERNEL + \
+            ("def host(x, mode):\n"
+             "    return topk_kernel(x, k=f'm-{mode}')\n")
+        findings = lint((OPS, src), select={"KJT002"})
+        assert any("formatted string" in f.message for f in findings)
+
+    def test_fires_on_float_cast_static_arg(self):
+        src = KJT002_KERNEL + \
+            ("def host(x, share):\n"
+             "    return topk_kernel(x, k=float(share))\n")
+        findings = lint((OPS, src), select={"KJT002"})
+        assert any("float() cast" in f.message for f in findings)
+
+    def test_fires_even_when_bucketing_is_inlined(self):
+        # `k=next_pow2(len(pods))` still walks over the inner len():
+        # the clean idiom binds the bucketed value to a local FIRST.
+        src = KJT002_KERNEL + \
+            ("def host(x, pods):\n"
+             "    return topk_kernel(x, k=next_pow2(len(pods)))\n")
+        findings = lint((OPS, src), select={"KJT002"})
+        assert "KJT002" in rules_of(findings)
+
+    def test_clean_when_bucketed_value_is_bound_first(self):
+        src = KJT002_KERNEL + \
+            ("def host(x, pods):\n"
+             "    k = next_pow2(len(pods))\n"
+             "    return topk_kernel(x, k=k)\n")
+        assert lint((OPS, src), select={"KJT002"}) == []
+
+    def test_dynamic_args_are_not_checked(self):
+        # x is a traced operand, not a static arg: shape rules (KJT001)
+        # own it, value-domain rules do not.
+        src = KJT002_KERNEL + \
+            ("def host(x, pods):\n"
+             "    k = next_pow2(len(pods))\n"
+             "    return topk_kernel(float(x), k=k)\n")
+        assert lint((OPS, src), select={"KJT002"}) == []
+
+
+# ---------------------------------------------------------------------------
+# KJT003 traced-host-escape
+# ---------------------------------------------------------------------------
+
+class TestKJT003TracedHostEscape:
+    def test_fires_on_float_cast_of_pipelined_result(self):
+        src = ("def cycle(session, fn, x):\n"
+               "    fut = session.dispatch_kernel(fn, x, blocking=False)\n"
+               "    return float(fut)\n")
+        findings = lint((FRAME, src), select={"KJT003"})
+        assert any(f.rule == "KJT003" and "`fut`" in f.message
+                   for f in findings)
+
+    def test_fires_on_np_call_and_item(self):
+        src = ("import numpy as np\n"
+               "def cycle(session, fn, x):\n"
+               "    fut = session.dispatch_kernel(fn, x, blocking=False)\n"
+               "    host = np.asarray(fut)\n"
+               "    return fut.item()\n")
+        findings = lint((FRAME, src), select={"KJT003"})
+        assert len(findings) == 2
+
+    def test_clean_when_fetched_through_a_thunk(self):
+        # The lambda handed to a later dispatch_kernel IS the sanctioned
+        # materialize point (`_dispatch_and_fetch`).
+        src = ("def cycle(session, fn, x):\n"
+               "    fut = session.dispatch_kernel(fn, x, blocking=False)\n"
+               "    return session.dispatch_kernel(lambda: float(fut),\n"
+               "                                   blocking=True)\n")
+        assert lint((FRAME, src), select={"KJT003"}) == []
+
+    def test_blocking_dispatch_results_are_not_lazy(self):
+        src = ("def cycle(session, fn, x):\n"
+               "    res = session.dispatch_kernel(fn, x, blocking=True)\n"
+               "    return float(res)\n")
+        assert lint((FRAME, src), select={"KJT003"}) == []
+
+    def test_rule_is_scoped_to_host_cycle_layers(self):
+        src = ("def cycle(session, fn, x):\n"
+               "    fut = session.dispatch_kernel(fn, x, blocking=False)\n"
+               "    return float(fut)\n")
+        assert lint(("kai_scheduler_tpu/utils/fix.py", src),
+                    select={"KJT003"}) == []
+
+
+# ---------------------------------------------------------------------------
+# KJT004 dtype-pin
+# ---------------------------------------------------------------------------
+
+class TestKJT004DtypePin:
+    def test_fires_when_resident_kernel_never_casts(self):
+        src = ("import jax\n"
+               "# kaijit: resident-state=arena\n"
+               "@jax.jit\n"
+               "def update_kernel(arena, vals):\n"
+               "    return arena + vals\n")
+        findings = lint((OPS, src), select={"KJT004"})
+        assert any("never casts" in f.message for f in findings)
+
+    def test_clean_when_kernel_casts_into_resident_dtype(self):
+        src = ("import jax\n"
+               "# kaijit: resident-state=arena\n"
+               "@jax.jit\n"
+               "def update_kernel(arena, vals):\n"
+               "    vals = vals.astype(arena.dtype)\n"
+               "    return arena + vals\n")
+        assert lint((OPS, src), select={"KJT004"}) == []
+
+    KERNEL = ("import jax\n"
+              "import jax.numpy as jnp\n"
+              "import numpy as np\n"
+              "# kaijit: resident-state=arena\n"
+              "@jax.jit\n"
+              "def update_kernel(arena, vals):\n"
+              "    vals = vals.astype(arena.dtype)\n"
+              "    return arena + vals\n")
+
+    def test_fires_on_unpinned_upload_to_resident_kernel(self):
+        src = self.KERNEL + \
+            ("def host(arena):\n"
+             "    buf = np.zeros((8, 4))\n"
+             "    return update_kernel(arena, jnp.asarray(buf))\n")
+        findings = lint((OPS, src), select={"KJT004"})
+        assert any("`buf`" in f.message and "uploaded" in f.message
+                   for f in findings)
+
+    def test_clean_when_constructor_pins_the_dtype(self):
+        src = self.KERNEL + \
+            ("def host(arena):\n"
+             "    buf = np.zeros((8, 4), dtype=np.float32)\n"
+             "    return update_kernel(arena, jnp.asarray(buf))\n")
+        assert lint((OPS, src), select={"KJT004"}) == []
+
+    def test_clean_when_asarray_pins_the_dtype(self):
+        src = self.KERNEL + \
+            ("def host(arena):\n"
+             "    buf = np.zeros((8, 4))\n"
+             "    return update_kernel(arena,\n"
+             "                         jnp.asarray(buf,\n"
+             "                                     dtype=jnp.float32))\n")
+        assert lint((OPS, src), select={"KJT004"}) == []
+
+    def test_param_origin_uploads_are_not_flagged(self):
+        # Unknown origin (a parameter) stays unflagged on purpose:
+        # flagging it would turn every caller into a false positive.
+        src = self.KERNEL + \
+            ("def host(arena, xs):\n"
+             "    return update_kernel(arena, jnp.asarray(xs))\n")
+        assert lint((OPS, src), select={"KJT004"}) == []
+
+
+# ---------------------------------------------------------------------------
+# KJT005 mutable-closure-capture
+# ---------------------------------------------------------------------------
+
+class TestKJT005MutableClosureCapture:
+    def test_fires_on_module_dict_read_from_jit_reachable_helper(self):
+        src = ("import jax\n"
+               "_CFG = {'beta': 0.5}\n"
+               "@jax.jit\n"
+               "def decay_kernel(x):\n"
+               "    return scale(x)\n"
+               "def scale(x):\n"
+               "    return x * _CFG['beta']\n")
+        findings = lint((OPS, src), select={"KJT005"})
+        assert any(f.rule == "KJT005" and "`_CFG`" in f.message
+                   and "`scale`" in f.message for f in findings)
+
+    def test_fires_on_os_environ_read_under_trace(self):
+        src = ("import os\n"
+               "import jax\n"
+               "@jax.jit\n"
+               "def tune_kernel(x):\n"
+               "    flag = os.environ.get('KAI_FAST', '1')\n"
+               "    return x\n")
+        findings = lint((OPS, src), select={"KJT005"})
+        assert any("os.environ" in f.message for f in findings)
+
+    def test_clean_when_config_resolved_at_host_level(self):
+        # The host wrapper reads _CFG and passes the VALUE in: nothing
+        # jit-reachable touches mutable state.
+        src = ("import jax\n"
+               "_CFG = {'beta': 0.5}\n"
+               "@jax.jit\n"
+               "def decay_kernel(x, beta):\n"
+               "    return x * beta\n"
+               "def host(x):\n"
+               "    return decay_kernel(x, _CFG['beta'])\n")
+        assert lint((OPS, src), select={"KJT005"}) == []
+
+    def test_shadowing_param_is_not_a_capture(self):
+        src = ("import jax\n"
+               "_CFG = {'beta': 0.5}\n"
+               "@jax.jit\n"
+               "def decay_kernel(x, _CFG):\n"
+               "    return x * _CFG['beta']\n")
+        assert lint((OPS, src), select={"KJT005"}) == []
+
+
+# ---------------------------------------------------------------------------
+# KJT006 resident-donation
+# ---------------------------------------------------------------------------
+
+class TestKJT006ResidentDonation:
+    def test_fires_when_resident_kernel_declares_no_donation(self):
+        src = ("import jax\n"
+               "# kaijit: resident-state=arena\n"
+               "@jax.jit\n"
+               "def upd_kernel(arena, vals):\n"
+               "    return arena + vals\n")
+        findings = lint((OPS, src), select={"KJT006"})
+        assert any("declares no donation" in f.message for f in findings)
+
+    def test_fires_when_resident_buffer_is_donated(self):
+        src = ("import functools\n"
+               "import jax\n"
+               "# kaijit: resident-state=arena\n"
+               "@functools.partial(jax.jit, donate_argnames=('arena',))\n"
+               "def upd_kernel(arena, vals):\n"
+               "    return arena + vals\n")
+        findings = lint((OPS, src), select={"KJT006"})
+        assert any("donates resident buffer(s) arena" in f.message
+                   for f in findings)
+
+    def test_clean_when_value_operands_are_donated(self):
+        src = ("import functools\n"
+               "import jax\n"
+               "# kaijit: resident-state=arena\n"
+               "@functools.partial(jax.jit, donate_argnames=('vals',))\n"
+               "def upd_kernel(arena, vals):\n"
+               "    return arena + vals\n")
+        assert lint((OPS, src), select={"KJT006"}) == []
+
+    def test_donate_argnums_resolve_against_param_order(self):
+        src = ("import functools\n"
+               "import jax\n"
+               "# kaijit: resident-state=arena\n"
+               "@functools.partial(jax.jit, donate_argnums=(1,))\n"
+               "def upd_kernel(arena, vals):\n"
+               "    return arena + vals\n")
+        assert lint((OPS, src), select={"KJT006"}) == []
+
+    def test_non_resident_kernels_are_exempt(self):
+        src = ("import jax\n"
+               "@jax.jit\n"
+               "def pure_kernel(x):\n"
+               "    return x\n")
+        assert lint((OPS, src), select={"KJT006"}) == []
+
+
+# ---------------------------------------------------------------------------
+# shared surface discovery (the KAI004 <-> kaijit drift guard)
+# ---------------------------------------------------------------------------
+
+SHARED_OPS = ("import jax\n"
+              "@jax.jit\n"
+              "def pack_kernel(slots):\n"
+              "    return slots\n"
+              "def pack_host(slots):\n"
+              "    return pack_kernel(slots)\n")
+
+SHARED_HOST = ("from ..ops.shared import pack_kernel, pack_host\n"
+               "def cycle(slots):\n"
+               "    a = pack_kernel(slots)\n"
+               "    return pack_host(a)\n")
+
+
+class TestSharedSurfaceDrift:
+    def test_both_tools_discover_the_identical_surface(self):
+        """kailint's KAI004 and kaijit's SurfaceRule must collect the
+        SAME ModuleSurface from the same source — the shared-module
+        contract that keeps the two analyzers from drifting."""
+        lint_rule, jit_rule = UnguardedDispatchRule(), SurfaceRule()
+        ctx = ModuleContext("kai_scheduler_tpu/ops/shared.py", SHARED_OPS)
+        lint_rule.collect(ctx)
+        jit_rule.collect(ctx)
+        assert lint_rule.surfaces == jit_rule.surfaces
+        surface = jit_rule.surfaces["kai_scheduler_tpu.ops.shared"]
+        assert surface.kernels["pack_kernel"].jitted
+        wrapper = surface.kernels["pack_host"]
+        assert not wrapper.jitted and wrapper.wraps == ("pack_kernel",)
+
+    def test_kai004_guards_every_kernel_kaijit_sees(self):
+        # Direct host calls to BOTH the jitted kernel and its transitive
+        # wrapper fire KAI004 — the wrapper dispatches to the device too.
+        report = Engine([UnguardedDispatchRule()]).run_modules(
+            [("kai_scheduler_tpu/ops/shared.py", SHARED_OPS),
+             ("kai_scheduler_tpu/framework/cycle.py", SHARED_HOST)])
+        named = sorted(f.message.split("`")[1]
+                       for f in report.findings if f.rule == "KAI004")
+        assert named == ["pack_host", "pack_kernel"]
+
+    def test_runtime_discovery_matches_cli_surface(self):
+        """utils/jittrace.py and ``kaijit --surface`` run the SAME
+        discovery over the real package — the journal and the static
+        model cannot disagree about what a kernel is."""
+        assert jittrace.discover_surface() == jit_surface([PACKAGE])
+
+    def test_real_package_surface_shape(self):
+        payload = jit_surface([PACKAGE])
+        assert payload["errors"] == []
+        kernels = payload["kernels"]
+        jitted = {q for q, d in kernels.items() if d["jitted"]}
+        assert len(jitted) >= 20
+        assert "kai_scheduler_tpu.ops.usage.usage_decay_kernel" in jitted
+        arena = kernels["kai_scheduler_tpu.ops.arena.apply_deltas_kernel"]
+        assert arena["resident"] and arena["donate"]
+        # Donation must be SOUND on the real arena kernel (KJT006).
+        assert set(arena["donate"]).isdisjoint(arena["resident"])
+        assert any(d["wraps"] for d in kernels.values())
+
+
+# ---------------------------------------------------------------------------
+# suppressions & baseline
+# ---------------------------------------------------------------------------
+
+FIRING = ("import jax\n"
+          "import jax.numpy as jnp\n"
+          "@jax.jit\n"
+          "def pack_kernel(slots):\n"
+          "    return slots\n"
+          "def host(pods):\n"
+          "    n = len(pods)\n"
+          "    slots = jnp.zeros((n, 4))\n"
+          "    {marker}\n"
+          "    return pack_kernel(slots)\n")
+
+
+class TestSuppressionsAndBaseline:
+    def test_inline_suppression_silences_the_finding(self):
+        src = FIRING.format(marker="# kaijit: disable=KJT001")
+        assert lint((OPS, src)) == []
+
+    def test_kailint_marker_does_not_silence_kaijit(self):
+        # Tool-scoped suppressions: shared engine chassis, distinct
+        # markers.
+        src = FIRING.format(marker="# kailint: disable=KJT001")
+        findings = lint((OPS, src))
+        assert "KJT001" in rules_of(findings)
+
+    def test_kaijit_marker_does_not_silence_kailint(self):
+        src = ("class C:\n"
+               "    def f(self):\n"
+               "        # kaijit: disable=KAI006\n"
+               "        self._lock.acquire()\n")
+        report = Engine(kailint_rules()).run_modules(
+            [("kai_scheduler_tpu/utils/fix.py", src)])
+        assert any(f.rule == "KAI006" for f in report.findings)
+
+    def test_committed_baseline_is_empty_forever(self):
+        """The kaijit baseline is EMPTY by contract (fix-don't-
+        baseline): a finding is a compilation-contract break to fix or
+        a reviewed suppression to annotate at the site, never debt to
+        park.  This gate keeps it that way."""
+        entries = load_baseline(BASELINE, tool="kaijit")
+        assert entries == {}, (
+            "the kaijit baseline must stay empty — fix the contract "
+            "break or suppress WITH A REASON at the site instead")
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def _make_pkg(tmp_path, src: str, filename: str = "bad.py"):
+    """A throwaway package with an ops/ segment so surface discovery
+    (which anchors on package-relative paths) sees the fixture."""
+    pkg = tmp_path / "pkg"
+    (pkg / "ops").mkdir(parents=True)
+    (pkg / "__init__.py").write_text("")
+    (pkg / "ops" / "__init__.py").write_text("")
+    (pkg / "ops" / filename).write_text(src)
+    return pkg
+
+
+class TestCLI:
+    def test_exit_0_on_clean_file(self, tmp_path, capsys):
+        mod = tmp_path / "clean.py"
+        mod.write_text("def f():\n    return 1\n")
+        assert kaijit_main([str(mod), "--no-baseline"]) == 0
+        assert "0 new finding(s)" in capsys.readouterr().out
+
+    def test_exit_1_on_findings_and_json_shape(self, tmp_path, capsys):
+        pkg = _make_pkg(tmp_path, FIRING.format(marker="pass"))
+        rc = kaijit_main([str(pkg), "--no-baseline", "--format", "json"])
+        assert rc == 1
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["findings"]
+        assert payload["findings"][0]["rule"] == "KJT001"
+        assert payload["findings"][0]["path"].endswith("pkg/ops/bad.py")
+
+    def test_exit_2_on_missing_path(self, capsys):
+        assert kaijit_main(["/no/such/dir"]) == 2
+
+    def test_exit_2_on_unknown_rule_id(self, tmp_path, capsys):
+        mod = tmp_path / "clean.py"
+        mod.write_text("x = 1\n")
+        assert kaijit_main([str(mod), "--select", "KJT999"]) == 2
+
+    def test_exit_2_on_unparseable_file(self, tmp_path):
+        mod = tmp_path / "broken.py"
+        mod.write_text("def f(:\n")
+        assert kaijit_main([str(mod), "--no-baseline"]) == 2
+
+    def test_exit_2_on_corrupt_baseline(self, tmp_path, capsys):
+        mod = tmp_path / "clean.py"
+        mod.write_text("x = 1\n")
+        bad = tmp_path / "corrupt.json"
+        bad.write_text('{"entries": "nope"}\n')
+        assert kaijit_main([str(mod), "--baseline", str(bad)]) == 2
+
+    def test_select_narrows_rules(self, tmp_path):
+        pkg = _make_pkg(tmp_path, FIRING.format(marker="pass"))
+        assert kaijit_main([str(pkg), "--no-baseline",
+                            "--select", "KJT006"]) == 0
+
+    def test_list_rules(self, capsys):
+        assert kaijit_main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        for rid in ("KJT001", "KJT002", "KJT003", "KJT004", "KJT005",
+                    "KJT006"):
+            assert rid in out
+
+    def test_surface_export(self, tmp_path, capsys):
+        pkg = _make_pkg(tmp_path, SHARED_OPS, filename="shared.py")
+        assert kaijit_main([str(pkg), "--surface"]) == 0
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["errors"] == []
+        decl = payload["kernels"]["pkg.ops.shared.pack_kernel"]
+        assert decl["jitted"] and decl["params"] == ["slots"]
+        assert not payload["kernels"]["pkg.ops.shared.pack_host"]["jitted"]
+
+    def test_surface_export_fails_on_parse_error(self, tmp_path, capsys):
+        pkg = _make_pkg(tmp_path, "def f(:\n")
+        assert kaijit_main([str(pkg), "--surface"]) == 2
+
+    def test_write_baseline_refuses_rule_filters(self, tmp_path, capsys):
+        pkg = _make_pkg(tmp_path, FIRING.format(marker="pass"))
+        assert kaijit_main([str(pkg), "--write-baseline",
+                            "--select", "KJT001"]) == 2
+
+    def test_write_baseline_then_rerun_is_green(self, tmp_path, capsys):
+        pkg = _make_pkg(tmp_path, FIRING.format(marker="pass"))
+        bl = tmp_path / "bl.json"
+        assert kaijit_main([str(pkg), "--write-baseline",
+                            "--baseline", str(bl)]) == 0
+        capsys.readouterr()
+        assert kaijit_main([str(pkg), "--baseline", str(bl)]) == 0
+        assert "1 baselined" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# package gate
+# ---------------------------------------------------------------------------
+
+class TestPackageGate:
+    def test_tree_is_clean_with_empty_baseline(self):
+        """Zero findings over the real package WITHOUT any baseline: a
+        failure here is a new compilation-contract break — fix it or
+        document a suppression at the site (docs/STATIC_ANALYSIS.md)."""
+        engine = Engine(default_rules(), tool="kaijit")
+        report = engine.run([PACKAGE], baseline=None)
+        assert report.errors == []
+        rendered = "\n".join(f.render() for f in report.findings)
+        assert report.findings == [], (
+            f"new kaijit findings:\n{rendered}")
+
+    def test_cli_entrypoint_runs_clean(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "kai_scheduler_tpu.tools.kaijit"],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=180)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "0 new finding(s)" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# runtime auditor (utils/jittrace.py)
+# ---------------------------------------------------------------------------
+
+class TestSignatureOf:
+    def test_arrays_statics_and_scalars(self):
+        a = jnp.zeros((4, 2), dtype=jnp.float32)
+        sig = jittrace.signature_of((a, 3), {"mode": "fast"},
+                                    ("x", "k"), frozenset({"k", "mode"}))
+        assert sig == "x=float32[4,2], k=s:3, mode=s:'fast'"
+
+    def test_python_scalars_trace_weakly_typed(self):
+        # The VALUE of a non-static scalar is not a compile key; its
+        # type is.
+        assert jittrace.signature_of((7,), {}, ("x",),
+                                     frozenset()) == "x=py:int"
+        assert jittrace.signature_of((7.5,), {}, ("x",),
+                                     frozenset()) == "x=py:float"
+
+    def test_none_containers_and_objects(self):
+        a = jnp.zeros((2,), dtype=jnp.int32)
+        sig = jittrace.signature_of((None, (a, 1)), {}, ("m", "xs"),
+                                    frozenset())
+        assert sig == "m=None, xs=(int32[2],py:int)"
+        assert jittrace.signature_of((object(),), {}, ("o",),
+                                     frozenset()) == "o=obj:object"
+
+    def test_static_repr_is_capped(self):
+        sig = jittrace.signature_of(("z" * 500,), {}, ("s",),
+                                    frozenset({"s"}))
+        assert sig.endswith("…") and len(sig) < 120
+
+    def test_extra_positionals_get_index_names(self):
+        sig = jittrace.signature_of((1, 2), {}, ("x",), frozenset())
+        assert sig == "x=py:int, arg1=py:int"
+
+
+class TestJitTracer:
+    def test_journal_dedupes_signatures_and_counts_calls(self):
+        t = jittrace.JitTracer()
+        t.note_call("m.k", "x=py:int")
+        t.note_call("m.k", "x=py:int")
+        t.note_call("m.k", "x=py:float")
+        dump = t.dump()
+        assert dump["kernels"] == {"m.k": ["x=py:float", "x=py:int"]}
+        assert dump["calls"] == {"m.k": 3}
+        t.reset()
+        assert t.dump()["kernels"] == {}
+
+
+class TestValidateObserved:
+    SURFACE = {"kernels": {"m.k": {"jitted": True},
+                           "m.wrap": {"jitted": False}}}
+
+    def test_green_run_with_budget(self):
+        dump = {"kernels": {"m.k": ["a", "b"]}, "calls": {"m.k": 5}}
+        budget = {"default_max": 4, "kernels": {}}
+        report = jittrace.validate_observed(self.SURFACE, [dump],
+                                            budget=budget)
+        assert report["ok"]
+        assert report["kernels"] == {"m.k": 2}
+        assert report["calls"] == {"m.k": 5}
+
+    def test_counts_take_max_across_journals_not_union(self):
+        # Signature strings are process-local; a union across seeds
+        # would double-count reprs differing only by object identity.
+        a = {"kernels": {"m.k": ["a", "b"]}, "calls": {"m.k": 2}}
+        b = {"kernels": {"m.k": ["c", "d", "e"]}, "calls": {"m.k": 3}}
+        report = jittrace.validate_observed(self.SURFACE, [a, b])
+        assert report["kernels"] == {"m.k": 3}
+        assert report["calls"] == {"m.k": 5}
+
+    def test_budget_breach_fails(self):
+        dump = {"kernels": {"m.k": ["a", "b"]}, "calls": {"m.k": 2}}
+        budget = {"default_max": 1, "kernels": {}}
+        report = jittrace.validate_observed(self.SURFACE, [dump],
+                                            budget=budget)
+        assert not report["ok"]
+        assert report["breaches"] == [{"kernel": "m.k", "signatures": 2,
+                                       "ceiling": 1}]
+
+    def test_per_kernel_ceiling_overrides_default(self):
+        dump = {"kernels": {"m.k": ["a", "b"]}, "calls": {"m.k": 2}}
+        budget = {"default_max": 1, "kernels": {"m.k": 2}}
+        assert jittrace.validate_observed(self.SURFACE, [dump],
+                                          budget=budget)["ok"]
+
+    def test_unexplained_kernel_fails_loud(self):
+        # A journaled kernel the static surface never discovered is an
+        # ANALYZER GAP — exactly locktrace's contradiction check.
+        dump = {"kernels": {"m.ghost": ["a"]}, "calls": {"m.ghost": 1}}
+        report = jittrace.validate_observed(self.SURFACE, [dump])
+        assert not report["ok"]
+        assert report["unexplained"] == ["m.ghost"]
+
+    def test_journaling_a_non_jitted_wrapper_is_unexplained(self):
+        # Only directly-compiled kernels mint signatures; a wrapper in
+        # the journal means the proxy wrapped something it shouldn't.
+        dump = {"kernels": {"m.wrap": ["a"]}, "calls": {"m.wrap": 1}}
+        report = jittrace.validate_observed(self.SURFACE, [dump])
+        assert report["unexplained"] == ["m.wrap"]
+
+    def test_uncovered_required_kernel_fails(self):
+        # A budget nobody spends proves nothing.
+        dump = {"kernels": {"m.k": ["a"]}, "calls": {"m.k": 1}}
+        budget = {"default_max": 4, "kernels": {},
+                  "require_observed": ["m.k", "m.k2"]}
+        report = jittrace.validate_observed(self.SURFACE, [dump],
+                                            budget=budget)
+        assert not report["ok"]
+        assert report["uncovered"] == ["m.k2"]
+
+    def test_empty_journal_fails(self):
+        assert not jittrace.validate_observed(self.SURFACE, [])["ok"]
+
+
+class TestCompileBudgetManifest:
+    def test_load_budget_rejects_corrupt_manifests(self, tmp_path):
+        bad = tmp_path / "b.json"
+        bad.write_text('{"kernels": {}}\n')       # no default_max
+        with pytest.raises(ValueError):
+            jittrace.load_budget(str(bad))
+        bad.write_text('[1, 2, 3]\n')
+        with pytest.raises(ValueError):
+            jittrace.load_budget(str(bad))
+
+    def test_committed_manifest_names_real_kernels(self):
+        """Every ceiling in docs/scale-tests/compile_budget.json must
+        name a kernel the static surface actually discovers — renaming
+        a kernel without updating the manifest fails HERE, not as a
+        silent default_max fallback in the budget gate."""
+        budget = jittrace.load_budget(BUDGET)
+        surface = jit_surface([PACKAGE])
+        jitted = {q for q, d in surface["kernels"].items()
+                  if d["jitted"]}
+        unknown = set(budget["kernels"]) - jitted
+        assert unknown == set(), unknown
+        assert set(budget["require_observed"]) <= set(budget["kernels"])
+
+
+@pytest.fixture
+def jtraced():
+    if jittrace.TRACER.installed:
+        jittrace.uninstall()
+    jittrace.TRACER.reset()
+    jittrace.install()
+    try:
+        yield jittrace.TRACER
+    finally:
+        jittrace.uninstall()
+        jittrace.TRACER.reset()
+
+
+USAGE_KERNEL = "kai_scheduler_tpu.ops.usage.usage_decay_kernel"
+
+
+class TestInstall:
+    def test_install_wraps_the_surface_and_journals_calls(self, jtraced):
+        from kai_scheduler_tpu.ops import usage
+        assert len(jtraced.wrapped) >= 20
+        assert getattr(usage.usage_decay_kernel,
+                       "__kai_jittrace__", None) == USAGE_KERNEL
+        u = jnp.zeros((3, 2))
+        al = jnp.zeros((3, 2))
+        keep = jnp.ones((3,), dtype=bool)
+        usage.usage_decay_kernel(u, al, keep, 0.5)
+        usage.usage_decay_kernel(u, al, keep, 0.25)
+        # Same shapes, different scalar VALUE: one compile signature.
+        assert len(jtraced.signatures[USAGE_KERNEL]) == 1
+        assert jtraced.calls[USAGE_KERNEL] == 2
+        usage.usage_decay_kernel(jnp.zeros((5, 2)), jnp.zeros((5, 2)),
+                                 jnp.ones((5,), dtype=bool), 0.5)
+        # A new shape IS a new compile key.
+        assert len(jtraced.signatures[USAGE_KERNEL]) == 2
+
+    def test_install_is_idempotent(self, jtraced):
+        from kai_scheduler_tpu.ops import usage
+        n = jittrace.install()
+        assert n == len(jtraced.wrapped)
+        # No double proxy: the wrapped original is the real kernel.
+        inner = usage.usage_decay_kernel.__wrapped__
+        assert not hasattr(inner, "__kai_jittrace__")
+
+    def test_uninstall_restores_module_attrs(self):
+        if jittrace.TRACER.installed:
+            jittrace.uninstall()
+        jittrace.install()
+        from kai_scheduler_tpu.ops import usage
+        assert hasattr(usage.usage_decay_kernel, "__kai_jittrace__")
+        jittrace.uninstall()
+        assert not hasattr(usage.usage_decay_kernel, "__kai_jittrace__")
+        jittrace.TRACER.reset()
+
+    def test_dump_to_writes_the_journal_shape(self, jtraced, tmp_path):
+        jtraced.note_call("m.k", "x=py:int")
+        out = tmp_path / "j.json"
+        jittrace._dump_to(str(out))
+        payload = json.loads(out.read_text())
+        assert payload["version"] == 1
+        assert payload["kernels"] == {"m.k": ["x=py:int"]}
+        assert payload["calls"] == {"m.k": 1}
+        assert USAGE_KERNEL in payload["wrapped"]
+
+    def test_sync_metrics_publishes_delta_counters(self, jtraced):
+        from kai_scheduler_tpu.utils.metrics import METRICS
+        METRICS.reset()
+        jtraced.note_call("m.k", "x=py:int")
+        jittrace.sync_metrics()
+        assert METRICS.counters["jittrace_signatures_recorded_total"] >= 1
+        assert METRICS.counters["jittrace_calls_total"] >= 1
+        # Second sync with no new activity publishes nothing.
+        before = dict(METRICS.counters)
+        jittrace.sync_metrics()
+        assert METRICS.counters == before
+
+    def test_install_from_env_honors_the_flag(self, monkeypatch):
+        monkeypatch.setenv("KAI_JITTRACE", "0")
+        assert jittrace.install_from_env() is False
+
+    def test_healthz_surfaces_journal_stats_when_installed(self, jtraced):
+        """Mirrors locktrace: /healthz carries the raw journal sizes
+        under ``jittrace`` only while the tracer is armed."""
+        from kai_scheduler_tpu.server import healthz_payload
+        jtraced.note_call("m.k", "x=py:int")
+        jtraced.note_call("m.k", "x=py:int")
+        stats = healthz_payload()["jittrace"]
+        assert stats["kernels_wrapped"] >= 20
+        assert stats["kernels_called"] == 1
+        assert stats["signatures_recorded"] == 1
+        assert stats["calls"] == 2
+
+    def test_healthz_omits_jittrace_when_dark(self):
+        from kai_scheduler_tpu.server import healthz_payload
+        assert not jittrace.TRACER.installed
+        assert "jittrace" not in healthz_payload()
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-q"]))

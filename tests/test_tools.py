@@ -425,6 +425,40 @@ class TestChaosMatrixDryRun:
         assert "races=off" in out
         assert "KAI_LOCKTRACE" not in out
 
+    def test_dry_run_compile_mode_arms_jittrace(self, capsys,
+                                                monkeypatch):
+        """--compile: the grid shows compile=on per seed plus the
+        KAI_JITTRACE banner and the kernel-heaviest suites, without
+        discovering the static surface or running anything; composes
+        with the suite-selection modes."""
+        from kai_scheduler_tpu.tools import chaos_matrix
+        monkeypatch.setattr(
+            chaos_matrix.subprocess, "run",
+            lambda *a, **kw: (_ for _ in ()).throw(AssertionError(
+                "dry run must not execute iterations")))
+        rc = chaos_matrix.main(["--dry-run", "--compile", "--seeds",
+                                "3,5"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert out.count("compile=on") == 2
+        assert "KAI_JITTRACE=1" in out
+        assert "static kaijit surface" in out
+        for suite in chaos_matrix.COMPILE_TESTS:
+            assert suite in out
+        rc = chaos_matrix.main(["--dry-run", "--compile", "--pipeline",
+                                "--seeds", "3"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "compile=on" in out
+        assert "tests/test_pipeline_cycle.py" in out
+        # Without the flag the tracer stays dark (an inherited
+        # KAI_JITTRACE env var must not arm it implicitly).
+        rc = chaos_matrix.main(["--dry-run", "--seeds", "3"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "compile=off" in out
+        assert "KAI_JITTRACE" not in out
+
     def test_dry_run_respects_iterations_default_seeds(self, capsys,
                                                        monkeypatch):
         from kai_scheduler_tpu.tools import chaos_matrix
@@ -527,10 +561,11 @@ class TestConformanceDryRun:
         assert rc == 0
         out = capsys.readouterr().out
         assert "kailint" in out and "kairace" in out
+        assert "kaijit" in out
         # Every matrix mode's definition is validated...
         for mode in ("arena", "incremental", "fused", "shards",
                      "pipeline", "latency", "columnar", "wire",
-                     "timeaware", "wire-faults"):
+                     "timeaware", "wire-faults", "compile"):
             assert f"matrix-def:{mode}" in out
         # ...plus ONE real sweep of the newest ring.
         assert "matrix:wire-faults(1 seed)" in out
@@ -547,7 +582,7 @@ class TestConformanceDryRun:
         out = capsys.readouterr().out
         for mode in ("default", "arena", "incremental", "fused",
                      "shards", "pipeline", "latency", "columnar",
-                     "wire", "timeaware", "wire-faults"):
+                     "wire", "timeaware", "wire-faults", "compile"):
             assert f"matrix:{mode}" in out
         assert "fleet-budget" in out
         assert "--seeds 7,11" in out
