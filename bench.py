@@ -222,6 +222,8 @@ def fleet_phase(n_nodes=2000, n_jobs=8, gang=100, waves=2,
     # the loopback server + watch threads, the 97Hz sampler, or the
     # resized lifecycle bounds into the rest of the bench.
     server = client = system = None
+    from kai_scheduler_tpu.utils import wireobs
+    wire0 = wireobs.wire_totals()
 
     def submit_wave(wave):
         api = system.api
@@ -346,17 +348,29 @@ def fleet_phase(n_nodes=2000, n_jobs=8, gang=100, waves=2,
         "stale_writes_skipped": METRICS.counters.get(
             "stale_write_skipped_total", 0),
     }
+    # Wire observatory verdict: byte/syscall/frame-cache movement across
+    # the whole phase (zeros on the in-memory substrate), plus the
+    # fragmentation gauges from the last packed snapshot (ROADMAP 4a).
+    wire_moved = wireobs.wire_delta(wire0, wireobs.wire_totals())
+    fragmentation = {
+        key: val for key, val in METRICS.gauges.items()
+        if key.startswith(("stranded_resource_total",
+                           "largest_placeable_gang"))
+    }
     result = {
         "config": f"{n_nodes}nodes_{n_jobs * gang}pods_fleet",
         "substrate": substrate,
         "pipelined": bool(pipelined),
         "cold_wave_s": round(cold_s, 2),
+        "cold_cycles": len(cold_cycles),
         "cold_bound_pods": cold_bound,
         "warm_cycle_s": round(float(np.median(warm_cycles)), 3),
         "warm_wave_s": round(warm_wave_s, 3),
         "warm_cycles": len(warm_cycles),
         "pod_latency": pod_latency,
         "incremental": incremental,
+        "wire": wire_moved,
+        "fragmentation": fragmentation,
         "stackprof": {
             "samples": prof.total_samples,
             "distinct_stacks": len(prof.samples),
@@ -615,7 +629,9 @@ def pipeline_ab_main() -> int:
                    "p50_submit_bound_ms":
                        r["pod_latency"].get("submit_to_bound_p50_ms"),
                    "p99_submit_bound_ms":
-                       r["pod_latency"].get("submit_to_bound_p99_ms")}
+                       r["pod_latency"].get("submit_to_bound_p99_ms"),
+                   "wire": r.get("wire"),
+                   "fragmentation": r.get("fragmentation")}
             if "pipeline" in r:
                 row["overlap_ratio_mean"] = \
                     r["pipeline"]["overlap_ratio_mean"]
@@ -727,7 +743,9 @@ def columnar_ab_main() -> int:
                    r["pod_latency"].get("submit_to_bound_p50_ms"),
                "p99_submit_bound_ms":
                    r["pod_latency"].get("submit_to_bound_p99_ms"),
-               "columnar_fallbacks": fallbacks}
+               "columnar_fallbacks": fallbacks,
+               "wire": r.get("wire"),
+               "fragmentation": r.get("fragmentation")}
         _append_result_row(row)
         _log(f"fleet columnar A/B {mode}: warm {r['warm_cycle_s']}s, "
              f"snapshotted {medians.get('snapshotted')}ms, grouped "

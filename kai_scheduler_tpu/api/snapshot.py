@@ -452,3 +452,96 @@ def pack_incremental(cluster: ClusterInfo, prev: SnapshotTensors,
         codec=codec, pack_epoch=epoch,
     )
     return snap, np.asarray(rows, np.int64)
+
+
+# -- fragmentation gauges (ROADMAP item 4a) ---------------------------------
+#
+# Per-cycle fragmentation facts computed from the packed feasibility arrays:
+#
+#   stranded_resource_total{resource}  idle capacity on real nodes where NO
+#                                      pending job's representative task fits
+#                                      (selector + taint + pod-room + resource
+#                                      mirror of ops/predicates.feasibility_row)
+#   largest_placeable_gang             max over pending jobs of how many of
+#                                      that job's replicas the cluster could
+#                                      place right now (bounded per node by
+#                                      resource and pod-room capacity)
+#
+# The kernel is a numpy mirror of the device-side feasibility predicate; it
+# runs once per cycle on the already-packed snapshot, so cost is O(J*N*R)
+# with a Python loop only over pending jobs (J <= FRAG_MAX_JOBS).
+
+FRAG_EPS = 1e-9
+FRAG_MAX_NODES = 16384
+FRAG_MAX_JOBS = 512
+
+
+def _frag_resource_names(n: int) -> list[str]:
+    names = list(rs.RESOURCE_NAMES[:n])
+    while len(names) < n:
+        names.append(f"res{len(names)}")
+    return names
+
+
+def fragmentation_stats(snap: SnapshotTensors,
+                        max_nodes: int = FRAG_MAX_NODES,
+                        max_jobs: int = FRAG_MAX_JOBS) -> dict | None:
+    """Fragmentation facts for the packed snapshot, or None when skipped.
+
+    Returns ``{"stranded": {resource: amount}, "largest_placeable_gang": int,
+    "stranded_nodes": int}``.  Each pending job is represented by its first
+    task row (gangs are homogeneous per replica spec), matching the
+    device-side predicate semantics.  Oversized snapshots are skipped (with
+    ``fragmentation_stats_skipped_total``) rather than risking a multi-second
+    numpy pass inside the cycle.
+    """
+    from ..utils.metrics import METRICS
+
+    idle = snap.node_idle
+    n_nodes, n_res = idle.shape
+    names = _frag_resource_names(n_res)
+    pending_jobs = np.nonzero(snap.job_task_count > 0)[0]
+    if pending_jobs.size == 0:
+        return {"stranded": {nm: 0.0 for nm in names},
+                "largest_placeable_gang": 0, "stranded_nodes": 0}
+    if n_nodes > max_nodes or pending_jobs.size > max_jobs:
+        METRICS.inc("fragmentation_stats_skipped_total")
+        return None
+
+    labels = snap.node_labels
+    taints = snap.node_taints
+    room = snap.node_pod_room
+    real = snap.node_allocatable.sum(axis=1) > 0
+    floor_room = np.floor(np.maximum(room, 0.0))
+    any_fit = np.zeros(n_nodes, dtype=bool)
+    largest = 0
+    for j in pending_jobs:
+        rep = int(snap.job_task_start[j])
+        if rep >= snap.task_req.shape[0]:
+            continue
+        req = snap.task_req[rep]
+        sel = snap.task_selector[rep]
+        tol = snap.task_tolerations[rep]
+        sel_ok = np.all((sel == NO_LABEL) | (sel == labels), axis=1)
+        tol_ok = (taints[:, :, None] == tol[None, None, :]).any(axis=2)
+        taint_ok = np.all((taints == NO_TAINT) | tol_ok, axis=1)
+        fit = (sel_ok & taint_ok & (room >= 1.0)
+               & np.all(req[None, :] <= idle + FRAG_EPS, axis=1))
+        any_fit |= fit
+        if not fit.any():
+            continue
+        pos = req > FRAG_EPS
+        if pos.any():
+            cap = np.floor((idle[:, pos] + FRAG_EPS) / req[pos]).min(axis=1)
+            cap = np.minimum(cap, floor_room)
+        else:
+            cap = floor_room
+        total = float(np.clip(cap[fit], 0.0, None).sum())
+        largest = max(largest, int(min(float(snap.job_task_count[j]), total)))
+
+    stranded_mask = real & ~any_fit
+    stranded = {nm: float(np.maximum(idle[stranded_mask, r], 0.0).sum())
+                for r, nm in enumerate(names)}
+    return {"stranded": stranded,
+            "largest_placeable_gang": largest,
+            "stranded_nodes": int(stranded_mask.sum())}

@@ -53,6 +53,14 @@ Protocol (JSON bodies everywhere):
   GET    /watch?since={seq}                chunked stream of events
   GET    /relist                           atomic snapshot + seq
   GET    /healthz
+  GET    /metrics                          Prometheus text — the
+                                           server-end wire-observatory
+                                           counters live here in the
+                                           split-process regime
+  GET    /debug/spans?since={id}           server-side request/fanout
+                                           span records after cursor
+                                           (the distributed-trace
+                                           graft pull)
 
 Every mutation response carries ``X-Kai-Seq``: the event-log sequence
 AFTER the write's events were appended.  A client that waits for its
@@ -90,6 +98,7 @@ import copy
 import io
 import itertools
 import json
+import os
 import queue
 import selectors
 import socket
@@ -100,9 +109,11 @@ from collections import deque
 from http.server import BaseHTTPRequestHandler
 from urllib.parse import parse_qs, urlparse
 
+from ..utils import wireobs
 from ..utils.deviceguard import control_fault
 from ..utils.logging import ScopedLogger
 from ..utils.metrics import METRICS
+from ..utils.tracing import SPAN_HEADER, TRACE_HEADER
 from .kubeapi import (Conflict, Fenced, InMemoryKubeAPI, NotFound,
                       field_match, obj_key, parse_field_selector)
 
@@ -154,6 +165,12 @@ class _FrameCache:
         # Multi-writer BY DESIGN (mutating threads + pool workers), every
         # access under _lock — no single-writer contract to annotate.
         self._frames: dict = {}
+        # Regression lever for the fleet_budget wire gates: disabling
+        # the cache makes every list/get re-encode every object per
+        # request, which the max-encodes-per-cycle ceiling and the
+        # frame-cache byte-hit ratio must catch loudly.
+        self._disabled = os.environ.get("KAI_WIRE_NO_FRAME_CACHE",
+                                        "") not in ("", "0")
 
     def put(self, key: tuple, rv, data: bytes) -> None:
         with self._lock:
@@ -176,13 +193,20 @@ class _FrameCache:
         hold whatever lock makes ``obj`` stable (the server lock)."""
         key = obj_key(obj)
         rv = obj.get("metadata", {}).get("resourceVersion")
-        data = self.get(key, rv) if rv is not None else None
+        data = (self.get(key, rv)
+                if rv is not None and not self._disabled else None)
         if data is not None:
             METRICS.inc("watch_frame_cache_hits_total")
+            wireobs.count_frame_bytes("cache", len(data))
             return data
         METRICS.inc("watch_frame_cache_misses_total")
+        # Serve-path encodes separately from the compulsory one-per-
+        # mutation append encode: with a warm cache this stays near
+        # zero, so the wire budget can pin it structurally.
+        METRICS.inc("frame_cache_serve_encodes_total")
         data = _dumps(obj)
-        if rv is not None:
+        wireobs.count_frame_bytes("encode", len(data))
+        if rv is not None and not self._disabled:
             self.put(key, rv, data)
         return data
 
@@ -217,6 +241,7 @@ class EventLog:
         # frame below and the list/get response cache.
         METRICS.inc("watch_frame_cache_misses_total")
         obj_bytes = _dumps(obj)
+        wireobs.count_frame_bytes("encode", len(obj_bytes))
         if key is not None:
             if event_type == "DELETED":
                 self.frames.drop(key)
@@ -312,9 +337,17 @@ class KubeAPIServer:
         # "restart" leaves clients reading heartbeats from a zombie
         # streamer forever instead of reconnecting.
         self._closing = threading.Event()
-        # Live watch streamer count (bounded by max_watch_streams).
-        self._watch_streams = 0
+        # Live watch streamer SLOTS (bounded by max_watch_streams).
+        # The smallest-free slot index doubles as the watcher's metric
+        # label (`stream`) — bounded cardinality by construction, never
+        # a client identity.
+        self._watch_slots: set = set()
         self._watch_lock = threading.Lock()
+        # Wire observatory (PR 19): completed server-side span records
+        # (request phases + watch fanout bursts), bounded ring, served
+        # at GET /debug/spans?since= and grafted into the scheduler's
+        # cycle traces by Tracer.graft_remote_spans.
+        self.spans = wireobs.SpanRing()
         # Wire-fault bookkeeping (KAI_FAULT_INJECT wire-* modes): one
         # deterministic counter per mode, server-wide — "first n" and
         # "every nth" semantics must hold across connections and pool
@@ -547,16 +580,21 @@ class KubeAPIServer:
                     "items": items}
 
     # -- watch streamer accounting ------------------------------------------
-    def acquire_watch_slot(self) -> bool:
+    def acquire_watch_slot(self) -> int | None:
+        """Claim the smallest free streamer slot index, or None at the
+        cap.  The index labels this watcher's fanout/depth metrics."""
         with self._watch_lock:
-            if self._watch_streams >= self.max_watch_streams:
-                return False
-            self._watch_streams += 1
-            return True
+            if len(self._watch_slots) >= self.max_watch_streams:
+                return None
+            slot = 0
+            while slot in self._watch_slots:
+                slot += 1
+            self._watch_slots.add(slot)
+            return slot
 
-    def release_watch_slot(self) -> None:
+    def release_watch_slot(self, slot: int) -> None:
         with self._watch_lock:
-            self._watch_streams -= 1
+            self._watch_slots.discard(slot)
 
 
 def selectors_select_one(sock: socket.socket, timeout: float) -> bool:
@@ -604,7 +642,8 @@ class _Conn:
     """One accepted connection: socket + buffered reader + raw writer +
     its (reusable) request handler."""
 
-    __slots__ = ("sock", "addr", "rfile", "wfile", "handler")
+    __slots__ = ("sock", "addr", "rfile", "wfile", "handler",
+                 "enqueued_at")
 
     def __init__(self, sock: socket.socket, addr, server: KubeAPIServer):
         self.sock = sock
@@ -614,6 +653,10 @@ class _Conn:
         # pre-assembled buffers; watch streams batch per event burst.
         self.wfile = _SocketWriter(sock)
         self.handler = _Handler(self, server)
+        # Stamped by the dispatcher at queue time; the handler's
+        # queue_wait phase is (dequeue - enqueue).  None when the
+        # worker served this request during its linger (no queue hop).
+        self.enqueued_at: float | None = None
 
     def close(self) -> None:
         for closer in (self.rfile.close, self.wfile.close,
@@ -755,6 +798,7 @@ class _PooledHTTPServer:
                 except (KeyError, ValueError, OSError):
                     continue
                 try:
+                    conn.enqueued_at = time.perf_counter()
                     self._work.put_nowait(conn)
                     METRICS.inc("apiserver_pool_dispatch_total")
                 except queue.Full:
@@ -871,13 +915,25 @@ class _Handler(BaseHTTPRequestHandler):
         self.close_connection = True
         self.detached = False
         self.suppress_response = False
+        # Wire-observatory accumulator for the IN-FLIGHT request
+        # (phases + byte counts); armed by _route, read by the send/
+        # read helpers below.  None between requests.
+        self._rq: dict | None = None
 
     def _send_json(self, code: int, payload: dict,
                    headers: dict | None = None) -> None:
-        self._send_bytes(code, _dumps(payload), headers)
+        rq = self._rq
+        t0 = time.perf_counter()
+        body = _dumps(payload)
+        if rq is not None:
+            rq["serialize_s"] += time.perf_counter() - t0
+        self._send_bytes(code, body, headers)
 
     def _send_bytes(self, code: int, body: bytes,
                     headers: dict | None = None) -> None:
+        rq = self._rq
+        if rq is not None:
+            rq["status"] = code
         if getattr(self, "suppress_response", False):
             # wire-reset fault: the mutation LANDED but the connection
             # dies before a single response byte — the client faces the
@@ -897,21 +953,108 @@ class _Handler(BaseHTTPRequestHandler):
             if v is not None:
                 self.send_header(k, str(v))
         self.end_headers()
+        t0 = time.perf_counter()
         self.wfile.write(body)
+        if rq is not None:
+            # Body bytes and the body's sendall only: the header flush
+            # is one more write, identical for every response — the
+            # reconciliation contract (client-sent == server-received)
+            # is over BODY bytes, which framing noise would blur.
+            rq["sendall_s"] += time.perf_counter() - t0
+            rq["bytes_out"] += len(body)
+            wireobs.count_bytes("server", rq["path"], "out", len(body))
+            wireobs.count_syscall("server", rq["path"], "send")
 
     def _read_body(self) -> dict | None:
         length = int(self.headers.get("Content-Length") or 0)
         if not length:
             return None
-        return json.loads(self.rfile.read(length))
+        raw = self.rfile.read(length)
+        rq = self._rq
+        if rq is not None:
+            rq["bytes_in"] += len(raw)
+            wireobs.count_syscall("server", rq["path"], "recv")
+        return json.loads(raw)
 
     def _route(self, method: str) -> None:
+        """Wire-observatory shell around the real router: times the
+        dispatch-queue wait / handler / serialize / sendall phases,
+        counts bytes at the seams, and records one span — tagged with
+        the client's injected X-Kai-Trace/X-Kai-Span context — into the
+        server's bounded SpanRing.  The /debug/spans pull itself,
+        /metrics scrapes, and detached watch attaches are not recorded
+        (the pull would make every pull return at least its own record,
+        a scrape is not control-plane traffic, and watch attaches are
+        covered by per-burst fanout records)."""
+        t0 = time.perf_counter()
+        enqueued = self.conn.enqueued_at
+        self.conn.enqueued_at = None  # linger reuse: no stale queue hop
+        queue_wait = max(0.0, t0 - enqueued) if enqueued is not None \
+            else 0.0
+        pcls = wireobs.path_class(method, self.path)
+        rq = self._rq = {"path": pcls, "bytes_in": 0, "bytes_out": 0,
+                         "serialize_s": 0.0, "sendall_s": 0.0,
+                         "status": None}
+        trace = self.headers.get(TRACE_HEADER)
+        parent = self.headers.get(SPAN_HEADER)
+        try:
+            self._route_inner(method)
+        finally:
+            self._rq = None
+            if rq["bytes_in"]:
+                wireobs.count_bytes("server", pcls, "in", rq["bytes_in"])
+            if not self.detached \
+                    and not self.path.startswith(("/debug/spans",
+                                                  "/metrics")):
+                elapsed = time.perf_counter() - t0
+                handler_s = max(0.0, elapsed - rq["serialize_s"]
+                                - rq["sendall_s"])
+                self.kai_server.spans.record({
+                    "trace": trace, "parent": parent,
+                    "name": f"http:{pcls}", "kind": "server_request",
+                    "path": pcls, "status": rq["status"],
+                    "bytes_in": rq["bytes_in"],
+                    "bytes_out": rq["bytes_out"],
+                    "dur_s": round(queue_wait + elapsed, 6),
+                    "phases": {
+                        "queue_wait": round(queue_wait, 6),
+                        "handler": round(handler_s, 6),
+                        "serialize": round(rq["serialize_s"], 6),
+                        "sendall": round(rq["sendall_s"], 6)}})
+
+    def _route_inner(self, method: str) -> None:
         server = self.kai_server
         parsed = urlparse(self.path)
         query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
         parts = [p for p in parsed.path.split("/") if p]
         if parsed.path == "/healthz":
             self._send_json(200, {"ok": True})
+            return
+        if parsed.path == "/metrics":
+            # The apiserver process owns the server-end wire counters
+            # (wire_bytes_total{end="server"}, frame_cache_bytes_total,
+            # watch_fanout_*, watch_stream_queue_depth) — in the
+            # split-process regime they are invisible from the
+            # scheduler daemon's /metrics, so expose them here.  Writes
+            # bypass _send_bytes: a scrape is not control-plane traffic
+            # and must not move the byte accounting it reports.
+            body = METRICS.to_prometheus_text().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        if parsed.path == "/debug/spans":
+            # The scheduler-side graft pull.  Served before the wire
+            # fault gates: the observatory must stay readable while the
+            # wire lies — that is when its data matters most.
+            try:
+                after = int(query.get("since", 0))
+            except ValueError:
+                after = 0
+            head, spans = server.spans.since(after)
+            self._send_json(200, {"next": head, "spans": spans})
             return
         if parsed.path.startswith("/watch"):
             self._start_watch_stream(int(query.get("since", 0)),
@@ -972,26 +1115,29 @@ class _Handler(BaseHTTPRequestHandler):
         streams live for the client's lifetime and must not occupy pool
         workers (a fleet of watchers would deadlock the pool)."""
         server = self.kai_server
-        if not server.acquire_watch_slot():
+        slot = server.acquire_watch_slot()
+        if slot is None:
             METRICS.inc("apiserver_watch_streams_rejected_total")
             self._send_json(429, {"error": "watch stream limit reached"},
                             {"Retry-After": 1})
             return
         self.detached = True
         t = threading.Thread(target=self._stream_watch_detached,
-                             args=(since, boot), daemon=True,
+                             args=(since, boot, slot), daemon=True,
                              name="apiserver-watch-stream")
         t.start()
 
-    def _stream_watch_detached(self, since: int, boot: str | None) -> None:
+    def _stream_watch_detached(self, since: int, boot: str | None,
+                               slot: int) -> None:
         try:
             self.conn.sock.settimeout(REQUEST_TIMEOUT_S)
-            self._stream_watch(since, boot)
+            self._stream_watch(since, boot, slot)
         finally:
-            self.kai_server.release_watch_slot()
+            self.kai_server.release_watch_slot(slot)
             self.conn.close()
 
-    def _stream_watch(self, since: int, boot: str | None) -> None:
+    def _stream_watch(self, since: int, boot: str | None,
+                      slot: int) -> None:
         server = self.kai_server
         self.send_response(200)
         self.send_header("Content-Type", "application/x-ndjson")
@@ -999,7 +1145,10 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
 
         def send_line(payload: dict) -> None:
-            self.wfile.write(_chunk(_dumps(payload) + b"\n"))
+            line = _chunk(_dumps(payload) + b"\n")
+            self.wfile.write(line)
+            wireobs.count_bytes("server", "watch", "out", len(line))
+            wireobs.count_syscall("server", "watch", "send")
 
         # Chaos: drop the stream after N lines (watchdrop fault) —
         # the client must reconnect with its seq and lose nothing.
@@ -1019,6 +1168,7 @@ class _Handler(BaseHTTPRequestHandler):
         stall_spec = control_fault("wire-stall")
         stall_s = (float(stall_spec or 50) / 1000.0) \
             if stall_spec is not None else None
+        depth_cap = wireobs.watch_queue_cap()
         sent = 0
         seq = since
         try:
@@ -1053,6 +1203,24 @@ class _Handler(BaseHTTPRequestHandler):
                        "seq": seq})
             while not server._closing.is_set():
                 events = server.log.since(seq)
+                # Send-queue depth: frames pending behind this
+                # watcher's cursor, ABOUT to be buffered into one
+                # burst.  Beyond the cap the watcher is too slow to
+                # keep a bounded buffer — answer an explicit GONE
+                # (it re-lists and resumes from head) instead of
+                # accumulating the ring into an in-flight bytearray,
+                # which was this streamer's unbounded-memory blind
+                # spot.
+                wireobs.note_stream_depth(slot, len(events))
+                if len(events) > depth_cap:
+                    METRICS.inc("watch_stream_depth_gone_total")
+                    send_line({"type": "GONE", "code": 410,
+                               "seq": server.log.seq,
+                               "boot": server.boot_id,
+                               "oldest": server.log.oldest(),
+                               "reason": "send queue depth "
+                                         f"{len(events)} > {depth_cap}"})
+                    return
                 if events and events[0][0] != seq + 1:
                     # This watcher overran the ring mid-stream: the
                     # events between its cursor and the retained
@@ -1102,8 +1270,28 @@ class _Handler(BaseHTTPRequestHandler):
                         METRICS.inc("wire_faults_injected_total",
                                     mode="wire-stall")
                         time.sleep(stall_s)
+                    t_burst = time.perf_counter()
                     self.wfile.write(buf)
+                    burst_s = time.perf_counter() - t_burst
                     METRICS.inc("watch_frame_cache_hits_total", n_frames)
+                    # Fanout accounting: the burst left in ONE sendall
+                    # of preserialized (cache-served) bytes; lag is
+                    # what already accumulated behind this watcher
+                    # while it was being written.
+                    wireobs.count_bytes("server", "watch", "out",
+                                        len(buf))
+                    wireobs.count_syscall("server", "watch", "send")
+                    wireobs.count_frame_bytes("cache", len(buf))
+                    lag = server.log.seq - seq
+                    wireobs.note_fanout(slot, n_frames, len(buf), lag)
+                    server.spans.record({
+                        "trace": None, "parent": None,
+                        "name": "watch:fanout",
+                        "kind": "server_fanout", "path": "watch",
+                        "stream": slot, "frames": n_frames,
+                        "lag_frames": lag, "bytes_out": len(buf),
+                        "dur_s": round(burst_s, 6),
+                        "phases": {"sendall": round(burst_s, 6)}})
                 if dropped or truncated:
                     return
                 with server.log.cond:

@@ -40,9 +40,11 @@ import weakref
 from collections import defaultdict
 from typing import Callable
 
-from ..utils import backoff_delay
+from ..utils import backoff_delay, wireobs
 from ..utils.deviceguard import control_fault
 from ..utils.metrics import METRICS
+from ..utils.tracing import (NULL_CLIENT_SPAN, SPAN_HEADER, TRACE_HEADER,
+                             TRACER)
 from .kubeapi import (Conflict, Fenced, NotFound, coalesce_events,
                       encode_field_selector, obj_key)
 
@@ -132,6 +134,9 @@ class HTTPKubeAPI:
         # wire-drop fault counter (mutating requests); deterministic so
         # the chaos matrix can replay a seed.
         self._wire_drop_count = 0
+        # Cursor into the apiserver's span ring (GET /debug/spans):
+        # pull_spans() drains past it once per cycle epilogue.
+        self._spans_cursor = 0
         # Default fence for mutating writes (set_fence); per-call epoch=
         # kwargs override.
         self._fence: str | None = None
@@ -228,10 +233,34 @@ class HTTPKubeAPI:
     def _request(self, method: str, path: str,
                  body: dict | None = None,
                  epoch: int | None = None,
-                 fence: str | None = None) -> dict:
+                 fence: str | None = None,
+                 observe: bool = True) -> dict:
+        """Wire-observatory shell around the transport: classifies the
+        request, opens the client half of a cross-process span (whose
+        context rides the X-Kai-Trace/X-Kai-Span headers), and counts
+        body bytes + send/recv calls per request class.  ``observe=
+        False`` turns ALL of it off — the /debug/spans pull itself must
+        not generate spans or count against the wire budgets it
+        feeds."""
+        if not observe:
+            return self._request_inner(method, path, body, epoch, fence,
+                                       None, NULL_CLIENT_SPAN)
+        pcls = wireobs.path_class(method, path)
+        with TRACER.client_span(f"http:{pcls}", kind="wire", path=pcls,
+                                method=method) as ctx:
+            return self._request_inner(method, path, body, epoch, fence,
+                                       pcls, ctx)
+
+    def _request_inner(self, method: str, path: str, body: dict | None,
+                       epoch: int | None, fence: str | None,
+                       pcls: str | None, ctx) -> dict:
         self._maybe_partition()
         data = json.dumps(body).encode() if body is not None else None
         headers = {"Content-Type": "application/json"}
+        if ctx.trace_id is not None:
+            headers[TRACE_HEADER] = ctx.trace_id
+            if ctx.span_id is not None:
+                headers[SPAN_HEADER] = ctx.span_id
         if fence is None and method in ("POST", "PUT", "PATCH", "DELETE") \
                 and self._fence is not None \
                 and self._epoch_provider is not None:
@@ -259,11 +288,22 @@ class HTTPKubeAPI:
                 conn.request(method, self._conn_path_prefix + path,
                              body=data, headers=headers)
                 sent = True
+                if pcls is not None:
+                    # Counted per ATTEMPT: a resent body crossed the
+                    # wire again — the server counts each receipt too,
+                    # so both ends reconcile.
+                    wireobs.count_bytes("client", pcls, "out",
+                                        len(data) if data else 0)
+                    wireobs.count_syscall("client", pcls, "send")
                 self._maybe_wire_drop(method, sent)
                 resp = conn.getresponse()
                 status = resp.status
                 try:
                     raw = resp.read()  # drain fully so the conn is reusable
+                    if pcls is not None:
+                        wireobs.count_bytes("client", pcls, "in",
+                                            len(raw))
+                        wireobs.count_syscall("client", pcls, "recv")
                 except (http.client.HTTPException, OSError) as exc:
                     # Body died mid-read: the conn is done, but the
                     # status line already arrived — a truncated 404/409
@@ -300,6 +340,9 @@ class HTTPKubeAPI:
                            + self._reconnect_rng.random() * 0.005)
                 continue
             break
+        ctx.set(status=status)
+        if throttles:
+            ctx.set(throttles=throttles)
         if status < 300 and method != "GET":
             seq_h = resp.getheader("X-Kai-Seq")
             if seq_h:
@@ -415,6 +458,27 @@ class HTTPKubeAPI:
         the server half of the anti-entropy exchange; see
         utils/antientropy.py and ``ClusterCache.anti_entropy_check``."""
         return self._request("GET", "/digest")
+
+    # -- wire observatory ----------------------------------------------------
+    def pull_spans(self) -> list[dict]:
+        """Drain the apiserver's span ring past our cursor (``GET
+        /debug/spans?since=``) — the operator grafts the result into
+        the owning cycle traces once per epilogue.  Untraced and
+        uncounted (observe=False): the observatory must not feed
+        itself into the budgets it measures.  A dead or old server
+        (no endpoint) yields [] — span loss is bounded-ring
+        observability, never an error the control plane acts on."""
+        try:
+            out = self._request(
+                "GET", f"/debug/spans?since={self._spans_cursor}",
+                observe=False)
+        except (NotFound, urllib.error.URLError, OSError, ValueError):
+            return []
+        head = out.get("next")
+        if isinstance(head, int) and head > self._spans_cursor:
+            self._spans_cursor = head
+        spans = out.get("spans")
+        return spans if isinstance(spans, list) else []
 
     # -- bulk writes ---------------------------------------------------------
     def _decode_outcomes(self, payload: dict) -> list[dict]:
@@ -586,13 +650,29 @@ class HTTPKubeAPI:
                 url = f"{self.base_url}/watch?since={self._watch_seq}"
                 if self._server_boot is not None:
                     url += f"&boot={self._server_boot}"
-                req = urllib.request.Request(url)
+                # Watch-attach trace stamping: the watch thread carries
+                # no cycle, so this is normally a no-op — but an
+                # embedder attaching under an ambient context gets the
+                # attach attributed like any other request.
+                hdrs = {}
+                tid, sid = TRACER.current_context()
+                if tid is not None:
+                    hdrs[TRACE_HEADER] = tid
+                    if sid is not None:
+                        hdrs[SPAN_HEADER] = sid
+                req = urllib.request.Request(url, headers=hdrs)
                 with urllib.request.urlopen(req, timeout=30.0) as resp:
                     for raw in resp:
                         if self._stop.is_set():
                             break  # decide at the locked loop top
                         got_line = True
                         failures = 0
+                        # One counted recv per delivered frame line —
+                        # deterministic (the stream is line-framed), not
+                        # a socket-level recv census.
+                        wireobs.count_bytes("client", "watch", "in",
+                                            len(raw))
+                        wireobs.count_syscall("client", "watch", "recv")
                         event = json.loads(raw)
                         etype = event.get("type")
                         if etype == "BOOT":

@@ -154,6 +154,13 @@ class System:
         self._older_token = 0
         self._last_token = 0
         self.pipeline_stats: deque = deque(maxlen=256)
+        # Wire-observatory baseline: last wire_totals() snapshot, so
+        # each cycle's trace gets the DELTA of wire counters it caused.
+        # Written only where cycles end — the cycle thread (serial) or
+        # the single commit-executor thread (pipelined), never both at
+        # once (the pipeline drains before the serial path runs).
+        # kairace: disable=KRC001
+        self._wire_last: dict = {}
         self.commit_executor = None
         # Sticky serial fallback after a poisoned (fenced/crashed)
         # commit stream: a deposed instance must not resume overlapping
@@ -526,6 +533,34 @@ class System:
                 pass
             self.api.drain()
 
+    def _wire_observatory(self, cycle_sessions) -> None:
+        """Post-epilogue wire-observatory pass: pull the apiserver's
+        server-side span records and graft them into the owning ring
+        traces (the distributed trace join), then attach this cycle's
+        wire-counter delta to its trace — the per-cycle `wire` section
+        on /debug/cycles.  Memory substrate: pull_spans is absent and
+        the counter delta is empty, so the whole pass is a no-op."""
+        from ..utils import wireobs
+        from ..utils.tracing import TRACER
+        pull = getattr(self.api, "pull_spans", None)
+        if pull is not None:
+            spans = pull()
+            if spans:
+                TRACER.graft_remote_spans(spans)
+        totals = wireobs.wire_totals()
+        # _wire_last is written from the serial epilogue (main) and the
+        # overlapped batch epilogue (commit executor) — never both in
+        # one regime, but the swap takes the control lock so the
+        # serial<->pipelined regime handoff can't tear it.
+        with self._control_lock:
+            if not totals and not self._wire_last:
+                return
+            delta = wireobs.wire_delta(self._wire_last, totals)
+            self._wire_last = totals
+        for _s, ssn in cycle_sessions:
+            TRACER.attach_wire_summary(
+                getattr(ssn, "trace_id", None), delta)
+
     def _record_decisions(self, ssn) -> None:
         if self.usage_db is not None \
                 and getattr(ssn, "proportion", None) is not None:
@@ -578,11 +613,25 @@ class System:
             self.api.drain()
         self.reconcile_config()
         self.reconcile_shards()
+        cycle_sessions = []
         for scheduler in self.schedulers:
             ssn = scheduler.run_once()
             scheduler.cache.update_job_statuses(ssn)
             self._record_decisions(ssn)
-        self._run_control_epilogue()
+            cycle_sessions.append((scheduler, ssn))
+        # Ambient wire context: the epilogue's own requests (binder
+        # waves, status flush, digest) happen after end_cycle finalized
+        # the trace on this thread — arm the trace id so they still
+        # stamp and attach to the owning cycle.
+        from ..utils.tracing import TRACER
+        trace_id = (getattr(cycle_sessions[-1][1], "trace_id", None)
+                    if cycle_sessions else None)
+        TRACER.set_wire_context(trace_id)
+        try:
+            self._run_control_epilogue()
+        finally:
+            TRACER.clear_wire_context()
+        self._wire_observatory(cycle_sessions)
 
     def _run_cycle_pipelined(self) -> None:
         """The overlapped cycle: stage A (drain + snapshot) and stage B
@@ -670,11 +719,18 @@ class System:
 
         from ..utils.tracing import TRACER
         t0 = _time.perf_counter()
+        # Ambient wire context on the executor thread: the epilogue's
+        # requests (binder waves, status flush, digest) stamp the
+        # owning cycle's trace and attach as deferred client spans.
+        trace_id = (getattr(cycle_sessions[-1][1], "trace_id", None)
+                    if cycle_sessions else None)
+        TRACER.set_wire_context(trace_id)
         try:
             for scheduler, ssn in cycle_sessions:
                 scheduler.cache.update_job_statuses(ssn)
             self._run_control_epilogue()
         finally:
+            TRACER.clear_wire_context()
             with self._pipe_lock:
                 sealed = self._pending_spec.pop(cycle_id, [])
             for cache, handle in sealed:
@@ -684,3 +740,4 @@ class System:
                 TRACER.attach_async_span(
                     getattr(ssn, "trace_id", None), "stage:epilogue",
                     "commit_async", dt)
+            self._wire_observatory(cycle_sessions)

@@ -528,11 +528,18 @@ class Statement:
 
         def run_batch() -> None:
             t_batch = _time.perf_counter()
+            # Ambient wire context: the batch's bulk bind/status waves
+            # run on the executor thread after the cycle trace was
+            # finalized — arm the trace id so every wave's request
+            # still stamps X-Kai-Trace and its client span attaches to
+            # the owning cycle (the wire observatory's commit leg).
+            TRACER.set_wire_context(trace_id)
             try:
                 self._run_overlapped_batch(executor, cache, log, by_op,
                                            intents, intent_ops, epoch,
                                            handle, ops)
             finally:
+                TRACER.clear_wire_context()
                 # The commit stage finishes after its cycle's trace was
                 # finalized: attach the span post-hoc so /debug/trace
                 # still shows where cycle N's commit budget went.

@@ -11,6 +11,7 @@ from __future__ import annotations
 import time
 
 from .actions import build_actions
+from .api.snapshot import fragmentation_stats
 from .framework.conf import SchedulerConfig
 from .framework.session import InMemoryCache, Session
 from .utils.deviceguard import (CycleDeadlineExceeded, DeviceGuardError,
@@ -85,6 +86,19 @@ class Scheduler:
                     # cycle trace: /debug/trace shows per-cycle pack
                     # behavior next to the span that paid for it.
                     snap_sp.set(**ssn.pack_stats)
+                frag = fragmentation_stats(ssn.snapshot)
+                if frag is not None:
+                    # Fragmentation gauges ride the snapshot span AND the
+                    # metrics registry so bench fleet rows and /metrics both
+                    # see per-cycle stranded capacity (ROADMAP item 4a).
+                    for res, amount in frag["stranded"].items():
+                        METRICS.set_gauge("stranded_resource_total",
+                                          amount, resource=res)
+                    METRICS.set_gauge("largest_placeable_gang",
+                                      float(frag["largest_placeable_gang"]))
+                    snap_sp.set(
+                        largest_placeable_gang=frag["largest_placeable_gang"],
+                        stranded_nodes=frag["stranded_nodes"])
             ssn.trace_id = trace_id
             ssn.commit_executor = self.commit_executor
             if self.commit_executor is not None:

@@ -54,6 +54,7 @@ from .controllers import ShardSpec, System, SystemConfig
 from .framework.conf import SchedulerConfig
 from .plugins.snapshot_plugin import dump_cluster
 from .utils import parse_bool as _parse_bool
+from .utils import wireobs
 from .utils.deviceguard import configure_device_guard, device_guard
 from .utils.lifecycle import LIFECYCLE
 from .utils.jittrace import TRACER as JITTRACE
@@ -231,6 +232,12 @@ def _make_handler(server_state):
                     # Incremental host pipeline: last snapshot's dirty
                     # counts, store sizes, and watch-delta mode.
                     payload["incremental_cache"] = cache_stats
+                wire = wireobs.wire_totals()
+                if wire:
+                    # Wire observatory: cumulative byte/syscall/frame-cache
+                    # totals across both transport ends.  Per-cycle deltas
+                    # ride each cycle summary's "wire" section.
+                    payload["wire"] = wire
                 system = server_state.get("system")
                 executor = getattr(system, "commit_executor", None)
                 if executor is not None:

@@ -94,6 +94,13 @@ WIRE_TESTS = ["tests/test_wire_protocol.py"]
 # including scheduler crash-replay and apiserver restart (seq
 # regression) mid bulk-bind-wave.
 WIRE_FAULT_TESTS = ["tests/test_wire_faults.py"]
+# --wiretrace: the wire-observatory ring (PR 19) — distributed trace
+# joins (client wire spans + grafted server_request/phase spans, one
+# trace id, Perfetto-exportable), /debug/spans cursor + bounded span
+# ring + self-exclusion, graft idempotence (re-grafting the same window
+# adds nothing) and client/server byte reconciliation under
+# wire-corrupt/reset/drop, and the watch depth-cap GONE contract.
+WIRETRACE_TESTS = ["tests/test_wiretrace.py"]
 # --compile: the compile-contract ring — the kernel-heaviest suites
 # (fused-parity regenerates randomized workloads per seed; rankplace
 # and usagedb sweep the rank & time kernels) run with KAI_JITTRACE=1
@@ -227,6 +234,16 @@ def main(argv=None) -> int:
                          "and anti-entropy digest convergence are "
                          "asserted, incl. crash-replay and apiserver "
                          "restart mid bulk-bind-wave")
+    ap.add_argument("--wiretrace", action="store_true",
+                    help="wire-observatory mode: sweep the distributed-"
+                         f"tracing ring ({WIRETRACE_TESTS}) — each seed "
+                         "reshuffles fleet churn while trace joins "
+                         "(grafted server spans, one trace id), graft "
+                         "idempotence, client/server byte "
+                         "reconciliation under wire-corrupt/reset/drop, "
+                         "the bounded /debug/spans ring, and the watch "
+                         "depth-cap GONE contract are asserted.  "
+                         "Composes with --wire/--wire-faults/--pipeline")
     ap.add_argument("--races", action="store_true",
                     help="runtime lock-order validation: every iteration "
                          "runs with KAI_LOCKTRACE=1 (threading factories "
@@ -284,6 +301,7 @@ def main(argv=None) -> int:
             (TIMEAWARE_TESTS if args.timeaware else []) + \
             (WIRE_TESTS if args.wire else []) + \
             (WIRE_FAULT_TESTS if args.wire_faults else []) + \
+            (WIRETRACE_TESTS if args.wiretrace else []) + \
             (COMPILE_TESTS if args.compile else [])
         if not tests:
             tests = DEFAULT_TESTS

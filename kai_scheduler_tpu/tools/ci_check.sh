@@ -21,7 +21,9 @@
 #   chaos matrix  --dry-run validation of the fault-grid definition
 #                 (including the --races KAI_LOCKTRACE lock-order
 #                 validation mode, the --wire-faults lying-wire ring,
-#                 and the --compile KAI_JITTRACE compile-contract ring)
+#                 the --compile KAI_JITTRACE compile-contract ring,
+#                 and the --wiretrace distributed-trace/byte-account
+#                 chaos ring)
 #   conformance   tools/conformance.py --smoke: every proof in one
 #                 command — all three analyzers, every chaos-matrix
 #                 mode definition, and a real 1-seed wire-faults sweep
@@ -45,7 +47,11 @@
 #                 frame-cache hit ratio) must stay in budget — the
 #                 whole run traces under KAI_JITTRACE, so the committed
 #                 per-kernel compile-signature ceilings
-#                 (docs/scale-tests/compile_budget.json) gate here too
+#                 (docs/scale-tests/compile_budget.json) gate here too,
+#                 as do the wire-observatory per-cycle ceilings
+#                 (docs/scale-tests/wire_budget.json): bytes/syscalls/
+#                 encodes per cycle, serve-path re-encode cap, the
+#                 frame-cache byte-hit floor, and a grafted-span floor
 #   tier-1 tests  pytest -m 'not slow' on CPU
 #
 # Usage: kai_scheduler_tpu/tools/ci_check.sh [--no-tests]
@@ -90,6 +96,8 @@ python -m kai_scheduler_tpu.tools.chaos_matrix --wire-faults --dry-run \
 python -m kai_scheduler_tpu.tools.chaos_matrix --races --dry-run \
     || fail=1
 python -m kai_scheduler_tpu.tools.chaos_matrix --compile --dry-run \
+    || fail=1
+python -m kai_scheduler_tpu.tools.chaos_matrix --wiretrace --dry-run \
     || fail=1
 
 echo

@@ -36,6 +36,15 @@ result against ``docs/scale-tests/fleet_budget.json``:
   ``usage_decay_dispatch_total`` per recorded cycle — a silent
   per-queue host loop multiplies it by Q while every wall clock still
   passes — with a fold-median ceiling on top;
+- **wire budget (PR 19 observatory)**: the HTTP smoke runs under the
+  wire observatory, and its per-cycle client-end byte/syscall/encode
+  footprint plus the frame cache's BYTE-hit ratio must stay within the
+  committed ``docs/scale-tests/wire_budget.json`` ceilings — disabling
+  the preserialized frame cache (``KAI_WIRE_NO_FRAME_CACHE=1``)
+  re-encodes every list/get response and trips the encode + byte-ratio
+  gates loudly while every wall clock still passes on a fast machine;
+  at least one server span must have grafted into a cycle trace, so a
+  silently broken trace join fails here too;
 - **compile budget (kaijit's runtime half)**: the whole run executes
   under utils/jittrace.py, and the per-kernel distinct abstract
   signatures (= XLA compilation keys) must stay within the committed
@@ -68,6 +77,9 @@ def main(argv=None) -> int:
     ap.add_argument("--compile-budget", default=None,
                     help="compile-budget manifest (default: "
                          "docs/scale-tests/compile_budget.json)")
+    ap.add_argument("--wire-budget", default=None,
+                    help="wire-budget manifest (default: "
+                         "docs/scale-tests/wire_budget.json)")
     args = ap.parse_args(argv)
 
     repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
@@ -251,6 +263,7 @@ def main(argv=None) -> int:
                + _labeled("apiserver_bulk_requests_total", op="patch"))
     h_hits0 = METRICS.counters.get("watch_frame_cache_hits_total", 0)
     h_miss0 = METRICS.counters.get("watch_frame_cache_misses_total", 0)
+    h_graft0 = METRICS.counters.get("wire_spans_grafted_total", 0)
     hres = bench.fleet_phase(hshape["nodes"], hshape["jobs"],
                              hshape["gang"], pipelined=True,
                              substrate="http")
@@ -261,6 +274,40 @@ def main(argv=None) -> int:
     h_miss = METRICS.counters.get("watch_frame_cache_misses_total",
                                   0) - h_miss0
     h_ratio = round(h_hits / max(h_hits + h_miss, 1), 3)
+
+    # Wire-budget measurement (PR 19): the http smoke's own ``wire``
+    # section is the byte/syscall delta across the whole phase; divide
+    # by the cycles it took for per-cycle footprints.  Client-end
+    # counters are the gated side — they move once per *attempt*, so a
+    # retry storm shows up here even when the server saw each write
+    # once.  Encodes = frame-cache misses (every one is a full
+    # json.dumps on the serve path).
+    from kai_scheduler_tpu.utils import wireobs
+    wire = hres.get("wire") or {}
+    h_cycles = max(1, (hres.get("cold_cycles") or 0)
+                   + (hres.get("warm_cycles") or 0))
+
+    def _wire(name, **labels):
+        return wire.get(_metric_key(name, labels), 0)
+
+    wire_client_bytes = sum(
+        _wire("wire_bytes_total", dir=d, end="client", path=p)
+        for d in ("in", "out") for p in wireobs.PATH_CLASSES)
+    wire_client_syscalls = sum(
+        _wire("wire_syscalls_total", end="client", op=op, path=p)
+        for op in ("send", "recv") for p in wireobs.PATH_CLASSES)
+    wire_encodes = wire.get("watch_frame_cache_misses_total", 0)
+    wire_serve_encodes = wire.get("frame_cache_serve_encodes_total", 0)
+    wire_cache_b = _wire("frame_cache_bytes_total", src="cache")
+    wire_enc_b = _wire("frame_cache_bytes_total", src="encode")
+    wire_byte_hit = round(
+        wire_cache_b / max(wire_cache_b + wire_enc_b, 1), 3)
+    wire_grafted = METRICS.counters.get("wire_spans_grafted_total",
+                                        0) - h_graft0
+    wire_budget_path = args.wire_budget or os.path.join(
+        repo_root, "docs", "scale-tests", "wire_budget.json")
+    with open(wire_budget_path) as f:
+        wire_budget = json.load(f)
 
     # Columnar host-state gates (DESIGN §11): the warm fleet shape must
     # stay on the array-native snapshot path end to end — a single
@@ -347,6 +394,25 @@ def main(argv=None) -> int:
          ">=", budget.get("min_http_bulk_requests", 2)),
         ("frame_cache_hit_ratio", h_ratio,
          ">=", budget.get("min_frame_cache_hit_ratio", 0.3)),
+        ("wire_bytes_per_cycle",
+         int(round(wire_client_bytes / h_cycles)),
+         "<=", wire_budget["max_bytes_per_cycle"]),
+        ("wire_syscalls_per_cycle",
+         int(round(wire_client_syscalls / h_cycles)),
+         "<=", wire_budget["max_syscalls_per_cycle"]),
+        ("wire_encodes_per_cycle",
+         int(round(wire_encodes / h_cycles)),
+         "<=", wire_budget["max_encodes_per_cycle"]),
+        # Serve-path re-encodes exclude the compulsory per-mutation
+        # append encode, so a disabled/rotted frame cache reads hundreds
+        # per cycle here against a near-zero warm baseline.
+        ("wire_serve_encodes_per_cycle",
+         int(round(wire_serve_encodes / h_cycles)),
+         "<=", wire_budget["max_serve_encodes_per_cycle"]),
+        ("frame_cache_byte_hit_ratio", wire_byte_hit,
+         ">=", wire_budget["min_frame_cache_byte_hit_ratio"]),
+        ("wire_spans_grafted", wire_grafted,
+         ">=", wire_budget.get("min_spans_grafted", 1)),
     ]
 
     # Compile-budget gate (kaijit's runtime half): merge the journal

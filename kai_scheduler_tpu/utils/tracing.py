@@ -31,6 +31,24 @@ Post-mortem hook: when ``KAI_TRACE_DIR`` is set, every aborted or
 degraded cycle's Chrome trace JSON is written there as it completes —
 ``tools/chaos_matrix.py --trace-dir`` uses this to capture the traces of
 failing chaos iterations.
+
+Cross-process propagation (PR 19, the wire observatory): a trace no
+longer dies at the process boundary.  ``HTTPKubeAPI`` opens a
+``client_span`` around every request and injects the active context as
+``X-Kai-Trace`` / ``X-Kai-Span`` headers (W3C ``traceparent`` shape,
+flattened to two headers because the only peer is our own apiserver);
+the apiserver times each request's dispatch-queue wait / handler /
+serialize / sendall phases and records them — tagged with the injected
+context — into a bounded ``SpanRing`` (utils/wireobs.py) served at
+``GET /debug/spans?since=``.  Once per cycle the operator pulls that
+ring and ``graft_remote_spans`` joins the server's spans back into the
+owning ring trace, CENTERED inside their client parent span: the two
+processes' ``perf_counter`` domains are unrelated, so the only honest
+alignment is containment — the residual gap on each side of the server
+span IS the wire time, visible in Perfetto instead of lost.  Threads
+that carry no live cycle (the commit executor) arm an **ambient wire
+context** (``set_wire_context``) so their requests still stamp the
+owning cycle's trace and their client spans attach post-hoc.
 """
 
 from __future__ import annotations
@@ -44,6 +62,12 @@ from collections import deque
 
 from .logging import LOG
 from .metrics import METRICS
+
+# Cross-process trace-context carriers (W3C traceparent analog, split
+# into two headers: trace id and the client span awaiting its server
+# half).  Shared by httpclient (inject) and apiserver (extract).
+TRACE_HEADER = "X-Kai-Trace"
+SPAN_HEADER = "X-Kai-Span"
 
 
 class Span:
@@ -129,6 +153,75 @@ class _SpanCtx:
         return False
 
 
+class _ClientSpanCtx:
+    """Client half of a cross-process wire span (one HTTP request).
+
+    Three regimes, decided at open time by ``Tracer.client_span``:
+
+    - **live**: a cycle trace is active on this thread — a real nested
+      span rides the thread-local stack like any ``Tracer.span``;
+    - **deferred**: no live trace, but an ambient wire context is armed
+      (commit-executor threads) — the span's id is pre-allocated so the
+      ``X-Kai-Span`` header can carry it, the duration is measured here,
+      and the finished span attaches to the finalized ring trace on
+      exit (same post-hoc path as ``attach_async_span``);
+    - **null**: no context at all (watch thread, bench setup) — every
+      call no-ops and ``trace_id`` is None, so the caller skips the
+      headers.
+    """
+
+    __slots__ = ("_tracer", "trace_id", "span_id", "_span", "_name",
+                 "_kind", "_parent_id", "_attrs", "_t0")
+
+    def __init__(self, tracer, trace_id=None, span_id=None, span=None,
+                 name="", kind="wire", parent_id=None, attrs=None):
+        self._tracer = tracer
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self._span = span  # live regime only
+        self._name = name
+        self._kind = kind
+        self._parent_id = parent_id
+        self._attrs = dict(attrs) if attrs else {}
+        self._t0 = 0.0
+
+    def set(self, **attrs) -> None:
+        if self._span is not None:
+            self._span.set(**attrs)
+        elif self.trace_id is not None:
+            self._attrs.update(attrs)
+
+    def mark_error(self, message: str) -> None:
+        if self._span is not None:
+            self._span.mark_error(message)
+        elif self.trace_id is not None:
+            self._attrs["status"] = "error"
+            self._attrs["error"] = message[:300]
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if self._span is not None:
+            if exc is not None and self._span.status == "ok":
+                self._span.mark_error(f"{exc_type.__name__}: {exc}")
+            self._tracer._close_span(self._span)
+        elif self.trace_id is not None:
+            if exc is not None and "error" not in self._attrs:
+                self._attrs["status"] = "error"
+                self._attrs["error"] = f"{exc_type.__name__}: {exc}"[:300]
+            self._tracer._attach_completed_span(
+                self.trace_id, self.span_id, self._parent_id, self._name,
+                self._kind, time.perf_counter() - self._t0, self._attrs)
+        return False
+
+
+# Shared null client span: requests made with tracing off (observability
+# traffic like the /debug/spans pull itself) reuse this.
+NULL_CLIENT_SPAN = _ClientSpanCtx(None)
+
+
 class CycleTrace:
     """One complete scheduling cycle: the root span, its children, the
     abort/degraded verdict, and the explainability ledger."""
@@ -153,6 +246,12 @@ class CycleTrace:
         self.duration_ms = 0.0
         self.explain: dict[str, list[str]] = {}  # podgroup -> reasons
         self.dropped_rejections = 0
+        # Wire observatory (PR 19): per-cycle wire-counter delta
+        # (attach_wire_summary) and the ids of server-side records
+        # already grafted — the graft dedup set, so a re-pulled or
+        # replayed /debug/spans record can never join twice.
+        self.wire: dict | None = None
+        self.grafted: set = set()
 
     def add_rejection(self, podgroup: str, reason: str) -> None:
         reasons = self.explain.get(podgroup)
@@ -189,7 +288,8 @@ class CycleTrace:
                 "spans": self.span_summary(),
                 "dropped_spans": self.dropped_spans,
                 "dropped_rejections": self.dropped_rejections,
-                "rejected_podgroups": sorted(self.explain)}
+                "rejected_podgroups": sorted(self.explain),
+                "wire": self.wire}
 
     def to_chrome(self) -> dict:
         """Chrome trace-event JSON: load in Perfetto (ui.perfetto.dev)
@@ -214,12 +314,22 @@ class Tracer:
     and take the ring lock; finished traces are immutable."""
 
     def __init__(self, capacity: int | None = None,
-                 max_spans_per_trace: int = 512):
+                 max_spans_per_trace: int | None = None):
         if capacity is None:
             try:
                 capacity = int(os.environ.get("KAI_TRACE_CYCLES", 32))
             except ValueError:
                 capacity = 32
+        if max_spans_per_trace is None:
+            # Fleet-scale cycles (hundreds of nodes over the http wire)
+            # legitimately record thousands of wire + grafted server
+            # spans per cycle; KAI_TRACE_MAX_SPANS deepens the recorder
+            # for those runs while the default keeps tier-1 memory flat.
+            try:
+                max_spans_per_trace = int(
+                    os.environ.get("KAI_TRACE_MAX_SPANS", 512))
+            except ValueError:
+                max_spans_per_trace = 512
         self.capacity = max(1, capacity)
         self.max_spans_per_trace = max(8, max_spans_per_trace)
         self._ring: deque = deque(maxlen=self.capacity)
@@ -362,6 +472,61 @@ class Tracer:
         trace = st["trace"] if st else None
         return trace.trace_id if trace is not None else None
 
+    # -- cross-process context (the wire observatory) ----------------------
+    def current_context(self) -> tuple[str | None, str | None]:
+        """(trace_id, span_id) to inject into outbound headers: the live
+        thread-local trace's innermost open span when a cycle is active
+        on this thread, else the ambient wire context armed by the
+        commit executor, else (None, None)."""
+        st = getattr(self._local, "state", None)
+        trace = st["trace"] if st else None
+        if trace is not None:
+            stack = st["stack"]
+            top = stack[-1] if stack else trace.root
+            return trace.trace_id, (top.span_id if top is not None
+                                    else None)
+        ambient = getattr(self._local, "ambient", None)
+        if ambient is not None:
+            return ambient
+        return None, None
+
+    def set_wire_context(self, trace_id: str | None,
+                         span_id: str | None = None) -> None:
+        """Arm an ambient wire context on THIS thread: requests made
+        here (commit executor, control epilogue) stamp ``trace_id``
+        even though the cycle trace was finalized on another thread.
+        Pair with ``clear_wire_context`` in a finally."""
+        self._local.ambient = (trace_id, span_id) if trace_id else None
+
+    def clear_wire_context(self) -> None:
+        self._local.ambient = None
+
+    def client_span(self, name: str, kind: str = "wire",
+                    **attrs) -> _ClientSpanCtx:
+        """Open the client half of a cross-process span (one outbound
+        request).  See ``_ClientSpanCtx`` for the three regimes; the
+        returned ctx's ``trace_id``/``span_id`` are what the transport
+        injects as ``X-Kai-Trace``/``X-Kai-Span``."""
+        st = self._state()
+        trace: CycleTrace | None = st["trace"]
+        if trace is not None:  # live: a real span on this thread's stack
+            parent = st["stack"][-1] if st["stack"] else None
+            sp = Span(trace.trace_id, f"s{next(self._ids)}",
+                      parent.span_id if parent is not None else None,
+                      name, kind, time.perf_counter() - trace.t0)
+            if attrs:
+                sp.attrs.update(attrs)
+            st["stack"].append(sp)
+            return _ClientSpanCtx(self, trace.trace_id, sp.span_id,
+                                  span=sp)
+        ambient = getattr(self._local, "ambient", None)
+        if ambient is not None and ambient[0] is not None:  # deferred
+            return _ClientSpanCtx(self, ambient[0],
+                                  f"s{next(self._ids)}", name=name,
+                                  kind=kind, parent_id=ambient[1],
+                                  attrs=attrs)
+        return NULL_CLIENT_SPAN
+
     def note_pipelined(self) -> None:
         """Mark the active cycle trace as running in overlapped-pipeline
         mode (the root span carries ``pipelined=True``)."""
@@ -378,6 +543,22 @@ class Tracer:
         flight recorder must still show where cycle N's commit budget
         went.  Thread-safe (ring lock); a trace that already aged out of
         the ring drops the span (returns False)."""
+        if not self._attach_completed_span(trace_id,
+                                           f"s{next(self._ids)}", None,
+                                           name, kind, duration_s,
+                                           attrs):
+            return False
+        METRICS.observe(f"cycle_span_{kind}_latency_ms",
+                        duration_s * 1e3)
+        return True
+
+    def _attach_completed_span(self, trace_id, span_id, parent_id, name,
+                               kind, duration_s, attrs) -> bool:
+        """Append an already-measured span to a finalized ring trace
+        (attach_async_span and the deferred client-span regime).  With
+        no explicit parent the span hangs off the root; the start is
+        back-dated from now so async work lands where it actually ran
+        relative to the cycle origin."""
         if trace_id is None:
             return False
         with self._lock:
@@ -385,21 +566,127 @@ class Tracer:
                 if trace.trace_id != trace_id:
                     continue
                 root = trace.root
-                sp = Span(trace_id, f"s{next(self._ids)}",
-                          root.span_id if root is not None else None,
-                          name, kind,
+                pid = parent_id or (root.span_id if root is not None
+                                    else None)
+                sp = Span(trace_id, span_id, pid, name, kind,
                           max(0.0, time.perf_counter() - trace.t0
                               - duration_s))
                 sp.duration_s = duration_s
                 if attrs:
                     sp.attrs.update(attrs)
                 self._record_span(trace, sp)
-                break
-            else:
-                return False
-        METRICS.observe(f"cycle_span_{kind}_latency_ms",
-                        duration_s * 1e3)
-        return True
+                return True
+        return False
+
+    # Phase order inside one server-side request record: insertion
+    # order matters — grafted phase children are laid out sequentially.
+    _SERVER_PHASES = ("queue_wait", "handler", "serialize", "sendall")
+
+    def graft_remote_spans(self, remote_spans) -> dict:
+        """Join server-side span records (``GET /debug/spans``) into
+        their owning ring traces; returns counts
+        ``{"grafted", "orphaned", "duplicate"}``.
+
+        Each record carries the (trace, parent) context the client
+        injected.  The server's ``perf_counter`` domain is unrelated to
+        ours, so a grafted request span is CENTERED inside its client
+        parent span — the residual left/right gap is the wire time,
+        attributed instead of invisible.  Its phases become child spans
+        (kinds ``server_queue_wait`` / ``server_handler`` /
+        ``server_serialize`` / ``server_sendall``) laid out
+        sequentially.  Records that carried no context at all (watch
+        fanout bursts, pre-cycle traffic) are expected and count as
+        unattributed; records whose trace already aged out of the ring
+        count as orphaned; a record id seen before on its trace counts
+        as duplicate and never double-grafts (``CycleTrace.grafted``)."""
+        out = {"grafted": 0, "orphaned": 0, "duplicate": 0,
+               "unattributed": 0}
+        if not remote_spans:
+            return out
+        with self._lock:
+            traces = {t.trace_id: t for t in self._ring}
+            for rec in remote_spans:
+                tid = rec.get("trace")
+                if not tid:
+                    out["unattributed"] += 1
+                    continue
+                trace = traces.get(tid)
+                if trace is None:
+                    out["orphaned"] += 1
+                    continue
+                rid = rec.get("id")
+                if rid in trace.grafted:
+                    out["duplicate"] += 1
+                    continue
+                trace.grafted.add(rid)
+                parent = None
+                parent_id = rec.get("parent")
+                if parent_id:
+                    for sp in trace.spans:
+                        if sp.span_id == parent_id:
+                            parent = sp
+                            break
+                dur = max(0.0, float(rec.get("dur_s") or 0.0))
+                if parent is not None:
+                    start = parent.start_s + max(
+                        0.0, (parent.duration_s - dur) / 2.0)
+                    pid = parent.span_id
+                else:
+                    # Client span lost (span cap) or never existed:
+                    # hang off the root at the trace's tail.
+                    start = max(0.0, trace.duration_ms / 1e3 - dur)
+                    pid = (trace.root.span_id
+                           if trace.root is not None else None)
+                srv = Span(trace.trace_id, f"s{next(self._ids)}", pid,
+                           str(rec.get("name") or "server"),
+                           str(rec.get("kind") or "server_request"),
+                           start)
+                srv.duration_s = dur
+                srv.attrs.update(
+                    {k: rec[k] for k in ("path", "status", "bytes_in",
+                                         "bytes_out", "frames",
+                                         "lag_frames", "stream")
+                     if k in rec})
+                srv.attrs["remote_id"] = rid
+                self._record_span(trace, srv)
+                cursor = start
+                phases = rec.get("phases") or {}
+                for phase in self._SERVER_PHASES:
+                    phase_s = max(0.0, float(phases.get(phase) or 0.0))
+                    if phase_s <= 0.0:
+                        continue
+                    child = Span(trace.trace_id, f"s{next(self._ids)}",
+                                 srv.span_id,
+                                 f"{srv.name}:{phase}",
+                                 f"server_{phase}", cursor)
+                    child.duration_s = phase_s
+                    cursor += phase_s
+                    self._record_span(trace, child)
+                out["grafted"] += 1
+        if out["grafted"]:
+            METRICS.inc("wire_spans_grafted_total", out["grafted"])
+        if out["orphaned"]:
+            METRICS.inc("wire_spans_orphaned_total", out["orphaned"])
+        if out["duplicate"]:
+            METRICS.inc("wire_spans_duplicate_total", out["duplicate"])
+        if out["unattributed"]:
+            METRICS.inc("wire_spans_unattributed_total",
+                        out["unattributed"])
+        return out
+
+    def attach_wire_summary(self, trace_id: str | None,
+                            wire: dict) -> bool:
+        """Attach this cycle's wire-counter delta (wireobs.wire_delta)
+        to its finalized ring trace — the `wire` section each row of
+        ``GET /debug/cycles`` carries."""
+        if trace_id is None or not wire:
+            return False
+        with self._lock:
+            for trace in reversed(self._ring):
+                if trace.trace_id == trace_id:
+                    trace.wire = dict(wire)
+                    return True
+        return False
 
     def export_chrome(self, key: str | None = None) -> dict | None:
         """Chrome-trace JSON for one ring entry, serialized UNDER the

@@ -1,0 +1,218 @@
+"""Wire observatory: shared byte/syscall accounting + server span ring.
+
+PR 19 makes the control-plane transport measurable end to end.  Both
+dialect ends (``controllers/httpclient.HTTPKubeAPI`` and
+``controllers/apiserver.KubeAPIServer``) funnel their accounting through
+THIS module so the metric families keep one label-key set at every call
+site (the KAI008 metrics-hygiene contract) and so the two ends agree on
+what a request class is:
+
+- ``wire_bytes_total{path,dir,end}``: request/response BODY bytes (and
+  watch frame bytes) per request class, direction (``in``/``out``) and
+  dialect end (``client``/``server``).  Body bytes, not raw socket
+  bytes: the reconciliation contract (tests/test_wiretrace.py) is
+  client-sent body bytes == server-received body bytes ± faulted or
+  refused requests, which header framing would blur.
+- ``wire_syscalls_total{path,op,end}``: sendall/recv *call* counts per
+  request class — the structural cost the future binary-codec PR
+  (ROADMAP item 1) must drive down.  One count per logical send/recv
+  call at the seam, deterministic, not a strace.
+- ``frame_cache_bytes_total{src}``: bytes served from the preserialized
+  frame cache (``src="cache"``) vs bytes that paid a fresh
+  ``json.dumps`` (``src="encode"``) — the BYTE-weighted companion of
+  ``watch_frame_cache_hits/misses_total``, gated as a hit ratio by
+  tools/fleet_budget.py.
+- ``watch_fanout_frames_total{stream}`` / ``watch_fanout_bytes_total
+  {stream}``: per-watcher fanout volume, labeled by the watcher's
+  bounded stream slot (< MAX_WATCH_STREAMS, never a client identity —
+  label cardinality stays bounded by construction).
+- ``watch_fanout_lag_frames{stream}`` (gauge): frames still buffered
+  in the event ring behind this watcher after its last burst — the
+  "slowest watcher" blind spot.
+- ``watch_stream_queue_depth{stream}`` (gauge): the send-queue depth
+  of one streamer at burst time; a depth beyond ``watch_queue_cap()``
+  answers an explicit GONE (``watch_stream_depth_gone_total``) instead
+  of buffering without bound.
+
+``SpanRing`` is the apiserver's bounded buffer of completed server-side
+span records, served at ``GET /debug/spans?since=`` and grafted into
+the scheduler's flight-recorder traces by ``Tracer.graft_remote_spans``
+(utils/tracing.py).  All timing near this module is
+``time.perf_counter`` (KAI003).
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+from collections import deque
+
+from .metrics import METRICS
+
+# Request classes both ends agree on (the `path` label's vocabulary).
+PATH_CLASSES = ("list", "get", "mutate", "bulk", "watch", "digest")
+
+SPAN_RING_DEFAULT = 2048
+
+
+def watch_queue_cap() -> int:
+    """Max frames a watch streamer may buffer for one burst before the
+    watcher is declared too slow and answered GONE (satellite fix:
+    previously a stalled watcher could accumulate the whole event ring
+    into one in-flight buffer).  Env-tunable per test."""
+    try:
+        return max(1, int(os.environ.get("KAI_WATCH_QUEUE_CAP", 10000)))
+    except ValueError:
+        return 10000
+
+
+def path_class(method: str, path: str) -> str:
+    """Classify one request path into the bounded `path` label set.
+    Shared by both dialect ends so client-sent and server-received
+    series line up key for key."""
+    if path.startswith("/watch"):
+        return "watch"
+    if path.startswith("/bulk"):
+        return "bulk"
+    if path.startswith("/digest"):
+        return "digest"
+    if path.startswith("/relist"):
+        return "list"
+    if path.startswith("/apis"):
+        parts = [p for p in path.partition("?")[0].split("/") if p]
+        named = len(parts) > 3  # /apis/{kind}/{ns}/{name}
+        if method == "GET":
+            return "get" if named else "list"
+        return "mutate"
+    return "get"  # /healthz, /debug/*, unknown routes
+
+
+def count_bytes(end: str, path: str, direction: str, n: int) -> None:
+    """``wire_bytes_total{dir,end,path}`` — body bytes at one seam."""
+    if n:
+        METRICS.inc("wire_bytes_total", float(n),
+                    dir=direction, end=end, path=path)
+
+
+def count_syscall(end: str, path: str, op: str, n: int = 1) -> None:
+    """``wire_syscalls_total{end,op,path}`` — sendall/recv call counts."""
+    METRICS.inc("wire_syscalls_total", float(n),
+                end=end, op=op, path=path)
+
+
+def count_frame_bytes(src: str, n: int) -> None:
+    """``frame_cache_bytes_total{src}`` — cache-served vs freshly
+    encoded bytes (src ``cache`` | ``encode``)."""
+    if n:
+        METRICS.inc("frame_cache_bytes_total", float(n), src=src)
+
+
+def note_fanout(stream: int, frames: int, nbytes: int, lag: int) -> None:
+    """One watch fanout burst shipped to stream slot ``stream``."""
+    slot = str(stream)
+    if frames:
+        METRICS.inc("watch_fanout_frames_total", float(frames),
+                    stream=slot)
+    if nbytes:
+        METRICS.inc("watch_fanout_bytes_total", float(nbytes),
+                    stream=slot)
+    METRICS.set_gauge("watch_fanout_lag_frames", float(max(0, lag)),
+                      stream=slot)
+
+
+def note_stream_depth(stream: int, depth: int) -> None:
+    """``watch_stream_queue_depth{stream}`` — the streamer's send-queue
+    depth (frames pending behind its cursor) at burst time."""
+    METRICS.set_gauge("watch_stream_queue_depth", float(depth),
+                      stream=str(stream))
+
+
+# Counter families the per-cycle `wire` section and the fleet budget
+# fold over (gauges are point-in-time, not deltas — excluded).
+WIRE_COUNTER_FAMILIES = (
+    "wire_bytes_total",
+    "wire_syscalls_total",
+    "frame_cache_bytes_total",
+    "frame_cache_serve_encodes_total",
+    "watch_fanout_frames_total",
+    "watch_fanout_bytes_total",
+    "watch_frame_cache_hits_total",
+    "watch_frame_cache_misses_total",
+    "watch_stream_depth_gone_total",
+)
+
+
+def wire_totals() -> dict:
+    """Flat snapshot of every wire-observatory counter series, keyed by
+    the rendered series name — ``/debug/cycles``' top-level ``wire``
+    section, and the operand of ``wire_delta`` for the per-cycle
+    section each CycleTrace carries."""
+    out = {}
+    # Lock-free read of a monotonically growing counter dict: at worst
+    # one tick stale (the Metrics read contract).
+    for key, value in list(METRICS.counters.items()):
+        if key.partition("{")[0] in WIRE_COUNTER_FAMILIES:
+            out[key] = value
+    return out
+
+
+def wire_delta(prev: dict, cur: dict) -> dict:
+    """Series that moved between two ``wire_totals`` snapshots."""
+    return {key: round(value - prev.get(key, 0), 3)
+            for key, value in cur.items()
+            if value != prev.get(key, 0)}
+
+
+class SpanRing:
+    """Bounded ring of completed server-side span records.
+
+    The apiserver records one dict per finished request (phases,
+    byte counts, the client's injected trace context) and per watch
+    fanout burst; ``GET /debug/spans?since=N`` serves the tail past a
+    client cursor.  Records carry contiguous monotone ids, so the
+    ``since`` read is a tail slice (O(result)), exactly like
+    ``EventLog.since``.  Bounded by construction: a scheduler that
+    never pulls costs the server ``capacity`` dicts, not memory
+    proportional to uptime."""
+
+    def __init__(self, capacity: int | None = None):
+        if capacity is None:
+            try:
+                capacity = int(os.environ.get("KAI_SERVER_SPAN_RING",
+                                              SPAN_RING_DEFAULT))
+            except ValueError:
+                capacity = SPAN_RING_DEFAULT
+        self.capacity = max(16, capacity)
+        self._ring: deque = deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self._next = 0
+
+    def record(self, rec: dict) -> int:
+        """Append one completed span record; returns its id."""
+        with self._lock:
+            self._next += 1
+            rec = dict(rec)
+            rec["id"] = self._next
+            self._ring.append(rec)
+            return self._next
+
+    def since(self, after: int) -> tuple[int, list]:
+        """(head_id, records with id > after).  A cursor from before
+        the ring's horizon simply yields the whole retained window —
+        span records are observability, not state: missing ones are
+        counted by the ring's bound, never a correctness gap."""
+        with self._lock:
+            head = self._next
+            missing = head - after
+            if missing <= 0:
+                return head, []
+            if missing >= len(self._ring):
+                return head, list(self._ring)
+            tail = list(itertools.islice(reversed(self._ring), missing))
+            tail.reverse()
+            return head, tail
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)

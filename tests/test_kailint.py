@@ -734,6 +734,80 @@ class TestKAI008MetricsHygiene:
                    and "bulk_write_batches_total" in f.message
                    for f in findings)
 
+    def test_wireobs_families_consistent_usage_is_clean(self):
+        # PR 19's wire-observatory families (utils/wireobs.py single
+        # call sites): byte/syscall counters per request class on both
+        # dialect ends, frame-cache byte split, fanout counters, the
+        # depth gauge, and the graft outcome counters.
+        src = ("from ..utils.metrics import METRICS\n"
+               "def f(v, p, s):\n"
+               "    METRICS.inc('wire_bytes_total', v, dir='in',"
+               " end='client', path=p)\n"
+               "    METRICS.inc('wire_bytes_total', v, dir='out',"
+               " end='server', path=p)\n"
+               "    METRICS.inc('wire_syscalls_total', v, end='client',"
+               " op='send', path=p)\n"
+               "    METRICS.inc('frame_cache_bytes_total', v,"
+               " src='cache')\n"
+               "    METRICS.inc('frame_cache_serve_encodes_total')\n"
+               "    METRICS.inc('watch_fanout_frames_total', v,"
+               " stream=s)\n"
+               "    METRICS.inc('watch_fanout_bytes_total', v,"
+               " stream=s)\n"
+               "    METRICS.set_gauge('watch_fanout_lag_frames', v,"
+               " stream=s)\n"
+               "    METRICS.set_gauge('watch_stream_queue_depth', v,"
+               " stream=s)\n"
+               "    METRICS.inc('watch_stream_depth_gone_total')\n"
+               "    METRICS.inc('wire_spans_grafted_total', v)\n"
+               "    METRICS.inc('wire_spans_orphaned_total', v)\n"
+               "    METRICS.inc('wire_spans_duplicate_total', v)\n"
+               "    METRICS.inc('wire_spans_unattributed_total', v)\n")
+        findings = lint(("kai_scheduler_tpu/utils/fix.py", src))
+        assert [f for f in findings if f.rule == "KAI008"] == []
+
+    def test_wireobs_family_label_drift_fires(self):
+        # A wire_bytes_total call dropping its `end` label (or a fanout
+        # counter dropping `stream`) would fork the family's label-key
+        # set and break wire_totals()'s reconciliation fold.
+        a = ("from ..utils.metrics import METRICS\n"
+             "def f(v, p):\n"
+             "    METRICS.inc('wire_bytes_total', v, dir='in',"
+             " end='client', path=p)\n")
+        b = ("from ..utils.metrics import METRICS\n"
+             "def g(v, p):\n"
+             "    METRICS.inc('wire_bytes_total', v, dir='in', path=p)\n")
+        findings = lint(("kai_scheduler_tpu/utils/a.py", a),
+                        ("kai_scheduler_tpu/controllers/b.py", b))
+        assert any(f.rule == "KAI008" and "label keys" in f.message
+                   and "wire_bytes_total" in f.message
+                   for f in findings)
+        c = ("from ..utils.metrics import METRICS\n"
+             "def h(v, s):\n"
+             "    METRICS.set_gauge('watch_fanout_lag_frames', v,"
+             " stream=s)\n"
+             "    METRICS.set_gauge('watch_fanout_lag_frames', v)\n")
+        findings = lint(("kai_scheduler_tpu/controllers/c.py", c))
+        assert any(f.rule == "KAI008" and "label keys" in f.message
+                   and "watch_fanout_lag_frames" in f.message
+                   for f in findings)
+
+    def test_wireobs_cross_instrument_collision_fires(self):
+        # The depth gauge reused as a counter would double-register the
+        # family in the exposition.
+        a = ("from ..utils.metrics import METRICS\n"
+             "def f(v, s):\n"
+             "    METRICS.set_gauge('watch_stream_queue_depth', v,"
+             " stream=s)\n")
+        b = ("from ..utils.metrics import METRICS\n"
+             "def g(s):\n"
+             "    METRICS.inc('watch_stream_queue_depth', stream=s)\n")
+        findings = lint(("kai_scheduler_tpu/utils/a.py", a),
+                        ("kai_scheduler_tpu/controllers/b.py", b))
+        assert any(f.rule == "KAI008" and "one instrument" in f.message
+                   and "watch_stream_queue_depth" in f.message
+                   for f in findings)
+
     def test_cycle_span_cross_instrument_collision_fires(self):
         # A counter reusing a cycle_span_* histogram name would double-
         # register the family in the exposition: the whole-tree pass

@@ -543,6 +543,39 @@ class TestWireFaultsDryRun:
         assert "tests/test_wire_protocol.py" in out
 
 
+class TestWiretraceDryRun:
+    def test_dry_run_wiretrace_mode_selects_observatory_ring(
+            self, capsys, monkeypatch):
+        """--wiretrace sweeps the wire-observatory ring (distributed
+        trace join, span-ring bounds, byte reconciliation under wire
+        faults, watch depth-cap GONE); composes with the other
+        suite-selection modes."""
+        from kai_scheduler_tpu.tools import chaos_matrix
+        monkeypatch.setattr(
+            chaos_matrix.subprocess, "run",
+            lambda *a, **kw: (_ for _ in ()).throw(AssertionError(
+                "dry run must not execute iterations")))
+        rc = chaos_matrix.main(["--dry-run", "--wiretrace",
+                                "--seeds", "3,5"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert out.count("seed ") == 2
+        assert "tests/test_wiretrace.py" in out
+        assert "tests/test_reconciler.py" not in out
+        rc = chaos_matrix.main(["--dry-run", "--wiretrace",
+                                "--wire-faults", "--pipeline",
+                                "--seeds", "3"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "tests/test_wiretrace.py" in out
+        assert "tests/test_wire_faults.py" in out
+        assert "tests/test_pipeline_cycle.py" in out
+        # Without the flag the observatory ring stays out of the grid.
+        rc = chaos_matrix.main(["--dry-run", "--seeds", "3"])
+        assert rc == 0
+        assert "tests/test_wiretrace.py" not in capsys.readouterr().out
+
+
 class TestConformanceDryRun:
     """tools/conformance.py: one command for every proof; the dry run
     validates the step plan without spawning anything."""

@@ -1,0 +1,339 @@
+"""Wire observatory chaos suite (PR 19): distributed trace joins and
+byte/syscall reconciliation across both dialect ends.
+
+Covers the tentpole contracts:
+
+- a clean http fleet cycle produces ONE joined distributed trace:
+  scheduler-side spans, client ``wire`` spans, grafted
+  ``server_request`` spans and their phase children (``server_handler``
+  / ``server_serialize`` / ``server_sendall`` / ``server_queue_wait``)
+  all under one trace id, exportable to Perfetto/Chrome;
+- ``GET /debug/spans?since=`` cursor semantics, the bounded span ring,
+  and the self-exclusion rule (the pull itself never generates spans);
+- under ``wire-corrupt``/``wire-reset``/``wire-drop`` faults, spans are
+  never leaked or double-grafted (re-grafting the same records counts
+  duplicates and adds nothing) and the byte counters still reconcile:
+  server-received body bytes never exceed client-sent body bytes per
+  request class;
+- a watcher that falls behind ``KAI_WATCH_QUEUE_CAP`` gets an explicit
+  GONE (``watch_stream_depth_gone_total``) instead of buffering without
+  bound, and converges through the re-list (satellite fix).
+
+Seeded in the chaos-matrix style: ``KAI_FAULT_SEED`` reshuffles the
+churn per iteration (``chaos_matrix --wiretrace`` sweeps it).
+"""
+
+import os
+import time
+import urllib.error
+
+import numpy as np
+import pytest
+
+from kai_scheduler_tpu.controllers import (HTTPKubeAPI, KubeAPIServer,
+                                           System, SystemConfig, make_pod,
+                                           owner_ref)
+from kai_scheduler_tpu.controllers.kubeapi import Conflict
+from kai_scheduler_tpu.utils import wireobs
+from kai_scheduler_tpu.utils.metrics import METRICS
+from kai_scheduler_tpu.utils.metrics import _key as _metric_key
+from kai_scheduler_tpu.utils.tracing import TRACER
+
+pytestmark = pytest.mark.chaos
+
+SWEEP_SEED = int(os.environ.get("KAI_FAULT_SEED", "0") or 0)
+
+
+def _counter(name, **labels):
+    return METRICS.counters.get(_metric_key(name, labels), 0)
+
+
+def make_node(api, name, gpu=8):
+    api.create({"kind": "Node", "metadata": {"name": name}, "spec": {},
+                "status": {"allocatable": {"cpu": "32", "memory": "256Gi",
+                                           "nvidia.com/gpu": gpu,
+                                           "pods": 110}}})
+
+
+def make_queue(api, name="fq0"):
+    api.create({"kind": "Queue", "metadata": {"name": name}, "spec": {}})
+
+
+def _bound_pods(store_api):
+    return [p for p in store_api.list("Pod")
+            if p["spec"].get("nodeName")
+            and not p["metadata"].get("deletionTimestamp")]
+
+
+def _client_out_server_in(delta):
+    """Per path class: (client-sent, server-received) body bytes."""
+    out = {}
+    for p in wireobs.PATH_CLASSES:
+        co = delta.get(_metric_key("wire_bytes_total",
+                                   {"dir": "out", "end": "client",
+                                    "path": p}), 0)
+        si = delta.get(_metric_key("wire_bytes_total",
+                                   {"dir": "in", "end": "server",
+                                    "path": p}), 0)
+        out[p] = (co, si)
+    return out
+
+
+def _ring_span_count():
+    """Total spans held across every retained cycle trace."""
+    total = 0
+    for summary in TRACER.cycles():
+        trace = TRACER.get_trace(summary["trace_id"])
+        if trace is not None:
+            total += len(trace.spans)
+    return total
+
+
+class TestDistributedTraceJoin:
+    def test_clean_fleet_cycle_joins_one_trace(self):
+        """The flagship: a clean http fleet cycle ends up as ONE joined
+        trace — client wire spans with grafted server_request children
+        carrying >= 3 server-side phase kinds — with the per-cycle
+        ``wire`` section attached and zero orphans on a clean wire."""
+        wire0 = wireobs.wire_totals()
+        orphan0 = _counter("wire_spans_orphaned_total")
+        srv = KubeAPIServer().start()
+        client = HTTPKubeAPI(srv.url)
+        system = System(SystemConfig(), api=client)
+        try:
+            for i in range(4):
+                make_node(client, f"n{i}")
+            make_queue(client)
+            ref = owner_ref("Job", "tj", uid="tj-u",
+                            api_version="batch/v1")
+            for k in range(8):
+                client.create(make_pod(f"tj-{k}", owner=ref, gpu=1,
+                                       queue="fq0"))
+            for _ in range(4):
+                system.run_cycle()
+                if len(_bound_pods(srv.api)) >= 8:
+                    break
+            assert len(_bound_pods(srv.api)) >= 8
+        finally:
+            client.close()
+            system.stop_pipeline()
+            srv.stop()
+
+        joined = None
+        for summary in TRACER.cycles():
+            trace = TRACER.get_trace(summary["trace_id"])
+            if trace is None:
+                continue
+            kinds = {s.kind for s in trace.spans}
+            if "wire" in kinds and "server_request" in kinds:
+                joined = (summary, trace, kinds)
+                break
+        assert joined is not None, \
+            "no cycle trace joined client and server spans"
+        summary, trace, kinds = joined
+        # ONE trace: every span (scheduler, client, grafted server)
+        # carries the owning cycle's trace id.
+        assert {s.trace_id for s in trace.spans} == {trace.trace_id}
+        phase_kinds = {k for k in kinds if k.startswith("server_")
+                       and k != "server_request"}
+        assert len(phase_kinds) >= 3, \
+            f"need >=3 server phase kinds, got {sorted(phase_kinds)}"
+        # Grafted server spans START inside their client parent (the
+        # centered-join contract: residual gap = wire time).  End
+        # containment is NOT asserted: the server's post-write
+        # timestamp can land after the client already read the
+        # response (GIL handoff on loopback), so a server duration may
+        # honestly overhang its parent by the scheduling delay.
+        by_id = {s.span_id: s for s in trace.spans}
+        checked = 0
+        for srv_span in trace.spans:
+            if srv_span.kind != "server_request":
+                continue
+            parent = by_id.get(srv_span.parent_id)
+            assert parent is not None
+            if parent.kind == "wire":
+                checked += 1
+                assert srv_span.start_s >= parent.start_s - 1e-9
+                assert (srv_span.start_s <= parent.start_s
+                        + parent.duration_s + 1e-9)
+        assert checked > 0, "no server span joined a client wire span"
+        # Perfetto/Chrome export of the joined trace.
+        chrome = TRACER.export_chrome(trace.trace_id)
+        assert chrome and chrome["traceEvents"]
+        exported_kinds = {e["cat"] for e in chrome["traceEvents"]}
+        assert "server_request" in exported_kinds
+        # The per-cycle wire section rode the summary.
+        assert summary.get("wire"), "cycle summary missing wire section"
+        # Clean wire: nothing orphaned, and the client-sent bytes the
+        # server received reconcile EXACTLY per request class.
+        assert _counter("wire_spans_orphaned_total") == orphan0
+        delta = wireobs.wire_delta(wire0, wireobs.wire_totals())
+        moved = 0
+        for p, (client_out, server_in) in \
+                _client_out_server_in(delta).items():
+            assert client_out == server_in, \
+                f"{p}: client sent {client_out} != server got {server_in}"
+            moved += client_out
+        assert moved > 0, "no request bodies moved at all"
+
+
+class TestSpansEndpoint:
+    def test_cursor_semantics_ring_bound_and_self_exclusion(
+            self, monkeypatch):
+        monkeypatch.setenv("KAI_SERVER_SPAN_RING", "32")
+        srv = KubeAPIServer().start()
+        client = HTTPKubeAPI(srv.url)
+        try:
+            for i in range(50):
+                client._request("GET", "/healthz")
+            out = client._request("GET", "/debug/spans?since=0",
+                                  observe=False)
+            # Bounded ring: >= 50 requests recorded, only the last 32
+            # retained; ids stay contiguous and monotone.
+            assert out["next"] >= 50
+            assert len(out["spans"]) == 32
+            assert len(srv.spans) <= 32
+            ids = [r["id"] for r in out["spans"]]
+            assert ids == sorted(ids) and ids[-1] == out["next"]
+            # Cursor: a second pull past the head returns nothing new.
+            again = client._request(
+                "GET", f"/debug/spans?since={out['next']}",
+                observe=False)
+            assert again["spans"] == []
+            # Self-exclusion: the pulls above must not have recorded
+            # themselves (a self-feeding ring never drains).
+            assert again["next"] == out["next"]
+        finally:
+            client.close()
+            srv.stop()
+
+
+class TestGraftSafetyUnderFaults:
+    def test_no_leak_or_double_graft_and_bytes_reconcile(
+            self, monkeypatch):
+        """Churn a fleet over a lying wire, then re-graft the server's
+        full span window twice: the second pass must add NOTHING
+        (duplicates counted, span totals unchanged), and per-class
+        server-received bytes never exceed client-sent bytes."""
+        rng = np.random.default_rng(3000 + SWEEP_SEED)
+        wire0 = wireobs.wire_totals()
+        srv = KubeAPIServer().start()
+        client = HTTPKubeAPI(srv.url)
+        system = None
+        try:
+            for i in range(4):
+                make_node(client, f"n{i}")
+            make_queue(client)
+            # Arm the lying wire BEFORE the System exists: watch
+            # streams read their fault spec at attach time, so arming
+            # after the prime would leave the established stream
+            # permanently clean.
+            monkeypatch.setenv(
+                "KAI_FAULT_INJECT",
+                "wire-corrupt:2,wire-reset:11,wire-drop:13")
+            system = System(SystemConfig(), api=client)
+            submitted = 0
+            for wave in range(2):
+                gang = int(rng.integers(3, 7))
+                ref = owner_ref("Job", f"g{wave}", uid=f"g{wave}-u",
+                                api_version="batch/v1")
+                for k in range(gang):
+                    for _ in range(6):
+                        try:
+                            client.create(make_pod(
+                                f"g{wave}-{k}", owner=ref, gpu=1,
+                                queue="fq0"))
+                            break
+                        except Conflict:
+                            break
+                        except (urllib.error.URLError, OSError):
+                            time.sleep(0.05)
+                    else:
+                        raise AssertionError("submit never landed")
+                submitted += gang
+                for _ in range(12):
+                    try:
+                        system.run_cycle()
+                    except (urllib.error.URLError, OSError):
+                        pass
+                    if len(_bound_pods(srv.api)) >= submitted:
+                        break
+                    time.sleep(0.05)
+            for mode in ("wire-corrupt", "wire-reset", "wire-drop"):
+                assert _counter("wire_faults_injected_total",
+                                mode=mode) > 0, f"{mode} never fired"
+            monkeypatch.setenv("KAI_FAULT_INJECT", "")
+            system.run_cycle()  # healed: last pull + graft
+
+            # Server span ring stayed within its bound throughout.
+            assert len(srv.spans) <= srv.spans.capacity
+
+            # Re-graft the server's ENTIRE retained window (cursor 0 —
+            # every record the operator already grafted comes back).
+            window = client._request("GET", "/debug/spans?since=0",
+                                     observe=False)["spans"]
+            assert window, "span window empty after a full churn"
+            before = _ring_span_count()
+            g1 = TRACER.graft_remote_spans(window)
+            mid = _ring_span_count()
+            g2 = TRACER.graft_remote_spans(window)
+            after = _ring_span_count()
+            # Anything g1 newly grafted (records the operator's last
+            # pull missed) grows the ring once; g2 must add ZERO.
+            assert g2["grafted"] == 0
+            assert g2["duplicate"] == g1["duplicate"] + g1["grafted"]
+            assert g2["unattributed"] == g1["unattributed"]
+            assert after == mid, \
+                f"double-graft leaked spans: {mid} -> {after}"
+            assert mid >= before
+        finally:
+            client.close()
+            if system is not None:
+                system.stop_pipeline()
+            srv.stop()
+
+        # Byte reconciliation survives the faults: the server can never
+        # have RECEIVED more body bytes than clients sent (attempts are
+        # counted client-side; reset/drop lose, never invent, bytes).
+        delta = wireobs.wire_delta(wire0, wireobs.wire_totals())
+        recon = _client_out_server_in(delta)
+        for p, (client_out, server_in) in recon.items():
+            assert server_in <= client_out, \
+                f"{p}: server got {server_in} > client sent {client_out}"
+        assert recon["mutate"][0] > 0 or recon["bulk"][0] > 0
+
+
+class TestWatchDepthCap:
+    def test_slow_watcher_gets_explicit_gone_and_relists(
+            self, monkeypatch):
+        """A watcher whose pending backlog exceeds KAI_WATCH_QUEUE_CAP
+        gets an explicit GONE (never an unbounded in-flight buffer) and
+        converges through the client's re-list recovery."""
+        monkeypatch.setenv("KAI_WATCH_QUEUE_CAP", "25")
+        srv = KubeAPIServer().start()
+        client = HTTPKubeAPI(srv.url)
+        try:
+            gone0 = _counter("watch_stream_depth_gone_total")
+            # Backlog first: 120 events land BEFORE any watcher exists,
+            # so the first burst's send queue is 120 > 25.
+            for i in range(120):
+                client.create(make_pod(f"dq{i:03d}"))
+            client.watch("Pod", lambda et, obj: None)
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                if _counter("watch_stream_depth_gone_total") > gone0 \
+                        and len([k for k in client._known
+                                 if k[0] == "Pod"]) == 120:
+                    break
+                time.sleep(0.05)
+            assert _counter("watch_stream_depth_gone_total") > gone0, \
+                "depth overrun never surfaced as GONE"
+            assert len([k for k in client._known if k[0] == "Pod"]) \
+                == 120, "client never converged after depth GONE"
+            # The depth gauge family exists and is slot-labeled.
+            assert any(k.startswith("watch_stream_queue_depth{")
+                       for k in METRICS.gauges), \
+                "watch_stream_queue_depth gauge never exported"
+        finally:
+            client.close()
+            srv.stop()
